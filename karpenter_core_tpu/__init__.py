@@ -1,0 +1,31 @@
+"""karpenter_core_tpu — a TPU-native node-provisioning autoscaler framework.
+
+A ground-up re-design of the capabilities of aws/karpenter-core (reference at
+/root/reference) around one idea: *scheduling and consolidation are batch tensor
+programs over a cluster snapshot*.  The event-driven Go controller mesh of the
+reference becomes a thin async reconciler shell (``controllers/``, ``operator/``)
+around a pure, jittable solver core (``ops/``, ``models/``) that runs on TPU via
+jit/shard_map, sharded over a ``jax.sharding.Mesh`` for multi-chip scale
+(``parallel/``).
+
+Layer map (mirrors SURVEY.md §1):
+  - ``apis``            — API types: Provisioner, Machine, label taxonomy, settings.
+  - ``scheduling``      — host-side constraint algebra (Requirements, Taints,
+                          HostPortUsage, VolumeUsage); the exact-semantics oracle.
+  - ``models``          — dense tensor encodings of cluster snapshots (pods, nodes,
+                          instance types, offerings) and the value-vocabulary codec.
+  - ``ops``             — JAX kernels: requirement-mask algebra, bin-pack solve,
+                          topology reductions, consolidation search.
+  - ``parallel``        — device-mesh sharding of the solve (pod axis DP,
+                          candidate-subset vmap, Monte-Carlo replicas).
+  - ``solver``          — the Scheduler: host relaxation/queue loop around the
+                          jitted kernels; Node/ExistingNode/MachineTemplate.
+  - ``cloudprovider``   — vendor SPI + fake provider + instance-type catalogs.
+  - ``state``           — in-memory cluster state cache (the solve's input snapshot).
+  - ``controllers``     — provisioning, deprovisioning, node lifecycle, termination,
+                          inflight checks, counter, metrics scrapers.
+  - ``operator``        — operator runtime: options, settings, controller framework.
+  - ``events``/``metrics``/``utils`` — cross-cutting.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+from karpenter_core_tpu.cloudprovider.types import (
+    CloudProvider,
+    InstanceType,
+    MachineNotFoundError,
+    Offering,
+    Offerings,
+)
+
+__all__ = ["CloudProvider", "InstanceType", "MachineNotFoundError", "Offering", "Offerings"]

@@ -1,0 +1,3 @@
+from karpenter_core_tpu.events.recorder import Event, Recorder
+
+__all__ = ["Event", "Recorder"]

@@ -1,0 +1,99 @@
+"""Typed event constructors (mirror of /root/reference/pkg/events/events.go:27-75)."""
+
+from __future__ import annotations
+
+from karpenter_core_tpu.apis.objects import Node, Pod
+from karpenter_core_tpu.events.recorder import Event
+
+
+def nominate_pod(pod: Pod, node: Node) -> Event:
+    return Event(
+        involved_object=pod,
+        type="Normal",
+        reason="Nominated",
+        message=(
+            f"Pod should schedule on node {node.name}"
+        ),
+        dedupe_values=[pod.namespace, pod.name, node.name],
+    )
+
+
+def evict_pod(pod: Pod) -> Event:
+    return Event(
+        involved_object=pod,
+        type="Normal",
+        reason="Evicted",
+        message="Evicted pod",
+        dedupe_values=[pod.namespace, pod.name],
+    )
+
+
+def pod_failed_to_schedule(pod: Pod, err: str) -> Event:
+    return Event(
+        involved_object=pod,
+        type="Warning",
+        reason="FailedScheduling",
+        message=f"Failed to schedule pod, {err}",
+        dedupe_values=[pod.namespace, pod.name, err],
+    )
+
+
+def node_failed_to_drain(node: Node, err: str) -> Event:
+    return Event(
+        involved_object=node,
+        type="Warning",
+        reason="FailedDraining",
+        message=f"Failed to drain node, {err}",
+        dedupe_values=[node.name],
+    )
+
+
+def node_inflight_check(node: Node, message: str) -> Event:
+    return Event(
+        involved_object=node,
+        type="Warning",
+        reason="FailedInflightCheck",
+        message=message,
+        dedupe_values=[node.name, message],
+    )
+
+
+def terminating_node(node: Node, reason: str) -> Event:
+    return Event(
+        involved_object=node,
+        type="Normal",
+        reason="DeprovisioningTerminating",
+        message=f"Deprovisioning node via {reason}",
+        dedupe_values=[node.name, reason],
+    )
+
+
+def launching_node(node_repr: str, reason: str) -> Event:
+    return Event(
+        involved_object=node_repr,
+        type="Normal",
+        reason="DeprovisioningLaunching",
+        message=f"Launching node for {reason}",
+        dedupe_values=[node_repr, reason],
+    )
+
+
+def waiting_on_readiness(node_repr: str) -> Event:
+    return Event(
+        involved_object=node_repr,
+        type="Normal",
+        reason="DeprovisioningWaitingReadiness",
+        message="Waiting on readiness to continue deprovisioning",
+        dedupe_values=[str(node_repr)],
+    )
+
+
+def unconsolidatable(node: Node, reason: str) -> Event:
+    return Event(
+        involved_object=node,
+        type="Normal",
+        reason="Unconsolidatable",
+        message=reason,
+        dedupe_values=[node.name, reason],
+        rate_limit_qps=None,
+    )

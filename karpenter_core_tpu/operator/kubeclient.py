@@ -1,0 +1,228 @@
+"""In-memory object store standing where the kube-apiserver stands.
+
+The reference's distributed communication backend is the apiserver watch/list
+plane (SURVEY.md §5.8; controller-runtime informers).  This framework is
+standalone: the KubeClient is the single source of truth for API objects, with
+list/get/create/update/delete plus watch callbacks that pump the state cluster
+informers (karpenter_core_tpu.state.informer).  Thread-safe; watch events are
+delivered synchronously in the mutating thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from karpenter_core_tpu.apis.objects import (
+    CSINode,
+    LabelSelector,
+    Namespace,
+    Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    PodDisruptionBudget,
+    StorageClass,
+    deep_copy,
+)
+from karpenter_core_tpu.apis.v1alpha5 import Machine, Provisioner
+
+WatchFunc = Callable[[str, object], None]  # (event_type, object); ADDED|MODIFIED|DELETED
+
+
+class ConflictError(Exception):
+    pass
+
+
+class NotFoundError(Exception):
+    pass
+
+
+class _Store:
+    """One kind's storage: keyed by (namespace, name) or name for cluster scope."""
+
+    def __init__(self, namespaced: bool) -> None:
+        self.namespaced = namespaced
+        self.objects: Dict[tuple, object] = {}
+        self.watchers: List[WatchFunc] = []
+
+    def key(self, obj) -> tuple:
+        meta = obj.metadata
+        return (meta.namespace, meta.name) if self.namespaced else (meta.name,)
+
+
+class KubeClient:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._stores: Dict[type, _Store] = {
+            Pod: _Store(True),
+            Node: _Store(False),
+            Provisioner: _Store(False),
+            Machine: _Store(False),
+            Namespace: _Store(False),
+            PodDisruptionBudget: _Store(True),
+            PersistentVolumeClaim: _Store(True),
+            PersistentVolume: _Store(False),
+            StorageClass: _Store(False),
+            CSINode: _Store(False),
+        }
+        self._resource_version = 0
+
+    # -- generic CRUD ---------------------------------------------------------
+
+    def _store(self, kind: type) -> _Store:
+        if kind not in self._stores:
+            self._stores[kind] = _Store(hasattr(kind, "namespace"))
+        return self._stores[kind]
+
+    def create(self, obj) -> object:
+        with self._lock:
+            store = self._store(type(obj))
+            key = store.key(obj)
+            if key in store.objects:
+                raise ConflictError(f"{type(obj).__name__} {key} already exists")
+            self._resource_version += 1
+            obj.metadata.resource_version = self._resource_version
+            store.objects[key] = obj
+            watchers = list(store.watchers)
+        for w in watchers:
+            w("ADDED", obj)
+        return obj
+
+    def get(self, kind: type, name: str, namespace: Optional[str] = None):
+        with self._lock:
+            store = self._store(kind)
+            key = (namespace, name) if store.namespaced else (name,)
+            return store.objects.get(key)
+
+    def update(self, obj) -> object:
+        with self._lock:
+            store = self._store(type(obj))
+            key = store.key(obj)
+            if key not in store.objects:
+                raise NotFoundError(f"{type(obj).__name__} {key} not found")
+            self._resource_version += 1
+            obj.metadata.resource_version = self._resource_version
+            store.objects[key] = obj
+            watchers = list(store.watchers)
+        for w in watchers:
+            w("MODIFIED", obj)
+        return obj
+
+    def apply(self, obj) -> object:
+        """create-or-update."""
+        with self._lock:
+            store = self._store(type(obj))
+            if store.key(obj) in store.objects:
+                return self.update(obj)
+            return self.create(obj)
+
+    def delete(self, obj, *, force: bool = False) -> None:
+        """Sets deletion timestamp; the object is removed once finalizers clear
+        (or immediately with no finalizers) — k8s deletion semantics."""
+        import time as _time
+
+        with self._lock:
+            store = self._store(type(obj))
+            key = store.key(obj)
+            stored = store.objects.get(key)
+            if stored is None:
+                raise NotFoundError(f"{type(obj).__name__} {key} not found")
+            if stored.metadata.finalizers and not force:
+                if stored.metadata.deletion_timestamp is None:
+                    stored.metadata.deletion_timestamp = _time.time()
+                    self._resource_version += 1
+                    stored.metadata.resource_version = self._resource_version
+                    watchers = list(store.watchers)
+                    event = ("MODIFIED", stored)
+                else:
+                    return
+            else:
+                del store.objects[key]
+                watchers = list(store.watchers)
+                event = ("DELETED", stored)
+        for w in watchers:
+            w(*event)
+
+    def remove_finalizer(self, obj, finalizer: str) -> None:
+        with self._lock:
+            store = self._store(type(obj))
+            stored = store.objects.get(store.key(obj))
+            if stored is None:
+                return
+            if finalizer in stored.metadata.finalizers:
+                stored.metadata.finalizers = [
+                    f for f in stored.metadata.finalizers if f != finalizer
+                ]
+            should_remove = (
+                stored.metadata.deletion_timestamp is not None
+                and not stored.metadata.finalizers
+            )
+        self.update(stored)
+        if should_remove:
+            self.delete(stored, force=True)
+
+    def list(self, kind: type, namespace: Optional[str] = None, selector=None) -> list:
+        with self._lock:
+            store = self._store(kind)
+            out = []
+            for key, obj in store.objects.items():
+                if namespace is not None and store.namespaced and key[0] != namespace:
+                    continue
+                if selector is not None and not _selector_matches(selector, obj):
+                    continue
+                out.append(obj)
+            return out
+
+    def watch(self, kind: type, callback: WatchFunc, *, replay: bool = True) -> None:
+        with self._lock:
+            store = self._store(kind)
+            store.watchers.append(callback)
+            existing = list(store.objects.values()) if replay else []
+        for obj in existing:
+            callback("ADDED", obj)
+
+    # -- typed conveniences (shapes used by scheduler/topology/volumes) -------
+
+    def list_pods(self, namespace: Optional[str] = None, selector=None) -> List[Pod]:
+        return self.list(Pod, namespace=namespace, selector=selector)
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        return self.get(Pod, name, namespace)
+
+    def get_node(self, name: str) -> Optional[Node]:
+        return self.get(Node, name)
+
+    def list_nodes(self) -> List[Node]:
+        return self.list(Node)
+
+    def list_namespaces(self, selector=None) -> List[Namespace]:
+        return self.list(Namespace, selector=selector)
+
+    def list_provisioners(self) -> List[Provisioner]:
+        return self.list(Provisioner)
+
+    def get_persistent_volume_claim(self, namespace: str, name: str):
+        return self.get(PersistentVolumeClaim, name, namespace)
+
+    def get_persistent_volume(self, name: str):
+        return self.get(PersistentVolume, name)
+
+    def get_storage_class(self, name: str):
+        return self.get(StorageClass, name)
+
+    def get_csi_node(self, name: str):
+        return self.get(CSINode, name)
+
+    def deep_copy(self, obj):
+        return deep_copy(obj)
+
+
+def _selector_matches(selector, obj) -> bool:
+    if isinstance(selector, LabelSelector):
+        return selector.matches(obj.metadata.labels)
+    if isinstance(selector, dict):
+        return all(obj.metadata.labels.get(k) == v for k, v in selector.items())
+    if callable(selector):
+        return selector(obj)
+    raise TypeError(f"unsupported selector {selector!r}")

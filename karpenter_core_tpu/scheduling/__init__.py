@@ -1,0 +1,7 @@
+from karpenter_core_tpu.scheduling.requirement import Requirement
+from karpenter_core_tpu.scheduling.requirements import Requirements
+from karpenter_core_tpu.scheduling.taints import Taints
+from karpenter_core_tpu.scheduling.hostportusage import HostPortUsage
+from karpenter_core_tpu.scheduling.volumeusage import VolumeUsage, VolumeCount
+
+__all__ = ["Requirement", "Requirements", "Taints", "HostPortUsage", "VolumeUsage", "VolumeCount"]

@@ -1,0 +1,20 @@
+from karpenter_core_tpu.solver.machinetemplate import MachineTemplate
+from karpenter_core_tpu.solver.topology import Topology, TopologyGroup, TopologyNodeFilter, TopologyType
+from karpenter_core_tpu.solver.queue import Queue
+from karpenter_core_tpu.solver.preferences import Preferences
+from karpenter_core_tpu.solver.node import SchedulingNode, ExistingNode
+from karpenter_core_tpu.solver.scheduler import Scheduler, SchedulerOptions
+
+__all__ = [
+    "MachineTemplate",
+    "Topology",
+    "TopologyGroup",
+    "TopologyNodeFilter",
+    "TopologyType",
+    "Queue",
+    "Preferences",
+    "SchedulingNode",
+    "ExistingNode",
+    "Scheduler",
+    "SchedulerOptions",
+]

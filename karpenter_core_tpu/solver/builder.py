@@ -1,0 +1,78 @@
+"""Scheduler construction: templates, catalogs, topology domains.
+
+Mirror of the wiring in /root/reference/pkg/controllers/provisioning/provisioner.go:237-296
+(NewScheduler): order provisioners by weight, collect instance-type catalogs,
+derive the topology-domain universe from instance-type requirements plus
+provisioner In-requirements, then assemble the Scheduler.  Used by both the
+provisioning controller and deprovisioning simulations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from karpenter_core_tpu.apis.objects import OP_IN, Pod
+from karpenter_core_tpu.apis.v1alpha5 import Provisioner, order_by_weight
+from karpenter_core_tpu.cloudprovider import CloudProvider, InstanceType
+from karpenter_core_tpu.scheduling import Requirements
+from karpenter_core_tpu.solver.machinetemplate import MachineTemplate
+from karpenter_core_tpu.solver.scheduler import Scheduler, SchedulerOptions
+from karpenter_core_tpu.solver.topology import Topology
+
+
+class NoProvisionersError(Exception):
+    pass
+
+
+def build_scheduler(
+    kube_client,
+    cloud_provider: CloudProvider,
+    cluster,
+    pods: List[Pod],
+    state_nodes: list,
+    daemonset_pods: Optional[List[Pod]] = None,
+    recorder=None,
+    opts: SchedulerOptions = SchedulerOptions(),
+    provisioners: Optional[List[Provisioner]] = None,
+) -> Scheduler:
+    if provisioners is None:
+        provisioners = kube_client.list_provisioners()
+    provisioners = [
+        p for p in provisioners if p.metadata.deletion_timestamp is None
+    ]
+    provisioners = order_by_weight(provisioners)
+    if not provisioners:
+        raise NoProvisionersError("no provisioners found")
+
+    machines: List[MachineTemplate] = []
+    instance_types: Dict[str, List[InstanceType]] = {}
+    domains: Dict[str, Set[str]] = {}
+    for provisioner in provisioners:
+        machines.append(MachineTemplate.from_provisioner(provisioner))
+        options = cloud_provider.get_instance_types(provisioner)
+        instance_types.setdefault(provisioner.name, []).extend(options)
+        # topology-domain universe
+        for it in options:
+            for key in it.requirements.keys():
+                domains.setdefault(key, set()).update(it.requirements.get(key).values_list())
+        provisioner_reqs = Requirements.from_node_selector_requirements(
+            *provisioner.spec.requirements
+        )
+        for key in provisioner_reqs.keys():
+            requirement = provisioner_reqs.get(key)
+            if requirement.operator() == OP_IN:
+                domains.setdefault(key, set()).update(requirement.values_list())
+
+    topology = Topology(kube_client, cluster, domains, pods)
+    return Scheduler(
+        kube_client,
+        machines,
+        provisioners,
+        cluster,
+        state_nodes,
+        topology,
+        instance_types,
+        daemonset_pods or [],
+        recorder=recorder,
+        opts=opts,
+    )

@@ -1,0 +1,235 @@
+"""In-construction and in-flight node models for the greedy solver.
+
+Mirror of /root/reference/pkg/controllers/provisioning/scheduling/{node.go:34-159,
+existingnode.go:28-130}.  A SchedulingNode accumulates pods against a shrinking
+set of viable instance types; an ExistingNode packs pods into the fixed capacity
+of a real (possibly still-launching) node.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field, replace
+from typing import List, Optional
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import OP_IN, Pod
+from karpenter_core_tpu.cloudprovider import InstanceType
+from karpenter_core_tpu.scheduling import (
+    HostPortUsage,
+    Requirement,
+    Requirements,
+    Taints,
+)
+from karpenter_core_tpu.solver.machinetemplate import MachineTemplate
+from karpenter_core_tpu.solver.topology import Topology
+from karpenter_core_tpu.utils import resources as resources_util
+
+_hostname_ids = itertools.count(1)
+
+
+def compatible(instance_type: InstanceType, requirements: Requirements) -> bool:
+    return instance_type.requirements.intersects(requirements) is None
+
+
+def fits(instance_type: InstanceType, requests: resources_util.ResourceList) -> bool:
+    return resources_util.fits(requests, instance_type.allocatable())
+
+
+def has_offering(instance_type: InstanceType, requirements: Requirements) -> bool:
+    for offering in instance_type.offerings.available():
+        if (
+            not requirements.has(labels_api.LABEL_TOPOLOGY_ZONE)
+            or requirements.get(labels_api.LABEL_TOPOLOGY_ZONE).has(offering.zone)
+        ) and (
+            not requirements.has(labels_api.LABEL_CAPACITY_TYPE)
+            or requirements.get(labels_api.LABEL_CAPACITY_TYPE).has(offering.capacity_type)
+        ):
+            return True
+    return False
+
+
+def filter_instance_types(
+    instance_types: List[InstanceType],
+    requirements: Requirements,
+    requests: resources_util.ResourceList,
+) -> List[InstanceType]:
+    """compat ∧ fits ∧ offering in one pass (node.go:137-159).  The tensorized
+    version is ops.masks.filter_instance_types — a single masked reduction."""
+    return [
+        it
+        for it in instance_types
+        if compatible(it, requirements) and fits(it, requests) and has_offering(it, requirements)
+    ]
+
+
+class SchedulingNode:
+    """A node we intend to create (node.go:34-107)."""
+
+    def __init__(
+        self,
+        machine_template: MachineTemplate,
+        topology: Topology,
+        daemon_resources: resources_util.ResourceList,
+        instance_types: List[InstanceType],
+    ) -> None:
+        hostname = f"hostname-placeholder-{next(_hostname_ids):04d}"
+        topology.register(labels_api.LABEL_HOSTNAME, hostname)
+        self.template = replace(
+            machine_template,
+            requirements=Requirements(*machine_template.requirements.values()),
+        )
+        self.template.requirements.add(
+            Requirement(labels_api.LABEL_HOSTNAME, OP_IN, [hostname])
+        )
+        self.hostname = hostname
+        self.pods: List[Pod] = []
+        self.topology = topology
+        self.host_port_usage = HostPortUsage()
+        self.instance_type_options = list(instance_types)
+        self.requests = dict(daemon_resources)
+
+    @property
+    def provisioner_name(self) -> str:
+        return self.template.provisioner_name
+
+    @property
+    def requirements(self) -> Requirements:
+        return self.template.requirements
+
+    @property
+    def taints(self) -> Taints:
+        return self.template.taints
+
+    def add(self, pod: Pod) -> Optional[str]:
+        """Try to place the pod; returns an error string (node unchanged) or
+        None on success (state committed) — node.go:62-107."""
+        err = self.taints.tolerates(pod)
+        if err is not None:
+            return err
+        err = self.host_port_usage.validate(pod)
+        if err is not None:
+            return err
+
+        node_requirements = Requirements(*self.requirements.values())
+        pod_requirements = Requirements.from_pod(pod)
+
+        err = node_requirements.compatible(pod_requirements)
+        if err is not None:
+            return f"incompatible requirements, {err}"
+        node_requirements.add(*pod_requirements.values())
+
+        topology_requirements, err = self.topology.add_requirements(
+            pod_requirements, node_requirements, pod
+        )
+        if err is not None:
+            return err
+        err = node_requirements.compatible(topology_requirements)
+        if err is not None:
+            return err
+        node_requirements.add(*topology_requirements.values())
+
+        requests = resources_util.merge(self.requests, resources_util.requests_for_pods(pod))
+        instance_types = filter_instance_types(
+            self.instance_type_options, node_requirements, requests
+        )
+        if not instance_types:
+            return (
+                f"no instance type satisfied resources {requests} "
+                f"and requirements {node_requirements!r}"
+            )
+
+        # commit
+        self.pods.append(pod)
+        self.instance_type_options = instance_types
+        self.requests = requests
+        self.template.requirements = node_requirements
+        self.topology.record(pod, node_requirements)
+        self.host_port_usage.add(pod)
+        return None
+
+    def finalize_scheduling(self) -> None:
+        """Drop the placeholder hostname before launch (node.go:111-115)."""
+        self.template.requirements.delete(labels_api.LABEL_HOSTNAME)
+
+    def __repr__(self) -> str:
+        names = ", ".join(it.name for it in self.instance_type_options[:5])
+        if len(self.instance_type_options) > 5:
+            names += f" and {len(self.instance_type_options) - 5} other(s)"
+        return f"node with {len(self.pods)} pods requesting {self.requests} from types {names}"
+
+
+class ExistingNode:
+    """A real or in-flight node with fixed capacity (existingnode.go:28-130).
+
+    ``state_node`` is a state.Node snapshot (deep copy — we mutate trackers).
+    """
+
+    def __init__(self, state_node, topology: Topology, daemon_resources) -> None:
+        self.state_node = state_node
+        self.node = state_node.node
+        # remaining daemon resources = template overhead minus what already runs
+        remaining = resources_util.subtract(daemon_resources, state_node.daemon_set_requests())
+        remaining = {k: max(v, 0.0) for k, v in remaining.items()}
+        self.pods: List[Pod] = []
+        self.requests = remaining
+        self.topology = topology
+        self.requirements = Requirements.from_labels(self.node.metadata.labels)
+        self.available = state_node.available()
+        self.taints = Taints.of(state_node.taints())
+        self.host_port_usage = state_node.host_port_usage().deep_copy()
+        self.volume_usage = state_node.volume_usage().deep_copy()
+        self.volume_limits = state_node.volume_limits()
+
+        hostname = self.node.metadata.labels.get(labels_api.LABEL_HOSTNAME) or self.node.name
+        self.requirements.add(Requirement(labels_api.LABEL_HOSTNAME, OP_IN, [hostname]))
+        topology.register(labels_api.LABEL_HOSTNAME, hostname)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def add(self, pod: Pod) -> Optional[str]:
+        err = self.taints.tolerates(pod)
+        if err is not None:
+            return err
+        err = self.host_port_usage.validate(pod)
+        if err is not None:
+            return err
+
+        mounted, err = self.volume_usage.validate(pod)
+        if err is not None:
+            return err
+        if mounted.exceeds(self.volume_limits):
+            return "would exceed node volume limits"
+
+        # resource check first: the most likely failure on a fixed-size node
+        requests = resources_util.merge(self.requests, resources_util.requests_for_pods(pod))
+        if not resources_util.fits(requests, self.available):
+            return "exceeds node resources"
+
+        node_requirements = Requirements(*self.requirements.values())
+        pod_requirements = Requirements.from_pod(pod)
+        err = node_requirements.compatible(pod_requirements)
+        if err is not None:
+            return err
+        node_requirements.add(*pod_requirements.values())
+
+        topology_requirements, err = self.topology.add_requirements(
+            pod_requirements, node_requirements, pod
+        )
+        if err is not None:
+            return err
+        err = node_requirements.compatible(topology_requirements)
+        if err is not None:
+            return err
+        node_requirements.add(*topology_requirements.values())
+
+        # commit
+        self.pods.append(pod)
+        self.requests = requests
+        self.requirements = node_requirements
+        self.topology.record(pod, node_requirements)
+        self.host_port_usage.add(pod)
+        self.volume_usage.add(pod)
+        return None

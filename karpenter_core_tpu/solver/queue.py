@@ -1,0 +1,50 @@
+"""Pod scheduling queue with no-progress cycle detection.
+
+Mirror of /root/reference/pkg/controllers/provisioning/scheduling/queue.go:27-110:
+pods are sorted CPU-then-memory descending for first-fit-decreasing bin-packing;
+Pop stops once a pod comes back around with the queue the same length it had
+when the pod was last pushed (no progress was made in a full cycle).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu.apis.objects import Pod
+from karpenter_core_tpu.utils import resources as resources_util
+
+
+def _sort_key(pod: Pod) -> Tuple:
+    requests = resources_util.requests_for_pods(pod)
+    return (
+        -requests.get(resources_util.CPU, 0.0),
+        -requests.get(resources_util.MEMORY, 0.0),
+        pod.metadata.creation_timestamp,
+        pod.uid,
+    )
+
+
+class Queue:
+    def __init__(self, *pods: Pod) -> None:
+        self.pods: "deque[Pod]" = deque(sorted(pods, key=_sort_key))
+        self.last_len: Dict[str, int] = {}
+
+    def pop(self) -> Optional[Pod]:
+        if not self.pods:
+            return None
+        p = self.pods[0]
+        if self.last_len.get(p.uid) == len(self.pods):
+            return None  # cycled without progress
+        self.pods.popleft()
+        return p
+
+    def push(self, pod: Pod, relaxed: bool) -> None:
+        self.pods.append(pod)
+        if relaxed:
+            self.last_len = {}
+        else:
+            self.last_len[pod.uid] = len(self.pods)
+
+    def list(self) -> List[Pod]:
+        return list(self.pods)

@@ -1,0 +1,268 @@
+"""The scheduler: greedy first-fit-decreasing bin-pack with constraint
+propagation and preference relaxation.
+
+Mirror of /root/reference/pkg/controllers/provisioning/scheduling/scheduler.go:42-309.
+This host-side path is the exact-semantics engine used by the controllers and as
+the oracle for the TPU kernel (karpenter_core_tpu.ops.solve), which accelerates
+the dominant homogeneous-batch workloads; the Scheduler can transparently route
+eligible batches to the TPU kernel (use_tpu_kernel=True).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    TAINT_EFFECT_PREFER_NO_SCHEDULE,
+    Pod,
+)
+from karpenter_core_tpu.apis.v1alpha5 import Provisioner
+from karpenter_core_tpu.cloudprovider import InstanceType
+from karpenter_core_tpu.scheduling import Requirements, Taints
+from karpenter_core_tpu.solver.machinetemplate import MachineTemplate
+from karpenter_core_tpu.solver.node import ExistingNode, SchedulingNode
+from karpenter_core_tpu.solver.preferences import Preferences
+from karpenter_core_tpu.solver.queue import Queue
+from karpenter_core_tpu.solver.topology import Topology
+from karpenter_core_tpu.utils import resources as resources_util
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class SchedulerOptions:
+    # Simulation mode suppresses nomination events/records (used by consolidation)
+    simulation_mode: bool = False
+
+
+@dataclass
+class SchedulingResults:
+    new_nodes: List[SchedulingNode] = field(default_factory=list)
+    existing_nodes: List[ExistingNode] = field(default_factory=list)
+    errors: Dict[str, str] = field(default_factory=dict)  # pod uid -> error
+    failed_pods: List[Pod] = field(default_factory=list)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        kube_client,
+        machine_templates: List[MachineTemplate],
+        provisioners: List[Provisioner],
+        cluster,
+        state_nodes: list,
+        topology: Topology,
+        instance_types: Dict[str, List[InstanceType]],
+        daemonset_pods: List[Pod],
+        recorder=None,
+        opts: Optional[SchedulerOptions] = None,
+    ) -> None:
+        opts = opts if opts is not None else SchedulerOptions()
+        self.kube_client = kube_client
+        self.machine_templates = machine_templates
+        self.topology = topology
+        self.cluster = cluster
+        self.instance_types = instance_types
+        self.recorder = recorder
+        self.opts = opts
+        # tolerate PreferNoSchedule during relaxation only when some provisioner
+        # actually carries such a taint (scheduler.go:47-56)
+        tolerate = any(
+            taint.effect == TAINT_EFFECT_PREFER_NO_SCHEDULE
+            for prov in provisioners
+            for taint in prov.spec.taints
+        )
+        self.preferences = Preferences(tolerate_prefer_no_schedule=tolerate)
+        self.remaining_resources: Dict[str, resources_util.ResourceList] = {
+            p.name: dict(p.spec.limits.resources)
+            for p in provisioners
+            if p.spec.limits is not None
+        }
+        self.daemon_overhead = _daemon_overhead(machine_templates, daemonset_pods)
+        self.new_nodes: List[SchedulingNode] = []
+        self.existing_nodes: List[ExistingNode] = []
+        self._calculate_existing_machines(state_nodes, daemonset_pods)
+
+    # -- the solve loop -------------------------------------------------------
+
+    def solve(self, pods: List[Pod]) -> SchedulingResults:
+        """Loop pods through the queue while progress is being made
+        (scheduler.go:96-133).  Requeue-with-relaxation handles batch
+        pod-affinity and order-dependent skew constraints.
+        """
+        errors: Dict[str, str] = {}
+        q = Queue(*pods)
+        while True:
+            pod = q.pop()
+            if pod is None:
+                break
+            err = self._add(pod)
+            errors[pod.uid] = err
+            if err is None:
+                continue
+            relaxed = self.preferences.relax(pod)
+            q.push(pod, relaxed)
+            if relaxed:
+                update_err = self.topology.update(pod)
+                if update_err is not None:
+                    log.error("updating topology, %s", update_err)
+
+        for n in self.new_nodes:
+            n.finalize_scheduling()
+
+        failed = q.list()
+        if not self.opts.simulation_mode:
+            self._record_results(pods, failed, errors)
+        return SchedulingResults(
+            new_nodes=self.new_nodes,
+            existing_nodes=self.existing_nodes,
+            errors={uid: e for uid, e in errors.items() if e is not None},
+            failed_pods=failed,
+        )
+
+    def _add(self, pod: Pod) -> Optional[str]:
+        """existing nodes → open new nodes (fewest pods first) → a fresh node
+        per weighted template (scheduler.go:174-219)."""
+        for node in self.existing_nodes:
+            if node.add(pod) is None:
+                return None
+
+        self.new_nodes.sort(key=lambda n: len(n.pods))
+        for node in self.new_nodes:
+            if node.add(pod) is None:
+                return None
+
+        errs: List[str] = []
+        for template in self.machine_templates:
+            instance_types = self.instance_types.get(template.provisioner_name, [])
+            if template.provisioner_name in self.remaining_resources:
+                remaining = self.remaining_resources[template.provisioner_name]
+                filtered = _filter_by_remaining_resources(instance_types, remaining)
+                if not filtered:
+                    errs.append("all available instance types exceed provisioner limits")
+                    continue
+                if len(filtered) != len(instance_types) and not self.opts.simulation_mode:
+                    log.debug(
+                        "%d out of %d instance types were excluded because they would "
+                        "breach provisioner limits",
+                        len(instance_types) - len(filtered),
+                        len(instance_types),
+                    )
+                instance_types = filtered
+
+            node = SchedulingNode(
+                template,
+                self.topology,
+                self.daemon_overhead.get(id(template), {}),
+                instance_types,
+            )
+            err = node.add(pod)
+            if err is not None:
+                errs.append(f"incompatible with provisioner {template.provisioner_name!r}, {err}")
+                continue
+            self.new_nodes.append(node)
+            # pessimistic limit tracking: assume the largest surviving instance
+            # type launches (scheduler.go:273-290 subtractMax)
+            if template.provisioner_name in self.remaining_resources:
+                self.remaining_resources[template.provisioner_name] = _subtract_max(
+                    self.remaining_resources[template.provisioner_name],
+                    node.instance_type_options,
+                )
+            return None
+        return "; ".join(errs) if errs else "no provisioner available"
+
+    # -- setup ----------------------------------------------------------------
+
+    def _calculate_existing_machines(self, state_nodes, daemonset_pods: List[Pod]) -> None:
+        """Wrap owned state nodes as ExistingNodes and charge their capacity
+        against provisioner limits (scheduler.go:221-248)."""
+        for state_node in state_nodes:
+            if not state_node.owned():
+                continue
+            daemons = []
+            for p in daemonset_pods:
+                if Taints.of(state_node.node.spec.taints).tolerates(p) is not None:
+                    continue
+                labels_reqs = Requirements.from_labels(state_node.node.metadata.labels)
+                if labels_reqs.compatible(Requirements.from_pod(p)) is not None:
+                    continue
+                daemons.append(p)
+            self.existing_nodes.append(
+                ExistingNode(state_node, self.topology, resources_util.requests_for_pods(*daemons))
+            )
+            provisioner_name = state_node.node.metadata.labels.get(
+                labels_api.PROVISIONER_NAME_LABEL_KEY
+            )
+            if provisioner_name in self.remaining_resources:
+                self.remaining_resources[provisioner_name] = resources_util.subtract(
+                    self.remaining_resources[provisioner_name], state_node.capacity()
+                )
+
+    def _record_results(
+        self, pods: List[Pod], failed: List[Pod], errors: Dict[str, str]
+    ) -> None:
+        from karpenter_core_tpu.events import events as evt
+
+        for pod in failed:
+            log.error(
+                "Could not schedule pod %s/%s, %s", pod.namespace, pod.name, errors.get(pod.uid)
+            )
+            if self.recorder is not None:
+                self.recorder.publish(evt.pod_failed_to_schedule(pod, errors.get(pod.uid, "")))
+        for node in self.existing_nodes:
+            if node.pods and self.cluster is not None:
+                self.cluster.nominate_node_for_pod(node.name)
+            if self.recorder is not None:
+                for pod in node.pods:
+                    self.recorder.publish(evt.nominate_pod(pod, node.node))
+        new_count = sum(len(n.pods) for n in self.new_nodes)
+        if new_count == 0:
+            return
+        log.info("found provisionable pod(s): %d", len(pods))
+        log.info("computed new node(s) to fit pod(s): %d nodes, %d pods", len(self.new_nodes), new_count)
+
+
+def _daemon_overhead(
+    templates: List[MachineTemplate], daemonset_pods: List[Pod]
+) -> Dict[int, resources_util.ResourceList]:
+    """Per-template daemonset resource overhead (scheduler.go:250-267); keyed by
+    id(template) since templates are mutable."""
+    overhead: Dict[int, resources_util.ResourceList] = {}
+    for template in templates:
+        daemons = []
+        for p in daemonset_pods:
+            if template.taints.tolerates(p) is not None:
+                continue
+            if template.requirements.compatible(Requirements.from_pod(p)) is not None:
+                continue
+            daemons.append(p)
+        overhead[id(template)] = resources_util.requests_for_pods(*daemons)
+    return overhead
+
+
+def _subtract_max(
+    remaining: resources_util.ResourceList, instance_types: List[InstanceType]
+) -> resources_util.ResourceList:
+    if not instance_types:
+        return remaining
+    it_max = resources_util.max_resources(*(it.capacity for it in instance_types))
+    return {k: v - it_max.get(k, 0.0) for k, v in remaining.items()}
+
+
+def _filter_by_remaining_resources(
+    instance_types: List[InstanceType], remaining: resources_util.ResourceList
+) -> List[InstanceType]:
+    """Drop instance types whose launch would breach provisioner limits
+    (scheduler.go:292-309)."""
+    out = []
+    for it in instance_types:
+        viable = all(
+            resources_util.cmp(it.capacity.get(name, 0.0), quantity) <= 0
+            for name, quantity in remaining.items()
+        )
+        if viable:
+            out.append(it)
+    return out

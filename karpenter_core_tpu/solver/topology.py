@@ -1,0 +1,491 @@
+"""Topology engine: spread / affinity / anti-affinity domain tracking.
+
+Mirror of /root/reference/pkg/controllers/provisioning/scheduling/{topology.go:37-406,
+topologygroup.go:32-253, topologynodefilter.go:28-70}.  Domain counts are kept as
+plain dicts here; the tensorized equivalent (dense [groups, domains] count
+matrices driving argmin/any/zero-mask reductions) lives in
+``karpenter_core_tpu.ops.topology``.
+"""
+
+from __future__ import annotations
+
+import math
+from enum import IntEnum
+from typing import Dict, List, Optional, Set
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    DO_NOT_SCHEDULE,
+    LabelSelector,
+    Node,
+    Pod,
+    PodAffinityTerm,
+)
+from karpenter_core_tpu.scheduling import Requirement, Requirements
+from karpenter_core_tpu.utils import pod as pod_util
+
+MAX_SKEW_UNBOUNDED = 1 << 31  # math.MaxInt32 stand-in for affinity groups
+
+
+class TopologyType(IntEnum):
+    SPREAD = 0
+    POD_AFFINITY = 1
+    POD_ANTI_AFFINITY = 2
+
+    def __str__(self) -> str:
+        return ("topology spread", "pod affinity", "pod anti-affinity")[int(self)]
+
+
+class TopologyNodeFilter(List[Requirements]):
+    """OR of requirement sets; empty filter matches everything
+    (topologynodefilter.go:28-70)."""
+
+    @classmethod
+    def for_pod(cls, pod: Pod) -> "TopologyNodeFilter":
+        node_selector = Requirements.from_labels(pod.spec.node_selector)
+        affinity = pod.spec.affinity
+        if (
+            affinity is None
+            or affinity.node_affinity is None
+            or affinity.node_affinity.required is None
+        ):
+            return cls([node_selector])
+        filter_ = cls()
+        for term in affinity.node_affinity.required.node_selector_terms:
+            requirements = Requirements()
+            requirements.add(*node_selector.values())
+            requirements.add(
+                *Requirements.from_node_selector_requirements(*term.match_expressions).values()
+            )
+            filter_.append(requirements)
+        return filter_
+
+    def matches_node(self, node: Node) -> bool:
+        return self.matches_requirements(Requirements.from_labels(node.metadata.labels))
+
+    def matches_requirements(self, requirements: Requirements) -> bool:
+        if not self:
+            return True
+        return any(requirements.compatible(req) is None for req in self)
+
+    def hash_key(self):
+        return tuple(
+            tuple(sorted((r.key, r.complement, r.values, r.greater_than, r.less_than) for r in reqs.values()))
+            for reqs in self
+        )
+
+
+def _selector_key(selector: Optional[LabelSelector]):
+    if selector is None:
+        return None
+    return (
+        tuple(sorted(selector.match_labels.items())),
+        tuple(
+            sorted(
+                (e.key, e.operator, tuple(sorted(e.values)))
+                for e in selector.match_expressions
+            )
+        ),
+    )
+
+
+class TopologyGroup:
+    """Tracks pod counts per topology domain for one constraint
+    (topologygroup.go:53-253)."""
+
+    def __init__(
+        self,
+        topology_type: TopologyType,
+        key: str,
+        pod: Optional[Pod],
+        namespaces: Set[str],
+        selector: Optional[LabelSelector],
+        max_skew: int,
+        domains: Set[str],
+    ) -> None:
+        self.type = topology_type
+        self.key = key
+        self.namespaces = set(namespaces)
+        self.selector = selector
+        self.max_skew = max_skew
+        # nil filter (always-match) for affinity types; spread filters on the pod's
+        # node selectors (topologygroup.go:71-75)
+        self.node_filter = (
+            TopologyNodeFilter.for_pod(pod)
+            if topology_type == TopologyType.SPREAD and pod is not None
+            else TopologyNodeFilter()
+        )
+        self.domains: Dict[str, int] = {domain: 0 for domain in domains}
+        self.owners: Set[str] = set()  # pod UIDs that have this topology as a rule
+
+    # -- counting -------------------------------------------------------------
+
+    def record(self, *domains: str) -> None:
+        for domain in domains:
+            self.domains[domain] = self.domains.get(domain, 0) + 1
+
+    def register(self, *domains: str) -> None:
+        for domain in domains:
+            self.domains.setdefault(domain, 0)
+
+    def counts(self, pod: Pod, requirements: Requirements) -> bool:
+        """Whether the pod, scheduled to a node with these requirements, counts
+        toward this topology (topologygroup.go:109-111)."""
+        return self.selects(pod) and self.node_filter.matches_requirements(requirements)
+
+    def selects(self, pod: Pod) -> bool:
+        # a nil selector matches nothing; an empty selector matches everything
+        # (metav1.LabelSelectorAsSelector semantics)
+        if self.selector is None:
+            return False
+        return pod.namespace in self.namespaces and self.selector.matches(pod.metadata.labels)
+
+    # -- ownership ------------------------------------------------------------
+
+    def add_owner(self, uid: str) -> None:
+        self.owners.add(uid)
+
+    def remove_owner(self, uid: str) -> None:
+        self.owners.discard(uid)
+
+    def is_owned_by(self, uid: str) -> bool:
+        return uid in self.owners
+
+    def hash_key(self):
+        """Identity for deduplication across pods sharing one constraint
+        (topologygroup.go:137-153)."""
+        return (
+            self.key,
+            int(self.type),
+            frozenset(self.namespaces),
+            _selector_key(self.selector),
+            self.max_skew,
+            self.node_filter.hash_key(),
+        )
+
+    # -- domain selection -----------------------------------------------------
+
+    def get(self, pod: Pod, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
+        if self.type == TopologyType.SPREAD:
+            return self._next_domain_spread(pod, pod_domains, node_domains)
+        if self.type == TopologyType.POD_AFFINITY:
+            return self._next_domain_affinity(pod, pod_domains, node_domains)
+        return self._next_domain_anti_affinity(pod_domains)
+
+    def _next_domain_spread(
+        self, pod: Pod, pod_domains: Requirement, node_domains: Requirement
+    ) -> Requirement:
+        """kube-scheduler skew formula: count + self - min <= maxSkew
+        (topologygroup.go:155-182)."""
+        min_count = self._domain_min_count(pod_domains)
+        self_selecting = self.selects(pod)
+        min_domain = None
+        min_domain_count = 1 << 31
+        for domain, count in self.domains.items():
+            if node_domains.has(domain):
+                if self_selecting:
+                    count = count + 1
+                if count - min_count <= self.max_skew and count < min_domain_count:
+                    min_domain = domain
+                    min_domain_count = count
+        if min_domain is None:
+            return Requirement(pod_domains.key, OP_DOES_NOT_EXIST)
+        return Requirement(pod_domains.key, OP_IN, [min_domain])
+
+    def _domain_min_count(self, domains: Requirement) -> int:
+        # hostname topologies always have min zero: we can always create a new node
+        if self.key == labels_api.LABEL_HOSTNAME:
+            return 0
+        min_count = 1 << 31
+        for domain, count in self.domains.items():
+            if domains.has(domain) and count < min_count:
+                min_count = count
+        return min_count
+
+    def _next_domain_affinity(
+        self, pod: Pod, pod_domains: Requirement, node_domains: Requirement
+    ) -> Requirement:
+        options = Requirement(pod_domains.key, OP_DOES_NOT_EXIST)
+        for domain, count in self.domains.items():
+            if pod_domains.has(domain) and count > 0:
+                options.insert(domain)
+        # Bootstrap self-affinity: no matching pod scheduled anywhere yet
+        # (topologygroup.go:210-231)
+        if options.len() == 0 and self.selects(pod):
+            intersected = pod_domains.intersection(node_domains)
+            for domain in self.domains:
+                if intersected.has(domain):
+                    options.insert(domain)
+                    break
+            for domain in self.domains:
+                if pod_domains.has(domain):
+                    options.insert(domain)
+                    break
+        return options
+
+    def _next_domain_anti_affinity(self, domains: Requirement) -> Requirement:
+        options = Requirement(domains.key, OP_DOES_NOT_EXIST)
+        for domain, count in self.domains.items():
+            if domains.has(domain) and count == 0:
+                options.insert(domain)
+        return options
+
+
+def ignored_for_topology(p: Pod) -> bool:
+    return not pod_util.is_scheduled(p) or pod_util.is_terminal(p) or pod_util.is_terminating(p)
+
+
+class Topology:
+    """Hash-deduped topology groups plus inverse anti-affinity groups
+    (topology.go:37-54).
+
+    ``kube_client`` needs list_pods(namespace=, selector=) / get_node(name) /
+    list_namespaces(selector=); ``cluster`` needs for_pods_with_anti_affinity().
+    """
+
+    def __init__(
+        self,
+        kube_client,
+        cluster,
+        domains: Dict[str, Set[str]],
+        pods: List[Pod],
+    ) -> None:
+        self.kube_client = kube_client
+        self.cluster = cluster
+        self.domains = domains
+        self.topologies: Dict[object, TopologyGroup] = {}
+        self.inverse_topologies: Dict[object, TopologyGroup] = {}
+        # pods being scheduled are excluded from counting to avoid double counts
+        self.excluded_pods: Set[str] = {p.uid for p in pods}
+        errs: List[str] = []
+        err = self._update_inverse_affinities()
+        if err:
+            errs.append(err)
+        for p in pods:
+            err = self.update(p)
+            if err:
+                errs.append(err)
+        if errs:
+            raise ValueError("; ".join(errs))
+
+    # -- registration ---------------------------------------------------------
+
+    def update(self, p: Pod) -> Optional[str]:
+        """(Re-)register the pod as owner of its topologies; called after
+        relaxation to drop ownership of removed constraints (topology.go:86-117)."""
+        for tg in self.topologies.values():
+            tg.remove_owner(p.uid)
+
+        if pod_util.has_pod_anti_affinity(p):
+            err = self._update_inverse_anti_affinity(p, None)
+            if err:
+                return f"updating inverse anti-affinities, {err}"
+
+        groups = self._new_for_topologies(p) + self._new_for_affinities(p)
+        for tg in groups:
+            hash_key = tg.hash_key()
+            existing = self.topologies.get(hash_key)
+            if existing is None:
+                err = self._count_domains(tg)
+                if err:
+                    return err
+                self.topologies[hash_key] = tg
+            else:
+                tg = existing
+            tg.add_owner(p.uid)
+        return None
+
+    def record(self, p: Pod, requirements: Requirements) -> None:
+        """Commit the pod's placement into every topology that counts it
+        (topology.go:120-143)."""
+        for tc in self.topologies.values():
+            if tc.counts(p, requirements):
+                domains = requirements.get(tc.key)
+                if tc.type == TopologyType.POD_ANTI_AFFINITY:
+                    # block every domain the pod could land in
+                    tc.record(*domains.values_list())
+                elif domains.len() == 1:
+                    tc.record(domains.values_list()[0])
+        for tc in self.inverse_topologies.values():
+            if tc.is_owned_by(p.uid):
+                tc.record(*requirements.get(tc.key).values_list())
+
+    def add_requirements(
+        self, pod_requirements: Requirements, node_requirements: Requirements, p: Pod
+    ) -> "tuple[Optional[Requirements], Optional[str]]":
+        """Tighten node requirements with each matching topology's next-domain
+        selection (topology.go:149-167)."""
+        requirements = Requirements(*node_requirements.values())
+        for topology in self._matching_topologies(p, node_requirements):
+            pod_domains = (
+                pod_requirements.get(topology.key)
+                if pod_requirements.has(topology.key)
+                else Requirement(topology.key, OP_EXISTS)
+            )
+            node_domains = (
+                node_requirements.get(topology.key)
+                if node_requirements.has(topology.key)
+                else Requirement(topology.key, OP_EXISTS)
+            )
+            domains = topology.get(p, pod_domains, node_domains)
+            if domains.len() == 0:
+                return None, f"unsatisfiable topology constraint for {topology.type}, key={topology.key}"
+            requirements.add(domains)
+        return requirements, None
+
+    def register(self, topology_key: str, domain: str) -> None:
+        """Make a new domain (e.g. a new hostname) visible to all groups."""
+        for tg in self.topologies.values():
+            if tg.key == topology_key:
+                tg.register(domain)
+        for tg in self.inverse_topologies.values():
+            if tg.key == topology_key:
+                tg.register(domain)
+
+    # -- internals ------------------------------------------------------------
+
+    def _update_inverse_affinities(self) -> Optional[str]:
+        errs: List[str] = []
+
+        def visit(pod: Pod, node: Node) -> bool:
+            if pod.uid in self.excluded_pods:
+                return True
+            err = self._update_inverse_anti_affinity(pod, node.metadata.labels)
+            if err:
+                errs.append(f"tracking existing pod anti-affinity, {err}")
+            return True
+
+        if self.cluster is not None:
+            self.cluster.for_pods_with_anti_affinity(visit)
+        return "; ".join(errs) if errs else None
+
+    def _update_inverse_anti_affinity(
+        self, pod: Pod, domains: Optional[Dict[str, str]]
+    ) -> Optional[str]:
+        """Track pods with anti-affinity terms so future pods they repel are
+        blocked (topology.go:202-227)."""
+        for term in pod.spec.affinity.pod_anti_affinity.required:
+            namespaces = self._build_namespace_list(
+                pod.namespace, term.namespaces, term.namespace_selector
+            )
+            tg = TopologyGroup(
+                TopologyType.POD_ANTI_AFFINITY,
+                term.topology_key,
+                pod,
+                namespaces,
+                term.label_selector,
+                MAX_SKEW_UNBOUNDED,
+                self.domains.get(term.topology_key, set()),
+            )
+            hash_key = tg.hash_key()
+            existing = self.inverse_topologies.get(hash_key)
+            if existing is None:
+                self.inverse_topologies[hash_key] = tg
+            else:
+                tg = existing
+            if domains and tg.key in domains:
+                tg.record(domains[tg.key])
+            tg.add_owner(pod.uid)
+        return None
+
+    def _count_domains(self, tg: TopologyGroup) -> Optional[str]:
+        """Count existing matching pods per domain (topology.go:231-276)."""
+        pods: List[Pod] = []
+        for ns in tg.namespaces:
+            pods.extend(self.kube_client.list_pods(namespace=ns, selector=tg.selector))
+        for p in pods:
+            if ignored_for_topology(p):
+                continue
+            if p.uid in self.excluded_pods:
+                continue
+            node = self.kube_client.get_node(p.spec.node_name)
+            if node is None:
+                return f"getting node {p.spec.node_name}"
+            domain = node.metadata.labels.get(tg.key)
+            # fall back to node name for not-yet-labeled hostname domains
+            if domain is None and tg.key == labels_api.LABEL_HOSTNAME:
+                domain = node.name
+            if domain is None:
+                continue
+            if not tg.node_filter.matches_node(node):
+                continue
+            tg.record(domain)
+        return None
+
+    def _new_for_topologies(self, p: Pod) -> List[TopologyGroup]:
+        groups = []
+        for cs in p.spec.topology_spread_constraints:
+            groups.append(
+                TopologyGroup(
+                    TopologyType.SPREAD,
+                    cs.topology_key,
+                    p,
+                    {p.namespace},
+                    cs.label_selector,
+                    cs.max_skew,
+                    self.domains.get(cs.topology_key, set()),
+                )
+            )
+        return groups
+
+    def _new_for_affinities(self, p: Pod) -> List[TopologyGroup]:
+        groups = []
+        if p.spec.affinity is None:
+            return groups
+        terms: Dict[TopologyType, List[PodAffinityTerm]] = {}
+        if p.spec.affinity.pod_affinity is not None:
+            terms.setdefault(TopologyType.POD_AFFINITY, []).extend(
+                p.spec.affinity.pod_affinity.required
+            )
+            for weighted in p.spec.affinity.pod_affinity.preferred:
+                terms.setdefault(TopologyType.POD_AFFINITY, []).append(
+                    weighted.pod_affinity_term
+                )
+        if p.spec.affinity.pod_anti_affinity is not None:
+            terms.setdefault(TopologyType.POD_ANTI_AFFINITY, []).extend(
+                p.spec.affinity.pod_anti_affinity.required
+            )
+            for weighted in p.spec.affinity.pod_anti_affinity.preferred:
+                terms.setdefault(TopologyType.POD_ANTI_AFFINITY, []).append(
+                    weighted.pod_affinity_term
+                )
+        for topology_type, term_list in terms.items():
+            for term in term_list:
+                namespaces = self._build_namespace_list(
+                    p.namespace, term.namespaces, term.namespace_selector
+                )
+                groups.append(
+                    TopologyGroup(
+                        topology_type,
+                        term.topology_key,
+                        p,
+                        namespaces,
+                        term.label_selector,
+                        MAX_SKEW_UNBOUNDED,
+                        self.domains.get(term.topology_key, set()),
+                    )
+                )
+        return groups
+
+    def _build_namespace_list(
+        self, namespace: str, namespaces: List[str], selector: Optional[LabelSelector]
+    ) -> Set[str]:
+        if not namespaces and selector is None:
+            return {namespace}
+        if selector is None:
+            return set(namespaces)
+        selected = {
+            ns.metadata.name for ns in self.kube_client.list_namespaces(selector=selector)
+        }
+        selected.update(namespaces)
+        return selected
+
+    def _matching_topologies(self, p: Pod, requirements: Requirements) -> List[TopologyGroup]:
+        matching = [tc for tc in self.topologies.values() if tc.is_owned_by(p.uid)]
+        matching.extend(
+            tc for tc in self.inverse_topologies.values() if tc.counts(p, requirements)
+        )
+        return matching

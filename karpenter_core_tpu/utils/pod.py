@@ -1,0 +1,89 @@
+"""Pod classification predicates (mirror of /root/reference/pkg/utils/pod/scheduling.go:25-106)."""
+
+from __future__ import annotations
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    POD_FAILED,
+    POD_SUCCEEDED,
+    Pod,
+)
+
+
+def is_provisionable(pod: Pod) -> bool:
+    """Unscheduled, not preempted, and not owned by a node (daemonset-like)."""
+    return (
+        not is_scheduled(pod)
+        and not is_preempting(pod)
+        and failed_to_schedule(pod)
+        and not is_owned_by_daemon_set(pod)
+        and not is_owned_by_node(pod)
+    )
+
+
+def is_scheduled(pod: Pod) -> bool:
+    return bool(pod.spec.node_name)
+
+
+def is_preempting(pod: Pod) -> bool:
+    return bool(pod.status.nominated_node_name)
+
+
+def is_terminal(pod: Pod) -> bool:
+    return pod.status.phase in (POD_FAILED, POD_SUCCEEDED)
+
+
+def is_terminating(pod: Pod) -> bool:
+    return pod.metadata.deletion_timestamp is not None
+
+
+def failed_to_schedule(pod: Pod) -> bool:
+    """True if the pod has a PodScheduled=False/Unschedulable condition."""
+    for condition in pod.status.conditions:
+        if condition.type == "PodScheduled" and condition.reason == "Unschedulable":
+            return True
+    return False
+
+
+def is_owned_by_daemon_set(pod: Pod) -> bool:
+    return _is_owned_by(pod, "DaemonSet")
+
+
+def is_owned_by_node(pod: Pod) -> bool:
+    return _is_owned_by(pod, "Node")
+
+
+def _is_owned_by(pod: Pod, kind: str) -> bool:
+    return any(ref.kind == kind for ref in pod.metadata.owner_references)
+
+
+def has_do_not_evict(pod: Pod) -> bool:
+    return pod.metadata.annotations.get(labels_api.DO_NOT_EVICT_POD_ANNOTATION_KEY) == "true"
+
+
+def has_pod_anti_affinity(pod: Pod) -> bool:
+    """True if the pod has required pod anti-affinity terms."""
+    return (
+        pod.spec.affinity is not None
+        and pod.spec.affinity.pod_anti_affinity is not None
+        and len(pod.spec.affinity.pod_anti_affinity.required) > 0
+    )
+
+
+def has_required_pod_affinity(pod: Pod) -> bool:
+    return (
+        pod.spec.affinity is not None
+        and pod.spec.affinity.pod_affinity is not None
+        and len(pod.spec.affinity.pod_affinity.required) > 0
+    )
+
+
+def tolerates_unschedulable_taint(pod: Pod) -> bool:
+    from karpenter_core_tpu.apis.objects import Taint
+
+    unschedulable = Taint(key="node.kubernetes.io/unschedulable", effect="NoSchedule")
+    return any(t.tolerates_taint(unschedulable) for t in pod.spec.tolerations)
+
+
+def is_owned_by_static_pod(pod: Pod) -> bool:
+    return is_owned_by_node(pod)

@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU platform before JAX loads.
+
+Multi-chip hardware is not available in CI; all sharding tests run against a
+virtual 8-device CPU mesh (SURVEY.md §7 step 8 / driver contract).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

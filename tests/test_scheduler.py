@@ -1,0 +1,469 @@
+"""Scenario tests for the greedy solver.
+
+Coverage modeled on the reference suites
+(/root/reference/pkg/controllers/provisioning/scheduling/{suite_test.go,
+topology_test.go, instance_selection_test.go}) — resources, node affinity,
+taints, host ports, topology spread, pod (anti-)affinity, relaxation, limits.
+"""
+
+import pytest
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    LabelSelector,
+    NodeSelectorRequirement,
+    PodAffinityTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.operator.kubeclient import KubeClient
+from karpenter_core_tpu.solver.builder import build_scheduler
+from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
+
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+HOSTNAME = labels_api.LABEL_HOSTNAME
+CT = labels_api.LABEL_CAPACITY_TYPE
+
+
+def solve(pods, provisioners=None, instance_types=None, state_nodes=None, daemonsets=None):
+    kube = KubeClient()
+    for p in provisioners or [make_provisioner()]:
+        kube.create(p)
+    provider = fake_cp.FakeCloudProvider(instance_types)
+    scheduler = build_scheduler(
+        kube,
+        provider,
+        cluster=None,
+        pods=pods,
+        state_nodes=state_nodes or [],
+        daemonset_pods=daemonsets or [],
+    )
+    return scheduler.solve(pods)
+
+
+def scheduled_count(results):
+    return sum(len(n.pods) for n in results.new_nodes) + sum(
+        len(n.pods) for n in results.existing_nodes
+    )
+
+
+class TestBasicScheduling:
+    def test_single_pod_gets_a_node(self):
+        results = solve([make_pod(requests={"cpu": 1})])
+        assert len(results.new_nodes) == 1
+        assert scheduled_count(results) == 1
+        assert not results.failed_pods
+
+    def test_pods_pack_onto_one_node(self):
+        # 3 tiny pods fit one default (4-cpu, 5-pod) instance
+        results = solve(make_pods(3, requests={"cpu": "500m"}))
+        assert len(results.new_nodes) == 1
+        assert scheduled_count(results) == 3
+
+    def test_pod_count_limit_opens_new_nodes(self):
+        # default instance types allow 5 pods per node
+        results = solve(make_pods(6, requests={"cpu": "1m"}))
+        assert scheduled_count(results) == 6
+        assert len(results.new_nodes) == 2
+
+    def test_huge_pod_fails(self):
+        results = solve([make_pod(requests={"cpu": 1000})])
+        assert results.failed_pods
+        assert scheduled_count(results) == 0
+
+    def test_gpu_pod_selects_gpu_instance(self):
+        results = solve([make_pod(requests={fake_cp.RESOURCE_GPU_VENDOR_A: 1})])
+        assert scheduled_count(results) == 1
+        names = {it.name for it in results.new_nodes[0].instance_type_options}
+        assert names == {"gpu-vendor-instance-type"}
+
+    def test_different_resources_split_nodes(self):
+        results = solve(
+            [
+                make_pod(requests={fake_cp.RESOURCE_GPU_VENDOR_A: 1}),
+                make_pod(requests={fake_cp.RESOURCE_GPU_VENDOR_B: 1}),
+            ]
+        )
+        assert scheduled_count(results) == 2
+        assert len(results.new_nodes) == 2
+
+
+class TestNodeAffinity:
+    def test_node_selector(self):
+        results = solve([make_pod(node_selector={ZONE: "test-zone-2"})])
+        assert scheduled_count(results) == 1
+        node = results.new_nodes[0]
+        assert node.requirements.get(ZONE).values_list() == ["test-zone-2"]
+
+    def test_node_selector_impossible_zone(self):
+        results = solve([make_pod(node_selector={ZONE: "unknown-zone"})])
+        assert scheduled_count(results) == 0
+
+    def test_node_affinity_in(self):
+        results = solve(
+            [
+                make_pod(
+                    node_requirements=[
+                        NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1", "test-zone-2"])
+                    ]
+                )
+            ]
+        )
+        assert scheduled_count(results) == 1
+        values = set(results.new_nodes[0].requirements.get(ZONE).values_list())
+        assert values <= {"test-zone-1", "test-zone-2"}
+
+    def test_node_affinity_not_in(self):
+        results = solve(
+            [make_pod(node_requirements=[NodeSelectorRequirement(ZONE, OP_NOT_IN, ["test-zone-1"])])]
+        )
+        assert scheduled_count(results) == 1
+        assert not results.new_nodes[0].requirements.get(ZONE).has("test-zone-1")
+
+    def test_custom_label_requires_provisioner_definition(self):
+        # a pod requiring an undefined custom label cannot schedule
+        results = solve([make_pod(node_requirements=[NodeSelectorRequirement("team", OP_IN, ["a"])])])
+        assert scheduled_count(results) == 0
+        # but schedules when the provisioner defines the label
+        results = solve(
+            [make_pod(node_requirements=[NodeSelectorRequirement("team", OP_IN, ["a"])])],
+            provisioners=[
+                make_provisioner(requirements=[NodeSelectorRequirement("team", OP_IN, ["a", "b"])])
+            ],
+        )
+        assert scheduled_count(results) == 1
+
+    def test_provisioner_requirements_constrain_pods(self):
+        provisioner = make_provisioner(
+            requirements=[NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1"])]
+        )
+        results = solve([make_pod(node_selector={ZONE: "test-zone-2"})], provisioners=[provisioner])
+        assert scheduled_count(results) == 0
+
+    def test_conflicting_pods_get_different_nodes(self):
+        results = solve(
+            [
+                make_pod(node_selector={ZONE: "test-zone-1"}),
+                make_pod(node_selector={ZONE: "test-zone-2"}),
+            ]
+        )
+        assert scheduled_count(results) == 2
+        assert len(results.new_nodes) == 2
+
+    def test_gt_lt_operators(self):
+        provisioner = make_provisioner(
+            requirements=[
+                NodeSelectorRequirement(fake_cp.INTEGER_INSTANCE_LABEL_KEY, OP_EXISTS)
+            ]
+        )
+        results = solve(
+            [
+                make_pod(
+                    node_requirements=[
+                        NodeSelectorRequirement(fake_cp.INTEGER_INSTANCE_LABEL_KEY, OP_GT, ["8"])
+                    ]
+                )
+            ],
+            provisioners=[provisioner],
+        )
+        assert scheduled_count(results) == 1
+        # only the 16-cpu arm instance has integer label > 8
+        names = {it.name for it in results.new_nodes[0].instance_type_options}
+        assert names == {"arm-instance-type"}
+
+
+class TestTaints:
+    def test_untolerated_taint_blocks(self):
+        provisioner = make_provisioner(taints=[Taint("example.com/special", "true")])
+        results = solve([make_pod()], provisioners=[provisioner])
+        assert scheduled_count(results) == 0
+
+    def test_tolerated_taint_schedules(self):
+        provisioner = make_provisioner(taints=[Taint("example.com/special", "true")])
+        pod = make_pod(
+            tolerations=[Toleration(key="example.com/special", operator="Exists")]
+        )
+        results = solve([pod], provisioners=[provisioner])
+        assert scheduled_count(results) == 1
+
+    def test_exists_toleration_tolerates_all_of_key(self):
+        provisioner = make_provisioner(taints=[Taint("k", "any-value")])
+        pod = make_pod(tolerations=[Toleration(key="k", operator="Exists")])
+        assert scheduled_count(solve([pod], provisioners=[provisioner])) == 1
+
+
+class TestHostPorts:
+    def test_conflicting_host_ports_split_nodes(self):
+        results = solve(
+            [make_pod(host_ports=[8080], requests={"cpu": "1m"}) for _ in range(2)]
+        )
+        assert scheduled_count(results) == 2
+        assert len(results.new_nodes) == 2
+
+    def test_distinct_host_ports_share_node(self):
+        results = solve(
+            [
+                make_pod(host_ports=[8080], requests={"cpu": "1m"}),
+                make_pod(host_ports=[8081], requests={"cpu": "1m"}),
+            ]
+        )
+        assert scheduled_count(results) == 2
+        assert len(results.new_nodes) == 1
+
+
+class TestTopologySpread:
+    def _spread_pods(self, n, key=ZONE, max_skew=1):
+        return [
+            make_pod(
+                labels={"app": "web"},
+                requests={"cpu": "10m"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=max_skew,
+                        topology_key=key,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ],
+            )
+            for _ in range(n)
+        ]
+
+    def test_zonal_spread_balances(self):
+        results = solve(self._spread_pods(9))
+        assert scheduled_count(results) == 9
+        counts = {}
+        for node in results.new_nodes:
+            zone = node.requirements.get(ZONE).values_list()[0]
+            counts[zone] = counts.get(zone, 0) + len(node.pods)
+        assert sorted(counts.values()) == [3, 3, 3]
+
+    def test_hostname_spread_forces_nodes(self):
+        results = solve(self._spread_pods(4, key=HOSTNAME))
+        assert scheduled_count(results) == 4
+        assert len(results.new_nodes) == 4
+
+    def test_max_skew_2_allows_imbalance(self):
+        results = solve(self._spread_pods(4, max_skew=2))
+        assert scheduled_count(results) == 4
+        counts = {}
+        for node in results.new_nodes:
+            zone = node.requirements.get(ZONE).values_list()[0]
+            counts[zone] = counts.get(zone, 0) + len(node.pods)
+        assert max(counts.values()) - min(counts.get(z, 0) for z in
+                                          ["test-zone-1", "test-zone-2", "test-zone-3"]) <= 2
+
+    def test_spread_constrained_by_zone_selector(self):
+        # pods restricted to 2 zones spread across only those
+        pods = [
+            make_pod(
+                labels={"app": "web"},
+                requests={"cpu": "10m"},
+                node_requirements=[
+                    NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1", "test-zone-2"])
+                ],
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=ZONE,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ],
+            )
+            for _ in range(4)
+        ]
+        results = solve(pods)
+        assert scheduled_count(results) == 4
+        zones = set()
+        for node in results.new_nodes:
+            zones.update(node.requirements.get(ZONE).values_list())
+        assert zones <= {"test-zone-1", "test-zone-2"}
+
+
+class TestPodAffinity:
+    def _affinity_pod(self, **kwargs):
+        return make_pod(
+            labels={"app": "db"},
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key=HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"app": "db"}),
+                )
+            ],
+            **kwargs,
+        )
+
+    def test_self_affinity_colocates(self):
+        results = solve([self._affinity_pod(requests={"cpu": "10m"}) for _ in range(3)])
+        assert scheduled_count(results) == 3
+        assert len(results.new_nodes) == 1
+
+    def test_anti_affinity_separates(self):
+        pods = [
+            make_pod(
+                labels={"app": "db"},
+                requests={"cpu": "10m"},
+                pod_anti_affinity=[
+                    PodAffinityTerm(
+                        topology_key=HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"app": "db"}),
+                    )
+                ],
+            )
+            for _ in range(3)
+        ]
+        results = solve(pods)
+        assert scheduled_count(results) == 3
+        assert len(results.new_nodes) == 3
+
+    def test_zonal_anti_affinity_caps_at_domain_count(self):
+        pods = [
+            make_pod(
+                labels={"app": "db"},
+                requests={"cpu": "10m"},
+                pod_anti_affinity=[
+                    PodAffinityTerm(
+                        topology_key=ZONE,
+                        label_selector=LabelSelector(match_labels={"app": "db"}),
+                    )
+                ],
+            )
+            for _ in range(4)
+        ]
+        results = solve(pods)
+        # late committal is pessimistic: the first pod could land in any zone, so
+        # it blocks all zones for the rest of the batch; zonal anti-affinity
+        # resolves over multiple batches (reference topology_test.go:1896-1900)
+        assert scheduled_count(results) == 1
+        assert len(results.failed_pods) == 3
+
+    def test_affinity_to_unconstrained_target_defers(self):
+        # zone affinity to an unconstrained target can't resolve in one batch:
+        # the target's zone never collapses to a single value, so it is never
+        # counted (reference topology_test.go:1941-1963)
+        target = make_pod(labels={"app": "web"}, requests={"cpu": "10m"})
+        follower = make_pod(
+            requests={"cpu": "10m"},
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key=ZONE,
+                    label_selector=LabelSelector(match_labels={"app": "web"}),
+                )
+            ],
+        )
+        results = solve([target, follower])
+        assert scheduled_count(results) == 1
+        assert len(results.failed_pods) == 1
+
+    def test_affinity_to_zone_constrained_target_colocates(self):
+        # when the target is pinned to one zone, followers can join it in-batch
+        target = make_pod(
+            labels={"app": "web"},
+            requests={"cpu": "10m"},
+            node_selector={ZONE: "test-zone-2"},
+        )
+        followers = [
+            make_pod(
+                requests={"cpu": "10m"},
+                pod_affinity=[
+                    PodAffinityTerm(
+                        topology_key=ZONE,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ],
+            )
+            for _ in range(2)
+        ]
+        results = solve([target] + followers)
+        assert scheduled_count(results) == 3
+        for node in results.new_nodes:
+            if node.pods:
+                assert node.requirements.get(ZONE).values_list() == ["test-zone-2"]
+
+
+class TestRelaxation:
+    def test_preferred_node_affinity_relaxed(self):
+        pod = make_pod(
+            node_preferences=[NodeSelectorRequirement(ZONE, OP_IN, ["unknown-zone"])]
+        )
+        results = solve([pod])
+        assert scheduled_count(results) == 1
+
+    def test_schedule_anyway_spread_relaxed(self):
+        # an unsatisfiable ScheduleAnyway spread is dropped
+        pod = make_pod(
+            labels={"app": "a"},
+            topology_spread=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key="undefined-topology-key",
+                    when_unsatisfiable="ScheduleAnyway",
+                    label_selector=LabelSelector(match_labels={"app": "a"}),
+                )
+            ],
+        )
+        results = solve([pod])
+        assert scheduled_count(results) == 1
+
+    def test_required_affinity_not_relaxed(self):
+        pod = make_pod(
+            node_requirements=[NodeSelectorRequirement(ZONE, OP_IN, ["unknown-zone"])]
+        )
+        results = solve([pod])
+        assert scheduled_count(results) == 0
+
+
+class TestProvisionerSelection:
+    def test_weight_order(self):
+        heavy = make_provisioner(name="heavy", weight=100, labels={"tier": "heavy"})
+        light = make_provisioner(name="light", weight=1, labels={"tier": "light"})
+        results = solve([make_pod()], provisioners=[light, heavy])
+        assert scheduled_count(results) == 1
+        assert results.new_nodes[0].provisioner_name == "heavy"
+
+    def test_fallback_to_compatible_provisioner(self):
+        tainted = make_provisioner(name="tainted", weight=100, taints=[Taint("special", "true")])
+        normal = make_provisioner(name="normal", weight=1)
+        results = solve([make_pod()], provisioners=[tainted, normal])
+        assert scheduled_count(results) == 1
+        assert results.new_nodes[0].provisioner_name == "normal"
+
+    def test_limits_enforced(self):
+        provisioner = make_provisioner(limits={"cpu": 4})
+        # each default node consumes up to 16 cpu pessimistically; after the
+        # first node the provisioner is exhausted
+        results = solve(
+            make_pods(10, requests={"cpu": 3}),
+            provisioners=[provisioner],
+        )
+        assert scheduled_count(results) < 10
+
+    def test_limits_zero_blocks_all(self):
+        provisioner = make_provisioner(limits={"cpu": 0})
+        results = solve([make_pod(requests={"cpu": 1})], provisioners=[provisioner])
+        assert scheduled_count(results) == 0
+
+
+class TestInstanceSelection:
+    def test_cheapest_instances_survive(self):
+        # with the incremental catalog, a 1-cpu pod keeps small types viable
+        its = fake_cp.instance_types(10)
+        results = solve(make_pods(1, requests={"cpu": "500m"}), instance_types=its)
+        assert scheduled_count(results) == 1
+        names = {it.name for it in results.new_nodes[0].instance_type_options}
+        assert "fake-it-0" in names  # smallest still viable
+
+    def test_capacity_type_requirement(self):
+        pod = make_pod(
+            node_requirements=[NodeSelectorRequirement(CT, OP_IN, ["spot"])]
+        )
+        results = solve([pod])
+        assert scheduled_count(results) == 1
+        assert results.new_nodes[0].requirements.get(CT).values_list() == ["spot"]
