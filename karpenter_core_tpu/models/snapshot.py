@@ -1,0 +1,412 @@
+"""Dense cluster-snapshot encoding for the TPU solve kernel.
+
+Encodes the solver's inputs (SURVEY.md §7 step 2) into numpy tensors:
+
+  - instance types: general-key requirement masks, allocatable vectors, and
+    offering availability/price over the zone × capacity-type axes
+  - machine templates (per provisioner, weight-ordered): requirement masks,
+    structural-axis masks, daemonset overhead, taints (pre-evaluated against
+    pod classes)
+  - pod *classes*: pods deduplicated by (requirements, requests, tolerations,
+    topology spec) — the kernel's scan runs over classes, not pods, which is
+    what makes 50k-pod solves tractable: cost scales with distinct pod shapes
+
+Structural keys (hostname / instance-type / zone / capacity-type) are encoded
+as dedicated axes rather than general masks (models.vocab.STRUCTURAL_KEYS).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import Pod
+from karpenter_core_tpu.apis.v1alpha5 import Provisioner
+from karpenter_core_tpu.cloudprovider import InstanceType
+from karpenter_core_tpu.models.vocab import Vocabulary, encode_value_set
+from karpenter_core_tpu.scheduling import Requirements, Taints
+from karpenter_core_tpu.solver.machinetemplate import MachineTemplate
+from karpenter_core_tpu.utils import pod as pod_util
+from karpenter_core_tpu.utils import resources as resources_util
+
+UNLIMITED = np.int32(1 << 30)
+
+
+@dataclass
+class PodClass:
+    """One equivalence class of identical pods."""
+
+    pods: List[Pod]
+    requirements: Requirements
+    requests: resources_util.ResourceList
+    # topology spec (self-selecting groups only; cross-class groups take the
+    # host path — see encode_pods)
+    zone_spread_skew: Optional[int] = None
+    host_spread_skew: Optional[int] = None
+    zone_anti_affinity: bool = False
+    host_anti_affinity: bool = False
+
+    @property
+    def count(self) -> int:
+        return len(self.pods)
+
+
+@dataclass
+class EncodedSnapshot:
+    vocab: Vocabulary
+    resources: List[str]  # R axis
+    zones: List[str]  # Z axis
+    capacity_types: List[str]  # CT axis
+    it_names: List[str]  # I axis
+    classes: List[PodClass]  # C axis (solve order: FFD cpu/mem descending)
+
+    # instance types [I, ...]
+    it_mask: np.ndarray = None
+    it_defined: np.ndarray = None
+    it_negative: np.ndarray = None
+    it_gt: np.ndarray = None
+    it_lt: np.ndarray = None
+    it_alloc: np.ndarray = None  # f32[I, R]
+    it_avail: np.ndarray = None  # bool[I, Z, CT] offering available
+    it_price: np.ndarray = None  # f32[I, Z, CT] (+inf unavailable)
+
+    # templates [T, ...] (weight-ordered)
+    tmpl_mask: np.ndarray = None
+    tmpl_defined: np.ndarray = None
+    tmpl_negative: np.ndarray = None
+    tmpl_gt: np.ndarray = None
+    tmpl_lt: np.ndarray = None
+    tmpl_zone: np.ndarray = None  # bool[T, Z]
+    tmpl_ct: np.ndarray = None  # bool[T, CT]
+    tmpl_it: np.ndarray = None  # bool[T, I] catalog membership ∧ it-name reqs
+    tmpl_daemon: np.ndarray = None  # f32[T, R]
+
+    # pod classes [C, ...]
+    cls_mask: np.ndarray = None
+    cls_defined: np.ndarray = None
+    cls_negative: np.ndarray = None
+    cls_gt: np.ndarray = None
+    cls_lt: np.ndarray = None
+    cls_zone: np.ndarray = None  # bool[C, Z]
+    cls_ct: np.ndarray = None  # bool[C, CT]
+    cls_it: np.ndarray = None  # bool[C, I]
+    cls_requests: np.ndarray = None  # f32[C, R]
+    cls_count: np.ndarray = None  # i32[C]
+    cls_tol: np.ndarray = None  # bool[C, T] tolerates template taints
+    cls_zone_cap: np.ndarray = None  # i32[C] max added pods per zone (anti-aff=1)
+    cls_zone_skew: np.ndarray = None  # i32[C] spread skew (UNLIMITED = none)
+    cls_host_cap: np.ndarray = None  # i32[C] max pods per node
+    cls_zone_count0: np.ndarray = None  # i32[C, Z] pre-existing group counts
+
+    # vocabulary statics
+    valid: np.ndarray = None  # bool[K, V+1]
+    is_custom: np.ndarray = None  # bool[K]
+    vocab_ints: np.ndarray = None  # f32[K, V]
+
+
+def _class_signature(pod: Pod, requirements: Requirements) -> tuple:
+    req_sig = tuple(
+        sorted(
+            (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
+            for r in requirements.values()
+        )
+    )
+    requests = resources_util.requests_for_pods(pod)
+    req_vec = tuple(sorted((k, round(v, 9)) for k, v in requests.items() if k != "pods"))
+    tol_sig = tuple(
+        sorted((t.key, t.operator, t.value, t.effect) for t in pod.spec.tolerations)
+    )
+    spread_sig = tuple(
+        sorted(
+            (
+                c.topology_key,
+                c.max_skew,
+                c.when_unsatisfiable,
+                _selector_sig(c.label_selector),
+            )
+            for c in pod.spec.topology_spread_constraints
+        )
+    )
+    affinity_sig = ()
+    if pod.spec.affinity is not None:
+        aff = pod.spec.affinity
+        terms = []
+        if aff.pod_affinity is not None:
+            for t in aff.pod_affinity.required:
+                terms.append(("aff", t.topology_key, _selector_sig(t.label_selector)))
+        if aff.pod_anti_affinity is not None:
+            for t in aff.pod_anti_affinity.required:
+                terms.append(("anti", t.topology_key, _selector_sig(t.label_selector)))
+        affinity_sig = tuple(sorted(terms))
+    labels_sig = tuple(sorted(pod.metadata.labels.items()))
+    ports_sig = tuple(
+        sorted(
+            (p.host_port, p.protocol, p.host_ip)
+            for c in pod.spec.containers
+            for p in c.ports
+            if p.host_port
+        )
+    )
+    return (req_sig, req_vec, tol_sig, spread_sig, affinity_sig, labels_sig, ports_sig)
+
+
+def _selector_sig(selector) -> tuple:
+    if selector is None:
+        return ()
+    return (
+        tuple(sorted(selector.match_labels.items())),
+        tuple(
+            sorted(
+                (e.key, e.operator, tuple(sorted(e.values)))
+                for e in selector.match_expressions
+            )
+        ),
+    )
+
+
+def _self_selecting(pod: Pod, selector) -> bool:
+    return selector is not None and selector.matches(pod.metadata.labels)
+
+
+class KernelUnsupported(Exception):
+    """The batch uses a feature the tensor kernel does not cover; callers fall
+    back to the host solver (solver.scheduler.Scheduler)."""
+
+
+def classify_pods(pods: List[Pod]) -> List[PodClass]:
+    """Group pods into equivalence classes and derive each class's topology
+    spec.  Raises KernelUnsupported for shapes the kernel doesn't model:
+    cross-class selectors, non-self-selecting affinity, host ports, region/
+    custom-key spreads."""
+    groups: Dict[tuple, PodClass] = {}
+    order: List[tuple] = []
+    for pod in pods:
+        requirements = Requirements.from_pod(pod)
+        sig = _class_signature(pod, requirements)
+        if sig not in groups:
+            cls = PodClass(
+                pods=[],
+                requirements=requirements,
+                requests=resources_util.ceiling(pod),
+            )
+            _derive_topology_spec(pod, cls)
+            groups[sig] = cls
+            order.append(sig)
+        groups[sig].pods.append(pod)
+
+    classes = [groups[sig] for sig in order]
+    # FFD: cpu desc, then memory desc (queue.go:74-110)
+    classes.sort(
+        key=lambda c: (
+            -c.requests.get(resources_util.CPU, 0.0),
+            -c.requests.get(resources_util.MEMORY, 0.0),
+        )
+    )
+    return classes
+
+
+def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
+    for constraint in pod.spec.topology_spread_constraints:
+        if constraint.when_unsatisfiable != "DoNotSchedule":
+            continue  # ScheduleAnyway spreads relax away on failure
+        if not _self_selecting(pod, constraint.label_selector):
+            raise KernelUnsupported("spread selector not self-selecting")
+        if constraint.topology_key == labels_api.LABEL_TOPOLOGY_ZONE:
+            cls.zone_spread_skew = constraint.max_skew
+        elif constraint.topology_key == labels_api.LABEL_HOSTNAME:
+            cls.host_spread_skew = constraint.max_skew
+        else:
+            raise KernelUnsupported(
+                f"spread on {constraint.topology_key} not kernel-supported"
+            )
+    affinity = pod.spec.affinity
+    if affinity is not None:
+        if affinity.pod_affinity is not None and affinity.pod_affinity.required:
+            raise KernelUnsupported("required pod affinity not kernel-supported")
+        if affinity.pod_anti_affinity is not None:
+            for term in affinity.pod_anti_affinity.required:
+                if not _self_selecting(pod, term.label_selector):
+                    raise KernelUnsupported("anti-affinity selector not self-selecting")
+                if term.topology_key == labels_api.LABEL_HOSTNAME:
+                    cls.host_anti_affinity = True
+                elif term.topology_key == labels_api.LABEL_TOPOLOGY_ZONE:
+                    cls.zone_anti_affinity = True
+                else:
+                    raise KernelUnsupported(
+                        f"anti-affinity on {term.topology_key} not kernel-supported"
+                    )
+    for container in pod.spec.containers:
+        if any(p.host_port for p in container.ports):
+            raise KernelUnsupported("host ports not kernel-supported")
+
+
+def encode_snapshot(
+    pods: List[Pod],
+    provisioners: List[Provisioner],
+    templates: List[MachineTemplate],
+    instance_types: Dict[str, List[InstanceType]],
+) -> EncodedSnapshot:
+    """Encode a solve input.  ``templates`` must be weight-ordered (the order
+    is the kernel's template preference order, scheduler.go:174-219)."""
+    classes = classify_pods(pods)
+
+    # -- axes -----------------------------------------------------------------
+    all_its: List[InstanceType] = []
+    it_index: Dict[str, int] = {}
+    for tmpl in templates:
+        for it in instance_types.get(tmpl.provisioner_name, []):
+            if it.name not in it_index:
+                it_index[it.name] = len(all_its)
+                all_its.append(it)
+    it_names = [it.name for it in all_its]
+
+    zones: List[str] = []
+    capacity_types: List[str] = []
+    for it in all_its:
+        for off in it.offerings:
+            if off.zone not in zones:
+                zones.append(off.zone)
+            if off.capacity_type not in capacity_types:
+                capacity_types.append(off.capacity_type)
+    zones = sorted(zones)
+    capacity_types = sorted(capacity_types)
+
+    resources: List[str] = [resources_util.CPU, resources_util.MEMORY, resources_util.PODS]
+    for cls in classes:
+        for name in cls.requests:
+            if name not in resources:
+                resources.append(name)
+    for it in all_its:
+        for name in it.capacity:
+            if name not in resources:
+                resources.append(name)
+
+    # -- vocabulary -----------------------------------------------------------
+    req_sets = [cls.requirements for cls in classes]
+    req_sets += [it.requirements for it in all_its]
+    req_sets += [tmpl.requirements for tmpl in templates]
+    vocab = Vocabulary.build(req_sets)
+
+    snap = EncodedSnapshot(
+        vocab=vocab,
+        resources=resources,
+        zones=zones,
+        capacity_types=capacity_types,
+        it_names=it_names,
+        classes=classes,
+    )
+    snap.valid = vocab.valid_mask()
+    snap.is_custom = vocab.is_custom()
+    snap.vocab_ints = vocab.ints_table()
+
+    # -- instance types -------------------------------------------------------
+    I, Z, CT, R = len(all_its), len(zones), len(capacity_types), len(resources)
+    snap.it_alloc = np.zeros((I, R), dtype=np.float32)
+    snap.it_avail = np.zeros((I, Z, CT), dtype=bool)
+    snap.it_price = np.full((I, Z, CT), np.inf, dtype=np.float32)
+    it_planes = [vocab.encode_requirements(it.requirements) for it in all_its]
+    snap.it_mask, snap.it_defined, snap.it_negative, snap.it_gt, snap.it_lt = (
+        np.stack([p[j] for p in it_planes]) for j in range(5)
+    )
+    zone_idx = {z: i for i, z in enumerate(zones)}
+    ct_idx = {c: i for i, c in enumerate(capacity_types)}
+    for i, it in enumerate(all_its):
+        alloc = it.allocatable()
+        for r, name in enumerate(resources):
+            snap.it_alloc[i, r] = alloc.get(name, 0.0)
+        for off in it.offerings:
+            if off.available:
+                snap.it_avail[i, zone_idx[off.zone], ct_idx[off.capacity_type]] = True
+                snap.it_price[i, zone_idx[off.zone], ct_idx[off.capacity_type]] = off.price
+
+    # -- templates ------------------------------------------------------------
+    T = len(templates)
+    tmpl_planes = [vocab.encode_requirements(t.requirements) for t in templates]
+    snap.tmpl_mask, snap.tmpl_defined, snap.tmpl_negative, snap.tmpl_gt, snap.tmpl_lt = (
+        np.stack([p[j] for p in tmpl_planes]) for j in range(5)
+    )
+    snap.tmpl_zone = np.zeros((T, Z), dtype=bool)
+    snap.tmpl_ct = np.zeros((T, CT), dtype=bool)
+    snap.tmpl_it = np.zeros((T, I), dtype=bool)
+    snap.tmpl_daemon = np.zeros((T, R), dtype=np.float32)
+    for t, tmpl in enumerate(templates):
+        reqs = tmpl.requirements
+        snap.tmpl_zone[t] = encode_value_set(
+            reqs.get(labels_api.LABEL_TOPOLOGY_ZONE) if reqs.has(labels_api.LABEL_TOPOLOGY_ZONE) else None,
+            zones,
+        )
+        snap.tmpl_ct[t] = encode_value_set(
+            reqs.get(labels_api.LABEL_CAPACITY_TYPE) if reqs.has(labels_api.LABEL_CAPACITY_TYPE) else None,
+            capacity_types,
+        )
+        name_req = (
+            reqs.get(labels_api.LABEL_INSTANCE_TYPE_STABLE)
+            if reqs.has(labels_api.LABEL_INSTANCE_TYPE_STABLE)
+            else None
+        )
+        catalog = {it.name for it in instance_types.get(tmpl.provisioner_name, [])}
+        snap.tmpl_it[t] = np.array(
+            [
+                name in catalog and (name_req is None or name_req.has(name))
+                for name in it_names
+            ],
+            dtype=bool,
+        )
+        for r, name in enumerate(resources):
+            snap.tmpl_daemon[t, r] = tmpl.requests.get(name, 0.0) if tmpl.requests else 0.0
+
+    # -- pod classes ----------------------------------------------------------
+    C = len(classes)
+    cls_planes = [vocab.encode_requirements(c.requirements) for c in classes]
+    snap.cls_mask, snap.cls_defined, snap.cls_negative, snap.cls_gt, snap.cls_lt = (
+        np.stack([p[j] for p in cls_planes]) for j in range(5)
+    )
+    snap.cls_zone = np.zeros((C, Z), dtype=bool)
+    snap.cls_ct = np.zeros((C, CT), dtype=bool)
+    snap.cls_it = np.zeros((C, I), dtype=bool)
+    snap.cls_requests = np.zeros((C, R), dtype=np.float32)
+    snap.cls_count = np.zeros(C, dtype=np.int32)
+    snap.cls_tol = np.zeros((C, T), dtype=bool)
+    snap.cls_zone_cap = np.full(C, UNLIMITED, dtype=np.int32)
+    snap.cls_zone_skew = np.full(C, UNLIMITED, dtype=np.int32)
+    snap.cls_host_cap = np.full(C, UNLIMITED, dtype=np.int32)
+    snap.cls_zone_count0 = np.zeros((C, Z), dtype=np.int32)
+    for c, cls in enumerate(classes):
+        reqs = cls.requirements
+        snap.cls_zone[c] = encode_value_set(
+            reqs.get(labels_api.LABEL_TOPOLOGY_ZONE) if reqs.has(labels_api.LABEL_TOPOLOGY_ZONE) else None,
+            zones,
+        )
+        snap.cls_ct[c] = encode_value_set(
+            reqs.get(labels_api.LABEL_CAPACITY_TYPE) if reqs.has(labels_api.LABEL_CAPACITY_TYPE) else None,
+            capacity_types,
+        )
+        snap.cls_it[c] = encode_value_set(
+            reqs.get(labels_api.LABEL_INSTANCE_TYPE_STABLE)
+            if reqs.has(labels_api.LABEL_INSTANCE_TYPE_STABLE)
+            else None,
+            it_names,
+        )
+        requests = dict(cls.requests)
+        requests[resources_util.PODS] = 1.0
+        for r, name in enumerate(resources):
+            snap.cls_requests[c, r] = requests.get(name, 0.0)
+        snap.cls_count[c] = cls.count
+        example = cls.pods[0]
+        for t, tmpl in enumerate(templates):
+            snap.cls_tol[c, t] = Taints.of(tmpl.taints).tolerates(example) is None
+        if cls.zone_anti_affinity:
+            snap.cls_zone_cap[c] = 1
+        if cls.zone_spread_skew is not None:
+            snap.cls_zone_skew[c] = cls.zone_spread_skew
+        if cls.host_anti_affinity:
+            snap.cls_host_cap[c] = 1
+        elif cls.host_spread_skew is not None:
+            # hostname min-count is always 0 (a new node is always possible,
+            # topologygroup.go:184-188), so per-node cap = maxSkew
+            snap.cls_host_cap[c] = cls.host_spread_skew
+
+    return snap

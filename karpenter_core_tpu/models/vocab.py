@@ -1,0 +1,155 @@
+"""Value vocabulary: the finite universe behind the mask encoding.
+
+At snapshot-encode time the universe of values per label key is finite — it is
+the union of values carried by instance types, provisioners, existing nodes, and
+the pod batch.  Each key gets a dense value index; every Requirements set then
+encodes as boolean masks over [K, V+1], the final slot meaning "any value not in
+the vocabulary" (see karpenter_core_tpu.ops.masks).
+
+Structural keys (hostname, instance-type, zone, capacity-type) are excluded
+from the general mask axes by the snapshot encoder — they are handled
+structurally (node identity, viability vectors, zone/capacity axes), which
+keeps mask state small at 50k-node scale.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.scheduling import Requirement, Requirements
+
+STRUCTURAL_KEYS = (
+    labels_api.LABEL_HOSTNAME,
+    labels_api.LABEL_INSTANCE_TYPE_STABLE,
+    labels_api.LABEL_TOPOLOGY_ZONE,
+    labels_api.LABEL_CAPACITY_TYPE,
+)
+
+
+@dataclass
+class Vocabulary:
+    keys: List[str]
+    values: Dict[str, List[str]]
+    key_index: Dict[str, int] = field(default_factory=dict)
+    value_index: Dict[str, Dict[str, int]] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self.key_index = {k: i for i, k in enumerate(self.keys)}
+        self.value_index = {
+            k: {v: i for i, v in enumerate(vals)} for k, vals in self.values.items()
+        }
+
+    @property
+    def n_keys(self) -> int:
+        return len(self.keys)
+
+    @property
+    def vmax(self) -> int:
+        return max((len(v) for v in self.values.values()), default=0)
+
+    @property
+    def width(self) -> int:
+        """V+1: mask width including the 'other' slot."""
+        return self.vmax + 1
+
+    def valid_mask(self) -> np.ndarray:
+        """bool[K, V+1]: which slots are real values per key (other slot on)."""
+        out = np.zeros((self.n_keys, self.width), dtype=bool)
+        for k, key in enumerate(self.keys):
+            out[k, : len(self.values[key])] = True
+            out[k, -1] = True
+        return out
+
+    def is_custom(self) -> np.ndarray:
+        """bool[K]: keys subject to the denied-if-undefined rule
+        (requirements.go:125)."""
+        return np.array(
+            [k not in labels_api.WELL_KNOWN_LABELS for k in self.keys], dtype=bool
+        )
+
+    @classmethod
+    def build(
+        cls,
+        requirement_sets: Iterable[Requirements],
+        exclude_keys: Tuple[str, ...] = STRUCTURAL_KEYS,
+    ) -> "Vocabulary":
+        values: Dict[str, Dict[str, None]] = {}
+        for reqs in requirement_sets:
+            for key in reqs.keys():
+                if key in exclude_keys:
+                    continue
+                bucket = values.setdefault(key, {})
+                r = reqs.get(key)
+                for v in r.values:
+                    bucket.setdefault(v, None)
+                # materialize small finite Gt/Lt ranges so bounded-integer
+                # requirements stay exact under the mask encoding
+                if r.greater_than is not None and r.less_than is not None:
+                    lo, hi = r.greater_than + 1, r.less_than
+                    if 0 < hi - lo <= 64:
+                        for i in range(lo, hi):
+                            bucket.setdefault(str(i), None)
+        keys = sorted(values)
+        return cls(keys=keys, values={k: list(v) for k, v in values.items()})
+
+    # -- encoding -------------------------------------------------------------
+
+    def ints_table(self) -> np.ndarray:
+        """f32[K, Vmax]: vocabulary values as numbers, +inf where non-numeric
+        or padding — the kernel counts these inside Gt/Lt ranges when deciding
+        unseen-value overlap (ops.masks._unseen_overlap)."""
+        out = np.full((self.n_keys, self.vmax), np.inf, dtype=np.float32)
+        for k, key in enumerate(self.keys):
+            for i, v in enumerate(self.values[key]):
+                try:
+                    out[k, i] = float(int(v))
+                except ValueError:
+                    pass
+        return out
+
+    def encode_requirement(self, r: Requirement) -> Tuple[np.ndarray, bool, float, float]:
+        """(mask row bool[V+1], negative, gt, lt) for one requirement of a
+        known key.  The other-slot is the complement bit; Gt/Lt bounds are
+        returned separately (±inf when absent) for exact range math in-kernel."""
+        key = r.key
+        row = np.zeros(self.width, dtype=bool)
+        for v, idx in self.value_index[key].items():
+            row[idx] = r.has(v)
+        row[-1] = r.complement
+        negative = r.operator() in ("NotIn", "DoesNotExist")
+        gt = float(r.greater_than) if r.greater_than is not None else -np.inf
+        lt = float(r.less_than) if r.less_than is not None else np.inf
+        return row, negative, gt, lt
+
+    def encode_requirements(
+        self, reqs: Requirements
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """(mask[K, V+1], defined[K], negative[K], gt[K], lt[K]) — undefined
+        keys read as Exists (all slots allowed), per requirements.go:114-120."""
+        mask = self.valid_mask().copy()
+        defined = np.zeros(self.n_keys, dtype=bool)
+        negative = np.zeros(self.n_keys, dtype=bool)
+        gt = np.full(self.n_keys, -np.inf, dtype=np.float32)
+        lt = np.full(self.n_keys, np.inf, dtype=np.float32)
+        for k, key in enumerate(self.keys):
+            if not reqs.has(key):
+                continue
+            row, neg, g, l = self.encode_requirement(reqs.get(key))
+            mask[k] = row
+            defined[k] = True
+            negative[k] = neg
+            gt[k] = g
+            lt[k] = l
+        return mask, defined, negative, gt, lt
+
+
+def encode_value_set(requirement: Optional[Requirement], universe: List[str]) -> np.ndarray:
+    """bool[len(universe)]: which universe values a requirement allows (None =
+    all).  Used for the structural zone/capacity-type/instance-type axes."""
+    if requirement is None:
+        return np.ones(len(universe), dtype=bool)
+    return np.array([requirement.has(v) for v in universe], dtype=bool)

@@ -1,0 +1,189 @@
+"""Requirement-set algebra as boolean-mask kernels.
+
+The tensorized form of karpenter_core_tpu.scheduling.{requirement,requirements}
+(which mirror /root/reference/pkg/scheduling/requirement.go:117-150 and
+requirements.go:123-206).  At snapshot-encode time every label key's value
+universe is finite, so a Requirement over key k becomes a boolean mask over
+``V_k + 1`` slots — the final slot means "values outside the vocabulary" and
+carries the complement bit: an In set has other=0, a NotIn/Exists complement
+has other=1.  Gt/Lt bounds ride as separate ±inf float planes; overlap through
+*unseen* values is then computed exactly: two complements overlap outside the
+vocabulary iff their combined integer range (or the unbounded string universe)
+contains at least one value not in the vocabulary.
+
+With that encoding:
+  - Intersection            = elementwise AND + bound max/min
+  - "intersection nonempty" = any(AND) | unseen-range overlap
+  - Compatible / Intersects = masked all-reductions over keys (below)
+
+All functions broadcast over leading batch axes and are jit/vmap-safe.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -jnp.inf
+POS_INF = jnp.inf
+
+
+class ReqTensor(NamedTuple):
+    """A batch of requirement sets in mask form.
+
+    mask:     bool[..., K, V+1]  allowed vocabulary values per key (undefined
+                                 keys = all ones); slot V = "unseen values"
+    defined:  bool[..., K]       key explicitly present
+    negative: bool[..., K]       operator is NotIn or DoesNotExist
+    gt:       f32[..., K]        exclusive lower bound (-inf when absent)
+    lt:       f32[..., K]        exclusive upper bound (+inf when absent)
+    """
+
+    mask: jnp.ndarray
+    defined: jnp.ndarray
+    negative: jnp.ndarray
+    gt: jnp.ndarray
+    lt: jnp.ndarray
+
+
+def _unseen_overlap(
+    a: ReqTensor, b: ReqTensor, vocab_ints: Optional[jnp.ndarray]
+) -> jnp.ndarray:
+    """bool[..., K]: both sides admit some value OUTSIDE the vocabulary.
+
+    Requires both other-slots set.  With no bounds the unseen string universe
+    is infinite.  With bounds, only integers strictly inside (gt, lt) qualify
+    (requirement.go:227-243 withinIntPtrs rejects non-ints under bounds);
+    the count of such integers minus those already in the vocabulary must be
+    positive.  ``vocab_ints`` is f32[K, V] — each key's vocabulary values as
+    numbers, +inf where non-numeric (never inside a finite range).
+    """
+    both_other = a.mask[..., -1] & b.mask[..., -1]
+    gt = jnp.maximum(a.gt, b.gt)
+    lt = jnp.minimum(a.lt, b.lt)
+    # number of integers strictly between the bounds (inf when unbounded)
+    n_range = jnp.maximum(jnp.ceil(lt) - jnp.floor(gt) - 1.0, 0.0)
+    if vocab_ints is None:
+        n_vocab_in_range = jnp.zeros_like(gt)
+    else:
+        inside = (vocab_ints > gt[..., None]) & (vocab_ints < lt[..., None])
+        n_vocab_in_range = jnp.sum(inside.astype(jnp.float32), axis=-1)
+    return both_other & (n_range - n_vocab_in_range >= 1.0)
+
+
+def nonempty_intersection(
+    a: ReqTensor, b: ReqTensor, vocab_ints: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
+    """bool[..., K]: per-key Intersection(a, b).Len() > 0."""
+    vocab_overlap = jnp.any(a.mask[..., :-1] & b.mask[..., :-1], axis=-1)
+    return vocab_overlap | _unseen_overlap(a, b, vocab_ints)
+
+
+def derive_negative(
+    mask: jnp.ndarray,
+    gt: jnp.ndarray,
+    lt: jnp.ndarray,
+    valid: jnp.ndarray,
+    vocab_ints: Optional[jnp.ndarray],
+) -> jnp.ndarray:
+    """bool[..., K]: operator ∈ {NotIn, DoesNotExist} for a mask-form set.
+
+    Mirrors requirement.go:186-197 Operator(): a complement is NotIn iff its
+    exclusion list is non-empty — and bounds drop out-of-range values from the
+    exclusion list (requirement.go:139-143), so only *within-bounds* vocabulary
+    values count as exclusions.  A concrete empty set is DoesNotExist.
+    """
+    bounds_set = jnp.isfinite(gt) | jnp.isfinite(lt)
+    if vocab_ints is None:
+        within = jnp.ones(valid.shape[:-1] + (valid.shape[-1] - 1,), dtype=bool)
+    else:
+        in_range = (vocab_ints > gt[..., None]) & (vocab_ints < lt[..., None])
+        within = jnp.where(bounds_set[..., None], in_range, True)
+    exclusions = jnp.any(valid[..., :-1] & ~mask[..., :-1] & within, axis=-1)
+    empty = ~jnp.any(mask, axis=-1)
+    return (mask[..., -1] & exclusions) | empty
+
+
+def intersection(
+    a: ReqTensor,
+    b: ReqTensor,
+    valid: Optional[jnp.ndarray] = None,
+    vocab_ints: Optional[jnp.ndarray] = None,
+) -> ReqTensor:
+    """Key-wise intersection (requirement.go:117-150 under the mask encoding).
+
+    Bound filtering of vocabulary values is already baked into each side's
+    mask; combined bounds propagate by max/min.  Operator negativity is
+    re-derived from the result (see derive_negative) when ``valid`` is given;
+    the fallback (both-negative | empty) is exact except for complements whose
+    exclusion lists change NotIn↔Exists across the intersection.
+    """
+    mask = a.mask & b.mask
+    defined = a.defined | b.defined
+    gt = jnp.maximum(a.gt, b.gt)
+    lt = jnp.minimum(a.lt, b.lt)
+    if valid is not None:
+        negative = derive_negative(mask, gt, lt, valid, vocab_ints)
+    else:
+        empty = ~jnp.any(mask, axis=-1)
+        negative = (a.negative & b.negative) | empty
+    return ReqTensor(mask, defined, negative, gt, lt)
+
+
+def intersects(
+    a: ReqTensor, b: ReqTensor, vocab_ints: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
+    """bool[...]: requirements.go:189-206 Intersects == nil.
+
+    Only keys defined on BOTH sides are checked; an empty intersection is
+    forgiven when both operators are negative (NotIn/DoesNotExist).
+    """
+    checked = a.defined & b.defined
+    nonempty = nonempty_intersection(a, b, vocab_ints)
+    both_negative = a.negative & b.negative
+    key_ok = ~checked | nonempty | both_negative
+    return jnp.all(key_ok, axis=-1)
+
+
+def compatible(
+    a: ReqTensor,
+    b: ReqTensor,
+    is_custom: jnp.ndarray,
+    vocab_ints: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """bool[...]: requirements.go:123-133 Compatible == nil, a=node side,
+    b=incoming (pod) side.
+
+    Adds the custom-label rule to Intersects: a custom (non-well-known) key
+    required positively (In/Exists/Gt/Lt) by ``b`` must be defined on ``a``.
+    ``is_custom`` is bool[K] from the vocabulary.
+    """
+    denied = is_custom & b.defined & ~b.negative & ~a.defined
+    return intersects(a, b, vocab_ints) & ~jnp.any(denied, axis=-1)
+
+
+def add(
+    a: ReqTensor,
+    b: ReqTensor,
+    valid: Optional[jnp.ndarray] = None,
+    vocab_ints: Optional[jnp.ndarray] = None,
+) -> ReqTensor:
+    """Requirements.Add: a tightened by b (intersect-on-add per key,
+    requirements.go:87-94)."""
+    return intersection(a, b, valid, vocab_ints)
+
+
+def count_allowed(a: ReqTensor, valid: jnp.ndarray) -> jnp.ndarray:
+    """int32[..., K]: number of in-vocabulary values allowed per key.  The
+    "other" slot is excluded — callers needing Len()-infinite semantics should
+    test mask[..., -1] directly."""
+    return jnp.sum((a.mask & valid).astype(jnp.int32)[..., :-1], axis=-1)
+
+
+def single_value(a: ReqTensor) -> jnp.ndarray:
+    """bool[..., K]: the key collapsed to exactly one in-vocab value and
+    excludes unseen values — the condition under which topology Record counts
+    a domain (topology.go:129-131)."""
+    in_vocab = jnp.sum(a.mask[..., :-1].astype(jnp.int32), axis=-1)
+    return (in_vocab == 1) & ~a.mask[..., -1]

@@ -1,0 +1,515 @@
+"""The TPU bin-packing solve kernel.
+
+Re-centers the reference's greedy first-fit-decreasing loop
+(/root/reference/pkg/controllers/provisioning/scheduling/scheduler.go:96-219,
+node.go:62-159) as a batch tensor program:
+
+  - pods are pre-grouped into equivalence classes (models.snapshot) and the
+    kernel scans over *classes* — identical pods commit identically, so the
+    sequential dependency that matters is between distinct shapes, not pods
+  - each scan step is dense vectorized work over [N] node slots × [I] instance
+    types: requirement-mask compatibility rides the MXU as [N,V]x[V,I] matmuls
+    per key, capacity checks are [N,I] elementwise min-reductions, offering
+    checks flatten zone×capacity-type and matmul too
+  - zonal topology spread becomes a closed-form water-fill over per-zone
+    counts (the per-pod argmin of topologygroup.go:155-182 telescopes into
+    fill-the-lowest-level), then per-zone placement phases
+  - hostname spread / anti-affinity become per-node caps on pods-per-class
+  - node selection order (existing first, then emptiest new node,
+    scheduler.go:174-190) becomes an argsort + prefix-sum fill
+
+Static shapes: N node slots, I instance types, C classes, Z zones, CT capacity
+types, K general keys, V+1 mask width, R resources.  Everything under jit; no
+data-dependent Python control flow.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from karpenter_core_tpu.models.snapshot import EncodedSnapshot, UNLIMITED
+from karpenter_core_tpu.ops import masks as mask_ops
+
+BIG = jnp.float32(1e30)
+
+
+class NodeState(NamedTuple):
+    """Per-node-slot solver state (all leading dim N)."""
+
+    used: jnp.ndarray  # f32[N, R] accumulated requests incl. daemon overhead
+    kmask: jnp.ndarray  # bool[N, K, V+1]
+    kdef: jnp.ndarray  # bool[N, K]
+    kneg: jnp.ndarray  # bool[N, K]
+    kgt: jnp.ndarray  # f32[N, K]
+    klt: jnp.ndarray  # f32[N, K]
+    zone: jnp.ndarray  # bool[N, Z]
+    ct: jnp.ndarray  # bool[N, CT]
+    viable: jnp.ndarray  # bool[N, I]
+    pod_count: jnp.ndarray  # i32[N]
+    tmpl_id: jnp.ndarray  # i32[N]
+    open_: jnp.ndarray  # bool[N]
+    n_next: jnp.ndarray  # i32[] next free slot
+
+
+class SolveOutputs(NamedTuple):
+    assign: jnp.ndarray  # i32[C, N] pods of class c on node n
+    failed: jnp.ndarray  # i32[C]
+    state: NodeState
+
+
+def _water_fill(count0: jnp.ndarray, allowed: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """i32[Z] quotas: distribute m pods over allowed zones, always filling the
+    lowest-count zone first — the telescoped form of the reference's per-pod
+    min-domain selection (topologygroup.go:155-182; maxSkew ≥ 1 guarantees the
+    min-count zone is always admissible so skew never blocks the min choice).
+    """
+    z = count0.shape[0]
+    c = jnp.where(allowed, count0.astype(jnp.float32), BIG)
+    order = jnp.argsort(c)
+    s = c[order]
+    # cost[k] = pods needed to raise the k lowest zones to level s[k]
+    idx = jnp.arange(z, dtype=jnp.float32)
+    prefix = jnp.cumsum(s) - s
+    cost = idx * s - prefix  # cost to reach level s[k] for first k zones
+    cost = jnp.where(jnp.isfinite(cost), cost, BIG)
+    mf = m.astype(jnp.float32)
+    # k* = number of zones that participate in the fill
+    k_star = jnp.sum((cost <= mf).astype(jnp.int32)) - 1
+    k_star = jnp.clip(k_star, 0, z - 1)
+    base_level = s[k_star]
+    spent = cost[k_star]
+    rem = mf - spent
+    k_count = (k_star + 1).astype(jnp.float32)
+    level = base_level + jnp.floor(rem / k_count)
+    leftover = rem - jnp.floor(rem / k_count) * k_count
+    # zones among the k* lowest get filled to `level`, the first `leftover`
+    # (in sorted order) get one extra
+    in_fill = jnp.arange(z) <= k_star
+    extra = (jnp.arange(z) < leftover).astype(jnp.float32)
+    final_sorted = jnp.where(in_fill, jnp.maximum(s, level + extra), s)
+    final = jnp.zeros_like(c).at[order].set(final_sorted)
+    quota = jnp.where(allowed, final - c, 0.0)
+    return jnp.maximum(quota, 0.0).astype(jnp.int32)
+
+
+def _key_compat_node_class(state: NodeState, cls, statics) -> jnp.ndarray:
+    """bool[N]: Requirements.Compatible(node, class) vectorized over nodes."""
+    node_t = mask_ops.ReqTensor(state.kmask, state.kdef, state.kneg, state.kgt, state.klt)
+    cls_t = mask_ops.ReqTensor(
+        cls.mask[None], cls.defined[None], cls.negative[None], cls.gt[None], cls.lt[None]
+    )
+    return mask_ops.compatible(node_t, cls_t, statics.is_custom, statics.vocab_ints)
+
+
+def _merge_node_class(state: NodeState, cls, statics) -> mask_ops.ReqTensor:
+    node_t = mask_ops.ReqTensor(state.kmask, state.kdef, state.kneg, state.kgt, state.klt)
+    cls_t = mask_ops.ReqTensor(
+        cls.mask[None], cls.defined[None], cls.negative[None], cls.gt[None], cls.lt[None]
+    )
+    return mask_ops.add(node_t, cls_t, statics.valid, statics.vocab_ints)
+
+
+def _it_intersects(merged: mask_ops.ReqTensor, statics) -> jnp.ndarray:
+    """bool[N, I]: InstanceType.Requirements.Intersects(nodeReqs) for every
+    (node, instance type) pair (node.go:143-145), with the mask-AND reduction
+    expressed as per-key [N,V]x[V,I] matmuls so it lands on the MXU."""
+    it = statics.it  # ReqTensor [I, K, V+1]
+    n_keys = it.mask.shape[-2]
+    ok_all = None
+    for k in range(n_keys):  # K is small and static: unrolled
+        a_mask = merged.mask[:, k, :]  # [N, V+1]
+        b_mask = it.mask[:, k, :]  # [I, V+1]
+        vocab_overlap = (
+            jnp.einsum(
+                "nv,iv->ni",
+                a_mask[:, :-1].astype(jnp.bfloat16),
+                b_mask[:, :-1].astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            > 0.5
+        )
+        both_other = a_mask[:, -1:] & b_mask[None, :, -1]
+        if statics.key_has_bounds[k]:
+            gt = jnp.maximum(merged.gt[:, k, None], it.gt[None, :, k])
+            lt = jnp.minimum(merged.lt[:, k, None], it.lt[None, :, k])
+            n_range = jnp.maximum(jnp.ceil(lt) - jnp.floor(gt) - 1.0, 0.0)
+            ints_k = statics.vocab_ints[k]  # [V]
+            inside = (ints_k[None, None, :] > gt[..., None]) & (
+                ints_k[None, None, :] < lt[..., None]
+            )
+            n_in = jnp.sum(inside.astype(jnp.float32), axis=-1)
+            unseen = both_other & (n_range - n_in >= 1.0)
+        else:
+            unseen = both_other
+        nonempty = vocab_overlap | unseen
+        checked = merged.defined[:, k, None] & it.defined[None, :, k]
+        both_neg = merged.negative[:, k, None] & it.negative[None, :, k]
+        ok = ~checked | nonempty | both_neg
+        ok_all = ok if ok_all is None else (ok_all & ok)
+    return ok_all
+
+
+def _capacity(used: jnp.ndarray, size: jnp.ndarray, statics) -> jnp.ndarray:
+    """i32[N, I]: how many more pods of the class fit on node n as instance
+    type i — min over resources of floor((alloc - used) / size)
+    (resources Fits telescoped over identical pods)."""
+    n_res = statics.it_alloc.shape[-1]
+    count = None
+    for r in range(n_res):  # R static: unrolled
+        free = statics.it_alloc[None, :, r] - used[:, r, None]  # [N, I]
+        per = jnp.where(
+            size[r] > 0, jnp.floor((free + 1e-4) / jnp.maximum(size[r], 1e-9)), BIG
+        )
+        per = jnp.maximum(per, 0.0)
+        count = per if count is None else jnp.minimum(count, per)
+    return jnp.minimum(count, BIG).astype(jnp.int32)
+
+
+def _offering_ok(zone_ok: jnp.ndarray, ct_ok: jnp.ndarray, statics) -> jnp.ndarray:
+    """bool[N, I]: some available offering lies in the node's allowed
+    zone × capacity-type rectangle (node.go:151-159 hasOffering)."""
+    n = zone_ok.shape[0]
+    zc = (zone_ok[:, :, None] & ct_ok[:, None, :]).reshape(n, -1)  # [N, Z*CT]
+    avail2 = statics.it_avail.reshape(statics.it_avail.shape[0], -1)  # [I, Z*CT]
+    return (
+        jnp.einsum(
+            "nz,iz->ni",
+            zc.astype(jnp.bfloat16),
+            avail2.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        > 0.5
+    )
+
+
+def _fill_by_priority(
+    quota: jnp.ndarray, cap: jnp.ndarray, priority: jnp.ndarray
+) -> jnp.ndarray:
+    """i32[N]: assign up to quota pods to nodes in priority order (ascending),
+    each node taking at most cap[n] — the vectorized form of 'sort nodes by
+    pod count, first node that accepts wins' (scheduler.go:183-190)."""
+    order = jnp.argsort(priority)
+    cap_sorted = cap[order]
+    before = jnp.cumsum(cap_sorted) - cap_sorted
+    assigned_sorted = jnp.clip(quota - before, 0, cap_sorted)
+    return jnp.zeros_like(cap).at[order].set(assigned_sorted)
+
+
+class Statics(NamedTuple):
+    """Trace-time constants bundled for the kernel."""
+
+    it: mask_ops.ReqTensor
+    it_alloc: jnp.ndarray
+    it_avail: jnp.ndarray
+    tmpl: mask_ops.ReqTensor
+    tmpl_zone: jnp.ndarray
+    tmpl_ct: jnp.ndarray
+    tmpl_it: jnp.ndarray
+    tmpl_daemon: jnp.ndarray
+    valid: jnp.ndarray
+    is_custom: jnp.ndarray
+    vocab_ints: jnp.ndarray
+    key_has_bounds: Tuple[bool, ...]  # python tuple -> static per-key branching
+
+
+class ClassTensors(NamedTuple):
+    mask: jnp.ndarray
+    defined: jnp.ndarray
+    negative: jnp.ndarray
+    gt: jnp.ndarray
+    lt: jnp.ndarray
+    zone: jnp.ndarray
+    ct: jnp.ndarray
+    it: jnp.ndarray
+    requests: jnp.ndarray
+    count: jnp.ndarray
+    tol: jnp.ndarray
+    zone_cap: jnp.ndarray
+    zone_skew: jnp.ndarray
+    host_cap: jnp.ndarray
+    zone_count0: jnp.ndarray
+
+
+def _phase(
+    state: NodeState,
+    cls: ClassTensors,
+    statics: Statics,
+    quota: jnp.ndarray,
+    zone_restrict: jnp.ndarray,
+    collapse_zone: bool,
+) -> Tuple[NodeState, jnp.ndarray, jnp.ndarray]:
+    """Place up to ``quota`` pods of the class on nodes whose zone mask meets
+    ``zone_restrict`` — first onto open nodes, then fresh nodes from the first
+    viable template.  Returns (state, assigned[N], placed)."""
+    n_slots = state.used.shape[0]
+    n_tmpl = statics.tmpl_it.shape[0]
+
+    merged = _merge_node_class(state, cls, statics)
+    key_ok = _key_compat_node_class(state, cls, statics)  # [N]
+    zone_ok = state.zone & zone_restrict[None, :] & cls.zone[None, :]  # [N, Z]
+    ct_ok = state.ct & cls.ct[None, :]  # [N, CT]
+    tol_ok = cls.tol[state.tmpl_id]  # [N]
+
+    it_ok = (
+        state.viable
+        & cls.it[None, :]
+        & _it_intersects(merged, statics)
+        & _offering_ok(zone_ok, ct_ok, statics)
+    )  # [N, I]
+    cap_ni = _capacity(state.used, cls.requests, statics)
+    cap_ni = jnp.where(it_ok, cap_ni, 0)
+    cap_n = jnp.max(cap_ni, axis=-1)  # [N]
+
+    elig = (
+        state.open_
+        & key_ok
+        & tol_ok
+        & jnp.any(zone_ok, axis=-1)
+        & jnp.any(ct_ok, axis=-1)
+    )
+    cap_n = jnp.where(elig, jnp.minimum(cap_n, cls.host_cap), 0)
+
+    # node order: emptiest first (pod count, then slot index); pod_count and
+    # slot count both stay far below 2^15 so the packed key fits int32
+    priority = state.pod_count * n_slots + jnp.arange(n_slots, dtype=jnp.int32)
+    priority = jnp.where(cap_n > 0, priority, jnp.iinfo(jnp.int32).max)
+    assigned = _fill_by_priority(quota, cap_n, priority)
+    placed_existing = jnp.sum(assigned)
+
+    # -- commit to existing nodes --------------------------------------------
+    took = assigned > 0
+    add_req = assigned[:, None].astype(jnp.float32) * cls.requests[None, :]
+    used = state.used + add_req
+    sel = took[:, None]
+    kmask = jnp.where(sel[..., None], merged.mask, state.kmask)
+    kdef = jnp.where(sel, merged.defined, state.kdef)
+    kneg = jnp.where(sel, merged.negative, state.kneg)
+    kgt = jnp.where(sel, merged.gt, state.kgt)
+    klt = jnp.where(sel, merged.lt, state.klt)
+    new_zone = jnp.where(sel, zone_ok, state.zone) if collapse_zone else jnp.where(
+        sel, state.zone & cls.zone[None, :], state.zone
+    )
+    new_ct = jnp.where(sel, ct_ok, state.ct)
+    viable = jnp.where(sel, it_ok & (cap_ni >= assigned[:, None]), state.viable)
+    pod_count = state.pod_count + assigned
+
+    # -- open fresh nodes ----------------------------------------------------
+    rem = quota - placed_existing
+
+    # template viability for this class+restriction (scheduler.go:192-217):
+    # taints, requirement compat, and a non-empty filtered instance list
+    tmpl_t = statics.tmpl
+    cls_t = mask_ops.ReqTensor(
+        cls.mask[None], cls.defined[None], cls.negative[None], cls.gt[None], cls.lt[None]
+    )
+    tmpl_key_ok = mask_ops.compatible(tmpl_t, cls_t, statics.is_custom, statics.vocab_ints)
+    tmpl_merged = mask_ops.add(tmpl_t, cls_t, statics.valid, statics.vocab_ints)
+    t_zone = statics.tmpl_zone & zone_restrict[None, :] & cls.zone[None, :]  # [T, Z]
+    t_ct = statics.tmpl_ct & cls.ct[None, :]
+    t_it_ok = (
+        statics.tmpl_it
+        & cls.it[None, :]
+        & _it_intersects(tmpl_merged, statics)
+        & _offering_ok(t_zone, t_ct, statics)
+    )  # [T, I]
+    t_cap_ti = _capacity(statics.tmpl_daemon, cls.requests, statics)
+    t_cap_ti = jnp.where(t_it_ok, t_cap_ti, 0)
+    t_cap = jnp.max(t_cap_ti, axis=-1)  # [T]
+    t_viable = (
+        cls.tol
+        & tmpl_key_ok
+        & jnp.any(t_zone, axis=-1)
+        & jnp.any(t_ct, axis=-1)
+        & (t_cap > 0)
+    )
+    t_star = jnp.argmax(t_viable)  # first True (argmax of bool picks first max)
+    t_ok = t_viable[t_star]
+
+    per_node = jnp.minimum(t_cap[t_star], cls.host_cap)
+    per_node = jnp.maximum(per_node, 1)
+    n_new = jnp.where(t_ok & (rem > 0), -(-rem // per_node), 0)
+    free_slots = n_slots - state.n_next
+    n_new = jnp.minimum(n_new, free_slots)
+
+    slot_idx = jnp.arange(n_slots)
+    is_new = (slot_idx >= state.n_next) & (slot_idx < state.n_next + n_new)
+    rank = slot_idx - state.n_next
+    a_new = jnp.where(is_new, jnp.clip(rem - rank * per_node, 0, per_node), 0)
+    placed_new = jnp.sum(a_new)
+
+    seln = is_new[:, None]
+    used = jnp.where(seln, statics.tmpl_daemon[t_star][None, :] + a_new[:, None].astype(jnp.float32) * cls.requests[None, :], used)
+    kmask = jnp.where(seln[..., None], tmpl_merged.mask[t_star][None], kmask)
+    kdef = jnp.where(seln, tmpl_merged.defined[t_star][None], kdef)
+    kneg = jnp.where(seln, tmpl_merged.negative[t_star][None], kneg)
+    kgt = jnp.where(seln, tmpl_merged.gt[t_star][None], kgt)
+    klt = jnp.where(seln, tmpl_merged.lt[t_star][None], klt)
+    new_zone = jnp.where(seln, t_zone[t_star][None, :], new_zone)
+    new_ct = jnp.where(seln, t_ct[t_star][None, :], new_ct)
+    fresh_viable = t_it_ok[t_star][None, :] & (t_cap_ti[t_star][None, :] >= a_new[:, None])
+    viable = jnp.where(seln, fresh_viable, viable)
+    pod_count = jnp.where(is_new, a_new, pod_count)
+    tmpl_id = jnp.where(is_new, t_star, state.tmpl_id)
+    open_ = state.open_ | is_new
+    n_next = state.n_next + n_new
+
+    new_state = NodeState(
+        used, kmask, kdef, kneg, kgt, klt, new_zone, new_ct, viable,
+        pod_count, tmpl_id, open_, n_next,
+    )
+    return new_state, assigned + a_new, placed_existing + placed_new
+
+
+def _class_step(statics: Statics, n_zones: int, state: NodeState, cls: ClassTensors):
+    """One scan step: schedule every pod of one class."""
+    m = cls.count
+    spread = cls.zone_skew < UNLIMITED
+    anti = cls.zone_cap < UNLIMITED
+
+    quotas = _water_fill(cls.zone_count0, cls.zone, m)
+    assigned_total = jnp.zeros_like(state.pod_count)
+    placed_total = jnp.int32(0)
+
+    # zone-constrained phases (spread classes commit one zone per phase)
+    for z in range(n_zones):
+        restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
+        q = jnp.where(spread, quotas[z], 0)
+        state, assigned, placed = _phase(state, cls, statics, q, restrict, collapse_zone=True)
+        assigned_total = assigned_total + assigned
+        placed_total = placed_total + placed
+
+    # anti-affinity phase: one pod, restricted to zero-count allowed zones
+    zero_zones = cls.zone & (cls.zone_count0 == 0)
+    anti_quota = jnp.where(anti & jnp.any(zero_zones), jnp.minimum(m, 1), 0)
+    state, assigned, placed = _phase(
+        state, cls, statics, anti_quota, zero_zones, collapse_zone=True
+    )
+    assigned_total = assigned_total + assigned
+    placed_total = placed_total + placed
+
+    # unconstrained phase for plain classes
+    any_quota = jnp.where(spread | anti, 0, m)
+    all_zones = jnp.ones(n_zones, dtype=bool)
+    state, assigned, placed = _phase(
+        state, cls, statics, any_quota, all_zones, collapse_zone=False
+    )
+    assigned_total = assigned_total + assigned
+    placed_total = placed_total + placed
+
+    failed = m - placed_total
+    return state, (assigned_total, failed)
+
+
+@functools.partial(jax.jit, static_argnames=("n_slots", "key_has_bounds"))
+def _solve_jit(class_tensors, statics_arrays, n_slots: int, key_has_bounds):
+    statics = Statics(*statics_arrays, key_has_bounds=key_has_bounds)
+    n_zones = statics.tmpl_zone.shape[-1]
+    n_res = statics.it_alloc.shape[-1]
+    n_keys = statics.valid.shape[0]
+    width = statics.valid.shape[1]
+    n_it = statics.it_alloc.shape[0]
+    n_ct = statics.tmpl_ct.shape[-1]
+
+    state = NodeState(
+        used=jnp.zeros((n_slots, n_res), dtype=jnp.float32),
+        kmask=jnp.ones((n_slots, n_keys, width), dtype=bool),
+        kdef=jnp.zeros((n_slots, n_keys), dtype=bool),
+        kneg=jnp.zeros((n_slots, n_keys), dtype=bool),
+        kgt=jnp.full((n_slots, n_keys), -jnp.inf, dtype=jnp.float32),
+        klt=jnp.full((n_slots, n_keys), jnp.inf, dtype=jnp.float32),
+        zone=jnp.ones((n_slots, n_zones), dtype=bool),
+        ct=jnp.ones((n_slots, n_ct), dtype=bool),
+        viable=jnp.ones((n_slots, n_it), dtype=bool),
+        pod_count=jnp.zeros(n_slots, dtype=jnp.int32),
+        tmpl_id=jnp.zeros(n_slots, dtype=jnp.int32),
+        open_=jnp.zeros(n_slots, dtype=bool),
+        n_next=jnp.int32(0),
+    )
+
+    def step(carry, cls):
+        return _class_step(statics, n_zones, carry, cls)
+
+    final_state, (assign, failed) = jax.lax.scan(step, state, class_tensors)
+    return SolveOutputs(assign=assign, failed=failed, state=final_state)
+
+
+def solve(snapshot: EncodedSnapshot, n_slots: int = 0) -> SolveOutputs:
+    """Run the kernel on an encoded snapshot.  ``n_slots`` defaults to a
+    rounded estimate; if slots run out (failed>0 with n_next==n_slots) the
+    caller should retry with more (solver.tpu handles this)."""
+    if n_slots <= 0:
+        n_slots = estimate_slots(snapshot)
+
+    cls = ClassTensors(
+        mask=jnp.asarray(snapshot.cls_mask),
+        defined=jnp.asarray(snapshot.cls_defined),
+        negative=jnp.asarray(snapshot.cls_negative),
+        gt=jnp.asarray(snapshot.cls_gt),
+        lt=jnp.asarray(snapshot.cls_lt),
+        zone=jnp.asarray(snapshot.cls_zone),
+        ct=jnp.asarray(snapshot.cls_ct),
+        it=jnp.asarray(snapshot.cls_it),
+        requests=jnp.asarray(snapshot.cls_requests),
+        count=jnp.asarray(snapshot.cls_count),
+        tol=jnp.asarray(snapshot.cls_tol),
+        zone_cap=jnp.asarray(snapshot.cls_zone_cap),
+        zone_skew=jnp.asarray(snapshot.cls_zone_skew),
+        host_cap=jnp.asarray(snapshot.cls_host_cap),
+        zone_count0=jnp.asarray(snapshot.cls_zone_count0),
+    )
+    it_t = mask_ops.ReqTensor(
+        jnp.asarray(snapshot.it_mask),
+        jnp.asarray(snapshot.it_defined),
+        jnp.asarray(snapshot.it_negative),
+        jnp.asarray(snapshot.it_gt),
+        jnp.asarray(snapshot.it_lt),
+    )
+    tmpl_t = mask_ops.ReqTensor(
+        jnp.asarray(snapshot.tmpl_mask),
+        jnp.asarray(snapshot.tmpl_defined),
+        jnp.asarray(snapshot.tmpl_negative),
+        jnp.asarray(snapshot.tmpl_gt),
+        jnp.asarray(snapshot.tmpl_lt),
+    )
+    statics_arrays = (
+        it_t,
+        jnp.asarray(snapshot.it_alloc),
+        jnp.asarray(snapshot.it_avail),
+        tmpl_t,
+        jnp.asarray(snapshot.tmpl_zone),
+        jnp.asarray(snapshot.tmpl_ct),
+        jnp.asarray(snapshot.tmpl_it),
+        jnp.asarray(snapshot.tmpl_daemon),
+        jnp.asarray(snapshot.valid),
+        jnp.asarray(snapshot.is_custom),
+        jnp.asarray(snapshot.vocab_ints),
+    )
+    key_has_bounds = tuple(
+        bool(np.isfinite(snapshot.cls_gt[:, k]).any() or np.isfinite(snapshot.cls_lt[:, k]).any()
+             or np.isfinite(snapshot.it_gt[:, k]).any() or np.isfinite(snapshot.it_lt[:, k]).any()
+             or np.isfinite(snapshot.tmpl_gt[:, k]).any() or np.isfinite(snapshot.tmpl_lt[:, k]).any())
+        for k in range(snapshot.valid.shape[0])
+    )
+    return _solve_jit(cls, statics_arrays, n_slots, key_has_bounds)
+
+
+def estimate_slots(snapshot: EncodedSnapshot) -> int:
+    """Optimistic node-count estimate: per class, best pods-per-node over the
+    catalog, plus slack for zone phases; rounded up to a power of two for
+    compile-cache friendliness."""
+    total = 16
+    alloc = snapshot.it_alloc  # [I, R]
+    for c in range(len(snapshot.classes)):
+        size = snapshot.cls_requests[c]  # [R]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.floor(np.where(size > 0, alloc / np.maximum(size, 1e-9), np.inf))
+        per_it = np.min(np.where(np.isfinite(per), per, np.inf), axis=-1)
+        best = np.max(per_it) if per_it.size else 0
+        best = max(1.0, min(best, float(snapshot.cls_host_cap[c])))
+        total += int(np.ceil(float(snapshot.cls_count[c]) / best)) + snapshot.cls_zone.shape[1]
+    return int(2 ** np.ceil(np.log2(max(total, 16))))

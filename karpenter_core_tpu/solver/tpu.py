@@ -1,0 +1,124 @@
+"""TPU solver facade: encode → kernel → decode.
+
+Stands behind the same Solve() contract as the host Scheduler
+(solver.scheduler) for the batch shapes the kernel models (see
+models.snapshot.classify_pods); callers use ``supports()``/KernelUnsupported to
+route between the tensor path and the host path.  This is the Solver the
+BASELINE.json north star describes: cluster snapshots in, node decisions out,
+with the bin-pack running as a batch tensor program on the TPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from karpenter_core_tpu.apis.objects import Pod
+from karpenter_core_tpu.apis.v1alpha5 import Provisioner, order_by_weight
+from karpenter_core_tpu.cloudprovider import CloudProvider, InstanceType
+from karpenter_core_tpu.models.snapshot import (
+    EncodedSnapshot,
+    KernelUnsupported,
+    encode_snapshot,
+)
+from karpenter_core_tpu.ops import solve as solve_ops
+from karpenter_core_tpu.scheduling import Requirements
+from karpenter_core_tpu.solver.machinetemplate import MachineTemplate
+from karpenter_core_tpu.solver.scheduler import _daemon_overhead
+from karpenter_core_tpu.utils import resources as resources_util
+
+
+@dataclass
+class TPUNodeDecision:
+    """One node the kernel decided to create."""
+
+    provisioner_name: str
+    instance_type_names: List[str]
+    zones: List[str]
+    pods: List[Pod] = field(default_factory=list)
+    requests: resources_util.ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class TPUSolveResults:
+    new_nodes: List[TPUNodeDecision] = field(default_factory=list)
+    failed_pods: List[Pod] = field(default_factory=list)
+    n_slots_used: int = 0
+
+
+class TPUSolver:
+    def __init__(
+        self,
+        cloud_provider: CloudProvider,
+        provisioners: List[Provisioner],
+        daemonset_pods: Optional[List[Pod]] = None,
+    ) -> None:
+        self.provisioners = order_by_weight(
+            [p for p in provisioners if p.metadata.deletion_timestamp is None]
+        )
+        self.templates = [MachineTemplate.from_provisioner(p) for p in self.provisioners]
+        self.instance_types: Dict[str, List[InstanceType]] = {
+            p.name: cloud_provider.get_instance_types(p) for p in self.provisioners
+        }
+        overhead = _daemon_overhead(self.templates, daemonset_pods or [])
+        for template in self.templates:
+            template.requests = overhead[id(template)]
+
+    def encode(self, pods: List[Pod]) -> EncodedSnapshot:
+        """Raises models.snapshot.KernelUnsupported when the batch needs the
+        host path."""
+        return encode_snapshot(pods, self.provisioners, self.templates, self.instance_types)
+
+    def solve(self, pods: List[Pod], n_slots: int = 0) -> TPUSolveResults:
+        snapshot = self.encode(pods)
+        outputs = solve_ops.solve(snapshot, n_slots=n_slots)
+        # slot exhaustion: retry once with double capacity
+        n_used = int(outputs.state.n_next)
+        slots = outputs.assign.shape[1]
+        if int(np.sum(np.asarray(outputs.failed))) > 0 and n_used >= slots:
+            outputs = solve_ops.solve(snapshot, n_slots=slots * 2)
+            n_used = int(outputs.state.n_next)
+        return self.decode(snapshot, outputs)
+
+    def decode(self, snapshot: EncodedSnapshot, outputs: solve_ops.SolveOutputs) -> TPUSolveResults:
+        assign = np.asarray(outputs.assign)  # [C, N]
+        failed = np.asarray(outputs.failed)  # [C]
+        state = outputs.state
+        pod_count = np.asarray(state.pod_count)
+        tmpl_id = np.asarray(state.tmpl_id)
+        viable = np.asarray(state.viable)
+        zone = np.asarray(state.zone)
+        used = np.asarray(state.used)
+        open_ = np.asarray(state.open_)
+
+        results = TPUSolveResults(n_slots_used=int(state.n_next))
+        nodes: Dict[int, TPUNodeDecision] = {}
+        for n in np.nonzero(open_ & (pod_count > 0))[0]:
+            nodes[int(n)] = TPUNodeDecision(
+                provisioner_name=self.templates[int(tmpl_id[n])].provisioner_name,
+                instance_type_names=[
+                    snapshot.it_names[i] for i in np.nonzero(viable[n])[0]
+                ],
+                zones=[snapshot.zones[z] for z in np.nonzero(zone[n])[0]],
+                requests={
+                    name: float(used[n, r])
+                    for r, name in enumerate(snapshot.resources)
+                    if used[n, r] > 0
+                },
+            )
+
+        for c, cls in enumerate(snapshot.classes):
+            cursor = 0
+            for n in np.nonzero(assign[c] > 0)[0]:
+                take = int(assign[c, n])
+                for pod in cls.pods[cursor : cursor + take]:
+                    nodes[int(n)].pods.append(pod)
+                cursor += take
+            results.failed_pods.extend(cls.pods[cursor:])
+        results.new_nodes = [nodes[n] for n in sorted(nodes)]
+        return results
+
+
+__all__ = ["TPUSolver", "TPUSolveResults", "TPUNodeDecision", "KernelUnsupported"]

@@ -1,0 +1,178 @@
+"""Property tests: the mask-algebra kernels agree with the host oracle.
+
+Random requirement sets are encoded through the vocabulary and checked:
+ops.masks.intersects/compatible must equal Requirements.intersects/compatible
+(the exact mirrors of requirements.go:123-206) on every pair.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+)
+from karpenter_core_tpu.models.vocab import Vocabulary
+from karpenter_core_tpu.ops import masks as mask_ops
+from karpenter_core_tpu.scheduling import Requirement, Requirements
+
+KEYS = [
+    labels_api.LABEL_ARCH_STABLE,  # well-known
+    labels_api.LABEL_OS_STABLE,  # well-known
+    "example.com/team",  # custom
+    "integer",  # custom, numeric values
+]
+VALUES = {
+    labels_api.LABEL_ARCH_STABLE: ["amd64", "arm64"],
+    labels_api.LABEL_OS_STABLE: ["linux", "windows", "darwin"],
+    "example.com/team": ["a", "b", "c", "d"],
+    "integer": ["1", "2", "4", "8", "16"],
+}
+
+
+def random_requirement(rng: random.Random, key: str) -> Requirement:
+    op = rng.choice([OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST, OP_GT, OP_LT])
+    if op in (OP_GT, OP_LT):
+        if key != "integer":
+            op = OP_IN
+        else:
+            return Requirement(key, op, [rng.choice(VALUES[key])])
+    if op in (OP_IN, OP_NOT_IN):
+        k = rng.randint(1, len(VALUES[key]))
+        return Requirement(key, op, rng.sample(VALUES[key], k))
+    return Requirement(key, op)
+
+
+def random_requirements(rng: random.Random) -> Requirements:
+    n = rng.randint(0, len(KEYS))
+    keys = rng.sample(KEYS, n)
+    return Requirements(*(random_requirement(rng, k) for k in keys))
+
+
+@pytest.fixture(scope="module")
+def vocab():
+    # universe covers every value any requirement may use
+    base = [
+        Requirements(*(Requirement(k, OP_IN, vs) for k, vs in VALUES.items()))
+    ]
+    return Vocabulary.build(base)
+
+
+def encode(vocab, reqs):
+    mask, defined, negative, gt, lt = vocab.encode_requirements(reqs)
+    return mask_ops.ReqTensor(
+        jnp.asarray(mask), jnp.asarray(defined), jnp.asarray(negative),
+        jnp.asarray(gt), jnp.asarray(lt),
+    )
+
+
+N_TRIALS = 500
+
+
+def _encode_np(vocab, reqs):
+    return vocab.encode_requirements(reqs)
+
+
+def _stack(vocab, reqs_list):
+    planes = [vocab.encode_requirements(r) for r in reqs_list]
+    return mask_ops.ReqTensor(*(jnp.asarray(np.stack(p)) for p in zip(*planes)))
+
+
+def test_intersects_parity(vocab):
+    rng = random.Random(42)
+    ints = jnp.asarray(vocab.ints_table())
+    pairs = [(random_requirements(rng), random_requirements(rng)) for _ in range(N_TRIALS)]
+    a_t = _stack(vocab, [a for a, _ in pairs])
+    b_t = _stack(vocab, [b for _, b in pairs])
+    got = np.asarray(mask_ops.intersects(a_t, b_t, ints))
+    for i, (a, b) in enumerate(pairs):
+        oracle = a.intersects(b) is None
+        assert bool(got[i]) == oracle, f"trial {i}: {a!r} vs {b!r}: oracle={oracle}"
+
+
+def test_compatible_parity(vocab):
+    rng = random.Random(43)
+    is_custom = jnp.asarray(vocab.is_custom())
+    ints = jnp.asarray(vocab.ints_table())
+    pairs = [(random_requirements(rng), random_requirements(rng)) for _ in range(N_TRIALS)]
+    a_t = _stack(vocab, [a for a, _ in pairs])
+    b_t = _stack(vocab, [b for _, b in pairs])
+    got = np.asarray(mask_ops.compatible(a_t, b_t, is_custom, ints))
+    for i, (a, b) in enumerate(pairs):
+        oracle = a.compatible(b) is None
+        assert bool(got[i]) == oracle, f"trial {i}: {a!r} vs {b!r}: oracle={oracle}"
+
+
+def test_add_then_check_parity(vocab):
+    """Sequential accumulation (node requirements absorbing pods) stays exact.
+
+    Runs the whole battery vectorized: each trial is an independent lane; adds
+    are applied only on lanes whose oracle accepted (mirroring the solver's
+    commit-on-success), via jnp.where selection.
+    """
+    rng = random.Random(44)
+    is_custom = jnp.asarray(vocab.is_custom())
+    ints = jnp.asarray(vocab.ints_table())
+    valid = jnp.asarray(vocab.valid_mask())
+    n = 100
+    nodes = [random_requirements(rng) for _ in range(n)]
+    node_t = _stack(vocab, nodes)
+    for round_ in range(3):
+        pods = [random_requirements(rng) for _ in range(n)]
+        pod_t = _stack(vocab, pods)
+        got = np.asarray(mask_ops.compatible(node_t, pod_t, is_custom, ints))
+        oracle = np.array([nodes[i].compatible(pods[i]) is None for i in range(n)])
+        for i in range(n):
+            assert bool(got[i]) == oracle[i], (
+                f"round {round_} lane {i}: {nodes[i]!r} + {pods[i]!r}"
+            )
+        added = mask_ops.add(node_t, pod_t, valid, ints)
+        keep = jnp.asarray(oracle)
+        node_t = mask_ops.ReqTensor(
+            *(
+                jnp.where(keep.reshape((n,) + (1,) * (new.ndim - 1)), new, old)
+                for new, old in zip(added, node_t)
+            )
+        )
+        for i in range(n):
+            if oracle[i]:
+                nodes[i].add(*pods[i].values())
+
+
+def test_single_value(vocab):
+    valid = jnp.asarray(vocab.valid_mask())
+    r = encode(vocab, Requirements(Requirement("example.com/team", OP_IN, ["a"])))
+    sv = mask_ops.single_value(r)
+    k = vocab.key_index["example.com/team"]
+    assert bool(sv[k])
+    r2 = encode(vocab, Requirements(Requirement("example.com/team", OP_IN, ["a", "b"])))
+    assert not bool(mask_ops.single_value(r2)[k])
+    r3 = encode(vocab, Requirements(Requirement("example.com/team", OP_NOT_IN, ["a", "b", "c"])))
+    # complement allows unseen values -> not single
+    assert not bool(mask_ops.single_value(r3)[k])
+
+
+def test_batched_broadcasting(vocab):
+    """Mask ops broadcast over leading axes (the kernel's [N] and [C] dims)."""
+    rng = random.Random(45)
+    reqs = [random_requirements(rng) for _ in range(8)]
+    enc = [encode(vocab, r) for r in reqs]
+    stack = mask_ops.ReqTensor(*(jnp.stack(plane) for plane in zip(*enc)))
+    single = enc[0]
+    got = mask_ops.intersects(
+        stack,
+        mask_ops.ReqTensor(*(plane[None] for plane in single)),
+        jnp.asarray(vocab.ints_table()),
+    )
+    assert got.shape == (8,)
+    for i, r in enumerate(reqs):
+        assert bool(got[i]) == (r.intersects(reqs[0]) is None)

@@ -1,0 +1,310 @@
+"""Parity tests: the TPU class-scan kernel vs the host oracle scheduler.
+
+Aggregate outcomes (scheduled count, failed count, node count, zone skew) must
+agree with the host Scheduler — the exact-semantics mirror of the reference —
+on every kernel-supported scenario.  Tie-breaking (which specific node gets
+which pod) is allowed to differ, exactly as the reference's own unstable sort
+makes pod placement nondeterministic (scheduler.go:183).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_IN,
+    OP_NOT_IN,
+    LabelSelector,
+    NodeSelectorRequirement,
+    PodAffinityTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.models.snapshot import KernelUnsupported, classify_pods
+from karpenter_core_tpu.operator.kubeclient import KubeClient
+from karpenter_core_tpu.solver.builder import build_scheduler
+from karpenter_core_tpu.solver.tpu import TPUSolver
+from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
+
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+HOSTNAME = labels_api.LABEL_HOSTNAME
+
+
+def host_solve(pods, provisioners, instance_types=None):
+    kube = KubeClient()
+    for p in provisioners:
+        kube.create(p)
+    provider = fake_cp.FakeCloudProvider(instance_types)
+    scheduler = build_scheduler(
+        kube, provider, cluster=None, pods=pods, state_nodes=[], daemonset_pods=[]
+    )
+    return scheduler.solve(pods)
+
+
+def tpu_solve(pods, provisioners, instance_types=None):
+    provider = fake_cp.FakeCloudProvider(instance_types)
+    solver = TPUSolver(provider, provisioners)
+    return solver.solve(pods)
+
+
+def compare(pods_factory, provisioners=None, instance_types=None):
+    """Run both paths on identical inputs; compare aggregates."""
+    provisioners = provisioners or [make_provisioner()]
+    host = host_solve(pods_factory(), provisioners, instance_types)
+    tpu = tpu_solve(pods_factory(), provisioners, instance_types)
+    host_scheduled = sum(len(n.pods) for n in host.new_nodes)
+    tpu_scheduled = sum(len(n.pods) for n in tpu.new_nodes)
+    assert tpu_scheduled == host_scheduled, (
+        f"scheduled: tpu={tpu_scheduled} host={host_scheduled}"
+    )
+    assert len(tpu.failed_pods) == len(host.failed_pods), (
+        f"failed: tpu={len(tpu.failed_pods)} host={len(host.failed_pods)}"
+    )
+    assert len(tpu.new_nodes) == len(host.new_nodes), (
+        f"nodes: tpu={len(tpu.new_nodes)} host={len(host.new_nodes)}"
+    )
+    return host, tpu
+
+
+class TestKernelParity:
+    def test_homogeneous_batch(self):
+        compare(lambda: make_pods(40, requests={"cpu": "500m"}))
+
+    def test_pod_count_limit(self):
+        # default types cap at 5 pods/node
+        compare(lambda: make_pods(17, requests={"cpu": "1m"}))
+
+    def test_two_sizes(self):
+        compare(
+            lambda: make_pods(10, requests={"cpu": 2}) + make_pods(20, requests={"cpu": "250m"})
+        )
+
+    def test_impossible_pod(self):
+        host, tpu = compare(
+            lambda: make_pods(2, requests={"cpu": 10000}) + make_pods(3, requests={"cpu": 1})
+        )
+        assert len(tpu.failed_pods) == 2
+
+    def test_gpu_resources_split(self):
+        compare(
+            lambda: [
+                make_pod(requests={fake_cp.RESOURCE_GPU_VENDOR_A: 1}),
+                make_pod(requests={fake_cp.RESOURCE_GPU_VENDOR_B: 1}),
+                make_pod(requests={"cpu": 1}),
+            ]
+        )
+
+    def test_zone_selector(self):
+        host, tpu = compare(
+            lambda: make_pods(4, node_selector={ZONE: "test-zone-2"}, requests={"cpu": "100m"})
+        )
+        for node in tpu.new_nodes:
+            assert node.zones == ["test-zone-2"]
+
+    def test_node_affinity_not_in(self):
+        host, tpu = compare(
+            lambda: make_pods(
+                4,
+                requests={"cpu": "100m"},
+                node_requirements=[NodeSelectorRequirement(ZONE, OP_NOT_IN, ["test-zone-1"])],
+            )
+        )
+        for node in tpu.new_nodes:
+            assert "test-zone-1" not in node.zones
+
+    def test_incompatible_zone(self):
+        host, tpu = compare(
+            lambda: make_pods(2, node_selector={ZONE: "nope"})
+        )
+        assert len(tpu.failed_pods) == 2
+
+    def test_taints(self):
+        tainted = make_provisioner(name="tainted", taints=[Taint("special", "true")])
+        host, tpu = compare(lambda: make_pods(3), provisioners=[tainted])
+        assert len(tpu.failed_pods) == 3
+
+    def test_toleration_and_weight_order(self):
+        heavy = make_provisioner(name="heavy", weight=100, taints=[Taint("special", "true")])
+        light = make_provisioner(name="light", weight=1)
+        host, tpu = compare(
+            lambda: make_pods(
+                3, tolerations=[Toleration(key="special", operator="Exists")]
+            ),
+            provisioners=[heavy, light],
+        )
+        assert all(n.provisioner_name == "heavy" for n in tpu.new_nodes)
+
+    def test_custom_label_requirement(self):
+        prov = make_provisioner(
+            requirements=[NodeSelectorRequirement("team", OP_IN, ["a", "b"])]
+        )
+        compare(
+            lambda: make_pods(
+                3,
+                requests={"cpu": "100m"},
+                node_requirements=[NodeSelectorRequirement("team", OP_IN, ["a"])],
+            ),
+            provisioners=[prov],
+        )
+
+    def test_custom_label_undefined_fails(self):
+        host, tpu = compare(
+            lambda: make_pods(
+                2, node_requirements=[NodeSelectorRequirement("team", OP_IN, ["a"])]
+            )
+        )
+        assert len(tpu.failed_pods) == 2
+
+
+def spread_pods(n, key=ZONE, max_skew=1, requests=None):
+    return [
+        make_pod(
+            labels={"app": "web"},
+            requests=requests or {"cpu": "10m"},
+            topology_spread=[
+                TopologySpreadConstraint(
+                    max_skew=max_skew,
+                    topology_key=key,
+                    label_selector=LabelSelector(match_labels={"app": "web"}),
+                )
+            ],
+        )
+        for _ in range(n)
+    ]
+
+
+def anti_pods(n, key=HOSTNAME, requests=None):
+    return [
+        make_pod(
+            labels={"app": "db"},
+            requests=requests or {"cpu": "10m"},
+            pod_anti_affinity=[
+                PodAffinityTerm(
+                    topology_key=key,
+                    label_selector=LabelSelector(match_labels={"app": "db"}),
+                )
+            ],
+        )
+        for _ in range(n)
+    ]
+
+
+class TestKernelTopologyParity:
+    def test_zonal_spread(self):
+        host, tpu = compare(lambda: spread_pods(9))
+        zone_counts = {}
+        for node in tpu.new_nodes:
+            assert len(node.zones) == 1
+            zone_counts[node.zones[0]] = zone_counts.get(node.zones[0], 0) + len(node.pods)
+        assert sorted(zone_counts.values()) == [3, 3, 3]
+
+    def test_zonal_spread_uneven(self):
+        host, tpu = compare(lambda: spread_pods(7))
+        zone_counts = {}
+        for node in tpu.new_nodes:
+            zone_counts[node.zones[0]] = zone_counts.get(node.zones[0], 0) + len(node.pods)
+        assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+    def test_hostname_spread(self):
+        host, tpu = compare(lambda: spread_pods(5, key=HOSTNAME))
+        assert all(len(n.pods) == 1 for n in tpu.new_nodes)
+
+    def test_hostname_anti_affinity(self):
+        host, tpu = compare(lambda: anti_pods(4))
+        assert all(len(n.pods) == 1 for n in tpu.new_nodes)
+
+    def test_zonal_anti_affinity_pessimistic(self):
+        # one per batch; the rest fail (late committal, topology_test.go:1896)
+        host, tpu = compare(lambda: anti_pods(4, key=ZONE))
+        assert len(tpu.failed_pods) == 3
+
+    def test_spread_with_zone_restriction(self):
+        def pods():
+            return [
+                make_pod(
+                    labels={"app": "web"},
+                    requests={"cpu": "10m"},
+                    node_requirements=[
+                        NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1", "test-zone-2"])
+                    ],
+                    topology_spread=[
+                        TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key=ZONE,
+                            label_selector=LabelSelector(match_labels={"app": "web"}),
+                        )
+                    ],
+                )
+                for _ in range(6)
+            ]
+
+        host, tpu = compare(pods)
+        zones = set()
+        for node in tpu.new_nodes:
+            zones.update(node.zones)
+        assert zones == {"test-zone-1", "test-zone-2"}
+
+    def test_mixed_batch(self):
+        def pods():
+            return (
+                make_pods(20, requests={"cpu": "500m"})
+                + spread_pods(6)
+                + anti_pods(3)
+            )
+
+        compare(pods)
+
+
+class TestKernelUnsupported:
+    def test_pod_affinity_rejected(self):
+        pods = [
+            make_pod(
+                labels={"app": "a"},
+                pod_affinity=[
+                    PodAffinityTerm(
+                        topology_key=ZONE,
+                        label_selector=LabelSelector(match_labels={"app": "a"}),
+                    )
+                ],
+            )
+        ]
+        with pytest.raises(KernelUnsupported):
+            classify_pods(pods)
+
+    def test_host_ports_rejected(self):
+        with pytest.raises(KernelUnsupported):
+            classify_pods([make_pod(host_ports=[80])])
+
+    def test_non_self_selecting_spread_rejected(self):
+        pods = [
+            make_pod(
+                labels={"app": "a"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=ZONE,
+                        label_selector=LabelSelector(match_labels={"app": "OTHER"}),
+                    )
+                ],
+            )
+        ]
+        with pytest.raises(KernelUnsupported):
+            classify_pods(pods)
+
+
+class TestClassify:
+    def test_identical_pods_one_class(self):
+        classes = classify_pods(make_pods(10, requests={"cpu": 1}))
+        assert len(classes) == 1
+        assert classes[0].count == 10
+
+    def test_ffd_order(self):
+        classes = classify_pods(
+            make_pods(2, requests={"cpu": 1})
+            + make_pods(2, requests={"cpu": 4})
+            + make_pods(2, requests={"cpu": 2, "memory": "1Gi"})
+        )
+        cpus = [c.requests.get("cpu") for c in classes]
+        assert cpus == sorted(cpus, reverse=True)
