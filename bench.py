@@ -1,0 +1,125 @@
+"""Headline benchmark: the north-star solve from BASELINE.json.
+
+Runs the 50k-pending-pods × 1k-instance-types × 5-provisioners scheduling solve
+on the available accelerator and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference's CI throughput floor of 100 pods/sec for the Go
+scheduler (scheduling_benchmark_test.go:48,178-182) — the only published
+performance number the reference has.  vs_baseline is our pods/sec over that
+floor (higher is better).  The measured value is warm end-to-end wall time:
+snapshot encode (host) + kernel solve (device) + decode (host).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_inputs(n_pods: int, n_instance_types: int, n_provisioners: int):
+    from karpenter_core_tpu.apis.objects import LabelSelector, TopologySpreadConstraint
+    from karpenter_core_tpu.apis import labels as labels_api
+    from karpenter_core_tpu.cloudprovider import fake as fake_cp
+    from karpenter_core_tpu.solver.tpu import TPUSolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(n_instance_types))
+    provisioners = [
+        make_provisioner(name=f"prov-{i}", weight=n_provisioners - i)
+        for i in range(n_provisioners)
+    ]
+    solver = TPUSolver(provider, provisioners)
+
+    # pod mix mirroring the reference benchmark's makeDiversePods shape
+    # (scheduling_benchmark_test.go:185-197), minus pod-affinity which the
+    # kernel does not yet model: generic + zonal spread + hostname spread.
+    pods = []
+    n_spread = n_pods // 7
+    n_host_spread = n_pods // 7
+    n_generic = n_pods - n_spread - n_host_spread
+    sizes = [
+        {"cpu": "500m", "memory": "512Mi"},
+        {"cpu": 1, "memory": "2Gi"},
+        {"cpu": 2, "memory": "4Gi"},
+        {"cpu": "250m", "memory": "256Mi"},
+    ]
+    for i in range(n_generic):
+        pods.append(make_pod(requests=sizes[i % len(sizes)]))
+    for _ in range(n_spread):
+        pods.append(
+            make_pod(
+                labels={"app": "spread"},
+                requests={"cpu": "250m", "memory": "256Mi"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                        label_selector=LabelSelector(match_labels={"app": "spread"}),
+                    )
+                ],
+            )
+        )
+    for _ in range(n_host_spread):
+        pods.append(
+            make_pod(
+                labels={"app": "hspread"},
+                requests={"cpu": "250m", "memory": "256Mi"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=labels_api.LABEL_HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"app": "hspread"}),
+                    )
+                ],
+            )
+        )
+    return solver, pods
+
+
+def main() -> None:
+    n_pods = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    n_its = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000
+    solver, pods = build_inputs(n_pods, n_its, n_provisioners=5)
+
+    from karpenter_core_tpu.ops import solve as solve_ops
+
+    # cold: encode + compile + solve + decode
+    t0 = time.perf_counter()
+    snapshot = solver.encode(pods)
+    out = solve_ops.solve(snapshot)
+    out.assign.block_until_ready()
+    results = solver.decode(snapshot, out)
+    cold_s = time.perf_counter() - t0
+
+    # warm end-to-end (compile cached): this is the steady-state reconcile cost
+    t0 = time.perf_counter()
+    snapshot = solver.encode(pods)
+    out = solve_ops.solve(snapshot)
+    out.assign.block_until_ready()
+    results = solver.decode(snapshot, out)
+    warm_s = time.perf_counter() - t0
+
+    scheduled = sum(len(n.pods) for n in results.new_nodes)
+    pods_per_sec = scheduled / warm_s if warm_s > 0 else 0.0
+    line = {
+        "metric": f"solve_{n_pods // 1000}k_pods_{n_its}_types_wall_clock",
+        "value": round(warm_s, 4),
+        "unit": "s",
+        "vs_baseline": round(pods_per_sec / 100.0, 1),
+        "detail": {
+            "scheduled": scheduled,
+            "failed": len(results.failed_pods),
+            "nodes": len(results.new_nodes),
+            "pods_per_sec": round(pods_per_sec),
+            "cold_s": round(cold_s, 2),
+            "baseline": "reference CI floor: 100 pods/sec (scheduling_benchmark_test.go:48)",
+        },
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

@@ -107,15 +107,35 @@ class EncodedSnapshot:
     vocab_ints: np.ndarray = None  # f32[K, V]
 
 
-def _class_signature(pod: Pod, requirements: Requirements) -> tuple:
-    req_sig = tuple(
-        sorted(
-            (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
-            for r in requirements.values()
+def _class_signature(pod: Pod) -> tuple:
+    """Equivalence key computed from the raw spec — cheap enough to run per pod
+    at 50k scale; Requirements construction happens once per class."""
+    selector_sig = tuple(sorted(pod.spec.node_selector.items()))
+    affinity_req_sig = ()
+    if pod.spec.affinity is not None and pod.spec.affinity.node_affinity is not None:
+        na = pod.spec.affinity.node_affinity
+        req_terms = (
+            tuple(
+                tuple(
+                    (e.key, e.operator, tuple(e.values))
+                    for e in term.match_expressions
+                )
+                for term in na.required.node_selector_terms
+            )
+            if na.required is not None
+            else ()
         )
-    )
-    requests = resources_util.requests_for_pods(pod)
-    req_vec = tuple(sorted((k, round(v, 9)) for k, v in requests.items() if k != "pods"))
+        pref_terms = tuple(
+            (
+                p.weight,
+                tuple((e.key, e.operator, tuple(e.values)) for e in p.preference.match_expressions),
+            )
+            for p in na.preferred
+        )
+        affinity_req_sig = (req_terms, pref_terms)
+    req_sig = (selector_sig, affinity_req_sig)
+    requests = resources_util.ceiling(pod)
+    req_vec = tuple(sorted((k, round(v, 9)) for k, v in requests.items()))
     tol_sig = tuple(
         sorted((t.key, t.operator, t.value, t.effect) for t in pod.spec.tolerations)
     )
@@ -184,18 +204,18 @@ def classify_pods(pods: List[Pod]) -> List[PodClass]:
     groups: Dict[tuple, PodClass] = {}
     order: List[tuple] = []
     for pod in pods:
-        requirements = Requirements.from_pod(pod)
-        sig = _class_signature(pod, requirements)
-        if sig not in groups:
+        sig = _class_signature(pod)
+        cls = groups.get(sig)
+        if cls is None:
             cls = PodClass(
                 pods=[],
-                requirements=requirements,
+                requirements=Requirements.from_pod(pod),
                 requests=resources_util.ceiling(pod),
             )
             _derive_topology_spec(pod, cls)
             groups[sig] = cls
             order.append(sig)
-        groups[sig].pods.append(pod)
+        cls.pods.append(pod)
 
     classes = [groups[sig] for sig in order]
     # FFD: cpu desc, then memory desc (queue.go:74-110)

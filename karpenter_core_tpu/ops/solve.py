@@ -405,8 +405,9 @@ def _class_step(statics: Statics, n_zones: int, state: NodeState, cls: ClassTens
     return state, (assigned_total, failed)
 
 
-@functools.partial(jax.jit, static_argnames=("n_slots", "key_has_bounds"))
-def _solve_jit(class_tensors, statics_arrays, n_slots: int, key_has_bounds):
+def solve_core(class_tensors, statics_arrays, n_slots: int, key_has_bounds):
+    """Unjitted kernel core — jit/vmap/shard_map-composable (the parallel layer
+    vmaps this over snapshot replicas; __graft_entry__ compile-checks it)."""
     statics = Statics(*statics_arrays, key_has_bounds=key_has_bounds)
     n_zones = statics.tmpl_zone.shape[-1]
     n_res = statics.it_alloc.shape[-1]
@@ -438,13 +439,58 @@ def _solve_jit(class_tensors, statics_arrays, n_slots: int, key_has_bounds):
     return SolveOutputs(assign=assign, failed=failed, state=final_state)
 
 
+_solve_jit = functools.partial(jax.jit, static_argnames=("n_slots", "key_has_bounds"))(
+    solve_core
+)
+
+
+@jax.jit
+def pack_bool(arr: jnp.ndarray) -> jnp.ndarray:
+    """uint8[..., ceil(M/8)] bit-packed bools — device→host transfers ride a
+    network tunnel under axon, so the big [N, I] planes ship packed (8×
+    smaller) and unpack host-side with np.unpackbits."""
+    m = arr.shape[-1]
+    pad = (-m) % 8
+    if pad:
+        arr = jnp.pad(arr, [(0, 0)] * (arr.ndim - 1) + [(0, pad)])
+    grouped = arr.reshape(arr.shape[:-1] + (-1, 8)).astype(jnp.uint8)
+    weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], dtype=jnp.uint8)
+    return jnp.sum(grouped * weights, axis=-1, dtype=jnp.uint8)
+
+
+def unpack_bool(packed: np.ndarray, m: int) -> np.ndarray:
+    """Host-side inverse of pack_bool."""
+    bits = np.unpackbits(packed, axis=-1)
+    return bits[..., :m].astype(bool)
+
+
+def node_prices(state: NodeState, it_price: jnp.ndarray) -> jnp.ndarray:
+    """f32[N]: min over (viable instance type, allowed zone, allowed ct) of
+    offering price; +inf when no offering, 0 for closed slots."""
+    # price[i, z, ct] -> restrict to node's viable/zone/ct masks
+    allowed = (
+        state.viable[:, :, None, None]
+        & state.zone[:, None, :, None]
+        & state.ct[:, None, None, :]
+    )
+    priced = jnp.where(allowed, it_price[None, :, :, :], jnp.inf)
+    best = jnp.min(priced, axis=(1, 2, 3))
+    return jnp.where(state.open_ & (state.pod_count > 0), best, 0.0)
+
+
 def solve(snapshot: EncodedSnapshot, n_slots: int = 0) -> SolveOutputs:
     """Run the kernel on an encoded snapshot.  ``n_slots`` defaults to a
     rounded estimate; if slots run out (failed>0 with n_next==n_slots) the
     caller should retry with more (solver.tpu handles this)."""
     if n_slots <= 0:
         n_slots = estimate_slots(snapshot)
+    cls, statics_arrays, key_has_bounds = prepare(snapshot)
+    return _solve_jit(cls, statics_arrays, n_slots, key_has_bounds)
 
+
+def prepare(snapshot: EncodedSnapshot):
+    """Device-ready kernel inputs: (class_tensors, statics_arrays,
+    key_has_bounds)."""
     cls = ClassTensors(
         mask=jnp.asarray(snapshot.cls_mask),
         defined=jnp.asarray(snapshot.cls_defined),
@@ -495,7 +541,7 @@ def solve(snapshot: EncodedSnapshot, n_slots: int = 0) -> SolveOutputs:
              or np.isfinite(snapshot.tmpl_gt[:, k]).any() or np.isfinite(snapshot.tmpl_lt[:, k]).any())
         for k in range(snapshot.valid.shape[0])
     )
-    return _solve_jit(cls, statics_arrays, n_slots, key_has_bounds)
+    return cls, statics_arrays, key_has_bounds
 
 
 def estimate_slots(snapshot: EncodedSnapshot) -> int:

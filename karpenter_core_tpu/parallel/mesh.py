@@ -1,0 +1,110 @@
+"""Device-mesh parallelism for the solver.
+
+The reference has no collective layer (its "distributed backend" is the
+kube-apiserver watch plane, SURVEY.md §2.11/§5.8); the TPU-native design adds
+one where the problem is data-parallel:
+
+  - **Monte-Carlo what-if** (BASELINE config 5): vmap the solve kernel over
+    perturbed snapshot replicas (spot-interruption scenarios), sharded across
+    the mesh's ``replica`` axis; cost statistics reduce over ICI with psum.
+  - **Consolidation subset search** (BASELINE config 3): vmap the simulation
+    over candidate node subsets, sharded the same way (ops.consolidate).
+
+Multi-slice scaling note: the replica/subset axes are embarrassingly parallel,
+so cross-slice traffic is one scalar reduction per solve — lay the mesh's
+replica axis over DCN and everything else rides ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from karpenter_core_tpu.models.snapshot import EncodedSnapshot
+from karpenter_core_tpu.ops import solve as solve_ops
+
+
+def default_mesh(n_devices: Optional[int] = None, axis: str = "replica") -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def perturb_spot_availability(
+    snapshot: EncodedSnapshot, n_replicas: int, seed: int = 0, interruption_rate: float = 0.3
+) -> jnp.ndarray:
+    """bool[REP, I, Z, CT]: per-replica offering availability with spot
+    offerings randomly interrupted — the scenario axis for the what-if sweep."""
+    key = jax.random.PRNGKey(seed)
+    avail = jnp.asarray(snapshot.it_avail)  # [I, Z, CT]
+    is_spot = jnp.asarray(
+        np.array([ct == "spot" for ct in snapshot.capacity_types], dtype=bool)
+    )  # [CT]
+    interrupted = (
+        jax.random.uniform(key, (n_replicas,) + avail.shape) < interruption_rate
+    ) & is_spot[None, None, None, :]
+    return avail[None] & ~interrupted
+
+
+def monte_carlo_solve(
+    snapshot: EncodedSnapshot,
+    n_replicas: int,
+    mesh: Optional[Mesh] = None,
+    seed: int = 0,
+    interruption_rate: float = 0.3,
+    n_slots: int = 0,
+) -> dict:
+    """Solve ``n_replicas`` perturbed snapshots in parallel across the mesh.
+
+    Returns summary statistics (per-replica scheduled/failed/node counts and
+    total cost, plus mean/min/max cost) — the cost-vs-disruption Pareto input.
+    """
+    if mesh is None:
+        mesh = default_mesh()
+    if n_slots <= 0:
+        n_slots = solve_ops.estimate_slots(snapshot)
+
+    cls, statics_arrays, key_has_bounds = solve_ops.prepare(snapshot)
+    avail_r = perturb_spot_availability(snapshot, n_replicas, seed, interruption_rate)
+    it_price = jnp.asarray(snapshot.it_price)
+
+    def one_replica(avail):
+        arrays = list(statics_arrays)
+        arrays[2] = avail  # it_avail
+        out = solve_ops.solve_core(cls, tuple(arrays), n_slots, key_has_bounds)
+        scheduled = jnp.sum(out.assign)
+        failed = jnp.sum(out.failed)
+        nodes = jnp.sum((out.state.pod_count > 0).astype(jnp.int32))
+        prices = solve_ops.node_prices(out.state, it_price)
+        cost = jnp.sum(jnp.where(jnp.isfinite(prices), prices, 0.0))
+        return scheduled, failed, nodes, cost
+
+    replicated = NamedSharding(mesh, P())
+    sharded = NamedSharding(mesh, P("replica"))
+    fn = jax.jit(
+        jax.vmap(one_replica),
+        in_shardings=(sharded,),
+        out_shardings=(sharded, sharded, sharded, sharded),
+    )
+    with mesh:
+        scheduled, failed, nodes, cost = fn(avail_r)
+        scheduled, failed, nodes, cost = jax.device_get(
+            (scheduled, failed, nodes, cost)
+        )
+    return {
+        "replicas": n_replicas,
+        "scheduled": np.asarray(scheduled),
+        "failed": np.asarray(failed),
+        "nodes": np.asarray(nodes),
+        "cost": np.asarray(cost),
+        "cost_mean": float(np.mean(cost)),
+        "cost_min": float(np.min(cost)),
+        "cost_max": float(np.max(cost)),
+        "failed_mean": float(np.mean(failed)),
+    }

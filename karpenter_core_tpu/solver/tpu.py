@@ -13,6 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+import jax
 import numpy as np
 
 from karpenter_core_tpu.apis.objects import Pod
@@ -30,15 +31,36 @@ from karpenter_core_tpu.solver.scheduler import _daemon_overhead
 from karpenter_core_tpu.utils import resources as resources_util
 
 
-@dataclass
 class TPUNodeDecision:
-    """One node the kernel decided to create."""
+    """One node the kernel decided to create.  Instance-type/zone name lists
+    and the request vector materialize lazily — at 50k-pod scale eager
+    materialization of ~7k nodes × ~1k type names dominates decode time."""
 
-    provisioner_name: str
-    instance_type_names: List[str]
-    zones: List[str]
-    pods: List[Pod] = field(default_factory=list)
-    requests: resources_util.ResourceList = field(default_factory=dict)
+    __slots__ = ("provisioner_name", "pods", "_snapshot", "_viable", "_zone", "_used")
+
+    def __init__(self, provisioner_name, snapshot, viable_row, zone_row, used_row):
+        self.provisioner_name = provisioner_name
+        self.pods: List[Pod] = []
+        self._snapshot = snapshot
+        self._viable = viable_row
+        self._zone = zone_row
+        self._used = used_row
+
+    @property
+    def instance_type_names(self) -> List[str]:
+        return [self._snapshot.it_names[i] for i in np.nonzero(self._viable)[0]]
+
+    @property
+    def zones(self) -> List[str]:
+        return [self._snapshot.zones[z] for z in np.nonzero(self._zone)[0]]
+
+    @property
+    def requests(self) -> resources_util.ResourceList:
+        return {
+            name: float(self._used[r])
+            for r, name in enumerate(self._snapshot.resources)
+            if self._used[r] > 0
+        }
 
 
 @dataclass
@@ -86,35 +108,37 @@ class TPUSolver:
         assign = np.asarray(outputs.assign)  # [C, N]
         failed = np.asarray(outputs.failed)  # [C]
         state = outputs.state
-        pod_count = np.asarray(state.pod_count)
-        tmpl_id = np.asarray(state.tmpl_id)
-        viable = np.asarray(state.viable)
-        zone = np.asarray(state.zone)
-        used = np.asarray(state.used)
-        open_ = np.asarray(state.open_)
+        n_it = state.viable.shape[-1]
+        n_zones = state.zone.shape[-1]
+        # big bool planes ship bit-packed (the device link is a tunnel)
+        viable_p, zone_p, pod_count, tmpl_id, used, open_ = jax.device_get(
+            (
+                solve_ops.pack_bool(state.viable),
+                solve_ops.pack_bool(state.zone),
+                state.pod_count,
+                state.tmpl_id,
+                state.used,
+                state.open_,
+            )
+        )
+        viable = solve_ops.unpack_bool(viable_p, n_it)
+        zone = solve_ops.unpack_bool(zone_p, n_zones)
 
         results = TPUSolveResults(n_slots_used=int(state.n_next))
         nodes: Dict[int, TPUNodeDecision] = {}
+        provisioner_names = [t.provisioner_name for t in self.templates]
         for n in np.nonzero(open_ & (pod_count > 0))[0]:
-            nodes[int(n)] = TPUNodeDecision(
-                provisioner_name=self.templates[int(tmpl_id[n])].provisioner_name,
-                instance_type_names=[
-                    snapshot.it_names[i] for i in np.nonzero(viable[n])[0]
-                ],
-                zones=[snapshot.zones[z] for z in np.nonzero(zone[n])[0]],
-                requests={
-                    name: float(used[n, r])
-                    for r, name in enumerate(snapshot.resources)
-                    if used[n, r] > 0
-                },
+            n = int(n)
+            nodes[n] = TPUNodeDecision(
+                provisioner_names[int(tmpl_id[n])], snapshot, viable[n], zone[n], used[n]
             )
 
         for c, cls in enumerate(snapshot.classes):
+            node_idx = np.nonzero(assign[c] > 0)[0]
+            counts = assign[c][node_idx]
             cursor = 0
-            for n in np.nonzero(assign[c] > 0)[0]:
-                take = int(assign[c, n])
-                for pod in cls.pods[cursor : cursor + take]:
-                    nodes[int(n)].pods.append(pod)
+            for n, take in zip(node_idx.tolist(), counts.tolist()):
+                nodes[n].pods.extend(cls.pods[cursor : cursor + take])
                 cursor += take
             results.failed_pods.extend(cls.pods[cursor:])
         results.new_nodes = [nodes[n] for n in sorted(nodes)]

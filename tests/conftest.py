@@ -1,15 +1,21 @@
-"""Test configuration: force an 8-device virtual CPU platform before JAX loads.
+"""Test configuration: force an 8-device virtual CPU platform.
 
 Multi-chip hardware is not available in CI; all sharding tests run against a
-virtual 8-device CPU mesh (SURVEY.md §7 step 8 / driver contract).
+virtual 8-device CPU mesh (SURVEY.md §7 step 8 / driver contract).  The
+environment's sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS=axon (the real-TPU tunnel), so env vars are already consumed —
+the override must go through jax.config before the backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.default_backend() == "cpu", "tests must run on the virtual CPU platform"
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for mesh tests"
