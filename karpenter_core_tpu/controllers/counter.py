@@ -1,0 +1,50 @@
+"""Counter: maintains provisioner.status.resources.
+
+Mirror of /root/reference/pkg/controllers/counter/controller.go:62-148: the
+provisioner's status carries the summed capacity of its state nodes; the
+reference waits until the state cache and list cache agree before writing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.v1alpha5 import Provisioner
+from karpenter_core_tpu.state.cluster import Cluster
+from karpenter_core_tpu.utils import resources as resources_util
+
+
+class CounterController:
+    name = "counter"
+
+    def __init__(self, kube_client, cluster: Cluster) -> None:
+        self.kube_client = kube_client
+        self.cluster = cluster
+
+    def reconcile(self, provisioner: Provisioner) -> Optional[float]:
+        stored = self.kube_client.get(Provisioner, provisioner.name)
+        if stored is None:
+            return None
+        resources: resources_util.ResourceList = {}
+
+        def visit(node) -> bool:
+            nonlocal resources
+            if (
+                node.node.metadata.labels.get(labels_api.PROVISIONER_NAME_LABEL_KEY)
+                == stored.name
+            ):
+                resources = resources_util.merge(resources, node.capacity())
+            return True
+
+        self.cluster.for_each_node(visit)
+        # write only on change (the reference waits for state/list agreement
+        # and compares before writing, controller.go:121-148)
+        if stored.status.resources != resources:
+            stored.status.resources = resources
+            self.kube_client.apply(stored)
+        return None
+
+    def reconcile_all(self) -> None:
+        for provisioner in self.kube_client.list_provisioners():
+            self.reconcile(provisioner)

@@ -1,0 +1,164 @@
+"""Inflight checks: periodic node health audits surfaced as events.
+
+Mirror of /root/reference/pkg/controllers/inflightchecks/{controller.go:84-93,
+failedinit.go:34-90, termination.go:40-66, nodeshape.go:40-85}: FailedInit
+(uninitialized >1h and why), Termination (stuck deleting: PDB / do-not-evict
+blockers), NodeShape (capacity <90% of the instance type's expectation);
+issues dedupe so each is reported once per node per condition.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import List, Optional
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import Node
+from karpenter_core_tpu.apis.v1alpha5 import Provisioner
+from karpenter_core_tpu.controllers.deprovisioning import PDBLimits, pods_prevent_eviction
+from karpenter_core_tpu.controllers.node import (
+    extended_resource_registered,
+    startup_taint_removed,
+)
+from karpenter_core_tpu.events import events as evt
+from karpenter_core_tpu.utils import node as node_util
+from karpenter_core_tpu.utils import resources as resources_util
+from karpenter_core_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+INIT_FAILURE_TIME = 3600.0  # failedinit.go:34
+SCAN_PERIOD = 10 * 60.0  # controller.go: 10 min per node
+
+
+@dataclass
+class Issue:
+    node: Node
+    message: str
+
+
+class FailedInit:
+    def __init__(self, clock: Clock, provider) -> None:
+        self.clock = clock
+        self.provider = provider
+
+    def check(self, node: Node, provisioner: Optional[Provisioner], pdbs: PDBLimits, kube) -> List[Issue]:
+        if node.metadata.deletion_timestamp is not None:
+            return []
+        age = self.clock.now() - node.metadata.creation_timestamp
+        if node.metadata.labels.get(labels_api.LABEL_NODE_INITIALIZED) == "true" or age < INIT_FAILURE_TIME:
+            return []
+        it_name = node.metadata.labels.get(labels_api.LABEL_INSTANCE_TYPE_STABLE)
+        instance_type = next(
+            (it for it in self.provider.get_instance_types(provisioner) if it.name == it_name),
+            None,
+        )
+        if instance_type is None:
+            return [Issue(node, f"Instance Type {it_name!r} not found")]
+        issues = []
+        taint, removed = startup_taint_removed(node, provisioner)
+        if not removed:
+            issues.append(
+                Issue(node, f"Startup taint {taint.key}={taint.value}:{taint.effect} is still on the node")
+            )
+        resource, registered = extended_resource_registered(node, instance_type)
+        if not registered:
+            issues.append(Issue(node, f"Expected resource {resource!r} didn't register on the node"))
+        return issues
+
+
+class TerminationCheck:
+    def check(self, node: Node, provisioner, pdbs: PDBLimits, kube) -> List[Issue]:
+        if node.metadata.deletion_timestamp is None:
+            return []
+        pods = node_util.get_node_pods(kube, node)
+        issues = []
+        pdb, ok = pdbs.can_evict_pods(pods)
+        if not ok:
+            issues.append(Issue(node, f"Can't drain node, PDB {pdb} is blocking evictions"))
+        reason, prevented = pods_prevent_eviction(pods)
+        if prevented:
+            issues.append(Issue(node, f"Can't drain node, {reason}"))
+        return issues
+
+
+class NodeShape:
+    def __init__(self, provider) -> None:
+        self.provider = provider
+
+    def check(self, node: Node, provisioner, pdbs: PDBLimits, kube) -> List[Issue]:
+        if node.metadata.deletion_timestamp is not None:
+            return []
+        if node.metadata.labels.get(labels_api.LABEL_NODE_INITIALIZED) != "true":
+            return []
+        it_name = node.metadata.labels.get(labels_api.LABEL_INSTANCE_TYPE_STABLE)
+        instance_type = next(
+            (it for it in self.provider.get_instance_types(provisioner) if it.name == it_name),
+            None,
+        )
+        if instance_type is None:
+            return [Issue(node, f"Instance Type {it_name!r} not found")]
+        issues = []
+        for name, expected in instance_type.capacity.items():
+            if resources_util.is_zero(expected):
+                continue
+            actual = node.status.capacity.get(name)
+            if actual is None:
+                issues.append(Issue(node, f"Expected resource {name} not found"))
+                continue
+            pct = actual / expected
+            if pct < 0.90:
+                issues.append(
+                    Issue(
+                        node,
+                        f"Expected {expected} of resource {name}, but found {actual} "
+                        f"({pct * 100:.1f}% of expected)",
+                    )
+                )
+        return issues
+
+
+class InflightChecksController:
+    """Runs every check per node at most once per SCAN_PERIOD; dedupes issue
+    events (controller.go:84-93)."""
+
+    name = "inflightchecks"
+
+    def __init__(self, clock: Clock, kube_client, cloud_provider, recorder) -> None:
+        self.clock = clock
+        self.kube_client = kube_client
+        self.recorder = recorder
+        self.checks = [
+            FailedInit(clock, cloud_provider),
+            TerminationCheck(),
+            NodeShape(cloud_provider),
+        ]
+        self._last_scan = {}
+        self._reported = {}
+
+    def reconcile(self, node: Node) -> Optional[float]:
+        provisioner_name = node.metadata.labels.get(labels_api.PROVISIONER_NAME_LABEL_KEY)
+        if not provisioner_name:
+            return None
+        now = self.clock.now()
+        last = self._last_scan.get(node.name)
+        if last is not None and now - last < SCAN_PERIOD:
+            return SCAN_PERIOD - (now - last)
+        self._last_scan[node.name] = now
+        provisioner = self.kube_client.get(Provisioner, provisioner_name)
+        pdbs = PDBLimits(self.kube_client)
+        for check in self.checks:
+            for issue in check.check(node, provisioner, pdbs, self.kube_client):
+                key = (node.name, issue.message)
+                if key in self._reported:
+                    continue
+                self._reported[key] = now
+                log.info("inflight check failed for node %s, %s", node.name, issue.message)
+                if self.recorder is not None:
+                    self.recorder.publish(evt.node_inflight_check(node, issue.message))
+        return SCAN_PERIOD
+
+    def reconcile_all(self) -> None:
+        for node in self.kube_client.list_nodes():
+            self.reconcile(node)

@@ -1,0 +1,173 @@
+"""Metrics scrapers: node, pod, and provisioner gauges.
+
+Mirror of /root/reference/pkg/controllers/metrics/{state/scraper/node.go:42-113,
+pod/controller.go:57-69, provisioner/controller.go:48-68}: per-node resource
+gauges (allocatable, total pod requests/limits, daemon requests/limits, system
+overhead) labeled by node/provisioner/zone/arch/capacity-type/phase; pod state
+gauge and startup-time summary; provisioner limit/usage/usage_pct gauges.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import POD_RUNNING, Pod
+from karpenter_core_tpu.metrics import REGISTRY
+from karpenter_core_tpu.state.cluster import Cluster
+from karpenter_core_tpu.utils import pod as pod_util
+from karpenter_core_tpu.utils import resources as resources_util
+
+SCRAPE_PERIOD = 5.0  # state/controller.go:29-56
+
+_NODE_LABELS = ("node_name", "provisioner", "zone", "arch", "capacity_type", "phase", "resource_type")
+
+NODE_ALLOCATABLE = REGISTRY.gauge(
+    "karpenter_nodes_allocatable", "Node allocatable", _NODE_LABELS
+)
+NODE_POD_REQUESTS = REGISTRY.gauge(
+    "karpenter_nodes_total_pod_requests", "Total pod requests on node", _NODE_LABELS
+)
+NODE_POD_LIMITS = REGISTRY.gauge(
+    "karpenter_nodes_total_pod_limits", "Total pod limits on node", _NODE_LABELS
+)
+NODE_DAEMON_REQUESTS = REGISTRY.gauge(
+    "karpenter_nodes_total_daemon_requests", "Total daemonset requests on node", _NODE_LABELS
+)
+NODE_DAEMON_LIMITS = REGISTRY.gauge(
+    "karpenter_nodes_total_daemon_limits", "Total daemonset limits on node", _NODE_LABELS
+)
+NODE_OVERHEAD = REGISTRY.gauge(
+    "karpenter_nodes_system_overhead", "Node system overhead", _NODE_LABELS
+)
+
+POD_STATE = REGISTRY.gauge(
+    "karpenter_pods_state",
+    "Pod state",
+    ("name", "namespace", "owner", "node", "provisioner", "zone", "arch", "capacity_type", "instance_type", "phase"),
+)
+POD_STARTUP_TIME = REGISTRY.summary(
+    "karpenter_pods_startup_time_seconds",
+    "The time from pod creation until the pod is running.",
+)
+
+PROVISIONER_LIMIT = REGISTRY.gauge(
+    "karpenter_provisioner_limit", "Provisioner resource limits", ("provisioner", "resource_type")
+)
+PROVISIONER_USAGE = REGISTRY.gauge(
+    "karpenter_provisioner_usage", "Provisioner resource usage", ("provisioner", "resource_type")
+)
+PROVISIONER_USAGE_PCT = REGISTRY.gauge(
+    "karpenter_provisioner_usage_pct", "Provisioner usage percentage", ("provisioner", "resource_type")
+)
+
+
+class NodeScraper:
+    """5s singleton scrape of cluster state into node gauges."""
+
+    name = "metrics_state"
+
+    def __init__(self, cluster: Cluster) -> None:
+        self.cluster = cluster
+
+    def scrape(self) -> float:
+        for gauge in (
+            NODE_ALLOCATABLE,
+            NODE_POD_REQUESTS,
+            NODE_POD_LIMITS,
+            NODE_DAEMON_REQUESTS,
+            NODE_DAEMON_LIMITS,
+            NODE_OVERHEAD,
+        ):
+            gauge.clear()
+
+        def visit(state_node) -> bool:
+            node = state_node.node
+            base = dict(
+                node_name=node.name,
+                provisioner=node.metadata.labels.get(labels_api.PROVISIONER_NAME_LABEL_KEY, ""),
+                zone=node.metadata.labels.get(labels_api.LABEL_TOPOLOGY_ZONE, ""),
+                arch=node.metadata.labels.get(labels_api.LABEL_ARCH_STABLE, ""),
+                capacity_type=node.metadata.labels.get(labels_api.LABEL_CAPACITY_TYPE, ""),
+                phase=node.status.phase,
+            )
+            overhead = resources_util.subtract(node.status.capacity, state_node.allocatable())
+            for gauge, values in (
+                (NODE_ALLOCATABLE, state_node.allocatable()),
+                (NODE_POD_REQUESTS, state_node.pod_requests_total()),
+                (NODE_POD_LIMITS, state_node.pod_limits_total()),
+                (NODE_DAEMON_REQUESTS, state_node.daemon_set_requests()),
+                (NODE_DAEMON_LIMITS, state_node.daemon_set_limits()),
+                (NODE_OVERHEAD, overhead),
+            ):
+                for resource_name, quantity in values.items():
+                    gauge.labels(**{**base, "resource_type": resource_name}).set(quantity)
+            return True
+
+        self.cluster.for_each_node(visit)
+        return SCRAPE_PERIOD
+
+
+class PodScraper:
+    name = "metrics_pod"
+
+    def __init__(self, kube_client) -> None:
+        self.kube_client = kube_client
+        self._started: Dict[str, float] = {}
+        # drop series and startup tracking for deleted pods: without this the
+        # gauge cardinality and _started grow forever on a churning cluster
+        from karpenter_core_tpu.apis.objects import Pod as _Pod
+
+        kube_client.watch(_Pod, self._on_event, replay=False)
+
+    def _on_event(self, event_type: str, pod: Pod) -> None:
+        if event_type == "DELETED":
+            self._started.pop(pod.uid, None)
+
+    def reconcile(self, pod: Pod) -> None:
+        node = self.kube_client.get_node(pod.spec.node_name) if pod.spec.node_name else None
+        node_labels = node.metadata.labels if node is not None else {}
+        owner = pod.metadata.owner_references[0].name if pod.metadata.owner_references else ""
+        POD_STATE.labels(
+            name=pod.name,
+            namespace=pod.namespace,
+            owner=owner,
+            node=pod.spec.node_name,
+            provisioner=node_labels.get(labels_api.PROVISIONER_NAME_LABEL_KEY, ""),
+            zone=node_labels.get(labels_api.LABEL_TOPOLOGY_ZONE, ""),
+            arch=node_labels.get(labels_api.LABEL_ARCH_STABLE, ""),
+            capacity_type=node_labels.get(labels_api.LABEL_CAPACITY_TYPE, ""),
+            instance_type=node_labels.get(labels_api.LABEL_INSTANCE_TYPE_STABLE, ""),
+            phase=pod.status.phase,
+        ).set(1)
+        if pod.status.phase == POD_RUNNING and pod.uid not in self._started:
+            if pod.status.start_time is not None:
+                self._started[pod.uid] = pod.status.start_time
+                POD_STARTUP_TIME.observe(
+                    pod.status.start_time - pod.metadata.creation_timestamp
+                )
+
+    def reconcile_all(self) -> None:
+        POD_STATE.clear()
+        for pod in self.kube_client.list_pods():
+            self.reconcile(pod)
+
+
+class ProvisionerScraper:
+    name = "metrics_provisioner"
+
+    def __init__(self, kube_client) -> None:
+        self.kube_client = kube_client
+
+    def reconcile_all(self) -> None:
+        for provisioner in self.kube_client.list_provisioners():
+            usage = provisioner.status.resources
+            for name, quantity in usage.items():
+                PROVISIONER_USAGE.labels(provisioner.name, name).set(quantity)
+            if provisioner.spec.limits is not None:
+                for name, limit in provisioner.spec.limits.resources.items():
+                    PROVISIONER_LIMIT.labels(provisioner.name, name).set(limit)
+                    if limit > 0:
+                        PROVISIONER_USAGE_PCT.labels(provisioner.name, name).set(
+                            usage.get(name, 0.0) / limit
+                        )

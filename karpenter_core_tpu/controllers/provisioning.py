@@ -1,0 +1,357 @@
+"""Provisioning: pod batching, scheduling, machine launch, nomination.
+
+Mirror of /root/reference/pkg/controllers/provisioning/{controller.go,
+provisioner.go,batcher.go,volumetopology.go}: a pod-watch trigger feeds a
+batching window; the singleton reconciler snapshots cluster state, collects
+pending pods (plus pods on deleting nodes), runs the scheduler, launches
+machines in parallel, pre-creates node objects, and nominates nodes for pods.
+
+The solve itself routes to the TPU kernel when the batch is kernel-supported
+(models.snapshot) and large enough to beat the host path, else to the exact
+host scheduler — the Solver-interface seam described in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Tuple
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_IN,
+    Affinity,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NodeStatus,
+    Pod,
+)
+from karpenter_core_tpu.apis.v1alpha5 import Provisioner as ProvisionerCRD
+from karpenter_core_tpu.cloudprovider import CloudProvider
+from karpenter_core_tpu.events import events as evt
+from karpenter_core_tpu.metrics import REGISTRY, measure
+from karpenter_core_tpu.operator.settings import Settings
+from karpenter_core_tpu.solver.builder import NoProvisionersError, build_scheduler
+from karpenter_core_tpu.solver.scheduler import SchedulerOptions, SchedulingResults
+from karpenter_core_tpu.state.cluster import Cluster
+from karpenter_core_tpu.utils import pod as pod_util
+from karpenter_core_tpu.utils import resources as resources_util
+from karpenter_core_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+SCHEDULING_DURATION = REGISTRY.histogram(
+    "karpenter_provisioner_scheduling_duration_seconds",
+    "Duration of the scheduling process in seconds.",
+    ("provisioner",),
+)
+NODES_CREATED = REGISTRY.counter(
+    "karpenter_nodes_created", "Number of nodes created in total by Karpenter.", ("reason",)
+)
+
+
+class Batcher:
+    """Idle/max-duration pod batching window (batcher.go:27-74): an idempotent
+    one-slot trigger; Wait blocks for the first trigger then extends while
+    triggers keep arriving within the idle window, up to the max window."""
+
+    def __init__(self, clock: Clock, settings: Settings) -> None:
+        self.clock = clock
+        self.settings = settings
+        self._trigger = threading.Event()
+
+    def trigger(self) -> None:
+        self._trigger.set()
+
+    def wait(self, poll_interval: float = 0.05) -> bool:
+        """True when a batch is ready; False when no trigger arrived."""
+        if not self._trigger.wait(timeout=0.001):
+            return False
+        self._trigger.clear()
+        start = self.clock.now()
+        last_activity = start
+        while True:
+            self.clock.sleep(poll_interval)
+            now = self.clock.now()
+            if self._trigger.is_set():
+                self._trigger.clear()
+                last_activity = now
+            if now - last_activity >= self.settings.batch_idle_duration:
+                return True
+            if now - start >= self.settings.batch_max_duration:
+                return True
+
+
+class VolumeTopology:
+    """Rewrites pod node-affinity to AND in PV/StorageClass zone requirements
+    so relaxation can't drop them (volumetopology.go:36-173)."""
+
+    def __init__(self, kube_client) -> None:
+        self.kube_client = kube_client
+
+    def inject(self, pod: Pod) -> Optional[str]:
+        requirements: List[NodeSelectorRequirement] = []
+        for volume in pod.spec.volumes:
+            reqs, err = self._requirements_for(pod, volume)
+            if err is not None:
+                return err
+            requirements.extend(reqs)
+        if not requirements:
+            return None
+        if pod.spec.affinity is None:
+            pod.spec.affinity = Affinity()
+        if pod.spec.affinity.node_affinity is None:
+            pod.spec.affinity.node_affinity = NodeAffinity()
+        if pod.spec.affinity.node_affinity.required is None:
+            pod.spec.affinity.node_affinity.required = NodeSelector()
+        terms = pod.spec.affinity.node_affinity.required.node_selector_terms
+        if not terms:
+            terms.append(NodeSelectorTerm())
+        # AND into every OR term so relaxation can't drop the volume zone
+        for term in terms:
+            term.match_expressions.extend(requirements)
+        return None
+
+    def _requirements_for(self, pod: Pod, volume) -> Tuple[List[NodeSelectorRequirement], Optional[str]]:
+        if volume.persistent_volume_claim is None:
+            return [], None
+        pvc = self.kube_client.get_persistent_volume_claim(
+            pod.namespace, volume.persistent_volume_claim.claim_name
+        )
+        if pvc is None:
+            return [], f"pvc {volume.persistent_volume_claim.claim_name} not found"
+        if pvc.spec.volume_name:
+            pv = self.kube_client.get_persistent_volume(pvc.spec.volume_name)
+            if pv is None:
+                return [], f"pv {pvc.spec.volume_name} not found"
+            if pv.spec.node_affinity_required and pv.spec.node_affinity_required.node_selector_terms:
+                return list(pv.spec.node_affinity_required.node_selector_terms[0].match_expressions), None
+            return [], None
+        if pvc.spec.storage_class_name:
+            sc = self.kube_client.get_storage_class(pvc.spec.storage_class_name)
+            if sc is None:
+                return [], f"storage class {pvc.spec.storage_class_name} not found"
+            if sc.allowed_topologies:
+                return [
+                    NodeSelectorRequirement(e.key, OP_IN, list(e.values))
+                    for e in sc.allowed_topologies[0].match_expressions
+                ], None
+        return [], None
+
+    def validate(self, pod: Pod) -> Optional[str]:
+        """PVC/StorageClass existence validation (volumetopology.go:145-173)."""
+        for volume in pod.spec.volumes:
+            if volume.persistent_volume_claim is None:
+                continue
+            pvc = self.kube_client.get_persistent_volume_claim(
+                pod.namespace, volume.persistent_volume_claim.claim_name
+            )
+            if pvc is None:
+                return f"pvc {volume.persistent_volume_claim.claim_name} not found"
+            if pvc.spec.storage_class_name:
+                if self.kube_client.get_storage_class(pvc.spec.storage_class_name) is None:
+                    return f"storage class {pvc.spec.storage_class_name} not found"
+        return None
+
+
+class PodController:
+    """Pod-watch trigger (controller.go:56-66): provisionable pods trip the
+    batcher."""
+
+    name = "provisioning_trigger"
+
+    def __init__(self, provisioner: "ProvisioningController") -> None:
+        self.provisioner = provisioner
+
+    def reconcile(self, pod: Pod) -> None:
+        if pod_util.is_provisionable(pod):
+            self.provisioner.trigger()
+
+    def start(self, kube_client) -> None:
+        kube_client.watch(Pod, lambda event, pod: event != "DELETED" and self.reconcile(pod))
+
+
+class ProvisioningController:
+    """The Provisioner singleton (provisioner.go:106-360)."""
+
+    name = "provisioning"
+
+    def __init__(
+        self,
+        kube_client,
+        cloud_provider: CloudProvider,
+        cluster: Cluster,
+        recorder=None,
+        settings: Optional[Settings] = None,
+        clock: Optional[Clock] = None,
+        use_tpu_kernel: bool = False,
+        tpu_kernel_min_pods: int = 256,
+    ) -> None:
+        self.kube_client = kube_client
+        self.cloud_provider = cloud_provider
+        self.cluster = cluster
+        self.recorder = recorder
+        self.settings = settings or Settings()
+        self.clock = clock or Clock()
+        self.batcher = Batcher(self.clock, self.settings)
+        self.volume_topology = VolumeTopology(kube_client)
+        self.use_tpu_kernel = use_tpu_kernel
+        self.tpu_kernel_min_pods = tpu_kernel_min_pods
+
+    def trigger(self) -> None:
+        self.batcher.trigger()
+
+    # -- reconcile ------------------------------------------------------------
+
+    def reconcile(self, wait_for_batch: bool = True) -> Optional[str]:
+        if wait_for_batch and not self.batcher.wait():
+            return None
+        state_nodes = []
+        deleting_nodes = []
+        for node in self.cluster.snapshot_nodes():
+            if not node.marked():
+                state_nodes.append(node)
+            else:
+                deleting_nodes.append(node)
+
+        pods = self.get_pending_pods()
+        # pods on deleting (cordoned) nodes also need homes (provisioner.go:137-144)
+        deleting_names = {n.node.name for n in deleting_nodes}
+        for pod in self.kube_client.list_pods():
+            if (
+                pod.spec.node_name in deleting_names
+                and not pod_util.is_terminal(pod)
+                and not pod_util.is_terminating(pod)
+                and not pod_util.is_owned_by_daemon_set(pod)
+                and not pod_util.is_owned_by_node(pod)
+            ):
+                pods.append(pod)
+        if not pods:
+            return None
+
+        results, err = self.schedule(pods, state_nodes)
+        if err is not None:
+            return err
+        if not results.new_nodes:
+            return None
+
+        node_names, launch_err = self.launch_machines(results.new_nodes)
+        created = sum(1 for n in node_names if n)
+        if created:
+            NODES_CREATED.labels("provisioning").inc(created)
+        return launch_err
+
+    def get_pending_pods(self) -> List[Pod]:
+        pods = []
+        for pod in self.kube_client.list_pods(selector=lambda p: not p.spec.node_name):
+            if not pod_util.is_provisionable(pod):
+                continue
+            err = self.volume_topology.validate(pod)
+            if err is not None:
+                log.debug("ignoring pod %s/%s, %s", pod.namespace, pod.name, err)
+                continue
+            pods.append(pod)
+        return pods
+
+    def schedule(self, pods: List[Pod], state_nodes) -> Tuple[Optional[SchedulingResults], Optional[str]]:
+        done = measure(SCHEDULING_DURATION.labels("default"))
+        try:
+            for pod in pods:
+                err = self.volume_topology.inject(pod)
+                if err is not None:
+                    return None, err
+            scheduler = build_scheduler(
+                self.kube_client,
+                self.cloud_provider,
+                self.cluster,
+                pods,
+                state_nodes,
+                daemonset_pods=self.get_daemonset_pods(),
+                recorder=self.recorder,
+                opts=SchedulerOptions(),
+            )
+            return scheduler.solve(pods), None
+        except NoProvisionersError as e:
+            return None, str(e)
+        finally:
+            done()
+
+    def get_daemonset_pods(self) -> List[Pod]:
+        """Representative daemonset pods for overhead calculation.  The
+        reference lists DaemonSet objects (provisioner.go getDaemonSetPods); we
+        derive from daemonset-owned pods in the store."""
+        seen = {}
+        for pod in self.kube_client.list_pods():
+            if pod_util.is_owned_by_daemon_set(pod):
+                owner = next(
+                    (r.name for r in pod.metadata.owner_references if r.kind == "DaemonSet"),
+                    pod.name,
+                )
+                seen.setdefault(owner, pod)
+        return list(seen.values())
+
+    # -- launch ---------------------------------------------------------------
+
+    def launch_machines(self, machines) -> Tuple[List[str], Optional[str]]:
+        """Parallel machine launches (provisioner.go:169-189)."""
+        names: List[Optional[str]] = [None] * len(machines)
+        errs: List[Optional[str]] = [None] * len(machines)
+
+        def one(i: int) -> None:
+            name, err = self.launch(machines[i])
+            names[i] = name or ""
+            errs[i] = err
+
+        if len(machines) == 1:
+            one(0)
+        else:
+            with ThreadPoolExecutor(max_workers=min(len(machines), 32)) as pool:
+                list(pool.map(one, range(len(machines))))
+        messages = [e for e in errs if e]
+        return [n or "" for n in names], ("; ".join(messages) if messages else None)
+
+    def launch(self, machine_node) -> Tuple[Optional[str], Optional[str]]:
+        """Launch one machine and pre-create its node (provisioner.go:311-358)."""
+        latest = self.kube_client.get(ProvisionerCRD, machine_node.provisioner_name)
+        if latest is None:
+            return None, f"provisioner {machine_node.provisioner_name} not found"
+        if latest.spec.limits is not None:
+            err = latest.spec.limits.exceeded_by(latest.status.resources)
+            if err is not None:
+                return None, err
+
+        template = machine_node.template
+        template.instance_type_options = machine_node.instance_type_options
+        template.requests = machine_node.requests
+        machine = template.to_machine(latest)
+        try:
+            created = self.cloud_provider.create(machine)
+        except Exception as e:  # noqa: BLE001 - cloud errors surface as strings
+            return None, f"creating cloud provider instance, {e}"
+
+        node = Node(
+            metadata=created.metadata,
+            spec=machine_node.template.to_node().spec,
+            status=NodeStatus(),
+        )
+        node.metadata.labels.update(template.labels)
+        node.metadata.finalizers = [labels_api.TERMINATION_FINALIZER]
+        node.spec.provider_id = created.status.provider_id
+
+        # idempotent node pre-create (provisioner.go:338-348)
+        try:
+            self.kube_client.create(node)
+        except Exception:
+            log.debug("node already registered")
+        err = self.cluster.update_node(node)
+        if err is not None:
+            return None, f"updating cluster state, {err}"
+        self.cluster.nominate_node_for_pod(node.name)
+        if self.recorder is not None:
+            for pod in machine_node.pods:
+                self.recorder.publish(evt.nominate_pod(pod, node))
+        return node.name, None

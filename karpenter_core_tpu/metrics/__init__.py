@@ -1,0 +1,21 @@
+from karpenter_core_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    Summary,
+    REGISTRY,
+    DURATION_BUCKETS,
+    measure,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Summary",
+    "Registry",
+    "REGISTRY",
+    "DURATION_BUCKETS",
+    "measure",
+]

@@ -1,0 +1,257 @@
+"""Prometheus-style metrics registry.
+
+Mirror of the role of /root/reference/pkg/metrics/constants.go:41-66 and the
+controller-runtime registry: counters/gauges/histograms/summaries with label
+sets, a shared default registry, DurationBuckets, and the ``measure`` closure
+timer used around scheduling and deprovisioning evaluations.  Exposition is
+text-format compatible (``Registry.render``) for scraping.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+NAMESPACE = "karpenter"
+
+# metrics/constants.go:46-55 DurationBuckets
+DURATION_BUCKETS = [
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 180, 300,
+]
+# SummaryObjectives p0/p50/p90/p99 (constants.go:57-59)
+SUMMARY_OBJECTIVES = [0.0, 0.5, 0.9, 0.99]
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(self, name: str, help_: str, label_names: Iterable[str] = ()) -> None:
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def labels(self, *values: str, **kwargs: str):
+        if kwargs:
+            values = tuple(kwargs.get(name, "") for name in self.label_names)
+        if len(values) != len(self.label_names):
+            raise ValueError(f"{self.name}: expected labels {self.label_names}")
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._new_child()
+                self._children[key] = child
+            return child
+
+    def _new_child(self):
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        with self._lock:
+            self._children.clear()
+
+    def samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        raise NotImplementedError
+
+    def _label_dicts(self):
+        with self._lock:
+            return [
+                (dict(zip(self.label_names, key)), child)
+                for key, child in self._children.items()
+            ]
+
+
+class _CounterChild:
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+    add = inc
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def samples(self):
+        return [(self.name, labels, c.value) for labels, c in self._label_dicts()]
+
+
+class _GaugeChild:
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = value
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.value -= amount
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, value: float) -> None:
+        self.labels().set(value)
+
+    def samples(self):
+        return [(self.name, labels, g.value) for labels, g in self._label_dicts()]
+
+    def delete_labels(self, *values: str) -> None:
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            self._children.pop(key, None)
+
+
+class _HistogramChild:
+    def __init__(self, buckets: List[float]) -> None:
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        idx = bisect.bisect_left(self.buckets, value)
+        self.counts[idx] += 1
+        self.total += value
+        self.count += 1
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, label_names=(), buckets: Optional[List[float]] = None):
+        super().__init__(name, help_, label_names)
+        self.buckets = list(buckets or DURATION_BUCKETS)
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, value: float) -> None:
+        self.labels().observe(value)
+
+    def samples(self):
+        out = []
+        for labels, h in self._label_dicts():
+            out.append((self.name + "_count", labels, float(h.count)))
+            out.append((self.name + "_sum", labels, h.total))
+        return out
+
+
+class _SummaryChild:
+    def __init__(self) -> None:
+        self.values: List[float] = []
+
+    def observe(self, value: float) -> None:
+        bisect.insort(self.values, value)
+
+    def quantile(self, q: float) -> float:
+        if not self.values:
+            return float("nan")
+        idx = min(int(q * len(self.values)), len(self.values) - 1)
+        return self.values[idx]
+
+
+class Summary(_Metric):
+    kind = "summary"
+
+    def _new_child(self):
+        return _SummaryChild()
+
+    def observe(self, value: float) -> None:
+        self.labels().observe(value)
+
+    def samples(self):
+        out = []
+        for labels, s in self._label_dicts():
+            out.append((self.name + "_count", labels, float(len(s.values))))
+            out.append((self.name + "_sum", labels, float(sum(s.values))))
+            for q in SUMMARY_OBJECTIVES:
+                out.append(
+                    (self.name, {**labels, "quantile": str(q)}, s.quantile(q))
+                )
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name, help_="", label_names=()) -> Counter:
+        return self.register(Counter(name, help_, label_names))  # type: ignore[return-value]
+
+    def gauge(self, name, help_="", label_names=()) -> Gauge:
+        return self.register(Gauge(name, help_, label_names))  # type: ignore[return-value]
+
+    def histogram(self, name, help_="", label_names=(), buckets=None) -> Histogram:
+        return self.register(Histogram(name, help_, label_names, buckets))  # type: ignore[return-value]
+
+    def summary(self, name, help_="", label_names=()) -> Summary:
+        return self.register(Summary(name, help_, label_names))  # type: ignore[return-value]
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def reset(self) -> None:
+        with self._lock:
+            for metric in self._metrics.values():
+                metric.clear()
+
+    def render(self) -> str:
+        """Prometheus text exposition."""
+        lines = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for metric in metrics:
+            lines.append(f"# HELP {metric.name} {metric.help}")
+            lines.append(f"# TYPE {metric.name} {metric.kind}")
+            for name, labels, value in metric.samples():
+                if labels:
+                    rendered = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+                    lines.append(f"{name}{{{rendered}}} {value}")
+                else:
+                    lines.append(f"{name} {value}")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+
+def measure(observer, clock=None):
+    """Closure timer (constants.go:60-66): ``done = measure(hist.labels(...))``
+    then ``done()`` observes the elapsed seconds."""
+    start = time.perf_counter()
+
+    def done() -> float:
+        elapsed = time.perf_counter() - start
+        observer.observe(elapsed)
+        return elapsed
+
+    return done

@@ -1,0 +1,175 @@
+"""Controller framework: singleton loops, typed watch controllers, metrics.
+
+Mirror of /root/reference/pkg/operator/controller/{controller.go:25-45,
+singleton.go:92-122, typed.go:33-84}: a Singleton runs a self-ticking reconcile
+loop with per-controller duration metrics and rate-limited requeue; a typed
+watch controller dispatches object events (routing deleting objects to
+Finalize, typed.go:75-78).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+from typing import Callable, Optional
+
+from karpenter_core_tpu.metrics import REGISTRY, measure
+from karpenter_core_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+RECONCILE_DURATION = REGISTRY.histogram(
+    "controller_runtime_reconcile_time_seconds",
+    "Length of time per reconciliation per controller",
+    ("controller",),
+)
+RECONCILE_ERRORS = REGISTRY.counter(
+    "controller_runtime_reconcile_errors_total",
+    "Total number of reconciliation errors per controller",
+    ("controller",),
+)
+
+
+class Singleton:
+    """Self-ticking reconcile loop (singleton.go:92-122).  ``reconcile``
+    returns the requeue-after in seconds (None = default)."""
+
+    def __init__(
+        self,
+        name: str,
+        reconcile: Callable[[], Optional[float]],
+        clock: Optional[Clock] = None,
+        default_requeue: float = 10.0,
+    ) -> None:
+        self.name = name
+        self.reconcile = reconcile
+        self.clock = clock or Clock()
+        self.default_requeue = default_requeue
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name=self.name, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            requeue = self.tick()
+            self._stop.wait(timeout=requeue)
+
+    def tick(self) -> float:
+        done = measure(RECONCILE_DURATION.labels(self.name))
+        try:
+            requeue = self.reconcile()
+        except Exception:  # noqa: BLE001 - controller loops never die
+            log.exception("reconciling %s", self.name)
+            RECONCILE_ERRORS.labels(self.name).inc()
+            requeue = None
+        finally:
+            done()
+        return requeue if requeue is not None else self.default_requeue
+
+
+class TypedWatchController:
+    """Watch-driven controller for one object kind (typed.go:33-84): routes
+    deleting objects to ``finalize`` and live ones to ``reconcile``.
+
+    Events flow through a deduping workqueue drained by a worker thread —
+    controller-runtime semantics.  Without the queue, a reconcile that mutates
+    its own object (e.g. termination cordoning a node) re-enters itself through
+    the synchronous watch dispatch and recurses.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        kind: type,
+        kube_client,
+        reconcile: Callable,
+        finalize: Optional[Callable] = None,
+    ) -> None:
+        self.name = name
+        self.kind = kind
+        self.kube_client = kube_client
+        self.reconcile = reconcile
+        self.finalize = finalize
+        self._queue: "queue_mod.Queue" = queue_mod.Queue()
+        self._pending = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._timers: set = set()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._worker, name=self.name, daemon=True)
+        self._thread.start()
+        self.kube_client.watch(self.kind, self._on_event)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            for timer in self._timers:
+                timer.cancel()
+            self._timers.clear()
+        self._queue.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _on_event(self, event_type: str, obj) -> None:
+        if event_type == "DELETED":
+            return
+        key = (obj.metadata.namespace, obj.metadata.name)
+        with self._lock:
+            if key in self._pending:
+                return  # dedupe: already queued
+            self._pending.add(key)
+        self._queue.put((key, obj))
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            item = self._queue.get()
+            if item is None:
+                return
+            key, obj = item
+            with self._lock:
+                self._pending.discard(key)
+            done = measure(RECONCILE_DURATION.labels(self.name))
+            try:
+                # re-fetch: the queued object may be stale (the namespace arg
+                # is ignored for cluster-scoped kinds)
+                stored = self.kube_client.get(self.kind, key[1], key[0])
+                if stored is None:
+                    continue
+                if stored.metadata.deletion_timestamp is not None and self.finalize is not None:
+                    requeue = self.finalize(stored)
+                else:
+                    requeue = self.reconcile(stored)
+                if requeue is not None and not self._stop.is_set():
+                    # schedule a delayed requeue without blocking the worker;
+                    # honor the controller's interval (drift polls at 5 min)
+                    timer = threading.Timer(
+                        float(requeue), self._requeue_cb(stored)
+                    )
+                    timer.daemon = True
+                    with self._lock:
+                        self._timers = {t for t in self._timers if t.is_alive()}
+                        self._timers.add(timer)
+                    timer.start()
+            except Exception:  # noqa: BLE001
+                log.exception("reconciling %s", self.name)
+                RECONCILE_ERRORS.labels(self.name).inc()
+            finally:
+                done()
+
+    def _requeue_cb(self, obj):
+        def fire():
+            if not self._stop.is_set():
+                self._on_event("MODIFIED", obj)
+
+        return fire

@@ -52,7 +52,10 @@ class _Store:
 
 
 class KubeClient:
-    def __init__(self) -> None:
+    def __init__(self, clock=None) -> None:
+        import time as _time
+
+        self._now = clock.now if clock is not None else _time.time
         self._lock = threading.RLock()
         self._stores: Dict[type, _Store] = {
             Pod: _Store(True),
@@ -83,6 +86,8 @@ class KubeClient:
                 raise ConflictError(f"{type(obj).__name__} {key} already exists")
             self._resource_version += 1
             obj.metadata.resource_version = self._resource_version
+            if not obj.metadata.creation_timestamp:
+                obj.metadata.creation_timestamp = self._now()
             store.objects[key] = obj
             watchers = list(store.watchers)
         for w in watchers:
@@ -120,8 +125,6 @@ class KubeClient:
     def delete(self, obj, *, force: bool = False) -> None:
         """Sets deletion timestamp; the object is removed once finalizers clear
         (or immediately with no finalizers) — k8s deletion semantics."""
-        import time as _time
-
         with self._lock:
             store = self._store(type(obj))
             key = store.key(obj)
@@ -130,7 +133,7 @@ class KubeClient:
                 raise NotFoundError(f"{type(obj).__name__} {key} not found")
             if stored.metadata.finalizers and not force:
                 if stored.metadata.deletion_timestamp is None:
-                    stored.metadata.deletion_timestamp = _time.time()
+                    stored.metadata.deletion_timestamp = self._now()
                     self._resource_version += 1
                     stored.metadata.resource_version = self._resource_version
                     watchers = list(store.watchers)

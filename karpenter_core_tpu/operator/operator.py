@@ -1,0 +1,135 @@
+"""The operator runtime: composition root for the framework.
+
+Mirror of /root/reference/pkg/operator/operator.go:70-177 and
+controllers.go:46-73: builds clients, cluster state, informers, and all
+controllers, then runs them as singleton loops / watch controllers.  The
+consuming binary composes ``Operator(...).with_controllers().start()`` exactly
+as cloud-provider repos compose the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from karpenter_core_tpu.apis.objects import Node, Pod
+from karpenter_core_tpu.apis.v1alpha5 import Provisioner
+from karpenter_core_tpu.cloudprovider import CloudProvider
+from karpenter_core_tpu.controllers.counter import CounterController
+from karpenter_core_tpu.controllers.deprovisioning import DeprovisioningController
+from karpenter_core_tpu.controllers.inflightchecks import InflightChecksController
+from karpenter_core_tpu.controllers.metrics_scrapers import (
+    NodeScraper,
+    PodScraper,
+    ProvisionerScraper,
+)
+from karpenter_core_tpu.controllers.node import NodeController
+from karpenter_core_tpu.controllers.provisioning import PodController, ProvisioningController
+from karpenter_core_tpu.controllers.termination import TerminationController
+from karpenter_core_tpu.events import Recorder
+from karpenter_core_tpu.operator.controller import Singleton, TypedWatchController
+from karpenter_core_tpu.operator.kubeclient import KubeClient
+from karpenter_core_tpu.operator.options import Options
+from karpenter_core_tpu.operator.settings import Settings
+from karpenter_core_tpu.state.cluster import Cluster
+from karpenter_core_tpu.state.informer import start_informers
+from karpenter_core_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class Operator:
+    cloud_provider: CloudProvider
+    options: Options = field(default_factory=Options)
+    settings: Settings = field(default_factory=Settings)
+    clock: Clock = field(default_factory=Clock)
+    kube_client: Optional[KubeClient] = None
+    recorder: Optional[Recorder] = None
+    use_tpu_kernel: bool = False
+
+    def __post_init__(self) -> None:
+        if self.kube_client is None:
+            self.kube_client = KubeClient(self.clock)
+        if self.recorder is None:
+            self.recorder = Recorder(clock=self.clock.now)
+        self.cluster = Cluster(self.clock, self.kube_client, self.cloud_provider, self.settings)
+        self._singletons: List[Singleton] = []
+        self._watchers: List[TypedWatchController] = []
+        self._started = False
+
+    def with_controllers(self) -> "Operator":
+        """Wire the full controller set (controllers.go:46-73)."""
+        kube, cluster, provider = self.kube_client, self.cluster, self.cloud_provider
+        self.provisioning = ProvisioningController(
+            kube, provider, cluster,
+            recorder=self.recorder, settings=self.settings, clock=self.clock,
+            use_tpu_kernel=self.use_tpu_kernel,
+        )
+        self.deprovisioning = DeprovisioningController(
+            self.clock, kube, self.provisioning, provider, self.recorder, cluster, self.settings
+        )
+        self.node_lifecycle = NodeController(self.clock, kube, provider, cluster, self.settings)
+        self.termination = TerminationController(self.clock, kube, provider, self.recorder)
+        self.inflight_checks = InflightChecksController(self.clock, kube, provider, self.recorder)
+        self.counter = CounterController(kube, cluster)
+        self.node_scraper = NodeScraper(cluster)
+        self.pod_scraper = PodScraper(kube)
+        self.provisioner_scraper = ProvisionerScraper(kube)
+
+        self._watchers = [
+            TypedWatchController(
+                "node", Node, kube,
+                reconcile=self.node_lifecycle.reconcile,
+                finalize=self.termination.reconcile,
+            ),
+            TypedWatchController(
+                "provisioning_trigger", Pod, kube,
+                reconcile=PodController(self.provisioning).reconcile,
+            ),
+            TypedWatchController("counter", Provisioner, kube, reconcile=self.counter.reconcile),
+        ]
+        self._singletons = [
+            Singleton("provisioning", lambda: self._provision(), clock=self.clock, default_requeue=0.1),
+            Singleton(
+                "deprovisioning",
+                lambda: self.deprovisioning.reconcile()[1],
+                clock=self.clock,
+                default_requeue=10.0,
+            ),
+            Singleton("metrics_state", self.node_scraper.scrape, clock=self.clock, default_requeue=5.0),
+            Singleton(
+                "inflightchecks",
+                lambda: (self.inflight_checks.reconcile_all(), 60.0)[1],
+                clock=self.clock,
+                default_requeue=60.0,
+            ),
+        ]
+        return self
+
+    def _provision(self) -> float:
+        self.provisioning.reconcile(wait_for_batch=True)
+        return 0.1
+
+    def start(self) -> "Operator":
+        """Start informers, watch controllers, and singleton loops."""
+        start_informers(self.cluster, self.kube_client)
+        for watcher in self._watchers:
+            watcher.start()
+        for singleton in self._singletons:
+            singleton.start()
+        self._started = True
+        log.info("operator started with %d controllers", len(self._singletons) + len(self._watchers))
+        return self
+
+    def stop(self) -> None:
+        for singleton in self._singletons:
+            singleton.stop()
+        for watcher in self._watchers:
+            watcher.stop()
+        self._started = False
+
+    def healthy(self) -> bool:
+        return self._started

@@ -1,0 +1,72 @@
+"""Static options (mirror of /root/reference/pkg/operator/options/options.go:34-87):
+flag/env configuration for the operator process."""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, fields
+from typing import List, Optional
+
+
+@dataclass
+class Options:
+    service_name: str = ""
+    metrics_port: int = 8080  # options.go:59
+    health_probe_port: int = 8081
+    kube_client_qps: int = 200  # options.go:61
+    kube_client_burst: int = 300  # options.go:62
+    enable_profiling: bool = False
+    enable_leader_election: bool = True
+    memory_limit: int = -1  # bytes; GC soft limit at 90% (options.go:67-70)
+    poll_interval: float = 10.0
+
+    @classmethod
+    def parse(cls, argv: Optional[List[str]] = None) -> "Options":
+        parser = argparse.ArgumentParser("karpenter-core-tpu")
+        parser.add_argument("--karpenter-service", default=_env("KARPENTER_SERVICE", ""))
+        parser.add_argument("--metrics-port", type=int, default=int(_env("METRICS_PORT", "8080")))
+        parser.add_argument(
+            "--health-probe-port", type=int, default=int(_env("HEALTH_PROBE_PORT", "8081"))
+        )
+        parser.add_argument(
+            "--kube-client-qps", type=int, default=int(_env("KUBE_CLIENT_QPS", "200"))
+        )
+        parser.add_argument(
+            "--kube-client-burst", type=int, default=int(_env("KUBE_CLIENT_BURST", "300"))
+        )
+        parser.add_argument(
+            "--enable-profiling", action="store_true", default=_env_bool("ENABLE_PROFILING", False)
+        )
+        parser.add_argument(
+            "--leader-elect",
+            action=argparse.BooleanOptionalAction,
+            default=_env_bool("LEADER_ELECT", True),
+        )
+        parser.add_argument(
+            "--memory-limit", type=int, default=int(_env("MEMORY_LIMIT", "-1"))
+        )
+        # argv=None means the process command line (standard argparse contract);
+        # pass [] explicitly for defaults-only parsing
+        args = parser.parse_args(argv)
+        return cls(
+            service_name=args.karpenter_service,
+            metrics_port=args.metrics_port,
+            health_probe_port=args.health_probe_port,
+            kube_client_qps=args.kube_client_qps,
+            kube_client_burst=args.kube_client_burst,
+            enable_profiling=args.enable_profiling,
+            enable_leader_election=args.leader_elect,
+            memory_limit=args.memory_limit,
+        )
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.lower() in ("1", "true", "yes")

@@ -1,0 +1,56 @@
+"""Dynamic settings (mirror of /root/reference/pkg/apis/config/settings/settings.go:33-112).
+
+The reference watches a ``karpenter-global-settings`` ConfigMap; here Settings
+is a plain dataclass validated on construction, swappable at runtime through
+the SettingsStore (operator.settingsstore).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+
+@dataclass
+class Settings:
+    batch_max_duration: float = 10.0  # seconds (settings.go:39)
+    batch_idle_duration: float = 1.0  # seconds (settings.go:40)
+    drift_enabled: bool = False  # featureGates.driftEnabled (settings.go:58)
+
+    def __post_init__(self) -> None:
+        errs = []
+        if self.batch_max_duration <= 0:
+            errs.append("batchMaxDuration cannot be negative or zero")
+        if self.batch_idle_duration <= 0:
+            errs.append("batchIdleDuration cannot be negative or zero")
+        if errs:
+            raise ValueError("validating settings, " + "; ".join(errs))
+
+    @classmethod
+    def from_config_map(cls, data: Dict[str, str]) -> "Settings":
+        """Parse the reference's ConfigMap keys (settings.go:52-66); raises on
+        invalid values, mirroring the parse-or-panic contract."""
+        kwargs = {}
+        if "batchMaxDuration" in data:
+            kwargs["batch_max_duration"] = _parse_duration(data["batchMaxDuration"])
+        if "batchIdleDuration" in data:
+            kwargs["batch_idle_duration"] = _parse_duration(data["batchIdleDuration"])
+        if "featureGates.driftEnabled" in data:
+            kwargs["drift_enabled"] = data["featureGates.driftEnabled"].lower() == "true"
+        return cls(**kwargs)
+
+
+def _parse_duration(value: str) -> float:
+    """Parse Go-style durations ('10s', '1m30s', '500ms')."""
+    import re
+
+    m = re.fullmatch(r"((?P<h>\d+(\.\d+)?)h)?((?P<m>\d+(\.\d+)?)m)?((?P<s>\d+(\.\d+)?)s)?((?P<ms>\d+(\.\d+)?)ms)?", value.strip())
+    if not m or not any(m.groupdict().values()):
+        raise ValueError(f"invalid duration {value!r}")
+    parts = m.groupdict()
+    return (
+        float(parts["h"] or 0) * 3600
+        + float(parts["m"] or 0) * 60
+        + float(parts["s"] or 0)
+        + float(parts["ms"] or 0) / 1000
+    )

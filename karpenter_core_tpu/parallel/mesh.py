@@ -74,9 +74,13 @@ def monte_carlo_solve(
     avail_r = perturb_spot_availability(snapshot, n_replicas, seed, interruption_rate)
     it_price = jnp.asarray(snapshot.it_price)
 
+    # patch the availability plane by field name so a reordering of the
+    # Statics tuple can't silently perturb the wrong tensor
+    avail_idx = solve_ops.Statics._fields.index("it_avail")
+
     def one_replica(avail):
         arrays = list(statics_arrays)
-        arrays[2] = avail  # it_avail
+        arrays[avail_idx] = avail
         out = solve_ops.solve_core(cls, tuple(arrays), n_slots, key_has_bounds)
         scheduled = jnp.sum(out.assign)
         failed = jnp.sum(out.failed)

@@ -1,0 +1,3 @@
+from karpenter_core_tpu.state.cluster import Cluster, StateNode
+
+__all__ = ["Cluster", "StateNode"]

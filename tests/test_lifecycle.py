@@ -1,0 +1,271 @@
+"""Node lifecycle, termination, inflight checks, counter, metrics, operator."""
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    LabelSelector,
+    NodeCondition,
+    ObjectMeta,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodDisruptionBudgetStatus,
+    Taint,
+    Toleration,
+)
+from karpenter_core_tpu.apis.v1alpha5 import Provisioner
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
+
+
+class TestNodeLifecycle:
+    def test_initialization_requires_ready(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = make_node(
+            ready=False,
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+            },
+        )
+        env.kube.create(node)
+        env.node_lifecycle.reconcile(node)
+        assert labels_api.LABEL_NODE_INITIALIZED not in env.kube.get_node(node.name).metadata.labels
+        env.make_node_ready(node)
+        assert env.kube.get_node(node.name).metadata.labels[labels_api.LABEL_NODE_INITIALIZED] == "true"
+
+    def test_initialization_waits_for_startup_taints(self):
+        env = make_environment()
+        env.kube.create(
+            make_provisioner(startup_taints=[Taint("example.com/agent", "", "NoSchedule")])
+        )
+        node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+            },
+            taints=[Taint("example.com/agent", "", "NoSchedule")],
+        )
+        env.kube.create(node)
+        env.node_lifecycle.reconcile(node)
+        assert labels_api.LABEL_NODE_INITIALIZED not in env.kube.get_node(node.name).metadata.labels
+        node.spec.taints = []
+        env.kube.apply(node)
+        env.node_lifecycle.reconcile(node)
+        assert env.kube.get_node(node.name).metadata.labels.get(labels_api.LABEL_NODE_INITIALIZED) == "true"
+
+    def test_initialization_waits_for_extended_resources(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "gpu-vendor-instance-type",
+            },
+            allocatable={"cpu": 4, "memory": "4Gi", "pods": 5},  # gpu resource missing
+        )
+        env.kube.create(node)
+        env.node_lifecycle.reconcile(node)
+        assert labels_api.LABEL_NODE_INITIALIZED not in env.kube.get_node(node.name).metadata.labels
+        node.status.allocatable["fake.com/vendor-a"] = 2.0
+        env.kube.apply(node)
+        env.node_lifecycle.reconcile(node)
+        assert env.kube.get_node(node.name).metadata.labels.get(labels_api.LABEL_NODE_INITIALIZED) == "true"
+
+    def test_finalizer_added(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = make_node(labels={labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                                 labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type"})
+        env.kube.create(node)
+        env.node_lifecycle.reconcile(node)
+        stored = env.kube.get_node(node.name)
+        assert labels_api.TERMINATION_FINALIZER in stored.metadata.finalizers
+        assert any(r.kind == "Provisioner" for r in stored.metadata.owner_references)
+
+
+class TestTermination:
+    def _provisioned_node(self, env):
+        env.kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": "100m"})
+        result = expect_provisioned(env, pod)
+        node = result[pod.uid]
+        assert node is not None
+        return node, pod
+
+    def test_delete_drains_and_removes_node(self):
+        env = make_environment()
+        node, pod = self._provisioned_node(env)
+        env.kube.delete(node)  # finalizer-driven; harness watch runs termination
+        assert env.kube.get_node(node.name) is None
+        assert env.kube.get_pod(pod.namespace, pod.name) is None
+        assert env.provider.delete_calls, "cloud instance deleted"
+
+    def test_do_not_evict_blocks_drain(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(
+            requests={"cpu": "100m"},
+            annotations={labels_api.DO_NOT_EVICT_POD_ANNOTATION_KEY: "true"},
+        )
+        result = expect_provisioned(env, pod)
+        node = result[pod.uid]
+        env.kube.delete(node)
+        # node still present: drain aborts on do-not-evict
+        assert env.kube.get_node(node.name) is not None
+
+    def test_pdb_blocks_eviction(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        env.kube.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="pdb", namespace="default"),
+                spec=PodDisruptionBudgetSpec(selector=LabelSelector(match_labels={"app": "x"})),
+                status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+            )
+        )
+        pod = make_pod(requests={"cpu": "100m"}, labels={"app": "x"})
+        result = expect_provisioned(env, pod)
+        node = result[pod.uid]
+        env.kube.delete(node)
+        assert env.kube.get_node(node.name) is not None
+        assert env.kube.get_pod(pod.namespace, pod.name) is not None
+
+    def test_tolerating_unschedulable_pods_not_evicted(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(
+            requests={"cpu": "100m"},
+            tolerations=[Toleration(key="node.kubernetes.io/unschedulable", operator="Exists", effect="NoSchedule")],
+        )
+        result = expect_provisioned(env, pod)
+        node = result[pod.uid]
+        env.kube.delete(node)
+        # pod tolerates unschedulable: skipped by drain, node deletes anyway
+        assert env.kube.get_node(node.name) is None
+
+
+class TestInflightChecks:
+    def test_failed_init_reported_after_an_hour(self):
+        env = make_environment()
+        env.kube.create(
+            make_provisioner(startup_taints=[Taint("example.com/agent", "", "NoSchedule")])
+        )
+        node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+            },
+            taints=[Taint("example.com/agent", "", "NoSchedule")],
+        )
+        env.kube.create(node)
+        env.clock.step(3601)
+        from karpenter_core_tpu.controllers.inflightchecks import InflightChecksController
+
+        checks = InflightChecksController(env.clock, env.kube, env.provider, env.recorder)
+        checks.reconcile(node)
+        messages = [e.message for e in env.recorder.events if e.reason == "FailedInflightCheck"]
+        assert any("Startup taint" in m for m in messages)
+
+    def test_node_shape_reported(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+                labels_api.LABEL_NODE_INITIALIZED: "true",
+            },
+            capacity={"cpu": 1, "memory": "1Gi", "pods": 5},  # default type has 4 cpu
+        )
+        env.kube.create(node)
+        from karpenter_core_tpu.controllers.inflightchecks import InflightChecksController
+
+        checks = InflightChecksController(env.clock, env.kube, env.provider, env.recorder)
+        checks.reconcile(node)
+        messages = [e.message for e in env.recorder.events if e.reason == "FailedInflightCheck"]
+        assert any("of expected" in m for m in messages)
+
+    def test_issues_deduped(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+                labels_api.LABEL_NODE_INITIALIZED: "true",
+            },
+            capacity={"cpu": 1},
+        )
+        env.kube.create(node)
+        from karpenter_core_tpu.controllers.inflightchecks import InflightChecksController
+
+        checks = InflightChecksController(env.clock, env.kube, env.provider, env.recorder)
+        checks.reconcile(node)
+        first = len([e for e in env.recorder.events if e.reason == "FailedInflightCheck"])
+        env.clock.step(601)
+        checks.reconcile(node)
+        second = len([e for e in env.recorder.events if e.reason == "FailedInflightCheck"])
+        assert second == first  # deduped
+
+
+class TestCounter:
+    def test_provisioner_status_resources(self):
+        from karpenter_core_tpu.controllers.counter import CounterController
+
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": "100m"})
+        expect_provisioned(env, pod)
+        env.make_all_nodes_ready()
+        counter = CounterController(env.kube, env.cluster)
+        counter.reconcile_all()
+        provisioner = env.kube.get(Provisioner, "default")
+        assert provisioner.status.resources.get("cpu", 0) > 0
+
+
+class TestMetrics:
+    def test_node_gauges_scraped(self):
+        from karpenter_core_tpu.controllers.metrics_scrapers import NODE_ALLOCATABLE, NodeScraper
+
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": "100m"})
+        expect_provisioned(env, pod)
+        NodeScraper(env.cluster).scrape()
+        assert NODE_ALLOCATABLE.samples(), "expected node allocatable samples"
+
+    def test_registry_renders(self):
+        from karpenter_core_tpu.metrics import REGISTRY
+
+        text = REGISTRY.render()
+        assert "# TYPE" in text
+
+
+class TestOperator:
+    def test_operator_end_to_end(self):
+        """Full loop on real threads: pod created -> node launched -> bound."""
+        import time
+
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_core_tpu.operator.operator import Operator
+        from karpenter_core_tpu.operator.settings import Settings
+
+        operator = Operator(
+            cloud_provider=FakeCloudProvider(),
+            settings=Settings(batch_idle_duration=0.05, batch_max_duration=0.2),
+        ).with_controllers()
+        operator.start()
+        try:
+            operator.kube_client.create(make_provisioner())
+            pod = make_pod(requests={"cpu": 1})
+            operator.kube_client.create(pod)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if operator.kube_client.list_nodes():
+                    break
+                time.sleep(0.05)
+            nodes = operator.kube_client.list_nodes()
+            assert nodes, "operator should have launched a node"
+            assert operator.healthy()
+        finally:
+            operator.stop()

@@ -1,0 +1,125 @@
+"""Cluster state suite (modeled on /root/reference/pkg/controllers/state/suite_test.go)."""
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+from karpenter_core_tpu.testing.harness import make_environment
+
+
+def owned_node(env, name=None, instance_type="default-instance-type", **kwargs):
+    node = make_node(
+        name=name,
+        labels={
+            labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+            labels_api.LABEL_INSTANCE_TYPE_STABLE: instance_type,
+            **kwargs.pop("labels", {}),
+        },
+        **kwargs,
+    )
+    env.kube.create(node)
+    return node
+
+
+class TestClusterState:
+    def test_node_tracked_on_create(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_node(env)
+        nodes = env.cluster.snapshot_nodes()
+        assert len(nodes) == 1
+        assert nodes[0].node.name == node.name
+
+    def test_pod_binding_updates_usage(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_node(env)
+        pod = make_pod(requests={"cpu": 2}, node_name=node.name, unschedulable=False)
+        env.kube.create(pod)
+        state_node = env.cluster.snapshot_nodes()[0]
+        assert state_node.pod_requests_total()["cpu"] == 2
+        assert state_node.available()["cpu"] == state_node.allocatable()["cpu"] - 2
+
+    def test_pod_deletion_releases_usage(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_node(env)
+        pod = make_pod(requests={"cpu": 2}, node_name=node.name, unschedulable=False)
+        env.kube.create(pod)
+        env.kube.delete(pod, force=True)
+        state_node = env.cluster.snapshot_nodes()[0]
+        assert state_node.pod_requests_total().get("cpu", 0) == 0
+
+    def test_inflight_capacity_from_instance_type(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        # node registers with zero capacity (kubelet not up yet)
+        node = owned_node(env, allocatable={}, capacity={})
+        state_node = env.cluster.snapshot_nodes()[0]
+        # capacity stands in from the instance type until initialized
+        assert state_node.allocatable()["cpu"] > 0
+
+    def test_node_deletion_untracked(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_node(env)
+        env.kube.delete(node, force=True)
+        assert env.cluster.snapshot_nodes() == []
+
+    def test_anti_affinity_pod_index(self):
+        from karpenter_core_tpu.apis.objects import LabelSelector, PodAffinityTerm
+
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_node(env)
+        pod = make_pod(
+            labels={"app": "a"},
+            node_name=node.name,
+            unschedulable=False,
+            pod_anti_affinity=[
+                PodAffinityTerm(
+                    topology_key=labels_api.LABEL_HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"app": "a"}),
+                )
+            ],
+        )
+        env.kube.create(pod)
+        visited = []
+        env.cluster.for_pods_with_anti_affinity(lambda p, n: visited.append((p.name, n.name)) or True)
+        assert visited == [(pod.name, node.name)]
+
+    def test_consolidation_state_changes_on_events(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        state0 = env.cluster.cluster_consolidation_state()
+        env.clock.step(1)
+        owned_node(env)
+        assert env.cluster.cluster_consolidation_state() != state0
+
+    def test_consolidation_state_forced_refresh(self):
+        env = make_environment()
+        state0 = env.cluster.cluster_consolidation_state()
+        env.clock.step(301)  # 5-minute forced refresh
+        assert env.cluster.cluster_consolidation_state() != state0
+
+    def test_nomination_expires(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_node(env)
+        env.cluster.nominate_node_for_pod(node.name)
+        assert env.cluster.is_node_nominated(node.name)
+        env.clock.step(21)
+        assert not env.cluster.is_node_nominated(node.name)
+
+    def test_startup_taints_filtered_until_initialized(self):
+        from karpenter_core_tpu.apis.objects import Taint
+
+        env = make_environment()
+        env.kube.create(
+            make_provisioner(startup_taints=[Taint("example.com/startup", "", "NoSchedule")])
+        )
+        node = owned_node(env, taints=[Taint("example.com/startup", "", "NoSchedule")])
+        state_node = env.cluster.snapshot_nodes()[0]
+        assert state_node.taints() == []  # startup taint hidden while uninitialized
+        node.metadata.labels[labels_api.LABEL_NODE_INITIALIZED] = "true"
+        env.kube.apply(node)
+        state_node = env.cluster.snapshot_nodes()[0]
+        assert len(state_node.taints()) == 1
