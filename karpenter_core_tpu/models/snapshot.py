@@ -268,9 +268,12 @@ def encode_snapshot(
     provisioners: List[Provisioner],
     templates: List[MachineTemplate],
     instance_types: Dict[str, List[InstanceType]],
+    extra_requirement_sets: Optional[List[Requirements]] = None,
 ) -> EncodedSnapshot:
     """Encode a solve input.  ``templates`` must be weight-ordered (the order
-    is the kernel's template preference order, scheduler.go:174-219)."""
+    is the kernel's template preference order, scheduler.go:174-219).
+    ``extra_requirement_sets`` widen the vocabulary (e.g. existing-node label
+    values, which must be representable for NotIn semantics to stay exact)."""
     classes = classify_pods(pods)
 
     # -- axes -----------------------------------------------------------------
@@ -308,6 +311,7 @@ def encode_snapshot(
     req_sets = [cls.requirements for cls in classes]
     req_sets += [it.requirements for it in all_its]
     req_sets += [tmpl.requirements for tmpl in templates]
+    req_sets += list(extra_requirement_sets or [])
     vocab = Vocabulary.build(req_sets)
 
     snap = EncodedSnapshot(
@@ -380,10 +384,18 @@ def encode_snapshot(
 
     # -- pod classes ----------------------------------------------------------
     C = len(classes)
-    cls_planes = [vocab.encode_requirements(c.requirements) for c in classes]
-    snap.cls_mask, snap.cls_defined, snap.cls_negative, snap.cls_gt, snap.cls_lt = (
-        np.stack([p[j] for p in cls_planes]) for j in range(5)
-    )
+    if C == 0:
+        K, W = vocab.n_keys, vocab.width
+        snap.cls_mask = np.zeros((0, K, W), dtype=bool)
+        snap.cls_defined = np.zeros((0, K), dtype=bool)
+        snap.cls_negative = np.zeros((0, K), dtype=bool)
+        snap.cls_gt = np.zeros((0, K), dtype=np.float32)
+        snap.cls_lt = np.zeros((0, K), dtype=np.float32)
+    else:
+        cls_planes = [vocab.encode_requirements(c.requirements) for c in classes]
+        snap.cls_mask, snap.cls_defined, snap.cls_negative, snap.cls_gt, snap.cls_lt = (
+            np.stack([p[j] for p in cls_planes]) for j in range(5)
+        )
     snap.cls_zone = np.zeros((C, Z), dtype=bool)
     snap.cls_ct = np.zeros((C, CT), dtype=bool)
     snap.cls_it = np.zeros((C, I), dtype=bool)

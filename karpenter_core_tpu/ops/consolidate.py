@@ -1,0 +1,123 @@
+"""Consolidation search as a parallel subset sweep on TPU.
+
+The reference's multi-node consolidation binary-searches the first-N prefix of
+disruption-sorted candidates, one full scheduling simulation per probe
+(multinodeconsolidation.go:74-114).  Here every prefix size is evaluated
+simultaneously: the simulation (a solve with the subset's nodes closed and
+their pods re-injected) is vmapped over the prefix axis, so one device pass
+answers "what is the largest set of nodes we can delete/replace" — and, unlike
+binary search, it does not assume monotonic feasibility.  This is the
+pmap-over-candidate-subsets search of BASELINE.json config 3.
+
+The host wrapper (solver.consolidation) applies the price/spot validity rules
+to each lane's decoded replacement and picks the largest valid prefix.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from karpenter_core_tpu.ops import solve as solve_ops
+
+
+class SweepOutputs(NamedTuple):
+    """Per-lane (prefix size) results; leading dim S."""
+
+    n_new: jnp.ndarray  # i32[S] new nodes the simulation opened
+    failed: jnp.ndarray  # i32[S] pods that failed to schedule
+    used_uninitialized: jnp.ndarray  # bool[S] relied on an uninitialized node
+    new_viable: jnp.ndarray  # bool[S, M, I] replacement instance viability
+    new_zone: jnp.ndarray  # bool[S, M, Z]
+    new_ct: jnp.ndarray  # bool[S, M, CT]
+    new_used: jnp.ndarray  # f32[S, M, R]
+    new_tmpl: jnp.ndarray  # i32[S, M]
+
+
+def sweep(
+    class_tensors,
+    statics_arrays,
+    key_has_bounds,
+    ex_state: solve_ops.ExistingState,
+    ex_static: solve_ops.ExistingStatic,
+    candidate_rank: jnp.ndarray,  # i32[E]: position in disruption order, big=not candidate
+    ex_cls_count: jnp.ndarray,  # i32[C, E]: candidate pods per class per node
+    prefix_sizes: jnp.ndarray,  # i32[S]
+    n_slots: int = 16,
+) -> SweepOutputs:
+    """Simulate closing the first-k candidates for every k in prefix_sizes."""
+
+    ex_zone = ex_state.zone  # [E, Z] (candidates have concrete zones)
+
+    def one_prefix(k):
+        subset = candidate_rank < k  # bool[E]
+        # close the subset's nodes
+        ex = ex_state._replace(open_=ex_state.open_ & ~subset)
+        # displaced pods join their classes
+        displaced = jnp.sum(
+            ex_cls_count * subset[None, :].astype(jnp.int32), axis=-1
+        )  # [C]
+        # pre-existing matching pods on removed nodes no longer count for
+        # topology (they are being rescheduled - excludedPods semantics)
+        removed_zone_counts = jnp.einsum(
+            "ce,ez->cz",
+            (ex_static.host_count0 * subset[None, :]).astype(jnp.float32),
+            ex_zone.astype(jnp.float32),
+        ).astype(jnp.int32)
+        cls = class_tensors._replace(
+            count=class_tensors.count + displaced,
+            zone_count0=jnp.maximum(class_tensors.zone_count0 - removed_zone_counts, 0),
+        )
+        out = solve_ops.solve_core(
+            cls, statics_arrays, n_slots, key_has_bounds, ex, ex_static
+        )
+        n_new = out.state.n_next
+        failed = jnp.sum(out.failed)
+        uninit = jnp.any(
+            (out.assign_existing > 0) & ~ex_static.init[None, :]
+        )
+        return (
+            n_new,
+            failed,
+            uninit,
+            out.state.viable,
+            out.state.zone,
+            out.state.ct,
+            out.state.used,
+            out.state.tmpl_id,
+        )
+
+    results = jax.vmap(one_prefix)(prefix_sizes)
+    return SweepOutputs(*results)
+
+
+_sweep_jit = functools.partial(
+    jax.jit, static_argnames=("key_has_bounds", "n_slots")
+)(sweep)
+
+
+def run_sweep(
+    snapshot,
+    ex_state,
+    ex_static,
+    candidate_rank: np.ndarray,
+    ex_cls_count: np.ndarray,
+    prefix_sizes: np.ndarray,
+    n_slots: int = 16,
+) -> SweepOutputs:
+    cls, statics_arrays, key_has_bounds = solve_ops.prepare(snapshot)
+    return _sweep_jit(
+        cls,
+        statics_arrays,
+        key_has_bounds,
+        ex_state,
+        ex_static,
+        jnp.asarray(candidate_rank),
+        jnp.asarray(ex_cls_count),
+        jnp.asarray(prefix_sizes),
+        n_slots=n_slots,
+    )

@@ -26,7 +26,7 @@ data-dependent Python control flow.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +39,7 @@ BIG = jnp.float32(1e30)
 
 
 class NodeState(NamedTuple):
-    """Per-node-slot solver state (all leading dim N)."""
+    """Per-new-node-slot solver state (all leading dim N)."""
 
     used: jnp.ndarray  # f32[N, R] accumulated requests incl. daemon overhead
     kmask: jnp.ndarray  # bool[N, K, V+1]
@@ -56,10 +56,41 @@ class NodeState(NamedTuple):
     n_next: jnp.ndarray  # i32[] next free slot
 
 
+class ExistingState(NamedTuple):
+    """Per-existing-node solver state (leading dim E).
+
+    Existing (in-flight/real) nodes have fixed capacity and no instance-type
+    viability plane — that keeps consolidation sweeps over thousands of nodes
+    memory-light (ExistingNode.Add semantics, existingnode.go:77-130).
+    """
+
+    used: jnp.ndarray  # f32[E, R] accumulated (starts at remaining daemon overhead)
+    kmask: jnp.ndarray  # bool[E, K, V+1]
+    kdef: jnp.ndarray  # bool[E, K]
+    kneg: jnp.ndarray  # bool[E, K]
+    kgt: jnp.ndarray  # f32[E, K]
+    klt: jnp.ndarray  # f32[E, K]
+    zone: jnp.ndarray  # bool[E, Z]
+    ct: jnp.ndarray  # bool[E, CT]
+    pod_count: jnp.ndarray  # i32[E] pods added THIS solve
+    open_: jnp.ndarray  # bool[E]
+
+
+class ExistingStatic(NamedTuple):
+    """Trace-time constants for existing nodes."""
+
+    alloc: jnp.ndarray  # f32[E, R] available() at snapshot time
+    init: jnp.ndarray  # bool[E] karpenter.sh/initialized
+    tol: jnp.ndarray  # bool[C, E] class tolerates node taints
+    host_count0: jnp.ndarray  # i32[C, E] selector-matching pods already on node
+
+
 class SolveOutputs(NamedTuple):
-    assign: jnp.ndarray  # i32[C, N] pods of class c on node n
+    assign: jnp.ndarray  # i32[C, N] pods of class c on NEW node n
+    assign_existing: jnp.ndarray  # i32[C, E] pods of class c on existing node e
     failed: jnp.ndarray  # i32[C]
     state: NodeState
+    ex_state: ExistingState
 
 
 def _water_fill(count0: jnp.ndarray, allowed: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
@@ -235,6 +266,72 @@ class ClassTensors(NamedTuple):
     zone_count0: jnp.ndarray
 
 
+def _phase_existing(
+    ex: ExistingState,
+    ex_static: ExistingStatic,
+    cls: ClassTensors,
+    statics: Statics,
+    quota: jnp.ndarray,
+    zone_restrict: jnp.ndarray,
+    collapse_zone: bool,
+    host_count0_row: jnp.ndarray,
+    tol_row: jnp.ndarray,
+) -> Tuple[ExistingState, jnp.ndarray, jnp.ndarray]:
+    """Place up to ``quota`` pods of the class onto existing nodes, in index
+    order (the reference iterates existing nodes first, in order, and takes the
+    first that accepts — scheduler.go:176-180)."""
+    n_ex = ex.used.shape[0]
+
+    node_t = mask_ops.ReqTensor(ex.kmask, ex.kdef, ex.kneg, ex.kgt, ex.klt)
+    cls_t = mask_ops.ReqTensor(
+        cls.mask[None], cls.defined[None], cls.negative[None], cls.gt[None], cls.lt[None]
+    )
+    key_ok = mask_ops.compatible(node_t, cls_t, statics.is_custom, statics.vocab_ints)
+    merged = mask_ops.add(node_t, cls_t, statics.valid, statics.vocab_ints)
+    zone_ok = ex.zone & zone_restrict[None, :] & cls.zone[None, :]
+    ct_ok = ex.ct & cls.ct[None, :]
+
+    # fixed-capacity fit: min over resources of floor((available - used)/size)
+    n_res = ex_static.alloc.shape[-1]
+    cap = None
+    for r in range(n_res):
+        free = ex_static.alloc[:, r] - ex.used[:, r]
+        per = jnp.where(
+            cls.requests[r] > 0,
+            jnp.floor((free + 1e-4) / jnp.maximum(cls.requests[r], 1e-9)),
+            BIG,
+        )
+        per = jnp.maximum(per, 0.0)
+        cap = per if cap is None else jnp.minimum(cap, per)
+    cap = jnp.minimum(cap, BIG).astype(jnp.int32)
+
+    elig = ex.open_ & key_ok & tol_row & jnp.any(zone_ok, axis=-1) & jnp.any(ct_ok, axis=-1)
+    host_cap = jnp.maximum(cls.host_cap - host_count0_row, 0)
+    cap = jnp.where(elig, jnp.minimum(cap, host_cap), 0)
+
+    priority = jnp.where(cap > 0, jnp.arange(n_ex, dtype=jnp.int32), jnp.iinfo(jnp.int32).max)
+    assigned = _fill_by_priority(quota, cap, priority)
+    placed = jnp.sum(assigned)
+
+    took = assigned > 0
+    sel = took[:, None]
+    new_ex = ExistingState(
+        used=ex.used + assigned[:, None].astype(jnp.float32) * cls.requests[None, :],
+        kmask=jnp.where(sel[..., None], merged.mask, ex.kmask),
+        kdef=jnp.where(sel, merged.defined, ex.kdef),
+        kneg=jnp.where(sel, merged.negative, ex.kneg),
+        kgt=jnp.where(sel, merged.gt, ex.kgt),
+        klt=jnp.where(sel, merged.lt, ex.klt),
+        zone=jnp.where(sel, zone_ok, ex.zone) if collapse_zone else jnp.where(
+            sel, ex.zone & cls.zone[None, :], ex.zone
+        ),
+        ct=jnp.where(sel, ct_ok, ex.ct),
+        pod_count=ex.pod_count + assigned,
+        open_=ex.open_,
+    )
+    return new_ex, assigned, placed
+
+
 def _phase(
     state: NodeState,
     cls: ClassTensors,
@@ -365,49 +462,83 @@ def _phase(
     return new_state, assigned + a_new, placed_existing + placed_new
 
 
-def _class_step(statics: Statics, n_zones: int, state: NodeState, cls: ClassTensors):
-    """One scan step: schedule every pod of one class."""
+def _class_step(
+    statics: Statics,
+    ex_static: ExistingStatic,
+    n_zones: int,
+    carry,
+    cls_with_index,
+):
+    """One scan step: schedule every pod of one class — existing nodes first,
+    then new nodes, per phase."""
+    state, ex = carry
+    cls, cls_index = cls_with_index
     m = cls.count
     spread = cls.zone_skew < UNLIMITED
     anti = cls.zone_cap < UNLIMITED
 
+    host_count0_row = ex_static.host_count0[cls_index]  # [E]
+    tol_row = ex_static.tol[cls_index]  # [E]
+
     quotas = _water_fill(cls.zone_count0, cls.zone, m)
     assigned_total = jnp.zeros_like(state.pod_count)
+    assigned_ex_total = jnp.zeros_like(ex.pod_count)
     placed_total = jnp.int32(0)
+
+    def run_phase(state, ex, quota, restrict, collapse):
+        ex, a_ex, placed_ex = _phase_existing(
+            ex, ex_static, cls, statics, quota, restrict, collapse,
+            host_count0_row, tol_row,
+        )
+        state, a_new, placed_new = _phase(
+            state, cls, statics, quota - placed_ex, restrict, collapse_zone=collapse
+        )
+        return state, ex, a_new, a_ex, placed_ex + placed_new
 
     # zone-constrained phases (spread classes commit one zone per phase)
     for z in range(n_zones):
         restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
         q = jnp.where(spread, quotas[z], 0)
-        state, assigned, placed = _phase(state, cls, statics, q, restrict, collapse_zone=True)
+        state, ex, assigned, assigned_ex, placed = run_phase(state, ex, q, restrict, True)
         assigned_total = assigned_total + assigned
+        assigned_ex_total = assigned_ex_total + assigned_ex
         placed_total = placed_total + placed
 
     # anti-affinity phase: one pod, restricted to zero-count allowed zones
     zero_zones = cls.zone & (cls.zone_count0 == 0)
     anti_quota = jnp.where(anti & jnp.any(zero_zones), jnp.minimum(m, 1), 0)
-    state, assigned, placed = _phase(
-        state, cls, statics, anti_quota, zero_zones, collapse_zone=True
+    state, ex, assigned, assigned_ex, placed = run_phase(
+        state, ex, anti_quota, zero_zones, True
     )
     assigned_total = assigned_total + assigned
+    assigned_ex_total = assigned_ex_total + assigned_ex
     placed_total = placed_total + placed
 
     # unconstrained phase for plain classes
     any_quota = jnp.where(spread | anti, 0, m)
     all_zones = jnp.ones(n_zones, dtype=bool)
-    state, assigned, placed = _phase(
-        state, cls, statics, any_quota, all_zones, collapse_zone=False
+    state, ex, assigned, assigned_ex, placed = run_phase(
+        state, ex, any_quota, all_zones, False
     )
     assigned_total = assigned_total + assigned
+    assigned_ex_total = assigned_ex_total + assigned_ex
     placed_total = placed_total + placed
 
     failed = m - placed_total
-    return state, (assigned_total, failed)
+    return (state, ex), (assigned_total, assigned_ex_total, failed)
 
 
-def solve_core(class_tensors, statics_arrays, n_slots: int, key_has_bounds):
+def solve_core(
+    class_tensors,
+    statics_arrays,
+    n_slots: int,
+    key_has_bounds,
+    existing_state: "Optional[ExistingState]" = None,
+    existing_static: "Optional[ExistingStatic]" = None,
+):
     """Unjitted kernel core — jit/vmap/shard_map-composable (the parallel layer
-    vmaps this over snapshot replicas; __graft_entry__ compile-checks it)."""
+    vmaps this over snapshot replicas and consolidation subsets;
+    __graft_entry__ compile-checks it)."""
     statics = Statics(*statics_arrays, key_has_bounds=key_has_bounds)
     n_zones = statics.tmpl_zone.shape[-1]
     n_res = statics.it_alloc.shape[-1]
@@ -415,6 +546,7 @@ def solve_core(class_tensors, statics_arrays, n_slots: int, key_has_bounds):
     width = statics.valid.shape[1]
     n_it = statics.it_alloc.shape[0]
     n_ct = statics.tmpl_ct.shape[-1]
+    n_classes = class_tensors.count.shape[0]
 
     state = NodeState(
         used=jnp.zeros((n_slots, n_res), dtype=jnp.float32),
@@ -431,12 +563,49 @@ def solve_core(class_tensors, statics_arrays, n_slots: int, key_has_bounds):
         open_=jnp.zeros(n_slots, dtype=bool),
         n_next=jnp.int32(0),
     )
+    if existing_state is None:
+        existing_state = empty_existing_state(n_res, n_keys, width, n_zones, n_ct)
+        existing_static = empty_existing_static(n_res, n_classes)
 
-    def step(carry, cls):
-        return _class_step(statics, n_zones, carry, cls)
+    def step(carry, cls_with_index):
+        return _class_step(statics, existing_static, n_zones, carry, cls_with_index)
 
-    final_state, (assign, failed) = jax.lax.scan(step, state, class_tensors)
-    return SolveOutputs(assign=assign, failed=failed, state=final_state)
+    cls_indices = jnp.arange(n_classes, dtype=jnp.int32)
+    (final_state, final_ex), (assign, assign_ex, failed) = jax.lax.scan(
+        step, (state, existing_state), (class_tensors, cls_indices)
+    )
+    return SolveOutputs(
+        assign=assign,
+        assign_existing=assign_ex,
+        failed=failed,
+        state=final_state,
+        ex_state=final_ex,
+    )
+
+
+def empty_existing_state(n_res, n_keys, width, n_zones, n_ct) -> ExistingState:
+    """A single closed dummy slot (E=0 shapes upset some XLA reductions)."""
+    return ExistingState(
+        used=jnp.zeros((1, n_res), dtype=jnp.float32),
+        kmask=jnp.ones((1, n_keys, width), dtype=bool),
+        kdef=jnp.zeros((1, n_keys), dtype=bool),
+        kneg=jnp.zeros((1, n_keys), dtype=bool),
+        kgt=jnp.full((1, n_keys), -jnp.inf, dtype=jnp.float32),
+        klt=jnp.full((1, n_keys), jnp.inf, dtype=jnp.float32),
+        zone=jnp.ones((1, n_zones), dtype=bool),
+        ct=jnp.ones((1, n_ct), dtype=bool),
+        pod_count=jnp.zeros(1, dtype=jnp.int32),
+        open_=jnp.zeros(1, dtype=bool),
+    )
+
+
+def empty_existing_static(n_res, n_classes) -> ExistingStatic:
+    return ExistingStatic(
+        alloc=jnp.zeros((1, n_res), dtype=jnp.float32),
+        init=jnp.zeros(1, dtype=bool),
+        tol=jnp.zeros((n_classes, 1), dtype=bool),
+        host_count0=jnp.zeros((n_classes, 1), dtype=jnp.int32),
+    )
 
 
 _solve_jit = functools.partial(jax.jit, static_argnames=("n_slots", "key_has_bounds"))(

@@ -1,0 +1,211 @@
+"""TPU-accelerated consolidation search.
+
+Couples the kernel subset sweep (ops.consolidate) with the reference's
+validity rules (consolidation.go:190-290): every prefix of the disruption-
+sorted candidate list is simulated in parallel on device; the host then
+applies price filtering, the spot→spot prohibition, and the same-type price
+sanity filter to each lane's decoded replacement, and picks the largest valid
+prefix — the result the binary search converges to, computed in one pass.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import OP_IN, Pod
+from karpenter_core_tpu.cloudprovider import InstanceType
+from karpenter_core_tpu.controllers.deprovisioning import (
+    Action,
+    CandidateNode,
+    Command,
+    filter_by_price,
+    MultiNodeConsolidation,
+)
+from karpenter_core_tpu.models.snapshot import KernelUnsupported
+from karpenter_core_tpu.ops import consolidate as consolidate_ops
+from karpenter_core_tpu.ops import solve as solve_ops
+from karpenter_core_tpu.scheduling import Requirement, Requirements
+from karpenter_core_tpu.solver.tpu import TPUSolver
+
+MAX_LANES = 64
+
+
+@dataclass
+class TPUReplacement:
+    """Launchable replacement description compatible with
+    ProvisioningController.launch (duck-typed like solver.node.SchedulingNode)."""
+
+    template: object
+    instance_type_options: List[InstanceType]
+    requests: dict
+    pods: List[Pod] = field(default_factory=list)
+
+    @property
+    def provisioner_name(self) -> str:
+        return self.template.provisioner_name
+
+    @property
+    def requirements(self) -> Requirements:
+        return self.template.requirements
+
+
+class TPUConsolidationSearch:
+    def __init__(self, cloud_provider, provisioners) -> None:
+        self.solver = TPUSolver(cloud_provider, provisioners)
+        self.it_by_name = {
+            it.name: it
+            for p in self.solver.provisioners
+            for it in self.solver.instance_types.get(p.name, [])
+        }
+
+    def compute_command(
+        self,
+        candidates: List[CandidateNode],
+        pending_pods: List[Pod],
+        state_nodes: list,
+        bound_pods: Optional[List[Pod]] = None,
+    ) -> Command:
+        """candidates must be disruption-cost sorted.  Raises KernelUnsupported
+        when the pod shapes need the host path."""
+        if not candidates:
+            return Command(Action.DO_NOTHING)
+
+        candidate_pods = [p for c in candidates for p in c.pods]
+        all_pods = list(pending_pods) + candidate_pods
+        if not all_pods:
+            # no pods anywhere: every candidate is empty, deleting all is
+            # trivially valid (the simulation would open zero new nodes)
+            return Command(Action.DELETE, [c.node for c in candidates])
+        snapshot = self.solver.encode(all_pods, state_nodes)
+        ex_state, ex_static = self.solver.encode_existing(
+            snapshot, state_nodes, bound_pods
+        )
+
+        # split class counts: pending (base) vs on-candidate (per-node)
+        node_index = {n.node.name: e for e, n in enumerate(state_nodes)}
+        candidate_names = {c.node.name for c in candidates}
+        E = max(len(state_nodes), 1)
+        C = len(snapshot.classes)
+        ex_cls_count = np.zeros((C, E), dtype=np.int32)
+        base_counts = np.zeros(C, dtype=np.int32)
+        for c, cls in enumerate(snapshot.classes):
+            for pod in cls.pods:
+                if pod.spec.node_name and pod.spec.node_name in candidate_names:
+                    ex_cls_count[c, node_index[pod.spec.node_name]] += 1
+                else:
+                    base_counts[c] += 1
+        snapshot.cls_count = base_counts
+
+        rank = np.full(E, 1 << 30, dtype=np.int32)
+        for i, candidate in enumerate(candidates):
+            rank[node_index[candidate.node.name]] = i
+
+        n = len(candidates)
+        if n <= MAX_LANES:
+            sizes = np.arange(1, n + 1, dtype=np.int32)
+        else:
+            sizes = np.unique(
+                np.round(np.linspace(1, n, MAX_LANES)).astype(np.int32)
+            )
+        out = consolidate_ops.run_sweep(
+            snapshot, ex_state, ex_static, rank, ex_cls_count, sizes
+        )
+
+        n_new = np.asarray(out.n_new)
+        failed = np.asarray(out.failed)
+        uninit = np.asarray(out.used_uninitialized)
+        viable = np.asarray(out.new_viable)
+        zone = np.asarray(out.new_zone)
+        ct = np.asarray(out.new_ct)
+        used = np.asarray(out.new_used)
+        tmpl_id = np.asarray(out.new_tmpl)
+
+        best: Optional[Command] = None
+        for lane, k in enumerate(sizes.tolist()):
+            if failed[lane] > 0 or uninit[lane]:
+                continue
+            subset = candidates[:k]
+            if int(n_new[lane]) == 0:
+                best = Command(Action.DELETE, [c.node for c in subset])
+                continue
+            if int(n_new[lane]) != 1:
+                continue
+            replacement = self._decode_replacement(
+                snapshot, viable[lane, 0], zone[lane, 0], ct[lane, 0],
+                used[lane, 0], int(tmpl_id[lane, 0]), subset,
+            )
+            if replacement is None:
+                continue
+            best = Command(
+                Action.REPLACE, [c.node for c in subset], [replacement]
+            )
+        return best if best is not None else Command(Action.DO_NOTHING)
+
+    def _decode_replacement(
+        self, snapshot, viable_row, zone_row, ct_row, used_row, tmpl_idx, subset
+    ) -> Optional[TPUReplacement]:
+        options = [
+            self.it_by_name[snapshot.it_names[i]]
+            for i in np.nonzero(viable_row)[0]
+            if snapshot.it_names[i] in self.it_by_name
+        ]
+        zones = [snapshot.zones[z] for z in np.nonzero(zone_row)[0]]
+        cts = [snapshot.capacity_types[c] for c in np.nonzero(ct_row)[0]]
+        template = self.solver.templates[tmpl_idx]
+
+        requirements = Requirements(*template.requirements.values())
+        if zones:
+            requirements.add(Requirement(labels_api.LABEL_TOPOLOGY_ZONE, OP_IN, zones))
+        if cts:
+            requirements.add(Requirement(labels_api.LABEL_CAPACITY_TYPE, OP_IN, cts))
+
+        # price rules (consolidation.go:227-267)
+        old_price = 0.0
+        for c in subset:
+            offering = c.instance_type.offerings.get(c.capacity_type, c.zone)
+            if offering is None:
+                return None
+            old_price += offering.price
+        options = filter_by_price(options, requirements, old_price)
+        if not options:
+            return None
+        all_spot = all(
+            c.capacity_type == labels_api.CAPACITY_TYPE_SPOT for c in subset
+        )
+        ct_req = requirements.get(labels_api.LABEL_CAPACITY_TYPE)
+        if all_spot and ct_req.has(labels_api.CAPACITY_TYPE_SPOT):
+            return None
+        if ct_req.has(labels_api.CAPACITY_TYPE_SPOT) and ct_req.has(
+            labels_api.CAPACITY_TYPE_ON_DEMAND
+        ):
+            requirements.add(
+                Requirement(
+                    labels_api.LABEL_CAPACITY_TYPE, OP_IN, [labels_api.CAPACITY_TYPE_SPOT]
+                )
+            )
+        # same-type price sanity for multi-node (multinodeconsolidation.go:132-165)
+        from dataclasses import replace as dc_replace
+
+        out_template = dc_replace(template, requirements=requirements)
+        requests = {
+            name: float(used_row[r])
+            for r, name in enumerate(snapshot.resources)
+            if used_row[r] > 0
+        }
+        replacement = TPUReplacement(
+            template=out_template,
+            instance_type_options=options,
+            requests=requests,
+            pods=[p for c in subset for p in c.pods],
+        )
+        if len(subset) >= 2:
+            replacement.instance_type_options = MultiNodeConsolidation.filter_out_same_type(
+                replacement, subset
+            )
+            if not replacement.instance_type_options:
+                return None
+        return replacement

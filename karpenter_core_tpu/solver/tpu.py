@@ -66,8 +66,25 @@ class TPUNodeDecision:
 @dataclass
 class TPUSolveResults:
     new_nodes: List[TPUNodeDecision] = field(default_factory=list)
+    # existing-node placements: node name -> pods nominated onto it
+    existing_assignments: Dict[str, List[Pod]] = field(default_factory=dict)
     failed_pods: List[Pod] = field(default_factory=list)
     n_slots_used: int = 0
+
+
+def _class_selectors(cls):
+    """Label selectors of a class's spread/anti-affinity constraints (used to
+    count pre-existing matching pods, topology.go:231-276)."""
+    example = cls.pods[0]
+    selectors = []
+    for constraint in example.spec.topology_spread_constraints:
+        if constraint.label_selector is not None:
+            selectors.append(constraint.label_selector)
+    if example.spec.affinity is not None and example.spec.affinity.pod_anti_affinity is not None:
+        for term in example.spec.affinity.pod_anti_affinity.required:
+            if term.label_selector is not None:
+                selectors.append(term.label_selector)
+    return selectors
 
 
 class TPUSolver:
@@ -88,24 +105,171 @@ class TPUSolver:
         for template in self.templates:
             template.requests = overhead[id(template)]
 
-    def encode(self, pods: List[Pod]) -> EncodedSnapshot:
+    def encode(self, pods: List[Pod], state_nodes: Optional[list] = None) -> EncodedSnapshot:
         """Raises models.snapshot.KernelUnsupported when the batch needs the
-        host path."""
-        return encode_snapshot(pods, self.provisioners, self.templates, self.instance_types)
+        host path.  Existing-node label values widen the vocabulary so NotIn
+        checks against them stay exact."""
+        extra = [
+            Requirements.from_labels(n.node.metadata.labels) for n in (state_nodes or [])
+        ]
+        return encode_snapshot(
+            pods, self.provisioners, self.templates, self.instance_types,
+            extra_requirement_sets=extra,
+        )
 
-    def solve(self, pods: List[Pod], n_slots: int = 0) -> TPUSolveResults:
-        snapshot = self.encode(pods)
-        outputs = solve_ops.solve(snapshot, n_slots=n_slots)
+    def encode_existing(
+        self,
+        snapshot: EncodedSnapshot,
+        state_nodes: list,
+        bound_pods: Optional[List[Pod]] = None,
+    ):
+        """(ExistingState, ExistingStatic) numpy planes for the kernel, plus
+        selector-matching counts folded into the snapshot's zone_count0.
+
+        Mirrors ExistingNode construction (existingnode.go:43-75): available
+        capacity, remaining daemonset overhead, label requirements, ephemeral-
+        taint-filtered toleration checks; and topology countDomains
+        (topology.go:231-276) for pre-existing matching pods.
+        """
+        import jax.numpy as jnp
+
+        from karpenter_core_tpu.apis import labels as labels_api
+        from karpenter_core_tpu.scheduling import Taints
+
+        vocab = snapshot.vocab
+        E = max(len(state_nodes), 1)
+        C = len(snapshot.classes)
+        R = len(snapshot.resources)
+        Z = len(snapshot.zones)
+        CT = len(snapshot.capacity_types)
+        K, W = vocab.n_keys, vocab.width
+
+        used = np.zeros((E, R), dtype=np.float32)
+        alloc = np.zeros((E, R), dtype=np.float32)
+        kmask = np.ones((E, K, W), dtype=bool)
+        kdef = np.zeros((E, K), dtype=bool)
+        kneg = np.zeros((E, K), dtype=bool)
+        kgt = np.full((E, K), -np.inf, dtype=np.float32)
+        klt = np.full((E, K), np.inf, dtype=np.float32)
+        zone = np.zeros((E, Z), dtype=bool)
+        ct = np.zeros((E, CT), dtype=bool)
+        pod_count = np.zeros(E, dtype=np.int32)
+        open_ = np.zeros(E, dtype=bool)
+        init = np.zeros(E, dtype=bool)
+        tol = np.zeros((C, E), dtype=bool)
+        host_count0 = np.zeros((C, E), dtype=np.int32)
+
+        tmpl_by_name = {t.provisioner_name: t for t in self.templates}
+        zone_idx = {z: i for i, z in enumerate(snapshot.zones)}
+        ct_idx = {c: i for i, c in enumerate(snapshot.capacity_types)}
+        node_zone: dict = {}
+
+        for e, state_node in enumerate(state_nodes):
+            node = state_node.node
+            available = state_node.available()
+            for r, name in enumerate(snapshot.resources):
+                alloc[e, r] = available.get(name, 0.0)
+            template = tmpl_by_name.get(
+                node.metadata.labels.get(labels_api.PROVISIONER_NAME_LABEL_KEY, "")
+            )
+            if template is not None and template.requests:
+                remaining = resources_util.subtract(
+                    template.requests, state_node.daemon_set_requests()
+                )
+                for r, name in enumerate(snapshot.resources):
+                    used[e, r] = max(remaining.get(name, 0.0), 0.0)
+            reqs = Requirements.from_labels(node.metadata.labels)
+            kmask[e], kdef[e], kneg[e], kgt[e], klt[e] = vocab.encode_requirements(reqs)
+            z = node.metadata.labels.get(labels_api.LABEL_TOPOLOGY_ZONE)
+            if z is None:
+                zone[e, :] = True  # unknown zone: any
+            elif z in zone_idx:
+                zone[e, zone_idx[z]] = True
+                node_zone[node.name] = z
+            c_label = node.metadata.labels.get(labels_api.LABEL_CAPACITY_TYPE)
+            if c_label is None:
+                ct[e, :] = True
+            elif c_label in ct_idx:
+                ct[e, ct_idx[c_label]] = True
+            open_[e] = True
+            init[e] = state_node.initialized()
+            taints = Taints.of(state_node.taints())
+            for c, cls in enumerate(snapshot.classes):
+                tol[c, e] = taints.tolerates(cls.pods[0]) is None
+
+        # selector-matching pre-existing pods: zone counts + per-node counts
+        for c, cls in enumerate(snapshot.classes):
+            selectors = _class_selectors(cls)
+            if not selectors:
+                continue
+            scheduling_uids = {p.uid for p in cls.pods}
+            for pod in bound_pods or []:
+                if not pod.spec.node_name or pod.uid in scheduling_uids:
+                    continue
+                if not any(s.matches(pod.metadata.labels) for s in selectors):
+                    continue
+                for e, state_node in enumerate(state_nodes):
+                    if state_node.node.name == pod.spec.node_name:
+                        host_count0[c, e] += 1
+                        break
+                z = node_zone.get(pod.spec.node_name)
+                if z is not None:
+                    snapshot.cls_zone_count0[c, zone_idx[z]] += 1
+
+        ex_state = solve_ops.ExistingState(
+            used=jnp.asarray(used),
+            kmask=jnp.asarray(kmask),
+            kdef=jnp.asarray(kdef),
+            kneg=jnp.asarray(kneg),
+            kgt=jnp.asarray(kgt),
+            klt=jnp.asarray(klt),
+            zone=jnp.asarray(zone),
+            ct=jnp.asarray(ct),
+            pod_count=jnp.asarray(pod_count),
+            open_=jnp.asarray(open_),
+        )
+        ex_static = solve_ops.ExistingStatic(
+            alloc=jnp.asarray(alloc),
+            init=jnp.asarray(init),
+            tol=jnp.asarray(tol),
+            host_count0=jnp.asarray(host_count0),
+        )
+        return ex_state, ex_static
+
+    def solve(
+        self,
+        pods: List[Pod],
+        state_nodes: Optional[list] = None,
+        bound_pods: Optional[List[Pod]] = None,
+        n_slots: int = 0,
+    ) -> TPUSolveResults:
+        snapshot = self.encode(pods, state_nodes)
+        ex_state = ex_static = None
+        if state_nodes:
+            ex_state, ex_static = self.encode_existing(snapshot, state_nodes, bound_pods)
+        if n_slots <= 0:
+            n_slots = solve_ops.estimate_slots(snapshot)
+        cls, statics_arrays, key_has_bounds = solve_ops.prepare(snapshot)
+        outputs = solve_ops._solve_jit(
+            cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static
+        )
         # slot exhaustion: retry once with double capacity
         n_used = int(outputs.state.n_next)
         slots = outputs.assign.shape[1]
         if int(np.sum(np.asarray(outputs.failed))) > 0 and n_used >= slots:
-            outputs = solve_ops.solve(snapshot, n_slots=slots * 2)
-            n_used = int(outputs.state.n_next)
-        return self.decode(snapshot, outputs)
+            outputs = solve_ops._solve_jit(
+                cls, statics_arrays, slots * 2, key_has_bounds, ex_state, ex_static
+            )
+        return self.decode(snapshot, outputs, state_nodes or [])
 
-    def decode(self, snapshot: EncodedSnapshot, outputs: solve_ops.SolveOutputs) -> TPUSolveResults:
+    def decode(
+        self,
+        snapshot: EncodedSnapshot,
+        outputs: solve_ops.SolveOutputs,
+        state_nodes: Optional[list] = None,
+    ) -> TPUSolveResults:
         assign = np.asarray(outputs.assign)  # [C, N]
+        assign_ex = np.asarray(outputs.assign_existing)  # [C, E]
         failed = np.asarray(outputs.failed)  # [C]
         state = outputs.state
         n_it = state.viable.shape[-1]
@@ -133,10 +297,20 @@ class TPUSolver:
                 provisioner_names[int(tmpl_id[n])], snapshot, viable[n], zone[n], used[n]
             )
 
+        state_nodes = state_nodes or []
         for c, cls in enumerate(snapshot.classes):
+            cursor = 0
+            # existing-node placements first (they were tried first in-kernel)
+            ex_idx = np.nonzero(assign_ex[c] > 0)[0]
+            for e, take in zip(ex_idx.tolist(), assign_ex[c][ex_idx].tolist()):
+                if e < len(state_nodes):
+                    name = state_nodes[e].node.name
+                    results.existing_assignments.setdefault(name, []).extend(
+                        cls.pods[cursor : cursor + take]
+                    )
+                cursor += take
             node_idx = np.nonzero(assign[c] > 0)[0]
             counts = assign[c][node_idx]
-            cursor = 0
             for n, take in zip(node_idx.tolist(), counts.tolist()):
                 nodes[n].pods.extend(cls.pods[cursor : cursor + take])
                 cursor += take

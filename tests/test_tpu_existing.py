@@ -1,0 +1,178 @@
+"""Parity tests: kernel existing-node placement vs the host ExistingNode path."""
+
+import numpy as np
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.solver.tpu import TPUSolver
+from karpenter_core_tpu.state.cluster import Cluster, StateNode
+from karpenter_core_tpu.testing import make_node, make_pod, make_pods, make_provisioner
+from karpenter_core_tpu.testing.harness import make_environment
+
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+
+
+def owned_ready_node(env, cpu=4, zone="test-zone-1", instance_type="default-instance-type", name=None):
+    node = make_node(
+        name=name,
+        labels={
+            labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+            labels_api.LABEL_INSTANCE_TYPE_STABLE: instance_type,
+            labels_api.LABEL_CAPACITY_TYPE: "spot",
+            labels_api.LABEL_NODE_INITIALIZED: "true",
+            ZONE: zone,
+        },
+        allocatable={"cpu": cpu, "memory": "4Gi", "pods": 10},
+    )
+    env.kube.create(node)
+    return node
+
+
+class TestExistingNodes:
+    def test_pods_fill_existing_before_new(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        owned_ready_node(env, cpu=4)
+        pods = make_pods(3, requests={"cpu": "1"})
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        res = solver.solve(
+            pods,
+            state_nodes=env.cluster.snapshot_nodes(),
+            bound_pods=env.kube.list_pods(),
+        )
+        assert not res.failed_pods
+        placed_existing = sum(len(v) for v in res.existing_assignments.values())
+        assert placed_existing == 3
+        assert not res.new_nodes
+
+    def test_overflow_opens_new_node(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        owned_ready_node(env, cpu=2)
+        pods = make_pods(4, requests={"cpu": "1"})
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        res = solver.solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        assert not res.failed_pods
+        placed_existing = sum(len(v) for v in res.existing_assignments.values())
+        assert placed_existing == 2
+        assert sum(len(n.pods) for n in res.new_nodes) == 2
+
+    def test_existing_capacity_accounts_bound_pods(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_ready_node(env, cpu=4)
+        bound = make_pod(requests={"cpu": 3}, node_name=node.name, unschedulable=False)
+        env.kube.create(bound)
+        pods = make_pods(2, requests={"cpu": "1"})
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        res = solver.solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        assert not res.failed_pods
+        placed_existing = sum(len(v) for v in res.existing_assignments.values())
+        assert placed_existing == 1  # only 1 cpu free
+        assert sum(len(n.pods) for n in res.new_nodes) == 1
+
+    def test_zone_selector_respects_existing_zone(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        owned_ready_node(env, cpu=8, zone="test-zone-1")
+        pods = [make_pod(requests={"cpu": 1}, node_selector={ZONE: "test-zone-2"})]
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        res = solver.solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        # zone-2 pod can't use the zone-1 node
+        assert not res.existing_assignments
+        assert sum(len(n.pods) for n in res.new_nodes) == 1
+
+    def test_taints_block_existing(self):
+        from karpenter_core_tpu.apis.objects import Taint
+
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+                labels_api.LABEL_NODE_INITIALIZED: "true",
+                ZONE: "test-zone-1",
+            },
+            taints=[Taint("dedicated", "x")],
+            allocatable={"cpu": 8, "memory": "8Gi", "pods": 10},
+        )
+        env.kube.create(node)
+        pods = [make_pod(requests={"cpu": 1})]
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        res = solver.solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        assert not res.existing_assignments
+        assert sum(len(n.pods) for n in res.new_nodes) == 1
+
+    def test_hostname_spread_counts_existing_pods(self):
+        from karpenter_core_tpu.apis.objects import LabelSelector, TopologySpreadConstraint
+
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_ready_node(env, cpu=8)
+        # one matching pod already on the node
+        existing_pod = make_pod(
+            labels={"app": "web"}, node_name=node.name, unschedulable=False,
+            requests={"cpu": "100m"},
+        )
+        env.kube.create(existing_pod)
+        spread = [
+            make_pod(
+                labels={"app": "web"},
+                requests={"cpu": "100m"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=labels_api.LABEL_HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ],
+            )
+            for _ in range(2)
+        ]
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        res = solver.solve(
+            spread, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        assert not res.failed_pods
+        # node already holds 1 matching pod (cap=skew=1): both new pods need new nodes
+        placed_existing = sum(len(v) for v in res.existing_assignments.values())
+        assert placed_existing == 0
+        assert len(res.new_nodes) == 2
+
+    def test_host_parity_on_mixed_existing_scenario(self):
+        """Aggregate parity vs the host scheduler with existing capacity."""
+        from karpenter_core_tpu.solver.builder import build_scheduler
+
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        owned_ready_node(env, cpu=4, zone="test-zone-1", name="ex-1")
+        owned_ready_node(env, cpu=4, zone="test-zone-2", name="ex-2")
+
+        def pods():
+            return make_pods(10, requests={"cpu": "1"})
+
+        host_sched = build_scheduler(
+            env.kube, env.provider, env.cluster, pods(), env.cluster.snapshot_nodes(),
+            daemonset_pods=[],
+        )
+        host = host_sched.solve(pods())
+        host_existing = sum(len(n.pods) for n in host.existing_nodes)
+        host_new = sum(len(n.pods) for n in host.new_nodes)
+
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        tpu = solver.solve(
+            pods(), state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        tpu_existing = sum(len(v) for v in tpu.existing_assignments.values())
+        tpu_new = sum(len(n.pods) for n in tpu.new_nodes)
+        assert (tpu_existing, tpu_new) == (host_existing, host_new)
+        assert len(tpu.failed_pods) == len(host.failed_pods) == 0
