@@ -1,0 +1,219 @@
+"""Provisioner admission validation.
+
+Mirror of /root/reference/pkg/apis/v1alpha5/provisioner_validation.go:34-307:
+requirements (supported operators, restricted labels, qualified names, Gt/Lt
+integer rules), labels, taints (valid effects, no duplicate key/effect),
+TTLs non-negative, consolidation ⊕ ttlSecondsAfterEmpty mutual exclusion, and
+kubelet configuration (reserved resources, eviction thresholds).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    NodeSelectorRequirement,
+    Taint,
+)
+from karpenter_core_tpu.apis.v1alpha5 import KubeletConfiguration, Provisioner
+from karpenter_core_tpu.utils import resources as resources_util
+
+SUPPORTED_NODE_SELECTOR_OPS = {OP_IN, OP_NOT_IN, OP_GT, OP_LT, OP_EXISTS, OP_DOES_NOT_EXIST}
+SUPPORTED_TAINT_EFFECTS = {"NoSchedule", "PreferNoSchedule", "NoExecute"}
+
+_NAME_RE = re.compile(r"^[a-z0-9A-Z]([-a-z0-9A-Z_.]*[a-z0-9A-Z])?$")
+_DNS1123_RE = re.compile(r"^[a-z0-9]([-a-z0-9.]*[a-z0-9])?$")
+
+
+def _is_qualified_name(key: str) -> Optional[str]:
+    """k8s qualified name: optional dns-subdomain prefix + '/' + name ≤63."""
+    parts = key.split("/")
+    if len(parts) > 2 or not key:
+        return "a qualified name must consist of alphanumeric characters"
+    name = parts[-1]
+    if len(parts) == 2:
+        prefix = parts[0]
+        if not prefix or len(prefix) > 253 or not _DNS1123_RE.match(prefix):
+            return f"prefix part {prefix!r} must be a valid DNS subdomain"
+    if not name or len(name) > 63 or not _NAME_RE.match(name):
+        return (
+            "name part must consist of alphanumeric characters, '-', '_' or '.', "
+            "and must start and end with an alphanumeric character"
+        )
+    return None
+
+
+def _is_valid_label_value(value: str) -> Optional[str]:
+    if len(value) > 63:
+        return "must be no more than 63 characters"
+    if value and not _NAME_RE.match(value):
+        return (
+            "a valid label value must be an empty string or consist of alphanumeric "
+            "characters, '-', '_' or '.'"
+        )
+    return None
+
+
+def validate_requirement(requirement: NodeSelectorRequirement) -> List[str]:
+    """ValidateRequirement (provisioner_validation.go:274-307)."""
+    errs: List[str] = []
+    key = labels_api.NORMALIZED_LABELS.get(requirement.key, requirement.key)
+    if requirement.operator not in SUPPORTED_NODE_SELECTOR_OPS:
+        errs.append(
+            f"key {key} has an unsupported operator {requirement.operator} "
+            f"not in {sorted(SUPPORTED_NODE_SELECTOR_OPS)}"
+        )
+    restricted = labels_api.is_restricted_label(key)
+    if restricted is not None:
+        errs.append(restricted)
+    name_err = _is_qualified_name(key)
+    if name_err is not None:
+        errs.append(f"key {key} is not a qualified name, {name_err}")
+    for value in requirement.values:
+        value_err = _is_valid_label_value(value)
+        if value_err is not None:
+            errs.append(f"invalid value {value} for key {key}, {value_err}")
+    if requirement.operator == OP_IN and not requirement.values:
+        errs.append(f"key {key} with operator {requirement.operator} must have a value defined")
+    if requirement.operator in (OP_GT, OP_LT):
+        if len(requirement.values) != 1 or not _is_non_negative_int(requirement.values[:1]):
+            errs.append(
+                f"key {key} with operator {requirement.operator} must have a "
+                "single positive integer value"
+            )
+    return errs
+
+
+def _is_non_negative_int(values: List[str]) -> bool:
+    try:
+        return int(values[0]) >= 0
+    except (ValueError, IndexError):
+        return False
+
+
+def validate_provisioner(provisioner: Provisioner) -> List[str]:
+    """Provisioner.Validate (provisioner_validation.go:65-108)."""
+    errs: List[str] = []
+    if not provisioner.name:
+        errs.append("metadata.name: required")
+    spec = provisioner.spec
+    if spec.ttl_seconds_until_expired is not None and spec.ttl_seconds_until_expired < 0:
+        errs.append("ttlSecondsUntilExpired: cannot be negative")
+    if spec.ttl_seconds_after_empty is not None and spec.ttl_seconds_after_empty < 0:
+        errs.append("ttlSecondsAfterEmpty: cannot be negative")
+    # consolidation and emptiness TTL are mutually exclusive (validation.go:93-96)
+    if (
+        spec.consolidation is not None
+        and spec.consolidation.enabled
+        and spec.ttl_seconds_after_empty is not None
+    ):
+        errs.append("expected exactly one of: ttlSecondsAfterEmpty, consolidation.enabled")
+
+    errs.extend(_validate_labels(provisioner))
+    errs.extend(_validate_taints(spec.taints, spec.startup_taints))
+    for i, requirement in enumerate(spec.requirements):
+        for err in validate_requirement(requirement):
+            errs.append(f"requirements[{i}]: {err}")
+    if spec.kubelet_configuration is not None:
+        errs.extend(_validate_kubelet(spec.kubelet_configuration))
+    return errs
+
+
+def _validate_labels(provisioner: Provisioner) -> List[str]:
+    errs: List[str] = []
+    for key, value in provisioner.spec.labels.items():
+        if key == labels_api.PROVISIONER_NAME_LABEL_KEY:
+            errs.append(f"labels[{key}]: restricted")
+            continue
+        name_err = _is_qualified_name(key)
+        if name_err is not None:
+            errs.append(f"labels[{key}]: {name_err}")
+        value_err = _is_valid_label_value(value)
+        if value_err is not None:
+            errs.append(f"labels[{key}]={value}: {value_err}")
+        if labels_api.is_restricted_label(key) is not None:
+            errs.append(f"labels[{key}]: label domain is restricted")
+    return errs
+
+
+def _validate_taints(taints: List[Taint], startup_taints: List[Taint]) -> List[str]:
+    """No empty keys, valid effects, no duplicate key/effect pairs across both
+    lists (provisioner_validation.go:132-173)."""
+    errs: List[str] = []
+    existing = set()
+    for field_name, taint_list in (("taints", taints), ("startupTaints", startup_taints)):
+        for i, taint in enumerate(taint_list):
+            if not taint.key:
+                errs.append(f"{field_name}[{i}]: taint key is required")
+            elif _is_qualified_name(taint.key) is not None:
+                errs.append(f"{field_name}[{i}]: invalid taint key {taint.key!r}")
+            if taint.value and _is_valid_label_value(taint.value) is not None:
+                errs.append(f"{field_name}[{i}]: invalid taint value {taint.value!r}")
+            if taint.effect not in SUPPORTED_TAINT_EFFECTS:
+                errs.append(f"{field_name}[{i}]: invalid taint effect {taint.effect!r}")
+            pair = (taint.key, taint.effect)
+            if pair in existing:
+                errs.append(
+                    f"duplicate taint Key/Effect pair {taint.key}={taint.effect} in {field_name}"
+                )
+            existing.add(pair)
+    return errs
+
+
+def _validate_kubelet(kc: KubeletConfiguration) -> List[str]:
+    errs: List[str] = []
+    for field_name, reserved in (
+        ("systemReserved", kc.system_reserved),
+        ("kubeReserved", kc.kube_reserved),
+    ):
+        for key, value in reserved.items():
+            if value < 0:
+                errs.append(
+                    f"kubeletConfiguration.{field_name}[{key}]: "
+                    "Value cannot be a negative resource quantity"
+                )
+    for field_name, thresholds in (
+        ("evictionHard", kc.eviction_hard),
+        ("evictionSoft", kc.eviction_soft),
+    ):
+        for key, value in thresholds.items():
+            err = _validate_threshold(value)
+            if err is not None:
+                errs.append(f"kubeletConfiguration.{field_name}[{key}]: {err}")
+    if kc.max_pods is not None and kc.max_pods < 0:
+        errs.append("kubeletConfiguration.maxPods: cannot be negative")
+    if kc.pods_per_core is not None and kc.pods_per_core < 0:
+        errs.append("kubeletConfiguration.podsPerCore: cannot be negative")
+    return errs
+
+
+def _validate_threshold(value: str) -> Optional[str]:
+    if value.endswith("%"):
+        try:
+            pct = float(value[:-1])
+        except ValueError:
+            return f"could not be parsed as a percentage value: {value!r}"
+        if pct < 0:
+            return "percentage values cannot be negative"
+        if pct > 100:
+            return "percentage values cannot be greater than 100"
+        return None
+    try:
+        resources_util.parse_quantity(value)
+    except ValueError:
+        return f"could not be parsed as a resource quantity: {value!r}"
+    return None
+
+
+def set_defaults(provisioner: Provisioner) -> Provisioner:
+    """Provisioner.SetDefaults (provisioner_defaults.go:22 — a no-op upstream;
+    kept as the admission seam)."""
+    return provisioner

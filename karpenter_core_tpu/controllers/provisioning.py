@@ -264,6 +264,10 @@ class ProvisioningController:
                 err = self.volume_topology.inject(pod)
                 if err is not None:
                     return None, err
+            if self.use_tpu_kernel and len(pods) >= self.tpu_kernel_min_pods:
+                results = self._schedule_tpu(pods, state_nodes)
+                if results is not None:
+                    return results, None
             scheduler = build_scheduler(
                 self.kube_client,
                 self.cloud_provider,
@@ -279,6 +283,46 @@ class ProvisioningController:
             return None, str(e)
         finally:
             done()
+
+    def _schedule_tpu(self, pods: List[Pod], state_nodes) -> Optional[SchedulingResults]:
+        """Route the batch through the TPU kernel; None falls back to the host
+        path (batch shape unsupported — models.snapshot.classify_pods)."""
+        from karpenter_core_tpu.models.snapshot import KernelUnsupported
+        from karpenter_core_tpu.solver.tpu import TPUSolver
+
+        provisioners = self.kube_client.list_provisioners()
+        if not provisioners:
+            raise NoProvisionersError("no provisioners found")
+        try:
+            solver = TPUSolver(
+                self.cloud_provider, provisioners, daemonset_pods=self.get_daemonset_pods()
+            )
+            tpu_results = solver.solve(
+                pods,
+                state_nodes=state_nodes,
+                bound_pods=self.kube_client.list_pods(),
+            )
+        except KernelUnsupported as e:
+            log.debug("TPU kernel unsupported for batch, falling back: %s", e)
+            return None
+
+        results = SchedulingResults(failed_pods=list(tpu_results.failed_pods))
+        results.new_nodes = [
+            solver.to_launchable(decision) for decision in tpu_results.new_nodes
+        ]
+        # nominate existing nodes + publish pod nominations
+        for node_name, placed in tpu_results.existing_assignments.items():
+            self.cluster.nominate_node_for_pod(node_name)
+            node = self.kube_client.get_node(node_name)
+            if self.recorder is not None and node is not None:
+                for pod in placed:
+                    self.recorder.publish(evt.nominate_pod(pod, node))
+        if self.recorder is not None:
+            for pod in results.failed_pods:
+                self.recorder.publish(
+                    evt.pod_failed_to_schedule(pod, "no capacity (tpu solve)")
+                )
+        return results
 
     def get_daemonset_pods(self) -> List[Pod]:
         """Representative daemonset pods for overhead calculation.  The

@@ -1,0 +1,79 @@
+"""Columnar pod-batch ingestion: the wire-format fast path.
+
+At production scale the solver sidecar receives cluster snapshots over a
+binary channel (SURVEY.md §5.8), not as Python objects — pods arrive columnar:
+a requests matrix plus integer-coded constraint columns.  Classification then
+reduces to grouping identical signature rows, which runs through the native
+runtime (models.native, C++) instead of per-object Python hashing.
+
+``from_pods`` converts an object batch for benchmarking/tests; a gRPC/IPC
+front-end would construct ColumnarPodBatch directly from the wire.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_core_tpu.apis.objects import Pod
+from karpenter_core_tpu.models import native
+from karpenter_core_tpu.utils import resources as resources_util
+
+
+@dataclass
+class ColumnarPodBatch:
+    """Pods as columns.  ``signature`` carries one u64 row per pod: stable
+    hashes of the pod's constraint content (requirements, tolerations,
+    topology, labels) plus its quantized resource vector."""
+
+    n_pods: int
+    requests: np.ndarray  # f32[P, R]
+    resource_names: List[str]
+    signature: np.ndarray  # u64[P, W]
+    pods: Optional[List[Pod]] = None  # object backing when converted
+
+    @classmethod
+    def from_pods(cls, pods: List[Pod], resource_names: Optional[List[str]] = None) -> "ColumnarPodBatch":
+        from karpenter_core_tpu.models.snapshot import _class_signature
+
+        if resource_names is None:
+            seen: Dict[str, None] = {}
+            for pod in pods:
+                for name in resources_util.ceiling(pod):
+                    seen.setdefault(name)
+            resource_names = sorted(seen)
+        requests = np.zeros((len(pods), len(resource_names)), dtype=np.float32)
+        index = {name: r for r, name in enumerate(resource_names)}
+        signature = np.zeros((len(pods), 1), dtype=np.uint64)
+        for p, pod in enumerate(pods):
+            for name, quantity in resources_util.ceiling(pod).items():
+                requests[p, index[name]] = quantity
+            signature[p, 0] = np.uint64(hash(_class_signature(pod)) & (2**64 - 1))
+        return cls(
+            n_pods=len(pods),
+            requests=requests,
+            resource_names=resource_names,
+            signature=signature,
+            pods=pods,
+        )
+
+
+@dataclass
+class ColumnarClasses:
+    class_ids: np.ndarray  # i64[P]
+    n_classes: int
+    counts: np.ndarray  # i64[C]
+    requests: np.ndarray  # f32[C, R] per-pod request vector of each class
+
+
+def classify_columnar(batch: ColumnarPodBatch) -> ColumnarClasses:
+    """Group the batch into equivalence classes through the native runtime."""
+    class_ids, n_classes = native.group_rows(batch.signature)
+    totals, counts = native.class_totals(batch.requests, class_ids, n_classes)
+    # per-pod request vector = class total / count (identical pods by definition)
+    requests = totals / np.maximum(counts[:, None], 1)
+    return ColumnarClasses(
+        class_ids=class_ids, n_classes=n_classes, counts=counts, requests=requests
+    )

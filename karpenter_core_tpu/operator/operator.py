@@ -109,6 +109,15 @@ class Operator:
         ]
         return self
 
+    def with_webhooks(self) -> "Operator":
+        """Install defaulting/validation admission (webhooks.go:32-69,
+        operator.go:157)."""
+        from karpenter_core_tpu.operator.webhooks import Webhooks
+
+        self.webhooks = Webhooks()
+        self.webhooks.install(self.kube_client)
+        return self
+
     def _provision(self) -> float:
         self.provisioning.reconcile(wait_for_batch=True)
         return 0.1

@@ -1,0 +1,52 @@
+"""Admission webhooks: defaulting + validation on writes.
+
+Mirror of /root/reference/pkg/webhooks/webhooks.go:32-69: the reference runs
+knative defaulting/validation admission controllers as a second process; here
+admission hooks intercept KubeClient writes for the registered kinds, applying
+SetDefaults then Validate and rejecting invalid objects.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from karpenter_core_tpu.apis import validation as validation_api
+from karpenter_core_tpu.apis.v1alpha5 import Provisioner
+
+
+class AdmissionError(Exception):
+    def __init__(self, errors: List[str]) -> None:
+        super().__init__("; ".join(errors))
+        self.errors = errors
+
+
+class Webhooks:
+    """Wraps a KubeClient's create/update/apply with admission chains."""
+
+    def __init__(self) -> None:
+        self.defaulters: Dict[type, Callable] = {Provisioner: validation_api.set_defaults}
+        self.validators: Dict[type, Callable] = {Provisioner: validation_api.validate_provisioner}
+
+    def admit(self, obj):
+        defaulter = self.defaulters.get(type(obj))
+        if defaulter is not None:
+            obj = defaulter(obj)
+        validator = self.validators.get(type(obj))
+        if validator is not None:
+            errors = validator(obj)
+            if errors:
+                raise AdmissionError(errors)
+        return obj
+
+    def install(self, kube_client) -> None:
+        """Decorate the client's mutating entry points."""
+        original_create, original_update = kube_client.create, kube_client.update
+
+        def create(obj):
+            return original_create(self.admit(obj))
+
+        def update(obj):
+            return original_update(self.admit(obj))
+
+        kube_client.create = create
+        kube_client.update = update

@@ -24,8 +24,9 @@ from karpenter_core_tpu.models.snapshot import (
     KernelUnsupported,
     encode_snapshot,
 )
+from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.ops import solve as solve_ops
-from karpenter_core_tpu.scheduling import Requirements
+from karpenter_core_tpu.scheduling import Requirement, Requirements
 from karpenter_core_tpu.solver.machinetemplate import MachineTemplate
 from karpenter_core_tpu.solver.scheduler import _daemon_overhead
 from karpenter_core_tpu.utils import resources as resources_util
@@ -87,6 +88,26 @@ def _class_selectors(cls):
     return selectors
 
 
+@dataclass
+class LaunchableNode:
+    """Launch-path adapter (duck-typed like solver.node.SchedulingNode):
+    template + instance types + requests + pods, consumable by
+    ProvisioningController.launch."""
+
+    template: object
+    instance_type_options: List[InstanceType]
+    requests: dict
+    pods: List[Pod] = field(default_factory=list)
+
+    @property
+    def provisioner_name(self) -> str:
+        return self.template.provisioner_name
+
+    @property
+    def requirements(self):
+        return self.template.requirements
+
+
 class TPUSolver:
     def __init__(
         self,
@@ -104,6 +125,9 @@ class TPUSolver:
         overhead = _daemon_overhead(self.templates, daemonset_pods or [])
         for template in self.templates:
             template.requests = overhead[id(template)]
+        self._it_by_name = {
+            it.name: it for its in self.instance_types.values() for it in its
+        }
 
     def encode(self, pods: List[Pod], state_nodes: Optional[list] = None) -> EncodedSnapshot:
         """Raises models.snapshot.KernelUnsupported when the batch needs the
@@ -317,6 +341,35 @@ class TPUSolver:
             results.failed_pods.extend(cls.pods[cursor:])
         results.new_nodes = [nodes[n] for n in sorted(nodes)]
         return results
+
+    def to_launchable(self, decision: TPUNodeDecision) -> LaunchableNode:
+        """Convert a kernel node decision into a launch-path object: the
+        provisioner's template with zone/capacity-type pinned to the decision's
+        surviving domains and the viable instance-type list attached."""
+        from dataclasses import replace as dc_replace
+
+        from karpenter_core_tpu.apis.objects import OP_IN
+
+        template = next(
+            t for t in self.templates if t.provisioner_name == decision.provisioner_name
+        )
+        requirements = Requirements(*template.requirements.values())
+        zones = decision.zones
+        if zones:
+            requirements.add(
+                Requirement(labels_api.LABEL_TOPOLOGY_ZONE, OP_IN, zones)
+            )
+        options = [
+            self._it_by_name[name]
+            for name in decision.instance_type_names
+            if name in self._it_by_name
+        ]
+        return LaunchableNode(
+            template=dc_replace(template, requirements=requirements),
+            instance_type_options=options,
+            requests=dict(decision.requests),
+            pods=list(decision.pods),
+        )
 
 
 __all__ = ["TPUSolver", "TPUSolveResults", "TPUNodeDecision", "KernelUnsupported"]

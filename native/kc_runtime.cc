@@ -1,0 +1,101 @@
+// Native runtime kernels for the snapshot ingestion path.
+//
+// The production ingestion seam (SURVEY.md §5.8: the gRPC snapshot channel
+// standing where the reference's apiserver watch plane stands) delivers pod
+// batches in columnar form.  Grouping 50k pods into equivalence classes is the
+// host-side hot loop of snapshot encoding (models/snapshot.py classify_pods);
+// this library does the row-grouping over a pre-built signature matrix at
+// native speed, exposed through a plain C ABI for ctypes.
+//
+// Build: make -C native   (produces libkc_runtime.so)
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// FNV-1a over a row of u64 words — cheap, deterministic, good dispersion for
+// signature rows whose words are already hashes or small ids.
+inline uint64_t row_hash(const uint64_t* row, int64_t width) {
+  uint64_t h = 1469598103934665603ULL;
+  for (int64_t i = 0; i < width; ++i) {
+    const unsigned char* p = reinterpret_cast<const unsigned char*>(&row[i]);
+    for (int j = 0; j < 8; ++j) {
+      h ^= p[j];
+      h *= 1099511628211ULL;
+    }
+  }
+  return h;
+}
+
+struct RowKey {
+  const uint64_t* data;
+  int64_t width;
+  uint64_t hash;
+  bool operator==(const RowKey& other) const {
+    return hash == other.hash &&
+           std::memcmp(data, other.data, width * sizeof(uint64_t)) == 0;
+  }
+};
+
+struct RowKeyHash {
+  size_t operator()(const RowKey& k) const { return static_cast<size_t>(k.hash); }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Group identical rows of a [n_rows, width] u64 matrix.
+//
+//   class_ids_out: i64[n_rows]  — class index per row (first-seen order)
+//   returns: number of distinct classes (negative on error)
+int64_t kc_group_rows(const uint64_t* matrix, int64_t n_rows, int64_t width,
+                      int64_t* class_ids_out) {
+  if (matrix == nullptr || class_ids_out == nullptr || n_rows < 0 || width <= 0) {
+    return -1;
+  }
+  std::unordered_map<RowKey, int64_t, RowKeyHash> seen;
+  seen.reserve(static_cast<size_t>(n_rows) / 4 + 16);
+  int64_t next_class = 0;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    const uint64_t* row = matrix + r * width;
+    RowKey key{row, width, row_hash(row, width)};
+    auto it = seen.find(key);
+    if (it == seen.end()) {
+      seen.emplace(key, next_class);
+      class_ids_out[r] = next_class;
+      ++next_class;
+    } else {
+      class_ids_out[r] = it->second;
+    }
+  }
+  return next_class;
+}
+
+// Sum rows of a [n_rows, width] f32 matrix into per-class accumulators.
+//
+//   class_ids: i64[n_rows] (from kc_group_rows)
+//   out:       f32[n_classes, width] (zero-initialized by the caller)
+//   counts:    i64[n_classes]        (zero-initialized by the caller)
+int64_t kc_class_totals(const float* matrix, const int64_t* class_ids,
+                        int64_t n_rows, int64_t width, int64_t n_classes,
+                        float* out, int64_t* counts) {
+  if (matrix == nullptr || class_ids == nullptr || out == nullptr ||
+      counts == nullptr || n_rows < 0 || width <= 0 || n_classes < 0) {
+    return -1;
+  }
+  for (int64_t r = 0; r < n_rows; ++r) {
+    int64_t c = class_ids[r];
+    if (c < 0 || c >= n_classes) return -2;
+    const float* row = matrix + r * width;
+    float* acc = out + c * width;
+    for (int64_t i = 0; i < width; ++i) acc[i] += row[i];
+    counts[c] += 1;
+  }
+  return 0;
+}
+
+}  // extern "C"

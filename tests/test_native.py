@@ -1,0 +1,64 @@
+"""Native runtime (C++) kernels and the columnar ingestion path."""
+
+import numpy as np
+
+from karpenter_core_tpu.models import native
+from karpenter_core_tpu.models.columnar import ColumnarPodBatch, classify_columnar
+from karpenter_core_tpu.models.snapshot import classify_pods
+from karpenter_core_tpu.testing import make_pod, make_pods
+
+
+class TestNativeKernels:
+    def test_library_builds(self):
+        assert native.available(), "g++ toolchain is baked in; native build must succeed"
+
+    def test_group_rows(self):
+        matrix = np.array(
+            [[1, 2], [3, 4], [1, 2], [5, 6], [3, 4], [1, 2]], dtype=np.uint64
+        )
+        ids, n = native.group_rows(matrix)
+        assert n == 3
+        assert ids.tolist() == [0, 1, 0, 2, 1, 0]  # first-seen order
+
+    def test_group_rows_matches_numpy_fallback(self):
+        rng = np.random.default_rng(0)
+        matrix = rng.integers(0, 5, size=(500, 3)).astype(np.uint64)
+        ids_native, n_native = native.group_rows(matrix)
+        # recompute with the documented fallback semantics
+        _, first_idx, inverse = np.unique(matrix, axis=0, return_index=True, return_inverse=True)
+        order = np.argsort(np.argsort(first_idx))
+        ids_np = order[inverse]
+        assert n_native == len(first_idx)
+        assert (ids_native == ids_np).all()
+
+    def test_class_totals(self):
+        matrix = np.array([[1.0, 2.0], [3.0, 4.0], [1.0, 1.0]], dtype=np.float32)
+        ids = np.array([0, 1, 0], dtype=np.int64)
+        totals, counts = native.class_totals(matrix, ids, 2)
+        assert counts.tolist() == [2, 1]
+        assert totals[0].tolist() == [2.0, 3.0]
+        assert totals[1].tolist() == [3.0, 4.0]
+
+
+class TestColumnarPath:
+    def test_matches_object_classification(self):
+        pods = (
+            make_pods(20, requests={"cpu": "500m"})
+            + make_pods(10, requests={"cpu": 2})
+            + make_pods(5, requests={"cpu": 2, "memory": "1Gi"})
+        )
+        batch = ColumnarPodBatch.from_pods(pods)
+        columnar = classify_columnar(batch)
+        object_classes = classify_pods(pods)
+        assert columnar.n_classes == len(object_classes)
+        assert sorted(columnar.counts.tolist()) == sorted(
+            c.count for c in object_classes
+        )
+
+    def test_per_class_requests(self):
+        pods = make_pods(4, requests={"cpu": 2, "memory": "1Gi"})
+        batch = ColumnarPodBatch.from_pods(pods)
+        columnar = classify_columnar(batch)
+        assert columnar.n_classes == 1
+        cpu_idx = batch.resource_names.index("cpu")
+        assert abs(columnar.requests[0, cpu_idx] - 2.0) < 1e-6

@@ -1,0 +1,82 @@
+"""End-to-end provisioning through the TPU kernel path (use_tpu_kernel=True)."""
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_core_tpu.controllers.provisioning import ProvisioningController
+from karpenter_core_tpu.events import Recorder
+from karpenter_core_tpu.operator.kubeclient import KubeClient
+from karpenter_core_tpu.operator.settings import Settings
+from karpenter_core_tpu.state.cluster import Cluster
+from karpenter_core_tpu.state.informer import start_informers
+from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
+from karpenter_core_tpu.utils.clock import FakeClock
+
+
+def tpu_env(min_pods=1):
+    clock = FakeClock()
+    kube = KubeClient(clock)
+    provider = FakeCloudProvider()
+    settings = Settings()
+    recorder = Recorder(clock=clock.now)
+    cluster = Cluster(clock, kube, provider, settings)
+    start_informers(cluster, kube)
+    controller = ProvisioningController(
+        kube, provider, cluster, recorder=recorder, settings=settings, clock=clock,
+        use_tpu_kernel=True, tpu_kernel_min_pods=min_pods,
+    )
+    return kube, provider, cluster, recorder, controller
+
+
+class TestTPUProvisioningPath:
+    def test_kernel_path_launches_nodes(self):
+        kube, provider, cluster, recorder, controller = tpu_env()
+        kube.create(make_provisioner())
+        for pod in make_pods(6, requests={"cpu": "900m"}):
+            kube.create(pod)
+        err = controller.reconcile(wait_for_batch=False)
+        assert err is None
+        nodes = kube.list_nodes()
+        assert nodes, "kernel path should launch nodes"
+        assert provider.create_calls
+        assert all(
+            labels_api.PROVISIONER_NAME_LABEL_KEY in n.metadata.labels for n in nodes
+        )
+        nominated = [e for e in recorder.events if e.reason == "Nominated"]
+        assert len(nominated) == 6
+
+    def test_unsupported_batch_falls_back_to_host(self):
+        from karpenter_core_tpu.apis.objects import LabelSelector, PodAffinityTerm
+
+        kube, provider, cluster, recorder, controller = tpu_env()
+        kube.create(make_provisioner())
+        # required pod affinity is kernel-unsupported: host path must handle it
+        target = make_pod(labels={"app": "a"}, requests={"cpu": "100m"},
+                          node_selector={labels_api.LABEL_TOPOLOGY_ZONE: "test-zone-1"})
+        follower = make_pod(
+            requests={"cpu": "100m"},
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                    label_selector=LabelSelector(match_labels={"app": "a"}),
+                )
+            ],
+        )
+        kube.create(target)
+        kube.create(follower)
+        err = controller.reconcile(wait_for_batch=False)
+        assert err is None
+        assert kube.list_nodes(), "host fallback should still provision"
+        nominated = [e for e in recorder.events if e.reason == "Nominated"]
+        assert len(nominated) == 2
+
+    def test_kernel_reuses_inflight_capacity(self):
+        kube, provider, cluster, recorder, controller = tpu_env()
+        kube.create(make_provisioner())
+        kube.create(make_pod(requests={"cpu": "500m"}))
+        controller.reconcile(wait_for_batch=False)
+        assert len(provider.create_calls) == 1
+        # second round: pod fits the in-flight node -> no new machine
+        kube.create(make_pod(requests={"cpu": "500m"}))
+        controller.reconcile(wait_for_batch=False)
+        assert len(provider.create_calls) == 1
+        assert len(kube.list_nodes()) == 1

@@ -1,0 +1,101 @@
+"""Provisioner validation/admission suite (modeled on
+/root/reference/pkg/apis/v1alpha5/suite_test.go validation cases)."""
+
+import pytest
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import OP_GT, OP_IN, NodeSelectorRequirement, Taint
+from karpenter_core_tpu.apis.v1alpha5 import KubeletConfiguration
+from karpenter_core_tpu.apis.validation import validate_provisioner, validate_requirement
+from karpenter_core_tpu.operator.kubeclient import KubeClient
+from karpenter_core_tpu.operator.webhooks import AdmissionError, Webhooks
+from karpenter_core_tpu.testing import make_provisioner
+
+
+class TestProvisionerValidation:
+    def test_valid_provisioner(self):
+        assert validate_provisioner(make_provisioner()) == []
+
+    def test_negative_ttls(self):
+        p = make_provisioner(ttl_seconds_until_expired=-1)
+        assert any("ttlSecondsUntilExpired" in e for e in validate_provisioner(p))
+        p = make_provisioner(ttl_seconds_after_empty=-1)
+        assert any("ttlSecondsAfterEmpty" in e for e in validate_provisioner(p))
+
+    def test_consolidation_and_empty_ttl_exclusive(self):
+        p = make_provisioner(ttl_seconds_after_empty=30, consolidation_enabled=True)
+        assert any("exactly one" in e for e in validate_provisioner(p))
+
+    def test_restricted_label_rejected(self):
+        p = make_provisioner(labels={"kubernetes.io/custom": "x"})
+        assert validate_provisioner(p)
+
+    def test_provisioner_name_label_rejected(self):
+        p = make_provisioner(labels={labels_api.PROVISIONER_NAME_LABEL_KEY: "x"})
+        assert any("restricted" in e for e in validate_provisioner(p))
+
+    def test_taint_validation(self):
+        p = make_provisioner(taints=[Taint("", "v")])
+        assert any("taint key is required" in e for e in validate_provisioner(p))
+        p = make_provisioner(taints=[Taint("k", "v", "BadEffect")])
+        assert any("invalid taint effect" in e for e in validate_provisioner(p))
+        p = make_provisioner(taints=[Taint("k", "a"), Taint("k", "b")])
+        assert any("duplicate taint" in e for e in validate_provisioner(p))
+
+    def test_duplicate_taint_across_startup(self):
+        p = make_provisioner(taints=[Taint("k", "a")], startup_taints=[Taint("k", "a")])
+        assert any("duplicate taint" in e for e in validate_provisioner(p))
+
+    def test_kubelet_validation(self):
+        p = make_provisioner()
+        p.spec.kubelet_configuration = KubeletConfiguration(system_reserved={"cpu": -1})
+        assert any("negative resource" in e for e in validate_provisioner(p))
+        p.spec.kubelet_configuration = KubeletConfiguration(eviction_hard={"memory.available": "150%"})
+        assert any("greater than 100" in e for e in validate_provisioner(p))
+        p.spec.kubelet_configuration = KubeletConfiguration(eviction_hard={"memory.available": "5%"})
+        assert validate_provisioner(p) == []
+
+
+class TestRequirementValidation:
+    def test_unsupported_operator(self):
+        errs = validate_requirement(NodeSelectorRequirement("key", "Weird", ["a"]))
+        assert any("unsupported operator" in e for e in errs)
+
+    def test_in_requires_values(self):
+        errs = validate_requirement(NodeSelectorRequirement("key", OP_IN, []))
+        assert any("must have a value defined" in e for e in errs)
+
+    def test_gt_requires_single_int(self):
+        assert validate_requirement(NodeSelectorRequirement("key", OP_GT, ["5"])) == []
+        assert validate_requirement(NodeSelectorRequirement("key", OP_GT, ["a"]))
+        assert validate_requirement(NodeSelectorRequirement("key", OP_GT, ["1", "2"]))
+        assert validate_requirement(NodeSelectorRequirement("key", OP_GT, ["-3"]))
+
+    def test_restricted_label(self):
+        errs = validate_requirement(
+            NodeSelectorRequirement("kubernetes.io/whatever", OP_IN, ["x"])
+        )
+        assert any("restricted" in e for e in errs)
+
+    def test_well_known_ok(self):
+        assert validate_requirement(
+            NodeSelectorRequirement(labels_api.LABEL_TOPOLOGY_ZONE, OP_IN, ["z"])
+        ) == []
+
+    def test_invalid_label_value(self):
+        errs = validate_requirement(NodeSelectorRequirement("key", OP_IN, ["bad value!"]))
+        assert any("invalid value" in e for e in errs)
+
+
+class TestWebhooks:
+    def test_admission_rejects_invalid(self):
+        kube = KubeClient()
+        Webhooks().install(kube)
+        with pytest.raises(AdmissionError):
+            kube.create(make_provisioner(ttl_seconds_until_expired=-5))
+
+    def test_admission_allows_valid(self):
+        kube = KubeClient()
+        Webhooks().install(kube)
+        kube.create(make_provisioner())
+        assert len(kube.list_provisioners()) == 1
