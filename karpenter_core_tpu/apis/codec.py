@@ -1,0 +1,382 @@
+"""Wire codec for API objects: dict ⇄ dataclass.
+
+The snapshot channel (service.snapshot_channel) ships pods/provisioners/nodes
+between the controller plane and the solver sidecar; this codec keeps the wire
+format explicit and versionable.  Only solver-relevant fields travel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from karpenter_core_tpu.apis.objects import (
+    Affinity,
+    Container,
+    ContainerPort,
+    LabelSelector,
+    LabelSelectorRequirement,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    PodStatus,
+    PreferredSchedulingTerm,
+    ResourceRequirements,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from karpenter_core_tpu.apis.v1alpha5 import (
+    Consolidation,
+    Limits,
+    Provisioner,
+    ProvisionerSpec,
+)
+
+
+def _meta_to_dict(meta: ObjectMeta) -> Dict[str, Any]:
+    return {
+        "name": meta.name,
+        "namespace": meta.namespace,
+        "uid": meta.uid,
+        "labels": dict(meta.labels),
+        "annotations": dict(meta.annotations),
+        "creationTimestamp": meta.creation_timestamp,
+    }
+
+
+def _meta_from_dict(d: Dict[str, Any]) -> ObjectMeta:
+    return ObjectMeta(
+        name=d.get("name", ""),
+        namespace=d.get("namespace", "default"),
+        uid=d.get("uid") or ObjectMeta().uid,
+        labels=dict(d.get("labels", {})),
+        annotations=dict(d.get("annotations", {})),
+        creation_timestamp=d.get("creationTimestamp", 0.0),
+    )
+
+
+def _selector_to_dict(s: Optional[LabelSelector]) -> Optional[Dict[str, Any]]:
+    if s is None:
+        return None
+    return {
+        "matchLabels": dict(s.match_labels),
+        "matchExpressions": [
+            {"key": e.key, "operator": e.operator, "values": list(e.values)}
+            for e in s.match_expressions
+        ],
+    }
+
+
+def _selector_from_dict(d: Optional[Dict[str, Any]]) -> Optional[LabelSelector]:
+    if d is None:
+        return None
+    return LabelSelector(
+        match_labels=dict(d.get("matchLabels", {})),
+        match_expressions=[
+            LabelSelectorRequirement(e["key"], e["operator"], list(e.get("values", [])))
+            for e in d.get("matchExpressions", [])
+        ],
+    )
+
+
+def _nsr_to_dict(r: NodeSelectorRequirement) -> Dict[str, Any]:
+    return {"key": r.key, "operator": r.operator, "values": list(r.values)}
+
+
+def _nsr_from_dict(d: Dict[str, Any]) -> NodeSelectorRequirement:
+    return NodeSelectorRequirement(d["key"], d["operator"], list(d.get("values", [])))
+
+
+def _affinity_term_to_dict(t: PodAffinityTerm) -> Dict[str, Any]:
+    return {
+        "topologyKey": t.topology_key,
+        "labelSelector": _selector_to_dict(t.label_selector),
+        "namespaces": list(t.namespaces),
+    }
+
+
+def _affinity_term_from_dict(d: Dict[str, Any]) -> PodAffinityTerm:
+    return PodAffinityTerm(
+        topology_key=d["topologyKey"],
+        label_selector=_selector_from_dict(d.get("labelSelector")),
+        namespaces=list(d.get("namespaces", [])),
+    )
+
+
+def pod_to_dict(pod: Pod) -> Dict[str, Any]:
+    spec = pod.spec
+    out: Dict[str, Any] = {
+        "metadata": _meta_to_dict(pod.metadata),
+        "spec": {
+            "nodeSelector": dict(spec.node_selector),
+            "nodeName": spec.node_name,
+            "tolerations": [
+                {"key": t.key, "operator": t.operator, "value": t.value, "effect": t.effect}
+                for t in spec.tolerations
+            ],
+            "containers": [
+                {
+                    "requests": dict(c.resources.requests),
+                    "limits": dict(c.resources.limits),
+                    "hostPorts": [
+                        {"port": p.host_port, "protocol": p.protocol, "hostIP": p.host_ip}
+                        for p in c.ports
+                        if p.host_port
+                    ],
+                }
+                for c in spec.containers
+            ],
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": c.max_skew,
+                    "topologyKey": c.topology_key,
+                    "whenUnsatisfiable": c.when_unsatisfiable,
+                    "labelSelector": _selector_to_dict(c.label_selector),
+                }
+                for c in spec.topology_spread_constraints
+            ],
+            "priority": spec.priority,
+        },
+        "status": {"phase": pod.status.phase},
+    }
+    if spec.affinity is not None:
+        affinity: Dict[str, Any] = {}
+        if spec.affinity.node_affinity is not None:
+            na = spec.affinity.node_affinity
+            affinity["nodeAffinity"] = {
+                "required": (
+                    [
+                        [_nsr_to_dict(e) for e in term.match_expressions]
+                        for term in na.required.node_selector_terms
+                    ]
+                    if na.required is not None
+                    else None
+                ),
+                "preferred": [
+                    {
+                        "weight": p.weight,
+                        "matchExpressions": [_nsr_to_dict(e) for e in p.preference.match_expressions],
+                    }
+                    for p in na.preferred
+                ],
+            }
+        if spec.affinity.pod_affinity is not None:
+            affinity["podAffinity"] = {
+                "required": [_affinity_term_to_dict(t) for t in spec.affinity.pod_affinity.required],
+                "preferred": [
+                    {"weight": w.weight, "term": _affinity_term_to_dict(w.pod_affinity_term)}
+                    for w in spec.affinity.pod_affinity.preferred
+                ],
+            }
+        if spec.affinity.pod_anti_affinity is not None:
+            affinity["podAntiAffinity"] = {
+                "required": [
+                    _affinity_term_to_dict(t) for t in spec.affinity.pod_anti_affinity.required
+                ],
+                "preferred": [
+                    {"weight": w.weight, "term": _affinity_term_to_dict(w.pod_affinity_term)}
+                    for w in spec.affinity.pod_anti_affinity.preferred
+                ],
+            }
+        out["spec"]["affinity"] = affinity
+    return out
+
+
+def pod_from_dict(d: Dict[str, Any]) -> Pod:
+    spec_d = d.get("spec", {})
+    containers = [
+        Container(
+            resources=ResourceRequirements(
+                requests=dict(c.get("requests", {})), limits=dict(c.get("limits", {}))
+            ),
+            ports=[
+                ContainerPort(
+                    host_port=p["port"], protocol=p.get("protocol", "TCP"), host_ip=p.get("hostIP", "")
+                )
+                for p in c.get("hostPorts", [])
+            ],
+        )
+        for c in spec_d.get("containers", [])
+    ]
+    affinity = None
+    aff_d = spec_d.get("affinity")
+    if aff_d:
+        node_affinity = None
+        if "nodeAffinity" in aff_d:
+            na = aff_d["nodeAffinity"]
+            required = None
+            if na.get("required") is not None:
+                required = NodeSelector(
+                    node_selector_terms=[
+                        NodeSelectorTerm(match_expressions=[_nsr_from_dict(e) for e in term])
+                        for term in na["required"]
+                    ]
+                )
+            node_affinity = NodeAffinity(
+                required=required,
+                preferred=[
+                    PreferredSchedulingTerm(
+                        weight=p["weight"],
+                        preference=NodeSelectorTerm(
+                            match_expressions=[_nsr_from_dict(e) for e in p["matchExpressions"]]
+                        ),
+                    )
+                    for p in na.get("preferred", [])
+                ],
+            )
+        pod_affinity = None
+        if "podAffinity" in aff_d:
+            pa = aff_d["podAffinity"]
+            pod_affinity = PodAffinity(
+                required=[_affinity_term_from_dict(t) for t in pa.get("required", [])],
+                preferred=[
+                    WeightedPodAffinityTerm(w["weight"], _affinity_term_from_dict(w["term"]))
+                    for w in pa.get("preferred", [])
+                ],
+            )
+        pod_anti = None
+        if "podAntiAffinity" in aff_d:
+            pa = aff_d["podAntiAffinity"]
+            pod_anti = PodAntiAffinity(
+                required=[_affinity_term_from_dict(t) for t in pa.get("required", [])],
+                preferred=[
+                    WeightedPodAffinityTerm(w["weight"], _affinity_term_from_dict(w["term"]))
+                    for w in pa.get("preferred", [])
+                ],
+            )
+        affinity = Affinity(
+            node_affinity=node_affinity, pod_affinity=pod_affinity, pod_anti_affinity=pod_anti
+        )
+    return Pod(
+        metadata=_meta_from_dict(d.get("metadata", {})),
+        spec=PodSpec(
+            node_selector=dict(spec_d.get("nodeSelector", {})),
+            node_name=spec_d.get("nodeName", ""),
+            affinity=affinity,
+            tolerations=[
+                Toleration(
+                    key=t.get("key", ""),
+                    operator=t.get("operator", "Equal"),
+                    value=t.get("value", ""),
+                    effect=t.get("effect", ""),
+                )
+                for t in spec_d.get("tolerations", [])
+            ],
+            containers=containers,
+            topology_spread_constraints=[
+                TopologySpreadConstraint(
+                    max_skew=c["maxSkew"],
+                    topology_key=c["topologyKey"],
+                    when_unsatisfiable=c.get("whenUnsatisfiable", "DoNotSchedule"),
+                    label_selector=_selector_from_dict(c.get("labelSelector")),
+                )
+                for c in spec_d.get("topologySpreadConstraints", [])
+            ],
+            priority=spec_d.get("priority"),
+        ),
+        status=PodStatus(phase=d.get("status", {}).get("phase", "Pending")),
+    )
+
+
+def provisioner_to_dict(p: Provisioner) -> Dict[str, Any]:
+    return {
+        "metadata": _meta_to_dict(p.metadata),
+        "spec": {
+            "labels": dict(p.spec.labels),
+            "annotations": dict(p.spec.annotations),
+            "taints": [
+                {"key": t.key, "value": t.value, "effect": t.effect} for t in p.spec.taints
+            ],
+            "startupTaints": [
+                {"key": t.key, "value": t.value, "effect": t.effect}
+                for t in p.spec.startup_taints
+            ],
+            "requirements": [_nsr_to_dict(r) for r in p.spec.requirements],
+            "ttlSecondsAfterEmpty": p.spec.ttl_seconds_after_empty,
+            "ttlSecondsUntilExpired": p.spec.ttl_seconds_until_expired,
+            "weight": p.spec.weight,
+            "limits": dict(p.spec.limits.resources) if p.spec.limits else None,
+            "consolidation": (
+                {"enabled": p.spec.consolidation.enabled} if p.spec.consolidation else None
+            ),
+        },
+    }
+
+
+def provisioner_from_dict(d: Dict[str, Any]) -> Provisioner:
+    spec_d = d.get("spec", {})
+    return Provisioner(
+        metadata=_meta_from_dict(d.get("metadata", {})),
+        spec=ProvisionerSpec(
+            labels=dict(spec_d.get("labels", {})),
+            annotations=dict(spec_d.get("annotations", {})),
+            taints=[
+                Taint(t["key"], t.get("value", ""), t.get("effect", "NoSchedule"))
+                for t in spec_d.get("taints", [])
+            ],
+            startup_taints=[
+                Taint(t["key"], t.get("value", ""), t.get("effect", "NoSchedule"))
+                for t in spec_d.get("startupTaints", [])
+            ],
+            requirements=[_nsr_from_dict(r) for r in spec_d.get("requirements", [])],
+            ttl_seconds_after_empty=spec_d.get("ttlSecondsAfterEmpty"),
+            ttl_seconds_until_expired=spec_d.get("ttlSecondsUntilExpired"),
+            weight=spec_d.get("weight"),
+            limits=(
+                Limits(resources=dict(spec_d["limits"])) if spec_d.get("limits") else None
+            ),
+            consolidation=(
+                Consolidation(enabled=spec_d["consolidation"]["enabled"])
+                if spec_d.get("consolidation")
+                else None
+            ),
+        ),
+    )
+
+
+def node_to_dict(n: Node) -> Dict[str, Any]:
+    return {
+        "metadata": _meta_to_dict(n.metadata),
+        "spec": {
+            "taints": [
+                {"key": t.key, "value": t.value, "effect": t.effect} for t in n.spec.taints
+            ],
+            "unschedulable": n.spec.unschedulable,
+            "providerID": n.spec.provider_id,
+        },
+        "status": {
+            "capacity": dict(n.status.capacity),
+            "allocatable": dict(n.status.allocatable),
+        },
+    }
+
+
+def node_from_dict(d: Dict[str, Any]) -> Node:
+    spec_d = d.get("spec", {})
+    status_d = d.get("status", {})
+    return Node(
+        metadata=_meta_from_dict(d.get("metadata", {})),
+        spec=NodeSpec(
+            taints=[
+                Taint(t["key"], t.get("value", ""), t.get("effect", "NoSchedule"))
+                for t in spec_d.get("taints", [])
+            ],
+            unschedulable=spec_d.get("unschedulable", False),
+            provider_id=spec_d.get("providerID", ""),
+        ),
+        status=NodeStatus(
+            capacity=dict(status_d.get("capacity", {})),
+            allocatable=dict(status_d.get("allocatable", {})),
+        ),
+    )

@@ -711,15 +711,22 @@ class SingleNodeConsolidation(_ConsolidationBase):
 class MultiNodeConsolidation(_ConsolidationBase):
     """Binary search over the first-N disruption-sorted prefix for the largest
     simultaneously-consolidatable set, m→1 replacement only
-    (multinodeconsolidation.go:41-165)."""
+    (multinodeconsolidation.go:41-165).  With ``use_tpu_kernel`` the search
+    runs as a parallel subset sweep on device (solver.consolidation) and only
+    the TTL validation stays on the host path."""
 
     name = "consolidation"
+    use_tpu_kernel = False
 
     def compute_command(self, candidates: List[CandidateNode]) -> Command:
         if not self.should_attempt():
             return Command(Action.DO_NOTHING)
         candidates = self.sort_and_filter_candidates(candidates)
-        cmd = self.first_n_consolidation_option(candidates, len(candidates))
+        cmd = None
+        if self.use_tpu_kernel:
+            cmd = self._tpu_search(candidates)
+        if cmd is None:
+            cmd = self.first_n_consolidation_option(candidates, len(candidates))
         if cmd.action == Action.DO_NOTHING:
             return cmd
         validation = Validation(
@@ -729,6 +736,27 @@ class MultiNodeConsolidation(_ConsolidationBase):
         if not validation.is_valid(cmd):
             return Command(Action.RETRY)
         return cmd
+
+    def _tpu_search(self, candidates: List[CandidateNode]) -> Optional[Command]:
+        """Device subset sweep; None falls back to the host binary search."""
+        from karpenter_core_tpu.models.snapshot import KernelUnsupported
+        from karpenter_core_tpu.solver.consolidation import TPUConsolidationSearch
+
+        if len(candidates) < 2:
+            return Command(Action.DO_NOTHING)
+        try:
+            search = TPUConsolidationSearch(
+                self.cloud_provider, self.kube_client.list_provisioners()
+            )
+            return search.compute_command(
+                candidates,
+                pending_pods=self.provisioning.get_pending_pods(),
+                state_nodes=self.cluster.snapshot_nodes(),
+                bound_pods=self.kube_client.list_pods(),
+            )
+        except KernelUnsupported as e:
+            log.debug("TPU consolidation unsupported for cluster shape, %s", e)
+            return None
 
     def first_n_consolidation_option(
         self, candidates: List[CandidateNode], max_parallel: int
@@ -815,6 +843,7 @@ class DeprovisioningController:
         recorder,
         cluster: Cluster,
         settings,
+        use_tpu_kernel: bool = False,
     ) -> None:
         self.clock = clock
         self.kube_client = kube_client
@@ -830,6 +859,7 @@ class DeprovisioningController:
         self.emptiness = Emptiness(clock, kube_client, cluster)
         self.empty_node_consolidation = EmptyNodeConsolidation(*base_args)
         self.multi_node_consolidation = MultiNodeConsolidation(*base_args)
+        self.multi_node_consolidation.use_tpu_kernel = use_tpu_kernel
         self.single_node_consolidation = SingleNodeConsolidation(*base_args)
         # test hook: invoked after replacements launch so suites can initialize
         # the nodes that the readiness wait polls for

@@ -134,8 +134,13 @@ def _class_signature(pod: Pod) -> tuple:
         )
         affinity_req_sig = (req_terms, pref_terms)
     req_sig = (selector_sig, affinity_req_sig)
-    requests = resources_util.ceiling(pod)
-    req_vec = tuple(sorted((k, round(v, 9)) for k, v in requests.items()))
+    # fast path for the dominant shape: one plain container, no limits/init
+    spec = pod.spec
+    if len(spec.containers) == 1 and not spec.init_containers and not spec.containers[0].resources.limits:
+        req_vec = tuple(sorted(spec.containers[0].resources.requests.items()))
+    else:
+        requests = resources_util.ceiling(pod)
+        req_vec = tuple(sorted((k, round(v, 9)) for k, v in requests.items()))
     tol_sig = tuple(
         sorted((t.key, t.operator, t.value, t.effect) for t in pod.spec.tolerations)
     )

@@ -69,7 +69,8 @@ class Operator:
             use_tpu_kernel=self.use_tpu_kernel,
         )
         self.deprovisioning = DeprovisioningController(
-            self.clock, kube, self.provisioning, provider, self.recorder, cluster, self.settings
+            self.clock, kube, self.provisioning, provider, self.recorder, cluster,
+            self.settings, use_tpu_kernel=self.use_tpu_kernel,
         )
         self.node_lifecycle = NodeController(self.clock, kube, provider, cluster, self.settings)
         self.termination = TerminationController(self.clock, kube, provider, self.recorder)

@@ -1,0 +1,91 @@
+"""Dynamic settings store.
+
+Mirror of /root/reference/pkg/operator/settingsstore/settingsstore.go:34-98 and
+apis/config/settings (knative UntypedStore): watches the
+``karpenter-global-settings`` ConfigMap-equivalent, blocks startup until it
+exists (or seeds it), parses-or-raises on updates, and hands the live Settings
+to every controller through a shared mutable holder.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from karpenter_core_tpu.apis.objects import ObjectMeta
+from karpenter_core_tpu.operator.settings import Settings
+
+log = logging.getLogger(__name__)
+
+SETTINGS_NAME = "karpenter-global-settings"
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+class SettingsStore:
+    """Live settings holder; controllers read attributes through it so updates
+    apply without rewiring (the role of InjectSettings, injectsettings.go:30-52)."""
+
+    def __init__(self, kube_client, defaults: Optional[Settings] = None) -> None:
+        self.kube_client = kube_client
+        self._settings = defaults or Settings()
+        self._lock = threading.Lock()
+        self._watchers: List[Callable[[Settings], None]] = []
+
+    # controllers read settings fields through the store
+    @property
+    def batch_max_duration(self) -> float:
+        return self.current.batch_max_duration
+
+    @property
+    def batch_idle_duration(self) -> float:
+        return self.current.batch_idle_duration
+
+    @property
+    def drift_enabled(self) -> bool:
+        return self.current.drift_enabled
+
+    @property
+    def current(self) -> Settings:
+        with self._lock:
+            return self._settings
+
+    def on_change(self, callback: Callable[[Settings], None]) -> None:
+        self._watchers.append(callback)
+
+    def start(self) -> "SettingsStore":
+        """Ensure the ConfigMap exists (the reference blocks startup until all
+        registered ConfigMaps appear, settingsstore.go:71-92) and watch it."""
+        existing = self.kube_client.get(ConfigMap, SETTINGS_NAME, "karpenter")
+        if existing is None:
+            self.kube_client.create(
+                ConfigMap(metadata=ObjectMeta(name=SETTINGS_NAME, namespace="karpenter"))
+            )
+        else:
+            self._apply(existing)
+        self.kube_client.watch(ConfigMap, self._on_event, replay=False)
+        return self
+
+    def _on_event(self, event_type: str, cm: ConfigMap) -> None:
+        if cm.metadata.name != SETTINGS_NAME or event_type == "DELETED":
+            return
+        self._apply(cm)
+
+    def _apply(self, cm: ConfigMap) -> None:
+        # parse-or-raise, mirroring the reference's panic-on-invalid contract
+        # (settings.go:61-66) — but on *updates* we keep the last good config
+        try:
+            parsed = Settings.from_config_map(cm.data)
+        except ValueError as e:
+            log.error("invalid settings update rejected, %s", e)
+            return
+        with self._lock:
+            self._settings = parsed
+        for callback in self._watchers:
+            callback(parsed)

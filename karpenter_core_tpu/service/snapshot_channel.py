@@ -1,0 +1,148 @@
+"""The snapshot channel: gRPC service in front of the TPU solver.
+
+The north-star architecture (BASELINE.json) keeps the controller plane where
+it is and ships cluster-state snapshots over gRPC to a solver sidecar on the
+TPU host — this module is that channel.  Requests carry pods, provisioners,
+and existing nodes (apis.codec wire dicts, msgpack-framed); responses carry
+node decisions, existing-node nominations, and failures.
+
+Implemented with gRPC generic method handlers (no codegen: the environment has
+no protoc python plugin) — the method contract is documented here and stable:
+
+    /karpenter.v1.SnapshotSolver/Solve   unary-unary, msgpack bytes
+    /karpenter.v1.SnapshotSolver/Health  unary-unary, empty → msgpack bytes
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+from typing import Dict, List, Optional
+
+import grpc
+import msgpack
+
+from karpenter_core_tpu.apis import codec
+from karpenter_core_tpu.models.snapshot import KernelUnsupported
+from karpenter_core_tpu.solver.tpu import TPUSolver
+from karpenter_core_tpu.state.cluster import StateNode
+
+log = logging.getLogger(__name__)
+
+SERVICE = "karpenter.v1.SnapshotSolver"
+
+
+class SnapshotSolverService(grpc.GenericRpcHandler):
+    """Stateless solver endpoint: each request is one snapshot solve."""
+
+    def __init__(self, cloud_provider) -> None:
+        self.cloud_provider = cloud_provider
+
+    # -- grpc plumbing --------------------------------------------------------
+
+    def service(self, handler_call_details):
+        method = handler_call_details.method
+        if method == f"/{SERVICE}/Solve":
+            return grpc.unary_unary_rpc_method_handler(self._solve)
+        if method == f"/{SERVICE}/Health":
+            return grpc.unary_unary_rpc_method_handler(self._health)
+        return None
+
+    # -- handlers -------------------------------------------------------------
+
+    def _health(self, request: bytes, context) -> bytes:
+        return msgpack.packb({"status": "ok"})
+
+    def _solve(self, request: bytes, context) -> bytes:
+        try:
+            req = msgpack.unpackb(request)
+            pods = [codec.pod_from_dict(p) for p in req.get("pods", [])]
+            provisioners = [
+                codec.provisioner_from_dict(p) for p in req.get("provisioners", [])
+            ]
+            daemonset_pods = [
+                codec.pod_from_dict(p) for p in req.get("daemonsetPods", [])
+            ]
+            state_nodes = []
+            for n in req.get("nodes", []):
+                state_node = StateNode(codec.node_from_dict(n["node"]))
+                for p in n.get("pods", []):
+                    state_node.update_for_pod(codec.pod_from_dict(p))
+                state_nodes.append(state_node)
+            bound = [
+                codec.pod_from_dict(p) for n in req.get("nodes", []) for p in n.get("pods", [])
+            ]
+
+            solver = TPUSolver(self.cloud_provider, provisioners, daemonset_pods)
+            results = solver.solve(pods, state_nodes=state_nodes or None, bound_pods=bound)
+
+            pod_index = {p.uid: i for i, p in enumerate(pods)}
+            response = {
+                "newNodes": [
+                    {
+                        "provisioner": n.provisioner_name,
+                        "instanceTypes": n.instance_type_names,
+                        "zones": n.zones,
+                        "requests": n.requests,
+                        "podIndices": [pod_index[p.uid] for p in n.pods if p.uid in pod_index],
+                    }
+                    for n in results.new_nodes
+                ],
+                "existingAssignments": {
+                    name: [pod_index[p.uid] for p in placed if p.uid in pod_index]
+                    for name, placed in results.existing_assignments.items()
+                },
+                "failedPodIndices": [
+                    pod_index[p.uid] for p in results.failed_pods if p.uid in pod_index
+                ],
+            }
+            return msgpack.packb(response)
+        except KernelUnsupported as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, f"kernel unsupported: {e}")
+        except Exception as e:  # noqa: BLE001 - surface as INTERNAL
+            log.exception("solve request failed")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+
+def serve(cloud_provider, address: str = "127.0.0.1:0", max_workers: int = 4):
+    """Start the sidecar; returns (server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((SnapshotSolverService(cloud_provider),))
+    port = server.add_insecure_port(address)
+    server.start()
+    log.info("snapshot solver listening on port %d", port)
+    return server, port
+
+
+class SnapshotSolverClient:
+    """Controller-plane client for the channel."""
+
+    def __init__(self, address: str) -> None:
+        self.channel = grpc.insecure_channel(address)
+        self._solve = self.channel.unary_unary(f"/{SERVICE}/Solve")
+        self._health = self.channel.unary_unary(f"/{SERVICE}/Health")
+
+    def health(self) -> Dict:
+        return msgpack.unpackb(self._health(msgpack.packb({})))
+
+    def solve(
+        self,
+        pods: List,
+        provisioners: List,
+        nodes: Optional[List[Dict]] = None,
+        daemonset_pods: Optional[List] = None,
+        timeout: float = 60.0,
+    ) -> Dict:
+        """nodes: [{"node": node_dict, "pods": [pod_dict, ...]}, ...]"""
+        request = msgpack.packb(
+            {
+                "pods": [codec.pod_to_dict(p) for p in pods],
+                "provisioners": [codec.provisioner_to_dict(p) for p in provisioners],
+                "daemonsetPods": [codec.pod_to_dict(p) for p in daemonset_pods or []],
+                "nodes": nodes or [],
+            }
+        )
+        return msgpack.unpackb(self._solve(request, timeout=timeout))
+
+    def close(self) -> None:
+        self.channel.close()

@@ -1,0 +1,173 @@
+"""Snapshot channel (gRPC sidecar), wire codec, and settings store."""
+
+import pytest
+
+from karpenter_core_tpu.apis import codec, labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    LabelSelector,
+    PodAffinityTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_core_tpu.testing import make_node, make_pod, make_pods, make_provisioner
+
+
+class TestCodec:
+    def test_pod_roundtrip(self):
+        pod = make_pod(
+            labels={"app": "web"},
+            requests={"cpu": 1, "memory": "1Gi"},
+            node_selector={labels_api.LABEL_TOPOLOGY_ZONE: "test-zone-1"},
+            tolerations=[Toleration(key="k", operator="Exists")],
+            topology_spread=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                    label_selector=LabelSelector(match_labels={"app": "web"}),
+                )
+            ],
+            pod_anti_affinity=[
+                PodAffinityTerm(
+                    topology_key=labels_api.LABEL_HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"app": "web"}),
+                )
+            ],
+        )
+        restored = codec.pod_from_dict(codec.pod_to_dict(pod))
+        assert restored.metadata.labels == pod.metadata.labels
+        assert restored.spec.node_selector == pod.spec.node_selector
+        assert restored.spec.tolerations[0].operator == "Exists"
+        assert restored.spec.topology_spread_constraints[0].max_skew == 1
+        assert restored.spec.affinity.pod_anti_affinity.required[0].topology_key == (
+            labels_api.LABEL_HOSTNAME
+        )
+        from karpenter_core_tpu.utils import resources as r
+
+        assert r.ceiling(restored) == r.ceiling(pod)
+
+    def test_provisioner_roundtrip(self):
+        p = make_provisioner(
+            weight=10,
+            taints=[Taint("k", "v")],
+            limits={"cpu": 100},
+            consolidation_enabled=True,
+        )
+        restored = codec.provisioner_from_dict(codec.provisioner_to_dict(p))
+        assert restored.name == p.name
+        assert restored.spec.weight == 10
+        assert restored.spec.limits.resources == {"cpu": 100.0}
+        assert restored.spec.consolidation.enabled
+
+    def test_node_roundtrip(self):
+        n = make_node(labels={"a": "b"}, taints=[Taint("t", "v")])
+        restored = codec.node_from_dict(codec.node_to_dict(n))
+        assert restored.name == n.name
+        assert restored.status.allocatable == n.status.allocatable
+        assert restored.spec.taints == n.spec.taints
+
+
+class TestSnapshotChannel:
+    @pytest.fixture()
+    def channel(self):
+        from karpenter_core_tpu.service.snapshot_channel import (
+            SnapshotSolverClient,
+            serve,
+        )
+
+        server, port = serve(FakeCloudProvider())
+        client = SnapshotSolverClient(f"127.0.0.1:{port}")
+        yield client
+        client.close()
+        server.stop(0)
+
+    def test_health(self, channel):
+        assert channel.health() == {"status": "ok"}
+
+    def test_solve_over_the_wire(self, channel):
+        pods = make_pods(5, requests={"cpu": "900m"})
+        response = channel.solve(pods, [make_provisioner()])
+        placed = sum(len(n["podIndices"]) for n in response["newNodes"])
+        assert placed == 5
+        assert response["failedPodIndices"] == []
+        for node in response["newNodes"]:
+            assert node["provisioner"] == "default"
+            assert node["instanceTypes"]
+
+    def test_solve_with_existing_nodes(self, channel):
+        node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+                labels_api.LABEL_CAPACITY_TYPE: "spot",
+                labels_api.LABEL_NODE_INITIALIZED: "true",
+                labels_api.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            },
+            allocatable={"cpu": 4, "memory": "4Gi", "pods": 10},
+        )
+        pods = make_pods(2, requests={"cpu": 1})
+        response = channel.solve(
+            pods,
+            [make_provisioner()],
+            nodes=[{"node": codec.node_to_dict(node), "pods": []}],
+        )
+        assigned = response["existingAssignments"]
+        assert sum(len(v) for v in assigned.values()) == 2
+        assert not response["newNodes"]
+
+    def test_unsupported_batch_rejected(self, channel):
+        import grpc
+
+        pod = make_pod(host_ports=[80])
+        with pytest.raises(grpc.RpcError) as excinfo:
+            channel.solve([pod], [make_provisioner()])
+        assert excinfo.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+
+class TestSettingsStore:
+    def test_live_update(self):
+        from karpenter_core_tpu.operator.kubeclient import KubeClient
+        from karpenter_core_tpu.operator.settingsstore import (
+            ConfigMap,
+            SETTINGS_NAME,
+            SettingsStore,
+        )
+        from karpenter_core_tpu.apis.objects import ObjectMeta
+
+        kube = KubeClient()
+        store = SettingsStore(kube).start()
+        assert store.batch_max_duration == 10.0
+        cm = kube.get(ConfigMap, SETTINGS_NAME, "karpenter")
+        cm.data = {"batchMaxDuration": "20s", "featureGates.driftEnabled": "true"}
+        kube.update(cm)
+        assert store.batch_max_duration == 20.0
+        assert store.drift_enabled
+
+    def test_invalid_update_keeps_last_good(self):
+        from karpenter_core_tpu.operator.kubeclient import KubeClient
+        from karpenter_core_tpu.operator.settingsstore import (
+            ConfigMap,
+            SETTINGS_NAME,
+            SettingsStore,
+        )
+
+        kube = KubeClient()
+        store = SettingsStore(kube).start()
+        cm = kube.get(ConfigMap, SETTINGS_NAME, "karpenter")
+        cm.data = {"batchMaxDuration": "not-a-duration"}
+        kube.update(cm)
+        assert store.batch_max_duration == 10.0
+
+
+class TestTPUConsolidationInController:
+    def test_controller_uses_tpu_sweep(self):
+        from tests.test_tpu_consolidation import build_cluster
+        from karpenter_core_tpu.controllers.deprovisioning import Result
+
+        env = build_cluster(n_nodes=2, pods_per_node=1, pod_cpu="500m", oversize=True)
+        env.deprovisioning.multi_node_consolidation.use_tpu_kernel = True
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.SUCCESS
+        # consolidated: fewer nodes than before
+        assert len(env.kube.list_nodes()) == 1
