@@ -1,0 +1,23 @@
+# Build/CI entry points (role of the reference's Makefile:8-24)
+
+PYTEST ?= python -m pytest
+
+presubmit: verify test  ## everything a PR needs to pass
+
+verify:  ## static checks: bytecode-compile the tree, build the native library
+	python -m compileall -q karpenter_core_tpu tests bench.py __graft_entry__.py
+	$(MAKE) -C native
+
+test:  ## the full suite (virtual 8-device CPU mesh)
+	$(PYTEST) tests/ -x -q
+
+perf:  ## performance-gated tests (reference: //go:build test_performance)
+	KC_TPU_PERF=1 $(PYTEST) tests/test_performance.py -q
+
+bench:  ## headline benchmark on the available accelerator
+	python bench.py
+
+graft-check:  ## driver contract: compile check + multi-chip dry run
+	python __graft_entry__.py
+
+.PHONY: presubmit verify test perf bench graft-check

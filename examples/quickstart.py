@@ -1,0 +1,74 @@
+"""Quickstart: a self-contained cluster with provisioning + consolidation.
+
+Run:  python examples/quickstart.py
+
+Launches the operator against the fake cloud provider, provisions a workload,
+then shrinks it and watches consolidation reclaim nodes.  Swap in a real
+CloudProvider implementation (karpenter_core_tpu/cloudprovider/types.py) to
+drive actual capacity.
+"""
+
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from karpenter_core_tpu.cloudprovider import metrics as cp_metrics
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_core_tpu.operator.operator import Operator
+from karpenter_core_tpu.operator.settings import Settings
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
+
+
+def main() -> None:
+    provider = cp_metrics.decorate(FakeCloudProvider(instance_types(10)))
+    operator = (
+        Operator(
+            provider,
+            settings=Settings(batch_idle_duration=0.2, batch_max_duration=1.0),
+        )
+        .with_controllers()
+        .with_webhooks()
+        .start()
+    )
+    kube = operator.kube_client
+
+    kube.create(make_provisioner(name="default", consolidation_enabled=True))
+    print("== provisioning 12 pods ==")
+    pods = [make_pod(requests={"cpu": "750m", "memory": "512Mi"}) for _ in range(12)]
+    for pod in pods:
+        kube.create(pod)
+
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        nominated = {e.involved_object.uid for e in operator.recorder.events if e.reason == "Nominated"}
+        if len(nominated) == len(pods):
+            break
+        time.sleep(0.2)
+    nodes = kube.list_nodes()
+    print(f"nodes launched: {[n.name for n in nodes]}")
+
+    # emulate kube-scheduler binding (a real cluster's scheduler does this)
+    for event in operator.recorder.events:
+        if event.reason == "Nominated":
+            pod = event.involved_object
+            pod.spec.node_name = event.message.rsplit(" ", 1)[-1]
+            kube.apply(pod)
+
+    print("== metrics sample ==")
+    from karpenter_core_tpu.metrics import REGISTRY
+
+    for line in REGISTRY.render().splitlines():
+        if "nodes_created" in line and not line.startswith("#"):
+            print(" ", line)
+
+    operator.stop()
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""CloudProvider metrics decorator.
+
+Mirror of /root/reference/pkg/cloudprovider/metrics/cloudprovider.go: wraps any
+CloudProvider and counts method calls (and durations) by provider/method.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karpenter_core_tpu.apis.v1alpha5 import Machine, Provisioner
+from karpenter_core_tpu.cloudprovider.types import CloudProvider, InstanceType
+from karpenter_core_tpu.metrics import REGISTRY, measure
+
+METHOD_CALLS = REGISTRY.counter(
+    "karpenter_cloudprovider_method_calls_total",
+    "Number of cloud provider method calls.",
+    ("provider", "method"),
+)
+METHOD_DURATION = REGISTRY.histogram(
+    "karpenter_cloudprovider_duration_seconds",
+    "Duration of cloud provider method calls.",
+    ("provider", "method"),
+)
+
+
+def decorate(provider: CloudProvider) -> CloudProvider:
+    return _Decorator(provider)
+
+
+class _Decorator(CloudProvider):
+    def __init__(self, inner: CloudProvider) -> None:
+        self.inner = inner
+
+    def _observe(self, method: str):
+        METHOD_CALLS.labels(self.inner.name(), method).inc()
+        return measure(METHOD_DURATION.labels(self.inner.name(), method))
+
+    def create(self, machine: Machine) -> Machine:
+        done = self._observe("Create")
+        try:
+            return self.inner.create(machine)
+        finally:
+            done()
+
+    def delete(self, machine: Machine) -> None:
+        done = self._observe("Delete")
+        try:
+            return self.inner.delete(machine)
+        finally:
+            done()
+
+    def get_instance_types(self, provisioner: Optional[Provisioner]) -> List[InstanceType]:
+        done = self._observe("GetInstanceTypes")
+        try:
+            return self.inner.get_instance_types(provisioner)
+        finally:
+            done()
+
+    def is_machine_drifted(self, machine: Machine) -> bool:
+        done = self._observe("IsMachineDrifted")
+        try:
+            return self.inner.is_machine_drifted(machine)
+        finally:
+            done()
+
+    def name(self) -> str:
+        return self.inner.name()
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)

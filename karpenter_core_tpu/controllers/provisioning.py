@@ -201,6 +201,9 @@ class ProvisioningController:
         self.volume_topology = VolumeTopology(kube_client)
         self.use_tpu_kernel = use_tpu_kernel
         self.tpu_kernel_min_pods = tpu_kernel_min_pods
+        from karpenter_core_tpu.utils.pretty import ChangeMonitor
+
+        self._change_monitor = ChangeMonitor(ttl_seconds=3600.0)
 
     def trigger(self) -> None:
         self.batcher.trigger()
@@ -254,8 +257,33 @@ class ProvisioningController:
             if err is not None:
                 log.debug("ignoring pod %s/%s, %s", pod.namespace, pod.name, err)
                 continue
+            self._consolidation_warnings(pod)
             pods.append(pod)
         return pods
+
+    def _consolidation_warnings(self, pod: Pod) -> None:
+        """Warn (hourly, deduped) about constraints that can block consolidation
+        (provisioner.go:216-235)."""
+        affinity = pod.spec.affinity
+        if (
+            affinity is not None
+            and affinity.pod_anti_affinity is not None
+            and affinity.pod_anti_affinity.preferred
+        ):
+            if self._change_monitor.has_changed((pod.uid, "pod-antiaffinity"), True):
+                log.info(
+                    "pod %s/%s has a preferred Anti-Affinity which can prevent consolidation",
+                    pod.namespace, pod.name,
+                )
+        for constraint in pod.spec.topology_spread_constraints:
+            if constraint.when_unsatisfiable == "ScheduleAnyway":
+                if self._change_monitor.has_changed((pod.uid, "pod-topology-spread"), True):
+                    log.info(
+                        "pod %s/%s has a preferred TopologySpreadConstraint which can "
+                        "prevent consolidation",
+                        pod.namespace, pod.name,
+                    )
+                break
 
     def schedule(self, pods: List[Pod], state_nodes) -> Tuple[Optional[SchedulingResults], Optional[str]]:
         done = measure(SCHEDULING_DURATION.labels("default"))

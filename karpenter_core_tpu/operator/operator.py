@@ -55,6 +55,12 @@ class Operator:
             self.kube_client = KubeClient(self.clock)
         if self.recorder is None:
             self.recorder = Recorder(clock=self.clock.now)
+        # live settings: controllers read through the store so ConfigMap
+        # updates apply without rewiring (settingsstore.go:94-98)
+        from karpenter_core_tpu.operator.settingsstore import SettingsStore
+
+        self.settings_store = SettingsStore(self.kube_client, defaults=self.settings)
+        self.settings = self.settings_store
         self.cluster = Cluster(self.clock, self.kube_client, self.cloud_provider, self.settings)
         self._singletons: List[Singleton] = []
         self._watchers: List[TypedWatchController] = []
@@ -125,6 +131,7 @@ class Operator:
 
     def start(self) -> "Operator":
         """Start informers, watch controllers, and singleton loops."""
+        self.settings_store.start()
         start_informers(self.cluster, self.kube_client)
         for watcher in self._watchers:
             watcher.start()

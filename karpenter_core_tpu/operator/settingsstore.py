@@ -61,16 +61,29 @@ class SettingsStore:
 
     def start(self) -> "SettingsStore":
         """Ensure the ConfigMap exists (the reference blocks startup until all
-        registered ConfigMaps appear, settingsstore.go:71-92) and watch it."""
+        registered ConfigMaps appear, settingsstore.go:71-92) and watch it.
+        The seed serializes the store's defaults so a restart re-reading the
+        seeded ConfigMap reproduces them instead of resetting to globals."""
         existing = self.kube_client.get(ConfigMap, SETTINGS_NAME, "karpenter")
         if existing is None:
             self.kube_client.create(
-                ConfigMap(metadata=ObjectMeta(name=SETTINGS_NAME, namespace="karpenter"))
+                ConfigMap(
+                    metadata=ObjectMeta(name=SETTINGS_NAME, namespace="karpenter"),
+                    data=self._serialize(self.current),
+                )
             )
         else:
             self._apply(existing)
         self.kube_client.watch(ConfigMap, self._on_event, replay=False)
         return self
+
+    @staticmethod
+    def _serialize(settings: Settings) -> Dict[str, str]:
+        return {
+            "batchMaxDuration": f"{settings.batch_max_duration}s",
+            "batchIdleDuration": f"{settings.batch_idle_duration}s",
+            "featureGates.driftEnabled": "true" if settings.drift_enabled else "false",
+        }
 
     def _on_event(self, event_type: str, cm: ConfigMap) -> None:
         if cm.metadata.name != SETTINGS_NAME or event_type == "DELETED":

@@ -100,6 +100,24 @@ _sweep_jit = functools.partial(
 )(sweep)
 
 
+@functools.lru_cache(maxsize=16)
+def _sharded_sweep_fn(mesh, key_has_bounds, n_slots: int):
+    """Cached jitted sweep with the lane axis sharded over the mesh — a fresh
+    closure per call would defeat JAX's compile cache (keyed on callable
+    identity) and recompile every sweep."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    lane_sharded = NamedSharding(mesh, P("replica"))
+
+    def core(sizes_arg, cls_arg, statics_arg, ex_state_arg, ex_static_arg, rank_arg, counts_arg):
+        return sweep(
+            cls_arg, statics_arg, key_has_bounds, ex_state_arg, ex_static_arg,
+            rank_arg, counts_arg, sizes_arg, n_slots=n_slots,
+        )
+
+    return jax.jit(core, in_shardings=(lane_sharded, None, None, None, None, None, None))
+
+
 def run_sweep(
     snapshot,
     ex_state,
@@ -108,8 +126,29 @@ def run_sweep(
     ex_cls_count: np.ndarray,
     prefix_sizes: np.ndarray,
     n_slots: int = 16,
+    mesh=None,
 ) -> SweepOutputs:
+    """With ``mesh``, the lane (prefix) axis shards across devices — each chip
+    simulates its share of the subsets; lanes are independent so the only
+    cross-device traffic is the gather of per-lane results."""
     cls, statics_arrays, key_has_bounds = solve_ops.prepare(snapshot)
+    sizes = jnp.asarray(prefix_sizes)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n_dev = mesh.devices.size
+        pad = (-len(prefix_sizes)) % n_dev
+        if pad:
+            sizes = jnp.concatenate([sizes, jnp.repeat(sizes[-1:], pad)])
+        fn = _sharded_sweep_fn(mesh, key_has_bounds, n_slots)
+        with mesh:
+            out = fn(
+                sizes, cls, statics_arrays, ex_state, ex_static,
+                jnp.asarray(candidate_rank), jnp.asarray(ex_cls_count),
+            )
+        if pad:
+            out = SweepOutputs(*(np.asarray(plane)[: len(prefix_sizes)] for plane in out))
+        return out
     return _sweep_jit(
         cls,
         statics_arrays,
@@ -118,6 +157,6 @@ def run_sweep(
         ex_static,
         jnp.asarray(candidate_rank),
         jnp.asarray(ex_cls_count),
-        jnp.asarray(prefix_sizes),
+        sizes,
         n_slots=n_slots,
     )

@@ -1,0 +1,42 @@
+"""Change-deduped logging helper.
+
+Mirror of /root/reference/pkg/utils/pretty/changemonitor.go:31-45: HasChanged
+returns True only when the value for a key differs from the last observation
+or the entry's TTL has lapsed — used to log once per condition (and re-warn
+after the TTL) instead of every reconcile.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Hashable, Tuple
+
+
+class ChangeMonitor:
+    def __init__(self, ttl_seconds: float = 24 * 3600.0, clock=time.monotonic) -> None:
+        self.ttl = ttl_seconds
+        self.clock = clock
+        self._seen: Dict[Hashable, Tuple[Hashable, float]] = {}
+
+    def has_changed(self, key: Hashable, value: Hashable) -> bool:
+        now = self.clock()
+        self._sweep(now)
+        last = self._seen.get(key)
+        # the timestamp only refreshes when the observation changes or expires
+        # (go-cache Get does not extend expiry) so the TTL re-fire works under
+        # continuous observation
+        if last is not None:
+            last_value, last_time = last
+            if last_value == value and now - last_time <= self.ttl:
+                return False
+        self._seen[key] = (value, now)
+        return True
+
+    def _sweep(self, now: float) -> None:
+        """Opportunistic eviction of expired entries (the reference runs a
+        go-cache janitor); keeps memory bounded under pod churn."""
+        if len(self._seen) < 1024:
+            return
+        expired = [k for k, (_, ts) in self._seen.items() if now - ts > self.ttl]
+        for k in expired:
+            del self._seen[k]

@@ -1,0 +1,269 @@
+"""Extended topology + instance-selection scenarios.
+
+Ports the behavioral cases of the reference's largest suites
+(/root/reference/pkg/controllers/provisioning/scheduling/topology_test.go,
+instance_selection_test.go) against the host scheduler via the suite harness:
+spread with existing cluster pods, combined constraints, multi-level spreads,
+inverse anti-affinity, capacity-type/arch/os selection.
+"""
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_IN,
+    OP_NOT_IN,
+    LabelSelector,
+    NodeSelectorRequirement,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
+from karpenter_core_tpu.testing.harness import (
+    expect_provisioned,
+    make_environment,
+)
+
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+HOSTNAME = labels_api.LABEL_HOSTNAME
+CT = labels_api.LABEL_CAPACITY_TYPE
+ARCH = labels_api.LABEL_ARCH_STABLE
+OS = labels_api.LABEL_OS_STABLE
+
+
+def spread_pod(app="web", key=ZONE, skew=1, **kwargs):
+    return make_pod(
+        labels={"app": app},
+        requests=kwargs.pop("requests", {"cpu": "10m"}),
+        topology_spread=[
+            TopologySpreadConstraint(
+                max_skew=skew,
+                topology_key=key,
+                label_selector=LabelSelector(match_labels={"app": app}),
+            )
+        ],
+        **kwargs,
+    )
+
+
+def zone_skew(env):
+    """pod-count per zone for app=web pods, via bound nodes."""
+    counts = {}
+    for pod in env.kube.list_pods():
+        if pod.metadata.labels.get("app") != "web" or not pod.spec.node_name:
+            continue
+        node = env.kube.get_node(pod.spec.node_name)
+        zone = node.metadata.labels.get(ZONE)
+        counts[zone] = counts.get(zone, 0) + 1
+    return counts
+
+
+class TestSpreadWithExistingCluster:
+    def test_spread_counts_existing_pods(self):
+        """Pods already in the cluster count toward skew (topology.go:231-276)."""
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        # round 1: three spread pods land balanced
+        result = expect_provisioned(env, *[spread_pod() for _ in range(3)])
+        env.make_all_nodes_ready()
+        assert sorted(zone_skew(env).values()) == [1, 1, 1]
+        # round 2: three more — balance must extend to 2/2/2, not restart
+        expect_provisioned(env, *[spread_pod() for _ in range(3)])
+        assert sorted(zone_skew(env).values()) == [2, 2, 2]
+
+    def test_spread_respects_do_not_schedule(self):
+        """Skew violations leave pods pending rather than violating."""
+        env = make_environment()
+        env.kube.create(
+            make_provisioner(
+                requirements=[NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1"])]
+            )
+        )
+        pods = [spread_pod() for _ in range(3)]
+        result = expect_provisioned(env, *pods)
+        # only one zone available: maxSkew=1 allows only 1 pod (min over the
+        # full domain universe stays 0 for the unreachable zones)
+        scheduled = [p for p in pods if result[p.uid] is not None]
+        assert len(scheduled) == 1
+
+    def test_schedule_anyway_spread_violates_when_needed(self):
+        env = make_environment()
+        env.kube.create(
+            make_provisioner(
+                requirements=[NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1"])]
+            )
+        )
+        pods = [
+            make_pod(
+                labels={"app": "web"},
+                requests={"cpu": "10m"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=ZONE,
+                        when_unsatisfiable="ScheduleAnyway",
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ],
+            )
+            for _ in range(4)
+        ]
+        result = expect_provisioned(env, *pods)
+        assert all(result[p.uid] is not None for p in pods)
+
+
+class TestCombinedConstraints:
+    def test_zone_and_hostname_spread_together(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pods = [
+            make_pod(
+                labels={"app": "web"},
+                requests={"cpu": "10m"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=ZONE,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    ),
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    ),
+                ],
+            )
+            for _ in range(6)
+        ]
+        result = expect_provisioned(env, *pods)
+        env.make_all_nodes_ready()
+        assert all(result[p.uid] is not None for p in pods)
+        assert sorted(zone_skew(env).values()) == [2, 2, 2]
+        # hostname spread: max 1 per node → 6 nodes
+        assert len(env.kube.list_nodes()) == 6
+
+    def test_spread_plus_anti_affinity(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pods = [
+            make_pod(
+                labels={"app": "web"},
+                requests={"cpu": "10m"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=ZONE,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ],
+                pod_anti_affinity=[
+                    PodAffinityTerm(
+                        topology_key=HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ],
+            )
+            for _ in range(3)
+        ]
+        result = expect_provisioned(env, *pods)
+        assert all(result[p.uid] is not None for p in pods)
+        assert len({result[p.uid].name for p in pods}) == 3  # distinct nodes
+
+    def test_inverse_anti_affinity_blocks_later_pods(self):
+        """A pod WITHOUT anti-affinity can't land where an anti-affinity pod
+        that selects it already runs (topology.go:44-47 inverse topologies)."""
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        guard = make_pod(
+            labels={"app": "lonely"},
+            requests={"cpu": "10m"},
+            pod_anti_affinity=[
+                PodAffinityTerm(
+                    topology_key=HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"role": "noisy"}),
+                )
+            ],
+        )
+        result = expect_provisioned(env, guard)
+        guard_node = result[guard.uid]
+        assert guard_node is not None
+        env.make_all_nodes_ready()
+        # the noisy pod must avoid the guard's node
+        noisy = make_pod(labels={"role": "noisy"}, requests={"cpu": "10m"})
+        result = expect_provisioned(env, noisy)
+        noisy_node = result[noisy.uid]
+        assert noisy_node is not None
+        assert noisy_node.name != guard_node.name
+
+
+class TestInstanceSelection:
+    def test_arch_selection(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(
+            node_requirements=[NodeSelectorRequirement(ARCH, OP_IN, ["arm64"])]
+        )
+        result = expect_provisioned(env, pod)
+        node = result[pod.uid]
+        assert node is not None
+        assert node.metadata.labels[labels_api.LABEL_INSTANCE_TYPE_STABLE] == "arm-instance-type"
+
+    def test_os_selection(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(node_selector={OS: "ios"})
+        result = expect_provisioned(env, pod)
+        node = result[pod.uid]
+        assert node is not None
+        assert node.metadata.labels[labels_api.LABEL_INSTANCE_TYPE_STABLE] == "arm-instance-type"
+
+    def test_capacity_type_not_in(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(
+            node_requirements=[NodeSelectorRequirement(CT, OP_NOT_IN, ["spot"])]
+        )
+        result = expect_provisioned(env, pod)
+        node = result[pod.uid]
+        assert node is not None
+        assert node.metadata.labels[CT] == "on-demand"
+
+    def test_cheapest_compatible_instance_launches(self):
+        env = make_environment(instance_types=fake_cp.instance_types(20))
+        env.kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": "1500m"})
+        result = expect_provisioned(env, pod)
+        node = result[pod.uid]
+        # cheapest type with >=1.5 cpu allocatable (plus overhead) is fake-it-1
+        assert node.metadata.labels[labels_api.LABEL_INSTANCE_TYPE_STABLE] == "fake-it-1"
+
+    def test_fragmented_batch_packs_few_nodes(self):
+        """Mixed sizes pack via FFD instead of one node per pod."""
+        env = make_environment(instance_types=fake_cp.instance_types(10))
+        env.kube.create(make_provisioner())
+        pods = (
+            make_pods(4, requests={"cpu": 3})
+            + make_pods(8, requests={"cpu": 1})
+            + make_pods(16, requests={"cpu": "250m"})
+        )
+        result = expect_provisioned(env, *pods)
+        assert all(result[p.uid] is not None for p in pods)
+        # total 24 cpu across pods; nodes reach 10 cpu: expect <= 5 nodes
+        assert len(env.kube.list_nodes()) <= 5
+
+
+class TestProvisionerLimitsEndToEnd:
+    def test_usage_accumulates_and_blocks(self):
+        from karpenter_core_tpu.controllers.counter import CounterController
+
+        env = make_environment()
+        env.kube.create(make_provisioner(limits={"cpu": 6}))
+        counter = CounterController(env.kube, env.cluster)
+        pod1 = make_pod(requests={"cpu": 1})
+        result = expect_provisioned(env, pod1)
+        assert result[pod1.uid] is not None
+        env.make_all_nodes_ready()
+        counter.reconcile_all()
+        # first node (4cpu capacity) counted; pessimistic remaining blocks a
+        # second large node
+        pod2 = make_pod(requests={"cpu": 4})
+        result = expect_provisioned(env, pod2)
+        # 4cpu pod needs a >=4cpu-allocatable node: only arm (16cpu) fits, and
+        # 16 > remaining 2 → blocked
+        assert result[pod2.uid] is None
