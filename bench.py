@@ -33,13 +33,16 @@ def build_inputs(n_pods: int, n_instance_types: int, n_provisioners: int):
     ]
     solver = TPUSolver(provider, provisioners)
 
+    from karpenter_core_tpu.apis.objects import PodAffinityTerm
+
     # pod mix mirroring the reference benchmark's makeDiversePods shape
-    # (scheduling_benchmark_test.go:185-197), minus pod-affinity which the
-    # kernel does not yet model: generic + zonal spread + hostname spread.
+    # (scheduling_benchmark_test.go:185-197): generic + zonal spread +
+    # hostname spread + pod (self-)affinity.
     pods = []
     n_spread = n_pods // 7
     n_host_spread = n_pods // 7
-    n_generic = n_pods - n_spread - n_host_spread
+    n_affinity = 2 * n_pods // 7
+    n_generic = n_pods - n_spread - n_host_spread - n_affinity
     sizes = [
         {"cpu": "500m", "memory": "512Mi"},
         {"cpu": 1, "memory": "2Gi"},
@@ -72,6 +75,25 @@ def build_inputs(n_pods: int, n_instance_types: int, n_provisioners: int):
                         max_skew=1,
                         topology_key=labels_api.LABEL_HOSTNAME,
                         label_selector=LabelSelector(match_labels={"app": "hspread"}),
+                    )
+                ],
+            )
+        )
+    # zone self-affinity groups over a 7-value label pool — the reference's
+    # 2/7 affinity share draws labels/selectors from the same 7 values
+    # (scheduling_benchmark_test.go:263-278); self-selecting keeps the batch
+    # kernel-eligible (independent label/selector draws couple groups across
+    # classes and would route to the host path)
+    for i in range(n_affinity):
+        group = f"g{i % 7}"
+        pods.append(
+            make_pod(
+                labels={"aff-group": group},
+                requests={"cpu": "250m", "memory": "256Mi"},
+                pod_affinity=[
+                    PodAffinityTerm(
+                        topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                        label_selector=LabelSelector(match_labels={"aff-group": group}),
                     )
                 ],
             )

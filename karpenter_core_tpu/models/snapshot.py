@@ -48,6 +48,8 @@ class PodClass:
     host_spread_skew: Optional[int] = None
     zone_anti_affinity: bool = False
     host_anti_affinity: bool = False
+    zone_affinity: bool = False  # self-affinity: colocate the class in one zone
+    host_affinity: bool = False  # self-affinity: colocate the class on one node
 
     @property
     def count(self) -> int:
@@ -100,6 +102,8 @@ class EncodedSnapshot:
     cls_zone_skew: np.ndarray = None  # i32[C] spread skew (UNLIMITED = none)
     cls_host_cap: np.ndarray = None  # i32[C] max pods per node
     cls_zone_count0: np.ndarray = None  # i32[C, Z] pre-existing group counts
+    cls_zone_aff: np.ndarray = None  # bool[C] self-affinity on zone
+    cls_host_aff: np.ndarray = None  # bool[C] self-affinity on hostname
 
     # vocabulary statics
     valid: np.ndarray = None  # bool[K, V+1]
@@ -223,6 +227,23 @@ def classify_pods(pods: List[Pod]) -> List[PodClass]:
         cls.pods.append(pod)
 
     classes = [groups[sig] for sig in order]
+
+    # the kernel counts topology per class (group == class); a selector that
+    # also matches ANOTHER class's pods couples the groups and needs the host
+    # path's shared-group counting
+    for cls in classes:
+        selectors = _constraint_selectors(cls.pods[0])
+        if not selectors:
+            continue
+        for other in classes:
+            if other is cls:
+                continue
+            other_labels = other.pods[0].metadata.labels
+            if any(s.matches(other_labels) for s in selectors):
+                raise KernelUnsupported(
+                    "topology selector spans multiple pod classes"
+                )
+
     # FFD: cpu desc, then memory desc (queue.go:74-110)
     classes.sort(
         key=lambda c: (
@@ -231,6 +252,20 @@ def classify_pods(pods: List[Pod]) -> List[PodClass]:
         )
     )
     return classes
+
+
+def _constraint_selectors(pod: Pod) -> List[LabelSelector]:
+    selectors = []
+    for constraint in pod.spec.topology_spread_constraints:
+        if constraint.when_unsatisfiable == "DoNotSchedule" and constraint.label_selector:
+            selectors.append(constraint.label_selector)
+    if pod.spec.affinity is not None:
+        for group in (pod.spec.affinity.pod_affinity, pod.spec.affinity.pod_anti_affinity):
+            if group is not None:
+                for term in group.required:
+                    if term.label_selector is not None:
+                        selectors.append(term.label_selector)
+    return selectors
 
 
 def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
@@ -249,8 +284,21 @@ def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
             )
     affinity = pod.spec.affinity
     if affinity is not None:
-        if affinity.pod_affinity is not None and affinity.pod_affinity.required:
-            raise KernelUnsupported("required pod affinity not kernel-supported")
+        if affinity.pod_affinity is not None:
+            for term in affinity.pod_affinity.required:
+                # only *self*-affinity is kernel-supported: the group colocates
+                # with itself (the dominant benchmark shape); affinity to other
+                # groups needs the host path's cross-group resolution
+                if not _self_selecting(pod, term.label_selector):
+                    raise KernelUnsupported("pod affinity selector not self-selecting")
+                if term.topology_key == labels_api.LABEL_TOPOLOGY_ZONE:
+                    cls.zone_affinity = True
+                elif term.topology_key == labels_api.LABEL_HOSTNAME:
+                    cls.host_affinity = True
+                else:
+                    raise KernelUnsupported(
+                        f"pod affinity on {term.topology_key} not kernel-supported"
+                    )
         if affinity.pod_anti_affinity is not None:
             for term in affinity.pod_anti_affinity.required:
                 if not _self_selecting(pod, term.label_selector):
@@ -266,6 +314,12 @@ def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
     for container in pod.spec.containers:
         if any(p.host_port for p in container.ports):
             raise KernelUnsupported("host ports not kernel-supported")
+    if cls.zone_affinity and cls.zone_spread_skew is not None:
+        raise KernelUnsupported("combined zone spread + zone affinity not kernel-supported")
+    if cls.zone_affinity and cls.zone_anti_affinity:
+        raise KernelUnsupported("combined zone affinity + anti-affinity not kernel-supported")
+    if cls.host_affinity and (cls.host_spread_skew is not None or cls.host_anti_affinity):
+        raise KernelUnsupported("combined hostname affinity + spread/anti not kernel-supported")
 
 
 def encode_snapshot(
@@ -411,6 +465,8 @@ def encode_snapshot(
     snap.cls_zone_skew = np.full(C, UNLIMITED, dtype=np.int32)
     snap.cls_host_cap = np.full(C, UNLIMITED, dtype=np.int32)
     snap.cls_zone_count0 = np.zeros((C, Z), dtype=np.int32)
+    snap.cls_zone_aff = np.zeros(C, dtype=bool)
+    snap.cls_host_aff = np.zeros(C, dtype=bool)
     for c, cls in enumerate(classes):
         reqs = cls.requirements
         snap.cls_zone[c] = encode_value_set(
@@ -445,5 +501,7 @@ def encode_snapshot(
             # hostname min-count is always 0 (a new node is always possible,
             # topologygroup.go:184-188), so per-node cap = maxSkew
             snap.cls_host_cap[c] = cls.host_spread_skew
+        snap.cls_zone_aff[c] = cls.zone_affinity
+        snap.cls_host_aff[c] = cls.host_affinity
 
     return snap

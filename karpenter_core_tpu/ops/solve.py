@@ -264,6 +264,8 @@ class ClassTensors(NamedTuple):
     zone_skew: jnp.ndarray
     host_cap: jnp.ndarray
     zone_count0: jnp.ndarray
+    zone_aff: jnp.ndarray
+    host_aff: jnp.ndarray
 
 
 def _phase_existing(
@@ -276,10 +278,14 @@ def _phase_existing(
     collapse_zone: bool,
     host_count0_row: jnp.ndarray,
     tol_row: jnp.ndarray,
+    extra_elig: Optional[jnp.ndarray] = None,
+    single_node: bool = False,
 ) -> Tuple[ExistingState, jnp.ndarray, jnp.ndarray]:
     """Place up to ``quota`` pods of the class onto existing nodes, in index
     order (the reference iterates existing nodes first, in order, and takes the
-    first that accepts — scheduler.go:176-180)."""
+    first that accepts — scheduler.go:176-180).  ``extra_elig`` restricts to a
+    node subset (affinity targets); ``single_node`` pins the whole quota to the
+    first eligible node (hostname self-affinity bootstrap)."""
     n_ex = ex.used.shape[0]
 
     node_t = mask_ops.ReqTensor(ex.kmask, ex.kdef, ex.kneg, ex.kgt, ex.klt)
@@ -306,8 +312,13 @@ def _phase_existing(
     cap = jnp.minimum(cap, BIG).astype(jnp.int32)
 
     elig = ex.open_ & key_ok & tol_row & jnp.any(zone_ok, axis=-1) & jnp.any(ct_ok, axis=-1)
+    if extra_elig is not None:
+        elig = elig & extra_elig
     host_cap = jnp.maximum(cls.host_cap - host_count0_row, 0)
     cap = jnp.where(elig, jnp.minimum(cap, host_cap), 0)
+    if single_node:
+        first = jnp.argmax(cap > 0)
+        cap = jnp.where(jnp.arange(n_ex) == first, cap, 0)
 
     priority = jnp.where(cap > 0, jnp.arange(n_ex, dtype=jnp.int32), jnp.iinfo(jnp.int32).max)
     assigned = _fill_by_priority(quota, cap, priority)
@@ -339,10 +350,12 @@ def _phase(
     quota: jnp.ndarray,
     zone_restrict: jnp.ndarray,
     collapse_zone: bool,
+    max_new_nodes: Optional[int] = None,
 ) -> Tuple[NodeState, jnp.ndarray, jnp.ndarray]:
     """Place up to ``quota`` pods of the class on nodes whose zone mask meets
     ``zone_restrict`` — first onto open nodes, then fresh nodes from the first
-    viable template.  Returns (state, assigned[N], placed)."""
+    viable template.  Returns (state, assigned[N], placed).  ``max_new_nodes``
+    caps node openings (hostname self-affinity bootstraps exactly one)."""
     n_slots = state.used.shape[0]
     n_tmpl = statics.tmpl_it.shape[0]
 
@@ -370,6 +383,10 @@ def _phase(
         & jnp.any(ct_ok, axis=-1)
     )
     cap_n = jnp.where(elig, jnp.minimum(cap_n, cls.host_cap), 0)
+    if max_new_nodes is not None:
+        # hostname self-affinity bootstrap: at most one node hosts the class
+        first = jnp.argmax(cap_n > 0)
+        cap_n = jnp.where(jnp.arange(n_slots) == first, cap_n, 0)
 
     # node order: emptiest first (pod count, then slot index); pod_count and
     # slot count both stay far below 2^15 so the packed key fits int32
@@ -432,6 +449,10 @@ def _phase(
     n_new = jnp.where(t_ok & (rem > 0), -(-rem // per_node), 0)
     free_slots = n_slots - state.n_next
     n_new = jnp.minimum(n_new, free_slots)
+    if max_new_nodes is not None:
+        # single-node semantics: once the class bootstrapped onto an open
+        # slot, the remainder must join it — no fresh node for the overflow
+        n_new = jnp.where(placed_existing > 0, 0, jnp.minimum(n_new, max_new_nodes))
 
     slot_idx = jnp.arange(n_slots)
     is_new = (slot_idx >= state.n_next) & (slot_idx < state.n_next + n_new)
@@ -486,14 +507,31 @@ def _class_step(
     placed_total = jnp.int32(0)
 
     def run_phase(state, ex, quota, restrict, collapse):
-        ex, a_ex, placed_ex = _phase_existing(
-            ex, ex_static, cls, statics, quota, restrict, collapse,
-            host_count0_row, tol_row,
-        )
-        state, a_new, placed_new = _phase(
-            state, cls, statics, quota - placed_ex, restrict, collapse_zone=collapse
-        )
-        return state, ex, a_new, a_ex, placed_ex + placed_new
+        """Wrapped in lax.cond so zero-quota phases (most of them: each class
+        participates in 1-2 of the Z+4 phase kinds) cost nothing on device."""
+
+        def do(operand):
+            state_i, ex_i = operand
+            ex_o, a_ex, placed_ex = _phase_existing(
+                ex_i, ex_static, cls, statics, quota, restrict, collapse,
+                host_count0_row, tol_row,
+            )
+            state_o, a_new, placed_new = _phase(
+                state_i, cls, statics, quota - placed_ex, restrict, collapse_zone=collapse
+            )
+            return state_o, ex_o, a_new, a_ex, placed_ex + placed_new
+
+        def skip(operand):
+            state_i, ex_i = operand
+            return (
+                state_i,
+                ex_i,
+                jnp.zeros_like(state_i.pod_count),
+                jnp.zeros_like(ex_i.pod_count),
+                jnp.int32(0),
+            )
+
+        return jax.lax.cond(quota > 0, do, skip, (state, ex))
 
     # zone-constrained phases (spread classes commit one zone per phase)
     for z in range(n_zones):
@@ -514,9 +552,67 @@ def _class_step(
     assigned_ex_total = assigned_ex_total + assigned_ex
     placed_total = placed_total + placed
 
-    # unconstrained phase for plain classes
-    any_quota = jnp.where(spread | anti, 0, m)
+    # zone self-affinity: nonzero-count zones when matching pods exist,
+    # else bootstrap a single allowed zone (topologygroup.go:202-233)
+    zone_aff = cls.zone_aff
+    host_aff = cls.host_aff
+    nonzero_zones = cls.zone & (cls.zone_count0 > 0)
+    bootstrap_zone = (
+        jnp.zeros(n_zones, dtype=bool).at[jnp.argmax(cls.zone)].set(jnp.any(cls.zone))
+    )
+    zone_aff_restrict = jnp.where(jnp.any(nonzero_zones), nonzero_zones, bootstrap_zone)
+    zone_aff_quota = jnp.where(zone_aff & ~host_aff, m, 0)
+    state, ex, assigned, assigned_ex, placed = run_phase(
+        state, ex, zone_aff_quota, zone_aff_restrict, True
+    )
+    assigned_total = assigned_total + assigned
+    assigned_ex_total = assigned_ex_total + assigned_ex
+    placed_total = placed_total + placed
+
+    # hostname self-affinity: fill target nodes (count>0) when they exist,
+    # else bootstrap the whole class onto exactly one node
     all_zones = jnp.ones(n_zones, dtype=bool)
+    host_restrict = jnp.where(zone_aff, zone_aff_restrict, all_zones)
+    host_targets = host_count0_row > 0
+    targets_exist = jnp.any(host_targets & ex.open_)
+    host_quota = jnp.where(host_aff, m, 0)
+
+    def do_host_aff(operand):
+        state_i, ex_i = operand
+        q_targets = jnp.where(targets_exist, host_quota, 0)
+        ex_o, a_ex_t, placed_t = _phase_existing(
+            ex_i, ex_static, cls, statics, q_targets, host_restrict, True,
+            host_count0_row, tol_row, extra_elig=host_targets,
+        )
+        q_boot = jnp.where(targets_exist, 0, host_quota)
+        ex_o, a_ex_b, placed_b = _phase_existing(
+            ex_o, ex_static, cls, statics, q_boot, host_restrict, True,
+            host_count0_row, tol_row, single_node=True,
+        )
+        q_new = jnp.where(placed_b > 0, 0, q_boot - placed_b)
+        state_o, a_new_h, placed_h = _phase(
+            state_i, cls, statics, q_new, host_restrict, collapse_zone=True, max_new_nodes=1
+        )
+        return state_o, ex_o, a_new_h, a_ex_t + a_ex_b, placed_t + placed_b + placed_h
+
+    def skip_host_aff(operand):
+        state_i, ex_i = operand
+        return (
+            state_i, ex_i,
+            jnp.zeros_like(state_i.pod_count),
+            jnp.zeros_like(ex_i.pod_count),
+            jnp.int32(0),
+        )
+
+    state, ex, a_new_h, a_ex_h, placed_h = jax.lax.cond(
+        host_quota > 0, do_host_aff, skip_host_aff, (state, ex)
+    )
+    assigned_total = assigned_total + a_new_h
+    assigned_ex_total = assigned_ex_total + a_ex_h
+    placed_total = placed_total + placed_h
+
+    # unconstrained phase for plain classes
+    any_quota = jnp.where(spread | anti | zone_aff | host_aff, 0, m)
     state, ex, assigned, assigned_ex, placed = run_phase(
         state, ex, any_quota, all_zones, False
     )
@@ -676,6 +772,8 @@ def prepare(snapshot: EncodedSnapshot):
         zone_skew=jnp.asarray(snapshot.cls_zone_skew),
         host_cap=jnp.asarray(snapshot.cls_host_cap),
         zone_count0=jnp.asarray(snapshot.cls_zone_count0),
+        zone_aff=jnp.asarray(snapshot.cls_zone_aff),
+        host_aff=jnp.asarray(snapshot.cls_host_aff),
     )
     it_t = mask_ops.ReqTensor(
         jnp.asarray(snapshot.it_mask),

@@ -81,10 +81,15 @@ def _class_selectors(cls):
     for constraint in example.spec.topology_spread_constraints:
         if constraint.label_selector is not None:
             selectors.append(constraint.label_selector)
-    if example.spec.affinity is not None and example.spec.affinity.pod_anti_affinity is not None:
-        for term in example.spec.affinity.pod_anti_affinity.required:
-            if term.label_selector is not None:
-                selectors.append(term.label_selector)
+    if example.spec.affinity is not None:
+        for group in (
+            example.spec.affinity.pod_anti_affinity,
+            example.spec.affinity.pod_affinity,
+        ):
+            if group is not None:
+                for term in group.required:
+                    if term.label_selector is not None:
+                        selectors.append(term.label_selector)
     return selectors
 
 

@@ -257,15 +257,86 @@ class TestKernelTopologyParity:
         compare(pods)
 
 
+def affinity_pods(n, key=HOSTNAME, requests=None):
+    return [
+        make_pod(
+            labels={"app": "db"},
+            requests=requests or {"cpu": "10m"},
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key=key,
+                    label_selector=LabelSelector(match_labels={"app": "db"}),
+                )
+            ],
+        )
+        for _ in range(n)
+    ]
+
+
+class TestKernelSelfAffinity:
+    def test_hostname_self_affinity_colocates(self):
+        host, tpu = compare(lambda: affinity_pods(3))
+        # all three on a single node
+        assert len([n for n in tpu.new_nodes if n.pods]) == 1
+        assert len(tpu.new_nodes[0].pods) == 3
+
+    def test_hostname_self_affinity_overflow_fails(self):
+        # default instance types cap at 5 pods/node: the 6th+ cannot colocate
+        host, tpu = compare(lambda: affinity_pods(8, requests={"cpu": "1m"}))
+        assert len(tpu.failed_pods) == 3  # 5 fit, 3 fail
+
+    def test_zone_self_affinity_single_zone(self):
+        host, tpu = compare(lambda: affinity_pods(12, key=ZONE, requests={"cpu": "900m"}))
+        zones = set()
+        for node in tpu.new_nodes:
+            if node.pods:
+                zones.update(node.zones)
+        assert len(zones) == 1
+
+    def test_full_benchmark_mix(self):
+        """The reference benchmark's diverse mix (generic + spreads + affinity,
+        scheduling_benchmark_test.go:185-197) is fully kernel-supported."""
+        def pods():
+            # distinct labels per group: same-label groups couple across
+            # classes and take the host path (see classify_pods)
+            zonal = spread_pods(3)
+            hostname = [
+                make_pod(
+                    labels={"app": "hweb"},
+                    requests={"cpu": "10m"},
+                    topology_spread=[
+                        TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key=HOSTNAME,
+                            label_selector=LabelSelector(match_labels={"app": "hweb"}),
+                        )
+                    ],
+                )
+                for _ in range(3)
+            ]
+            return (
+                make_pods(15, requests={"cpu": "500m"}) + zonal + hostname + affinity_pods(6)
+            )
+
+        compare(pods)
+
+    def test_coupled_selector_classes_rejected(self):
+        # two groups sharing one label selector-couple: host path required
+        pods = spread_pods(2) + spread_pods(2, key=HOSTNAME)
+        with pytest.raises(KernelUnsupported):
+            classify_pods(pods)
+
+
 class TestKernelUnsupported:
-    def test_pod_affinity_rejected(self):
+    def test_cross_group_pod_affinity_rejected(self):
+        # affinity to a DIFFERENT group (not self-selecting) needs the host path
         pods = [
             make_pod(
                 labels={"app": "a"},
                 pod_affinity=[
                     PodAffinityTerm(
                         topology_key=ZONE,
-                        label_selector=LabelSelector(match_labels={"app": "a"}),
+                        label_selector=LabelSelector(match_labels={"app": "other"}),
                     )
                 ],
             )
