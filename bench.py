@@ -81,9 +81,8 @@ def build_inputs(n_pods: int, n_instance_types: int, n_provisioners: int):
         )
     # zone self-affinity groups over a 7-value label pool — the reference's
     # 2/7 affinity share draws labels/selectors from the same 7 values
-    # (scheduling_benchmark_test.go:263-278); self-selecting keeps the batch
-    # kernel-eligible (independent label/selector draws couple groups across
-    # classes and would route to the host path)
+    # (scheduling_benchmark_test.go:263-278); self-selecting groups avoid the
+    # cross-group scan-order dependency that routes to the host path
     for i in range(n_affinity):
         group = f"g{i % 7}"
         pods.append(

@@ -35,6 +35,23 @@ from karpenter_core_tpu.utils import resources as resources_util
 UNLIMITED = np.int32(1 << 30)
 
 
+GRP_SPREAD = 0
+GRP_AFFINITY = 1
+GRP_ANTI = 2
+
+
+@dataclass(frozen=True)
+class GroupSpec:
+    """A topology group: the hash-deduped identity the reference tracks
+    (topologygroup.go:137-153) — one per distinct (type, key, selector, skew)
+    across the whole batch, shared by every class that owns or matches it."""
+
+    gtype: int  # GRP_SPREAD | GRP_AFFINITY | GRP_ANTI
+    is_zone: bool  # zone key vs hostname key
+    selector_sig: tuple
+    skew: int
+
+
 @dataclass
 class PodClass:
     """One equivalence class of identical pods."""
@@ -42,18 +59,34 @@ class PodClass:
     pods: List[Pod]
     requirements: Requirements
     requests: resources_util.ResourceList
-    # topology spec (self-selecting groups only; cross-class groups take the
-    # host path — see encode_pods)
-    zone_spread_skew: Optional[int] = None
-    host_spread_skew: Optional[int] = None
-    zone_anti_affinity: bool = False
-    host_anti_affinity: bool = False
-    zone_affinity: bool = False  # self-affinity: colocate the class in one zone
-    host_affinity: bool = False  # self-affinity: colocate the class on one node
+    # owned topology groups, at most one per (type, key) pair — multiple
+    # same-kind constraints on one pod take the host path
+    zone_spread: Optional[GroupSpec] = None
+    host_spread: Optional[GroupSpec] = None
+    zone_affinity: Optional[GroupSpec] = None
+    host_affinity: Optional[GroupSpec] = None
+    zone_anti: Optional[GroupSpec] = None
+    host_anti: Optional[GroupSpec] = None
+    # selector objects per owned group (for membership evaluation)
+    selectors: Dict[GroupSpec, object] = field(default_factory=dict)
 
     @property
     def count(self) -> int:
         return len(self.pods)
+
+    def owned_groups(self):
+        return [
+            g
+            for g in (
+                self.zone_spread,
+                self.host_spread,
+                self.zone_affinity,
+                self.host_affinity,
+                self.zone_anti,
+                self.host_anti,
+            )
+            if g is not None
+        ]
 
 
 @dataclass
@@ -98,12 +131,15 @@ class EncodedSnapshot:
     cls_requests: np.ndarray = None  # f32[C, R]
     cls_count: np.ndarray = None  # i32[C]
     cls_tol: np.ndarray = None  # bool[C, T] tolerates template taints
-    cls_zone_cap: np.ndarray = None  # i32[C] max added pods per zone (anti-aff=1)
-    cls_zone_skew: np.ndarray = None  # i32[C] spread skew (UNLIMITED = none)
-    cls_host_cap: np.ndarray = None  # i32[C] max pods per node
-    cls_zone_count0: np.ndarray = None  # i32[C, Z] pre-existing group counts
-    cls_zone_aff: np.ndarray = None  # bool[C] self-affinity on zone
-    cls_host_aff: np.ndarray = None  # bool[C] self-affinity on hostname
+    # topology groups [G1] (shared across classes; last row = dummy "none")
+    groups: List[GroupSpec] = None  # host-side identities, len G
+    group_selectors: list = None  # selector object per group (membership tests)
+    grp_skew: np.ndarray = None  # i32[G1]
+    grp_is_zone: np.ndarray = None  # bool[G1]
+    grp_is_anti: np.ndarray = None  # bool[G1]
+    grp_member: np.ndarray = None  # bool[C, G1] selector matches class labels
+    cls_groups: np.ndarray = None  # i32[C, 6] owned group per kind (G = none):
+    #   [zone_spread, host_spread, zone_aff, host_aff, zone_anti, host_anti]
 
     # vocabulary statics
     valid: np.ndarray = None  # bool[K, V+1]
@@ -206,10 +242,12 @@ class KernelUnsupported(Exception):
 
 
 def classify_pods(pods: List[Pod]) -> List[PodClass]:
-    """Group pods into equivalence classes and derive each class's topology
-    spec.  Raises KernelUnsupported for shapes the kernel doesn't model:
-    cross-class selectors, non-self-selecting affinity, host ports, region/
-    custom-key spreads."""
+    """Group pods into equivalence classes and derive each class's owned
+    topology groups.  Groups are shared across classes by identity (type, key,
+    selector, skew) — the reference's hash dedup — so selectors may span
+    classes (cross-group affinity, inverse anti-affinity).  Raises
+    KernelUnsupported for shapes the kernel doesn't model: host ports,
+    region/custom-key topologies, multiple same-kind constraints per pod."""
     groups: Dict[tuple, PodClass] = {}
     order: List[tuple] = []
     for pod in pods:
@@ -227,23 +265,6 @@ def classify_pods(pods: List[Pod]) -> List[PodClass]:
         cls.pods.append(pod)
 
     classes = [groups[sig] for sig in order]
-
-    # the kernel counts topology per class (group == class); a selector that
-    # also matches ANOTHER class's pods couples the groups and needs the host
-    # path's shared-group counting
-    for cls in classes:
-        selectors = _constraint_selectors(cls.pods[0])
-        if not selectors:
-            continue
-        for other in classes:
-            if other is cls:
-                continue
-            other_labels = other.pods[0].metadata.labels
-            if any(s.matches(other_labels) for s in selectors):
-                raise KernelUnsupported(
-                    "topology selector spans multiple pod classes"
-                )
-
     # FFD: cpu desc, then memory desc (queue.go:74-110)
     classes.sort(
         key=lambda c: (
@@ -251,74 +272,76 @@ def classify_pods(pods: List[Pod]) -> List[PodClass]:
             -c.requests.get(resources_util.MEMORY, 0.0),
         )
     )
+
+    # cross-group affinity is order-sensitive in a single-pass scan: the host
+    # path retries followers after their targets schedule (queue re-push,
+    # scheduler.go:117-123); the kernel has no retry, so a follower class whose
+    # target class scans later must take the host path
+    for idx, cls in enumerate(classes):
+        for spec in (cls.zone_affinity, cls.host_affinity):
+            if spec is None:
+                continue
+            selector = cls.selectors[spec]
+            own_labels = cls.pods[0].metadata.labels
+            if selector is not None and selector.matches(own_labels):
+                continue  # self-affinity: no ordering dependency
+            for later in classes[idx + 1 :]:
+                if selector is not None and selector.matches(later.pods[0].metadata.labels):
+                    raise KernelUnsupported(
+                        "cross-group affinity target scans after its follower"
+                    )
     return classes
 
 
-def _constraint_selectors(pod: Pod) -> List[LabelSelector]:
-    selectors = []
-    for constraint in pod.spec.topology_spread_constraints:
-        if constraint.when_unsatisfiable == "DoNotSchedule" and constraint.label_selector:
-            selectors.append(constraint.label_selector)
-    if pod.spec.affinity is not None:
-        for group in (pod.spec.affinity.pod_affinity, pod.spec.affinity.pod_anti_affinity):
-            if group is not None:
-                for term in group.required:
-                    if term.label_selector is not None:
-                        selectors.append(term.label_selector)
-    return selectors
+def _group_spec(gtype: int, topology_key: str, selector, skew: int) -> GroupSpec:
+    if topology_key == labels_api.LABEL_TOPOLOGY_ZONE:
+        is_zone = True
+    elif topology_key == labels_api.LABEL_HOSTNAME:
+        is_zone = False
+    else:
+        raise KernelUnsupported(f"topology on {topology_key} not kernel-supported")
+    return GroupSpec(
+        gtype=gtype, is_zone=is_zone, selector_sig=_selector_sig(selector), skew=skew
+    )
 
 
 def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
+    def set_slot(attr: str, spec: GroupSpec, selector) -> None:
+        if getattr(cls, attr) is not None:
+            raise KernelUnsupported(f"multiple {attr} constraints not kernel-supported")
+        setattr(cls, attr, spec)
+        cls.selectors[spec] = selector
+
     for constraint in pod.spec.topology_spread_constraints:
         if constraint.when_unsatisfiable != "DoNotSchedule":
             continue  # ScheduleAnyway spreads relax away on failure
         if not _self_selecting(pod, constraint.label_selector):
-            raise KernelUnsupported("spread selector not self-selecting")
-        if constraint.topology_key == labels_api.LABEL_TOPOLOGY_ZONE:
-            cls.zone_spread_skew = constraint.max_skew
-        elif constraint.topology_key == labels_api.LABEL_HOSTNAME:
-            cls.host_spread_skew = constraint.max_skew
-        else:
-            raise KernelUnsupported(
-                f"spread on {constraint.topology_key} not kernel-supported"
-            )
+            # a spread whose own pods don't count interacts with open-node
+            # packing in a per-pod way the batched water-fill doesn't model;
+            # exact handling stays on the host path
+            raise KernelUnsupported("non-self-selecting spread not kernel-supported")
+        spec = _group_spec(
+            GRP_SPREAD, constraint.topology_key, constraint.label_selector, constraint.max_skew
+        )
+        set_slot("zone_spread" if spec.is_zone else "host_spread", spec, constraint.label_selector)
     affinity = pod.spec.affinity
     if affinity is not None:
         if affinity.pod_affinity is not None:
             for term in affinity.pod_affinity.required:
-                # only *self*-affinity is kernel-supported: the group colocates
-                # with itself (the dominant benchmark shape); affinity to other
-                # groups needs the host path's cross-group resolution
-                if not _self_selecting(pod, term.label_selector):
-                    raise KernelUnsupported("pod affinity selector not self-selecting")
-                if term.topology_key == labels_api.LABEL_TOPOLOGY_ZONE:
-                    cls.zone_affinity = True
-                elif term.topology_key == labels_api.LABEL_HOSTNAME:
-                    cls.host_affinity = True
-                else:
-                    raise KernelUnsupported(
-                        f"pod affinity on {term.topology_key} not kernel-supported"
-                    )
+                spec = _group_spec(GRP_AFFINITY, term.topology_key, term.label_selector, UNLIMITED)
+                set_slot(
+                    "zone_affinity" if spec.is_zone else "host_affinity", spec, term.label_selector
+                )
         if affinity.pod_anti_affinity is not None:
             for term in affinity.pod_anti_affinity.required:
-                if not _self_selecting(pod, term.label_selector):
-                    raise KernelUnsupported("anti-affinity selector not self-selecting")
-                if term.topology_key == labels_api.LABEL_HOSTNAME:
-                    cls.host_anti_affinity = True
-                elif term.topology_key == labels_api.LABEL_TOPOLOGY_ZONE:
-                    cls.zone_anti_affinity = True
-                else:
-                    raise KernelUnsupported(
-                        f"anti-affinity on {term.topology_key} not kernel-supported"
-                    )
+                spec = _group_spec(GRP_ANTI, term.topology_key, term.label_selector, UNLIMITED)
+                set_slot("zone_anti" if spec.is_zone else "host_anti", spec, term.label_selector)
     for container in pod.spec.containers:
         if any(p.host_port for p in container.ports):
             raise KernelUnsupported("host ports not kernel-supported")
-    if cls.zone_affinity and cls.zone_spread_skew is not None:
-        raise KernelUnsupported("combined zone spread + zone affinity not kernel-supported")
-    if cls.zone_affinity and cls.zone_anti_affinity:
-        raise KernelUnsupported("combined zone affinity + anti-affinity not kernel-supported")
-    if cls.host_affinity and (cls.host_spread_skew is not None or cls.host_anti_affinity):
+    if cls.zone_affinity is not None and (cls.zone_spread is not None or cls.zone_anti is not None):
+        raise KernelUnsupported("combined zone affinity + spread/anti not kernel-supported")
+    if cls.host_affinity is not None and (cls.host_spread is not None or cls.host_anti is not None):
         raise KernelUnsupported("combined hostname affinity + spread/anti not kernel-supported")
 
 
@@ -328,6 +351,7 @@ def encode_snapshot(
     templates: List[MachineTemplate],
     instance_types: Dict[str, List[InstanceType]],
     extra_requirement_sets: Optional[List[Requirements]] = None,
+    extra_anti_groups: Optional[list] = None,
 ) -> EncodedSnapshot:
     """Encode a solve input.  ``templates`` must be weight-ordered (the order
     is the kernel's template preference order, scheduler.go:174-219).
@@ -461,12 +485,42 @@ def encode_snapshot(
     snap.cls_requests = np.zeros((C, R), dtype=np.float32)
     snap.cls_count = np.zeros(C, dtype=np.int32)
     snap.cls_tol = np.zeros((C, T), dtype=bool)
-    snap.cls_zone_cap = np.full(C, UNLIMITED, dtype=np.int32)
-    snap.cls_zone_skew = np.full(C, UNLIMITED, dtype=np.int32)
-    snap.cls_host_cap = np.full(C, UNLIMITED, dtype=np.int32)
-    snap.cls_zone_count0 = np.zeros((C, Z), dtype=np.int32)
-    snap.cls_zone_aff = np.zeros(C, dtype=bool)
-    snap.cls_host_aff = np.zeros(C, dtype=bool)
+    # -- topology groups (hash-deduped, topologygroup.go:137-153) -------------
+    group_index: Dict[GroupSpec, int] = {}
+    group_selectors: list = []
+    for cls in classes:
+        for spec in cls.owned_groups():
+            if spec not in group_index:
+                group_index[spec] = len(group_index)
+                group_selectors.append(cls.selectors[spec])
+    # anti-affinity groups owned only by already-bound cluster pods still gate
+    # the pods they select (inverse topologies, topology.go:185-198)
+    for spec, selector in extra_anti_groups or []:
+        if spec not in group_index:
+            group_index[spec] = len(group_index)
+            group_selectors.append(selector)
+    G = len(group_index)
+    snap.groups = list(group_index)
+    snap.group_selectors = group_selectors
+    snap.grp_skew = np.full(G + 1, UNLIMITED, dtype=np.int32)
+    snap.grp_is_zone = np.zeros(G + 1, dtype=bool)
+    snap.grp_is_anti = np.zeros(G + 1, dtype=bool)
+    snap.grp_member = np.zeros((C, G + 1), dtype=bool)
+    snap.cls_groups = np.full((C, 6), G, dtype=np.int32)
+    for spec, g in group_index.items():
+        snap.grp_skew[g] = spec.skew
+        snap.grp_is_zone[g] = spec.is_zone
+        snap.grp_is_anti[g] = spec.gtype == GRP_ANTI
+    for c, cls in enumerate(classes):
+        labels = cls.pods[0].metadata.labels
+        for g, selector in enumerate(group_selectors):
+            snap.grp_member[c, g] = selector is not None and selector.matches(labels)
+        for slot, spec in enumerate(
+            (cls.zone_spread, cls.host_spread, cls.zone_affinity,
+             cls.host_affinity, cls.zone_anti, cls.host_anti)
+        ):
+            if spec is not None:
+                snap.cls_groups[c, slot] = group_index[spec]
     for c, cls in enumerate(classes):
         reqs = cls.requirements
         snap.cls_zone[c] = encode_value_set(
@@ -491,17 +545,5 @@ def encode_snapshot(
         example = cls.pods[0]
         for t, tmpl in enumerate(templates):
             snap.cls_tol[c, t] = Taints.of(tmpl.taints).tolerates(example) is None
-        if cls.zone_anti_affinity:
-            snap.cls_zone_cap[c] = 1
-        if cls.zone_spread_skew is not None:
-            snap.cls_zone_skew[c] = cls.zone_spread_skew
-        if cls.host_anti_affinity:
-            snap.cls_host_cap[c] = 1
-        elif cls.host_spread_skew is not None:
-            # hostname min-count is always 0 (a new node is always possible,
-            # topologygroup.go:184-188), so per-node cap = maxSkew
-            snap.cls_host_cap[c] = cls.host_spread_skew
-        snap.cls_zone_aff[c] = cls.zone_affinity
-        snap.cls_host_aff[c] = cls.host_affinity
 
     return snap

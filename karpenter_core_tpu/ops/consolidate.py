@@ -51,27 +51,18 @@ def sweep(
 ) -> SweepOutputs:
     """Simulate closing the first-k candidates for every k in prefix_sizes."""
 
-    ex_zone = ex_state.zone  # [E, Z] (candidates have concrete zones)
 
     def one_prefix(k):
         subset = candidate_rank < k  # bool[E]
-        # close the subset's nodes
+        # close the subset's nodes; the topology count seeds derive from
+        # grp_node_member/owner masked by open_, so pre-existing pods on
+        # removed nodes stop counting automatically (excludedPods semantics)
         ex = ex_state._replace(open_=ex_state.open_ & ~subset)
         # displaced pods join their classes
         displaced = jnp.sum(
             ex_cls_count * subset[None, :].astype(jnp.int32), axis=-1
         )  # [C]
-        # pre-existing matching pods on removed nodes no longer count for
-        # topology (they are being rescheduled - excludedPods semantics)
-        removed_zone_counts = jnp.einsum(
-            "ce,ez->cz",
-            (ex_static.host_count0 * subset[None, :]).astype(jnp.float32),
-            ex_zone.astype(jnp.float32),
-        ).astype(jnp.int32)
-        cls = class_tensors._replace(
-            count=class_tensors.count + displaced,
-            zone_count0=jnp.maximum(class_tensors.zone_count0 - removed_zone_counts, 0),
-        )
+        cls = class_tensors._replace(count=class_tensors.count + displaced)
         out = solve_ops.solve_core(
             cls, statics_arrays, n_slots, key_has_bounds, ex, ex_static
         )

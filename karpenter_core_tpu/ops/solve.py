@@ -82,7 +82,27 @@ class ExistingStatic(NamedTuple):
     alloc: jnp.ndarray  # f32[E, R] available() at snapshot time
     init: jnp.ndarray  # bool[E] karpenter.sh/initialized
     tol: jnp.ndarray  # bool[C, E] class tolerates node taints
-    host_count0: jnp.ndarray  # i32[C, E] selector-matching pods already on node
+    # bound pods per topology group per node: members (forward counts) and
+    # anti-term owners (inverse counts) — count seeds derive from these with
+    # the node open-mask applied, so consolidation subsets adjust for free
+    grp_node_member: jnp.ndarray  # i32[G1, E]
+    grp_node_owner: jnp.ndarray  # i32[G1, E]
+
+
+class TopoCounts(NamedTuple):
+    """Shared topology-group counts, carried through the class scan.
+
+    Forward counts track selector-matching (member) pods — they gate spread
+    skew, affinity targets, and anti-affinity owners.  Inverse counts track
+    anti-term *owners* — they gate the pods those owners repel
+    (topology.go:44-47 inverse topologies)."""
+
+    zone_fwd: jnp.ndarray  # i32[G1, Z]
+    zone_inv: jnp.ndarray  # i32[G1, Z]
+    host_fwd_ex: jnp.ndarray  # i32[G1, E]
+    host_inv_ex: jnp.ndarray  # i32[G1, E]
+    host_fwd_new: jnp.ndarray  # i32[G1, N]
+    host_inv_new: jnp.ndarray  # i32[G1, N]
 
 
 class SolveOutputs(NamedTuple):
@@ -245,6 +265,10 @@ class Statics(NamedTuple):
     valid: jnp.ndarray
     is_custom: jnp.ndarray
     vocab_ints: jnp.ndarray
+    grp_skew: jnp.ndarray  # i32[G1]
+    grp_is_zone: jnp.ndarray  # bool[G1]
+    grp_is_anti: jnp.ndarray  # bool[G1]
+    grp_member: jnp.ndarray  # bool[C, G1]
     key_has_bounds: Tuple[bool, ...]  # python tuple -> static per-key branching
 
 
@@ -260,12 +284,8 @@ class ClassTensors(NamedTuple):
     requests: jnp.ndarray
     count: jnp.ndarray
     tol: jnp.ndarray
-    zone_cap: jnp.ndarray
-    zone_skew: jnp.ndarray
-    host_cap: jnp.ndarray
-    zone_count0: jnp.ndarray
-    zone_aff: jnp.ndarray
-    host_aff: jnp.ndarray
+    groups: jnp.ndarray  # i32[C, 6]: owned group per kind (G = none):
+    # [zone_spread, host_spread, zone_aff, host_aff, zone_anti, host_anti]
 
 
 def _phase_existing(
@@ -276,16 +296,18 @@ def _phase_existing(
     quota: jnp.ndarray,
     zone_restrict: jnp.ndarray,
     collapse_zone: bool,
-    host_count0_row: jnp.ndarray,
+    host_cap_vec: jnp.ndarray,
     tol_row: jnp.ndarray,
     extra_elig: Optional[jnp.ndarray] = None,
     single_node: bool = False,
 ) -> Tuple[ExistingState, jnp.ndarray, jnp.ndarray]:
     """Place up to ``quota`` pods of the class onto existing nodes, in index
     order (the reference iterates existing nodes first, in order, and takes the
-    first that accepts — scheduler.go:176-180).  ``extra_elig`` restricts to a
-    node subset (affinity targets); ``single_node`` pins the whole quota to the
-    first eligible node (hostname self-affinity bootstrap)."""
+    first that accepts — scheduler.go:176-180).  ``host_cap_vec`` carries the
+    per-node pods-of-this-class cap from hostname topology groups;
+    ``extra_elig`` restricts to a node subset (affinity targets / inverse
+    anti-affinity blocks); ``single_node`` pins the whole quota to the first
+    eligible node (hostname self-affinity bootstrap)."""
     n_ex = ex.used.shape[0]
 
     node_t = mask_ops.ReqTensor(ex.kmask, ex.kdef, ex.kneg, ex.kgt, ex.klt)
@@ -314,8 +336,7 @@ def _phase_existing(
     elig = ex.open_ & key_ok & tol_row & jnp.any(zone_ok, axis=-1) & jnp.any(ct_ok, axis=-1)
     if extra_elig is not None:
         elig = elig & extra_elig
-    host_cap = jnp.maximum(cls.host_cap - host_count0_row, 0)
-    cap = jnp.where(elig, jnp.minimum(cap, host_cap), 0)
+    cap = jnp.where(elig, jnp.minimum(cap, host_cap_vec), 0)
     if single_node:
         first = jnp.argmax(cap > 0)
         cap = jnp.where(jnp.arange(n_ex) == first, cap, 0)
@@ -350,12 +371,17 @@ def _phase(
     quota: jnp.ndarray,
     zone_restrict: jnp.ndarray,
     collapse_zone: bool,
+    host_cap_vec: jnp.ndarray,
+    fresh_host_cap: jnp.ndarray,
+    extra_elig: Optional[jnp.ndarray] = None,
     max_new_nodes: Optional[int] = None,
 ) -> Tuple[NodeState, jnp.ndarray, jnp.ndarray]:
     """Place up to ``quota`` pods of the class on nodes whose zone mask meets
     ``zone_restrict`` — first onto open nodes, then fresh nodes from the first
-    viable template.  Returns (state, assigned[N], placed).  ``max_new_nodes``
-    caps node openings (hostname self-affinity bootstraps exactly one)."""
+    viable template.  Returns (state, assigned[N], placed).  ``host_cap_vec``
+    is the per-slot class cap from hostname groups, ``fresh_host_cap`` the cap
+    for newly opened nodes; ``max_new_nodes`` caps node openings (hostname
+    self-affinity bootstraps exactly one, target-fill phases open none)."""
     n_slots = state.used.shape[0]
     n_tmpl = statics.tmpl_it.shape[0]
 
@@ -382,8 +408,10 @@ def _phase(
         & jnp.any(zone_ok, axis=-1)
         & jnp.any(ct_ok, axis=-1)
     )
-    cap_n = jnp.where(elig, jnp.minimum(cap_n, cls.host_cap), 0)
-    if max_new_nodes is not None:
+    if extra_elig is not None:
+        elig = elig & extra_elig
+    cap_n = jnp.where(elig, jnp.minimum(cap_n, host_cap_vec), 0)
+    if max_new_nodes is not None and max_new_nodes == 1:
         # hostname self-affinity bootstrap: at most one node hosts the class
         first = jnp.argmax(cap_n > 0)
         cap_n = jnp.where(jnp.arange(n_slots) == first, cap_n, 0)
@@ -444,7 +472,7 @@ def _phase(
     t_star = jnp.argmax(t_viable)  # first True (argmax of bool picks first max)
     t_ok = t_viable[t_star]
 
-    per_node = jnp.minimum(t_cap[t_star], cls.host_cap)
+    per_node = jnp.minimum(t_cap[t_star], fresh_host_cap)
     per_node = jnp.maximum(per_node, 1)
     n_new = jnp.where(t_ok & (rem > 0), -(-rem // per_node), 0)
     free_slots = n_slots - state.n_next
@@ -491,33 +519,101 @@ def _class_step(
     cls_with_index,
 ):
     """One scan step: schedule every pod of one class — existing nodes first,
-    then new nodes, per phase."""
-    state, ex = carry
+    then new nodes, per phase.  Topology lives in shared group counts (the
+    reference's hash-deduped TopologyGroups): forward counts gate spread skew /
+    affinity targets / anti owners; inverse counts gate the pods anti owners
+    repel."""
+    state, ex, topo = carry
     cls, cls_index = cls_with_index
     m = cls.count
-    spread = cls.zone_skew < UNLIMITED
-    anti = cls.zone_cap < UNLIMITED
+    n_ex = ex.pod_count.shape[0]
+    n_new_slots = state.pod_count.shape[0]
+    g1 = statics.grp_skew.shape[0]
+    g_dummy = g1 - 1
 
-    host_count0_row = ex_static.host_count0[cls_index]  # [E]
+    g_zs, g_hs, g_zaf, g_haf, g_zan, g_han = (cls.groups[i] for i in range(6))
+    member_row = statics.grp_member[cls_index]  # [G1]
     tol_row = ex_static.tol[cls_index]  # [E]
 
-    quotas = _water_fill(cls.zone_count0, cls.zone, m)
+    def own_onehot(g):
+        return (jnp.arange(g1) == g) & (g < g_dummy)
+
+    has_zs = g_zs < g_dummy
+    has_zaf = g_zaf < g_dummy
+    has_haf = g_haf < g_dummy
+    has_zan = g_zan < g_dummy
+
+    # -- inverse anti-affinity blocks (topology.go:44-47): members of anti
+    # groups avoid every domain the group's owners could occupy
+    mem_anti_zone = member_row & statics.grp_is_anti & statics.grp_is_zone
+    blocked_z = jnp.any(mem_anti_zone[:, None] & (topo.zone_inv > 0), axis=0)  # [Z]
+    allowed_zone = cls.zone & ~blocked_z
+    mem_anti_host = member_row & statics.grp_is_anti & ~statics.grp_is_zone
+    ok_ex = ~jnp.any(mem_anti_host[:, None] & (topo.host_inv_ex > 0), axis=0)  # [E]
+    ok_new = ~jnp.any(mem_anti_host[:, None] & (topo.host_inv_new > 0), axis=0)  # [N]
+
+    # -- per-node caps from hostname groups -----------------------------------
+    # spread (topologygroup.go:184-188: hostname min-count is 0, so cap=skew):
+    # members consume cap; non-members only need count <= skew
+    skew_hs = statics.grp_skew[g_hs]
+    member_hs = member_row[g_hs]
+    hs_fwd_ex = topo.host_fwd_ex[g_hs]
+    hs_fwd_new = topo.host_fwd_new[g_hs]
+    cap_hs_ex = jnp.where(
+        member_hs,
+        jnp.maximum(skew_hs - hs_fwd_ex, 0),
+        jnp.where(hs_fwd_ex <= skew_hs, UNLIMITED, 0),
+    )
+    cap_hs_new = jnp.where(
+        member_hs,
+        jnp.maximum(skew_hs - hs_fwd_new, 0),
+        jnp.where(hs_fwd_new <= skew_hs, UNLIMITED, 0),
+    )
+    # owned hostname anti-affinity: only zero-count nodes; self-members cap 1
+    han_fwd_ex = topo.host_fwd_ex[g_han]
+    han_fwd_new = topo.host_fwd_new[g_han]
+    member_han = member_row[g_han]
+    cap_han_ex = jnp.where(
+        g_han < g_dummy,
+        jnp.where(han_fwd_ex == 0, jnp.where(member_han, 1, UNLIMITED), 0),
+        UNLIMITED,
+    )
+    cap_han_new = jnp.where(
+        g_han < g_dummy,
+        jnp.where(han_fwd_new == 0, jnp.where(member_han, 1, UNLIMITED), 0),
+        UNLIMITED,
+    )
+    host_cap_ex = jnp.minimum(cap_hs_ex, cap_han_ex).astype(jnp.int32)
+    host_cap_new = jnp.minimum(cap_hs_new, cap_han_new).astype(jnp.int32)
+    fresh_host_cap = jnp.minimum(
+        jnp.where(member_hs, skew_hs, UNLIMITED),
+        jnp.where((g_han < g_dummy) & member_han, 1, UNLIMITED),
+    ).astype(jnp.int32)
+
     assigned_total = jnp.zeros_like(state.pod_count)
     assigned_ex_total = jnp.zeros_like(ex.pod_count)
     placed_total = jnp.int32(0)
 
-    def run_phase(state, ex, quota, restrict, collapse):
+    def run_phase(state, ex, quota, restrict, collapse, targets_ex=None, targets_new=None,
+                  single_node=False, max_new_nodes=None):
         """Wrapped in lax.cond so zero-quota phases (most of them: each class
         participates in 1-2 of the Z+4 phase kinds) cost nothing on device."""
 
         def do(operand):
             state_i, ex_i = operand
+            extra_ex = ok_ex if targets_ex is None else (ok_ex & targets_ex)
+            extra_new = ok_new if targets_new is None else (ok_new & targets_new)
             ex_o, a_ex, placed_ex = _phase_existing(
                 ex_i, ex_static, cls, statics, quota, restrict, collapse,
-                host_count0_row, tol_row,
+                host_cap_ex, tol_row, extra_elig=extra_ex, single_node=single_node,
             )
+            q_new = quota - placed_ex
+            if single_node:
+                q_new = jnp.where(placed_ex > 0, 0, q_new)
             state_o, a_new, placed_new = _phase(
-                state_i, cls, statics, quota - placed_ex, restrict, collapse_zone=collapse
+                state_i, cls, statics, q_new, restrict, collapse,
+                host_cap_new, fresh_host_cap, extra_elig=extra_new,
+                max_new_nodes=max_new_nodes,
             )
             return state_o, ex_o, a_new, a_ex, placed_ex + placed_new
 
@@ -533,95 +629,124 @@ def _class_step(
 
         return jax.lax.cond(quota > 0, do, skip, (state, ex))
 
-    # zone-constrained phases (spread classes commit one zone per phase)
-    for z in range(n_zones):
-        restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
-        q = jnp.where(spread, quotas[z], 0)
-        state, ex, assigned, assigned_ex, placed = run_phase(state, ex, q, restrict, True)
+    def accumulate(results):
+        nonlocal state, ex, assigned_total, assigned_ex_total, placed_total
+        state, ex, assigned, assigned_ex, placed = results
         assigned_total = assigned_total + assigned
         assigned_ex_total = assigned_ex_total + assigned_ex
         placed_total = placed_total + placed
 
-    # anti-affinity phase: one pod, restricted to zero-count allowed zones
-    zero_zones = cls.zone & (cls.zone_count0 == 0)
-    anti_quota = jnp.where(anti & jnp.any(zero_zones), jnp.minimum(m, 1), 0)
-    state, ex, assigned, assigned_ex, placed = run_phase(
-        state, ex, anti_quota, zero_zones, True
+    # -- zone spread phases (one committed zone per phase) --------------------
+    counts_zs = topo.zone_fwd[g_zs]  # [Z]
+    member_zs = member_row[g_zs]
+    quotas_member = _water_fill(counts_zs, allowed_zone, m)
+    # non-member spread: pods never increment the counts, so every pod goes to
+    # the min-count zone (the reference's per-pod argmin never moves)
+    argmin_zone = jnp.argmin(jnp.where(allowed_zone, counts_zs, jnp.int32(1 << 30)))
+    quotas_nonmember = (
+        jnp.zeros(n_zones, dtype=jnp.int32)
+        .at[argmin_zone]
+        .set(jnp.where(jnp.any(allowed_zone), m, 0))
     )
-    assigned_total = assigned_total + assigned
-    assigned_ex_total = assigned_ex_total + assigned_ex
-    placed_total = placed_total + placed
+    quotas = jnp.where(member_zs, quotas_member, quotas_nonmember)
+    for z in range(n_zones):
+        restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
+        q = jnp.where(has_zs, quotas[z], 0)
+        accumulate(run_phase(state, ex, q, restrict, True))
 
-    # zone self-affinity: nonzero-count zones when matching pods exist,
-    # else bootstrap a single allowed zone (topologygroup.go:202-233)
-    zone_aff = cls.zone_aff
-    host_aff = cls.host_aff
-    nonzero_zones = cls.zone & (cls.zone_count0 > 0)
+    # -- owned zone anti-affinity: zero-forward-count zones only --------------
+    # self-members block every domain they might occupy (pessimistic late
+    # committal): one pod per step; non-member owners don't repel each other
+    zero_zones = allowed_zone & (topo.zone_fwd[g_zan] == 0)
+    anti_quota = jnp.where(
+        has_zan & jnp.any(zero_zones),
+        jnp.where(member_row[g_zan], jnp.minimum(m, 1), m),
+        0,
+    )
+    accumulate(run_phase(state, ex, anti_quota, zero_zones, True))
+
+    # -- zone affinity: nonzero-count zones (the selected pods' locations),
+    # else self-members bootstrap one allowed zone (topologygroup.go:202-233).
+    # The bootstrap must be capacity-aware (the host's per-node bootstrap only
+    # lands where a node is viable): restrict to zones some template offers
+    # for this class, or where an open existing node sits
+    tmpl_offers = jnp.einsum(
+        "ti,izc,tz,tc->z",
+        statics.tmpl_it.astype(jnp.bfloat16),
+        (statics.it_avail & cls.it[:, None, None]).astype(jnp.bfloat16),
+        statics.tmpl_zone.astype(jnp.bfloat16),
+        (statics.tmpl_ct & cls.ct[None, :]).astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ) > 0.5  # [Z]
+    ex_offers = jnp.any(ex.open_[:, None] & ex.zone, axis=0)  # [Z]
+    bootstrap_allowed = allowed_zone & (tmpl_offers | ex_offers)
+    nonzero_zones = allowed_zone & (topo.zone_fwd[g_zaf] > 0)
     bootstrap_zone = (
-        jnp.zeros(n_zones, dtype=bool).at[jnp.argmax(cls.zone)].set(jnp.any(cls.zone))
+        jnp.zeros(n_zones, dtype=bool)
+        .at[jnp.argmax(bootstrap_allowed)]
+        .set(jnp.any(bootstrap_allowed) & member_row[g_zaf])
     )
     zone_aff_restrict = jnp.where(jnp.any(nonzero_zones), nonzero_zones, bootstrap_zone)
-    zone_aff_quota = jnp.where(zone_aff & ~host_aff, m, 0)
-    state, ex, assigned, assigned_ex, placed = run_phase(
-        state, ex, zone_aff_quota, zone_aff_restrict, True
-    )
-    assigned_total = assigned_total + assigned
-    assigned_ex_total = assigned_ex_total + assigned_ex
-    placed_total = placed_total + placed
+    zone_aff_quota = jnp.where(has_zaf & ~has_haf & jnp.any(zone_aff_restrict), m, 0)
+    accumulate(run_phase(state, ex, zone_aff_quota, zone_aff_restrict, True))
 
-    # hostname self-affinity: fill target nodes (count>0) when they exist,
-    # else bootstrap the whole class onto exactly one node
+    # -- hostname affinity: fill target nodes (forward count > 0) on both
+    # planes; else self-members bootstrap exactly one node
     all_zones = jnp.ones(n_zones, dtype=bool)
-    host_restrict = jnp.where(zone_aff, zone_aff_restrict, all_zones)
-    host_targets = host_count0_row > 0
-    targets_exist = jnp.any(host_targets & ex.open_)
-    host_quota = jnp.where(host_aff, m, 0)
-
-    def do_host_aff(operand):
-        state_i, ex_i = operand
-        q_targets = jnp.where(targets_exist, host_quota, 0)
-        ex_o, a_ex_t, placed_t = _phase_existing(
-            ex_i, ex_static, cls, statics, q_targets, host_restrict, True,
-            host_count0_row, tol_row, extra_elig=host_targets,
+    host_restrict = jnp.where(has_zaf, zone_aff_restrict, all_zones) & allowed_zone
+    targets_ex = (topo.host_fwd_ex[g_haf] > 0) & ex.open_
+    targets_new = (topo.host_fwd_new[g_haf] > 0) & state.open_
+    targets_exist = jnp.any(targets_ex) | jnp.any(targets_new)
+    host_quota = jnp.where(has_haf, m, 0)
+    q_targets = jnp.where(targets_exist, host_quota, 0)
+    accumulate(
+        run_phase(
+            state, ex, q_targets, host_restrict, True,
+            targets_ex=targets_ex, targets_new=targets_new, max_new_nodes=0,
         )
-        q_boot = jnp.where(targets_exist, 0, host_quota)
-        ex_o, a_ex_b, placed_b = _phase_existing(
-            ex_o, ex_static, cls, statics, q_boot, host_restrict, True,
-            host_count0_row, tol_row, single_node=True,
-        )
-        q_new = jnp.where(placed_b > 0, 0, q_boot - placed_b)
-        state_o, a_new_h, placed_h = _phase(
-            state_i, cls, statics, q_new, host_restrict, collapse_zone=True, max_new_nodes=1
-        )
-        return state_o, ex_o, a_new_h, a_ex_t + a_ex_b, placed_t + placed_b + placed_h
-
-    def skip_host_aff(operand):
-        state_i, ex_i = operand
-        return (
-            state_i, ex_i,
-            jnp.zeros_like(state_i.pod_count),
-            jnp.zeros_like(ex_i.pod_count),
-            jnp.int32(0),
-        )
-
-    state, ex, a_new_h, a_ex_h, placed_h = jax.lax.cond(
-        host_quota > 0, do_host_aff, skip_host_aff, (state, ex)
     )
-    assigned_total = assigned_total + a_new_h
-    assigned_ex_total = assigned_ex_total + a_ex_h
-    placed_total = placed_total + placed_h
-
-    # unconstrained phase for plain classes
-    any_quota = jnp.where(spread | anti | zone_aff | host_aff, 0, m)
-    state, ex, assigned, assigned_ex, placed = run_phase(
-        state, ex, any_quota, all_zones, False
+    q_boot = jnp.where(targets_exist | ~member_row[g_haf], 0, host_quota)
+    accumulate(
+        run_phase(
+            state, ex, q_boot, host_restrict, True, single_node=True, max_new_nodes=1
+        )
     )
-    assigned_total = assigned_total + assigned
-    assigned_ex_total = assigned_ex_total + assigned_ex
-    placed_total = placed_total + placed
+
+    # -- unconstrained phase for plain classes --------------------------------
+    any_quota = jnp.where(has_zs | has_zan | has_zaf | has_haf, 0, m)
+    accumulate(run_phase(state, ex, any_quota, allowed_zone, False))
+
+    # -- record (topology.go:120-143): update shared counts -------------------
+    # committed zone per node: singleton masks count for spread/affinity;
+    # anti members/owners record every zone the node could be in
+    ex_sing = jnp.sum(ex.zone.astype(jnp.int32), axis=-1) == 1
+    new_sing = jnp.sum(state.zone.astype(jnp.int32), axis=-1) == 1
+    a_ex_f = assigned_ex_total.astype(jnp.int32)
+    a_new_f = assigned_total.astype(jnp.int32)
+    zone_sing = (
+        jnp.einsum("e,ez->z", jnp.where(ex_sing, a_ex_f, 0), ex.zone.astype(jnp.int32))
+        + jnp.einsum("n,nz->z", jnp.where(new_sing, a_new_f, 0), state.zone.astype(jnp.int32))
+    )
+    zone_full = (
+        jnp.einsum("e,ez->z", a_ex_f, ex.zone.astype(jnp.int32))
+        + jnp.einsum("n,nz->z", a_new_f, state.zone.astype(jnp.int32))
+    )
+    member_zone_pos = member_row & statics.grp_is_zone & ~statics.grp_is_anti
+    member_zone_anti = member_row & statics.grp_is_zone & statics.grp_is_anti
+    member_host = member_row & ~statics.grp_is_zone
+    topo = TopoCounts(
+        zone_fwd=topo.zone_fwd
+        + member_zone_pos[:, None] * zone_sing[None, :]
+        + member_zone_anti[:, None] * zone_full[None, :],
+        zone_inv=topo.zone_inv + own_onehot(g_zan)[:, None] * zone_full[None, :],
+        host_fwd_ex=topo.host_fwd_ex + member_host[:, None] * a_ex_f[None, :],
+        host_inv_ex=topo.host_inv_ex + own_onehot(g_han)[:, None] * a_ex_f[None, :],
+        host_fwd_new=topo.host_fwd_new + member_host[:, None] * a_new_f[None, :],
+        host_inv_new=topo.host_inv_new + own_onehot(g_han)[:, None] * a_new_f[None, :],
+    )
 
     failed = m - placed_total
-    return (state, ex), (assigned_total, assigned_ex_total, failed)
+    return (state, ex, topo), (assigned_total, assigned_ex_total, failed)
 
 
 def solve_core(
@@ -659,16 +784,36 @@ def solve_core(
         open_=jnp.zeros(n_slots, dtype=bool),
         n_next=jnp.int32(0),
     )
+    g1 = statics.grp_skew.shape[0]
     if existing_state is None:
         existing_state = empty_existing_state(n_res, n_keys, width, n_zones, n_ct)
-        existing_static = empty_existing_static(n_res, n_classes)
+        existing_static = empty_existing_static(n_res, n_classes, g1)
+
+    # seed topology counts from pre-existing pods (topology.go:231-276
+    # countDomains): forward from selector-matching pods, inverse from
+    # anti-term owners — closed nodes (consolidation subsets) drop out here
+    open_i = existing_state.open_.astype(jnp.int32)
+    ex_sing = jnp.sum(existing_state.zone.astype(jnp.int32), axis=-1) == 1
+    zone_onehot = jnp.where(
+        ex_sing[:, None], existing_state.zone, False
+    ).astype(jnp.int32)
+    member_open = existing_static.grp_node_member * open_i[None, :]
+    owner_open = existing_static.grp_node_owner * open_i[None, :]
+    topo = TopoCounts(
+        zone_fwd=jnp.einsum("ge,ez->gz", member_open, zone_onehot),
+        zone_inv=jnp.einsum("ge,ez->gz", owner_open, zone_onehot),
+        host_fwd_ex=member_open,
+        host_inv_ex=owner_open,
+        host_fwd_new=jnp.zeros((g1, n_slots), dtype=jnp.int32),
+        host_inv_new=jnp.zeros((g1, n_slots), dtype=jnp.int32),
+    )
 
     def step(carry, cls_with_index):
         return _class_step(statics, existing_static, n_zones, carry, cls_with_index)
 
     cls_indices = jnp.arange(n_classes, dtype=jnp.int32)
-    (final_state, final_ex), (assign, assign_ex, failed) = jax.lax.scan(
-        step, (state, existing_state), (class_tensors, cls_indices)
+    (final_state, final_ex, _), (assign, assign_ex, failed) = jax.lax.scan(
+        step, (state, existing_state, topo), (class_tensors, cls_indices)
     )
     return SolveOutputs(
         assign=assign,
@@ -695,12 +840,13 @@ def empty_existing_state(n_res, n_keys, width, n_zones, n_ct) -> ExistingState:
     )
 
 
-def empty_existing_static(n_res, n_classes) -> ExistingStatic:
+def empty_existing_static(n_res, n_classes, n_groups1: int = 1) -> ExistingStatic:
     return ExistingStatic(
         alloc=jnp.zeros((1, n_res), dtype=jnp.float32),
         init=jnp.zeros(1, dtype=bool),
         tol=jnp.zeros((n_classes, 1), dtype=bool),
-        host_count0=jnp.zeros((n_classes, 1), dtype=jnp.int32),
+        grp_node_member=jnp.zeros((n_groups1, 1), dtype=jnp.int32),
+        grp_node_owner=jnp.zeros((n_groups1, 1), dtype=jnp.int32),
     )
 
 
@@ -768,12 +914,7 @@ def prepare(snapshot: EncodedSnapshot):
         requests=jnp.asarray(snapshot.cls_requests),
         count=jnp.asarray(snapshot.cls_count),
         tol=jnp.asarray(snapshot.cls_tol),
-        zone_cap=jnp.asarray(snapshot.cls_zone_cap),
-        zone_skew=jnp.asarray(snapshot.cls_zone_skew),
-        host_cap=jnp.asarray(snapshot.cls_host_cap),
-        zone_count0=jnp.asarray(snapshot.cls_zone_count0),
-        zone_aff=jnp.asarray(snapshot.cls_zone_aff),
-        host_aff=jnp.asarray(snapshot.cls_host_aff),
+        groups=jnp.asarray(snapshot.cls_groups),
     )
     it_t = mask_ops.ReqTensor(
         jnp.asarray(snapshot.it_mask),
@@ -801,6 +942,10 @@ def prepare(snapshot: EncodedSnapshot):
         jnp.asarray(snapshot.valid),
         jnp.asarray(snapshot.is_custom),
         jnp.asarray(snapshot.vocab_ints),
+        jnp.asarray(snapshot.grp_skew),
+        jnp.asarray(snapshot.grp_is_zone),
+        jnp.asarray(snapshot.grp_is_anti),
+        jnp.asarray(snapshot.grp_member),
     )
     key_has_bounds = tuple(
         bool(np.isfinite(snapshot.cls_gt[:, k]).any() or np.isfinite(snapshot.cls_lt[:, k]).any()
@@ -817,12 +962,17 @@ def estimate_slots(snapshot: EncodedSnapshot) -> int:
     compile-cache friendliness."""
     total = 16
     alloc = snapshot.it_alloc  # [I, R]
-    for c in range(len(snapshot.classes)):
+    for c, cls in enumerate(snapshot.classes):
         size = snapshot.cls_requests[c]  # [R]
         with np.errstate(divide="ignore", invalid="ignore"):
             per = np.floor(np.where(size > 0, alloc / np.maximum(size, 1e-9), np.inf))
         per_it = np.min(np.where(np.isfinite(per), per, np.inf), axis=-1)
         best = np.max(per_it) if per_it.size else 0
-        best = max(1.0, min(best, float(snapshot.cls_host_cap[c])))
+        host_cap = float(UNLIMITED)
+        if cls.host_spread is not None:
+            host_cap = float(cls.host_spread.skew)
+        if cls.host_anti is not None:
+            host_cap = 1.0
+        best = max(1.0, min(best, host_cap))
         total += int(np.ceil(float(snapshot.cls_count[c]) / best)) + snapshot.cls_zone.shape[1]
     return int(2 ** np.ceil(np.log2(max(total, 16))))

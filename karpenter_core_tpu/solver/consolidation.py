@@ -80,7 +80,7 @@ class TPUConsolidationSearch:
             # no pods anywhere: every candidate is empty, deleting all is
             # trivially valid (the simulation would open zero new nodes)
             return Command(Action.DELETE, [c.node for c in candidates])
-        snapshot = self.solver.encode(all_pods, state_nodes)
+        snapshot = self.solver.encode(all_pods, state_nodes, bound_pods)
         ex_state, ex_static = self.solver.encode_existing(
             snapshot, state_nodes, bound_pods
         )

@@ -73,26 +73,6 @@ class TPUSolveResults:
     n_slots_used: int = 0
 
 
-def _class_selectors(cls):
-    """Label selectors of a class's spread/anti-affinity constraints (used to
-    count pre-existing matching pods, topology.go:231-276)."""
-    example = cls.pods[0]
-    selectors = []
-    for constraint in example.spec.topology_spread_constraints:
-        if constraint.label_selector is not None:
-            selectors.append(constraint.label_selector)
-    if example.spec.affinity is not None:
-        for group in (
-            example.spec.affinity.pod_anti_affinity,
-            example.spec.affinity.pod_affinity,
-        ):
-            if group is not None:
-                for term in group.required:
-                    if term.label_selector is not None:
-                        selectors.append(term.label_selector)
-    return selectors
-
-
 @dataclass
 class LaunchableNode:
     """Launch-path adapter (duck-typed like solver.node.SchedulingNode):
@@ -134,16 +114,47 @@ class TPUSolver:
             it.name: it for its in self.instance_types.values() for it in its
         }
 
-    def encode(self, pods: List[Pod], state_nodes: Optional[list] = None) -> EncodedSnapshot:
+    def encode(
+        self,
+        pods: List[Pod],
+        state_nodes: Optional[list] = None,
+        bound_pods: Optional[List[Pod]] = None,
+    ) -> EncodedSnapshot:
         """Raises models.snapshot.KernelUnsupported when the batch needs the
         host path.  Existing-node label values widen the vocabulary so NotIn
-        checks against them stay exact."""
+        checks against them stay exact; bound pods' anti-affinity terms
+        register as groups so their inverse blocking reaches the kernel."""
+        from karpenter_core_tpu.models.snapshot import (
+            GRP_ANTI,
+            UNLIMITED,
+            KernelUnsupported,
+            _group_spec,
+        )
+
         extra = [
             Requirements.from_labels(n.node.metadata.labels) for n in (state_nodes or [])
         ]
+        extra_anti = []
+        for pod in bound_pods or []:
+            affinity = pod.spec.affinity
+            if affinity is None or affinity.pod_anti_affinity is None:
+                continue
+            for term in affinity.pod_anti_affinity.required:
+                try:
+                    spec = _group_spec(GRP_ANTI, term.topology_key, term.label_selector, UNLIMITED)
+                except KernelUnsupported:
+                    # an unrepresentable anti key only matters if it can gate
+                    # a scheduling pod
+                    if term.label_selector is not None and any(
+                        term.label_selector.matches(p.metadata.labels) for p in pods
+                    ):
+                        raise
+                    continue
+                extra_anti.append((spec, term.label_selector))
         return encode_snapshot(
             pods, self.provisioners, self.templates, self.instance_types,
             extra_requirement_sets=extra,
+            extra_anti_groups=extra_anti,
         )
 
     def encode_existing(
@@ -152,8 +163,8 @@ class TPUSolver:
         state_nodes: list,
         bound_pods: Optional[List[Pod]] = None,
     ):
-        """(ExistingState, ExistingStatic) numpy planes for the kernel, plus
-        selector-matching counts folded into the snapshot's zone_count0.
+        """(ExistingState, ExistingStatic) numpy planes for the kernel; the
+        per-group member/owner node counts seed the kernel's topology counts.
 
         Mirrors ExistingNode construction (existingnode.go:43-75): available
         capacity, remaining daemonset overhead, label requirements, ephemeral-
@@ -173,6 +184,7 @@ class TPUSolver:
         CT = len(snapshot.capacity_types)
         K, W = vocab.n_keys, vocab.width
 
+        G1 = len(snapshot.groups) + 1
         used = np.zeros((E, R), dtype=np.float32)
         alloc = np.zeros((E, R), dtype=np.float32)
         kmask = np.ones((E, K, W), dtype=bool)
@@ -186,12 +198,12 @@ class TPUSolver:
         open_ = np.zeros(E, dtype=bool)
         init = np.zeros(E, dtype=bool)
         tol = np.zeros((C, E), dtype=bool)
-        host_count0 = np.zeros((C, E), dtype=np.int32)
+        grp_node_member = np.zeros((G1, E), dtype=np.int32)
+        grp_node_owner = np.zeros((G1, E), dtype=np.int32)
 
         tmpl_by_name = {t.provisioner_name: t for t in self.templates}
         zone_idx = {z: i for i, z in enumerate(snapshot.zones)}
         ct_idx = {c: i for i, c in enumerate(snapshot.capacity_types)}
-        node_zone: dict = {}
 
         for e, state_node in enumerate(state_nodes):
             node = state_node.node
@@ -214,7 +226,6 @@ class TPUSolver:
                 zone[e, :] = True  # unknown zone: any
             elif z in zone_idx:
                 zone[e, zone_idx[z]] = True
-                node_zone[node.name] = z
             c_label = node.metadata.labels.get(labels_api.LABEL_CAPACITY_TYPE)
             if c_label is None:
                 ct[e, :] = True
@@ -226,24 +237,34 @@ class TPUSolver:
             for c, cls in enumerate(snapshot.classes):
                 tol[c, e] = taints.tolerates(cls.pods[0]) is None
 
-        # selector-matching pre-existing pods: zone counts + per-node counts
-        for c, cls in enumerate(snapshot.classes):
-            selectors = _class_selectors(cls)
-            if not selectors:
+        # pre-existing pod counts per topology group (countDomains semantics,
+        # topology.go:231-276): members (forward) and anti-term owners
+        # (inverse); pods being scheduled this solve are excluded
+        from karpenter_core_tpu.models.snapshot import GRP_ANTI, UNLIMITED, _group_spec
+
+        node_index = {n.node.name: e for e, n in enumerate(state_nodes)}
+        group_of = {spec: g for g, spec in enumerate(snapshot.groups)}
+        scheduling_uids = {p.uid for cls in snapshot.classes for p in cls.pods}
+        for pod in bound_pods or []:
+            e = node_index.get(pod.spec.node_name)
+            if e is None or pod.uid in scheduling_uids:
                 continue
-            scheduling_uids = {p.uid for p in cls.pods}
-            for pod in bound_pods or []:
-                if not pod.spec.node_name or pod.uid in scheduling_uids:
-                    continue
-                if not any(s.matches(pod.metadata.labels) for s in selectors):
-                    continue
-                for e, state_node in enumerate(state_nodes):
-                    if state_node.node.name == pod.spec.node_name:
-                        host_count0[c, e] += 1
-                        break
-                z = node_zone.get(pod.spec.node_name)
-                if z is not None:
-                    snapshot.cls_zone_count0[c, zone_idx[z]] += 1
+            labels = pod.metadata.labels
+            for g, selector in enumerate(snapshot.group_selectors):
+                if selector is not None and selector.matches(labels):
+                    grp_node_member[g, e] += 1
+            affinity = pod.spec.affinity
+            if affinity is not None and affinity.pod_anti_affinity is not None:
+                for term in affinity.pod_anti_affinity.required:
+                    try:
+                        spec = _group_spec(
+                            GRP_ANTI, term.topology_key, term.label_selector, UNLIMITED
+                        )
+                    except Exception:  # noqa: BLE001 - unsupported keys don't track
+                        continue
+                    g = group_of.get(spec)
+                    if g is not None:
+                        grp_node_owner[g, e] += 1
 
         ex_state = solve_ops.ExistingState(
             used=jnp.asarray(used),
@@ -261,7 +282,8 @@ class TPUSolver:
             alloc=jnp.asarray(alloc),
             init=jnp.asarray(init),
             tol=jnp.asarray(tol),
-            host_count0=jnp.asarray(host_count0),
+            grp_node_member=jnp.asarray(grp_node_member),
+            grp_node_owner=jnp.asarray(grp_node_owner),
         )
         return ex_state, ex_static
 
@@ -272,7 +294,7 @@ class TPUSolver:
         bound_pods: Optional[List[Pod]] = None,
         n_slots: int = 0,
     ) -> TPUSolveResults:
-        snapshot = self.encode(pods, state_nodes)
+        snapshot = self.encode(pods, state_nodes, bound_pods)
         ex_state = ex_static = None
         if state_nodes:
             ex_state, ex_static = self.encode_existing(snapshot, state_nodes, bound_pods)

@@ -176,3 +176,102 @@ class TestExistingNodes:
         tpu_new = sum(len(n.pods) for n in tpu.new_nodes)
         assert (tpu_existing, tpu_new) == (host_existing, host_new)
         assert len(tpu.failed_pods) == len(host.failed_pods) == 0
+
+
+class TestReviewRegressions:
+    """Scenarios from review: kernel/host divergences that are now fixed."""
+
+    def test_bound_anti_affinity_guards_node(self):
+        """A bound pod's anti-affinity term blocks the pods it selects even
+        when no pending pod owns an identical term (inverse topologies from
+        cluster pods, topology.go:185-198)."""
+        from karpenter_core_tpu.apis.objects import LabelSelector, PodAffinityTerm
+
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_ready_node(env, cpu=8)
+        guard = make_pod(
+            labels={"app": "lonely"},
+            node_name=node.name,
+            unschedulable=False,
+            requests={"cpu": "100m"},
+            pod_anti_affinity=[
+                PodAffinityTerm(
+                    topology_key=labels_api.LABEL_HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"role": "noisy"}),
+                )
+            ],
+        )
+        env.kube.create(guard)
+        noisy = [make_pod(labels={"role": "noisy"}, requests={"cpu": "100m"})]
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        res = solver.solve(
+            noisy, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        # the guarded node must not receive the noisy pod
+        assert node.name not in res.existing_assignments
+        assert sum(len(n.pods) for n in res.new_nodes) == 1
+
+    def test_cross_group_affinity_order_sensitivity_routed_to_host(self):
+        """Follower class (bigger cpu, scans first) with affinity to a target
+        class that scans later: single-pass kernel can't satisfy it, so the
+        host path must take over."""
+        import pytest
+
+        from karpenter_core_tpu.apis.objects import LabelSelector, PodAffinityTerm
+        from karpenter_core_tpu.models.snapshot import KernelUnsupported, classify_pods
+
+        targets = [
+            make_pod(labels={"app": "tgt"}, requests={"cpu": "10m"},
+                     node_selector={ZONE: "test-zone-2"})
+        ]
+        followers = [
+            make_pod(
+                requests={"cpu": "500m"},
+                pod_affinity=[
+                    PodAffinityTerm(
+                        topology_key=ZONE,
+                        label_selector=LabelSelector(match_labels={"app": "tgt"}),
+                    )
+                ],
+            )
+        ]
+        with pytest.raises(KernelUnsupported):
+            classify_pods(targets + followers)
+
+    def test_zone_affinity_bootstrap_capacity_aware(self):
+        """Bootstrap must pick a zone some template actually offers."""
+        from karpenter_core_tpu.apis.objects import (
+            LabelSelector,
+            NodeSelectorRequirement,
+            OP_IN,
+            PodAffinityTerm,
+        )
+        from karpenter_core_tpu.testing import make_pods
+
+        provisioner = make_provisioner(
+            requirements=[
+                NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-2", "test-zone-3"])
+            ]
+        )
+        pods = [
+            make_pod(
+                labels={"grp": "a"},
+                requests={"cpu": "100m"},
+                pod_affinity=[
+                    PodAffinityTerm(
+                        topology_key=ZONE,
+                        label_selector=LabelSelector(match_labels={"grp": "a"}),
+                    )
+                ],
+            )
+            for _ in range(3)
+        ]
+        provider = env_provider = __import__(
+            "karpenter_core_tpu.cloudprovider.fake", fromlist=["FakeCloudProvider"]
+        ).FakeCloudProvider()
+        solver = TPUSolver(provider, [provisioner])
+        res = solver.solve(pods)
+        assert not res.failed_pods
+        zones = {z for n in res.new_nodes for z in n.zones}
+        assert zones <= {"test-zone-2", "test-zone-3"}

@@ -320,23 +320,103 @@ class TestKernelSelfAffinity:
 
         compare(pods)
 
-    def test_coupled_selector_classes_rejected(self):
-        # two groups sharing one label selector-couple: host path required
-        pods = spread_pods(2) + spread_pods(2, key=HOSTNAME)
-        with pytest.raises(KernelUnsupported):
-            classify_pods(pods)
+    def test_coupled_selector_classes_parity(self):
+        """Two spread groups sharing one label (zonal + hostname, both on
+        app=web) couple across classes — shared-group counting must match the
+        host's hash-deduped topology groups."""
+        host, tpu = compare(lambda: spread_pods(4) + spread_pods(4, key=HOSTNAME))
+
+    def test_cross_group_affinity_parity(self):
+        """Affinity to a different group: followers colocate with a
+        zone-pinned target class (topology_test.go zone-affinity cases)."""
+        def pods():
+            targets = [
+                make_pod(
+                    labels={"app": "tgt"},
+                    requests={"cpu": "10m"},
+                    node_selector={ZONE: "test-zone-2"},
+                )
+                for _ in range(2)
+            ]
+            followers = [
+                make_pod(
+                    labels={"app": "fol"},
+                    requests={"cpu": "10m"},
+                    pod_affinity=[
+                        PodAffinityTerm(
+                            topology_key=ZONE,
+                            label_selector=LabelSelector(match_labels={"app": "tgt"}),
+                        )
+                    ],
+                )
+                for _ in range(3)
+            ]
+            return targets + followers
+
+        host, tpu = compare(pods)
+        # followers land in the targets' zone
+        for node in tpu.new_nodes:
+            if any(p.metadata.labels.get("app") == "fol" for p in node.pods):
+                assert node.zones == ["test-zone-2"]
+
+    def test_inverse_anti_affinity_parity(self):
+        """Pods selected by another class's anti-affinity avoid its nodes."""
+        def pods():
+            guards = [
+                make_pod(
+                    labels={"app": "lonely"},
+                    requests={"cpu": "10m"},
+                    pod_anti_affinity=[
+                        PodAffinityTerm(
+                            topology_key=HOSTNAME,
+                            label_selector=LabelSelector(match_labels={"role": "noisy"}),
+                        )
+                    ],
+                )
+            ]
+            noisy = [
+                make_pod(labels={"role": "noisy"}, requests={"cpu": "10m"})
+                for _ in range(2)
+            ]
+            return guards + noisy
+
+        host, tpu = compare(pods)
+        for node in tpu.new_nodes:
+            apps = {p.metadata.labels.get("app") or p.metadata.labels.get("role") for p in node.pods}
+            assert not ({"lonely", "noisy"} <= apps), "guard and noisy pods must not share a node"
 
 
 class TestKernelUnsupported:
-    def test_cross_group_pod_affinity_rejected(self):
-        # affinity to a DIFFERENT group (not self-selecting) needs the host path
+    def test_affinity_to_absent_group_fails_everywhere(self):
+        # affinity to a group with no pods anywhere: unsatisfiable, and not a
+        # bootstrap case since the selector doesn't match the pod itself
+        # (reference: 'should not schedule pods with affinity to a non-existent
+        # pod', topology_test.go:1924)
+        host, tpu = compare(
+            lambda: [
+                make_pod(
+                    labels={"app": "a"},
+                    pod_affinity=[
+                        PodAffinityTerm(
+                            topology_key=ZONE,
+                            label_selector=LabelSelector(match_labels={"app": "other"}),
+                        )
+                    ],
+                )
+                for _ in range(3)
+            ]
+        )
+        assert len(tpu.failed_pods) == 3
+
+    def test_region_spread_rejected(self):
         pods = [
             make_pod(
                 labels={"app": "a"},
-                pod_affinity=[
-                    PodAffinityTerm(
-                        topology_key=ZONE,
-                        label_selector=LabelSelector(match_labels={"app": "other"}),
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key="topology.kubernetes.io/region",
+                        label_selector=LabelSelector(match_labels={"app": "a"}),
                     )
                 ],
             )
@@ -349,6 +429,9 @@ class TestKernelUnsupported:
             classify_pods([make_pod(host_ports=[80])])
 
     def test_non_self_selecting_spread_rejected(self):
+        """A spread whose own pods don't count packs per-pod onto open nodes
+        within skew — a behavior the batched water-fill doesn't model, so the
+        host path handles it."""
         pods = [
             make_pod(
                 labels={"app": "a"},
