@@ -104,7 +104,7 @@ class Operator:
                 "deprovisioning",
                 lambda: self.deprovisioning.reconcile()[1],
                 clock=self.clock,
-                default_requeue=10.0,
+                default_requeue=self.options.poll_interval,
             ),
             Singleton("metrics_state", self.node_scraper.scrape, clock=self.clock, default_requeue=5.0),
             Singleton(

@@ -1,0 +1,138 @@
+"""Deprovisioning validation and execution edge cases.
+
+Deeper coverage of validation.go / controller.go behaviors: TTL re-validation
+races, nominated-node blocking, launch-failure rollback, replacement readiness
+timeout rollback, waitForDeletion, and the eviction queue's retry behavior.
+"""
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import OP_IN, NodeSelectorRequirement
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.controllers.deprovisioning import Action, Command, Result
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
+
+CT = labels_api.LABEL_CAPACITY_TYPE
+
+
+def od_consolidating_env(instance_types=5):
+    env = make_environment(instance_types=fake_cp.instance_types(instance_types))
+    env.kube.create(
+        make_provisioner(
+            consolidation_enabled=True,
+            requirements=[
+                NodeSelectorRequirement(CT, OP_IN, [labels_api.CAPACITY_TYPE_ON_DEMAND])
+            ],
+        )
+    )
+    return env
+
+
+def oversized_node(env, small_cpu="500m"):
+    big = make_pod(requests={"cpu": 4})
+    small = make_pod(requests={"cpu": small_cpu})
+    expect_provisioned(env, big, small)
+    env.make_all_nodes_ready()
+    env.kube.delete(env.kube.get_pod(big.namespace, big.name), force=True)
+    env.clock.step(21)
+    return small
+
+
+class TestValidationRaces:
+    def test_nominated_node_fails_validation(self):
+        """A node nominated between compute and validation blocks execution
+        (validation.go:86-91)."""
+        env = od_consolidating_env()
+        oversized_node(env)
+        node = env.kube.list_nodes()[0]
+
+        # nominate mid-TTL via a clock hook: when validation sleeps the 15s
+        # TTL, a 'concurrent provisioning pass' nominates the node
+        orig_sleep = env.clock.sleep
+
+        def sleep_and_nominate(seconds):
+            orig_sleep(seconds)
+            if seconds >= 10:
+                env.cluster.nominate_node_for_pod(node.name)
+
+        env.clock.sleep = sleep_and_nominate
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.RETRY
+        assert env.kube.get_node(node.name) is not None
+
+    def test_pod_added_mid_ttl_invalidates_delete(self):
+        """Empty-node consolidation re-checks emptiness after the TTL
+        (emptynodeconsolidation.go:64-87)."""
+        env = od_consolidating_env()
+        pod = make_pod(requests={"cpu": "100m"})
+        expect_provisioned(env, pod)
+        env.make_all_nodes_ready()
+        env.clock.step(21)
+        node = env.kube.list_nodes()[0]
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+
+        # a new pod binds to the node while the TTL elapses
+        orig_sleep = env.clock.sleep
+        bound = {"done": False}
+
+        def sleep_and_bind(seconds):
+            orig_sleep(seconds)
+            if seconds >= 10 and not bound["done"]:
+                bound["done"] = True
+                newcomer = make_pod(requests={"cpu": "100m"})
+                env.kube.create(newcomer)
+                env.bind(newcomer, node.name)
+
+        env.clock.sleep = sleep_and_bind
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.RETRY
+        assert env.kube.get_node(node.name) is not None
+
+
+class TestExecutionRollback:
+    def test_launch_failure_uncordons(self):
+        """Replacement launch failure rolls back the cordon
+        (controller.go:283-326)."""
+        env = od_consolidating_env()
+        oversized_node(env)
+        node = env.kube.list_nodes()[0]
+        env.provider.allowed_create_calls = len(env.provider.create_calls)  # next create fails
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.FAILED
+        stored = env.kube.get_node(node.name)
+        assert stored is not None
+        assert not stored.spec.unschedulable, "cordon must be rolled back"
+        assert not env.cluster.snapshot_nodes()[0].marked_for_deletion
+
+    def test_replacement_never_ready_rolls_back(self):
+        """Readiness timeout unmarks and uncordons (controller.go:305-326)."""
+        env = od_consolidating_env()
+        oversized_node(env)
+        node = env.kube.list_nodes()[0]
+        env.deprovisioning.on_replacements_launched = None  # nothing initializes them
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.FAILED
+        stored = env.kube.get_node(node.name)
+        assert stored is not None and not stored.spec.unschedulable
+        assert not env.cluster.snapshot_nodes()[0].marked_for_deletion
+
+
+class TestConsolidationStateGating:
+    def test_skips_until_cluster_changes(self):
+        env = od_consolidating_env()
+        pod = make_pod(requests={"cpu": "400m"})
+        expect_provisioned(env, pod)
+        env.make_all_nodes_ready()
+        env.clock.step(21)
+        # nothing consolidatable: single right-sized node
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.NOTHING_TO_DO
+        # unchanged cluster: consolidation methods record the state and skip
+        before = env.deprovisioning.single_node_consolidation.last_consolidation_state
+        assert before == env.cluster.cluster_consolidation_state()
+        # a cluster change (pod deleted) re-enables attempts
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        assert (
+            env.deprovisioning.single_node_consolidation.last_consolidation_state
+            != env.cluster.cluster_consolidation_state()
+        )
