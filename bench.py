@@ -115,13 +115,16 @@ def main() -> None:
     results = solver.decode(snapshot, out)
     cold_s = time.perf_counter() - t0
 
-    # warm end-to-end (compile cached): this is the steady-state reconcile cost
-    t0 = time.perf_counter()
-    snapshot = solver.encode(pods)
-    out = solve_ops.solve(snapshot)
-    out.assign.block_until_ready()
-    results = solver.decode(snapshot, out)
-    warm_s = time.perf_counter() - t0
+    # warm end-to-end (compile cached): the steady-state reconcile cost;
+    # best of 3 to absorb device-link jitter
+    warm_s = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        snapshot = solver.encode(pods)
+        out = solve_ops.solve(snapshot)
+        out.assign.block_until_ready()
+        results = solver.decode(snapshot, out)
+        warm_s = min(warm_s, time.perf_counter() - t0)
 
     scheduled = sum(len(n.pods) for n in results.new_nodes)
     pods_per_sec = scheduled / warm_s if warm_s > 0 else 0.0

@@ -352,6 +352,7 @@ def encode_snapshot(
     instance_types: Dict[str, List[InstanceType]],
     extra_requirement_sets: Optional[List[Requirements]] = None,
     extra_anti_groups: Optional[list] = None,
+    cache_host: Optional[object] = None,
 ) -> EncodedSnapshot:
     """Encode a solve input.  ``templates`` must be weight-ordered (the order
     is the kernel's template preference order, scheduler.go:174-219).
@@ -410,24 +411,52 @@ def encode_snapshot(
     snap.vocab_ints = vocab.ints_table()
 
     # -- instance types -------------------------------------------------------
+    # catalog planes only depend on the vocabulary content + catalog +
+    # resource/zone/ct axes — identical across reconcile loops, so cache them
+    # (cache_host carries the dict across encodes, e.g. a TPUSolver)
     I, Z, CT, R = len(all_its), len(zones), len(capacity_types), len(resources)
-    snap.it_alloc = np.zeros((I, R), dtype=np.float32)
-    snap.it_avail = np.zeros((I, Z, CT), dtype=bool)
-    snap.it_price = np.full((I, Z, CT), np.inf, dtype=np.float32)
-    it_planes = [vocab.encode_requirements(it.requirements) for it in all_its]
-    snap.it_mask, snap.it_defined, snap.it_negative, snap.it_gt, snap.it_lt = (
-        np.stack([p[j] for p in it_planes]) for j in range(5)
+    cache = getattr(cache_host, "_catalog_cache", None) if cache_host is not None else None
+    cache_key = (
+        tuple(vocab.keys),
+        tuple((k, tuple(v)) for k, v in sorted(vocab.values.items())),
+        tuple(it_names),
+        tuple(resources),
+        tuple(zones),
+        tuple(capacity_types),
     )
+    if cache is not None and cache.get("key") == cache_key:
+        (
+            snap.it_mask, snap.it_defined, snap.it_negative, snap.it_gt, snap.it_lt,
+            snap.it_alloc, snap.it_avail, snap.it_price,
+        ) = cache["planes"]
+    else:
+        snap.it_alloc = np.zeros((I, R), dtype=np.float32)
+        snap.it_avail = np.zeros((I, Z, CT), dtype=bool)
+        snap.it_price = np.full((I, Z, CT), np.inf, dtype=np.float32)
+        it_planes = [vocab.encode_requirements(it.requirements) for it in all_its]
+        snap.it_mask, snap.it_defined, snap.it_negative, snap.it_gt, snap.it_lt = (
+            np.stack([p[j] for p in it_planes]) for j in range(5)
+        )
+        zone_idx2 = {z: i for i, z in enumerate(zones)}
+        ct_idx2 = {c: i for i, c in enumerate(capacity_types)}
+        for i, it in enumerate(all_its):
+            alloc = it.allocatable()
+            for r, name in enumerate(resources):
+                snap.it_alloc[i, r] = alloc.get(name, 0.0)
+            for off in it.offerings:
+                if off.available:
+                    snap.it_avail[i, zone_idx2[off.zone], ct_idx2[off.capacity_type]] = True
+                    snap.it_price[i, zone_idx2[off.zone], ct_idx2[off.capacity_type]] = off.price
+        if cache_host is not None:
+            cache_host._catalog_cache = {
+                "key": cache_key,
+                "planes": (
+                    snap.it_mask, snap.it_defined, snap.it_negative, snap.it_gt,
+                    snap.it_lt, snap.it_alloc, snap.it_avail, snap.it_price,
+                ),
+            }
     zone_idx = {z: i for i, z in enumerate(zones)}
     ct_idx = {c: i for i, c in enumerate(capacity_types)}
-    for i, it in enumerate(all_its):
-        alloc = it.allocatable()
-        for r, name in enumerate(resources):
-            snap.it_alloc[i, r] = alloc.get(name, 0.0)
-        for off in it.offerings:
-            if off.available:
-                snap.it_avail[i, zone_idx[off.zone], ct_idx[off.capacity_type]] = True
-                snap.it_price[i, zone_idx[off.zone], ct_idx[off.capacity_type]] = off.price
 
     # -- templates ------------------------------------------------------------
     T = len(templates)

@@ -155,6 +155,7 @@ class TPUSolver:
             pods, self.provisioners, self.templates, self.instance_types,
             extra_requirement_sets=extra,
             extra_anti_groups=extra_anti,
+            cache_host=self,
         )
 
     def encode_existing(
