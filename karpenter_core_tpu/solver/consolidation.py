@@ -111,10 +111,34 @@ class TPUConsolidationSearch:
             sizes = np.unique(
                 np.round(np.linspace(1, n, MAX_LANES)).astype(np.int32)
             )
+        best, best_k = self._evaluate_sweep(
+            snapshot, ex_state, ex_static, rank, ex_cls_count, sizes, candidates
+        )
+
+        # refine: with a coarse grid, the exact largest valid prefix may sit
+        # between the best coarse lane and the next one — one more pass over
+        # that gap pins it (two passes total vs the reference's ~log2(n)
+        # sequential probes)
+        if n > MAX_LANES and best is not None:
+            upper = int(sizes[np.searchsorted(sizes, best_k) + 1]) if best_k < int(sizes[-1]) else None
+            if upper is not None and upper - best_k > 1:
+                fine = np.arange(best_k + 1, upper, dtype=np.int32)
+                if len(fine) > MAX_LANES:
+                    fine = np.unique(np.round(np.linspace(best_k + 1, upper - 1, MAX_LANES)).astype(np.int32))
+                refined, refined_k = self._evaluate_sweep(
+                    snapshot, ex_state, ex_static, rank, ex_cls_count, fine, candidates
+                )
+                if refined is not None and refined_k > best_k:
+                    best = refined
+        return best if best is not None else Command(Action.DO_NOTHING)
+
+    def _evaluate_sweep(
+        self, snapshot, ex_state, ex_static, rank, ex_cls_count, sizes, candidates
+    ):
+        """(best command, its prefix size) across the given lane sizes."""
         out = consolidate_ops.run_sweep(
             snapshot, ex_state, ex_static, rank, ex_cls_count, sizes
         )
-
         n_new = np.asarray(out.n_new)
         failed = np.asarray(out.failed)
         uninit = np.asarray(out.used_uninitialized)
@@ -125,12 +149,14 @@ class TPUConsolidationSearch:
         tmpl_id = np.asarray(out.new_tmpl)
 
         best: Optional[Command] = None
+        best_k = 0
         for lane, k in enumerate(sizes.tolist()):
             if failed[lane] > 0 or uninit[lane]:
                 continue
             subset = candidates[:k]
             if int(n_new[lane]) == 0:
                 best = Command(Action.DELETE, [c.node for c in subset])
+                best_k = k
                 continue
             if int(n_new[lane]) != 1:
                 continue
@@ -140,10 +166,9 @@ class TPUConsolidationSearch:
             )
             if replacement is None:
                 continue
-            best = Command(
-                Action.REPLACE, [c.node for c in subset], [replacement]
-            )
-        return best if best is not None else Command(Action.DO_NOTHING)
+            best = Command(Action.REPLACE, [c.node for c in subset], [replacement])
+            best_k = k
+        return best, best_k
 
     def _decode_replacement(
         self, snapshot, viable_row, zone_row, ct_row, used_row, tmpl_idx, subset
