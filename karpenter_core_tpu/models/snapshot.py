@@ -131,6 +131,9 @@ class EncodedSnapshot:
     cls_requests: np.ndarray = None  # f32[C, R]
     cls_count: np.ndarray = None  # i32[C]
     cls_tol: np.ndarray = None  # bool[C, T] tolerates template taints
+    # host ports [P axis: distinct (port, protocol) pairs in play]
+    ports: List[tuple] = None
+    cls_ports: np.ndarray = None  # bool[C, P] ports each class's pod binds
     # topology groups [G1] (shared across classes; last row = dummy "none")
     groups: List[GroupSpec] = None  # host-side identities, len G
     group_selectors: list = None  # selector object per group (membership tests)
@@ -337,8 +340,12 @@ def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
                 spec = _group_spec(GRP_ANTI, term.topology_key, term.label_selector, UNLIMITED)
                 set_slot("zone_anti" if spec.is_zone else "host_anti", spec, term.label_selector)
     for container in pod.spec.containers:
-        if any(p.host_port for p in container.ports):
-            raise KernelUnsupported("host ports not kernel-supported")
+        for p in container.ports:
+            if p.host_port and p.host_ip not in ("", "0.0.0.0", "::"):
+                # specific-IP host ports only conflict with same/unspecified
+                # IPs (hostportusage.go:44-56) — finer than the kernel's
+                # (port, proto) bitset models
+                raise KernelUnsupported("host ports with specific hostIP not kernel-supported")
     if cls.zone_affinity is not None and (cls.zone_spread is not None or cls.zone_anti is not None):
         raise KernelUnsupported("combined zone affinity + spread/anti not kernel-supported")
     if cls.host_affinity is not None and (cls.host_spread is not None or cls.host_anti is not None):
@@ -353,6 +360,7 @@ def encode_snapshot(
     extra_requirement_sets: Optional[List[Requirements]] = None,
     extra_anti_groups: Optional[list] = None,
     cache_host: Optional[object] = None,
+    extra_host_ports: Optional[List[tuple]] = None,
 ) -> EncodedSnapshot:
     """Encode a solve input.  ``templates`` must be weight-ordered (the order
     is the kernel's template preference order, scheduler.go:174-219).
@@ -574,5 +582,26 @@ def encode_snapshot(
         example = cls.pods[0]
         for t, tmpl in enumerate(templates):
             snap.cls_tol[c, t] = Taints.of(tmpl.taints).tolerates(example) is None
+
+    # -- host ports (hostportusage.go:31-144 as a (port, proto) bitset) -------
+    port_universe: Dict[tuple, None] = {}
+    def _pod_ports(pod):
+        return [
+            (p.host_port, p.protocol or "TCP")
+            for container in pod.spec.containers
+            for p in container.ports
+            if p.host_port
+        ]
+    for cls in classes:
+        for key in _pod_ports(cls.pods[0]):
+            port_universe.setdefault(key)
+    for key in extra_host_ports or []:
+        port_universe.setdefault(key)
+    snap.ports = list(port_universe) or [(0, "TCP")]  # >=1 column for XLA
+    port_idx = {key: i for i, key in enumerate(snap.ports)}
+    snap.cls_ports = np.zeros((C, len(snap.ports)), dtype=bool)
+    for c, cls in enumerate(classes):
+        for key in _pod_ports(cls.pods[0]):
+            snap.cls_ports[c, port_idx[key]] = True
 
     return snap

@@ -50,6 +50,7 @@ class NodeState(NamedTuple):
     zone: jnp.ndarray  # bool[N, Z]
     ct: jnp.ndarray  # bool[N, CT]
     viable: jnp.ndarray  # bool[N, I]
+    ports: jnp.ndarray  # bool[N, P] bound (port, proto) pairs
     pod_count: jnp.ndarray  # i32[N]
     tmpl_id: jnp.ndarray  # i32[N]
     open_: jnp.ndarray  # bool[N]
@@ -72,6 +73,7 @@ class ExistingState(NamedTuple):
     klt: jnp.ndarray  # f32[E, K]
     zone: jnp.ndarray  # bool[E, Z]
     ct: jnp.ndarray  # bool[E, CT]
+    ports: jnp.ndarray  # bool[E, P] bound (port, proto) pairs
     pod_count: jnp.ndarray  # i32[E] pods added THIS solve
     open_: jnp.ndarray  # bool[E]
 
@@ -284,6 +286,7 @@ class ClassTensors(NamedTuple):
     requests: jnp.ndarray
     count: jnp.ndarray
     tol: jnp.ndarray
+    ports: jnp.ndarray  # bool[C, P] host ports each pod of the class binds
     groups: jnp.ndarray  # i32[C, 6]: owned group per kind (G = none):
     # [zone_spread, host_spread, zone_aff, host_aff, zone_anti, host_anti]
 
@@ -333,9 +336,16 @@ def _phase_existing(
         cap = per if cap is None else jnp.minimum(cap, per)
     cap = jnp.minimum(cap, BIG).astype(jnp.int32)
 
+    # host ports: conflict blocks the node; identical pods conflict with each
+    # other, so a port-bearing class caps at one pod per node
+    # (hostportusage.go:31-56)
+    has_ports = jnp.any(cls.ports)
+    port_conflict = jnp.any(ex.ports & cls.ports[None, :], axis=-1)
     elig = ex.open_ & key_ok & tol_row & jnp.any(zone_ok, axis=-1) & jnp.any(ct_ok, axis=-1)
+    elig = elig & ~port_conflict
     if extra_elig is not None:
         elig = elig & extra_elig
+    cap = jnp.minimum(cap, jnp.where(has_ports, 1, UNLIMITED))
     cap = jnp.where(elig, jnp.minimum(cap, host_cap_vec), 0)
     if single_node:
         first = jnp.argmax(cap > 0)
@@ -358,6 +368,7 @@ def _phase_existing(
             sel, ex.zone & cls.zone[None, :], ex.zone
         ),
         ct=jnp.where(sel, ct_ok, ex.ct),
+        ports=jnp.where(sel, ex.ports | cls.ports[None, :], ex.ports),
         pod_count=ex.pod_count + assigned,
         open_=ex.open_,
     )
@@ -410,6 +421,10 @@ def _phase(
     )
     if extra_elig is not None:
         elig = elig & extra_elig
+    has_ports = jnp.any(cls.ports)
+    port_conflict = jnp.any(state.ports & cls.ports[None, :], axis=-1)
+    elig = elig & ~port_conflict
+    cap_n = jnp.minimum(cap_n, jnp.where(has_ports, 1, UNLIMITED))
     cap_n = jnp.where(elig, jnp.minimum(cap_n, host_cap_vec), 0)
     if max_new_nodes is not None and max_new_nodes == 1:
         # hostname self-affinity bootstrap: at most one node hosts the class
@@ -438,6 +453,7 @@ def _phase(
     )
     new_ct = jnp.where(sel, ct_ok, state.ct)
     viable = jnp.where(sel, it_ok & (cap_ni >= assigned[:, None]), state.viable)
+    ports_plane = jnp.where(sel, state.ports | cls.ports[None, :], state.ports)
     pod_count = state.pod_count + assigned
 
     # -- open fresh nodes ----------------------------------------------------
@@ -473,6 +489,7 @@ def _phase(
     t_ok = t_viable[t_star]
 
     per_node = jnp.minimum(t_cap[t_star], fresh_host_cap)
+    per_node = jnp.minimum(per_node, jnp.where(has_ports, 1, UNLIMITED))
     per_node = jnp.maximum(per_node, 1)
     n_new = jnp.where(t_ok & (rem > 0), -(-rem // per_node), 0)
     free_slots = n_slots - state.n_next
@@ -499,6 +516,9 @@ def _phase(
     new_ct = jnp.where(seln, t_ct[t_star][None, :], new_ct)
     fresh_viable = t_it_ok[t_star][None, :] & (t_cap_ti[t_star][None, :] >= a_new[:, None])
     viable = jnp.where(seln, fresh_viable, viable)
+    ports_plane = jnp.where(
+        seln, (a_new > 0)[:, None] & cls.ports[None, :], ports_plane
+    )
     pod_count = jnp.where(is_new, a_new, pod_count)
     tmpl_id = jnp.where(is_new, t_star, state.tmpl_id)
     open_ = state.open_ | is_new
@@ -506,7 +526,7 @@ def _phase(
 
     new_state = NodeState(
         used, kmask, kdef, kneg, kgt, klt, new_zone, new_ct, viable,
-        pod_count, tmpl_id, open_, n_next,
+        ports_plane, pod_count, tmpl_id, open_, n_next,
     )
     return new_state, assigned + a_new, placed_existing + placed_new
 
@@ -779,14 +799,16 @@ def solve_core(
         zone=jnp.ones((n_slots, n_zones), dtype=bool),
         ct=jnp.ones((n_slots, n_ct), dtype=bool),
         viable=jnp.ones((n_slots, n_it), dtype=bool),
+        ports=jnp.zeros((n_slots, class_tensors.ports.shape[-1] if n_classes else 1), dtype=bool),
         pod_count=jnp.zeros(n_slots, dtype=jnp.int32),
         tmpl_id=jnp.zeros(n_slots, dtype=jnp.int32),
         open_=jnp.zeros(n_slots, dtype=bool),
         n_next=jnp.int32(0),
     )
     g1 = statics.grp_skew.shape[0]
+    n_ports = class_tensors.ports.shape[-1] if n_classes else 1
     if existing_state is None:
-        existing_state = empty_existing_state(n_res, n_keys, width, n_zones, n_ct)
+        existing_state = empty_existing_state(n_res, n_keys, width, n_zones, n_ct, n_ports)
         existing_static = empty_existing_static(n_res, n_classes, g1)
 
     # seed topology counts from pre-existing pods (topology.go:231-276
@@ -824,7 +846,7 @@ def solve_core(
     )
 
 
-def empty_existing_state(n_res, n_keys, width, n_zones, n_ct) -> ExistingState:
+def empty_existing_state(n_res, n_keys, width, n_zones, n_ct, n_ports: int = 1) -> ExistingState:
     """A single closed dummy slot (E=0 shapes upset some XLA reductions)."""
     return ExistingState(
         used=jnp.zeros((1, n_res), dtype=jnp.float32),
@@ -835,6 +857,7 @@ def empty_existing_state(n_res, n_keys, width, n_zones, n_ct) -> ExistingState:
         klt=jnp.full((1, n_keys), jnp.inf, dtype=jnp.float32),
         zone=jnp.ones((1, n_zones), dtype=bool),
         ct=jnp.ones((1, n_ct), dtype=bool),
+        ports=jnp.zeros((1, n_ports), dtype=bool),
         pod_count=jnp.zeros(1, dtype=jnp.int32),
         open_=jnp.zeros(1, dtype=bool),
     )
@@ -914,6 +937,7 @@ def prepare(snapshot: EncodedSnapshot):
         requests=jnp.asarray(snapshot.cls_requests),
         count=jnp.asarray(snapshot.cls_count),
         tol=jnp.asarray(snapshot.cls_tol),
+        ports=jnp.asarray(snapshot.cls_ports),
         groups=jnp.asarray(snapshot.cls_groups),
     )
     it_t = mask_ops.ReqTensor(

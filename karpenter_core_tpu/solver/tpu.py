@@ -151,11 +151,19 @@ class TPUSolver:
                         raise
                     continue
                 extra_anti.append((spec, term.label_selector))
+        extra_ports = [
+            (p.host_port, p.protocol or "TCP")
+            for pod in bound_pods or []
+            for container in pod.spec.containers
+            for p in container.ports
+            if p.host_port
+        ]
         return encode_snapshot(
             pods, self.provisioners, self.templates, self.instance_types,
             extra_requirement_sets=extra,
             extra_anti_groups=extra_anti,
             cache_host=self,
+            extra_host_ports=extra_ports,
         )
 
     def encode_existing(
@@ -199,6 +207,8 @@ class TPUSolver:
         open_ = np.zeros(E, dtype=bool)
         init = np.zeros(E, dtype=bool)
         tol = np.zeros((C, E), dtype=bool)
+        P = len(snapshot.ports)
+        ports = np.zeros((E, P), dtype=bool)
         grp_node_member = np.zeros((G1, E), dtype=np.int32)
         grp_node_owner = np.zeros((G1, E), dtype=np.int32)
 
@@ -251,6 +261,13 @@ class TPUSolver:
             if e is None or pod.uid in scheduling_uids:
                 continue
             labels = pod.metadata.labels
+            port_idx = {key: i for i, key in enumerate(snapshot.ports)}
+            for container in pod.spec.containers:
+                for cp in container.ports:
+                    if cp.host_port:
+                        i = port_idx.get((cp.host_port, cp.protocol or "TCP"))
+                        if i is not None:
+                            ports[e, i] = True
             for g, selector in enumerate(snapshot.group_selectors):
                 if selector is not None and selector.matches(labels):
                     grp_node_member[g, e] += 1
@@ -276,6 +293,7 @@ class TPUSolver:
             klt=jnp.asarray(klt),
             zone=jnp.asarray(zone),
             ct=jnp.asarray(ct),
+            ports=jnp.asarray(ports),
             pod_count=jnp.asarray(pod_count),
             open_=jnp.asarray(open_),
         )

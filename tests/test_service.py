@@ -119,7 +119,12 @@ class TestSnapshotChannel:
     def test_unsupported_batch_rejected(self, channel):
         import grpc
 
-        pod = make_pod(host_ports=[80])
+        from karpenter_core_tpu.apis.objects import ContainerPort
+
+        pod = make_pod()
+        pod.spec.containers[0].ports.append(
+            ContainerPort(host_port=80, host_ip="10.0.0.1")  # specific-IP: host path
+        )
         with pytest.raises(grpc.RpcError) as excinfo:
             channel.solve([pod], [make_provisioner()])
         assert excinfo.value.code() == grpc.StatusCode.FAILED_PRECONDITION

@@ -275,3 +275,22 @@ class TestReviewRegressions:
         assert not res.failed_pods
         zones = {z for n in res.new_nodes for z in n.zones}
         assert zones <= {"test-zone-2", "test-zone-3"}
+
+    def test_bound_host_port_blocks_existing_node(self):
+        """A bound pod's host port blocks a pending pod using the same port
+        from that node (hostportusage seed from bound pods)."""
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_ready_node(env, cpu=8)
+        bound = make_pod(
+            host_ports=[8080], node_name=node.name, unschedulable=False,
+            requests={"cpu": "100m"},
+        )
+        env.kube.create(bound)
+        pending = [make_pod(host_ports=[8080], requests={"cpu": "100m"})]
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        res = solver.solve(
+            pending, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        assert node.name not in res.existing_assignments
+        assert sum(len(n.pods) for n in res.new_nodes) == 1

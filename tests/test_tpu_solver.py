@@ -424,9 +424,30 @@ class TestKernelUnsupported:
         with pytest.raises(KernelUnsupported):
             classify_pods(pods)
 
-    def test_host_ports_rejected(self):
+    def test_host_port_conflicts_parity(self):
+        """Same host port forces separate nodes; distinct ports share
+        (hostportusage.go:31-56)."""
+        host, tpu = compare(
+            lambda: [make_pod(host_ports=[8080], requests={"cpu": "1m"}) for _ in range(3)]
+        )
+        assert all(len(n.pods) == 1 for n in tpu.new_nodes if n.pods)
+        host, tpu = compare(
+            lambda: [
+                make_pod(host_ports=[8080], requests={"cpu": "1m"}),
+                make_pod(host_ports=[8081], requests={"cpu": "1m"}),
+            ]
+        )
+        assert len([n for n in tpu.new_nodes if n.pods]) == 1
+
+    def test_specific_host_ip_ports_rejected(self):
+        from karpenter_core_tpu.apis.objects import ContainerPort
+
+        pod = make_pod(requests={"cpu": "1m"})
+        pod.spec.containers[0].ports.append(
+            ContainerPort(host_port=80, host_ip="10.0.0.1")
+        )
         with pytest.raises(KernelUnsupported):
-            classify_pods([make_pod(host_ports=[80])])
+            classify_pods([pod])
 
     def test_non_self_selecting_spread_rejected(self):
         """A spread whose own pods don't count packs per-pod onto open nodes
