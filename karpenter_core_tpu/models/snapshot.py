@@ -118,6 +118,8 @@ class EncodedSnapshot:
     tmpl_ct: np.ndarray = None  # bool[T, CT]
     tmpl_it: np.ndarray = None  # bool[T, I] catalog membership ∧ it-name reqs
     tmpl_daemon: np.ndarray = None  # f32[T, R]
+    tmpl_limits: np.ndarray = None  # f32[T, R] provisioner limits minus usage (+inf none)
+    it_capacity: np.ndarray = None  # f32[I, R] (limits compare against capacity)
 
     # pod classes [C, ...]
     cls_mask: np.ndarray = None
@@ -476,6 +478,22 @@ def encode_snapshot(
     snap.tmpl_ct = np.zeros((T, CT), dtype=bool)
     snap.tmpl_it = np.zeros((T, I), dtype=bool)
     snap.tmpl_daemon = np.zeros((T, R), dtype=np.float32)
+    # provisioner limits minus current usage (scheduler.go:69-75, 244-246):
+    # the kernel's remaining-resources tracking starts here
+    snap.tmpl_limits = np.full((T, R), np.inf, dtype=np.float32)
+    prov_by_name = {p.name: p for p in provisioners}
+    snap.it_capacity = np.zeros((I, R), dtype=np.float32)
+    for i, it in enumerate(all_its):
+        for r, name in enumerate(resources):
+            snap.it_capacity[i, r] = it.capacity.get(name, 0.0)
+    for t, tmpl in enumerate(templates):
+        prov = prov_by_name.get(tmpl.provisioner_name)
+        if prov is not None and prov.spec.limits is not None:
+            for r, name in enumerate(resources):
+                if name in prov.spec.limits.resources:
+                    snap.tmpl_limits[t, r] = prov.spec.limits.resources[name] - (
+                        prov.status.resources.get(name, 0.0)
+                    )
     for t, tmpl in enumerate(templates):
         reqs = tmpl.requirements
         snap.tmpl_zone[t] = encode_value_set(

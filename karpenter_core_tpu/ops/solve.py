@@ -264,6 +264,8 @@ class Statics(NamedTuple):
     tmpl_ct: jnp.ndarray
     tmpl_it: jnp.ndarray
     tmpl_daemon: jnp.ndarray
+    tmpl_limits0: jnp.ndarray  # f32[T, R] initial remaining (limits - usage)
+    it_capacity: jnp.ndarray  # f32[I, R]
     valid: jnp.ndarray
     is_custom: jnp.ndarray
     vocab_ints: jnp.ndarray
@@ -384,9 +386,10 @@ def _phase(
     collapse_zone: bool,
     host_cap_vec: jnp.ndarray,
     fresh_host_cap: jnp.ndarray,
+    remaining: jnp.ndarray,
     extra_elig: Optional[jnp.ndarray] = None,
     max_new_nodes: Optional[int] = None,
-) -> Tuple[NodeState, jnp.ndarray, jnp.ndarray]:
+) -> Tuple[NodeState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Place up to ``quota`` pods of the class on nodes whose zone mask meets
     ``zone_restrict`` — first onto open nodes, then fresh nodes from the first
     viable template.  Returns (state, assigned[N], placed).  ``host_cap_vec``
@@ -469,11 +472,17 @@ def _phase(
     tmpl_merged = mask_ops.add(tmpl_t, cls_t, statics.valid, statics.vocab_ints)
     t_zone = statics.tmpl_zone & zone_restrict[None, :] & cls.zone[None, :]  # [T, Z]
     t_ct = statics.tmpl_ct & cls.ct[None, :]
+    # provisioner limits: drop instance types whose launch would breach the
+    # remaining budget (scheduler.go:292-309 filterByRemainingResources)
+    within_limits = jnp.all(
+        statics.it_capacity[None, :, :] <= remaining[:, None, :] + 1e-4, axis=-1
+    )  # [T, I]
     t_it_ok = (
         statics.tmpl_it
         & cls.it[None, :]
         & _it_intersects(tmpl_merged, statics)
         & _offering_ok(t_zone, t_ct, statics)
+        & within_limits
     )  # [T, I]
     t_cap_ti = _capacity(statics.tmpl_daemon, cls.requests, statics)
     t_cap_ti = jnp.where(t_it_ok, t_cap_ti, 0)
@@ -494,6 +503,20 @@ def _phase(
     n_new = jnp.where(t_ok & (rem > 0), -(-rem // per_node), 0)
     free_slots = n_slots - state.n_next
     n_new = jnp.minimum(n_new, free_slots)
+    # provisioner-limit budget: opening a node pessimistically consumes the
+    # largest surviving instance type (subtractMax), so the batch of openings
+    # is capped by floor(remaining / max_capacity) per limited resource
+    max_cap_star = jnp.max(
+        jnp.where(t_it_ok[t_star][:, None], statics.it_capacity, 0.0), axis=0
+    )  # [R]
+    rem_star = remaining[t_star]  # [R]
+    budget_per_r = jnp.where(
+        jnp.isfinite(rem_star) & (max_cap_star > 0),
+        jnp.floor((rem_star + 1e-4) / jnp.maximum(max_cap_star, 1e-9)),
+        BIG,
+    )
+    budget_nodes = jnp.maximum(jnp.min(budget_per_r), 0.0).astype(jnp.int32)
+    n_new = jnp.minimum(n_new, budget_nodes)
     if max_new_nodes is not None:
         # single-node semantics: once the class bootstrapped onto an open
         # slot, the remainder must join it — no fresh node for the overflow
@@ -524,11 +547,15 @@ def _phase(
     open_ = state.open_ | is_new
     n_next = state.n_next + n_new
 
+    # pessimistic limit tracking: each opened node may become the largest
+    # surviving instance type (scheduler.go:273-290 subtractMax)
+    remaining = remaining.at[t_star].add(-n_new.astype(jnp.float32) * max_cap_star)
+
     new_state = NodeState(
         used, kmask, kdef, kneg, kgt, klt, new_zone, new_ct, viable,
         ports_plane, pod_count, tmpl_id, open_, n_next,
     )
-    return new_state, assigned + a_new, placed_existing + placed_new
+    return new_state, assigned + a_new, placed_existing + placed_new, remaining
 
 
 def _class_step(
@@ -543,7 +570,7 @@ def _class_step(
     reference's hash-deduped TopologyGroups): forward counts gate spread skew /
     affinity targets / anti owners; inverse counts gate the pods anti owners
     repel."""
-    state, ex, topo = carry
+    state, ex, topo, remaining = carry
     cls, cls_index = cls_with_index
     m = cls.count
     n_ex = ex.pod_count.shape[0]
@@ -614,13 +641,13 @@ def _class_step(
     assigned_ex_total = jnp.zeros_like(ex.pod_count)
     placed_total = jnp.int32(0)
 
-    def run_phase(state, ex, quota, restrict, collapse, targets_ex=None, targets_new=None,
-                  single_node=False, max_new_nodes=None):
+    def run_phase(state, ex, remaining, quota, restrict, collapse, targets_ex=None,
+                  targets_new=None, single_node=False, max_new_nodes=None):
         """Wrapped in lax.cond so zero-quota phases (most of them: each class
         participates in 1-2 of the Z+4 phase kinds) cost nothing on device."""
 
         def do(operand):
-            state_i, ex_i = operand
+            state_i, ex_i, rem_i = operand
             extra_ex = ok_ex if targets_ex is None else (ok_ex & targets_ex)
             extra_new = ok_new if targets_new is None else (ok_new & targets_new)
             ex_o, a_ex, placed_ex = _phase_existing(
@@ -630,28 +657,29 @@ def _class_step(
             q_new = quota - placed_ex
             if single_node:
                 q_new = jnp.where(placed_ex > 0, 0, q_new)
-            state_o, a_new, placed_new = _phase(
+            state_o, a_new, placed_new, rem_o = _phase(
                 state_i, cls, statics, q_new, restrict, collapse,
-                host_cap_new, fresh_host_cap, extra_elig=extra_new,
+                host_cap_new, fresh_host_cap, rem_i, extra_elig=extra_new,
                 max_new_nodes=max_new_nodes,
             )
-            return state_o, ex_o, a_new, a_ex, placed_ex + placed_new
+            return state_o, ex_o, a_new, a_ex, placed_ex + placed_new, rem_o
 
         def skip(operand):
-            state_i, ex_i = operand
+            state_i, ex_i, rem_i = operand
             return (
                 state_i,
                 ex_i,
                 jnp.zeros_like(state_i.pod_count),
                 jnp.zeros_like(ex_i.pod_count),
                 jnp.int32(0),
+                rem_i,
             )
 
-        return jax.lax.cond(quota > 0, do, skip, (state, ex))
+        return jax.lax.cond(quota > 0, do, skip, (state, ex, remaining))
 
     def accumulate(results):
-        nonlocal state, ex, assigned_total, assigned_ex_total, placed_total
-        state, ex, assigned, assigned_ex, placed = results
+        nonlocal state, ex, remaining, assigned_total, assigned_ex_total, placed_total
+        state, ex, assigned, assigned_ex, placed, remaining = results
         assigned_total = assigned_total + assigned
         assigned_ex_total = assigned_ex_total + assigned_ex
         placed_total = placed_total + placed
@@ -672,7 +700,7 @@ def _class_step(
     for z in range(n_zones):
         restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
         q = jnp.where(has_zs, quotas[z], 0)
-        accumulate(run_phase(state, ex, q, restrict, True))
+        accumulate(run_phase(state, ex, remaining, q, restrict, True))
 
     # -- owned zone anti-affinity: zero-forward-count zones only --------------
     # self-members block every domain they might occupy (pessimistic late
@@ -683,7 +711,7 @@ def _class_step(
         jnp.where(member_row[g_zan], jnp.minimum(m, 1), m),
         0,
     )
-    accumulate(run_phase(state, ex, anti_quota, zero_zones, True))
+    accumulate(run_phase(state, ex, remaining, anti_quota, zero_zones, True))
 
     # -- zone affinity: nonzero-count zones (the selected pods' locations),
     # else self-members bootstrap one allowed zone (topologygroup.go:202-233).
@@ -708,7 +736,7 @@ def _class_step(
     )
     zone_aff_restrict = jnp.where(jnp.any(nonzero_zones), nonzero_zones, bootstrap_zone)
     zone_aff_quota = jnp.where(has_zaf & ~has_haf & jnp.any(zone_aff_restrict), m, 0)
-    accumulate(run_phase(state, ex, zone_aff_quota, zone_aff_restrict, True))
+    accumulate(run_phase(state, ex, remaining, zone_aff_quota, zone_aff_restrict, True))
 
     # -- hostname affinity: fill target nodes (forward count > 0) on both
     # planes; else self-members bootstrap exactly one node
@@ -721,20 +749,21 @@ def _class_step(
     q_targets = jnp.where(targets_exist, host_quota, 0)
     accumulate(
         run_phase(
-            state, ex, q_targets, host_restrict, True,
+            state, ex, remaining, q_targets, host_restrict, True,
             targets_ex=targets_ex, targets_new=targets_new, max_new_nodes=0,
         )
     )
     q_boot = jnp.where(targets_exist | ~member_row[g_haf], 0, host_quota)
     accumulate(
         run_phase(
-            state, ex, q_boot, host_restrict, True, single_node=True, max_new_nodes=1
+            state, ex, remaining, q_boot, host_restrict, True,
+            single_node=True, max_new_nodes=1,
         )
     )
 
     # -- unconstrained phase for plain classes --------------------------------
     any_quota = jnp.where(has_zs | has_zan | has_zaf | has_haf, 0, m)
-    accumulate(run_phase(state, ex, any_quota, allowed_zone, False))
+    accumulate(run_phase(state, ex, remaining, any_quota, allowed_zone, False))
 
     # -- record (topology.go:120-143): update shared counts -------------------
     # committed zone per node: singleton masks count for spread/affinity;
@@ -766,7 +795,7 @@ def _class_step(
     )
 
     failed = m - placed_total
-    return (state, ex, topo), (assigned_total, assigned_ex_total, failed)
+    return (state, ex, topo, remaining), (assigned_total, assigned_ex_total, failed)
 
 
 def solve_core(
@@ -834,8 +863,9 @@ def solve_core(
         return _class_step(statics, existing_static, n_zones, carry, cls_with_index)
 
     cls_indices = jnp.arange(n_classes, dtype=jnp.int32)
-    (final_state, final_ex, _), (assign, assign_ex, failed) = jax.lax.scan(
-        step, (state, existing_state, topo), (class_tensors, cls_indices)
+    remaining0 = statics.tmpl_limits0
+    (final_state, final_ex, _, _), (assign, assign_ex, failed) = jax.lax.scan(
+        step, (state, existing_state, topo, remaining0), (class_tensors, cls_indices)
     )
     return SolveOutputs(
         assign=assign,
@@ -963,6 +993,8 @@ def prepare(snapshot: EncodedSnapshot):
         jnp.asarray(snapshot.tmpl_ct),
         jnp.asarray(snapshot.tmpl_it),
         jnp.asarray(snapshot.tmpl_daemon),
+        jnp.asarray(snapshot.tmpl_limits),
+        jnp.asarray(snapshot.it_capacity),
         jnp.asarray(snapshot.valid),
         jnp.asarray(snapshot.is_custom),
         jnp.asarray(snapshot.vocab_ints),

@@ -483,3 +483,33 @@ class TestClassify:
         )
         cpus = [c.requests.get("cpu") for c in classes]
         assert cpus == sorted(cpus, reverse=True)
+
+
+class TestKernelLimits:
+    def test_limits_constrain_instance_choice(self):
+        """Provisioner limits filter instance types during the solve
+        (scheduler.go:292-309), not just at launch."""
+        prov = make_provisioner(limits={"cpu": 4})
+        host, tpu = compare(
+            lambda: make_pods(10, requests={"cpu": 3}),
+            provisioners=[prov],
+            instance_types=fake_cp.instance_types(8),
+        )
+        # pessimistic subtract-max exhausts the budget quickly on both paths
+        assert len(tpu.failed_pods) == len(host.failed_pods) > 0
+
+    def test_zero_limit_blocks_everything(self):
+        prov = make_provisioner(limits={"cpu": 0})
+        host, tpu = compare(
+            lambda: make_pods(2, requests={"cpu": 1}), provisioners=[prov]
+        )
+        assert len(tpu.failed_pods) == 2
+
+    def test_weighted_fallback_when_first_provisioner_limited(self):
+        limited = make_provisioner(name="limited", weight=100, limits={"cpu": 0})
+        fallback = make_provisioner(name="fallback", weight=1)
+        host, tpu = compare(
+            lambda: make_pods(2, requests={"cpu": 1}),
+            provisioners=[limited, fallback],
+        )
+        assert all(n.provisioner_name == "fallback" for n in tpu.new_nodes if n.pods)
