@@ -478,8 +478,10 @@ def encode_snapshot(
     snap.tmpl_ct = np.zeros((T, CT), dtype=bool)
     snap.tmpl_it = np.zeros((T, I), dtype=bool)
     snap.tmpl_daemon = np.zeros((T, R), dtype=np.float32)
-    # provisioner limits minus current usage (scheduler.go:69-75, 244-246):
-    # the kernel's remaining-resources tracking starts here
+    # raw provisioner limits (scheduler.go:69-75); in-solve usage is the
+    # capacity of the solve's own state nodes, subtracted in-kernel per
+    # open-mask (scheduler.go:244-246 calculateExistingMachines) so
+    # consolidation subsets release their nodes' budget per lane
     snap.tmpl_limits = np.full((T, R), np.inf, dtype=np.float32)
     prov_by_name = {p.name: p for p in provisioners}
     snap.it_capacity = np.zeros((I, R), dtype=np.float32)
@@ -491,9 +493,7 @@ def encode_snapshot(
         if prov is not None and prov.spec.limits is not None:
             for r, name in enumerate(resources):
                 if name in prov.spec.limits.resources:
-                    snap.tmpl_limits[t, r] = prov.spec.limits.resources[name] - (
-                        prov.status.resources.get(name, 0.0)
-                    )
+                    snap.tmpl_limits[t, r] = prov.spec.limits.resources[name]
     for t, tmpl in enumerate(templates):
         reqs = tmpl.requirements
         snap.tmpl_zone[t] = encode_value_set(
@@ -603,15 +603,8 @@ def encode_snapshot(
 
     # -- host ports (hostportusage.go:31-144 as a (port, proto) bitset) -------
     port_universe: Dict[tuple, None] = {}
-    def _pod_ports(pod):
-        return [
-            (p.host_port, p.protocol or "TCP")
-            for container in pod.spec.containers
-            for p in container.ports
-            if p.host_port
-        ]
     for cls in classes:
-        for key in _pod_ports(cls.pods[0]):
+        for key in pod_port_keys(cls.pods[0]):
             port_universe.setdefault(key)
     for key in extra_host_ports or []:
         port_universe.setdefault(key)
@@ -619,7 +612,17 @@ def encode_snapshot(
     port_idx = {key: i for i, key in enumerate(snap.ports)}
     snap.cls_ports = np.zeros((C, len(snap.ports)), dtype=bool)
     for c, cls in enumerate(classes):
-        for key in _pod_ports(cls.pods[0]):
+        for key in pod_port_keys(cls.pods[0]):
             snap.cls_ports[c, port_idx[key]] = True
 
     return snap
+
+
+def pod_port_keys(pod: Pod) -> List[tuple]:
+    """(host_port, protocol) pairs a pod binds (protocol defaults to TCP)."""
+    return [
+        (p.host_port, p.protocol or "TCP")
+        for container in pod.spec.containers
+        for p in container.ports
+        if p.host_port
+    ]

@@ -89,6 +89,11 @@ class ExistingStatic(NamedTuple):
     # the node open-mask applied, so consolidation subsets adjust for free
     grp_node_member: jnp.ndarray  # i32[G1, E]
     grp_node_owner: jnp.ndarray  # i32[G1, E]
+    # provisioner-limit accounting (scheduler.go:244-246): open owned nodes
+    # consume their template's budget; closed (consolidated) nodes release it
+    node_capacity: jnp.ndarray  # f32[E, R]
+    node_tmpl: jnp.ndarray  # i32[E] owning template (0 ok when not owned)
+    node_owned: jnp.ndarray  # bool[E]
 
 
 class TopoCounts(NamedTuple):
@@ -863,7 +868,15 @@ def solve_core(
         return _class_step(statics, existing_static, n_zones, carry, cls_with_index)
 
     cls_indices = jnp.arange(n_classes, dtype=jnp.int32)
-    remaining0 = statics.tmpl_limits0
+    # charge open owned nodes' capacity against their provisioner's budget
+    n_tmpl = statics.tmpl_zone.shape[0]
+    tmpl_onehot = (
+        existing_static.node_tmpl[:, None] == jnp.arange(n_tmpl)[None, :]
+    ) & (existing_static.node_owned & existing_state.open_)[:, None]  # [E, T]
+    used_budget = jnp.einsum(
+        "et,er->tr", tmpl_onehot.astype(jnp.float32), existing_static.node_capacity
+    )
+    remaining0 = statics.tmpl_limits0 - used_budget
     (final_state, final_ex, _, _), (assign, assign_ex, failed) = jax.lax.scan(
         step, (state, existing_state, topo, remaining0), (class_tensors, cls_indices)
     )
@@ -900,6 +913,9 @@ def empty_existing_static(n_res, n_classes, n_groups1: int = 1) -> ExistingStati
         tol=jnp.zeros((n_classes, 1), dtype=bool),
         grp_node_member=jnp.zeros((n_groups1, 1), dtype=jnp.int32),
         grp_node_owner=jnp.zeros((n_groups1, 1), dtype=jnp.int32),
+        node_capacity=jnp.zeros((1, n_res), dtype=jnp.float32),
+        node_tmpl=jnp.zeros(1, dtype=jnp.int32),
+        node_owned=jnp.zeros(1, dtype=bool),
     )
 
 

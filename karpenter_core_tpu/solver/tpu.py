@@ -151,13 +151,9 @@ class TPUSolver:
                         raise
                     continue
                 extra_anti.append((spec, term.label_selector))
-        extra_ports = [
-            (p.host_port, p.protocol or "TCP")
-            for pod in bound_pods or []
-            for container in pod.spec.containers
-            for p in container.ports
-            if p.host_port
-        ]
+        from karpenter_core_tpu.models.snapshot import pod_port_keys
+
+        extra_ports = [key for pod in bound_pods or [] for key in pod_port_keys(pod)]
         return encode_snapshot(
             pods, self.provisioners, self.templates, self.instance_types,
             extra_requirement_sets=extra,
@@ -211,6 +207,11 @@ class TPUSolver:
         ports = np.zeros((E, P), dtype=bool)
         grp_node_member = np.zeros((G1, E), dtype=np.int32)
         grp_node_owner = np.zeros((G1, E), dtype=np.int32)
+        node_capacity = np.zeros((E, R), dtype=np.float32)
+        node_tmpl = np.zeros(E, dtype=np.int32)
+        node_owned = np.zeros(E, dtype=bool)
+        port_idx = {key: i for i, key in enumerate(snapshot.ports)}
+        tmpl_index = {t.provisioner_name: i for i, t in enumerate(self.templates)}
 
         tmpl_by_name = {t.provisioner_name: t for t in self.templates}
         zone_idx = {z: i for i, z in enumerate(snapshot.zones)}
@@ -244,6 +245,15 @@ class TPUSolver:
                 ct[e, ct_idx[c_label]] = True
             open_[e] = True
             init[e] = state_node.initialized()
+            capacity = state_node.capacity()
+            for r, name in enumerate(snapshot.resources):
+                node_capacity[e, r] = capacity.get(name, 0.0)
+            t_idx = tmpl_index.get(
+                node.metadata.labels.get(labels_api.PROVISIONER_NAME_LABEL_KEY, "")
+            )
+            if t_idx is not None:
+                node_tmpl[e] = t_idx
+                node_owned[e] = True
             taints = Taints.of(state_node.taints())
             for c, cls in enumerate(snapshot.classes):
                 tol[c, e] = taints.tolerates(cls.pods[0]) is None
@@ -261,13 +271,12 @@ class TPUSolver:
             if e is None or pod.uid in scheduling_uids:
                 continue
             labels = pod.metadata.labels
-            port_idx = {key: i for i, key in enumerate(snapshot.ports)}
-            for container in pod.spec.containers:
-                for cp in container.ports:
-                    if cp.host_port:
-                        i = port_idx.get((cp.host_port, cp.protocol or "TCP"))
-                        if i is not None:
-                            ports[e, i] = True
+            from karpenter_core_tpu.models.snapshot import pod_port_keys as _ppk
+
+            for key in _ppk(pod):
+                i = port_idx.get(key)
+                if i is not None:
+                    ports[e, i] = True
             for g, selector in enumerate(snapshot.group_selectors):
                 if selector is not None and selector.matches(labels):
                     grp_node_member[g, e] += 1
@@ -303,6 +312,9 @@ class TPUSolver:
             tol=jnp.asarray(tol),
             grp_node_member=jnp.asarray(grp_node_member),
             grp_node_owner=jnp.asarray(grp_node_owner),
+            node_capacity=jnp.asarray(node_capacity),
+            node_tmpl=jnp.asarray(node_tmpl),
+            node_owned=jnp.asarray(node_owned),
         )
         return ex_state, ex_static
 

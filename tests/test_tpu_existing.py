@@ -294,3 +294,32 @@ class TestReviewRegressions:
         )
         assert node.name not in res.existing_assignments
         assert sum(len(n.pods) for n in res.new_nodes) == 1
+
+    def test_limits_account_existing_node_capacity(self):
+        """Kernel limit budget subtracts the solve's own state nodes
+        (scheduler.go:244-246), not the async counter status — a stale status
+        must not allow over-provisioning past the limit."""
+        env = make_environment()
+        env.kube.create(make_provisioner(limits={"cpu": 8}))
+        # an 8-cpu owned node exists; counter has NOT reconciled status
+        node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "arm-instance-type",
+                labels_api.LABEL_CAPACITY_TYPE: "spot",
+                labels_api.LABEL_NODE_INITIALIZED: "true",
+                ZONE: "test-zone-1",
+            },
+            capacity={"cpu": 8, "memory": "8Gi", "pods": 5},
+            allocatable={"cpu": 1, "memory": "8Gi", "pods": 5},
+        )
+        env.kube.create(node)
+        # pod doesn't fit the existing node (1 cpu free), and the budget is
+        # exhausted by the existing node's capacity: must fail, not launch
+        pods = [make_pod(requests={"cpu": 2})]
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        res = solver.solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        assert len(res.failed_pods) == 1
+        assert not res.new_nodes
