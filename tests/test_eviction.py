@@ -1,0 +1,81 @@
+"""Eviction queue behavior (mirror of termination/eviction.go:40-120)."""
+
+from karpenter_core_tpu.apis.objects import (
+    LabelSelector,
+    ObjectMeta,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodDisruptionBudgetStatus,
+)
+from karpenter_core_tpu.controllers.termination import EvictionQueue
+from karpenter_core_tpu.events import Recorder
+from karpenter_core_tpu.operator.kubeclient import KubeClient
+from karpenter_core_tpu.testing import make_pod
+from karpenter_core_tpu.utils.clock import FakeClock
+
+
+def queue_env():
+    clock = FakeClock()
+    kube = KubeClient(clock)
+    recorder = Recorder(clock=clock.now)
+    return kube, recorder, EvictionQueue(kube, recorder, clock)
+
+
+class TestEvictionQueue:
+    def test_evicts_and_records(self):
+        kube, recorder, queue = queue_env()
+        pod = make_pod()
+        kube.create(pod)
+        queue.add([pod])
+        assert kube.get_pod(pod.namespace, pod.name) is None
+        assert any(e.reason == "Evicted" for e in recorder.events)
+
+    def test_missing_pod_is_success(self):
+        """404 counts as evicted (eviction.go:101-103)."""
+        kube, recorder, queue = queue_env()
+        pod = make_pod()  # never created
+        queue.add([pod])
+        assert not queue._queue and not queue._set
+
+    def test_dedupe(self):
+        kube, recorder, queue = queue_env()
+        queue.synchronous = False
+        pod = make_pod()
+        kube.create(pod)
+        queue.add([pod])
+        queue.add([pod])
+        assert len(queue._queue) == 1
+
+    def test_pdb_violation_retries_with_backoff(self):
+        """A PDB-blocked eviction (the Evict API's 429) requeues with
+        exponential backoff and records the drain failure."""
+        kube, recorder, queue = queue_env()
+        kube.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="pdb", namespace="default"),
+                spec=PodDisruptionBudgetSpec(selector=LabelSelector(match_labels={"app": "x"})),
+                status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+            )
+        )
+        pod = make_pod(labels={"app": "x"})
+        kube.create(pod)
+        start = queue.clock.now()
+        queue.add([pod])  # synchronous pass: bounded retries then gives up the pass
+        assert kube.get_pod(pod.namespace, pod.name) is not None  # still blocked
+        assert (pod.namespace, pod.name) in queue._set  # remains queued
+        assert queue.clock.now() > start  # backoff sleeps consumed (fake) time
+        assert any(e.reason == "FailedDraining" for e in recorder.events)
+        # PDB lifts: the next pass succeeds
+        pdb = kube.list(PodDisruptionBudget)[0]
+        pdb.status.disruptions_allowed = 1
+        kube.update(pdb)
+        queue.drain_queue()
+        assert kube.get_pod(pod.namespace, pod.name) is None
+
+    def test_multiple_pods_one_pass(self):
+        kube, recorder, queue = queue_env()
+        pods = [make_pod() for _ in range(5)]
+        for p in pods:
+            kube.create(p)
+        queue.add(pods)
+        assert all(kube.get_pod(p.namespace, p.name) is None for p in pods)
