@@ -54,3 +54,10 @@ class TestMonteCarloMesh:
         import __graft_entry__ as graft
 
         graft.dryrun_multichip(8)
+
+    def test_dryrun_multichip_subprocess(self):
+        # The driver's process is bound to the real-TPU axon platform; the
+        # dry run must self-pin a virtual CPU mesh via re-exec (VERDICT r1 #1).
+        import __graft_entry__ as graft
+
+        graft._dryrun_multichip_subprocess(2)
