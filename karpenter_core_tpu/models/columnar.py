@@ -1,13 +1,19 @@
-"""Columnar pod-batch ingestion: the wire-format fast path.
+"""Columnar pod-batch ingestion: the steady-state fast path.
 
-At production scale the solver sidecar receives cluster snapshots over a
-binary channel (SURVEY.md §5.8), not as Python objects — pods arrive columnar:
-a requests matrix plus integer-coded constraint columns.  Classification then
-reduces to grouping identical signature rows, which runs through the native
-runtime (models.native, C++) instead of per-object Python hashing.
+At production scale the per-pod work (signature derivation, requirements
+construction) must happen once per pod *lifetime* — at watch-event time — not
+once per reconcile.  Two front-ends feed the solver without per-pod work on
+the solve path:
 
-``from_pods`` converts an object batch for benchmarking/tests; a gRPC/IPC
-front-end would construct ColumnarPodBatch directly from the wire.
+  - ``PodIngest``: the in-process incremental store.  ``add``/``remove``
+    maintain exact signature→class-slot dedup as pods arrive from the
+    informer; ``classes()`` assembles solver-ready PodClass lists in O(C).
+    This is the analog of the reference maintaining cluster state across
+    reconciles (state/cluster.go:152-196) rather than re-reading the world.
+  - ``ColumnarPodBatch``: pods as columns (requests matrix + signature rows)
+    for callers that arrive over a binary channel; classification reduces to
+    grouping identical signature rows through the native runtime
+    (models.native, C++) instead of per-object Python hashing.
 """
 
 from __future__ import annotations
@@ -66,6 +72,98 @@ class ColumnarClasses:
     n_classes: int
     counts: np.ndarray  # i64[C]
     requests: np.ndarray  # f32[C, R] per-pod request vector of each class
+
+
+class _ClassSlot:
+    """One equivalence class tracked incrementally: the derived class state is
+    built once (at first sight of the shape) and reused every reconcile."""
+
+    __slots__ = ("proto", "error", "pods")
+
+    def __init__(self, proto, error) -> None:
+        self.proto = proto  # PodClass with derived state, empty pods list
+        self.error = error  # KernelUnsupported captured at build time, if any
+        self.pods: Dict[str, Pod] = {}  # uid -> pod (insertion-ordered)
+
+
+class PodIngest:
+    """Incremental pod store: per-pod work happens once at add() time.
+
+    The informer feeds pod add/remove events as they happen; ``classes()``
+    then assembles the solver's PodClass list in O(distinct shapes) — the
+    steady-state reconcile never re-scans the pod set.  Dedup is exact (full
+    signature tuples as dict keys), so unlike hash-row grouping there is no
+    collision risk.
+
+    A shape the kernel doesn't model doesn't fail ingestion — the captured
+    KernelUnsupported is raised at classes() time, when the solve is routed,
+    so callers keep their host-path fallback semantics.
+    """
+
+    def __init__(self) -> None:
+        self._slots: Dict[tuple, _ClassSlot] = {}
+        self._by_uid: Dict[str, tuple] = {}
+
+    def __len__(self) -> int:
+        return len(self._by_uid)
+
+    def add(self, pod: Pod) -> None:
+        from karpenter_core_tpu.models.snapshot import (
+            KernelUnsupported,
+            _class_signature,
+            build_pod_class,
+        )
+
+        if pod.uid in self._by_uid:
+            self.remove(pod.uid)
+        sig = _class_signature(pod)
+        slot = self._slots.get(sig)
+        if slot is None:
+            proto, error = None, None
+            try:
+                proto = build_pod_class(pod)
+            except KernelUnsupported as e:
+                error = e
+            slot = _ClassSlot(proto, error)
+            self._slots[sig] = slot
+        slot.pods[pod.uid] = pod
+        self._by_uid[pod.uid] = sig
+
+    def add_all(self, pods: List[Pod]) -> None:
+        for pod in pods:
+            self.add(pod)
+
+    def remove(self, uid: str) -> bool:
+        sig = self._by_uid.pop(uid, None)
+        if sig is None:
+            return False
+        slot = self._slots[sig]
+        slot.pods.pop(uid, None)
+        if not slot.pods:
+            # evict emptied shapes: label churn (e.g. pod-template-hash) mints
+            # fresh signatures forever, so retired slots must not accumulate
+            del self._slots[sig]
+        return True
+
+    def pods(self) -> List[Pod]:
+        return [p for slot in self._slots.values() for p in slot.pods.values()]
+
+    def classes(self):
+        """Solver-ready PodClass list (fresh list each call; derived state
+        shared with the slot prototypes).  Raises the first captured
+        KernelUnsupported so callers route the batch to the host path."""
+        from dataclasses import replace
+
+        from karpenter_core_tpu.models.snapshot import finalize_classes
+
+        classes = []
+        for slot in self._slots.values():
+            if not slot.pods:
+                continue
+            if slot.error is not None:
+                raise slot.error
+            classes.append(replace(slot.proto, pods=list(slot.pods.values())))
+        return finalize_classes(classes)
 
 
 def classify_columnar(batch: ColumnarPodBatch) -> ColumnarClasses:

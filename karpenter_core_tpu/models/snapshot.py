@@ -246,30 +246,22 @@ class KernelUnsupported(Exception):
     back to the host solver (solver.scheduler.Scheduler)."""
 
 
-def classify_pods(pods: List[Pod]) -> List[PodClass]:
-    """Group pods into equivalence classes and derive each class's owned
-    topology groups.  Groups are shared across classes by identity (type, key,
-    selector, skew) — the reference's hash dedup — so selectors may span
-    classes (cross-group affinity, inverse anti-affinity).  Raises
-    KernelUnsupported for shapes the kernel doesn't model: host ports,
-    region/custom-key topologies, multiple same-kind constraints per pod."""
-    groups: Dict[tuple, PodClass] = {}
-    order: List[tuple] = []
-    for pod in pods:
-        sig = _class_signature(pod)
-        cls = groups.get(sig)
-        if cls is None:
-            cls = PodClass(
-                pods=[],
-                requirements=Requirements.from_pod(pod),
-                requests=resources_util.ceiling(pod),
-            )
-            _derive_topology_spec(pod, cls)
-            groups[sig] = cls
-            order.append(sig)
-        cls.pods.append(pod)
+def build_pod_class(pod: Pod) -> PodClass:
+    """Build the class-level derived state (requirements, requests, owned
+    topology groups) from one representative pod.  Raises KernelUnsupported
+    for shapes the kernel doesn't model."""
+    cls = PodClass(
+        pods=[],
+        requirements=Requirements.from_pod(pod),
+        requests=resources_util.ceiling(pod),
+    )
+    _derive_topology_spec(pod, cls)
+    return cls
 
-    classes = [groups[sig] for sig in order]
+
+def finalize_classes(classes: List[PodClass]) -> List[PodClass]:
+    """Order classes for the kernel scan and validate scan-order feasibility.
+    Mutates ``classes`` order in place and returns it."""
     # FFD: cpu desc, then memory desc (queue.go:74-110)
     classes.sort(
         key=lambda c: (
@@ -296,6 +288,26 @@ def classify_pods(pods: List[Pod]) -> List[PodClass]:
                         "cross-group affinity target scans after its follower"
                     )
     return classes
+
+
+def classify_pods(pods: List[Pod]) -> List[PodClass]:
+    """Group pods into equivalence classes and derive each class's owned
+    topology groups.  Groups are shared across classes by identity (type, key,
+    selector, skew) — the reference's hash dedup — so selectors may span
+    classes (cross-group affinity, inverse anti-affinity).  Raises
+    KernelUnsupported for shapes the kernel doesn't model: host ports,
+    region/custom-key topologies, multiple same-kind constraints per pod."""
+    groups: Dict[tuple, PodClass] = {}
+    order: List[tuple] = []
+    for pod in pods:
+        sig = _class_signature(pod)
+        cls = groups.get(sig)
+        if cls is None:
+            cls = build_pod_class(pod)
+            groups[sig] = cls
+            order.append(sig)
+        cls.pods.append(pod)
+    return finalize_classes([groups[sig] for sig in order])
 
 
 def _group_spec(gtype: int, topology_key: str, selector, skew: int) -> GroupSpec:
@@ -363,12 +375,16 @@ def encode_snapshot(
     extra_anti_groups: Optional[list] = None,
     cache_host: Optional[object] = None,
     extra_host_ports: Optional[List[tuple]] = None,
+    classes: Optional[List[PodClass]] = None,
 ) -> EncodedSnapshot:
     """Encode a solve input.  ``templates`` must be weight-ordered (the order
     is the kernel's template preference order, scheduler.go:174-219).
     ``extra_requirement_sets`` widen the vocabulary (e.g. existing-node label
-    values, which must be representable for NotIn semantics to stay exact)."""
-    classes = classify_pods(pods)
+    values, which must be representable for NotIn semantics to stay exact).
+    ``classes`` short-circuits classification when the caller maintains pod
+    classes incrementally (models.columnar.PodIngest)."""
+    if classes is None:
+        classes = classify_pods(pods)
 
     # -- axes -----------------------------------------------------------------
     all_its: List[InstanceType] = []

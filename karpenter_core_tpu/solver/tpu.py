@@ -32,35 +32,93 @@ from karpenter_core_tpu.solver.scheduler import _daemon_overhead
 from karpenter_core_tpu.utils import resources as resources_util
 
 
+class _LazyPlanes:
+    """Per-solve node planes (viable/zone/used), fetched device→host once on
+    first access.  Construction starts async copies so the transfer overlaps
+    the host-side pod-assignment decode; the big bool planes ship bit-packed
+    (the device link is a tunnel — bandwidth, not latency, is the cost)."""
+
+    __slots__ = ("_viable_p", "_zone_p", "_used_d", "_n_it", "_n_zones",
+                 "_viable", "_zone", "_used")
+
+    def __init__(self, state) -> None:
+        self._n_it = state.viable.shape[-1]
+        self._n_zones = state.zone.shape[-1]
+        self._viable_p = solve_ops.pack_bool(state.viable)
+        self._zone_p = solve_ops.pack_bool(state.zone)
+        self._used_d = state.used
+        self._viable = self._zone = self._used = None
+
+    def prefetch(self) -> None:
+        """Start async device→host copies.  Called *after* the solve's eager
+        fetch so the big planes don't queue ahead of it on the relay."""
+        for arr in (self._viable_p, self._zone_p, self._used_d):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:  # non-jax (already host) arrays
+                pass
+
+    def _fetch(self) -> None:
+        if self._viable is None:
+            viable_p, zone_p, used = jax.device_get(
+                (self._viable_p, self._zone_p, self._used_d)
+            )
+            self._viable = solve_ops.unpack_bool(viable_p, self._n_it)
+            self._zone = solve_ops.unpack_bool(zone_p, self._n_zones)
+            self._used = used
+            # release the device buffers — node decisions can outlive the
+            # solve (launch path), and holding both copies doubles memory
+            self._viable_p = self._zone_p = self._used_d = None
+
+    @property
+    def viable(self) -> np.ndarray:
+        self._fetch()
+        return self._viable
+
+    @property
+    def zone(self) -> np.ndarray:
+        self._fetch()
+        return self._zone
+
+    @property
+    def used(self) -> np.ndarray:
+        self._fetch()
+        return self._used
+
+
 class TPUNodeDecision:
     """One node the kernel decided to create.  Instance-type/zone name lists
     and the request vector materialize lazily — at 50k-pod scale eager
-    materialization of ~7k nodes × ~1k type names dominates decode time."""
+    materialization of ~7k nodes × ~1k type names dominates decode time, and
+    the underlying planes only cross the device link when first consumed
+    (launch path), off the solve critical path."""
 
-    __slots__ = ("provisioner_name", "pods", "_snapshot", "_viable", "_zone", "_used")
+    __slots__ = ("provisioner_name", "pods", "_snapshot", "_planes", "_slot")
 
-    def __init__(self, provisioner_name, snapshot, viable_row, zone_row, used_row):
+    def __init__(self, provisioner_name, snapshot, planes, slot):
         self.provisioner_name = provisioner_name
         self.pods: List[Pod] = []
         self._snapshot = snapshot
-        self._viable = viable_row
-        self._zone = zone_row
-        self._used = used_row
+        self._planes = planes
+        self._slot = slot
 
     @property
     def instance_type_names(self) -> List[str]:
-        return [self._snapshot.it_names[i] for i in np.nonzero(self._viable)[0]]
+        row = self._planes.viable[self._slot]
+        return [self._snapshot.it_names[i] for i in np.nonzero(row)[0]]
 
     @property
     def zones(self) -> List[str]:
-        return [self._snapshot.zones[z] for z in np.nonzero(self._zone)[0]]
+        row = self._planes.zone[self._slot]
+        return [self._snapshot.zones[z] for z in np.nonzero(row)[0]]
 
     @property
     def requests(self) -> resources_util.ResourceList:
+        row = self._planes.used[self._slot]
         return {
-            name: float(self._used[r])
+            name: float(row[r])
             for r, name in enumerate(self._snapshot.resources)
-            if self._used[r] > 0
+            if row[r] > 0
         }
 
 
@@ -116,14 +174,50 @@ class TPUSolver:
 
     def encode(
         self,
-        pods: List[Pod],
+        pods,
         state_nodes: Optional[list] = None,
         bound_pods: Optional[List[Pod]] = None,
     ) -> EncodedSnapshot:
         """Raises models.snapshot.KernelUnsupported when the batch needs the
         host path.  Existing-node label values widen the vocabulary so NotIn
         checks against them stay exact; bound pods' anti-affinity terms
-        register as groups so their inverse blocking reaches the kernel."""
+        register as groups so their inverse blocking reaches the kernel.
+
+        ``pods`` is a pod list or a models.columnar.PodIngest; with an ingest
+        the per-pod classification cost was already paid at watch-event time
+        and encode runs in O(distinct classes)."""
+        from karpenter_core_tpu.models.columnar import PodIngest
+
+        classes = None
+        if isinstance(pods, PodIngest):
+            classes = pods.classes()
+            # class representatives cover every distinct label set, which is
+            # all the anti-affinity relevance check below needs
+            pods = [cls.pods[0] for cls in classes]
+        return self._encode_with_classes(pods, classes, state_nodes, bound_pods)
+
+    def encode_classes(
+        self,
+        classes: list,
+        state_nodes: Optional[list] = None,
+        bound_pods: Optional[List[Pod]] = None,
+    ) -> EncodedSnapshot:
+        """Encode from prebuilt PodClass objects (the class-columnar wire path:
+        the channel ships one representative pod + count per distinct shape).
+        Orders/validates the classes in place (models.snapshot.finalize_classes)."""
+        from karpenter_core_tpu.models.snapshot import finalize_classes
+
+        classes = finalize_classes(list(classes))
+        reps = [cls.pods[0] for cls in classes]
+        return self._encode_with_classes(reps, classes, state_nodes, bound_pods)
+
+    def _encode_with_classes(
+        self,
+        pods: List[Pod],
+        classes: Optional[list],
+        state_nodes: Optional[list],
+        bound_pods: Optional[List[Pod]],
+    ) -> EncodedSnapshot:
         from karpenter_core_tpu.models.snapshot import (
             GRP_ANTI,
             UNLIMITED,
@@ -160,6 +254,7 @@ class TPUSolver:
             extra_anti_groups=extra_anti,
             cache_host=self,
             extra_host_ports=extra_ports,
+            classes=classes,
         )
 
     def encode_existing(
@@ -320,12 +415,21 @@ class TPUSolver:
 
     def solve(
         self,
-        pods: List[Pod],
+        pods,
         state_nodes: Optional[list] = None,
         bound_pods: Optional[List[Pod]] = None,
         n_slots: int = 0,
     ) -> TPUSolveResults:
         snapshot = self.encode(pods, state_nodes, bound_pods)
+        return self.solve_encoded(snapshot, state_nodes, bound_pods, n_slots)
+
+    def solve_encoded(
+        self,
+        snapshot: EncodedSnapshot,
+        state_nodes: Optional[list] = None,
+        bound_pods: Optional[List[Pod]] = None,
+        n_slots: int = 0,
+    ) -> TPUSolveResults:
         ex_state = ex_static = None
         if state_nodes:
             ex_state, ex_static = self.encode_existing(snapshot, state_nodes, bound_pods)
@@ -335,10 +439,13 @@ class TPUSolver:
         outputs = solve_ops._solve_jit(
             cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static
         )
-        # slot exhaustion: retry once with double capacity
-        n_used = int(outputs.state.n_next)
+        # slot exhaustion: retry once with double capacity.  One batched fetch
+        # (the relay costs ~67 ms per round trip); both arrays are cached on
+        # the jax array objects, so decode's batched fetch doesn't re-ship them.
+        n_next_h, failed_h = jax.device_get((outputs.state.n_next, outputs.failed))
+        n_used = int(n_next_h)
         slots = outputs.assign.shape[1]
-        if int(np.sum(np.asarray(outputs.failed))) > 0 and n_used >= slots:
+        if int(np.sum(failed_h)) > 0 and n_used >= slots:
             outputs = solve_ops._solve_jit(
                 cls, statics_arrays, slots * 2, key_has_bounds, ex_state, ex_static
             )
@@ -350,33 +457,38 @@ class TPUSolver:
         outputs: solve_ops.SolveOutputs,
         state_nodes: Optional[list] = None,
     ) -> TPUSolveResults:
-        assign = np.asarray(outputs.assign)  # [C, N]
-        assign_ex = np.asarray(outputs.assign_existing)  # [C, E]
-        failed = np.asarray(outputs.failed)  # [C]
         state = outputs.state
-        n_it = state.viable.shape[-1]
-        n_zones = state.zone.shape[-1]
-        # big bool planes ship bit-packed (the device link is a tunnel)
-        viable_p, zone_p, pod_count, tmpl_id, used, open_ = jax.device_get(
-            (
-                solve_ops.pack_bool(state.viable),
-                solve_ops.pack_bool(state.zone),
-                state.pod_count,
-                state.tmpl_id,
-                state.used,
-                state.open_,
-            )
+        # start every device→host copy up front so transfers overlap the
+        # host-side expansion work below; planes stay lazy until consumed.
+        # The device link is a high-latency relay (~67 ms per round trip on
+        # the axon tunnel), so everything eager ships in ONE batched fetch —
+        # including the n_next scalar, which as a bare int() would cost a
+        # full round trip of its own.
+        planes = _LazyPlanes(state)
+        small = (
+            outputs.assign,
+            outputs.assign_existing,
+            outputs.failed,
+            state.pod_count,
+            state.tmpl_id,
+            state.open_,
+            state.n_next,
         )
-        viable = solve_ops.unpack_bool(viable_p, n_it)
-        zone = solve_ops.unpack_bool(zone_p, n_zones)
+        for arr in small:
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+        assign, assign_ex, failed, pod_count, tmpl_id, open_, n_next = jax.device_get(small)
+        planes.prefetch()  # big planes ride the link while the host expands pods
 
-        results = TPUSolveResults(n_slots_used=int(state.n_next))
+        results = TPUSolveResults(n_slots_used=int(n_next))
         nodes: Dict[int, TPUNodeDecision] = {}
         provisioner_names = [t.provisioner_name for t in self.templates]
         for n in np.nonzero(open_ & (pod_count > 0))[0]:
             n = int(n)
             nodes[n] = TPUNodeDecision(
-                provisioner_names[int(tmpl_id[n])], snapshot, viable[n], zone[n], used[n]
+                provisioner_names[int(tmpl_id[n])], snapshot, planes, n
             )
 
         state_nodes = state_nodes or []

@@ -62,3 +62,126 @@ class TestColumnarPath:
         assert columnar.n_classes == 1
         cpu_idx = batch.resource_names.index("cpu")
         assert abs(columnar.requests[0, cpu_idx] - 2.0) < 1e-6
+
+
+class TestPodIngest:
+    def _mix(self):
+        from karpenter_core_tpu.apis import labels as labels_api
+        from karpenter_core_tpu.apis.objects import LabelSelector, TopologySpreadConstraint
+
+        pods = make_pods(20, requests={"cpu": "500m"}) + make_pods(10, requests={"cpu": 2})
+        pods += [
+            make_pod(
+                labels={"app": "s"},
+                requests={"cpu": "250m"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                        label_selector=LabelSelector(match_labels={"app": "s"}),
+                    )
+                ],
+            )
+            for _ in range(6)
+        ]
+        return pods
+
+    def test_classes_match_classify_pods(self):
+        from karpenter_core_tpu.models.columnar import PodIngest
+
+        pods = self._mix()
+        ingest = PodIngest()
+        ingest.add_all(pods)
+        incremental = ingest.classes()
+        direct = classify_pods(pods)
+        assert [c.count for c in incremental] == [c.count for c in direct]
+        assert [c.requests for c in incremental] == [c.requests for c in direct]
+        assert [c.owned_groups() for c in incremental] == [c.owned_groups() for c in direct]
+
+    def test_remove_and_readd(self):
+        from karpenter_core_tpu.models.columnar import PodIngest
+
+        pods = self._mix()
+        ingest = PodIngest()
+        ingest.add_all(pods)
+        assert len(ingest) == len(pods)
+        assert ingest.remove(pods[0].uid)
+        assert not ingest.remove(pods[0].uid)  # idempotent
+        assert len(ingest) == len(pods) - 1
+        ingest.add(pods[0])
+        assert len(ingest) == len(pods)
+        # double-add replaces, not duplicates
+        ingest.add(pods[0])
+        assert len(ingest) == len(pods)
+        assert sum(c.count for c in ingest.classes()) == len(pods)
+
+    def test_empty_class_slots_drop_out(self):
+        from karpenter_core_tpu.models.columnar import PodIngest
+
+        big = make_pods(3, requests={"cpu": 4})
+        small = make_pods(2, requests={"cpu": "100m"})
+        ingest = PodIngest()
+        ingest.add_all(big + small)
+        for pod in big:
+            ingest.remove(pod.uid)
+        classes = ingest.classes()
+        assert len(classes) == 1
+        assert classes[0].count == 2
+        # emptied slots are evicted, not retained (label churn would otherwise
+        # grow the slot table without bound in a long-running process)
+        assert len(ingest._slots) == 1
+
+    def test_unsupported_shape_raises_at_classes_time(self):
+        import pytest
+
+        from karpenter_core_tpu.apis import labels as labels_api
+        from karpenter_core_tpu.apis.objects import LabelSelector, TopologySpreadConstraint
+        from karpenter_core_tpu.models.columnar import PodIngest
+        from karpenter_core_tpu.models.snapshot import KernelUnsupported
+
+        ingest = PodIngest()
+        # non-self-selecting spread: ingestion succeeds, routing raises
+        bad = make_pod(
+            labels={"app": "other"},
+            topology_spread=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                    label_selector=LabelSelector(match_labels={"app": "s"}),
+                )
+            ],
+        )
+        ingest.add(bad)
+        with pytest.raises(KernelUnsupported):
+            ingest.classes()
+        # removing the offending pod clears the route-blocker
+        ingest.remove(bad.uid)
+        assert ingest.classes() == []
+
+    def test_solver_accepts_ingest(self):
+        from karpenter_core_tpu.cloudprovider import fake as fake_cp
+        from karpenter_core_tpu.models.columnar import PodIngest
+        from karpenter_core_tpu.ops import solve as solve_ops
+        from karpenter_core_tpu.solver.tpu import TPUSolver
+        from karpenter_core_tpu.testing import make_provisioner
+
+        pods = self._mix()
+        provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(10))
+        solver = TPUSolver(provider, [make_provisioner()])
+        ingest = PodIngest()
+        ingest.add_all(pods)
+        snap_inc = solver.encode(ingest)
+        snap_direct = solver.encode(pods)
+        out_inc = solve_ops.solve(snap_inc)
+        out_direct = solve_ops.solve(snap_direct)
+        res_inc = solver.decode(snap_inc, out_inc)
+        res_direct = solver.decode(snap_direct, out_direct)
+        assert len(res_inc.new_nodes) == len(res_direct.new_nodes)
+        assert sum(len(n.pods) for n in res_inc.new_nodes) == sum(
+            len(n.pods) for n in res_direct.new_nodes
+        )
+        assert len(res_inc.failed_pods) == len(res_direct.failed_pods)
+        # lazy planes materialize correctly
+        node = res_inc.new_nodes[0]
+        assert node.instance_type_names
+        assert node.requests

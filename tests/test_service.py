@@ -116,6 +116,62 @@ class TestSnapshotChannel:
         assert sum(len(v) for v in assigned.values()) == 2
         assert not response["newNodes"]
 
+    def test_solve_classes_matches_solve(self, channel):
+        pods = (
+            make_pods(8, requests={"cpu": "900m"})
+            + make_pods(4, requests={"cpu": 2, "memory": "2Gi"})
+            + [
+                make_pod(
+                    labels={"app": "s"},
+                    requests={"cpu": "250m"},
+                    topology_spread=[
+                        TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                            label_selector=LabelSelector(match_labels={"app": "s"}),
+                        )
+                    ],
+                )
+                for _ in range(6)
+            ]
+        )
+        full = channel.solve(pods, [make_provisioner()])
+        columnar = channel.solve_classes(pods, [make_provisioner()])
+        assert sum(len(n["podIndices"]) for n in columnar["newNodes"]) == sum(
+            len(n["podIndices"]) for n in full["newNodes"]
+        )
+        assert len(columnar["newNodes"]) == len(full["newNodes"])
+        assert columnar["failedPodIndices"] == []
+        # every pod index appears exactly once across nodes
+        seen = sorted(
+            i for n in columnar["newNodes"] for i in n["podIndices"]
+        ) + sorted(columnar["failedPodIndices"])
+        assert sorted(seen) == list(range(len(pods)))
+        for node in columnar["newNodes"]:
+            assert node["instanceTypes"]
+            assert node["provisioner"] == "default"
+
+    def test_solve_classes_existing_nodes(self, channel):
+        node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+                labels_api.LABEL_CAPACITY_TYPE: "spot",
+                labels_api.LABEL_NODE_INITIALIZED: "true",
+                labels_api.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            },
+            allocatable={"cpu": 4, "memory": "4Gi", "pods": 10},
+        )
+        pods = make_pods(2, requests={"cpu": 1})
+        response = channel.solve_classes(
+            pods,
+            [make_provisioner()],
+            nodes=[{"node": codec.node_to_dict(node), "pods": []}],
+        )
+        assigned = response["existingAssignments"]
+        assert sum(len(v) for v in assigned.values()) == 2
+        assert not response["newNodes"]
+
     def test_unsupported_batch_rejected(self, channel):
         import grpc
 
