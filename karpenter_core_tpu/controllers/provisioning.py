@@ -323,7 +323,9 @@ class ProvisioningController:
             raise NoProvisionersError("no provisioners found")
         try:
             solver = TPUSolver(
-                self.cloud_provider, provisioners, daemonset_pods=self.get_daemonset_pods()
+                self.cloud_provider, provisioners,
+                daemonset_pods=self.get_daemonset_pods(),
+                kube_client=self.kube_client,
             )
             tpu_results = solver.solve(
                 pods,

@@ -151,6 +151,13 @@ class EncodedSnapshot:
     is_custom: np.ndarray = None  # bool[K]
     vocab_ints: np.ndarray = None  # f32[K, V]
 
+    # per-class resolved volumes (volumeusage.go:33-236 resolution, filled by
+    # TPUSolver when a kube client is available).  Each entry:
+    #   {"shared": {driver: {pvc ids}}, "per_pod": {driver: count}}
+    # shared = every pod mounts the same set (count-independent per node);
+    # per_pod = each pod its own disjoint claims (count-dependent per node)
+    class_volumes: list = None
+
 
 def _class_signature(pod: Pod) -> tuple:
     """Equivalence key computed from the raw spec — cheap enough to run per pod
@@ -220,7 +227,17 @@ def _class_signature(pod: Pod) -> tuple:
             if p.host_port
         )
     )
-    return (req_sig, req_vec, tol_sig, spread_sig, affinity_sig, labels_sig, ports_sig)
+    # claim COUNT (not identity) keeps one-PVC-per-pod StatefulSets in a
+    # single class; volume resolution (solver.tpu._resolve_class_volumes)
+    # distinguishes shared vs per-pod claim sets per class.  Namespace scopes
+    # PVC ids, so it joins the signature only when claims exist.
+    claims = {
+        v.persistent_volume_claim.claim_name
+        for v in pod.spec.volumes
+        if v.persistent_volume_claim is not None
+    }
+    vol_sig = (pod.namespace or "", len(claims)) if claims else ()
+    return (req_sig, req_vec, tol_sig, spread_sig, affinity_sig, labels_sig, ports_sig, vol_sig)
 
 
 def _selector_sig(selector) -> tuple:

@@ -74,6 +74,7 @@ class ExistingState(NamedTuple):
     zone: jnp.ndarray  # bool[E, Z]
     ct: jnp.ndarray  # bool[E, CT]
     ports: jnp.ndarray  # bool[E, P] bound (port, proto) pairs
+    vol_used: jnp.ndarray  # i32[E, D] distinct PVCs mounted per CSI driver
     pod_count: jnp.ndarray  # i32[E] pods added THIS solve
     open_: jnp.ndarray  # bool[E]
 
@@ -94,6 +95,13 @@ class ExistingStatic(NamedTuple):
     node_capacity: jnp.ndarray  # f32[E, R]
     node_tmpl: jnp.ndarray  # i32[E] owning template (0 ok when not owned)
     node_owned: jnp.ndarray  # bool[E]
+    # volume attach limits (volumeusage.go / existingnode.go:77-130): only
+    # existing nodes carry limits — new nodes have no CSINode yet.  Within a
+    # class all pods mount the same PVC set, so the per-node increment is
+    # count-independent; cross-class PVC sharing routes to the host path
+    vol_limit: jnp.ndarray  # i32[E, D] per-driver attach limit (UNLIMITED none)
+    cls_vol_add: jnp.ndarray  # i32[C, E, D] distinct new PVCs class c adds to e
+    cls_vol_per_pod: jnp.ndarray  # i32[C, D] per-pod claims (disjoint sets mode)
 
 
 class TopoCounts(NamedTuple):
@@ -308,6 +316,8 @@ def _phase_existing(
     collapse_zone: bool,
     host_cap_vec: jnp.ndarray,
     tol_row: jnp.ndarray,
+    vol_add_row: jnp.ndarray,
+    vol_per_pod_row: jnp.ndarray,
     extra_elig: Optional[jnp.ndarray] = None,
     single_node: bool = False,
 ) -> Tuple[ExistingState, jnp.ndarray, jnp.ndarray]:
@@ -348,8 +358,22 @@ def _phase_existing(
     # (hostportusage.go:31-56)
     has_ports = jnp.any(cls.ports)
     port_conflict = jnp.any(ex.ports & cls.ports[None, :], axis=-1)
+    # volume attach limits.  Shared-set classes add a fixed count on first
+    # placement (count-independent); per-pod classes add per assigned pod
+    # (disjoint claim sets), capping the node's intake like a resource
+    vol_free = ex_static.vol_limit - ex.vol_used - vol_add_row  # [E, D]
+    vol_ok = jnp.all(vol_free >= vol_per_pod_row[None, :], axis=-1)
+    cap_vol = jnp.min(
+        jnp.where(
+            vol_per_pod_row[None, :] > 0,
+            vol_free // jnp.maximum(vol_per_pod_row[None, :], 1),
+            UNLIMITED,
+        ),
+        axis=-1,
+    ).astype(jnp.int32)
+    cap = jnp.minimum(cap, jnp.maximum(cap_vol, 0))
     elig = ex.open_ & key_ok & tol_row & jnp.any(zone_ok, axis=-1) & jnp.any(ct_ok, axis=-1)
-    elig = elig & ~port_conflict
+    elig = elig & ~port_conflict & vol_ok
     if extra_elig is not None:
         elig = elig & extra_elig
     cap = jnp.minimum(cap, jnp.where(has_ports, 1, UNLIMITED))
@@ -376,6 +400,11 @@ def _phase_existing(
         ),
         ct=jnp.where(sel, ct_ok, ex.ct),
         ports=jnp.where(sel, ex.ports | cls.ports[None, :], ex.ports),
+        vol_used=jnp.where(
+            sel,
+            ex.vol_used + vol_add_row + assigned[:, None] * vol_per_pod_row[None, :],
+            ex.vol_used,
+        ),
         pod_count=ex.pod_count + assigned,
         open_=ex.open_,
     )
@@ -586,6 +615,8 @@ def _class_step(
     g_zs, g_hs, g_zaf, g_haf, g_zan, g_han = (cls.groups[i] for i in range(6))
     member_row = statics.grp_member[cls_index]  # [G1]
     tol_row = ex_static.tol[cls_index]  # [E]
+    vol_add_row = ex_static.cls_vol_add[cls_index]  # [E, D]
+    vol_per_pod_row = ex_static.cls_vol_per_pod[cls_index]  # [D]
 
     def own_onehot(g):
         return (jnp.arange(g1) == g) & (g < g_dummy)
@@ -657,7 +688,8 @@ def _class_step(
             extra_new = ok_new if targets_new is None else (ok_new & targets_new)
             ex_o, a_ex, placed_ex = _phase_existing(
                 ex_i, ex_static, cls, statics, quota, restrict, collapse,
-                host_cap_ex, tol_row, extra_elig=extra_ex, single_node=single_node,
+                host_cap_ex, tol_row, vol_add_row, vol_per_pod_row,
+                extra_elig=extra_ex, single_node=single_node,
             )
             q_new = quota - placed_ex
             if single_node:
@@ -889,7 +921,9 @@ def solve_core(
     )
 
 
-def empty_existing_state(n_res, n_keys, width, n_zones, n_ct, n_ports: int = 1) -> ExistingState:
+def empty_existing_state(
+    n_res, n_keys, width, n_zones, n_ct, n_ports: int = 1, n_drivers: int = 1
+) -> ExistingState:
     """A single closed dummy slot (E=0 shapes upset some XLA reductions)."""
     return ExistingState(
         used=jnp.zeros((1, n_res), dtype=jnp.float32),
@@ -901,12 +935,15 @@ def empty_existing_state(n_res, n_keys, width, n_zones, n_ct, n_ports: int = 1) 
         zone=jnp.ones((1, n_zones), dtype=bool),
         ct=jnp.ones((1, n_ct), dtype=bool),
         ports=jnp.zeros((1, n_ports), dtype=bool),
+        vol_used=jnp.zeros((1, n_drivers), dtype=jnp.int32),
         pod_count=jnp.zeros(1, dtype=jnp.int32),
         open_=jnp.zeros(1, dtype=bool),
     )
 
 
-def empty_existing_static(n_res, n_classes, n_groups1: int = 1) -> ExistingStatic:
+def empty_existing_static(
+    n_res, n_classes, n_groups1: int = 1, n_drivers: int = 1
+) -> ExistingStatic:
     return ExistingStatic(
         alloc=jnp.zeros((1, n_res), dtype=jnp.float32),
         init=jnp.zeros(1, dtype=bool),
@@ -916,6 +953,9 @@ def empty_existing_static(n_res, n_classes, n_groups1: int = 1) -> ExistingStati
         node_capacity=jnp.zeros((1, n_res), dtype=jnp.float32),
         node_tmpl=jnp.zeros(1, dtype=jnp.int32),
         node_owned=jnp.zeros(1, dtype=bool),
+        vol_limit=jnp.full((1, n_drivers), UNLIMITED, dtype=jnp.int32),
+        cls_vol_add=jnp.zeros((n_classes, 1, n_drivers), dtype=jnp.int32),
+        cls_vol_per_pod=jnp.zeros((n_classes, n_drivers), dtype=jnp.int32),
     )
 
 

@@ -62,6 +62,27 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
     def _health(self, request: bytes, context) -> bytes:
         return msgpack.packb({"status": "ok"})
 
+    @staticmethod
+    def _decode_common(req):
+        """(provisioners, daemonset_pods, state_nodes, bound_pods) from the
+        request envelope shared by /Solve and /SolveClasses."""
+        provisioners = [
+            codec.provisioner_from_dict(p) for p in req.get("provisioners", [])
+        ]
+        daemonset_pods = [
+            codec.pod_from_dict(p) for p in req.get("daemonsetPods", [])
+        ]
+        state_nodes = []
+        bound = []
+        for n in req.get("nodes", []):
+            state_node = StateNode(codec.node_from_dict(n["node"]))
+            for p in n.get("pods", []):
+                pod = codec.pod_from_dict(p)
+                state_node.update_for_pod(pod)
+                bound.append(pod)
+            state_nodes.append(state_node)
+        return provisioners, daemonset_pods, state_nodes, bound
+
     def _solve_classes(self, request: bytes, context) -> bytes:
         from karpenter_core_tpu.models.snapshot import build_pod_class
 
@@ -75,21 +96,7 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 cls.pods = [rep] * int(entry["count"])
                 classes.append(cls)
             req_idx = {id(rep): i for i, rep in enumerate(reps)}
-            provisioners = [
-                codec.provisioner_from_dict(p) for p in req.get("provisioners", [])
-            ]
-            daemonset_pods = [
-                codec.pod_from_dict(p) for p in req.get("daemonsetPods", [])
-            ]
-            state_nodes = []
-            for n in req.get("nodes", []):
-                state_node = StateNode(codec.node_from_dict(n["node"]))
-                for p in n.get("pods", []):
-                    state_node.update_for_pod(codec.pod_from_dict(p))
-                state_nodes.append(state_node)
-            bound = [
-                codec.pod_from_dict(p) for n in req.get("nodes", []) for p in n.get("pods", [])
-            ]
+            provisioners, daemonset_pods, state_nodes, bound = self._decode_common(req)
 
             solver = TPUSolver(self.cloud_provider, provisioners, daemonset_pods)
             snapshot = solver.encode_classes(
@@ -132,21 +139,7 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
         try:
             req = msgpack.unpackb(request)
             pods = [codec.pod_from_dict(p) for p in req.get("pods", [])]
-            provisioners = [
-                codec.provisioner_from_dict(p) for p in req.get("provisioners", [])
-            ]
-            daemonset_pods = [
-                codec.pod_from_dict(p) for p in req.get("daemonsetPods", [])
-            ]
-            state_nodes = []
-            for n in req.get("nodes", []):
-                state_node = StateNode(codec.node_from_dict(n["node"]))
-                for p in n.get("pods", []):
-                    state_node.update_for_pod(codec.pod_from_dict(p))
-                state_nodes.append(state_node)
-            bound = [
-                codec.pod_from_dict(p) for n in req.get("nodes", []) for p in n.get("pods", [])
-            ]
+            provisioners, daemonset_pods, state_nodes, bound = self._decode_common(req)
 
             solver = TPUSolver(self.cloud_provider, provisioners, daemonset_pods)
             results = solver.solve(pods, state_nodes=state_nodes or None, bound_pods=bound)

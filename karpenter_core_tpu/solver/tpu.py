@@ -157,7 +157,12 @@ class TPUSolver:
         cloud_provider: CloudProvider,
         provisioners: List[Provisioner],
         daemonset_pods: Optional[List[Pod]] = None,
+        kube_client=None,
     ) -> None:
+        # kube_client resolves PVC -> CSI driver for volume attach-limit
+        # planes (volumeusage.go:65-90); None matches the host oracle's
+        # behavior of treating unresolvable volumes as unconstrained
+        self.kube_client = kube_client
         self.provisioners = order_by_weight(
             [p for p in provisioners if p.metadata.deletion_timestamp is None]
         )
@@ -248,7 +253,7 @@ class TPUSolver:
         from karpenter_core_tpu.models.snapshot import pod_port_keys
 
         extra_ports = [key for pod in bound_pods or [] for key in pod_port_keys(pod)]
-        return encode_snapshot(
+        snapshot = encode_snapshot(
             pods, self.provisioners, self.templates, self.instance_types,
             extra_requirement_sets=extra,
             extra_anti_groups=extra_anti,
@@ -256,6 +261,110 @@ class TPUSolver:
             extra_host_ports=extra_ports,
             classes=classes,
         )
+        snapshot.class_volumes = self._resolve_class_volumes(
+            snapshot.classes, state_nodes
+        )
+        return snapshot
+
+    def _resolve_class_volumes(self, classes, state_nodes) -> list:
+        """Per-class volume profile for the kernel's attach-limit planes
+        (volumeusage.go:65-90 resolution).  Each entry:
+
+          {"shared": {driver: {pvc ids}}, "per_pod": {driver: count}}
+
+        Only drivers with a finite limit on some state node can ever bind
+        (new nodes have no CSINode), so claims on unlimited drivers are
+        dropped up front — sharing through them is harmless.  For the rest a
+        class must be either SHARED (every member mounts the same claim set —
+        the per-node contribution is count-independent) or PERPOD (members
+        mount pairwise-disjoint sets with equal per-driver counts, nothing
+        overlapping other classes or already-mounted sets — the contribution
+        is count-dependent).  Anything else routes to the host path, as do
+        unresolvable references (the host path surfaces the per-pod error)."""
+        from karpenter_core_tpu.scheduling import VolumeUsage
+
+        empty = [{"shared": {}, "per_pod": {}} for _ in classes]
+        if self.kube_client is None:
+            return empty
+        limited = {
+            driver
+            for state_node in state_nodes or []
+            for driver in state_node.volume_limits()
+        }
+        has_claims = any(
+            v.persistent_volume_claim is not None
+            for cls in classes
+            for v in cls.pods[0].spec.volumes
+        )
+        if not limited or not has_claims:
+            return empty
+
+        mounted_ids = {
+            pvc_id
+            for state_node in state_nodes or []
+            for driver, ids in state_node.volume_usage().volumes.items()
+            if driver in limited
+            for pvc_id in ids
+        }
+        usage = VolumeUsage(self.kube_client)
+        resolve_cache: Dict[tuple, dict] = {}  # claim names -> limited-driver sets
+
+        def resolve(pod) -> dict:
+            key = (
+                pod.namespace or "",
+                tuple(
+                    sorted(
+                        v.persistent_volume_claim.claim_name
+                        for v in pod.spec.volumes
+                        if v.persistent_volume_claim is not None
+                    )
+                ),
+            )
+            hit = resolve_cache.get(key)
+            if hit is None:
+                volumes, err = usage._validate(pod)
+                if err is not None:
+                    raise KernelUnsupported(f"volume resolution: {err}")
+                hit = {d: ids for d, ids in volumes.items() if d in limited}
+                resolve_cache[key] = hit
+            return hit
+
+        class_volumes = []
+        seen: Dict[str, int] = {}  # pvc id -> class index
+        for c, cls in enumerate(classes):
+            member_sets = [resolve(pod) for pod in cls.pods]
+            first = member_sets[0]
+            for ids in first.values():
+                for pvc_id in ids:
+                    if seen.setdefault(pvc_id, c) != c:
+                        raise KernelUnsupported(
+                            f"pvc {pvc_id} shared across pod classes not kernel-supported"
+                        )
+            if all(m == first for m in member_sets):
+                class_volumes.append({"shared": first, "per_pod": {}})
+                continue
+            # PERPOD: pairwise-disjoint member sets, uniform count vector,
+            # nothing shared with other classes or already mounted
+            counts = {d: len(ids) for d, ids in first.items()}
+            all_ids: set = set()
+            for m in member_sets:
+                if {d: len(ids) for d, ids in m.items()} != counts:
+                    raise KernelUnsupported(
+                        "mixed volume shapes within a pod class not kernel-supported"
+                    )
+                for ids in m.values():
+                    for pvc_id in ids:
+                        if pvc_id in all_ids or pvc_id in mounted_ids:
+                            raise KernelUnsupported(
+                                f"pvc {pvc_id} shared across pods not kernel-supported"
+                            )
+                        if seen.setdefault(pvc_id, c) != c:
+                            raise KernelUnsupported(
+                                f"pvc {pvc_id} shared across pod classes not kernel-supported"
+                            )
+                        all_ids.add(pvc_id)
+            class_volumes.append({"shared": {}, "per_pod": counts})
+        return class_volumes
 
     def encode_existing(
         self,
@@ -388,6 +497,47 @@ class TPUSolver:
                     if g is not None:
                         grp_node_owner[g, e] += 1
 
+        # -- volume attach-limit planes (volumeusage.go:33-236 as per-driver
+        # counters; existingnode.go:77-130 enforcement).  Only existing nodes
+        # carry limits (CSINode); the axis covers drivers mounted by a
+        # scheduling class plus drivers already over their limit (which block
+        # every add, volume-less pods included — VolumeCount.exceeds).
+        from karpenter_core_tpu.models.snapshot import UNLIMITED
+
+        class_volumes = snapshot.class_volumes or [
+            {"shared": {}, "per_pod": {}} for _ in snapshot.classes
+        ]
+        drivers = sorted(
+            {d for vols in class_volumes for d in vols["shared"]}
+            | {d for vols in class_volumes for d in vols["per_pod"]}
+        )
+        for state_node in state_nodes:
+            limits = state_node.volume_limits()
+            mounted = state_node.volume_usage().volumes
+            for d, lim in limits.items():
+                if d not in drivers and len(mounted.get(d, ())) > lim:
+                    drivers.append(d)
+        D = max(len(drivers), 1)
+        vol_used = np.zeros((E, D), dtype=np.int32)
+        vol_limit = np.full((E, D), UNLIMITED, dtype=np.int32)
+        cls_vol_add = np.zeros((C, E, D), dtype=np.int32)
+        cls_vol_per_pod = np.zeros((C, D), dtype=np.int32)
+        for i, d in enumerate(drivers):
+            for c, vols in enumerate(class_volumes):
+                cls_vol_per_pod[c, i] = vols["per_pod"].get(d, 0)
+        for e, state_node in enumerate(state_nodes):
+            mounted = state_node.volume_usage().volumes
+            limits = state_node.volume_limits()
+            for i, d in enumerate(drivers):
+                have = mounted.get(d, set())
+                vol_used[e, i] = len(have)
+                if d in limits:
+                    vol_limit[e, i] = limits[d]
+                for c, vols in enumerate(class_volumes):
+                    new = vols["shared"].get(d)
+                    if new:
+                        cls_vol_add[c, e, i] = len(new - have)
+
         ex_state = solve_ops.ExistingState(
             used=jnp.asarray(used),
             kmask=jnp.asarray(kmask),
@@ -398,6 +548,7 @@ class TPUSolver:
             zone=jnp.asarray(zone),
             ct=jnp.asarray(ct),
             ports=jnp.asarray(ports),
+            vol_used=jnp.asarray(vol_used),
             pod_count=jnp.asarray(pod_count),
             open_=jnp.asarray(open_),
         )
@@ -410,6 +561,9 @@ class TPUSolver:
             node_capacity=jnp.asarray(node_capacity),
             node_tmpl=jnp.asarray(node_tmpl),
             node_owned=jnp.asarray(node_owned),
+            vol_limit=jnp.asarray(vol_limit),
+            cls_vol_add=jnp.asarray(cls_vol_add),
+            cls_vol_per_pod=jnp.asarray(cls_vol_per_pod),
         )
         return ex_state, ex_static
 

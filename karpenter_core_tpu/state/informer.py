@@ -7,7 +7,7 @@ provisioner informer records a consolidation change on spec-generation change.
 
 from __future__ import annotations
 
-from karpenter_core_tpu.apis.objects import Node, Pod
+from karpenter_core_tpu.apis.objects import CSINode, Node, Pod
 from karpenter_core_tpu.apis.v1alpha5 import Provisioner
 from karpenter_core_tpu.state.cluster import Cluster
 
@@ -62,11 +62,32 @@ class ProvisionerInformer:
             self.cluster.record_consolidation_change()
 
 
+class CSINodeInformer:
+    """Re-hydrates a node's volume attach limits when its CSINode appears or
+    changes — CSI driver registration always lands after node creation, so
+    limits would otherwise stay stale until the next node event."""
+
+    def __init__(self, cluster: Cluster) -> None:
+        self.cluster = cluster
+        self._kube = None
+
+    def start(self, kube_client) -> None:
+        self._kube = kube_client
+        kube_client.watch(CSINode, self.on_event)
+
+    def on_event(self, event_type: str, csi_node: CSINode) -> None:
+        node = self._kube.get(Node, csi_node.metadata.name)
+        if node is not None:
+            self.cluster.update_node(node)
+
+
 def start_informers(cluster: Cluster, kube_client) -> tuple:
     node = NodeInformer(cluster)
     pod = PodInformer(cluster)
     provisioner = ProvisionerInformer(cluster)
+    csi_node = CSINodeInformer(cluster)
     node.start(kube_client)
     pod.start(kube_client)
     provisioner.start(kube_client)
-    return node, pod, provisioner
+    csi_node.start(kube_client)
+    return node, pod, provisioner, csi_node

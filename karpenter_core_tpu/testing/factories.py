@@ -72,6 +72,7 @@ def make_pod(
     pod_affinity_preferred: Optional[List[WeightedPodAffinityTerm]] = None,
     pod_anti_affinity_preferred: Optional[List[WeightedPodAffinityTerm]] = None,
     host_ports: Optional[List[int]] = None,
+    pvcs: Optional[List[str]] = None,
     owner_kind: str = "",
     priority: Optional[int] = None,
     phase: str = "Pending",
@@ -148,6 +149,11 @@ def make_pod(
             PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
         )
 
+    from karpenter_core_tpu.apis.objects import (
+        PersistentVolumeClaimVolumeSource,
+        Volume,
+    )
+
     return Pod(
         metadata=meta,
         spec=PodSpec(
@@ -158,6 +164,13 @@ def make_pod(
             containers=[container],
             topology_spread_constraints=list(topology_spread or []),
             priority=priority,
+            volumes=[
+                Volume(
+                    name=f"vol-{claim}",
+                    persistent_volume_claim=PersistentVolumeClaimVolumeSource(claim_name=claim),
+                )
+                for claim in (pvcs or [])
+            ],
         ),
         status=status,
     )

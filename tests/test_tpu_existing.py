@@ -323,3 +323,236 @@ class TestReviewRegressions:
         )
         assert len(res.failed_pods) == 1
         assert not res.new_nodes
+
+
+class TestVolumeLimits:
+    """Kernel volume attach-limit plane vs the host ExistingNode path
+    (volumeusage.go:33-236, existingnode.go:77-130)."""
+
+    def _volume_env(self, attach_limit=2, cpu=16):
+        from karpenter_core_tpu.apis.objects import (
+            CSINode,
+            CSINodeDriver,
+            ObjectMeta,
+            StorageClass,
+        )
+
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        env.kube.create(
+            StorageClass(metadata=ObjectMeta(name="fast"), provisioner="csi.test")
+        )
+        node = owned_ready_node(env, cpu=cpu)
+        env.kube.create(
+            CSINode(
+                metadata=ObjectMeta(name=node.name),
+                drivers=[CSINodeDriver(name="csi.test", allocatable_count=attach_limit)],
+            )
+        )
+        return env, node
+
+    def _claim(self, env, name):
+        from karpenter_core_tpu.apis.objects import (
+            ObjectMeta,
+            PersistentVolumeClaim,
+            PersistentVolumeClaimSpec,
+        )
+
+        env.kube.create(
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name=name, namespace="default"),
+                spec=PersistentVolumeClaimSpec(storage_class_name="fast"),
+            )
+        )
+
+    def test_attach_limit_caps_existing_node(self):
+        env, node = self._volume_env(attach_limit=2)
+        pods = []
+        for i in range(4):  # statefulset-style: one PVC per pod
+            self._claim(env, f"claim-{i}")
+            pods.append(make_pod(requests={"cpu": "100m"}, pvcs=[f"claim-{i}"]))
+        solver = TPUSolver(
+            env.provider, env.kube.list_provisioners(), kube_client=env.kube
+        )
+        res = solver.solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        assert not res.failed_pods
+        assert sum(len(v) for v in res.existing_assignments.values()) == 2
+        # overflow opens a new node (no CSINode yet -> unlimited there)
+        assert sum(len(n.pods) for n in res.new_nodes) == 2
+
+    def test_shared_pvc_within_class_counts_once(self):
+        env, node = self._volume_env(attach_limit=1)
+        self._claim(env, "shared")
+        pods = [make_pod(requests={"cpu": "100m"}, pvcs=["shared"]) for _ in range(3)]
+        solver = TPUSolver(
+            env.provider, env.kube.list_provisioners(), kube_client=env.kube
+        )
+        res = solver.solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        # one distinct PVC: the whole class fits under the limit of 1
+        assert not res.failed_pods
+        assert sum(len(v) for v in res.existing_assignments.values()) == 3
+        assert not res.new_nodes
+
+    def test_bound_pod_volumes_count_against_limit(self):
+        env, node = self._volume_env(attach_limit=2)
+        self._claim(env, "bound-claim")
+        bound = make_pod(
+            requests={"cpu": "100m"}, pvcs=["bound-claim"],
+            node_name=node.name, unschedulable=False,
+        )
+        env.kube.create(bound)
+        self._claim(env, "new-1")
+        self._claim(env, "new-2")
+        pods = [
+            make_pod(requests={"cpu": "100m"}, pvcs=["new-1"]),
+            make_pod(requests={"cpu": "100m"}, pvcs=["new-2"]),
+        ]
+        solver = TPUSolver(
+            env.provider, env.kube.list_provisioners(), kube_client=env.kube
+        )
+        res = solver.solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        # 1 mounted + 2 new > 2: only one of the new claims fits
+        assert sum(len(v) for v in res.existing_assignments.values()) == 1
+        assert sum(len(n.pods) for n in res.new_nodes) == 1
+        assert not res.failed_pods
+
+    def test_bound_pod_sharing_class_pvc_adds_nothing(self):
+        env, node = self._volume_env(attach_limit=1)
+        self._claim(env, "shared")
+        bound = make_pod(
+            requests={"cpu": "100m"}, pvcs=["shared"],
+            node_name=node.name, unschedulable=False,
+        )
+        env.kube.create(bound)
+        pods = [make_pod(requests={"cpu": "100m"}, pvcs=["shared"]) for _ in range(2)]
+        solver = TPUSolver(
+            env.provider, env.kube.list_provisioners(), kube_client=env.kube
+        )
+        res = solver.solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        # the class's PVC is already mounted: zero incremental attach cost
+        assert sum(len(v) for v in res.existing_assignments.values()) == 2
+        assert not res.new_nodes
+        assert not res.failed_pods
+
+    def test_over_limit_node_blocks_all_pods(self):
+        env, node = self._volume_env(attach_limit=1)
+        self._claim(env, "a")
+        self._claim(env, "b")
+        for claim in ("a", "b"):
+            env.kube.create(
+                make_pod(
+                    requests={"cpu": "100m"}, pvcs=[claim],
+                    node_name=node.name, unschedulable=False,
+                )
+            )
+        pods = [make_pod(requests={"cpu": "100m"})]  # volume-less
+        solver = TPUSolver(
+            env.provider, env.kube.list_provisioners(), kube_client=env.kube
+        )
+        res = solver.solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        # mounted (2) exceeds limit (1): the node accepts nothing, volume-less
+        # pods included (VolumeCount.exceeds gates can_add wholesale)
+        assert node.name not in res.existing_assignments
+        assert sum(len(n.pods) for n in res.new_nodes) == 1
+
+    def test_cross_class_pvc_sharing_routes_to_host(self):
+        import pytest
+
+        from karpenter_core_tpu.models.snapshot import KernelUnsupported
+
+        env, node = self._volume_env()
+        self._claim(env, "shared")
+        pods = [
+            make_pod(requests={"cpu": "100m"}, pvcs=["shared"]),
+            make_pod(requests={"cpu": "200m"}, pvcs=["shared"]),  # distinct class
+        ]
+        solver = TPUSolver(
+            env.provider, env.kube.list_provisioners(), kube_client=env.kube
+        )
+        with pytest.raises(KernelUnsupported):
+            solver.solve(
+                pods,
+                state_nodes=env.cluster.snapshot_nodes(),
+                bound_pods=env.kube.list_pods(),
+            )
+
+    def test_host_parity_with_attach_limits(self):
+        from karpenter_core_tpu.solver.builder import build_scheduler
+
+        def build():
+            env, node = self._volume_env(attach_limit=2)
+            pods = []
+            for i in range(5):
+                self._claim(env, f"c-{i}")
+                pods.append(make_pod(requests={"cpu": "100m"}, pvcs=[f"c-{i}"]))
+            return env, pods
+
+        env, pods = build()
+        host_sched = build_scheduler(
+            env.kube, env.provider, env.cluster, pods, env.cluster.snapshot_nodes(),
+            daemonset_pods=[],
+        )
+        host = host_sched.solve(pods)
+        host_existing = sum(len(n.pods) for n in host.existing_nodes)
+
+        env, pods = build()
+        solver = TPUSolver(
+            env.provider, env.kube.list_provisioners(), kube_client=env.kube
+        )
+        tpu = solver.solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        tpu_existing = sum(len(v) for v in tpu.existing_assignments.values())
+        assert tpu_existing == host_existing == 2
+        assert len(tpu.failed_pods) == len(host.failed_pods) == 0
+
+    def test_statefulset_pods_stay_one_class(self):
+        # one-PVC-per-pod must NOT explode the class count (claim identity is
+        # excluded from the class signature; PERPOD mode counts per pod)
+        env, node = self._volume_env(attach_limit=2)
+        pods = []
+        for i in range(6):
+            self._claim(env, f"sts-{i}")
+            pods.append(make_pod(requests={"cpu": "100m"}, pvcs=[f"sts-{i}"]))
+        solver = TPUSolver(
+            env.provider, env.kube.list_provisioners(), kube_client=env.kube
+        )
+        snapshot = solver.encode(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        assert len(snapshot.classes) == 1
+        assert snapshot.class_volumes[0]["per_pod"] == {"csi.test": 1}
+
+    def test_cross_class_sharing_without_limits_stays_on_kernel(self):
+        # sharing through a driver nobody limits is harmless — no host fallback
+        from karpenter_core_tpu.apis.objects import ObjectMeta, StorageClass
+
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        env.kube.create(
+            StorageClass(metadata=ObjectMeta(name="fast"), provisioner="csi.test")
+        )
+        owned_ready_node(env, cpu=16)  # no CSINode -> no limits anywhere
+        self._claim(env, "shared")
+        pods = [
+            make_pod(requests={"cpu": "100m"}, pvcs=["shared"]),
+            make_pod(requests={"cpu": "200m"}, pvcs=["shared"]),
+        ]
+        solver = TPUSolver(
+            env.provider, env.kube.list_provisioners(), kube_client=env.kube
+        )
+        res = solver.solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        assert sum(len(v) for v in res.existing_assignments.values()) == 2
+        assert not res.failed_pods
