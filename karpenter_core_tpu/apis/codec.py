@@ -27,6 +27,7 @@ from karpenter_core_tpu.apis.objects import (
     PodAffinity,
     PodAffinityTerm,
     PodAntiAffinity,
+    PersistentVolumeClaimVolumeSource,
     PodSpec,
     PodStatus,
     PreferredSchedulingTerm,
@@ -34,6 +35,7 @@ from karpenter_core_tpu.apis.objects import (
     Taint,
     Toleration,
     TopologySpreadConstraint,
+    Volume,
     WeightedPodAffinityTerm,
 )
 from karpenter_core_tpu.apis.v1alpha5 import (
@@ -147,6 +149,11 @@ def pod_to_dict(pod: Pod) -> Dict[str, Any]:
                 for c in spec.topology_spread_constraints
             ],
             "priority": spec.priority,
+            "pvcs": [
+                v.persistent_volume_claim.claim_name
+                for v in spec.volumes
+                if v.persistent_volume_claim is not None
+            ],
         },
         "status": {"phase": pod.status.phase},
     }
@@ -284,6 +291,15 @@ def pod_from_dict(d: Dict[str, Any]) -> Pod:
                 for c in spec_d.get("topologySpreadConstraints", [])
             ],
             priority=spec_d.get("priority"),
+            volumes=[
+                Volume(
+                    name=f"vol-{claim}",
+                    persistent_volume_claim=PersistentVolumeClaimVolumeSource(
+                        claim_name=claim
+                    ),
+                )
+                for claim in spec_d.get("pvcs", [])
+            ],
         ),
         status=PodStatus(phase=d.get("status", {}).get("phase", "Pending")),
     )

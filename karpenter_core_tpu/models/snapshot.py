@@ -151,6 +151,10 @@ class EncodedSnapshot:
     is_custom: np.ndarray = None  # bool[K]
     vocab_ints: np.ndarray = None  # f32[K, V]
 
+    # kernel scan passes (cross-group affinity retry rounds, the host queue's
+    # re-push equivalent — affinity_scan_passes)
+    scan_passes: int = 1
+
     # per-class resolved volumes (volumeusage.go:33-236 resolution, filled by
     # TPUSolver when a kube client is available).  Each entry:
     #   {"shared": {driver: {pvc ids}}, "per_pod": {driver: count}}
@@ -254,10 +258,6 @@ def _selector_sig(selector) -> tuple:
     )
 
 
-def _self_selecting(pod: Pod, selector) -> bool:
-    return selector is not None and selector.matches(pod.metadata.labels)
-
-
 class KernelUnsupported(Exception):
     """The batch uses a feature the tensor kernel does not cover; callers fall
     back to the host solver (solver.scheduler.Scheduler)."""
@@ -277,34 +277,55 @@ def build_pod_class(pod: Pod) -> PodClass:
 
 
 def finalize_classes(classes: List[PodClass]) -> List[PodClass]:
-    """Order classes for the kernel scan and validate scan-order feasibility.
-    Mutates ``classes`` order in place and returns it."""
-    # FFD: cpu desc, then memory desc (queue.go:74-110)
+    """Order classes for the kernel scan (mutates in place, returns them).
+    FFD: cpu desc, then memory desc (queue.go:74-110)."""
     classes.sort(
         key=lambda c: (
             -c.requests.get(resources_util.CPU, 0.0),
             -c.requests.get(resources_util.MEMORY, 0.0),
         )
     )
-
-    # cross-group affinity is order-sensitive in a single-pass scan: the host
-    # path retries followers after their targets schedule (queue re-push,
-    # scheduler.go:117-123); the kernel has no retry, so a follower class whose
-    # target class scans later must take the host path
-    for idx, cls in enumerate(classes):
-        for spec in (cls.zone_affinity, cls.host_affinity):
-            if spec is None:
-                continue
-            selector = cls.selectors[spec]
-            own_labels = cls.pods[0].metadata.labels
-            if selector is not None and selector.matches(own_labels):
-                continue  # self-affinity: no ordering dependency
-            for later in classes[idx + 1 :]:
-                if selector is not None and selector.matches(later.pods[0].metadata.labels):
-                    raise KernelUnsupported(
-                        "cross-group affinity target scans after its follower"
-                    )
     return classes
+
+
+MAX_SCAN_PASSES = 3
+
+
+def affinity_scan_passes(classes: List[PodClass]) -> int:
+    """Scan passes the kernel needs for cross-group affinity whose targets
+    scan later.  The host path retries followers after their targets schedule
+    (queue re-push, scheduler.go:117-123); the kernel's equivalent is an extra
+    scan pass over the still-failed pods, seeded by the earlier passes'
+    topology counts.  pass(i) = max over affinity targets j of pass(j), +1
+    when j scans after i.  Chains deeper than MAX_SCAN_PASSES (or cyclic
+    cross-group dependencies) route to the host path."""
+    n = len(classes)
+    passes = [1] * n
+    labels = [cls.pods[0].metadata.labels for cls in classes]
+    for _ in range(n + 1):
+        changed = False
+        for i, cls in enumerate(classes):
+            for spec in (cls.zone_affinity, cls.host_affinity):
+                if spec is None:
+                    continue
+                selector = cls.selectors[spec]
+                if selector is None or selector.matches(labels[i]):
+                    continue  # self-affinity bootstraps in-pass
+                need = passes[i]
+                for j in range(n):
+                    if j != i and selector.matches(labels[j]):
+                        need = max(need, passes[j] + (1 if j > i else 0))
+                if need > MAX_SCAN_PASSES:
+                    raise KernelUnsupported(
+                        "cross-group affinity chain deeper than "
+                        f"{MAX_SCAN_PASSES} passes not kernel-supported"
+                    )
+                if need != passes[i]:
+                    passes[i] = need
+                    changed = True
+        if not changed:
+            return max(passes, default=1)
+    raise KernelUnsupported("cyclic cross-group affinity not kernel-supported")
 
 
 def classify_pods(pods: List[Pod]) -> List[PodClass]:
@@ -349,11 +370,9 @@ def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
     for constraint in pod.spec.topology_spread_constraints:
         if constraint.when_unsatisfiable != "DoNotSchedule":
             continue  # ScheduleAnyway spreads relax away on failure
-        if not _self_selecting(pod, constraint.label_selector):
-            # a spread whose own pods don't count interacts with open-node
-            # packing in a per-pod way the batched water-fill doesn't model;
-            # exact handling stays on the host path
-            raise KernelUnsupported("non-self-selecting spread not kernel-supported")
+        # self-selecting spreads water-fill (counts move with each placement);
+        # non-self-selecting ones reduce to a static within-skew domain mask —
+        # the kernel handles both (ops/solve.py zone-spread phases, host caps)
         spec = _group_spec(
             GRP_SPREAD, constraint.topology_key, constraint.label_selector, constraint.max_skew
         )
@@ -402,6 +421,7 @@ def encode_snapshot(
     classes incrementally (models.columnar.PodIngest)."""
     if classes is None:
         classes = classify_pods(pods)
+    scan_passes = affinity_scan_passes(classes)
 
     # -- axes -----------------------------------------------------------------
     all_its: List[InstanceType] = []
@@ -448,6 +468,7 @@ def encode_snapshot(
         capacity_types=capacity_types,
         it_names=it_names,
         classes=classes,
+        scan_passes=scan_passes,
     )
     snap.valid = vocab.valid_mask()
     snap.is_custom = vocab.is_custom()

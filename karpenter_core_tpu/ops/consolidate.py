@@ -48,6 +48,7 @@ def sweep(
     ex_cls_count: jnp.ndarray,  # i32[C, E]: candidate pods per class per node
     prefix_sizes: jnp.ndarray,  # i32[S]
     n_slots: int = 16,
+    n_passes: int = 1,
 ) -> SweepOutputs:
     """Simulate closing the first-k candidates for every k in prefix_sizes."""
 
@@ -64,7 +65,8 @@ def sweep(
         )  # [C]
         cls = class_tensors._replace(count=class_tensors.count + displaced)
         out = solve_ops.solve_core(
-            cls, statics_arrays, n_slots, key_has_bounds, ex, ex_static
+            cls, statics_arrays, n_slots, key_has_bounds, ex, ex_static,
+            n_passes=n_passes,
         )
         n_new = out.state.n_next
         failed = jnp.sum(out.failed)
@@ -87,12 +89,12 @@ def sweep(
 
 
 _sweep_jit = functools.partial(
-    jax.jit, static_argnames=("key_has_bounds", "n_slots")
+    jax.jit, static_argnames=("key_has_bounds", "n_slots", "n_passes")
 )(sweep)
 
 
 @functools.lru_cache(maxsize=16)
-def _sharded_sweep_fn(mesh, key_has_bounds, n_slots: int):
+def _sharded_sweep_fn(mesh, key_has_bounds, n_slots: int, n_passes: int = 1):
     """Cached jitted sweep with the lane axis sharded over the mesh — a fresh
     closure per call would defeat JAX's compile cache (keyed on callable
     identity) and recompile every sweep."""
@@ -103,7 +105,7 @@ def _sharded_sweep_fn(mesh, key_has_bounds, n_slots: int):
     def core(sizes_arg, cls_arg, statics_arg, ex_state_arg, ex_static_arg, rank_arg, counts_arg):
         return sweep(
             cls_arg, statics_arg, key_has_bounds, ex_state_arg, ex_static_arg,
-            rank_arg, counts_arg, sizes_arg, n_slots=n_slots,
+            rank_arg, counts_arg, sizes_arg, n_slots=n_slots, n_passes=n_passes,
         )
 
     return jax.jit(core, in_shardings=(lane_sharded, None, None, None, None, None, None))
@@ -131,7 +133,7 @@ def run_sweep(
         pad = (-len(prefix_sizes)) % n_dev
         if pad:
             sizes = jnp.concatenate([sizes, jnp.repeat(sizes[-1:], pad)])
-        fn = _sharded_sweep_fn(mesh, key_has_bounds, n_slots)
+        fn = _sharded_sweep_fn(mesh, key_has_bounds, n_slots, snapshot.scan_passes)
         with mesh:
             out = fn(
                 sizes, cls, statics_arrays, ex_state, ex_static,
@@ -150,4 +152,5 @@ def run_sweep(
         jnp.asarray(ex_cls_count),
         sizes,
         n_slots=n_slots,
+        n_passes=snapshot.scan_passes,
     )

@@ -313,7 +313,6 @@ def _phase_existing(
     statics: Statics,
     quota: jnp.ndarray,
     zone_restrict: jnp.ndarray,
-    collapse_zone: bool,
     host_cap_vec: jnp.ndarray,
     tol_row: jnp.ndarray,
     vol_add_row: jnp.ndarray,
@@ -395,9 +394,7 @@ def _phase_existing(
         kneg=jnp.where(sel, merged.negative, ex.kneg),
         kgt=jnp.where(sel, merged.gt, ex.kgt),
         klt=jnp.where(sel, merged.lt, ex.klt),
-        zone=jnp.where(sel, zone_ok, ex.zone) if collapse_zone else jnp.where(
-            sel, ex.zone & cls.zone[None, :], ex.zone
-        ),
+        zone=jnp.where(sel, zone_ok, ex.zone),
         ct=jnp.where(sel, ct_ok, ex.ct),
         ports=jnp.where(sel, ex.ports | cls.ports[None, :], ex.ports),
         vol_used=jnp.where(
@@ -417,7 +414,6 @@ def _phase(
     statics: Statics,
     quota: jnp.ndarray,
     zone_restrict: jnp.ndarray,
-    collapse_zone: bool,
     host_cap_vec: jnp.ndarray,
     fresh_host_cap: jnp.ndarray,
     remaining: jnp.ndarray,
@@ -485,9 +481,10 @@ def _phase(
     kneg = jnp.where(sel, merged.negative, state.kneg)
     kgt = jnp.where(sel, merged.gt, state.kgt)
     klt = jnp.where(sel, merged.lt, state.klt)
-    new_zone = jnp.where(sel, zone_ok, state.zone) if collapse_zone else jnp.where(
-        sel, state.zone & cls.zone[None, :], state.zone
-    )
+    # the node inherits the pod's zone requirements (incl. anti-affinity
+    # exclusions and the phase restriction) exactly as the host merges pod
+    # requirements into the node on add (node.go:62-117)
+    new_zone = jnp.where(sel, zone_ok, state.zone)
     new_ct = jnp.where(sel, ct_ok, state.ct)
     viable = jnp.where(sel, it_ok & (cap_ni >= assigned[:, None]), state.viable)
     ports_plane = jnp.where(sel, state.ports | cls.ports[None, :], state.ports)
@@ -677,7 +674,7 @@ def _class_step(
     assigned_ex_total = jnp.zeros_like(ex.pod_count)
     placed_total = jnp.int32(0)
 
-    def run_phase(state, ex, remaining, quota, restrict, collapse, targets_ex=None,
+    def run_phase(state, ex, remaining, quota, restrict, targets_ex=None,
                   targets_new=None, single_node=False, max_new_nodes=None):
         """Wrapped in lax.cond so zero-quota phases (most of them: each class
         participates in 1-2 of the Z+4 phase kinds) cost nothing on device."""
@@ -687,7 +684,7 @@ def _class_step(
             extra_ex = ok_ex if targets_ex is None else (ok_ex & targets_ex)
             extra_new = ok_new if targets_new is None else (ok_new & targets_new)
             ex_o, a_ex, placed_ex = _phase_existing(
-                ex_i, ex_static, cls, statics, quota, restrict, collapse,
+                ex_i, ex_static, cls, statics, quota, restrict,
                 host_cap_ex, tol_row, vol_add_row, vol_per_pod_row,
                 extra_elig=extra_ex, single_node=single_node,
             )
@@ -695,7 +692,7 @@ def _class_step(
             if single_node:
                 q_new = jnp.where(placed_ex > 0, 0, q_new)
             state_o, a_new, placed_new, rem_o = _phase(
-                state_i, cls, statics, q_new, restrict, collapse,
+                state_i, cls, statics, q_new, restrict,
                 host_cap_new, fresh_host_cap, rem_i, extra_elig=extra_new,
                 max_new_nodes=max_new_nodes,
             )
@@ -724,20 +721,20 @@ def _class_step(
     # -- zone spread phases (one committed zone per phase) --------------------
     counts_zs = topo.zone_fwd[g_zs]  # [Z]
     member_zs = member_row[g_zs]
-    quotas_member = _water_fill(counts_zs, allowed_zone, m)
-    # non-member spread: pods never increment the counts, so every pod goes to
-    # the min-count zone (the reference's per-pod argmin never moves)
-    argmin_zone = jnp.argmin(jnp.where(allowed_zone, counts_zs, jnp.int32(1 << 30)))
-    quotas_nonmember = (
-        jnp.zeros(n_zones, dtype=jnp.int32)
-        .at[argmin_zone]
-        .set(jnp.where(jnp.any(allowed_zone), m, 0))
-    )
-    quotas = jnp.where(member_zs, quotas_member, quotas_nonmember)
+    quotas = jnp.where(member_zs, _water_fill(counts_zs, allowed_zone, m), 0)
     for z in range(n_zones):
         restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
         q = jnp.where(has_zs, quotas[z], 0)
-        accumulate(run_phase(state, ex, remaining, q, restrict, True))
+        accumulate(run_phase(state, ex, remaining, q, restrict))
+
+    # non-self-selecting zone spread: the pod never increments its own group's
+    # counts, so the skew formula (count + 0 - min <= maxSkew,
+    # topologygroup.go:155-182) yields a STATIC admissible-zone mask — one
+    # plain phase over it, no per-zone quotas or committal needed
+    min_zs = jnp.min(jnp.where(cls.zone, counts_zs, jnp.int32(1 << 30)))
+    admissible_zs = allowed_zone & (counts_zs - min_zs <= statics.grp_skew[g_zs])
+    q_nm = jnp.where(has_zs & ~member_zs & jnp.any(admissible_zs), m, 0)
+    accumulate(run_phase(state, ex, remaining, q_nm, admissible_zs))
 
     # -- owned zone anti-affinity: zero-forward-count zones only --------------
     # self-members block every domain they might occupy (pessimistic late
@@ -748,7 +745,7 @@ def _class_step(
         jnp.where(member_row[g_zan], jnp.minimum(m, 1), m),
         0,
     )
-    accumulate(run_phase(state, ex, remaining, anti_quota, zero_zones, True))
+    accumulate(run_phase(state, ex, remaining, anti_quota, zero_zones))
 
     # -- zone affinity: nonzero-count zones (the selected pods' locations),
     # else self-members bootstrap one allowed zone (topologygroup.go:202-233).
@@ -773,7 +770,7 @@ def _class_step(
     )
     zone_aff_restrict = jnp.where(jnp.any(nonzero_zones), nonzero_zones, bootstrap_zone)
     zone_aff_quota = jnp.where(has_zaf & ~has_haf & jnp.any(zone_aff_restrict), m, 0)
-    accumulate(run_phase(state, ex, remaining, zone_aff_quota, zone_aff_restrict, True))
+    accumulate(run_phase(state, ex, remaining, zone_aff_quota, zone_aff_restrict))
 
     # -- hostname affinity: fill target nodes (forward count > 0) on both
     # planes; else self-members bootstrap exactly one node
@@ -786,21 +783,21 @@ def _class_step(
     q_targets = jnp.where(targets_exist, host_quota, 0)
     accumulate(
         run_phase(
-            state, ex, remaining, q_targets, host_restrict, True,
+            state, ex, remaining, q_targets, host_restrict,
             targets_ex=targets_ex, targets_new=targets_new, max_new_nodes=0,
         )
     )
     q_boot = jnp.where(targets_exist | ~member_row[g_haf], 0, host_quota)
     accumulate(
         run_phase(
-            state, ex, remaining, q_boot, host_restrict, True,
+            state, ex, remaining, q_boot, host_restrict,
             single_node=True, max_new_nodes=1,
         )
     )
 
     # -- unconstrained phase for plain classes --------------------------------
     any_quota = jnp.where(has_zs | has_zan | has_zaf | has_haf, 0, m)
-    accumulate(run_phase(state, ex, remaining, any_quota, allowed_zone, False))
+    accumulate(run_phase(state, ex, remaining, any_quota, allowed_zone))
 
     # -- record (topology.go:120-143): update shared counts -------------------
     # committed zone per node: singleton masks count for spread/affinity;
@@ -842,10 +839,17 @@ def solve_core(
     key_has_bounds,
     existing_state: "Optional[ExistingState]" = None,
     existing_static: "Optional[ExistingStatic]" = None,
+    n_passes: int = 1,
 ):
     """Unjitted kernel core — jit/vmap/shard_map-composable (the parallel layer
     vmaps this over snapshot replicas and consolidation subsets;
-    __graft_entry__ compile-checks it)."""
+    __graft_entry__ compile-checks it).
+
+    ``n_passes`` > 1 re-scans still-failed pods seeded by earlier passes'
+    topology counts — the kernel's equivalent of the host queue re-pushing
+    failed pods until no progress (scheduler.go:117-123), needed when a
+    cross-group affinity follower scans before its target
+    (models.snapshot.affinity_scan_passes)."""
     statics = Statics(*statics_arrays, key_has_bounds=key_has_bounds)
     n_zones = statics.tmpl_zone.shape[-1]
     n_res = statics.it_alloc.shape[-1]
@@ -909,9 +913,36 @@ def solve_core(
         "et,er->tr", tmpl_onehot.astype(jnp.float32), existing_static.node_capacity
     )
     remaining0 = statics.tmpl_limits0 - used_budget
-    (final_state, final_ex, _, _), (assign, assign_ex, failed) = jax.lax.scan(
-        step, (state, existing_state, topo, remaining0), (class_tensors, cls_indices)
-    )
+    carry = (state, existing_state, topo, remaining0)
+    assign = jnp.zeros((n_classes, n_slots), dtype=jnp.int32)
+    n_ex = existing_state.pod_count.shape[0]
+    assign_ex = jnp.zeros((n_classes, n_ex), dtype=jnp.int32)
+    count_left = class_tensors.count
+    failed = count_left
+    for p in range(max(n_passes, 1)):
+        cls_pass = class_tensors._replace(count=count_left)
+        carry, (a, a_ex, failed) = jax.lax.scan(
+            step, carry, (cls_pass, cls_indices)
+        )
+        assign = assign + a
+        assign_ex = assign_ex + a_ex
+        count_left = failed
+        if p + 1 < n_passes:
+            # shared volume adds are once-per-(class, node): a class placing on
+            # the same node again in the next pass must not re-add its PVC
+            # set, so rebuild vol_used from the accumulated assignment
+            state_c, ex_c, topo_c, rem_c = carry
+            placed_any = (assign_ex > 0).astype(jnp.int32)  # [C, E]
+            shared = jnp.sum(
+                placed_any[:, :, None] * existing_static.cls_vol_add, axis=0
+            )
+            per_pod = jnp.sum(
+                assign_ex[:, :, None] * existing_static.cls_vol_per_pod[:, None, :],
+                axis=0,
+            )
+            ex_c = ex_c._replace(vol_used=existing_state.vol_used + shared + per_pod)
+            carry = (state_c, ex_c, topo_c, rem_c)
+    final_state, final_ex, _, _ = carry
     return SolveOutputs(
         assign=assign,
         assign_existing=assign_ex,
@@ -959,9 +990,9 @@ def empty_existing_static(
     )
 
 
-_solve_jit = functools.partial(jax.jit, static_argnames=("n_slots", "key_has_bounds"))(
-    solve_core
-)
+_solve_jit = functools.partial(
+    jax.jit, static_argnames=("n_slots", "key_has_bounds", "n_passes")
+)(solve_core)
 
 
 @jax.jit
@@ -1005,7 +1036,10 @@ def solve(snapshot: EncodedSnapshot, n_slots: int = 0) -> SolveOutputs:
     if n_slots <= 0:
         n_slots = estimate_slots(snapshot)
     cls, statics_arrays, key_has_bounds = prepare(snapshot)
-    return _solve_jit(cls, statics_arrays, n_slots, key_has_bounds)
+    return _solve_jit(
+        cls, statics_arrays, n_slots, key_has_bounds,
+        n_passes=snapshot.scan_passes,
+    )
 
 
 def prepare(snapshot: EncodedSnapshot):

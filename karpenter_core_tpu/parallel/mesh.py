@@ -81,7 +81,10 @@ def monte_carlo_solve(
     def one_replica(avail):
         arrays = list(statics_arrays)
         arrays[avail_idx] = avail
-        out = solve_ops.solve_core(cls, tuple(arrays), n_slots, key_has_bounds)
+        out = solve_ops.solve_core(
+            cls, tuple(arrays), n_slots, key_has_bounds,
+            n_passes=snapshot.scan_passes,
+        )
         scheduled = jnp.sum(out.assign)
         failed = jnp.sum(out.failed)
         nodes = jnp.sum((out.state.pod_count > 0).astype(jnp.int32))

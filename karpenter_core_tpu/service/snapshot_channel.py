@@ -39,6 +39,45 @@ log = logging.getLogger(__name__)
 SERVICE = "karpenter.v1.SnapshotSolver"
 
 
+class _WireVolumeResolver:
+    """Minimal kube-lookup surface for PVC→CSI-driver resolution
+    (scheduling.VolumeUsage), backed by the request's ``claimDrivers`` map —
+    the controller plane resolves claims against its apiserver and ships just
+    the answers."""
+
+    _PREFIX = "wire://"
+
+    def __init__(self, claim_drivers) -> None:
+        self.claim_drivers = dict(claim_drivers or {})
+
+    def get_persistent_volume_claim(self, namespace: str, name: str):
+        from karpenter_core_tpu.apis.objects import (
+            ObjectMeta,
+            PersistentVolumeClaim,
+            PersistentVolumeClaimSpec,
+        )
+
+        driver = self.claim_drivers.get(f"{namespace}/{name}")
+        if driver is None:
+            return None
+        return PersistentVolumeClaim(
+            metadata=ObjectMeta(name=name, namespace=namespace),
+            spec=PersistentVolumeClaimSpec(storage_class_name=self._PREFIX + driver),
+        )
+
+    def get_persistent_volume(self, name: str):
+        return None
+
+    def get_storage_class(self, name: str):
+        from karpenter_core_tpu.apis.objects import ObjectMeta, StorageClass
+
+        if name.startswith(self._PREFIX):
+            return StorageClass(
+                metadata=ObjectMeta(name=name), provisioner=name[len(self._PREFIX):]
+            )
+        return None
+
+
 class SnapshotSolverService(grpc.GenericRpcHandler):
     """Stateless solver endpoint: each request is one snapshot solve."""
 
@@ -64,8 +103,19 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
 
     @staticmethod
     def _decode_common(req):
-        """(provisioners, daemonset_pods, state_nodes, bound_pods) from the
-        request envelope shared by /Solve and /SolveClasses."""
+        """(provisioners, daemonset_pods, state_nodes, bound_pods, resolver)
+        from the request envelope shared by /Solve and /SolveClasses.
+
+        ``claimDrivers`` ({"<ns>/<claim>": csi-driver}) lets the controller
+        plane ship its PVC→driver resolution so volume attach limits bind on
+        this side of the wire too; node entries may carry ``volumeLimits``
+        ({driver: allocatable count}) from their CSINode."""
+        # no claimDrivers → volumes stay unconstrained (the pre-existing wire
+        # contract); a provided map makes every referenced claim resolvable
+        # and unresolved ones route to FAILED_PRECONDITION like other
+        # kernel-unsupported shapes
+        claim_drivers = req.get("claimDrivers")
+        resolver = _WireVolumeResolver(claim_drivers) if claim_drivers else None
         provisioners = [
             codec.provisioner_from_dict(p) for p in req.get("provisioners", [])
         ]
@@ -75,13 +125,15 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
         state_nodes = []
         bound = []
         for n in req.get("nodes", []):
-            state_node = StateNode(codec.node_from_dict(n["node"]))
+            state_node = StateNode(codec.node_from_dict(n["node"]), resolver)
+            for driver, limit in (n.get("volumeLimits") or {}).items():
+                state_node._volume_limits[driver] = int(limit)
             for p in n.get("pods", []):
                 pod = codec.pod_from_dict(p)
                 state_node.update_for_pod(pod)
                 bound.append(pod)
             state_nodes.append(state_node)
-        return provisioners, daemonset_pods, state_nodes, bound
+        return provisioners, daemonset_pods, state_nodes, bound, resolver
 
     def _solve_classes(self, request: bytes, context) -> bytes:
         from karpenter_core_tpu.models.snapshot import build_pod_class
@@ -96,9 +148,14 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 cls.pods = [rep] * int(entry["count"])
                 classes.append(cls)
             req_idx = {id(rep): i for i, rep in enumerate(reps)}
-            provisioners, daemonset_pods, state_nodes, bound = self._decode_common(req)
+            provisioners, daemonset_pods, state_nodes, bound, resolver = (
+                self._decode_common(req)
+            )
 
-            solver = TPUSolver(self.cloud_provider, provisioners, daemonset_pods)
+            solver = TPUSolver(
+                self.cloud_provider, provisioners, daemonset_pods,
+                kube_client=resolver,
+            )
             snapshot = solver.encode_classes(
                 classes, state_nodes=state_nodes or None, bound_pods=bound
             )
@@ -139,9 +196,14 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
         try:
             req = msgpack.unpackb(request)
             pods = [codec.pod_from_dict(p) for p in req.get("pods", [])]
-            provisioners, daemonset_pods, state_nodes, bound = self._decode_common(req)
+            provisioners, daemonset_pods, state_nodes, bound, resolver = (
+                self._decode_common(req)
+            )
 
-            solver = TPUSolver(self.cloud_provider, provisioners, daemonset_pods)
+            solver = TPUSolver(
+                self.cloud_provider, provisioners, daemonset_pods,
+                kube_client=resolver,
+            )
             results = solver.solve(pods, state_nodes=state_nodes or None, bound_pods=bound)
 
             pod_index = {p.uid: i for i, p in enumerate(pods)}
@@ -200,15 +262,19 @@ class SnapshotSolverClient:
         provisioners: List,
         nodes: Optional[List[Dict]] = None,
         daemonset_pods: Optional[List] = None,
+        claim_drivers: Optional[Dict[str, str]] = None,
         timeout: float = 60.0,
     ) -> Dict:
-        """nodes: [{"node": node_dict, "pods": [pod_dict, ...]}, ...]"""
+        """nodes: [{"node": node_dict, "pods": [...], "volumeLimits": {...}}];
+        claim_drivers: {"<ns>/<claim>": csi-driver} resolved by this plane so
+        volume attach limits bind on the solver side."""
         request = msgpack.packb(
             {
                 "pods": [codec.pod_to_dict(p) for p in pods],
                 "provisioners": [codec.provisioner_to_dict(p) for p in provisioners],
                 "daemonsetPods": [codec.pod_to_dict(p) for p in daemonset_pods or []],
                 "nodes": nodes or [],
+                "claimDrivers": claim_drivers or {},
             }
         )
         return msgpack.unpackb(self._solve(request, timeout=timeout))
@@ -219,6 +285,7 @@ class SnapshotSolverClient:
         provisioners: List,
         nodes: Optional[List[Dict]] = None,
         daemonset_pods: Optional[List] = None,
+        claim_drivers: Optional[Dict[str, str]] = None,
         timeout: float = 60.0,
     ) -> Dict:
         """Class-columnar solve: dedup ``pods`` into shape classes locally,
@@ -240,6 +307,7 @@ class SnapshotSolverClient:
                 "provisioners": [codec.provisioner_to_dict(p) for p in provisioners],
                 "daemonsetPods": [codec.pod_to_dict(p) for p in daemonset_pods or []],
                 "nodes": nodes or [],
+                "claimDrivers": claim_drivers or {},
             }
         )
         response = msgpack.unpackb(self._solve_classes(request, timeout=timeout))

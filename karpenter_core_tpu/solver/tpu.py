@@ -591,7 +591,8 @@ class TPUSolver:
             n_slots = solve_ops.estimate_slots(snapshot)
         cls, statics_arrays, key_has_bounds = solve_ops.prepare(snapshot)
         outputs = solve_ops._solve_jit(
-            cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static
+            cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
+            n_passes=snapshot.scan_passes,
         )
         # slot exhaustion: retry once with double capacity.  One batched fetch
         # (the relay costs ~67 ms per round trip); both arrays are cached on
@@ -601,7 +602,8 @@ class TPUSolver:
         slots = outputs.assign.shape[1]
         if int(np.sum(failed_h)) > 0 and n_used >= slots:
             outputs = solve_ops._solve_jit(
-                cls, statics_arrays, slots * 2, key_has_bounds, ex_state, ex_static
+                cls, statics_arrays, slots * 2, key_has_bounds, ex_state, ex_static,
+                n_passes=snapshot.scan_passes,
             )
         return self.decode(snapshot, outputs, state_nodes or [])
 

@@ -140,13 +140,14 @@ class TestPodIngest:
         from karpenter_core_tpu.models.snapshot import KernelUnsupported
 
         ingest = PodIngest()
-        # non-self-selecting spread: ingestion succeeds, routing raises
+        # region-key topology is not kernel-modeled: ingestion succeeds,
+        # routing raises
         bad = make_pod(
-            labels={"app": "other"},
+            labels={"app": "s"},
             topology_spread=[
                 TopologySpreadConstraint(
                     max_skew=1,
-                    topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                    topology_key="topology.kubernetes.io/region",
                     label_selector=LabelSelector(match_labels={"app": "s"}),
                 )
             ],

@@ -172,6 +172,42 @@ class TestSnapshotChannel:
         assert sum(len(v) for v in assigned.values()) == 2
         assert not response["newNodes"]
 
+    def test_volume_limits_over_the_wire(self, channel):
+        node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+                labels_api.LABEL_CAPACITY_TYPE: "spot",
+                labels_api.LABEL_NODE_INITIALIZED: "true",
+                labels_api.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            },
+            allocatable={"cpu": 16, "memory": "16Gi", "pods": 20},
+        )
+        pods = [
+            make_pod(requests={"cpu": "100m"}, pvcs=[f"claim-{i}"]) for i in range(4)
+        ]
+        response = channel.solve(
+            pods,
+            [make_provisioner()],
+            nodes=[{
+                "node": codec.node_to_dict(node),
+                "pods": [],
+                "volumeLimits": {"csi.test": 2},
+            }],
+            claim_drivers={f"default/claim-{i}": "csi.test" for i in range(4)},
+        )
+        placed_existing = sum(len(v) for v in response["existingAssignments"].values())
+        placed_new = sum(len(n["podIndices"]) for n in response["newNodes"])
+        # attach limit 2 binds over the wire exactly as in-process
+        assert placed_existing == 2
+        assert placed_new == 2
+        assert response["failedPodIndices"] == []
+
+    def test_pvc_pods_without_claim_drivers_stay_unconstrained(self, channel):
+        pods = [make_pod(requests={"cpu": "100m"}, pvcs=["c1"])]
+        response = channel.solve(pods, [make_provisioner()])
+        assert sum(len(n["podIndices"]) for n in response["newNodes"]) == 1
+
     def test_unsupported_batch_rejected(self, channel):
         import grpc
 

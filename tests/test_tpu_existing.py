@@ -212,14 +212,15 @@ class TestReviewRegressions:
         assert node.name not in res.existing_assignments
         assert sum(len(n.pods) for n in res.new_nodes) == 1
 
-    def test_cross_group_affinity_order_sensitivity_routed_to_host(self):
+    def test_cross_group_affinity_late_target_second_pass(self):
         """Follower class (bigger cpu, scans first) with affinity to a target
-        class that scans later: single-pass kernel can't satisfy it, so the
-        host path must take over."""
-        import pytest
-
+        class that scans later: the follower's pods fail pass 1, then place in
+        pass 2 seeded by the target's recorded counts — the kernel equivalent
+        of the host queue's re-push (scheduler.go:117-123)."""
         from karpenter_core_tpu.apis.objects import LabelSelector, PodAffinityTerm
-        from karpenter_core_tpu.models.snapshot import KernelUnsupported, classify_pods
+        from karpenter_core_tpu.models.snapshot import affinity_scan_passes, classify_pods
+        from karpenter_core_tpu.cloudprovider import fake as fake_cp
+        from karpenter_core_tpu.testing import make_provisioner as mk_prov
 
         targets = [
             make_pod(labels={"app": "tgt"}, requests={"cpu": "10m"},
@@ -235,9 +236,42 @@ class TestReviewRegressions:
                     )
                 ],
             )
+            for _ in range(3)
         ]
-        with pytest.raises(KernelUnsupported):
-            classify_pods(targets + followers)
+        classes = classify_pods(targets + followers)
+        assert affinity_scan_passes(classes) == 2
+
+        provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(10))
+        solver = TPUSolver(provider, [mk_prov()])
+        res = solver.solve(targets + followers)
+        assert not res.failed_pods
+        # followers colocate with the zone-2-pinned target
+        for node in res.new_nodes:
+            assert node.zones == ["test-zone-2"]
+
+    def test_cross_group_affinity_no_target_still_fails(self):
+        """Followers whose target never schedules keep failing across passes
+        (host parity: retry makes no progress)."""
+        from karpenter_core_tpu.apis.objects import LabelSelector, PodAffinityTerm
+        from karpenter_core_tpu.cloudprovider import fake as fake_cp
+        from karpenter_core_tpu.testing import make_provisioner as mk_prov
+
+        followers = [
+            make_pod(
+                requests={"cpu": "500m"},
+                pod_affinity=[
+                    PodAffinityTerm(
+                        topology_key=ZONE,
+                        label_selector=LabelSelector(match_labels={"app": "ghost"}),
+                    )
+                ],
+            )
+            for _ in range(2)
+        ]
+        provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(10))
+        solver = TPUSolver(provider, [mk_prov()])
+        res = solver.solve(followers)
+        assert len(res.failed_pods) == 2
 
     def test_zone_affinity_bootstrap_capacity_aware(self):
         """Bootstrap must pick a zone some template actually offers."""
@@ -556,3 +590,142 @@ class TestVolumeLimits:
         )
         assert sum(len(v) for v in res.existing_assignments.values()) == 2
         assert not res.failed_pods
+
+
+class TestNonSelfSelectingSpread:
+    """Spreads whose own pods don't match the selector: the skew formula
+    (count + 0 - min <= maxSkew) reduces to a static admissible-domain mask
+    (topologygroup.go:155-182 with selects(pod)=false)."""
+
+    def _spread(self, key, skew=1):
+        from karpenter_core_tpu.apis.objects import LabelSelector, TopologySpreadConstraint
+
+        return [
+            TopologySpreadConstraint(
+                max_skew=skew,
+                topology_key=key,
+                label_selector=LabelSelector(match_labels={"app": "web"}),
+            )
+        ]
+
+    def test_zone_mask_excludes_over_skew_zones(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        n1 = owned_ready_node(env, cpu=8, zone="test-zone-1", name="n1")
+        n2 = owned_ready_node(env, cpu=8, zone="test-zone-2", name="n2")
+        # web counts: zone-1 = 2, zone-2 = 1, zone-3 = 0 -> admissible (skew 1)
+        # for a non-counting pod: zones with count <= min+1 = {zone-2, zone-3}
+        for node, n in ((n1, 2), (n2, 1)):
+            for _ in range(n):
+                env.kube.create(
+                    make_pod(labels={"app": "web"}, requests={"cpu": "100m"},
+                             node_name=node.name, unschedulable=False)
+                )
+        watchers = [
+            make_pod(
+                labels={"app": "watch"}, requests={"cpu": "100m"},
+                topology_spread=self._spread(ZONE),
+            )
+            for _ in range(4)
+        ]
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        res = solver.solve(
+            watchers, state_nodes=env.cluster.snapshot_nodes(),
+            bound_pods=env.kube.list_pods(),
+        )
+        assert not res.failed_pods
+        assert "n1" not in res.existing_assignments  # zone-1 is over skew
+        for node in res.new_nodes:
+            assert "test-zone-1" not in node.zones
+
+    def test_hostname_count_gate(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        crowded = owned_ready_node(env, cpu=8, name="crowded")
+        quiet = owned_ready_node(env, cpu=8, name="quiet")
+        for _ in range(2):  # crowded: web count 2 > skew 1 -> blocked
+            env.kube.create(
+                make_pod(labels={"app": "web"}, requests={"cpu": "100m"},
+                         node_name=crowded.name, unschedulable=False)
+            )
+        env.kube.create(  # quiet: web count 1 <= skew 1 -> open, unlimited
+            make_pod(labels={"app": "web"}, requests={"cpu": "100m"},
+                     node_name=quiet.name, unschedulable=False)
+        )
+        watchers = [
+            make_pod(
+                labels={"app": "watch"}, requests={"cpu": "100m"},
+                topology_spread=self._spread(labels_api.LABEL_HOSTNAME),
+            )
+            for _ in range(3)
+        ]
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        res = solver.solve(
+            watchers, state_nodes=env.cluster.snapshot_nodes(),
+            bound_pods=env.kube.list_pods(),
+        )
+        assert not res.failed_pods
+        assert "crowded" not in res.existing_assignments
+        assert len(res.existing_assignments.get("quiet", [])) == 3
+
+    def test_host_parity_mixed_batch(self):
+        from karpenter_core_tpu.solver.builder import build_scheduler
+
+        def build():
+            env = make_environment()
+            env.kube.create(make_provisioner())
+            pods = [
+                make_pod(labels={"app": "web"}, requests={"cpu": "500m"})
+                for _ in range(6)
+            ] + [
+                make_pod(
+                    labels={"app": "watch"}, requests={"cpu": "250m"},
+                    topology_spread=self._spread(ZONE),
+                )
+                for _ in range(4)
+            ]
+            return env, pods
+
+        env, pods = build()
+        host = build_scheduler(
+            env.kube, env.provider, env.cluster, pods, env.cluster.snapshot_nodes(),
+            daemonset_pods=[],
+        ).solve(pods)
+        env, pods = build()
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        tpu = solver.solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(), bound_pods=env.kube.list_pods()
+        )
+        host_new = sum(len(n.pods) for n in host.new_nodes)
+        tpu_new = sum(len(n.pods) for n in tpu.new_nodes)
+        assert tpu_new == host_new
+        assert len(tpu.failed_pods) == len(host.failed_pods) == 0
+
+    def test_no_capacity_in_admissible_zones_fails_pods(self):
+        from karpenter_core_tpu.apis.objects import NodeSelectorRequirement, OP_IN
+
+        env = make_environment()
+        # templates only offer zone-1; web count zone-1 = 1 > skew 0, so the
+        # only admissible zones for the non-counting watcher have no capacity
+        env.kube.create(
+            make_provisioner(
+                requirements=[NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1"])]
+            )
+        )
+        node = owned_ready_node(env, cpu=8, zone="test-zone-1", name="n1")
+        env.kube.create(
+            make_pod(labels={"app": "web"}, requests={"cpu": "100m"},
+                     node_name=node.name, unschedulable=False)
+        )
+        watchers = [
+            make_pod(
+                labels={"app": "watch"}, requests={"cpu": "100m"},
+                topology_spread=self._spread(ZONE, skew=0),
+            )
+        ]
+        solver = TPUSolver(env.provider, env.kube.list_provisioners())
+        res = solver.solve(
+            watchers, state_nodes=env.cluster.snapshot_nodes(),
+            bound_pods=env.kube.list_pods(),
+        )
+        assert len(res.failed_pods) == 1

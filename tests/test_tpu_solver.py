@@ -359,6 +359,38 @@ class TestKernelSelfAffinity:
             if any(p.metadata.labels.get("app") == "fol" for p in node.pods):
                 assert node.zones == ["test-zone-2"]
 
+    def test_cross_group_affinity_late_target_parity(self):
+        """Follower class scans BEFORE its target (bigger cpu): pass 2 places
+        it where the host's queue re-push does (scheduler.go:117-123)."""
+        def pods():
+            targets = [
+                make_pod(
+                    labels={"app": "tgt"},
+                    requests={"cpu": "10m"},
+                    node_selector={ZONE: "test-zone-2"},
+                )
+                for _ in range(2)
+            ]
+            followers = [
+                make_pod(
+                    labels={"app": "fol"},
+                    requests={"cpu": "900m"},
+                    pod_affinity=[
+                        PodAffinityTerm(
+                            topology_key=ZONE,
+                            label_selector=LabelSelector(match_labels={"app": "tgt"}),
+                        )
+                    ],
+                )
+                for _ in range(3)
+            ]
+            return targets + followers
+
+        host, tpu = compare(pods)
+        for node in tpu.new_nodes:
+            if any(p.metadata.labels.get("app") == "fol" for p in node.pods):
+                assert node.zones == ["test-zone-2"]
+
     def test_inverse_anti_affinity_parity(self):
         """Pods selected by another class's anti-affinity avoid its nodes."""
         def pods():
@@ -449,24 +481,40 @@ class TestKernelUnsupported:
         with pytest.raises(KernelUnsupported):
             classify_pods([pod])
 
-    def test_non_self_selecting_spread_rejected(self):
-        """A spread whose own pods don't count packs per-pod onto open nodes
-        within skew — a behavior the batched water-fill doesn't model, so the
-        host path handles it."""
-        pods = [
-            make_pod(
-                labels={"app": "a"},
+    def test_non_self_selecting_spread_accepted(self):
+        """A spread whose own pods don't count reduces to a static
+        within-skew domain mask — kernel-supported since round 2 (the
+        admissible-zone phase in ops/solve.py) with host parity."""
+        classes = classify_pods(
+            [
+                make_pod(
+                    labels={"app": "a"},
+                    topology_spread=[
+                        TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key=ZONE,
+                            label_selector=LabelSelector(match_labels={"app": "OTHER"}),
+                        )
+                    ],
+                )
+            ]
+        )
+        assert classes[0].zone_spread is not None
+        compare(
+            lambda: make_pods(6, labels={"app": "web"}, requests={"cpu": "500m"})
+            + make_pods(
+                3,
+                labels={"app": "watch"},
+                requests={"cpu": "250m"},
                 topology_spread=[
                     TopologySpreadConstraint(
                         max_skew=1,
                         topology_key=ZONE,
-                        label_selector=LabelSelector(match_labels={"app": "OTHER"}),
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
                     )
                 ],
             )
-        ]
-        with pytest.raises(KernelUnsupported):
-            classify_pods(pods)
+        )
 
 
 class TestClassify:
