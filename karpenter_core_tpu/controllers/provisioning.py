@@ -16,7 +16,7 @@ from __future__ import annotations
 import logging
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import (
@@ -314,25 +314,44 @@ class ProvisioningController:
 
     def _schedule_tpu(self, pods: List[Pod], state_nodes) -> Optional[SchedulingResults]:
         """Route the batch through the TPU kernel; None falls back to the host
-        path (batch shape unsupported — models.snapshot.classify_pods)."""
+        path (batch shape unsupported — models.snapshot.classify_pods).
+
+        Mixed batches split: pods whose shape the kernel doesn't model go to
+        the host oracle AFTER the kernel pass (with the kernel's existing-node
+        placements applied), so one exotic pod no longer drags 50k ordinary
+        pods onto the O(pods × nodes) host path.  The split only happens when
+        the two sets are topology- and volume-isolated from each other —
+        otherwise shared group counts would diverge and the whole batch stays
+        on the host path, as before."""
         from karpenter_core_tpu.models.snapshot import KernelUnsupported
         from karpenter_core_tpu.solver.tpu import TPUSolver
 
         provisioners = self.kube_client.list_provisioners()
         if not provisioners:
             raise NoProvisionersError("no provisioners found")
+        split = self._split_batch(pods)
+        if split is None:
+            return None  # unsupported pods entangled with the rest: whole-batch host
+        tpu_classes, tpu_pods, host_pods = split
+        if len(tpu_pods) < self.tpu_kernel_min_pods:
+            # post-split remainder too small to amortize the kernel's fixed
+            # encode/dispatch overhead — same regime the pre-solve gate covers
+            return None
+        solver = TPUSolver(
+            self.cloud_provider, provisioners,
+            daemonset_pods=self.get_daemonset_pods(),
+            kube_client=self.kube_client,
+        )
+        bound_pods = self.kube_client.list_pods()
         try:
-            solver = TPUSolver(
-                self.cloud_provider, provisioners,
-                daemonset_pods=self.get_daemonset_pods(),
-                kube_client=self.kube_client,
+            # classes were already built by the split — skip re-classification
+            snapshot = solver.encode_classes(
+                tpu_classes, state_nodes=state_nodes, bound_pods=bound_pods
             )
-            tpu_results = solver.solve(
-                pods,
-                state_nodes=state_nodes,
-                bound_pods=self.kube_client.list_pods(),
-            )
+            tpu_results = solver.solve_encoded(snapshot, state_nodes, bound_pods)
         except KernelUnsupported as e:
+            # batch-level shapes (deep affinity chains, cross-class PVC
+            # sharing) surface here rather than per class
             log.debug("TPU kernel unsupported for batch, falling back: %s", e)
             return None
 
@@ -352,7 +371,125 @@ class ProvisioningController:
                 self.recorder.publish(
                     evt.pod_failed_to_schedule(pod, "no capacity (tpu solve)")
                 )
+        if host_pods:
+            log.debug(
+                "solving %d kernel-unsupported pods on the host path "
+                "(%d solved on tpu)", len(host_pods), len(tpu_pods),
+            )
+            host_results = self._solve_host_remainder(
+                host_pods, state_nodes, tpu_results
+            )
+            results.new_nodes.extend(host_results.new_nodes)
+            results.failed_pods.extend(host_results.failed_pods)
+            results.errors.update(host_results.errors)
         return results
+
+    def _split_batch(self, pods: List[Pod]):
+        """(tpu_classes, tpu_pods, host_pods), or None when the unsupported
+        pods are not isolated from the supported ones (shared topology
+        selectors/labels or shared PVC claims — the split would desynchronize
+        shared counts).  The built classes feed TPUSolver.encode_classes so
+        classification is not repeated on the hot path."""
+        from karpenter_core_tpu.models.snapshot import (
+            KernelUnsupported,
+            PodClass,
+            _class_signature,
+            build_pod_class,
+        )
+
+        supported: Dict[tuple, List[Pod]] = {}
+        unsupported: Dict[tuple, List[Pod]] = {}
+        protos: Dict[tuple, Optional[PodClass]] = {}
+        for pod in pods:
+            sig = _class_signature(pod)
+            if sig not in protos:
+                try:
+                    protos[sig] = build_pod_class(pod)
+                except KernelUnsupported:
+                    protos[sig] = None
+            (supported if protos[sig] is not None else unsupported).setdefault(
+                sig, []
+            ).append(pod)
+
+        host_pods = [p for group in unsupported.values() for p in group]
+        tpu_classes = []
+        tpu_pods: List[Pod] = []
+        for sig, group in supported.items():
+            cls = protos[sig]
+            cls.pods = group
+            tpu_classes.append(cls)
+            tpu_pods.extend(group)
+        if not host_pods:
+            return tpu_classes, tpu_pods, []
+        if not tpu_pods:
+            return None
+
+        # isolation: no topology selector in either set may match labels in
+        # the other (label sets are class-invariant, so representatives
+        # suffice), and no PVC claim may span both sets (claim identity is
+        # NOT class-invariant — check every pod)
+        def selectors(pod: Pod):
+            for constraint in pod.spec.topology_spread_constraints:
+                yield constraint.label_selector
+            if pod.spec.affinity is not None:
+                for terms in (
+                    pod.spec.affinity.pod_affinity,
+                    pod.spec.affinity.pod_anti_affinity,
+                ):
+                    if terms is not None:
+                        for term in terms.required + [
+                            w.pod_affinity_term for w in terms.preferred
+                        ]:
+                            yield term.label_selector
+
+        def claims(pod: Pod):
+            return {
+                (pod.namespace or "", v.persistent_volume_claim.claim_name)
+                for v in pod.spec.volumes
+                if v.persistent_volume_claim is not None
+            }
+
+        host_reps = [group[0] for group in unsupported.values()]
+        tpu_reps = [group[0] for group in supported.values()]
+        for reps, others in ((host_reps, tpu_reps), (tpu_reps, host_reps)):
+            for rep in reps:
+                for selector in selectors(rep):
+                    if selector is not None and any(
+                        selector.matches(o.metadata.labels) for o in others
+                    ):
+                        return None
+        host_claims = set().union(*map(claims, host_pods)) if host_pods else set()
+        tpu_claims = set().union(*map(claims, tpu_pods)) if tpu_pods else set()
+        if host_claims & tpu_claims:
+            return None
+        return tpu_classes, tpu_pods, host_pods
+
+    def _solve_host_remainder(
+        self, host_pods: List[Pod], state_nodes, tpu_results
+    ) -> SchedulingResults:
+        """Host-oracle solve for the kernel-unsupported remainder, with the
+        kernel's existing-node placements applied so capacity is not
+        double-booked.  New nodes the kernel opened are not offered to the
+        remainder (they are not launched yet); the remainder opens its own."""
+        adjusted = []
+        for state_node in state_nodes:
+            placed = tpu_results.existing_assignments.get(state_node.node.name)
+            if placed:
+                state_node = state_node.deep_copy()
+                for pod in placed:
+                    state_node.update_for_pod(pod)
+            adjusted.append(state_node)
+        scheduler = build_scheduler(
+            self.kube_client,
+            self.cloud_provider,
+            self.cluster,
+            host_pods,
+            adjusted,
+            daemonset_pods=self.get_daemonset_pods(),
+            recorder=self.recorder,
+            opts=SchedulerOptions(),
+        )
+        return scheduler.solve(host_pods)
 
     def get_daemonset_pods(self) -> List[Pod]:
         """Representative daemonset pods for overhead calculation.  The

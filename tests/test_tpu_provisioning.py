@@ -80,3 +80,129 @@ class TestTPUProvisioningPath:
         controller.reconcile(wait_for_batch=False)
         assert len(provider.create_calls) == 1
         assert len(kube.list_nodes()) == 1
+
+
+class TestMixedBatchSplit:
+    """Kernel-unsupported pods no longer drag the whole batch to the host
+    path: isolated exotic shapes solve on the host AFTER the kernel pass."""
+
+    def _exotic_pod(self, **kwargs):
+        # specific-IP host port: a shape the kernel never models
+        from karpenter_core_tpu.apis.objects import ContainerPort
+
+        pod = make_pod(**kwargs)
+        pod.spec.containers[0].ports.append(
+            ContainerPort(host_port=8080, host_ip="10.0.0.1")
+        )
+        return pod
+
+    def test_isolated_exotic_pod_splits(self):
+        kube, provider, cluster, recorder, controller = tpu_env()
+        kube.create(make_provisioner())
+        plain = make_pods(8, requests={"cpu": "900m"})
+        exotic = self._exotic_pod(labels={"app": "edge"}, requests={"cpu": "100m"})
+        for pod in plain + [exotic]:
+            kube.create(pod)
+        pods = controller.get_pending_pods()
+        split = controller._split_batch(pods)
+        assert split is not None
+        tpu_classes, tpu_pods, host_pods = split
+        assert len(tpu_pods) == 8
+        assert len(host_pods) == 1
+        assert sum(len(c.pods) for c in tpu_classes) == 8
+        err = controller.reconcile(wait_for_batch=False)
+        assert err is None
+        nominated = [e for e in recorder.events if e.reason == "Nominated"]
+        assert len(nominated) == 9  # every pod found a home
+
+    def test_entangled_selector_stays_whole_batch_host(self):
+        from karpenter_core_tpu.apis.objects import LabelSelector, TopologySpreadConstraint
+
+        kube, provider, cluster, recorder, controller = tpu_env()
+        kube.create(make_provisioner())
+        plain = make_pods(4, labels={"app": "web"}, requests={"cpu": "500m"})
+        # exotic pod spreads over the SUPPORTED pods' labels: counts would
+        # desynchronize across a split, so no split happens
+        entangled = self._exotic_pod(
+            labels={"app": "edge"},
+            requests={"cpu": "100m"},
+            topology_spread=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                    label_selector=LabelSelector(match_labels={"app": "web"}),
+                )
+            ],
+        )
+        for pod in plain + [entangled]:
+            kube.create(pod)
+        pods = controller.get_pending_pods()
+        assert controller._split_batch(pods) is None
+        err = controller.reconcile(wait_for_batch=False)  # host path still works
+        assert err is None
+        nominated = [e for e in recorder.events if e.reason == "Nominated"]
+        assert len(nominated) == 5
+
+    def test_shared_claim_stays_whole_batch_host(self):
+        from karpenter_core_tpu.apis.objects import (
+            ObjectMeta,
+            PersistentVolumeClaim,
+        )
+
+        kube, provider, cluster, recorder, controller = tpu_env()
+        kube.create(make_provisioner())
+        kube.create(
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name="shared", namespace="default")
+            )
+        )
+        plain = make_pod(requests={"cpu": "500m"}, pvcs=["shared"])
+        exotic = self._exotic_pod(requests={"cpu": "100m"}, pvcs=["shared"])
+        kube.create(plain)
+        kube.create(exotic)
+        pods = controller.get_pending_pods()
+        assert controller._split_batch(pods) is None
+
+    def test_statefulset_claim_overlap_checked_per_pod(self):
+        """Claim identity is not class-invariant (StatefulSet classes hold
+        pods with different claims), so the isolation check must inspect every
+        pod — a shared claim hiding behind a non-representative pod blocks
+        the split."""
+        kube, provider, cluster, recorder, controller = tpu_env()
+        kube.create(make_provisioner())
+        sts = [
+            make_pod(labels={"app": "db"}, requests={"cpu": "500m"}, pvcs=[claim])
+            for claim in ("data-0", "data-1")
+        ]
+        exotic = self._exotic_pod(requests={"cpu": "100m"}, pvcs=["data-1"])
+        assert controller._split_batch(sts + [exotic]) is None
+
+    def test_split_respects_existing_capacity(self):
+        """The host remainder must see the kernel's existing-node placements
+        (no double-booking)."""
+        kube, provider, cluster, recorder, controller = tpu_env()
+        kube.create(make_provisioner())
+        from karpenter_core_tpu.testing import make_node
+
+        state_node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+                labels_api.LABEL_CAPACITY_TYPE: "spot",
+                labels_api.LABEL_NODE_INITIALIZED: "true",
+                labels_api.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            },
+            allocatable={"cpu": 2, "memory": "4Gi", "pods": 10},
+        )
+        kube.create(state_node)
+        # kernel pods fill the node exactly; the exotic pod must NOT also be
+        # nominated onto it
+        for pod in make_pods(2, requests={"cpu": 1}):
+            kube.create(pod)
+        exotic = self._exotic_pod(labels={"app": "edge"}, requests={"cpu": 1})
+        kube.create(exotic)
+        err = controller.reconcile(wait_for_batch=False)
+        assert err is None
+        # 2 kernel pods on the existing node + 1 new node for the exotic pod
+        created = [n for n in kube.list_nodes() if n.name != state_node.name]
+        assert len(created) == 1
