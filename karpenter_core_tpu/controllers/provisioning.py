@@ -337,9 +337,10 @@ class ProvisioningController:
             # post-split remainder too small to amortize the kernel's fixed
             # encode/dispatch overhead — same regime the pre-solve gate covers
             return None
+        daemonset_pods = self.get_daemonset_pods()
         solver = TPUSolver(
             self.cloud_provider, provisioners,
-            daemonset_pods=self.get_daemonset_pods(),
+            daemonset_pods=daemonset_pods,
             kube_client=self.kube_client,
         )
         bound_pods = self.kube_client.list_pods()
@@ -377,7 +378,8 @@ class ProvisioningController:
                 "(%d solved on tpu)", len(host_pods), len(tpu_pods),
             )
             host_results = self._solve_host_remainder(
-                host_pods, state_nodes, tpu_results
+                host_pods, state_nodes, tpu_results, results.new_nodes,
+                daemonset_pods,
             )
             results.new_nodes.extend(host_results.new_nodes)
             results.failed_pods.extend(host_results.failed_pods)
@@ -465,12 +467,18 @@ class ProvisioningController:
         return tpu_classes, tpu_pods, host_pods
 
     def _solve_host_remainder(
-        self, host_pods: List[Pod], state_nodes, tpu_results
+        self, host_pods: List[Pod], state_nodes, tpu_results, tpu_new_nodes,
+        daemonset_pods: List[Pod],
     ) -> SchedulingResults:
         """Host-oracle solve for the kernel-unsupported remainder, with the
         kernel's existing-node placements applied so capacity is not
         double-booked.  New nodes the kernel opened are not offered to the
-        remainder (they are not launched yet); the remainder opens its own."""
+        remainder (they are not launched yet); the remainder opens its own,
+        but the kernel nodes' pessimistic capacity is charged against the
+        provisioner limits first (subtractMax, scheduler.go:273-290) so the
+        two solves cannot jointly overspend a limit."""
+        from karpenter_core_tpu.solver.scheduler import _subtract_max
+
         adjusted = []
         for state_node in state_nodes:
             placed = tpu_results.existing_assignments.get(state_node.node.name)
@@ -485,10 +493,16 @@ class ProvisioningController:
             self.cluster,
             host_pods,
             adjusted,
-            daemonset_pods=self.get_daemonset_pods(),
+            daemonset_pods=daemonset_pods,
             recorder=self.recorder,
             opts=SchedulerOptions(),
         )
+        for node in tpu_new_nodes:
+            if node.provisioner_name in scheduler.remaining_resources:
+                scheduler.remaining_resources[node.provisioner_name] = _subtract_max(
+                    scheduler.remaining_resources[node.provisioner_name],
+                    node.instance_type_options,
+                )
         return scheduler.solve(host_pods)
 
     def get_daemonset_pods(self) -> List[Pod]:

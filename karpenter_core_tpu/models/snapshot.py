@@ -218,9 +218,19 @@ def _class_signature(pod: Pod) -> tuple:
         if aff.pod_affinity is not None:
             for t in aff.pod_affinity.required:
                 terms.append(("aff", t.topology_key, _selector_sig(t.label_selector)))
+            for w in aff.pod_affinity.preferred:
+                t = w.pod_affinity_term
+                terms.append(
+                    ("aff-pref", w.weight, t.topology_key, _selector_sig(t.label_selector))
+                )
         if aff.pod_anti_affinity is not None:
             for t in aff.pod_anti_affinity.required:
                 terms.append(("anti", t.topology_key, _selector_sig(t.label_selector)))
+            for w in aff.pod_anti_affinity.preferred:
+                t = w.pod_affinity_term
+                terms.append(
+                    ("anti-pref", w.weight, t.topology_key, _selector_sig(t.label_selector))
+                )
         affinity_sig = tuple(sorted(terms))
     labels_sig = tuple(sorted(pod.metadata.labels.items()))
     ports_sig = tuple(

@@ -131,6 +131,9 @@ class Operator:
 
     def start(self) -> "Operator":
         """Start informers, watch controllers, and singleton loops."""
+        from karpenter_core_tpu.utils import compilecache
+
+        compilecache.enable()  # restarts reuse compiled solve kernels
         self.settings_store.start()
         start_informers(self.cluster, self.kube_client)
         for watcher in self._watchers:

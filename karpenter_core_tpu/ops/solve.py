@@ -1035,9 +1035,11 @@ def solve(snapshot: EncodedSnapshot, n_slots: int = 0) -> SolveOutputs:
     caller should retry with more (solver.tpu handles this)."""
     if n_slots <= 0:
         n_slots = estimate_slots(snapshot)
-    cls, statics_arrays, key_has_bounds = prepare(snapshot)
-    return _solve_jit(
-        cls, statics_arrays, n_slots, key_has_bounds,
+    from karpenter_core_tpu.utils import compilecache
+
+    host_cls, host_statics, key_has_bounds = prepare_host(snapshot)
+    return compilecache.run_solve(
+        host_cls, host_statics, n_slots, key_has_bounds,
         n_passes=snapshot.scan_passes,
     )
 
@@ -1045,53 +1047,63 @@ def solve(snapshot: EncodedSnapshot, n_slots: int = 0) -> SolveOutputs:
 def prepare(snapshot: EncodedSnapshot):
     """Device-ready kernel inputs: (class_tensors, statics_arrays,
     key_has_bounds)."""
+    cls, statics_arrays, key_has_bounds = prepare_host(snapshot)
+    cls, statics_arrays = jax.device_put((cls, statics_arrays))
+    return cls, statics_arrays, key_has_bounds
+
+
+def prepare_host(snapshot: EncodedSnapshot):
+    """Kernel input pytrees still on host (numpy) — same shapes/dtypes as
+    prepare().  Callers that want to overlap the device upload with the
+    (seconds-long, relay-bound) compile load pass these to
+    compilecache.solve_callable and device_put on a separate thread."""
     cls = ClassTensors(
-        mask=jnp.asarray(snapshot.cls_mask),
-        defined=jnp.asarray(snapshot.cls_defined),
-        negative=jnp.asarray(snapshot.cls_negative),
-        gt=jnp.asarray(snapshot.cls_gt),
-        lt=jnp.asarray(snapshot.cls_lt),
-        zone=jnp.asarray(snapshot.cls_zone),
-        ct=jnp.asarray(snapshot.cls_ct),
-        it=jnp.asarray(snapshot.cls_it),
-        requests=jnp.asarray(snapshot.cls_requests),
-        count=jnp.asarray(snapshot.cls_count),
-        tol=jnp.asarray(snapshot.cls_tol),
-        ports=jnp.asarray(snapshot.cls_ports),
-        groups=jnp.asarray(snapshot.cls_groups),
+        mask=snapshot.cls_mask,
+        defined=snapshot.cls_defined,
+        negative=snapshot.cls_negative,
+        gt=snapshot.cls_gt,
+        lt=snapshot.cls_lt,
+        zone=snapshot.cls_zone,
+        ct=snapshot.cls_ct,
+        it=snapshot.cls_it,
+        requests=snapshot.cls_requests,
+        count=snapshot.cls_count,
+        tol=snapshot.cls_tol,
+        ports=snapshot.cls_ports,
+        groups=snapshot.cls_groups,
     )
     it_t = mask_ops.ReqTensor(
-        jnp.asarray(snapshot.it_mask),
-        jnp.asarray(snapshot.it_defined),
-        jnp.asarray(snapshot.it_negative),
-        jnp.asarray(snapshot.it_gt),
-        jnp.asarray(snapshot.it_lt),
+        snapshot.it_mask,
+        snapshot.it_defined,
+        snapshot.it_negative,
+        snapshot.it_gt,
+        snapshot.it_lt,
     )
     tmpl_t = mask_ops.ReqTensor(
-        jnp.asarray(snapshot.tmpl_mask),
-        jnp.asarray(snapshot.tmpl_defined),
-        jnp.asarray(snapshot.tmpl_negative),
-        jnp.asarray(snapshot.tmpl_gt),
-        jnp.asarray(snapshot.tmpl_lt),
+        snapshot.tmpl_mask,
+        snapshot.tmpl_defined,
+        snapshot.tmpl_negative,
+        snapshot.tmpl_gt,
+        snapshot.tmpl_lt,
     )
     statics_arrays = (
         it_t,
-        jnp.asarray(snapshot.it_alloc),
-        jnp.asarray(snapshot.it_avail),
+        snapshot.it_alloc,
+        snapshot.it_avail,
         tmpl_t,
-        jnp.asarray(snapshot.tmpl_zone),
-        jnp.asarray(snapshot.tmpl_ct),
-        jnp.asarray(snapshot.tmpl_it),
-        jnp.asarray(snapshot.tmpl_daemon),
-        jnp.asarray(snapshot.tmpl_limits),
-        jnp.asarray(snapshot.it_capacity),
-        jnp.asarray(snapshot.valid),
-        jnp.asarray(snapshot.is_custom),
-        jnp.asarray(snapshot.vocab_ints),
-        jnp.asarray(snapshot.grp_skew),
-        jnp.asarray(snapshot.grp_is_zone),
-        jnp.asarray(snapshot.grp_is_anti),
-        jnp.asarray(snapshot.grp_member),
+        snapshot.tmpl_zone,
+        snapshot.tmpl_ct,
+        snapshot.tmpl_it,
+        snapshot.tmpl_daemon,
+        snapshot.tmpl_limits,
+        snapshot.it_capacity,
+        snapshot.valid,
+        snapshot.is_custom,
+        snapshot.vocab_ints,
+        snapshot.grp_skew,
+        snapshot.grp_is_zone,
+        snapshot.grp_is_anti,
+        snapshot.grp_member,
     )
     key_has_bounds = tuple(
         bool(np.isfinite(snapshot.cls_gt[:, k]).any() or np.isfinite(snapshot.cls_lt[:, k]).any()

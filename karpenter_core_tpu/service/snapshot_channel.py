@@ -236,6 +236,9 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
 
 def serve(cloud_provider, address: str = "127.0.0.1:0", max_workers: int = 4):
     """Start the sidecar; returns (server, bound_port)."""
+    from karpenter_core_tpu.utils import compilecache
+
+    compilecache.enable()  # sidecar restarts reuse compiled solve kernels
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers((SnapshotSolverService(cloud_provider),))
     port = server.add_insecure_port(address)

@@ -589,8 +589,10 @@ class TPUSolver:
             ex_state, ex_static = self.encode_existing(snapshot, state_nodes, bound_pods)
         if n_slots <= 0:
             n_slots = solve_ops.estimate_slots(snapshot)
-        cls, statics_arrays, key_has_bounds = solve_ops.prepare(snapshot)
-        outputs = solve_ops._solve_jit(
+        from karpenter_core_tpu.utils import compilecache
+
+        cls, statics_arrays, key_has_bounds = solve_ops.prepare_host(snapshot)
+        outputs = compilecache.run_solve(
             cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
             n_passes=snapshot.scan_passes,
         )
@@ -601,7 +603,7 @@ class TPUSolver:
         n_used = int(n_next_h)
         slots = outputs.assign.shape[1]
         if int(np.sum(failed_h)) > 0 and n_used >= slots:
-            outputs = solve_ops._solve_jit(
+            outputs = compilecache.run_solve(
                 cls, statics_arrays, slots * 2, key_has_bounds, ex_state, ex_static,
                 n_passes=snapshot.scan_passes,
             )

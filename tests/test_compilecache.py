@@ -1,0 +1,101 @@
+"""Persistent compile/export caches (utils.compilecache) — the cold-start
+eliminator.  The disk entries must round-trip (a second, cache-backed load
+produces identical solve outputs) and invalidate on kernel-source change."""
+
+import os
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.models.columnar import PodIngest
+from karpenter_core_tpu.ops import solve as solve_ops
+from karpenter_core_tpu.solver.tpu import TPUSolver
+from karpenter_core_tpu.testing import make_pods, make_provisioner
+from karpenter_core_tpu.utils import compilecache
+
+
+@pytest.fixture()
+def cache_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("KC_TPU_COMPILE_CACHE", str(tmp_path))
+    # reset module state so the fixture dir is picked up
+    compilecache._memo.clear()
+    yield tmp_path
+    compilecache._memo.clear()
+
+
+def _inputs():
+    provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(10))
+    solver = TPUSolver(provider, [make_provisioner()])
+    ingest = PodIngest()
+    ingest.add_all(make_pods(12, requests={"cpu": "500m"}))
+    snap = solver.encode(ingest)
+    host_cls, host_statics, khb = solve_ops.prepare_host(snap)
+    return snap, host_cls, host_statics, khb
+
+
+class TestExportCache:
+    def test_roundtrip_matches_plain_jit(self, cache_dir):
+        snap, cls, statics, khb = _inputs()
+        n_slots = solve_ops.estimate_slots(snap)
+
+        fn = compilecache.solve_callable(cls, statics, n_slots, khb)
+        assert fn is not None
+        entries = [f for f in os.listdir(cache_dir) if f.endswith(".stablehlo")]
+        assert len(entries) == 1
+
+        import jax
+
+        dev_cls, dev_statics = jax.device_put((cls, statics))
+        out_cached = fn(dev_cls, dev_statics)
+        out_plain = solve_ops._solve_jit(dev_cls, dev_statics, n_slots, khb)
+        assert np.array_equal(np.asarray(out_cached.assign), np.asarray(out_plain.assign))
+        assert np.array_equal(np.asarray(out_cached.failed), np.asarray(out_plain.failed))
+
+    def test_disk_entry_reused_after_memo_clear(self, cache_dir):
+        snap, cls, statics, khb = _inputs()
+        n_slots = solve_ops.estimate_slots(snap)
+        compilecache.solve_callable(cls, statics, n_slots, khb)
+        before = {f: os.path.getmtime(os.path.join(cache_dir, f))
+                  for f in os.listdir(cache_dir) if f.endswith(".stablehlo")}
+        compilecache._memo.clear()  # simulate a process restart
+        fn = compilecache.solve_callable(cls, statics, n_slots, khb)
+        assert fn is not None
+        after = {f: os.path.getmtime(os.path.join(cache_dir, f))
+                 for f in os.listdir(cache_dir) if f.endswith(".stablehlo")}
+        assert before == after  # loaded, not re-exported
+
+    def test_memo_hit_returns_same_object(self, cache_dir):
+        snap, cls, statics, khb = _inputs()
+        n_slots = solve_ops.estimate_slots(snap)
+        a = compilecache.solve_callable(cls, statics, n_slots, khb)
+        b = compilecache.solve_callable(cls, statics, n_slots, khb)
+        assert a is b
+
+    def test_distinct_configs_get_distinct_entries(self, cache_dir):
+        snap, cls, statics, khb = _inputs()
+        n_slots = solve_ops.estimate_slots(snap)
+        compilecache.solve_callable(cls, statics, n_slots, khb)
+        compilecache.solve_callable(cls, statics, n_slots * 2, khb)
+        entries = [f for f in os.listdir(cache_dir) if f.endswith(".stablehlo")]
+        assert len(entries) == 2
+
+    def test_corrupt_entry_recovers(self, cache_dir):
+        snap, cls, statics, khb = _inputs()
+        n_slots = solve_ops.estimate_slots(snap)
+        compilecache.solve_callable(cls, statics, n_slots, khb)
+        (entry,) = [f for f in os.listdir(cache_dir) if f.endswith(".stablehlo")]
+        with open(os.path.join(cache_dir, entry), "wb") as f:
+            f.write(b"garbage")
+        compilecache._memo.clear()
+        fn = compilecache.solve_callable(cls, statics, n_slots, khb)
+        assert fn is not None  # re-exported over the corrupt entry
+
+    def test_solver_path_uses_cache(self, cache_dir):
+        provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(10))
+        solver = TPUSolver(provider, [make_provisioner()])
+        pods = make_pods(8, requests={"cpu": "900m"})
+        res = solver.solve(pods)
+        assert sum(len(n.pods) for n in res.new_nodes) == 8
+        entries = [f for f in os.listdir(cache_dir) if f.endswith(".stablehlo")]
+        assert entries, "TPUSolver.solve must populate the export cache"

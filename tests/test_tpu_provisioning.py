@@ -206,3 +206,28 @@ class TestMixedBatchSplit:
         # 2 kernel pods on the existing node + 1 new node for the exotic pod
         created = [n for n in kube.list_nodes() if n.name != state_node.name]
         assert len(created) == 1
+
+    def test_split_shares_provisioner_limit_budget(self):
+        """The host remainder must see the kernel pass's pessimistic node
+        budget so the two solves cannot jointly overspend a provisioner limit
+        (subtractMax, scheduler.go:273-290)."""
+        kube, provider, cluster, recorder, controller = tpu_env()
+        # budget for one 16-cpu node: the kernel pods consume it
+        # (pessimistic subtractMax charge), leaving no room for the exotic pod
+        kube.create(make_provisioner(limits={"cpu": 17.0}))
+        for pod in make_pods(4, requests={"cpu": 3}):
+            kube.create(pod)
+        exotic = self._exotic_pod(labels={"app": "edge"}, requests={"cpu": 3})
+        kube.create(exotic)
+        pods = controller.get_pending_pods()
+        results, err = controller.schedule(pods, [])
+        assert err is None
+        total_cpu_capacity = sum(
+            max(it.capacity.get("cpu", 0) for it in n.instance_type_options)
+            for n in results.new_nodes
+        )
+        assert total_cpu_capacity <= 17.0, (
+            f"split solves jointly overspent the 17-cpu limit: "
+            f"{total_cpu_capacity} across {len(results.new_nodes)} nodes"
+        )
+        assert results.failed_pods  # the exotic pod had no budget left
