@@ -407,6 +407,27 @@ class CSINode:
     drivers: List[CSINodeDriver] = field(default_factory=list)
 
 
+# --- coordination (leader election) ------------------------------------------
+
+
+@dataclass
+class LeaseSpec:
+    """coordination.k8s.io/v1 LeaseSpec — the leader-election primitive
+    (reference runs leader-elected replicas, operator.go:111-126)."""
+
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+
 # --- namespace --------------------------------------------------------------
 
 

@@ -50,7 +50,11 @@ class Singleton:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, name=self.name, daemon=True)
+        # fresh stop-event per start (see TypedWatchController.start)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(self._stop,), name=self.name, daemon=True
+        )
         self._thread.start()
 
     def stop(self) -> None:
@@ -58,10 +62,10 @@ class Singleton:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
-    def _run(self) -> None:
-        while not self._stop.is_set():
+    def _run(self, stop: threading.Event) -> None:
+        while not stop.is_set():
             requeue = self.tick()
-            self._stop.wait(timeout=requeue)
+            stop.wait(timeout=requeue)
 
     def tick(self) -> float:
         done = measure(RECONCILE_DURATION.labels(self.name))
@@ -107,9 +111,25 @@ class TypedWatchController:
         self._timers: set = set()
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._worker, name=self.name, daemon=True)
+        # fresh stop-event and queue per start: a previous worker that
+        # outlived its stop() join (long reconcile) keeps its own, already-set
+        # event and drained queue, so it can neither revive nor steal work
+        self._stop = threading.Event()
+        self._queue = queue_mod.Queue()
+        with self._lock:
+            self._pending.clear()
+        self._thread = threading.Thread(
+            target=self._worker, args=(self._stop, self._queue),
+            name=self.name, daemon=True,
+        )
         self._thread.start()
-        self.kube_client.watch(self.kind, self._on_event)
+        if not getattr(self, "_watching", False):
+            self.kube_client.watch(self.kind, self._on_event)
+            self._watching = True
+        else:
+            # re-acquired leadership: resync everything missed while standby
+            for obj in self.kube_client.list(self.kind):
+                self._on_event("MODIFIED", obj)
 
     def stop(self) -> None:
         self._stop.set()
@@ -122,6 +142,8 @@ class TypedWatchController:
             self._thread.join(timeout=5)
 
     def _on_event(self, event_type: str, obj) -> None:
+        if self._stop.is_set():
+            return  # standby (lost leadership): don't accumulate a backlog
         if event_type == "DELETED":
             return
         key = (obj.metadata.namespace, obj.metadata.name)
@@ -131,9 +153,9 @@ class TypedWatchController:
             self._pending.add(key)
         self._queue.put((key, obj))
 
-    def _worker(self) -> None:
-        while not self._stop.is_set():
-            item = self._queue.get()
+    def _worker(self, stop: threading.Event, queue: "queue_mod.Queue") -> None:
+        while not stop.is_set():
+            item = queue.get()
             if item is None:
                 return
             key, obj = item
@@ -150,7 +172,7 @@ class TypedWatchController:
                     requeue = self.finalize(stored)
                 else:
                     requeue = self.reconcile(stored)
-                if requeue is not None and not self._stop.is_set():
+                if requeue is not None and not stop.is_set():
                     # schedule a delayed requeue without blocking the worker;
                     # honor the controller's interval (drift polls at 5 min)
                     timer = threading.Timer(

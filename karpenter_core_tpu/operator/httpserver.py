@@ -1,0 +1,167 @@
+"""Operator HTTP endpoints: metrics exposition, health probes, profiling.
+
+Mirror of the reference's serving surface:
+
+  - /metrics on the metrics port (controller-runtime metrics server;
+    options.go:59) — Prometheus text from metrics.REGISTRY.render()
+  - /healthz and /readyz on the health-probe port (operator.go:100-108)
+  - /debug/pprof/* on the metrics port when --enable-profiling is set
+    (/root/reference/pkg/operator/profiling.go:25-40).  Python has no pprof,
+    so the equivalents are:
+      /debug/pprof/profile?seconds=N  stack-sampling CPU profile over all
+                                      threads, collapsed-stack text output
+                                      (flamegraph-compatible)
+      /debug/pprof/heap               tracemalloc top allocations (started on
+                                      first request)
+      /debug/pprof/device             accelerator memory stats (jax)
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from karpenter_core_tpu.metrics import REGISTRY
+
+log = logging.getLogger(__name__)
+
+
+def sample_stacks(seconds: float = 1.0, interval: float = 0.005) -> str:
+    """Collapsed-stack CPU profile: sample every thread's Python stack at
+    ``interval`` for ``seconds``; one `frame;frame;frame count` line per
+    distinct stack (the folded format flamegraph.pl / speedscope read)."""
+    counts: collections.Counter = collections.Counter()
+    own = threading.get_ident()
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for thread_id, frame in sys._current_frames().items():
+            if thread_id == own:
+                continue
+            stack = []
+            while frame is not None:
+                code = frame.f_code
+                stack.append(f"{code.co_name} ({code.co_filename}:{frame.f_lineno})")
+                frame = frame.f_back
+            counts[";".join(reversed(stack))] += 1
+        time.sleep(interval)
+    return "\n".join(f"{stack} {count}" for stack, count in counts.most_common())
+
+
+def heap_profile(limit: int = 50) -> str:
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+        return "tracemalloc started; request again for a snapshot\n"
+    snapshot = tracemalloc.take_snapshot()
+    lines = [str(stat) for stat in snapshot.statistics("lineno")[:limit]]
+    current, peak = tracemalloc.get_traced_memory()
+    lines.append(f"traced: current={current} peak={peak}")
+    return "\n".join(lines)
+
+
+def device_profile() -> str:
+    try:
+        import jax
+
+        lines = []
+        for device in jax.local_devices():
+            stats = device.memory_stats() or {}
+            lines.append(f"{device}:")
+            for key, value in sorted(stats.items()):
+                lines.append(f"  {key}: {value}")
+        return "\n".join(lines) or "no devices"
+    except Exception as e:  # noqa: BLE001 - profiling must not crash the operator
+        return f"device stats unavailable: {e}"
+
+
+class OperatorHTTP:
+    """Two listeners, matching the reference's port split: metrics (+pprof
+    when enabled) on ``metrics_port``, health probes on ``health_port``."""
+
+    def __init__(
+        self,
+        metrics_port: int = 8080,
+        health_port: int = 8081,
+        enable_profiling: bool = False,
+        healthy: Optional[Callable[[], bool]] = None,
+        ready: Optional[Callable[[], bool]] = None,
+    ) -> None:
+        self.enable_profiling = enable_profiling
+        self.healthy = healthy or (lambda: True)
+        self.ready = ready or (lambda: True)
+        outer = self
+
+        class MetricsHandler(BaseHTTPRequestHandler):
+            def log_message(self, *args) -> None:  # noqa: A003 - quiet
+                pass
+
+            def do_GET(self) -> None:  # noqa: N802 - http.server contract
+                parsed = urlparse(self.path)
+                if parsed.path == "/metrics":
+                    return self._text(200, REGISTRY.render())
+                if parsed.path.startswith("/debug/pprof"):
+                    if not outer.enable_profiling:
+                        return self._text(403, "profiling disabled (--enable-profiling)\n")
+                    if parsed.path == "/debug/pprof/profile":
+                        seconds = float(
+                            parse_qs(parsed.query).get("seconds", ["1"])[0]
+                        )
+                        return self._text(200, sample_stacks(min(seconds, 60.0)))
+                    if parsed.path == "/debug/pprof/heap":
+                        return self._text(200, heap_profile())
+                    if parsed.path == "/debug/pprof/device":
+                        return self._text(200, device_profile())
+                return self._text(404, "not found\n")
+
+            def _text(self, status: int, body: str) -> None:
+                data = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        class HealthHandler(BaseHTTPRequestHandler):
+            def log_message(self, *args) -> None:  # noqa: A003 - quiet
+                pass
+
+            def do_GET(self) -> None:  # noqa: N802 - http.server contract
+                if self.path.startswith("/healthz"):
+                    ok = outer.healthy()
+                elif self.path.startswith("/readyz"):
+                    ok = outer.ready()
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = b"ok\n" if ok else b"unhealthy\n"
+                self.send_response(200 if ok else 503)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._metrics_server = ThreadingHTTPServer(("0.0.0.0", metrics_port), MetricsHandler)
+        self._health_server = ThreadingHTTPServer(("0.0.0.0", health_port), HealthHandler)
+        self.metrics_port = self._metrics_server.server_address[1]
+        self.health_port = self._health_server.server_address[1]
+
+    def start(self) -> "OperatorHTTP":
+        for server in (self._metrics_server, self._health_server):
+            threading.Thread(target=server.serve_forever, daemon=True).start()
+        log.info(
+            "serving /metrics%s on :%d, probes on :%d",
+            " + /debug/pprof" if self.enable_profiling else "",
+            self.metrics_port, self.health_port,
+        )
+        return self
+
+    def stop(self) -> None:
+        self._metrics_server.shutdown()
+        self._health_server.shutdown()

@@ -15,6 +15,7 @@ from typing import Callable, Dict, List, Optional
 
 from karpenter_core_tpu.apis.objects import (
     CSINode,
+    Lease,
     LabelSelector,
     Namespace,
     Node,
@@ -52,10 +53,21 @@ class _Store:
 
 
 class KubeClient:
-    def __init__(self, clock=None) -> None:
+    def __init__(self, clock=None, qps: "Optional[float]" = None, burst: "Optional[int]" = None) -> None:
         import time as _time
 
         self._now = clock.now if clock is not None else _time.time
+        self._sleep = clock.sleep if clock is not None else _time.sleep
+        # client-side mutation throttle (--kube-client-qps/-burst,
+        # options.go:61-62): token bucket over create/update/delete; None
+        # disables (direct library use / tests)
+        self._qps = qps
+        if qps:
+            self._burst = max(burst if burst is not None else int(qps * 1.5), 1)
+        else:
+            self._burst = None
+        self._tokens = float(self._burst or 0)
+        self._last_refill = self._now()
         self._lock = threading.RLock()
         self._stores: Dict[type, _Store] = {
             Pod: _Store(True),
@@ -68,6 +80,7 @@ class KubeClient:
             PersistentVolume: _Store(False),
             StorageClass: _Store(False),
             CSINode: _Store(False),
+            Lease: _Store(True),
         }
         self._resource_version = 0
 
@@ -78,7 +91,27 @@ class KubeClient:
             self._stores[kind] = _Store(hasattr(kind, "namespace"))
         return self._stores[kind]
 
+    def _throttle(self) -> None:
+        if not self._qps:
+            return
+        while True:
+            with self._lock:
+                now = self._now()
+                self._tokens = min(
+                    float(self._burst), self._tokens + (now - self._last_refill) * self._qps
+                )
+                self._last_refill = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return
+                wait = (1.0 - self._tokens) / self._qps
+            self._sleep(wait)
+
     def create(self, obj) -> object:
+        self._throttle()
+        return self._create(obj)
+
+    def _create(self, obj) -> object:
         with self._lock:
             store = self._store(type(obj))
             key = store.key(obj)
@@ -101,6 +134,10 @@ class KubeClient:
             return store.objects.get(key)
 
     def update(self, obj) -> object:
+        self._throttle()
+        return self._update(obj)
+
+    def _update(self, obj) -> object:
         with self._lock:
             store = self._store(type(obj))
             key = store.key(obj)
@@ -114,17 +151,41 @@ class KubeClient:
             w("MODIFIED", obj)
         return obj
 
+    def update_with_version(self, obj, expected_resource_version: int) -> object:
+        """Optimistic-concurrency update: fails with ConflictError when the
+        stored object's resourceVersion moved past ``expected`` — the CAS the
+        leader-election lease protocol needs (client-go semantics).
+
+        ``obj`` must be the caller's own COPY and ``expected`` the version
+        snapshotted at read time: this in-memory client hands out live object
+        references, so a CAS against a shared mutated object is vacuous."""
+        self._throttle()
+        with self._lock:
+            store = self._store(type(obj))
+            key = store.key(obj)
+            stored = store.objects.get(key)
+            if stored is None:
+                raise NotFoundError(f"{type(obj).__name__} {key} not found")
+            if stored.metadata.resource_version != expected_resource_version:
+                raise ConflictError(
+                    f"{type(obj).__name__} {key} resourceVersion "
+                    f"{stored.metadata.resource_version} != {expected_resource_version}"
+                )
+            return self._update(obj)
+
     def apply(self, obj) -> object:
         """create-or-update."""
+        self._throttle()
         with self._lock:
             store = self._store(type(obj))
             if store.key(obj) in store.objects:
-                return self.update(obj)
-            return self.create(obj)
+                return self._update(obj)
+            return self._create(obj)
 
     def delete(self, obj, *, force: bool = False) -> None:
         """Sets deletion timestamp; the object is removed once finalizers clear
         (or immediately with no finalizers) — k8s deletion semantics."""
+        self._throttle()
         with self._lock:
             store = self._store(type(obj))
             key = store.key(obj)

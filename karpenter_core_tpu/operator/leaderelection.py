@@ -1,0 +1,177 @@
+"""Leader election over a coordination Lease.
+
+Mirror of the reference's leader-elected replicas
+(/root/reference/pkg/operator/operator.go:111-126, options.go:64 — client-go
+leaderelection with a Lease lock): one replica holds the lease and runs the
+controllers; standbys retry acquisition every ``retry_period`` and take over
+when the holder's renew time goes stale.  Acquisition is a CAS on the lease's
+resourceVersion (KubeClient.update_with_version), so two racing electors can
+never both win a term.
+
+The reference process exits when it loses leadership (client-go's default
+OnStoppedLeading is a fatal); the in-process equivalent is the
+``on_stopped_leading`` callback, which the Operator wires to stop its
+controllers.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import os
+import socket
+import threading
+import uuid
+from typing import Callable, Optional
+
+from karpenter_core_tpu.apis.objects import Lease, LeaseSpec, ObjectMeta
+from karpenter_core_tpu.operator.kubeclient import ConflictError
+from karpenter_core_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+LEASE_NAME = "karpenter-leader-election"
+LEASE_NAMESPACE = "kube-system"
+
+
+def default_identity() -> str:
+    return f"{socket.gethostname()}_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        kube_client,
+        clock: Optional[Clock] = None,
+        identity: Optional[str] = None,
+        lease_name: str = LEASE_NAME,
+        namespace: str = LEASE_NAMESPACE,
+        lease_duration: float = 15.0,
+        retry_period: float = 2.0,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self.kube_client = kube_client
+        self.clock = clock or Clock()
+        self.identity = identity or default_identity()
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.retry_period = retry_period
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.is_leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "LeaderElector":
+        self._thread = threading.Thread(
+            target=self._run, name="leader-election", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop electing; release the lease if held so a standby takes over
+        immediately (leaderelection.release semantics)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.is_leader:
+            self._release()
+            self._demote()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - the elector loop never dies
+                log.exception("leader election tick")
+            self._stop.wait(timeout=self.retry_period)
+
+    # -- protocol --------------------------------------------------------------
+
+    def tick(self) -> bool:
+        """One acquire/renew attempt; returns is_leader.  Callable directly in
+        tests for deterministic stepping."""
+        now = self.clock.now()
+        stored = self.kube_client.get(Lease, self.lease_name, self.namespace)
+        # the in-memory client hands out live references: mutate a COPY and
+        # CAS with the version snapshotted at read time, or two electors
+        # racing through the same object would both "win"
+        lease = copy.deepcopy(stored)
+        seen_version = stored.metadata.resource_version if stored is not None else None
+        if lease is None:
+            created = Lease(
+                metadata=ObjectMeta(name=self.lease_name, namespace=self.namespace),
+                spec=LeaseSpec(
+                    holder_identity=self.identity,
+                    lease_duration_seconds=int(self.lease_duration),
+                    acquire_time=now,
+                    renew_time=now,
+                ),
+            )
+            try:
+                self.kube_client.create(created)
+            except ConflictError:
+                return self.is_leader  # lost the create race
+            self._promote()
+            return True
+
+        if lease.spec.holder_identity == self.identity:
+            lease.spec.renew_time = now
+            try:
+                self.kube_client.update_with_version(lease, seen_version)
+            except ConflictError:
+                return self.is_leader
+            self._promote()
+            return True
+
+        if now - lease.spec.renew_time > self.lease_duration:
+            lease.spec.holder_identity = self.identity
+            lease.spec.acquire_time = now
+            lease.spec.renew_time = now
+            lease.spec.lease_transitions += 1
+            try:
+                self.kube_client.update_with_version(lease, seen_version)
+            except ConflictError:
+                return self.is_leader  # another standby won the takeover
+            log.info(
+                "leader election: %s took over (transition %d)",
+                self.identity, lease.spec.lease_transitions,
+            )
+            self._promote()
+            return True
+
+        # someone else holds a fresh lease
+        self._demote()
+        return False
+
+    def _release(self) -> None:
+        stored = self.kube_client.get(Lease, self.lease_name, self.namespace)
+        if stored is not None and stored.spec.holder_identity == self.identity:
+            lease = copy.deepcopy(stored)
+            lease.spec.holder_identity = ""
+            lease.spec.renew_time = 0.0
+            try:
+                self.kube_client.update_with_version(
+                    lease, stored.metadata.resource_version
+                )
+            except ConflictError:
+                pass
+
+    def _promote(self) -> None:
+        if not self.is_leader:
+            self.is_leader = True
+            log.info("leader election: %s acquired leadership", self.identity)
+            if self.on_started_leading is not None:
+                self.on_started_leading()
+
+    def _demote(self) -> None:
+        if self.is_leader:
+            self.is_leader = False
+            log.warning("leader election: %s lost leadership", self.identity)
+            if self.on_stopped_leading is not None:
+                self.on_stopped_leading()

@@ -49,10 +49,17 @@ class Operator:
     kube_client: Optional[KubeClient] = None
     recorder: Optional[Recorder] = None
     use_tpu_kernel: bool = False
+    # serve /metrics (+ /debug/pprof with --enable-profiling) and health
+    # probes over HTTP; off by default so embedded/test operators don't bind
+    serve_http: bool = False
 
     def __post_init__(self) -> None:
         if self.kube_client is None:
-            self.kube_client = KubeClient(self.clock)
+            self.kube_client = KubeClient(
+                self.clock,
+                qps=self.options.kube_client_qps,
+                burst=self.options.kube_client_burst,
+            )
         if self.recorder is None:
             self.recorder = Recorder(clock=self.clock.now)
         # live settings: controllers read through the store so ConfigMap
@@ -65,6 +72,8 @@ class Operator:
         self._singletons: List[Singleton] = []
         self._watchers: List[TypedWatchController] = []
         self._started = False
+        self.leader_elector = None
+        self.http = None
 
     def with_controllers(self) -> "Operator":
         """Wire the full controller set (controllers.go:46-73)."""
@@ -121,7 +130,7 @@ class Operator:
         operator.go:157)."""
         from karpenter_core_tpu.operator.webhooks import Webhooks
 
-        self.webhooks = Webhooks()
+        self.webhooks = Webhooks(service_name=self.options.service_name)
         self.webhooks.install(self.kube_client)
         return self
 
@@ -130,26 +139,73 @@ class Operator:
         return 0.1
 
     def start(self) -> "Operator":
-        """Start informers, watch controllers, and singleton loops."""
+        """Start informers, serving, and — once this replica holds the
+        leadership lease (operator.go:111-126) — the controllers.  Informers
+        and serving run on every replica; controllers only on the leader."""
         from karpenter_core_tpu.utils import compilecache
 
         compilecache.enable()  # restarts reuse compiled solve kernels
+        if self.options.memory_limit > 0:
+            from karpenter_core_tpu.utils import memlimit
+
+            memlimit.apply(self.options.memory_limit)
         self.settings_store.start()
         start_informers(self.cluster, self.kube_client)
+        if self.serve_http:
+            from karpenter_core_tpu.operator.httpserver import OperatorHTTP
+
+            self.http = OperatorHTTP(
+                metrics_port=self.options.metrics_port,
+                health_port=self.options.health_probe_port,
+                enable_profiling=self.options.enable_profiling,
+                healthy=self.healthy,
+                ready=self.ready,
+            ).start()
+        self._started = True
+        if self.options.enable_leader_election:
+            from karpenter_core_tpu.operator.leaderelection import LeaderElector
+
+            self.leader_elector = LeaderElector(
+                self.kube_client,
+                clock=self.clock,
+                on_started_leading=self._start_controllers,
+                on_stopped_leading=self._stop_controllers,
+            ).start()
+        else:
+            self._start_controllers()
+        return self
+
+    def _start_controllers(self) -> None:
         for watcher in self._watchers:
             watcher.start()
         for singleton in self._singletons:
             singleton.start()
-        self._started = True
-        log.info("operator started with %d controllers", len(self._singletons) + len(self._watchers))
-        return self
+        log.info(
+            "operator running %d controllers",
+            len(self._singletons) + len(self._watchers),
+        )
 
-    def stop(self) -> None:
+    def _stop_controllers(self) -> None:
         for singleton in self._singletons:
             singleton.stop()
         for watcher in self._watchers:
             watcher.stop()
+
+    def stop(self) -> None:
+        if self.leader_elector is not None:
+            self.leader_elector.stop()  # releases the lease for standbys
+        self._stop_controllers()
+        if self.http is not None:
+            self.http.stop()
         self._started = False
 
     def healthy(self) -> bool:
+        """Liveness: the replica is up (leaders and standbys alike)."""
         return self._started
+
+    def ready(self) -> bool:
+        """Readiness: this replica is the one acting (leader, or election
+        disabled)."""
+        return self._started and (
+            self.leader_elector is None or self.leader_elector.is_leader
+        )

@@ -21,9 +21,14 @@ class AdmissionError(Exception):
 
 
 class Webhooks:
-    """Wraps a KubeClient's create/update/apply with admission chains."""
+    """Wraps a KubeClient's create/update/apply with admission chains.
 
-    def __init__(self) -> None:
+    ``service_name`` identifies the serving endpoint admission requests are
+    attributed to (--karpenter-service, the reference's webhook Service name,
+    options.go:58) — informational for the in-process admission path."""
+
+    def __init__(self, service_name: str = "") -> None:
+        self.service_name = service_name
         self.defaulters: Dict[type, Callable] = {Provisioner: validation_api.set_defaults}
         self.validators: Dict[type, Callable] = {Provisioner: validation_api.validate_provisioner}
 

@@ -90,10 +90,15 @@ _kernel_hash: Optional[str] = None
 def _kernel_src_hash() -> str:
     global _kernel_hash
     if _kernel_hash is None:
+        # every module traced into solve_core must invalidate the cache
+        from karpenter_core_tpu.ops import masks as mask_ops
         from karpenter_core_tpu.ops import solve as solve_ops
 
-        with open(solve_ops.__file__, "rb") as f:
-            _kernel_hash = hashlib.sha256(f.read()).hexdigest()[:16]
+        digest = hashlib.sha256()
+        for module in (solve_ops, mask_ops):
+            with open(module.__file__, "rb") as f:
+                digest.update(f.read())
+        _kernel_hash = digest.hexdigest()[:16]
     return _kernel_hash
 
 

@@ -1,0 +1,35 @@
+"""Process memory limit (--memory-limit).
+
+Mirror of /root/reference/pkg/operator/options.go:67-70, which sets Go's
+runtime soft memory limit at 90% of the container limit so GC backpressure
+kicks in before the kubelet OOM-kills the pod.  CPython has no GC pacing
+target, so the equivalent levers are:
+
+  - an address-space rlimit at the configured bytes: allocation beyond it
+    raises MemoryError inside the process (fail fast, crash loops visibly)
+    instead of an opaque SIGKILL from the kernel OOM killer
+  - more aggressive cyclic-GC thresholds, the closest analog to leaning on
+    the collector harder as the limit approaches
+"""
+
+from __future__ import annotations
+
+import gc
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def apply(limit_bytes: int) -> None:
+    if limit_bytes <= 0:
+        return
+    try:
+        import resource
+
+        _, hard = resource.getrlimit(resource.RLIMIT_AS)
+        resource.setrlimit(resource.RLIMIT_AS, (limit_bytes, hard))
+        log.info("memory limit set: %d bytes (RLIMIT_AS soft)", limit_bytes)
+    except (ImportError, ValueError, OSError) as e:
+        log.warning("could not apply memory limit %d: %s", limit_bytes, e)
+    gen0, gen1, gen2 = gc.get_threshold()
+    gc.set_threshold(max(gen0 // 2, 100), gen1, gen2)

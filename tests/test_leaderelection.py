@@ -1,0 +1,190 @@
+"""Leader election (operator/leaderelection.py) — lease protocol, failover,
+and the operator-level guarantee that exactly one replica acts."""
+
+import time
+
+from karpenter_core_tpu.apis.objects import Lease
+from karpenter_core_tpu.operator.kubeclient import KubeClient
+from karpenter_core_tpu.operator.leaderelection import (
+    LEASE_NAME,
+    LEASE_NAMESPACE,
+    LeaderElector,
+)
+from karpenter_core_tpu.utils.clock import FakeClock
+
+
+def elector(kube, clock, name, **kwargs):
+    return LeaderElector(kube, clock=clock, identity=name, **kwargs)
+
+
+class TestLeaseProtocol:
+    def test_first_elector_acquires(self):
+        clock = FakeClock()
+        kube = KubeClient(clock)
+        a = elector(kube, clock, "a")
+        assert a.tick() is True
+        assert a.is_leader
+        lease = kube.get(Lease, LEASE_NAME, LEASE_NAMESPACE)
+        assert lease.spec.holder_identity == "a"
+
+    def test_standby_waits_while_lease_fresh(self):
+        clock = FakeClock()
+        kube = KubeClient(clock)
+        a, b = elector(kube, clock, "a"), elector(kube, clock, "b")
+        assert a.tick()
+        assert b.tick() is False
+        assert not b.is_leader
+
+    def test_takeover_after_expiry(self):
+        clock = FakeClock()
+        kube = KubeClient(clock)
+        a = elector(kube, clock, "a", lease_duration=15.0)
+        b = elector(kube, clock, "b", lease_duration=15.0)
+        assert a.tick()
+        clock.step(16.0)  # holder went silent past the lease duration
+        assert b.tick() is True
+        assert b.is_leader
+        lease = kube.get(Lease, LEASE_NAME, LEASE_NAMESPACE)
+        assert lease.spec.holder_identity == "b"
+        assert lease.spec.lease_transitions == 1
+        # the old leader notices on its next tick
+        lost = []
+        a.on_stopped_leading = lambda: lost.append(True)
+        assert a.tick() is False
+        assert lost
+
+    def test_renewal_keeps_leadership(self):
+        clock = FakeClock()
+        kube = KubeClient(clock)
+        a = elector(kube, clock, "a", lease_duration=15.0)
+        b = elector(kube, clock, "b", lease_duration=15.0)
+        assert a.tick()
+        for _ in range(5):
+            clock.step(10.0)
+            assert a.tick()  # renews within the duration
+            assert b.tick() is False
+
+    def test_stop_releases_for_standby(self):
+        clock = FakeClock()
+        kube = KubeClient(clock)
+        a = elector(kube, clock, "a")
+        b = elector(kube, clock, "b")
+        assert a.tick()
+        a._release()  # what stop() does when holding
+        assert b.tick() is True
+
+    def test_started_leading_callback_fires_once(self):
+        clock = FakeClock()
+        kube = KubeClient(clock)
+        starts = []
+        a = elector(kube, clock, "a", on_started_leading=lambda: starts.append(1))
+        a.tick()
+        a.tick()
+        a.tick()
+        assert starts == [1]
+
+
+class TestOperatorLeaderElection:
+    def _operator(self, kube, **kwargs):
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_core_tpu.operator.operator import Operator
+        from karpenter_core_tpu.operator.settings import Settings
+
+        return Operator(
+            cloud_provider=FakeCloudProvider(),
+            settings=Settings(batch_idle_duration=0.05, batch_max_duration=0.2),
+            kube_client=kube,
+            **kwargs,
+        ).with_controllers()
+
+    def test_exactly_one_replica_acts(self):
+        from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+        kube = KubeClient()
+        first = self._operator(kube)
+        second = self._operator(kube)
+        first.start()
+        # let the first replica win before the second starts electing
+        deadline = time.time() + 5
+        while time.time() < deadline and not first.ready():
+            time.sleep(0.02)
+        second.start()
+        try:
+            assert first.ready()
+            assert not second.ready()  # standby: healthy but not acting
+            assert second.healthy()
+            kube.create(make_provisioner())
+            kube.create(make_pod(requests={"cpu": 1}))
+            deadline = time.time() + 10
+            while time.time() < deadline and not kube.list_nodes():
+                time.sleep(0.05)
+            assert kube.list_nodes(), "the leader must provision"
+            # the standby's controllers never started
+            assert all(s._thread is None for s in second._singletons)
+        finally:
+            first.stop()
+            second.stop()
+
+    def test_standby_takes_over_on_leader_stop(self):
+        kube = KubeClient()
+        first = self._operator(kube)
+        second = self._operator(kube)
+        first.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not first.ready():
+            time.sleep(0.02)
+        second.start()
+        try:
+            assert first.ready() and not second.ready()
+            first.stop()  # releases the lease
+            deadline = time.time() + 10
+            while time.time() < deadline and not second.ready():
+                time.sleep(0.05)
+            assert second.ready(), "standby must take over after release"
+        finally:
+            second.stop()
+
+
+class TestCAS:
+    def test_stale_writer_rejected(self):
+        """The lease CAS must fail for a writer holding a stale snapshot —
+        the split-brain guard (two standbys racing a takeover)."""
+        import copy
+
+        import pytest
+
+        from karpenter_core_tpu.apis.objects import LeaseSpec, ObjectMeta
+        from karpenter_core_tpu.operator.kubeclient import ConflictError
+
+        kube = KubeClient()
+        kube.create(
+            Lease(
+                metadata=ObjectMeta(name=LEASE_NAME, namespace=LEASE_NAMESPACE),
+                spec=LeaseSpec(holder_identity="old"),
+            )
+        )
+        stored = kube.get(Lease, LEASE_NAME, LEASE_NAMESPACE)
+        version = stored.metadata.resource_version
+        racer_a = copy.deepcopy(stored)
+        racer_b = copy.deepcopy(stored)
+        racer_a.spec.holder_identity = "a"
+        racer_b.spec.holder_identity = "b"
+        kube.update_with_version(racer_a, version)
+        with pytest.raises(ConflictError):
+            kube.update_with_version(racer_b, version)
+        assert kube.get(Lease, LEASE_NAME, LEASE_NAMESPACE).spec.holder_identity == "a"
+
+    def test_racing_electors_single_winner(self):
+        """Interleaved takeover attempts after expiry: exactly one promotes."""
+        clock = FakeClock()
+        kube = KubeClient(clock)
+        a = elector(kube, clock, "a", lease_duration=5.0)
+        assert a.tick()
+        clock.step(10.0)
+        b = elector(kube, clock, "b", lease_duration=5.0)
+        c = elector(kube, clock, "c", lease_duration=5.0)
+        winners = [e for e in (b, c) if e.tick()]
+        assert len(winners) == 1
+        # the loser stays standby on its next tick (fresh lease now)
+        loser = c if winners == [b] else b
+        assert loser.tick() is False
