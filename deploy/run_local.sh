@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# One-command local bring-up of the deployed pair: the TPU solver sidecar and
+# the operator shell, as separate processes (the in-cluster equivalent is
+# deploy/manifests/deployment.yaml).  With --check, probes both and exits.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+export KC_SOLVER_LISTEN="${KC_SOLVER_LISTEN:-127.0.0.1:8980}"
+export METRICS_PORT="${METRICS_PORT:-8080}"
+export HEALTH_PROBE_PORT="${HEALTH_PROBE_PORT:-8081}"
+
+cleanup() { kill "${SOLVER_PID:-}" "${OPERATOR_PID:-}" 2>/dev/null || true; }
+trap cleanup EXIT
+
+python -m karpenter_core_tpu.cmd.solver &
+SOLVER_PID=$!
+python -m karpenter_core_tpu.cmd.operator &
+OPERATOR_PID=$!
+
+echo "waiting for the pair to come up..."
+for _ in $(seq 1 60); do
+  if curl -fsS "http://127.0.0.1:${HEALTH_PROBE_PORT}/healthz" >/dev/null 2>&1; then
+    break
+  fi
+  sleep 0.5
+done
+
+curl -fsS "http://127.0.0.1:${HEALTH_PROBE_PORT}/healthz" >/dev/null
+echo "operator healthy   :${HEALTH_PROBE_PORT}/healthz"
+curl -fsS "http://127.0.0.1:${METRICS_PORT}/metrics" | head -3
+python - <<EOF
+from karpenter_core_tpu.service.snapshot_channel import SnapshotSolverClient
+client = SnapshotSolverClient("${KC_SOLVER_LISTEN}")
+assert client.health() == {"status": "ok"}
+client.close()
+print("solver sidecar healthy ${KC_SOLVER_LISTEN} (gRPC /Health)")
+EOF
+
+if [[ "${1:-}" == "--check" ]]; then
+  echo "pair is up; --check done"
+  exit 0
+fi
+
+echo "pair running (ctrl-c to stop)"
+wait

@@ -1,0 +1,71 @@
+"""Operator entrypoint: ``python -m karpenter_core_tpu.cmd.operator``.
+
+The runnable equivalent of the reference's controller binary
+(/root/reference/cmd/controller — cloud providers compose the operator the
+same way).  The cloud provider is a plug point: ``CLOUD_PROVIDER`` names a
+``module:attr`` to import (a CloudProvider instance or zero-arg factory);
+the default is the fake provider so the pair runs end-to-end out of the box.
+
+Flags come from operator.options.Options (env-var equivalents included);
+serving (metrics/probes/pprof) is always on for a deployed operator.
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+import signal
+import sys
+import threading
+
+
+def load_cloud_provider(spec: str):
+    module_name, _, attr = spec.partition(":")
+    obj = getattr(importlib.import_module(module_name), attr or "CloudProvider")
+    return obj() if callable(obj) else obj
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO").upper(),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    from karpenter_core_tpu.operator.operator import Operator
+    from karpenter_core_tpu.operator.options import Options
+
+    options = Options.parse(argv)
+    provider = load_cloud_provider(
+        os.environ.get(
+            "CLOUD_PROVIDER",
+            "karpenter_core_tpu.cloudprovider.fake:FakeCloudProvider",
+        )
+    )
+    operator = (
+        Operator(
+            cloud_provider=provider,
+            options=options,
+            serve_http=True,
+            use_tpu_kernel=os.environ.get("KC_TPU_KERNEL", "1") == "1",
+        )
+        .with_controllers()
+        .with_webhooks()
+        .start()
+    )
+    logging.getLogger(__name__).info(
+        "operator up: metrics :%d, probes :%d, leader-election %s",
+        operator.http.metrics_port,
+        operator.http.health_port,
+        "on" if options.enable_leader_election else "off",
+    )
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    operator.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -110,10 +110,14 @@ class OperatorHTTP:
                     if not outer.enable_profiling:
                         return self._text(403, "profiling disabled (--enable-profiling)\n")
                     if parsed.path == "/debug/pprof/profile":
-                        seconds = float(
-                            parse_qs(parsed.query).get("seconds", ["1"])[0]
-                        )
-                        return self._text(200, sample_stacks(min(seconds, 60.0)))
+                        raw = parse_qs(parsed.query).get("seconds", ["1"])[0]
+                        try:
+                            seconds = float(raw)
+                        except ValueError:
+                            return self._text(400, f"bad seconds: {raw!r}\n")
+                        if not (0 < seconds <= 60.0):
+                            seconds = min(max(seconds, 0.1), 60.0) if seconds == seconds else 1.0
+                        return self._text(200, sample_stacks(seconds))
                     if parsed.path == "/debug/pprof/heap":
                         return self._text(200, heap_profile())
                     if parsed.path == "/debug/pprof/device":

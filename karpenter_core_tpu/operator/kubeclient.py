@@ -137,12 +137,21 @@ class KubeClient:
         self._throttle()
         return self._update(obj)
 
-    def _update(self, obj) -> object:
+    def _update(self, obj, expected_version: "Optional[int]" = None) -> object:
         with self._lock:
             store = self._store(type(obj))
             key = store.key(obj)
-            if key not in store.objects:
+            stored = store.objects.get(key)
+            if stored is None:
                 raise NotFoundError(f"{type(obj).__name__} {key} not found")
+            if (
+                expected_version is not None
+                and stored.metadata.resource_version != expected_version
+            ):
+                raise ConflictError(
+                    f"{type(obj).__name__} {key} resourceVersion "
+                    f"{stored.metadata.resource_version} != {expected_version}"
+                )
             self._resource_version += 1
             obj.metadata.resource_version = self._resource_version
             store.objects[key] = obj
@@ -160,27 +169,17 @@ class KubeClient:
         snapshotted at read time: this in-memory client hands out live object
         references, so a CAS against a shared mutated object is vacuous."""
         self._throttle()
-        with self._lock:
-            store = self._store(type(obj))
-            key = store.key(obj)
-            stored = store.objects.get(key)
-            if stored is None:
-                raise NotFoundError(f"{type(obj).__name__} {key} not found")
-            if stored.metadata.resource_version != expected_resource_version:
-                raise ConflictError(
-                    f"{type(obj).__name__} {key} resourceVersion "
-                    f"{stored.metadata.resource_version} != {expected_resource_version}"
-                )
-            return self._update(obj)
+        return self._update(obj, expected_version=expected_resource_version)
 
     def apply(self, obj) -> object:
-        """create-or-update."""
+        """create-or-update.  Watch callbacks must never fire under the store
+        lock (informer callbacks take Cluster locks whose holders call back
+        into this client — AB-BA), so this composes the unlocked primitives."""
         self._throttle()
-        with self._lock:
-            store = self._store(type(obj))
-            if store.key(obj) in store.objects:
-                return self._update(obj)
+        try:
             return self._create(obj)
+        except ConflictError:
+            return self._update(obj)
 
     def delete(self, obj, *, force: bool = False) -> None:
         """Sets deletion timestamp; the object is removed once finalizers clear

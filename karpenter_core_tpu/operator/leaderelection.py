@@ -31,7 +31,9 @@ from karpenter_core_tpu.utils.clock import Clock
 log = logging.getLogger(__name__)
 
 LEASE_NAME = "karpenter-leader-election"
-LEASE_NAMESPACE = "kube-system"
+# elect in the namespace the operator runs in (the deployment injects
+# SYSTEM_NAMESPACE from metadata.namespace; RBAC grants lease write there)
+LEASE_NAMESPACE = os.environ.get("SYSTEM_NAMESPACE", "kube-system")
 
 
 def default_identity() -> str:
@@ -45,7 +47,7 @@ class LeaderElector:
         clock: Optional[Clock] = None,
         identity: Optional[str] = None,
         lease_name: str = LEASE_NAME,
-        namespace: str = LEASE_NAMESPACE,
+        namespace: Optional[str] = None,
         lease_duration: float = 15.0,
         retry_period: float = 2.0,
         on_started_leading: Optional[Callable[[], None]] = None,
@@ -55,7 +57,7 @@ class LeaderElector:
         self.clock = clock or Clock()
         self.identity = identity or default_identity()
         self.lease_name = lease_name
-        self.namespace = namespace
+        self.namespace = namespace or os.environ.get("SYSTEM_NAMESPACE", "kube-system")
         self.lease_duration = lease_duration
         self.retry_period = retry_period
         self.on_started_leading = on_started_leading
@@ -80,8 +82,11 @@ class LeaderElector:
         if self._thread is not None:
             self._thread.join(timeout=5)
         if self.is_leader:
-            self._release()
+            # stop this replica's controllers BEFORE handing the lease over —
+            # releasing first would let a standby act while our in-flight
+            # reconciles drain (dual-leader window on every rollout)
             self._demote()
+            self._release()
 
     def _run(self) -> None:
         while not self._stop.is_set():

@@ -39,6 +39,13 @@ from karpenter_core_tpu.utils.clock import Clock
 
 log = logging.getLogger(__name__)
 
+from karpenter_core_tpu.metrics import REGISTRY  # noqa: E402
+
+LEADER_GAUGE = REGISTRY.gauge(
+    "karpenter_leader_election_leader",
+    "1 when this replica holds the leadership lease and runs controllers",
+)
+
 
 @dataclass
 class Operator:
@@ -176,6 +183,7 @@ class Operator:
         return self
 
     def _start_controllers(self) -> None:
+        LEADER_GAUGE.labels().set(1.0)
         for watcher in self._watchers:
             watcher.start()
         for singleton in self._singletons:
@@ -186,6 +194,7 @@ class Operator:
         )
 
     def _stop_controllers(self) -> None:
+        LEADER_GAUGE.labels().set(0.0)
         for singleton in self._singletons:
             singleton.stop()
         for watcher in self._watchers:
@@ -204,8 +213,11 @@ class Operator:
         return self._started
 
     def ready(self) -> bool:
-        """Readiness: this replica is the one acting (leader, or election
-        disabled)."""
-        return self._started and (
-            self.leader_elector is None or self.leader_elector.is_leader
-        )
+        """Readiness: the replica can serve (standbys included — gating
+        readiness on leadership would zero the PDB budget and pull standbys
+        out of Services; leadership is observable via is_leader() and the
+        karpenter_leader_election_leader gauge instead)."""
+        return self._started
+
+    def is_leader(self) -> bool:
+        return self.leader_elector is None or self.leader_elector.is_leader

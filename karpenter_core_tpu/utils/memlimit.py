@@ -3,33 +3,60 @@
 Mirror of /root/reference/pkg/operator/options.go:67-70, which sets Go's
 runtime soft memory limit at 90% of the container limit so GC backpressure
 kicks in before the kubelet OOM-kills the pod.  CPython has no GC pacing
-target, so the equivalent levers are:
+target, and an address-space rlimit misfires badly (virtual mappings — thread
+stacks, allocator arenas, jax runtime reservations — dwarf resident memory),
+so the analog here is:
 
-  - an address-space rlimit at the configured bytes: allocation beyond it
-    raises MemoryError inside the process (fail fast, crash loops visibly)
-    instead of an opaque SIGKILL from the kernel OOM killer
-  - more aggressive cyclic-GC thresholds, the closest analog to leaning on
-    the collector harder as the limit approaches
+  - more aggressive cyclic-GC thresholds up front
+  - an RSS watchdog sampling /proc/self/statm: above 90% of the limit it
+    forces a full collection and logs loudly, giving the operator the same
+    "lean on the collector before the OOM killer" behavior
 """
 
 from __future__ import annotations
 
 import gc
 import logging
+import os
+import threading
 
 log = logging.getLogger(__name__)
 
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+_watchdog_started = False
 
-def apply(limit_bytes: int) -> None:
+
+def rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def apply(limit_bytes: int, poll_seconds: float = 10.0) -> None:
+    global _watchdog_started
     if limit_bytes <= 0:
         return
-    try:
-        import resource
-
-        _, hard = resource.getrlimit(resource.RLIMIT_AS)
-        resource.setrlimit(resource.RLIMIT_AS, (limit_bytes, hard))
-        log.info("memory limit set: %d bytes (RLIMIT_AS soft)", limit_bytes)
-    except (ImportError, ValueError, OSError) as e:
-        log.warning("could not apply memory limit %d: %s", limit_bytes, e)
     gen0, gen1, gen2 = gc.get_threshold()
     gc.set_threshold(max(gen0 // 2, 100), gen1, gen2)
+    if _watchdog_started:
+        return
+    soft = int(limit_bytes * 0.9)
+
+    def watch() -> None:
+        import time
+
+        while True:
+            rss = rss_bytes()
+            if rss > soft:
+                collected = gc.collect()
+                log.warning(
+                    "memory watchdog: rss %d > %d (90%% of %d); gc collected %d",
+                    rss, soft, limit_bytes, collected,
+                )
+            time.sleep(poll_seconds)
+
+    threading.Thread(target=watch, name="memory-watchdog", daemon=True).start()
+    _watchdog_started = True
+    log.info("memory limit watchdog armed at %d bytes (90%% of %d)", soft, limit_bytes)

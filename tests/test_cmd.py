@@ -1,0 +1,35 @@
+"""Deploy entrypoints (karpenter_core_tpu/cmd) and the local bring-up."""
+
+import subprocess
+import sys
+
+
+class TestEntrypoints:
+    def test_load_cloud_provider(self):
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_core_tpu.cmd.operator import load_cloud_provider
+
+        provider = load_cloud_provider(
+            "karpenter_core_tpu.cloudprovider.fake:FakeCloudProvider"
+        )
+        assert isinstance(provider, FakeCloudProvider)
+
+    def test_run_local_check(self):
+        """deploy/run_local.sh --check brings up the operator + solver pair
+        from scratch and probes both — the deploy artifact's contract."""
+        import os
+
+        env = dict(os.environ)
+        env.update(
+            METRICS_PORT="0", HEALTH_PROBE_PORT="18281",
+            KC_SOLVER_LISTEN="127.0.0.1:18980", JAX_PLATFORMS="cpu",
+        )
+        # metrics port must be fixed for curl; pick distinct ephemeral-ish ones
+        env["METRICS_PORT"] = "18280"
+        proc = subprocess.run(
+            ["deploy/run_local.sh", "--check"],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=subprocess.os.path.dirname(subprocess.os.path.dirname(__file__)) or ".",
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "pair is up" in proc.stdout

@@ -106,13 +106,13 @@ class TestOperatorLeaderElection:
         first.start()
         # let the first replica win before the second starts electing
         deadline = time.time() + 5
-        while time.time() < deadline and not first.ready():
+        while time.time() < deadline and not first.is_leader():
             time.sleep(0.02)
         second.start()
         try:
-            assert first.ready()
-            assert not second.ready()  # standby: healthy but not acting
-            assert second.healthy()
+            assert first.is_leader()
+            assert not second.is_leader()  # standby: serving but not acting
+            assert second.healthy() and second.ready()
             kube.create(make_provisioner())
             kube.create(make_pod(requests={"cpu": 1}))
             deadline = time.time() + 10
@@ -131,16 +131,16 @@ class TestOperatorLeaderElection:
         second = self._operator(kube)
         first.start()
         deadline = time.time() + 5
-        while time.time() < deadline and not first.ready():
+        while time.time() < deadline and not first.is_leader():
             time.sleep(0.02)
         second.start()
         try:
-            assert first.ready() and not second.ready()
-            first.stop()  # releases the lease
+            assert first.is_leader() and not second.is_leader()
+            first.stop()  # stops its controllers, then releases the lease
             deadline = time.time() + 10
-            while time.time() < deadline and not second.ready():
+            while time.time() < deadline and not second.is_leader():
                 time.sleep(0.05)
-            assert second.ready(), "standby must take over after release"
+            assert second.is_leader(), "standby must take over after release"
         finally:
             second.stop()
 
