@@ -4,8 +4,9 @@ PYTEST ?= python -m pytest
 
 presubmit: verify test  ## everything a PR needs to pass
 
-verify:  ## static checks: bytecode-compile the tree, build the native library
+verify:  ## static checks: bytecode-compile, lint gate, build the native library
 	python -m compileall -q karpenter_core_tpu tests bench.py __graft_entry__.py
+	python tools/lint.py
 	$(MAKE) -C native
 
 test:  ## the full suite (virtual 8-device CPU mesh)

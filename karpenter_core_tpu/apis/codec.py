@@ -7,7 +7,7 @@ format explicit and versionable.  Only solver-relevant fields travel.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from karpenter_core_tpu.apis.objects import (
     Affinity,

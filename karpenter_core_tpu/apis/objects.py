@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import itertools
 import uuid
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from karpenter_core_tpu.utils import resources as resources_util

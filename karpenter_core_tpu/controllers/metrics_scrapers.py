@@ -9,13 +9,12 @@ gauge and startup-time summary; provisioner limit/usage/usage_pct gauges.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import POD_RUNNING, Pod
 from karpenter_core_tpu.metrics import REGISTRY
 from karpenter_core_tpu.state.cluster import Cluster
-from karpenter_core_tpu.utils import pod as pod_util
 from karpenter_core_tpu.utils import resources as resources_util
 
 SCRAPE_PERIOD = 5.0  # state/controller.go:29-56

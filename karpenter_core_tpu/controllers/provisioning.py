@@ -39,7 +39,6 @@ from karpenter_core_tpu.solver.builder import NoProvisionersError, build_schedul
 from karpenter_core_tpu.solver.scheduler import SchedulerOptions, SchedulingResults
 from karpenter_core_tpu.state.cluster import Cluster
 from karpenter_core_tpu.utils import pod as pod_util
-from karpenter_core_tpu.utils import resources as resources_util
 from karpenter_core_tpu.utils.clock import Clock
 
 log = logging.getLogger(__name__)

@@ -18,7 +18,7 @@ as dedicated axes rather than general masks (models.vocab.STRUCTURAL_KEYS).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -29,7 +29,6 @@ from karpenter_core_tpu.cloudprovider import InstanceType
 from karpenter_core_tpu.models.vocab import Vocabulary, encode_value_set
 from karpenter_core_tpu.scheduling import Requirements, Taints
 from karpenter_core_tpu.solver.machinetemplate import MachineTemplate
-from karpenter_core_tpu.utils import pod as pod_util
 from karpenter_core_tpu.utils import resources as resources_util
 
 UNLIMITED = np.int32(1 << 30)

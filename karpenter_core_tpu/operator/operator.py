@@ -10,7 +10,6 @@ as cloud-provider repos compose the reference.
 from __future__ import annotations
 
 import logging
-import threading
 from dataclasses import dataclass, field
 from typing import List, Optional
 
@@ -157,6 +156,9 @@ class Operator:
 
             memlimit.apply(self.options.memory_limit)
         self.settings_store.start()
+        from karpenter_core_tpu.operator.settingsstore import LoggingConfigWatcher
+
+        self.logging_watcher = LoggingConfigWatcher(self.kube_client).start()
         start_informers(self.cluster, self.kube_client)
         if self.serve_http:
             from karpenter_core_tpu.operator.httpserver import OperatorHTTP

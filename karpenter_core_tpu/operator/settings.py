@@ -7,7 +7,7 @@ the SettingsStore (operator.settingsstore).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict
 
 
@@ -44,7 +44,11 @@ def _parse_duration(value: str) -> float:
     """Parse Go-style durations ('10s', '1m30s', '500ms')."""
     import re
 
-    m = re.fullmatch(r"((?P<h>\d+(\.\d+)?)h)?((?P<m>\d+(\.\d+)?)m)?((?P<s>\d+(\.\d+)?)s)?((?P<ms>\d+(\.\d+)?)ms)?", value.strip())
+    m = re.fullmatch(
+        r"((?P<h>\d+(\.\d+)?)h)?((?P<m>\d+(\.\d+)?)m)?"
+        r"((?P<s>\d+(\.\d+)?)s)?((?P<ms>\d+(\.\d+)?)ms)?",
+        value.strip(),
+    )
     if not m or not any(m.groupdict().values()):
         raise ValueError(f"invalid duration {value!r}")
     parts = m.groupdict()

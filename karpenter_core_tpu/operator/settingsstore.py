@@ -102,3 +102,41 @@ class SettingsStore:
             self._settings = parsed
         for callback in self._watchers:
             callback(parsed)
+
+
+LOGGING_CONFIG_NAME = "config-logging"
+
+
+class LoggingConfigWatcher:
+    """Dynamic log level from the ``config-logging`` ConfigMap — the
+    reference reloads its zap level the same way
+    (/root/reference/pkg/operator/logger.go:31 ChangeLevel watch).  Data key:
+    ``loglevel.controller`` (debug|info|warning|error); invalid values keep
+    the last good level."""
+
+    def __init__(self, kube_client, logger_name: str = "karpenter_core_tpu") -> None:
+        self.kube_client = kube_client
+        self.logger_name = logger_name
+
+    def start(self) -> "LoggingConfigWatcher":
+        existing = self.kube_client.get(ConfigMap, LOGGING_CONFIG_NAME, "karpenter")
+        if existing is not None:
+            self._apply(existing)
+        self.kube_client.watch(ConfigMap, self._on_event, replay=False)
+        return self
+
+    def _on_event(self, event_type: str, cm: ConfigMap) -> None:
+        if cm.metadata.name != LOGGING_CONFIG_NAME or event_type == "DELETED":
+            return
+        self._apply(cm)
+
+    def _apply(self, cm: ConfigMap) -> None:
+        name = cm.data.get("loglevel.controller")
+        if name is None:
+            return  # key absent: keep the current level (incl. LOG_LEVEL env)
+        level = logging.getLevelName(name.upper())
+        if not isinstance(level, int):
+            log.error("invalid log level %r in %s, keeping current", name, LOGGING_CONFIG_NAME)
+            return
+        logging.getLogger(self.logger_name).setLevel(level)
+        log.info("log level set to %s (%s)", name, LOGGING_CONFIG_NAME)

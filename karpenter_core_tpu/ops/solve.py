@@ -560,7 +560,12 @@ def _phase(
     placed_new = jnp.sum(a_new)
 
     seln = is_new[:, None]
-    used = jnp.where(seln, statics.tmpl_daemon[t_star][None, :] + a_new[:, None].astype(jnp.float32) * cls.requests[None, :], used)
+    used = jnp.where(
+        seln,
+        statics.tmpl_daemon[t_star][None, :]
+        + a_new[:, None].astype(jnp.float32) * cls.requests[None, :],
+        used,
+    )
     kmask = jnp.where(seln[..., None], tmpl_merged.mask[t_star][None], kmask)
     kdef = jnp.where(seln, tmpl_merged.defined[t_star][None], kdef)
     kneg = jnp.where(seln, tmpl_merged.negative[t_star][None], kneg)

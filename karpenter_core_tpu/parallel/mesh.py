@@ -17,7 +17,6 @@ replica axis over DCN and everything else rides ICI.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
@@ -34,6 +33,27 @@ def default_mesh(n_devices: Optional[int] = None, axis: str = "replica") -> Mesh
     if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis,))
+
+
+def default_mesh_2d(
+    shape: Optional[Tuple[int, int]] = None, axes: Tuple[str, str] = ("replica", "lane")
+) -> Mesh:
+    """(replica × lane) mesh for crossed studies: Monte-Carlo scenarios on one
+    axis, consolidation prefix lanes on the other.  Both axes are
+    embarrassingly parallel, so on multi-slice hardware lay ``replica`` over
+    DCN and keep ``lane`` within a slice — the only cross-device traffic is
+    the result gather."""
+    devices = jax.devices()
+    if shape is None:
+        n = len(devices)
+        lanes = 1
+        for candidate in range(int(np.sqrt(n)), 0, -1):
+            if n % candidate == 0:
+                lanes = candidate
+                break
+        shape = (n // lanes, lanes)
+    r, l = shape
+    return Mesh(np.array(devices[: r * l]).reshape(r, l), axes)
 
 
 def perturb_spot_availability(
@@ -114,4 +134,88 @@ def monte_carlo_solve(
         "cost_min": float(np.min(cost)),
         "cost_max": float(np.max(cost)),
         "failed_mean": float(np.mean(failed)),
+    }
+
+
+def crossed_consolidation_study(
+    snapshot: EncodedSnapshot,
+    ex_state,
+    ex_static,
+    candidate_rank: np.ndarray,  # i32[E] disruption order, big = not candidate
+    ex_cls_count: np.ndarray,  # i32[C, E] candidate pods per class per node
+    prefix_sizes: np.ndarray,  # i32[S]
+    n_replicas: int,
+    mesh: Optional[Mesh] = None,
+    seed: int = 0,
+    interruption_rate: float = 0.3,
+    n_slots: int = 16,
+) -> dict:
+    """Risk-aware consolidation: every (spot-interruption scenario r,
+    consolidation prefix k) pair is one simulation — close the first-k
+    candidates AND apply replica r's perturbed offering availability, then
+    re-schedule.  The [R, S] grid shards over a 2D (replica × lane) mesh
+    (vmap∘vmap; XLA partitions both batch axes, no collectives until the
+    result gather).
+
+    Returns the failed/new-node grids plus ``safe_prefix``: per replica, the
+    largest prefix whose simulation fully re-schedules — min over replicas is
+    the consolidation depth that is safe under every sampled interruption
+    scenario (the 1D sweep in ops.consolidate answers only the rate-0 row)."""
+    if mesh is None:
+        mesh = default_mesh_2d()
+    n_rep_axis, n_lane_axis = (mesh.shape[name] for name in mesh.axis_names)
+
+    cls, statics_arrays, key_has_bounds = solve_ops.prepare(snapshot)
+    avail_r = perturb_spot_availability(snapshot, n_replicas, seed, interruption_rate)
+    avail_idx = solve_ops.Statics._fields.index("it_avail")
+
+    sizes = jnp.asarray(prefix_sizes, dtype=jnp.int32)
+    pad_s = (-len(prefix_sizes)) % n_lane_axis
+    if pad_s:
+        sizes = jnp.concatenate([sizes, jnp.repeat(sizes[-1:], pad_s)])
+    pad_r = (-n_replicas) % n_rep_axis
+    if pad_r:
+        avail_r = jnp.concatenate([avail_r, avail_r[-1:].repeat(pad_r, axis=0)])
+
+    def one_cell(avail, k):
+        arrays = list(statics_arrays)
+        arrays[avail_idx] = avail
+        subset = candidate_rank_d < k
+        ex = ex_state._replace(open_=ex_state.open_ & ~subset)
+        displaced = jnp.sum(
+            ex_cls_count_d * subset[None, :].astype(jnp.int32), axis=-1
+        )
+        cls_k = cls._replace(count=cls.count + displaced)
+        out = solve_ops.solve_core(
+            cls_k, tuple(arrays), n_slots, key_has_bounds, ex, ex_static,
+            n_passes=snapshot.scan_passes,
+        )
+        return jnp.sum(out.failed), out.state.n_next
+
+    candidate_rank_d = jnp.asarray(candidate_rank)
+    ex_cls_count_d = jnp.asarray(ex_cls_count)
+    grid = jax.vmap(jax.vmap(one_cell, in_axes=(None, 0)), in_axes=(0, None))
+    rep, lane = mesh.axis_names
+    fn = jax.jit(
+        grid,
+        in_shardings=(NamedSharding(mesh, P(rep)), NamedSharding(mesh, P(lane))),
+        out_shardings=(
+            NamedSharding(mesh, P(rep, lane)),
+            NamedSharding(mesh, P(rep, lane)),
+        ),
+    )
+    with mesh:
+        failed, n_new = jax.device_get(fn(avail_r, sizes))
+    failed = np.asarray(failed)[:n_replicas, : len(prefix_sizes)]
+    n_new = np.asarray(n_new)[:n_replicas, : len(prefix_sizes)]
+
+    feasible = failed == 0  # [R, S]
+    sizes_np = np.asarray(prefix_sizes)
+    # rows with no feasible prefix reduce to 0 (sizes are >= 1)
+    safe_prefix = np.max(np.where(feasible, sizes_np[None, :], 0), axis=1)
+    return {
+        "failed": failed,
+        "n_new": n_new,
+        "safe_prefix": safe_prefix,  # per replica
+        "safe_prefix_all": int(safe_prefix.min()) if len(safe_prefix) else 0,
     }

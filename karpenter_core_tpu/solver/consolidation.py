@@ -27,7 +27,6 @@ from karpenter_core_tpu.controllers.deprovisioning import (
 )
 from karpenter_core_tpu.models.snapshot import KernelUnsupported
 from karpenter_core_tpu.ops import consolidate as consolidate_ops
-from karpenter_core_tpu.ops import solve as solve_ops
 from karpenter_core_tpu.scheduling import Requirement, Requirements
 from karpenter_core_tpu.solver.tpu import TPUSolver
 

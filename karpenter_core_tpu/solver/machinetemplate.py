@@ -6,7 +6,7 @@ Mirror of /root/reference/pkg/controllers/provisioning/scheduling/machinetemplat
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import (

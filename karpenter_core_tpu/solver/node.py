@@ -9,7 +9,7 @@ of a real (possibly still-launching) node.
 from __future__ import annotations
 
 import itertools
-from dataclasses import dataclass, field, replace
+from dataclasses import replace
 from typing import List, Optional
 
 from karpenter_core_tpu.apis import labels as labels_api

@@ -2,14 +2,13 @@
 
 Mirror of /root/reference/pkg/controllers/provisioning/scheduling/{topology.go:37-406,
 topologygroup.go:32-253, topologynodefilter.go:28-70}.  Domain counts are kept as
-plain dicts here; the tensorized equivalent (dense [groups, domains] count
-matrices driving argmin/any/zero-mask reductions) lives in
-``karpenter_core_tpu.ops.topology``.
+plain dicts here; the tensorized equivalent (shared hash-deduped groups with
+forward/inverse count planes driving the water-fill and per-node caps) lives
+in ``karpenter_core_tpu.ops.solve`` (TopoCounts and the _class_step phases).
 """
 
 from __future__ import annotations
 
-import math
 from enum import IntEnum
 from typing import Dict, List, Optional, Set
 
@@ -18,7 +17,6 @@ from karpenter_core_tpu.apis.objects import (
     OP_DOES_NOT_EXIST,
     OP_EXISTS,
     OP_IN,
-    DO_NOT_SCHEDULE,
     LabelSelector,
     Node,
     Pod,

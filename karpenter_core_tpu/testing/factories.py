@@ -19,7 +19,6 @@ from karpenter_core_tpu.apis.objects import (
     NodeSelector,
     NodeSelectorRequirement,
     NodeSelectorTerm,
-    LabelSelector,
     Node,
     NodeSpec,
     NodeStatus,

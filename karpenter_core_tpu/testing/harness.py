@@ -10,7 +10,7 @@ emulates kube-scheduler by binding pods to their nominated nodes
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import Node, NodeCondition, Pod

@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 from karpenter_core_tpu.apis.objects import Node, Pod
 from karpenter_core_tpu.utils import pod as pod_util

@@ -1,7 +1,6 @@
 """Deploy entrypoints (karpenter_core_tpu/cmd) and the local bring-up."""
 
 import subprocess
-import sys
 
 
 class TestEntrypoints:

@@ -6,7 +6,6 @@ binary search, TTL validation, emptiness, expiration, drift, PDB and
 do-not-evict blocking, spot rules.
 """
 
-import pytest
 
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import (

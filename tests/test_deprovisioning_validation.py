@@ -8,7 +8,7 @@ timeout rollback, waitForDeletion, and the eviction queue's retry behavior.
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import OP_IN, NodeSelectorRequirement
 from karpenter_core_tpu.cloudprovider import fake as fake_cp
-from karpenter_core_tpu.controllers.deprovisioning import Action, Command, Result
+from karpenter_core_tpu.controllers.deprovisioning import Result
 from karpenter_core_tpu.testing import make_pod, make_provisioner
 from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
 

@@ -3,7 +3,6 @@
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import (
     LabelSelector,
-    NodeCondition,
     ObjectMeta,
     PodDisruptionBudget,
     PodDisruptionBudgetSpec,

@@ -61,3 +61,87 @@ class TestMonteCarloMesh:
         import __graft_entry__ as graft
 
         graft._dryrun_multichip_subprocess(2)
+
+
+class TestCrossedStudy:
+    """2D (replica x lane) mesh: Monte-Carlo scenarios x consolidation
+    prefixes in one sharded grid (parallel/mesh.py crossed_consolidation_study)."""
+
+    def _existing(self, solver, snapshot, n_nodes=3):
+        from karpenter_core_tpu.ops import solve as solve_ops
+
+        n_classes = len(snapshot.classes)
+        ex_state = solve_ops.empty_existing_state(
+            len(snapshot.resources), snapshot.vocab.n_keys, snapshot.vocab.width,
+            len(snapshot.zones), len(snapshot.capacity_types),
+        )
+        ex_static = solve_ops.empty_existing_static(
+            len(snapshot.resources), n_classes, len(snapshot.groups) + 1
+        )
+        return ex_state, ex_static
+
+    def test_grid_shape_and_sharding(self):
+        solver, pods = build()
+        snapshot = solver.encode(pods)
+        mesh = mesh_ops.default_mesh_2d((4, 2))
+        assert mesh.shape == {"replica": 4, "lane": 2}
+        ex_state, ex_static = self._existing(solver, snapshot)
+        n_classes = len(snapshot.classes)
+        out = mesh_ops.crossed_consolidation_study(
+            snapshot, ex_state, ex_static,
+            candidate_rank=np.full(1, 1 << 30, dtype=np.int32),
+            ex_cls_count=np.zeros((n_classes, 1), dtype=np.int32),
+            prefix_sizes=np.arange(1, 6, dtype=np.int32),  # 5 lanes, pads to 6
+            n_replicas=7,  # pads to 8
+            mesh=mesh,
+            interruption_rate=0.0,
+        )
+        assert out["failed"].shape == (7, 5)
+        assert out["n_new"].shape == (7, 5)
+        assert out["safe_prefix"].shape == (7,)
+
+    def test_rate_zero_row_matches_1d_sweep(self):
+        from karpenter_core_tpu.ops import consolidate as consolidate_ops
+
+        solver, pods = build()
+        snapshot = solver.encode(pods)
+        ex_state, ex_static = self._existing(solver, snapshot)
+        n_classes = len(snapshot.classes)
+        rank = np.full(1, 1 << 30, dtype=np.int32)
+        counts = np.zeros((n_classes, 1), dtype=np.int32)
+        sizes = np.arange(1, 5, dtype=np.int32)
+
+        sweep = consolidate_ops.run_sweep(
+            snapshot, ex_state, ex_static, rank, counts, sizes
+        )
+        out = mesh_ops.crossed_consolidation_study(
+            snapshot, ex_state, ex_static, rank, counts, sizes,
+            n_replicas=4, mesh=mesh_ops.default_mesh_2d((2, 2)),
+            interruption_rate=0.0,
+        )
+        # interruption rate 0: every replica row equals the plain 1D sweep
+        for r in range(4):
+            assert (out["failed"][r] == np.asarray(sweep.failed)).all()
+
+    def test_interruptions_shrink_safe_prefix(self):
+        # with heavy interruption some scenarios fail to re-schedule, so the
+        # risk-aware safe prefix can only be <= the calm one
+        solver, pods = build(n_pods=30, n_types=4)
+        snapshot = solver.encode(pods)
+        ex_state, ex_static = self._existing(solver, snapshot)
+        n_classes = len(snapshot.classes)
+        rank = np.full(1, 1 << 30, dtype=np.int32)
+        counts = np.zeros((n_classes, 1), dtype=np.int32)
+        sizes = np.arange(1, 5, dtype=np.int32)
+        calm = mesh_ops.crossed_consolidation_study(
+            snapshot, ex_state, ex_static, rank, counts, sizes,
+            n_replicas=8, mesh=mesh_ops.default_mesh_2d((4, 2)),
+            interruption_rate=0.0, seed=3,
+        )
+        stormy = mesh_ops.crossed_consolidation_study(
+            snapshot, ex_state, ex_static, rank, counts, sizes,
+            n_replicas=8, mesh=mesh_ops.default_mesh_2d((4, 2)),
+            interruption_rate=0.95, seed=3,
+        )
+        assert stormy["safe_prefix_all"] <= calm["safe_prefix_all"]
+        assert (stormy["failed"] >= calm["failed"]).all()

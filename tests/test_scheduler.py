@@ -6,15 +6,12 @@ topology_test.go, instance_selection_test.go}) — resources, node affinity,
 taints, host ports, topology spread, pod (anti-)affinity, relaxation, limits.
 """
 
-import pytest
 
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import (
-    OP_DOES_NOT_EXIST,
     OP_EXISTS,
     OP_GT,
     OP_IN,
-    OP_LT,
     OP_NOT_IN,
     LabelSelector,
     NodeSelectorRequirement,

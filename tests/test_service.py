@@ -222,6 +222,74 @@ class TestSnapshotChannel:
         assert excinfo.value.code() == grpc.StatusCode.FAILED_PRECONDITION
 
 
+class TestWireSchema:
+    """Golden test pinning service/SCHEMA.md to the code: the wire contract
+    is stable within karpenter.v1 — field renames must fail here first."""
+
+    def test_pod_wire_fields(self):
+        pod = make_pod(
+            labels={"a": "b"},
+            requests={"cpu": 1},
+            host_ports=[80],
+            pvcs=["claim-1"],
+            topology_spread=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                    label_selector=LabelSelector(match_labels={"a": "b"}),
+                )
+            ],
+        )
+        d = codec.pod_to_dict(pod)
+        assert set(d) == {"metadata", "spec", "status"}
+        assert set(d["metadata"]) == {
+            "name", "namespace", "uid", "labels", "annotations", "creationTimestamp",
+        }
+        assert set(d["spec"]) == {
+            "nodeSelector", "nodeName", "tolerations", "containers",
+            "topologySpreadConstraints", "priority", "pvcs",
+        }
+        container = d["spec"]["containers"][0]
+        assert set(container) == {"requests", "limits", "hostPorts"}
+        assert set(container["hostPorts"][0]) == {"port", "protocol", "hostIP"}
+        spread = d["spec"]["topologySpreadConstraints"][0]
+        assert set(spread) == {"maxSkew", "topologyKey", "whenUnsatisfiable", "labelSelector"}
+        assert d["spec"]["pvcs"] == ["claim-1"]
+
+    def test_service_method_names(self):
+        from karpenter_core_tpu.service.snapshot_channel import SERVICE, SnapshotSolverService
+
+        assert SERVICE == "karpenter.v1.SnapshotSolver"
+        service = SnapshotSolverService(FakeCloudProvider())
+        for method in ("Solve", "SolveClasses", "Health"):
+
+            class _Details:
+                pass
+
+            details = _Details()
+            details.method = f"/{SERVICE}/{method}"
+            assert service.service(details) is not None, method
+
+    def test_solve_response_fields(self):
+        from karpenter_core_tpu.service.snapshot_channel import (
+            SnapshotSolverClient,
+            serve,
+        )
+
+        server, port = serve(FakeCloudProvider())
+        client = SnapshotSolverClient(f"127.0.0.1:{port}")
+        try:
+            response = client.solve(make_pods(2, requests={"cpu": 1}), [make_provisioner()])
+            assert set(response) == {"newNodes", "existingAssignments", "failedPodIndices"}
+            node = response["newNodes"][0]
+            assert set(node) == {
+                "provisioner", "instanceTypes", "zones", "requests", "podIndices",
+            }
+        finally:
+            client.close()
+            server.stop(0)
+
+
 class TestSettingsStore:
     def test_live_update(self):
         from karpenter_core_tpu.operator.kubeclient import KubeClient
@@ -230,7 +298,6 @@ class TestSettingsStore:
             SETTINGS_NAME,
             SettingsStore,
         )
-        from karpenter_core_tpu.apis.objects import ObjectMeta
 
         kube = KubeClient()
         store = SettingsStore(kube).start()
@@ -268,3 +335,34 @@ class TestTPUConsolidationInController:
         assert result == Result.SUCCESS
         # consolidated: fewer nodes than before
         assert len(env.kube.list_nodes()) == 1
+
+
+class TestLoggingConfig:
+    def test_dynamic_log_level(self):
+        import logging
+
+        from karpenter_core_tpu.apis.objects import ObjectMeta
+        from karpenter_core_tpu.operator.kubeclient import KubeClient
+        from karpenter_core_tpu.operator.settingsstore import (
+            ConfigMap,
+            LoggingConfigWatcher,
+        )
+
+        kube = KubeClient()
+        logger = logging.getLogger("kc-test-dynlog")
+        logger.setLevel(logging.INFO)
+        LoggingConfigWatcher(kube, logger_name="kc-test-dynlog").start()
+        kube.create(
+            ConfigMap(
+                metadata=ObjectMeta(name="config-logging", namespace="karpenter"),
+                data={"loglevel.controller": "debug"},
+            )
+        )
+        assert logger.level == logging.DEBUG
+        cm = kube.get(ConfigMap, "config-logging", "karpenter")
+        cm.data["loglevel.controller"] = "bogus"
+        kube.update(cm)
+        assert logger.level == logging.DEBUG  # invalid keeps last good
+        cm.data = {"unrelated": "x"}
+        kube.update(cm)
+        assert logger.level == logging.DEBUG  # absent key keeps current

@@ -1,6 +1,5 @@
 """TPU consolidation sweep vs the host consolidation logic."""
 
-import numpy as np
 
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import OP_IN, NodeSelectorRequirement

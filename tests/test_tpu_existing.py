@@ -1,11 +1,9 @@
 """Parity tests: kernel existing-node placement vs the host ExistingNode path."""
 
-import numpy as np
 
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.cloudprovider import fake as fake_cp
 from karpenter_core_tpu.solver.tpu import TPUSolver
-from karpenter_core_tpu.state.cluster import Cluster, StateNode
 from karpenter_core_tpu.testing import make_node, make_pod, make_pods, make_provisioner
 from karpenter_core_tpu.testing.harness import make_environment
 

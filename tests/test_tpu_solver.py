@@ -7,7 +7,6 @@ which pod) is allowed to differ, exactly as the reference's own unstable sort
 makes pod placement nondeterministic (scheduler.go:183).
 """
 
-import numpy as np
 import pytest
 
 from karpenter_core_tpu.apis import labels as labels_api

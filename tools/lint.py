@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Zero-dependency lint gate for `make verify` (the role golangci-lint plays
+in the reference presubmit, /root/reference/Makefile:16-24; no third-party
+linter is vendorable in this environment, so the checks are implemented on
+the stdlib ast).
+
+Checks:
+  unused-import       imported name never referenced (module `__init__.py`
+                      re-export files and names in __all__ are exempt)
+  bare-except         `except:` with no exception class
+  mutable-default     list/dict/set literals as parameter defaults
+  f-string-no-field   f-string without any substitution
+  tabs / trailing-ws  formatting gate
+  long-line           > 120 characters (comments/strings included)
+
+Exit code 1 on any finding; print file:line: rule: detail.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+MAX_LINE = 120
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.imports: dict[str, tuple[int, str]] = {}  # name -> (line, module)
+        self.used: set[str] = set()
+        self.findings: list[tuple[int, str, str]] = []
+        self.dunder_all: set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.imports[name] = (node.lineno, alias.name)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            self.imports[name] = (node.lineno, f"{node.module}.{alias.name}")
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            if isinstance(target, ast.Name) and target.id == "__all__":
+                for element in ast.walk(node.value):
+                    if isinstance(element, ast.Constant) and isinstance(element.value, str):
+                        self.dunder_all.add(element.value)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.findings.append((node.lineno, "bare-except", "use `except Exception:`"))
+        self.generic_visit(node)
+
+    def _check_defaults(self, node) -> None:
+        for default in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self.findings.append(
+                    (default.lineno, "mutable-default", "use None + in-body init")
+                )
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.findings.append((node.lineno, "f-string-no-field", "drop the f prefix"))
+        # visit interpolated expressions but NOT format specs (they are inner
+        # JoinedStrs with no fields and would false-positive)
+        for value in node.values:
+            if isinstance(value, ast.FormattedValue):
+                self.visit(value.value)
+
+
+def lint_file(path: Path) -> list[str]:
+    source = path.read_text()
+    out: list[str] = []
+    for i, line in enumerate(source.splitlines(), 1):
+        if "\t" in line:
+            out.append(f"{path}:{i}: tabs: use spaces")
+        if line != line.rstrip():
+            out.append(f"{path}:{i}: trailing-ws: trailing whitespace")
+        if len(line) > MAX_LINE:
+            out.append(f"{path}:{i}: long-line: {len(line)} > {MAX_LINE}")
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return out + [f"{path}:{e.lineno}: syntax-error: {e.msg}"]
+    walker = _Walker()
+    walker.visit(tree)
+    # string-annotation references ("Optional[Clock]") count as uses —
+    # identifier-boundary matches only, or docstring prose would exempt
+    # short names like np/os from the check
+    import re as _re
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            for name in walker.imports:
+                if _re.search(rf"\b{_re.escape(name)}\b", node.value):
+                    walker.used.add(name)
+    is_reexport = path.name == "__init__.py"
+    if not is_reexport:
+        for name, (lineno, module) in sorted(walker.imports.items()):
+            if name not in walker.used and name not in walker.dunder_all:
+                out.append(f"{path}:{lineno}: unused-import: {module} as {name}")
+    for lineno, rule, detail in walker.findings:
+        out.append(f"{path}:{lineno}: {rule}: {detail}")
+    return out
+
+
+def main(argv: list[str]) -> int:
+    roots = [Path(p) for p in argv] or [
+        Path("karpenter_core_tpu"), Path("tests"), Path("tools"),
+        Path("bench.py"), Path("__graft_entry__.py"),
+    ]
+    findings: list[str] = []
+    for root in roots:
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for path in files:
+            findings.extend(lint_file(path))
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(f"\n{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
