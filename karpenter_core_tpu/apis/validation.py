@@ -66,6 +66,10 @@ def validate_requirement(requirement: NodeSelectorRequirement) -> List[str]:
     """ValidateRequirement (provisioner_validation.go:274-307)."""
     errs: List[str] = []
     key = labels_api.NORMALIZED_LABELS.get(requirement.key, requirement.key)
+    # the provisioner-name label is managed by the controller and may not be
+    # constrained by users (provisioner_validation.go:178)
+    if key == labels_api.PROVISIONER_NAME_LABEL_KEY:
+        errs.append(f"key {key} is restricted")
     if requirement.operator not in SUPPORTED_NODE_SELECTOR_OPS:
         errs.append(
             f"key {key} has an unsupported operator {requirement.operator} "

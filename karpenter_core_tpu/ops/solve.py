@@ -724,9 +724,39 @@ def _class_step(
         placed_total = placed_total + placed
 
     # -- zone spread phases (one committed zone per phase) --------------------
+    # zones some template can actually serve for this class (or an open
+    # existing node sits in) — used by spread quotas and the affinity
+    # bootstrap below
+    tmpl_offers = jnp.einsum(
+        "ti,izc,tz,tc->z",
+        statics.tmpl_it.astype(jnp.bfloat16),
+        (statics.it_avail & cls.it[:, None, None]).astype(jnp.bfloat16),
+        statics.tmpl_zone.astype(jnp.bfloat16),
+        (statics.tmpl_ct & cls.ct[None, :]).astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ) > 0.5  # [Z]
+    ex_offers = jnp.any(ex.open_[:, None] & ex.zone, axis=0)  # [Z]
+    fillable = tmpl_offers | ex_offers
+
     counts_zs = topo.zone_fwd[g_zs]  # [Z]
     member_zs = member_row[g_zs]
-    quotas = jnp.where(member_zs, _water_fill(counts_zs, allowed_zone, m), 0)
+    # the reference's per-pod skew check measures against the min over ALL the
+    # pod's domains, including zones no template can serve — those stay at
+    # their current count forever, capping every reachable zone at
+    # min_unreachable + skew (topology_test.go:124-162 "existing pod" case).
+    # The water-fill only fills reachable zones, with that cap applied.
+    unreachable = allowed_zone & ~fillable
+    min_unreachable = jnp.min(
+        jnp.where(unreachable, counts_zs, jnp.int32(1 << 30))
+    )
+    zone_cap = jnp.clip(
+        min_unreachable + statics.grp_skew[g_zs] - counts_zs, 0, UNLIMITED
+    )
+    quotas = jnp.where(
+        member_zs,
+        jnp.minimum(_water_fill(counts_zs, allowed_zone & fillable, m), zone_cap),
+        0,
+    )
     for z in range(n_zones):
         restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
         q = jnp.where(has_zs, quotas[z], 0)
@@ -757,16 +787,7 @@ def _class_step(
     # The bootstrap must be capacity-aware (the host's per-node bootstrap only
     # lands where a node is viable): restrict to zones some template offers
     # for this class, or where an open existing node sits
-    tmpl_offers = jnp.einsum(
-        "ti,izc,tz,tc->z",
-        statics.tmpl_it.astype(jnp.bfloat16),
-        (statics.it_avail & cls.it[:, None, None]).astype(jnp.bfloat16),
-        statics.tmpl_zone.astype(jnp.bfloat16),
-        (statics.tmpl_ct & cls.ct[None, :]).astype(jnp.bfloat16),
-        preferred_element_type=jnp.float32,
-    ) > 0.5  # [Z]
-    ex_offers = jnp.any(ex.open_[:, None] & ex.zone, axis=0)  # [Z]
-    bootstrap_allowed = allowed_zone & (tmpl_offers | ex_offers)
+    bootstrap_allowed = allowed_zone & fillable
     nonzero_zones = allowed_zone & (topo.zone_fwd[g_zaf] > 0)
     bootstrap_zone = (
         jnp.zeros(n_zones, dtype=bool)

@@ -17,6 +17,7 @@ replica axis over DCN and everything else rides ICI.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -137,6 +138,43 @@ def monte_carlo_solve(
     }
 
 
+@functools.lru_cache(maxsize=16)
+def _crossed_grid_fn(mesh, key_has_bounds, n_slots: int, n_passes: int, avail_idx: int):
+    """Cached jitted crossed grid — a fresh closure per call would defeat
+    JAX's compile cache (keyed on callable identity) and recompile the whole
+    vmap-of-vmap solve every study (same pattern as
+    ops.consolidate._sharded_sweep_fn)."""
+    rep, lane = mesh.axis_names
+
+    def one_cell(avail, k, cls, statics_arrays, ex_state, ex_static, rank, counts):
+        arrays = list(statics_arrays)
+        arrays[avail_idx] = avail
+        subset = rank < k
+        ex = ex_state._replace(open_=ex_state.open_ & ~subset)
+        displaced = jnp.sum(counts * subset[None, :].astype(jnp.int32), axis=-1)
+        cls_k = cls._replace(count=cls.count + displaced)
+        out = solve_ops.solve_core(
+            cls_k, tuple(arrays), n_slots, key_has_bounds, ex, ex_static,
+            n_passes=n_passes,
+        )
+        return jnp.sum(out.failed), out.state.n_next
+
+    batch_none = (None,) * 6
+    grid = jax.vmap(
+        jax.vmap(one_cell, in_axes=(None, 0) + batch_none),
+        in_axes=(0, None) + batch_none,
+    )
+    return jax.jit(
+        grid,
+        in_shardings=(NamedSharding(mesh, P(rep)), NamedSharding(mesh, P(lane)))
+        + (None,) * 6,
+        out_shardings=(
+            NamedSharding(mesh, P(rep, lane)),
+            NamedSharding(mesh, P(rep, lane)),
+        ),
+    )
+
+
 def crossed_consolidation_study(
     snapshot: EncodedSnapshot,
     ex_state,
@@ -177,35 +215,16 @@ def crossed_consolidation_study(
     if pad_r:
         avail_r = jnp.concatenate([avail_r, avail_r[-1:].repeat(pad_r, axis=0)])
 
-    def one_cell(avail, k):
-        arrays = list(statics_arrays)
-        arrays[avail_idx] = avail
-        subset = candidate_rank_d < k
-        ex = ex_state._replace(open_=ex_state.open_ & ~subset)
-        displaced = jnp.sum(
-            ex_cls_count_d * subset[None, :].astype(jnp.int32), axis=-1
-        )
-        cls_k = cls._replace(count=cls.count + displaced)
-        out = solve_ops.solve_core(
-            cls_k, tuple(arrays), n_slots, key_has_bounds, ex, ex_static,
-            n_passes=snapshot.scan_passes,
-        )
-        return jnp.sum(out.failed), out.state.n_next
-
-    candidate_rank_d = jnp.asarray(candidate_rank)
-    ex_cls_count_d = jnp.asarray(ex_cls_count)
-    grid = jax.vmap(jax.vmap(one_cell, in_axes=(None, 0)), in_axes=(0, None))
-    rep, lane = mesh.axis_names
-    fn = jax.jit(
-        grid,
-        in_shardings=(NamedSharding(mesh, P(rep)), NamedSharding(mesh, P(lane))),
-        out_shardings=(
-            NamedSharding(mesh, P(rep, lane)),
-            NamedSharding(mesh, P(rep, lane)),
-        ),
+    fn = _crossed_grid_fn(
+        mesh, key_has_bounds, n_slots, snapshot.scan_passes, avail_idx
     )
     with mesh:
-        failed, n_new = jax.device_get(fn(avail_r, sizes))
+        failed, n_new = jax.device_get(
+            fn(
+                avail_r, sizes, cls, statics_arrays, ex_state, ex_static,
+                jnp.asarray(candidate_rank), jnp.asarray(ex_cls_count),
+            )
+        )
     failed = np.asarray(failed)[:n_replicas, : len(prefix_sizes)]
     n_new = np.asarray(n_new)[:n_replicas, : len(prefix_sizes)]
 

@@ -1,0 +1,346 @@
+"""Deprovisioning scenario matrix, ported from the reference's largest suite
+(/root/reference/pkg/controllers/deprovisioning/suite_test.go): drift
+delete/replace, expiration ordering, disruption-cost ranking, spot/on-demand
+replacement rules, PDB and do-not-evict interplay, multi-node merges, and
+pending-pod interactions.  Complements tests/test_deprovisioning.py (the
+core flows) with the suite's edge matrix.
+"""
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_IN,
+    LabelSelector,
+    NodeSelectorRequirement,
+    ObjectMeta,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodDisruptionBudgetStatus,
+)
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.controllers.deprovisioning import Result
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
+
+CT = labels_api.LABEL_CAPACITY_TYPE
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+
+
+def env_with(provisioner=None, instance_types=None):
+    env = make_environment(instance_types=instance_types)
+    env.kube.create(provisioner or make_provisioner(consolidation_enabled=True))
+    return env
+
+
+def provision_and_ready(env, *pods):
+    result = expect_provisioned(env, *pods)
+    env.make_all_nodes_ready()
+    env.clock.step(21)  # step past the nomination window
+    return result
+
+
+class TestDriftMatrix:
+    """suite_test.go:149-473."""
+
+    def _drift_env(self):
+        from karpenter_core_tpu.operator.settings import Settings
+
+        env = make_environment(settings=Settings(drift_enabled=True))
+        env.kube.create(make_provisioner())
+        return env
+
+    def test_drift_disabled_flag_ignores_drifted(self):
+        # suite_test.go:149
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        env.provider.drifted = True
+        result, _ = env.deprovisioning.reconcile()
+        assert len(env.kube.list_nodes()) == 1  # feature-gated off: no action
+
+    def test_can_delete_drifted_empty_node(self):
+        # suite_test.go:243 — drifted node with no pods is deleted outright
+        env = self._drift_env()
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        env.provider.drifted = True
+        env.node_lifecycle.reconcile_all()  # stamps the drifted annotation
+        env.deprovisioning.reconcile()
+        assert len(env.kube.list_nodes()) == 0
+
+    def test_can_replace_drifted_node(self):
+        # suite_test.go:277 — drifted node with pods is replaced 1:1
+        env = self._drift_env()
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        before = {n.name for n in env.kube.list_nodes()}
+        env.provider.drifted = True
+        env.node_lifecycle.reconcile_all()  # stamps the drifted annotation
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.SUCCESS
+        after = {n.name for n in env.kube.list_nodes()}
+        assert after and after != before  # old gone, replacement up
+
+    def test_deletes_one_drifted_node_at_a_time(self):
+        # suite_test.go:424 — cpu 9 pods force one node each (max type 16)
+        env = self._drift_env()
+        pods = [make_pod(requests={"cpu": 9}) for _ in range(2)]
+        provision_and_ready(env, *pods)
+        assert len(env.kube.list_nodes()) == 2
+        for pod in pods:
+            env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        env.provider.drifted = True
+        env.node_lifecycle.reconcile_all()
+        env.deprovisioning.reconcile()
+        # one action per reconcile (the reference's serial drift handling)
+        assert len(env.kube.list_nodes()) == 1
+
+
+class TestExpirationMatrix:
+    """suite_test.go:474-819."""
+
+    def test_no_ttl_never_expires(self):
+        env = make_environment()
+        env.kube.create(make_provisioner())  # no ttl_seconds_until_expired
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        env.clock.step(100_000)
+        env.deprovisioning.reconcile()
+        assert len(env.kube.list_nodes()) == 1
+
+    def test_expires_most_expired_first(self):
+        # suite_test.go:536 — with two expired nodes, the older goes first
+        env = make_environment()
+        env.kube.create(make_provisioner(ttl_seconds_until_expired=60))
+        first = make_pod(requests={"cpu": 3})
+        provision_and_ready(env, first)
+        old_node = env.kube.list_nodes()[0].name
+        env.clock.step(30)
+        second = make_pod(requests={"cpu": 3})
+        provision_and_ready(env, second)
+        env.clock.step(45)  # first node 96s old (expired), second 66s (expired)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.SUCCESS
+        names = {n.name for n in env.kube.list_nodes()}
+        assert old_node not in names
+
+    def test_replacement_for_expired_node_with_pods(self):
+        # suite_test.go:580 — expiration replaces, never strands pods
+        env = make_environment()
+        env.kube.create(make_provisioner(ttl_seconds_until_expired=30))
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        old = {n.name for n in env.kube.list_nodes()}
+        env.clock.step(60)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.SUCCESS
+        new = {n.name for n in env.kube.list_nodes()}
+        assert new and not (new & old)
+
+
+class TestDisruptionCostOrdering:
+    """suite_test.go:820-873 — candidate ordering by eviction cost."""
+
+    def _cost(self, env, pod):
+        from karpenter_core_tpu.controllers.deprovisioning import get_pod_eviction_cost
+
+        return get_pod_eviction_cost(pod)
+
+    def test_deletion_cost_annotation_raises_cost(self):
+        env = env_with()
+        cheap = make_pod(deletion_cost=-100)
+        default = make_pod()
+        dear = make_pod(deletion_cost=100)
+        assert self._cost(env, cheap) < self._cost(env, default) < self._cost(env, dear)
+
+    def test_priority_raises_cost(self):
+        env = env_with()
+        low = make_pod(priority=-10)
+        default = make_pod()
+        high = make_pod(priority=100000)
+        assert self._cost(env, low) < self._cost(env, default) < self._cost(env, high)
+
+    def test_monotone_in_deletion_cost(self):
+        env = env_with()
+        costs = [self._cost(env, make_pod(deletion_cost=c)) for c in (-50, 0, 50, 500)]
+        assert costs == sorted(costs)
+
+
+class TestReplacementPriceRules:
+    """suite_test.go:1155-1345 — spot/on-demand replacement economics."""
+
+    def test_wont_replace_when_replacement_not_cheaper(self):
+        # a single-type catalog: any replacement costs the same -> no action
+        env = make_environment(instance_types=fake_cp.instance_types(1))
+        env.kube.create(
+            make_provisioner(
+                consolidation_enabled=True,
+                requirements=[
+                    NodeSelectorRequirement(CT, OP_IN, [labels_api.CAPACITY_TYPE_ON_DEMAND])
+                ],
+            )
+        )
+        pod = make_pod(requests={"cpu": "500m"})
+        provision_and_ready(env, pod)
+        nodes_before = {n.name for n in env.kube.list_nodes()}
+        assert nodes_before
+        env.deprovisioning.reconcile()
+        assert {n.name for n in env.kube.list_nodes()} == nodes_before
+
+    def test_spot_node_not_replaced_with_spot(self):
+        # consolidation.go:244-258 — spot->spot replacement is forbidden
+        env = env_with(instance_types=fake_cp.instance_types(5))
+        big = make_pod(requests={"cpu": 4})
+        small = make_pod(requests={"cpu": "500m"})
+        provision_and_ready(env, big, small)
+        env.kube.delete(env.kube.get_pod(big.namespace, big.name), force=True)
+        nodes_before = {n.name for n in env.kube.list_nodes()}
+        env.deprovisioning.reconcile()
+        # default provisioner allows spot: the node IS spot, so replace is
+        # blocked; delete is impossible (a pod lives there) -> no change
+        assert {n.name for n in env.kube.list_nodes()} == nodes_before
+
+
+class TestPDBMatrix:
+    """suite_test.go:930-1074, 1497-1589."""
+
+    def _pdb(self, selector_labels, disruptions_allowed, namespace="default"):
+        return PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace=namespace),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector(match_labels=dict(selector_labels))
+            ),
+            status=PodDisruptionBudgetStatus(disruptions_allowed=disruptions_allowed),
+        )
+
+    def test_pdb_zero_blocks_delete(self):
+        env = env_with()
+        pod = make_pod(labels={"app": "guarded"}, requests={"cpu": 3})
+        extra = make_pod(requests={"cpu": 3})
+        provision_and_ready(env, pod, extra)
+        env.kube.create(self._pdb({"app": "guarded"}, 0))
+        env.kube.delete(env.kube.get_pod(extra.namespace, extra.name), force=True)
+        nodes = {n.name for n in env.kube.list_nodes()}
+        env.deprovisioning.reconcile()
+        # the guarded pod's node survives; the emptied one is consolidated
+        guarded_node = env.kube.get_pod(pod.namespace, pod.name).spec.node_name
+        assert guarded_node in {n.name for n in env.kube.list_nodes()}
+
+    def test_pdb_different_namespace_does_not_block(self):
+        # suite_test.go:1004 — PDB selectors are namespace-scoped
+        env = env_with()
+        pod = make_pod(labels={"app": "guarded"}, requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        env.kube.create(self._pdb({"app": "guarded"}, 0, namespace="other"))
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        result, _ = env.deprovisioning.reconcile()
+        assert len(env.kube.list_nodes()) == 0  # nothing actually guards it
+
+    def test_pdb_allows_when_budget_positive(self):
+        env = env_with()
+        pod = make_pod(labels={"app": "guarded"}, requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        env.kube.create(self._pdb({"app": "guarded"}, 1))
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        env.deprovisioning.reconcile()
+        assert len(env.kube.list_nodes()) == 0
+
+
+class TestConsolidationInterplay:
+    """suite_test.go:2142-2554 — pending pods and in-flight interactions."""
+
+    def test_considers_bound_pods_when_consolidating(self):
+        # suite_test.go:2142 adapted — a node with a bound workload must not
+        # be deleted even when the rest of its capacity is idle and a pending
+        # pod is waiting for a new node
+        env = env_with(instance_types=fake_cp.instance_types(5))
+        small = make_pod(requests={"cpu": "200m"})
+        provision_and_ready(env, small)
+        env.kube.create(make_pod(requests={"cpu": 3}))  # pending
+        nodes_before = {n.name for n in env.kube.list_nodes()}
+        env.deprovisioning.reconcile()
+        assert nodes_before <= {n.name for n in env.kube.list_nodes()}
+
+    def test_merge_three_nodes_into_fewer(self):
+        # suite_test.go:2555 — multi-node consolidation merges small nodes
+        env = make_environment(instance_types=fake_cp.instance_types(5))
+        env.kube.create(
+            make_provisioner(
+                consolidation_enabled=True,
+                requirements=[
+                    NodeSelectorRequirement(CT, OP_IN, [labels_api.CAPACITY_TYPE_ON_DEMAND])
+                ],
+            )
+        )
+        bigs, smalls = [], []
+        for _ in range(3):
+            big = make_pod(requests={"cpu": 3})
+            small = make_pod(requests={"cpu": "200m"})
+            bigs.append(big)
+            smalls.append(small)
+            provision_and_ready(env, big, small)
+        assert len(env.kube.list_nodes()) == 3
+        for big in bigs:
+            env.kube.delete(env.kube.get_pod(big.namespace, big.name), force=True)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.SUCCESS
+        assert len(env.kube.list_nodes()) < 3
+
+    def test_wont_merge_identical_full_nodes(self):
+        # suite_test.go:2644 — two well-utilized same-type nodes stay
+        env = make_environment(instance_types=fake_cp.instance_types(1))
+        env.kube.create(
+            make_provisioner(
+                consolidation_enabled=True,
+                requirements=[
+                    NodeSelectorRequirement(CT, OP_IN, [labels_api.CAPACITY_TYPE_ON_DEMAND])
+                ],
+            )
+        )
+        for _ in range(2):
+            provision_and_ready(env, make_pod(requests={"cpu": "800m"}))
+        assert len(env.kube.list_nodes()) == 2
+        env.deprovisioning.reconcile()
+        assert len(env.kube.list_nodes()) == 2
+
+    def test_nominated_replacement_not_consolidated(self):
+        # suite_test.go:2467 — nodes launched for deleting-node pods are
+        # nominated and must not be immediate candidates
+        env = env_with()
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        node = env.kube.list_nodes()[0]
+        env.cluster.nominate_node_for_pod(node.name)
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        env.deprovisioning.reconcile()
+        assert len(env.kube.list_nodes()) == 1  # nomination shields it
+
+    def test_anti_affinity_not_violated_by_delete(self):
+        # suite_test.go:1936 — deleting a node must not force two anti pods
+        # onto one host
+        from karpenter_core_tpu.apis.objects import PodAffinityTerm
+
+        env = env_with(instance_types=fake_cp.instance_types(5))
+        anti = [
+            make_pod(
+                labels={"app": "db"},
+                requests={"cpu": "200m"},
+                pod_anti_affinity=[
+                    PodAffinityTerm(
+                        topology_key=labels_api.LABEL_HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"app": "db"}),
+                    )
+                ],
+            )
+            for _ in range(2)
+        ]
+        filler = make_pod(requests={"cpu": 3})
+        provision_and_ready(env, anti[0], filler)
+        provision_and_ready(env, anti[1])
+        env.kube.delete(env.kube.get_pod(filler.namespace, filler.name), force=True)
+        env.deprovisioning.reconcile()
+        # both anti pods still on distinct nodes
+        n1 = env.kube.get_pod(anti[0].namespace, anti[0].name).spec.node_name
+        n2 = env.kube.get_pod(anti[1].namespace, anti[1].name).spec.node_name
+        assert n1 != n2

@@ -79,3 +79,113 @@ class TestEvictionQueue:
             kube.create(p)
         queue.add(pods)
         assert all(kube.get_pod(p.namespace, p.name) is None for p in pods)
+
+
+class TestRetryCurve:
+    """Eviction backoff retry curve (eviction.go's workqueue rate limiter):
+    delays double from the base up to the cap, and clear on success."""
+
+    def test_backoff_doubles_to_cap(self):
+        """Observe the ACTUAL retry delays the queue sleeps between attempts
+        on a permanently blocked pod: doubling from the base, capped."""
+        from karpenter_core_tpu.apis.objects import (
+            LabelSelector,
+            ObjectMeta,
+            PodDisruptionBudget,
+            PodDisruptionBudgetSpec,
+            PodDisruptionBudgetStatus,
+        )
+        from karpenter_core_tpu.controllers.termination import (
+            EVICTION_QUEUE_BASE_DELAY,
+            EVICTION_QUEUE_MAX_DELAY,
+            EvictionQueue,
+        )
+        from karpenter_core_tpu.operator.kubeclient import KubeClient
+        from karpenter_core_tpu.utils.clock import FakeClock
+
+        class RecordingClock(FakeClock):
+            def __init__(self):
+                super().__init__()
+                self.sleeps = []
+
+            def sleep(self, seconds):
+                self.sleeps.append(seconds)
+                super().sleep(seconds)
+
+        clock = RecordingClock()
+        kube = KubeClient(clock)
+        pod = make_pod(labels={"app": "guarded"}, node_name="n", unschedulable=False)
+        kube.create(pod)
+        kube.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="pdb", namespace="default"),
+                spec=PodDisruptionBudgetSpec(
+                    selector=LabelSelector(match_labels={"app": "guarded"})
+                ),
+                status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+            )
+        )
+        queue = EvictionQueue(kube, None, clock=clock, synchronous=False)
+        queue.add([pod])
+        queue.drain_queue()
+        assert len(clock.sleeps) >= 4
+        assert clock.sleeps[0] == EVICTION_QUEUE_BASE_DELAY
+        for prev, cur in zip(clock.sleeps, clock.sleeps[1:]):
+            assert cur == min(prev * 2, EVICTION_QUEUE_MAX_DELAY)
+        assert all(d <= EVICTION_QUEUE_MAX_DELAY for d in clock.sleeps)
+
+    def test_pdb_blocked_pod_follows_curve_then_succeeds(self):
+        from karpenter_core_tpu.apis.objects import (
+            LabelSelector,
+            ObjectMeta,
+            PodDisruptionBudget,
+            PodDisruptionBudgetSpec,
+            PodDisruptionBudgetStatus,
+        )
+        from karpenter_core_tpu.controllers.termination import (
+            EVICTION_QUEUE_BASE_DELAY,
+            EvictionQueue,
+        )
+        from karpenter_core_tpu.operator.kubeclient import KubeClient
+        from karpenter_core_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        kube = KubeClient(clock)
+        pod = make_pod(labels={"app": "guarded"}, node_name="n", unschedulable=False)
+        kube.create(pod)
+        pdb = PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="default"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector(match_labels={"app": "guarded"})
+            ),
+            status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+        )
+        kube.create(pdb)
+        queue = EvictionQueue(kube, None, clock=clock, synchronous=False)
+        queue.add([pod])
+        start = clock.now()
+        queue.drain_queue()  # retries with backoff until the pass bound
+        waited = clock.now() - start
+        # the fake clock advanced through the doubling curve
+        assert waited >= EVICTION_QUEUE_BASE_DELAY * (2**3)
+        assert kube.get_pod(pod.namespace, pod.name) is not None  # still blocked
+        # lift the PDB: the next pass evicts promptly
+        pdb.status.disruptions_allowed = 1
+        kube.update(pdb)
+        queue.drain_queue()
+        assert kube.get_pod(pod.namespace, pod.name) is None
+
+    def test_success_resets_failure_state(self):
+        from karpenter_core_tpu.controllers.termination import EvictionQueue
+        from karpenter_core_tpu.operator.kubeclient import KubeClient
+        from karpenter_core_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        kube = KubeClient(clock)
+        pod = make_pod(node_name="n", unschedulable=False)
+        kube.create(pod)
+        queue = EvictionQueue(kube, None, clock=clock, synchronous=False)
+        queue.add([pod])
+        queue.drain_queue()
+        assert not queue._failures  # success clears the backoff ledger
+        assert not queue._set

@@ -268,3 +268,75 @@ class TestOperator:
             assert operator.healthy()
         finally:
             operator.stop()
+
+
+class TestInflightChecksMatrix:
+    """Inflight checks (inflightcheck.go suite): failed-init timeout,
+    stuck-termination PDB blockage, node-shape mismatch — each surfaces an
+    event exactly once per issue (the change monitor dedupe)."""
+
+    def _env(self):
+        from karpenter_core_tpu.controllers.inflightchecks import (
+            InflightChecksController,
+        )
+
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        controller = InflightChecksController(
+            env.clock, env.kube, env.provider, env.recorder
+        )
+        return env, controller
+
+    def _stuck_startup_taint_node(self, env):
+        from karpenter_core_tpu.apis.objects import Taint
+
+        prov = env.kube.list_provisioners()[0]
+        prov.spec.startup_taints = [Taint("init.sh/agent", "", "NoSchedule")]
+        env.kube.update(prov)
+        node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+            },
+            taints=[Taint("init.sh/agent", "", "NoSchedule")],
+        )
+        env.kube.create(node)
+        return node
+
+    def test_failed_init_fires_after_timeout(self):
+        env, controller = self._env()
+        node = self._stuck_startup_taint_node(env)
+        env.clock.step(61 * 60)
+        controller.reconcile(node)
+        assert any(e.reason == "FailedInflightCheck" for e in env.recorder.events)
+
+    def test_healthy_node_no_events(self):
+        env, controller = self._env()
+        pod = make_pod(requests={"cpu": "100m"})
+        expect_provisioned(env, pod)
+        env.make_all_nodes_ready()
+        node = env.kube.list_nodes()[0]
+        before = len(env.recorder.events)
+        controller.reconcile(node)
+        issues = [
+            e for e in env.recorder.events[before:]
+            if e.reason == "FailedInflightCheck"
+        ]
+        assert not issues
+
+    def test_issue_event_deduped_across_reconciles(self):
+        env, controller = self._env()
+        node = self._stuck_startup_taint_node(env)
+        env.clock.step(61 * 60)
+        controller.reconcile(node)
+        count_after_first = len(
+            [e for e in env.recorder.events if e.reason == "FailedInflightCheck"]
+        )
+        assert count_after_first >= 1
+        env.clock.step(11 * 60)  # past SCAN_PERIOD: the node is re-scanned
+        controller.reconcile(node)
+        # same issue re-detected: the reported ledger suppresses a repeat
+        count_after_second = len(
+            [e for e in env.recorder.events if e.reason == "FailedInflightCheck"]
+        )
+        assert count_after_second == count_after_first

@@ -1,0 +1,203 @@
+"""Requirement algebra operator matrix, ported from the reference's
+requirement_test.go / requirements_test.go (885 LoC of pairwise operator
+semantics).  The complement-set representation must behave as exact set
+algebra under every operator pairing: In, NotIn, Exists, DoesNotExist, Gt,
+Lt — intersection, membership, compatibility, and label normalization.
+"""
+
+import itertools
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+)
+from karpenter_core_tpu.scheduling import Requirement, Requirements
+
+KEY = "test.io/key"
+
+
+def req(operator, *values):
+    return Requirement(KEY, operator, list(values))
+
+
+# the reference's canonical instances (requirement_test.go:30-46)
+EXISTS = req(OP_EXISTS)
+DOES_NOT_EXIST = req(OP_DOES_NOT_EXIST)
+IN_A = req(OP_IN, "A")
+IN_B = req(OP_IN, "B")
+IN_AB = req(OP_IN, "A", "B")
+NOT_IN_A = req(OP_NOT_IN, "A")
+NOT_IN_B = req(OP_NOT_IN, "B")
+NOT_IN_AB = req(OP_NOT_IN, "A", "B")
+IN_1 = req(OP_IN, "1")
+IN_9 = req(OP_IN, "9")
+GT_1 = req(OP_GT, "1")
+LT_1 = req(OP_LT, "1")
+GT_9 = req(OP_GT, "9")
+LT_9 = req(OP_LT, "9")
+
+
+def members(requirement, universe=("A", "B", "C", "0", "1", "5", "9", "10")):
+    """The requirement's member set over a finite probe universe — ground
+    truth for checking the algebra as set operations."""
+    return {v for v in universe if requirement.has(v)}
+
+
+ALL = [
+    EXISTS, DOES_NOT_EXIST, IN_A, IN_B, IN_AB, NOT_IN_A, NOT_IN_B, NOT_IN_AB,
+    IN_1, IN_9, GT_1, LT_1, GT_9, LT_9,
+]
+
+
+class TestIntersectionAlgebra:
+    """requirement_test.go Intersection matrix — checked as set algebra over
+    a probe universe instead of 196 hand-written expectations."""
+
+    def test_pairwise_intersection_is_set_intersection(self):
+        for a, b in itertools.product(ALL, repeat=2):
+            got = members(a.intersection(b))
+            want = members(a) & members(b)
+            assert got == want, f"{a!r} ∩ {b!r}: got {got}, want {want}"
+
+    def test_intersection_commutes(self):
+        for a, b in itertools.product(ALL, repeat=2):
+            assert members(a.intersection(b)) == members(b.intersection(a))
+
+    def test_intersection_associates(self):
+        for a, b, c in itertools.product(
+            [EXISTS, IN_AB, NOT_IN_A, GT_1, LT_9], repeat=3
+        ):
+            left = a.intersection(b).intersection(c)
+            right = a.intersection(b.intersection(c))
+            assert members(left) == members(right)
+
+    def test_exists_is_identity(self):
+        for a in ALL:
+            assert members(EXISTS.intersection(a)) == members(a)
+
+    def test_does_not_exist_is_annihilator(self):
+        for a in ALL:
+            assert members(DOES_NOT_EXIST.intersection(a)) == set()
+
+    def test_gt_lt_band(self):
+        band = GT_1.intersection(LT_9)
+        assert members(band) == {"5"}
+        empty = GT_9.intersection(LT_1)
+        assert members(empty) == set()
+
+    def test_in_preserved_through_bounds(self):
+        assert members(IN_9.intersection(GT_1)) == {"9"}
+        assert members(IN_1.intersection(GT_1)) == set()
+        assert members(IN_1.intersection(LT_9)) == {"1"}
+
+
+class TestMembership:
+    """requirement_test.go Has()."""
+
+    def test_in(self):
+        assert IN_A.has("A") and not IN_A.has("B")
+
+    def test_not_in(self):
+        assert NOT_IN_A.has("B") and not NOT_IN_A.has("A")
+
+    def test_exists_has_everything(self):
+        assert EXISTS.has("A") and EXISTS.has("anything")
+
+    def test_does_not_exist_has_nothing(self):
+        assert not DOES_NOT_EXIST.has("A")
+
+    def test_bounds_numeric_membership(self):
+        assert GT_1.has("2") and not GT_1.has("1") and not GT_1.has("0")
+        assert LT_9.has("8") and not LT_9.has("9") and not LT_9.has("10")
+        # non-numeric values never satisfy a bound
+        assert not GT_1.has("A") and not LT_9.has("A")
+
+    def test_operator_roundtrip(self):
+        assert IN_A.operator() == OP_IN
+        assert NOT_IN_A.operator() == OP_NOT_IN
+        assert EXISTS.operator() == OP_EXISTS
+        assert DOES_NOT_EXIST.operator() == OP_DOES_NOT_EXIST
+
+
+class TestRequirementsCompatibility:
+    """requirements_test.go:50-290 compatibility matrix via the probe sets."""
+
+    def _compatible(self, a, b):
+        return Requirements(a).compatible(Requirements(b)) is None
+
+    def test_pairwise_compatibility_matches_set_overlap(self):
+        # ground truth mirrors requirements.go:189-206: overlap of member
+        # sets, with one carve-out — an empty intersection is tolerated when
+        # BOTH operators are negative (NotIn/DoesNotExist), the reference's
+        # "unconstrained can still avoid" rule
+        negative = (OP_NOT_IN, OP_DOES_NOT_EXIST)
+        for a, b in itertools.product(ALL, repeat=2):
+            want = bool(members(a) & members(b))
+            if not want and a.operator() in negative and b.operator() in negative:
+                want = True
+            got = self._compatible(a, b)
+            assert got == want, f"compatible({a!r}, {b!r}) = {got}, want {want}"
+
+    def test_custom_key_undefined_on_receiver_errors(self):
+        # requirements.go:123-133 — a custom label the receiver doesn't
+        # define cannot be required (In), but CAN be avoided (NotIn)
+        a = Requirements(Requirement("key-a", OP_IN, ["x"]))
+        require_b = Requirements(Requirement("key-b", OP_IN, ["y"]))
+        avoid_b = Requirements(Requirement("key-b", OP_NOT_IN, ["y"]))
+        assert a.compatible(require_b) is not None
+        assert a.compatible(avoid_b) is None
+
+    def test_well_known_key_undefined_is_allowed(self):
+        a = Requirements(Requirement("key-a", OP_IN, ["x"]))
+        zone = Requirements(
+            Requirement(labels_api.LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-1"])
+        )
+        assert a.compatible(zone) is None
+
+    def test_incremental_add_tightens(self):
+        reqs = Requirements(req(OP_IN, "A", "B", "C"))
+        reqs.add(req(OP_NOT_IN, "B"))
+        assert sorted(reqs.get(KEY).values_list()) == ["A", "C"]
+        reqs.add(req(OP_IN, "C"))
+        assert reqs.get(KEY).values_list() == ["C"]
+
+
+class TestLabelNormalization:
+    """requirements_test.go:27-49 — deprecated label aliases normalize."""
+
+    def test_beta_arch_normalizes(self):
+        r = Requirement("beta.kubernetes.io/arch", OP_IN, ["amd64"])
+        assert r.key == labels_api.LABEL_ARCH_STABLE
+
+    def test_beta_os_normalizes(self):
+        r = Requirement("beta.kubernetes.io/os", OP_IN, ["linux"])
+        assert r.key == labels_api.LABEL_OS_STABLE
+
+    def test_from_labels_normalizes(self):
+        reqs = Requirements.from_labels({"beta.kubernetes.io/arch": "arm64"})
+        assert reqs.has(labels_api.LABEL_ARCH_STABLE)
+        assert reqs.get(labels_api.LABEL_ARCH_STABLE).has("arm64")
+
+    def test_labels_roundtrip(self):
+        reqs = Requirements(
+            Requirement("a", OP_IN, ["1"]), Requirement("b", OP_IN, ["2"])
+        )
+        assert reqs.labels() == {"a": "1", "b": "2"}
+
+
+class TestRequirementEquality:
+    def test_equal_same_content(self):
+        assert req(OP_IN, "A", "B") == req(OP_IN, "B", "A")
+        assert hash(req(OP_IN, "A", "B")) == hash(req(OP_IN, "B", "A"))
+
+    def test_unequal_different_operator(self):
+        assert req(OP_IN, "A") != req(OP_NOT_IN, "A")
+
+    def test_intersection_idempotent(self):
+        for a in ALL:
+            assert members(a.intersection(a)) == members(a)

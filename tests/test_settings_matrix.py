@@ -1,0 +1,128 @@
+"""Settings parsing matrix, ported from the reference's
+/root/reference/pkg/apis/config/settings/suite_test.go: duration formats,
+feature gates, invalid-value rejection, and the store's keep-last-good
+update contract under a live ConfigMap watch.
+"""
+
+import pytest
+
+from karpenter_core_tpu.apis.objects import ObjectMeta
+from karpenter_core_tpu.operator.kubeclient import KubeClient
+from karpenter_core_tpu.operator.settings import Settings, _parse_duration
+from karpenter_core_tpu.operator.settingsstore import (
+    SETTINGS_NAME,
+    ConfigMap,
+    SettingsStore,
+)
+
+
+class TestDurationParsing:
+    """Go-style duration strings (settings.go AsDuration)."""
+
+    def test_seconds(self):
+        assert _parse_duration("10s") == 10.0
+
+    def test_minutes_seconds(self):
+        assert _parse_duration("1m30s") == 90.0
+
+    def test_milliseconds(self):
+        assert _parse_duration("500ms") == 0.5
+
+    def test_hours(self):
+        assert _parse_duration("2h") == 7200.0
+
+    def test_fractional(self):
+        assert _parse_duration("1.5s") == 1.5
+
+    def test_composite(self):
+        assert _parse_duration("1h1m1s") == 3661.0
+
+    @pytest.mark.parametrize("bad", ["", "abc", "10", "s10", "10x", "-5s"])
+    def test_invalid_rejected(self, bad):
+        with pytest.raises(ValueError):
+            _parse_duration(bad)
+
+
+class TestSettingsFromConfigMap:
+    def test_defaults_when_empty(self):
+        settings = Settings.from_config_map({})
+        defaults = Settings()
+        assert settings.batch_max_duration == defaults.batch_max_duration
+        assert settings.batch_idle_duration == defaults.batch_idle_duration
+        assert settings.drift_enabled == defaults.drift_enabled
+
+    def test_all_keys_parsed(self):
+        settings = Settings.from_config_map(
+            {
+                "batchMaxDuration": "20s",
+                "batchIdleDuration": "2s",
+                "featureGates.driftEnabled": "true",
+            }
+        )
+        assert settings.batch_max_duration == 20.0
+        assert settings.batch_idle_duration == 2.0
+        assert settings.drift_enabled is True
+
+    def test_feature_gate_false_variants(self):
+        for raw in ("false", "False", "FALSE", "0", "no"):
+            settings = Settings.from_config_map({"featureGates.driftEnabled": raw})
+            assert settings.drift_enabled is False
+
+    def test_invalid_duration_raises(self):
+        with pytest.raises(ValueError):
+            Settings.from_config_map({"batchMaxDuration": "tomorrow"})
+
+
+class TestStoreUpdateContract:
+    """settingsstore.go:71-98 — seed, live update, keep-last-good."""
+
+    def _store(self):
+        kube = KubeClient()
+        return kube, SettingsStore(kube, defaults=Settings()).start()
+
+    def test_seeds_config_map_with_defaults(self):
+        kube, store = self._store()
+        cm = kube.get(ConfigMap, SETTINGS_NAME, "karpenter")
+        assert cm is not None
+        # re-reading the seed reproduces the defaults exactly
+        assert Settings.from_config_map(cm.data).batch_max_duration == (
+            Settings().batch_max_duration
+        )
+
+    def test_live_update_applies(self):
+        kube, store = self._store()
+        cm = kube.get(ConfigMap, SETTINGS_NAME, "karpenter")
+        cm.data["batchMaxDuration"] = "33s"
+        kube.update(cm)
+        assert store.batch_max_duration == 33.0
+
+    def test_invalid_update_keeps_last_good(self):
+        kube, store = self._store()
+        cm = kube.get(ConfigMap, SETTINGS_NAME, "karpenter")
+        cm.data["batchMaxDuration"] = "44s"
+        kube.update(cm)
+        assert store.batch_max_duration == 44.0
+        cm.data["batchMaxDuration"] = "not-a-duration"
+        kube.update(cm)
+        assert store.batch_max_duration == 44.0  # rejected, last good stands
+
+    def test_on_change_callbacks_fire(self):
+        kube, store = self._store()
+        seen = []
+        store.on_change(lambda s: seen.append(s.batch_max_duration))
+        cm = kube.get(ConfigMap, SETTINGS_NAME, "karpenter")
+        cm.data["batchMaxDuration"] = "55s"
+        kube.update(cm)
+        assert seen and seen[-1] == 55.0
+
+    def test_existing_config_map_read_at_start(self):
+        kube = KubeClient()
+        kube.create(
+            ConfigMap(
+                metadata=ObjectMeta(name=SETTINGS_NAME, namespace="karpenter"),
+                data={"batchMaxDuration": "77s", "batchIdleDuration": "7s"},
+            )
+        )
+        store = SettingsStore(kube, defaults=Settings()).start()
+        assert store.batch_max_duration == 77.0
+        assert store.batch_idle_duration == 7.0

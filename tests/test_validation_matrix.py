@@ -1,0 +1,324 @@
+"""Provisioner validation matrix, ported from the reference's
+/root/reference/pkg/apis/v1alpha5/suite_test.go (452 LoC): TTL combinations,
+label rules, taint rules, requirement operator/domain rules, and the kubelet
+configuration threshold matrix.  Also covers kubelet-config propagation
+(provisioner -> machine template -> launched machine) — the core's contract
+is to carry it to the cloud provider, which applies it (instancetype.go).
+"""
+
+from karpenter_core_tpu.apis import labels as labels_api, validation
+from karpenter_core_tpu.apis.objects import (
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    NodeSelectorRequirement,
+    Taint,
+)
+from karpenter_core_tpu.apis.v1alpha5 import Consolidation, KubeletConfiguration
+from karpenter_core_tpu.testing import make_provisioner
+
+
+def errs(provisioner):
+    return validation.validate_provisioner(provisioner)
+
+
+def make(**kwargs):
+    return make_provisioner(**kwargs)
+
+
+class TestTTLMatrix:
+    """suite_test.go:54-91."""
+
+    def test_negative_expiry_ttl_fails(self):
+        p = make()
+        p.spec.ttl_seconds_until_expired = -5
+        assert errs(p)
+
+    def test_missing_expiry_ttl_ok(self):
+        assert not errs(make())
+
+    def test_negative_empty_ttl_fails(self):
+        p = make()
+        p.spec.ttl_seconds_after_empty = -1
+        assert errs(p)
+
+    def test_valid_empty_ttl_ok(self):
+        p = make()
+        p.spec.ttl_seconds_after_empty = 30
+        assert not errs(p)
+
+    def test_consolidation_and_empty_ttl_conflict(self):
+        p = make()
+        p.spec.consolidation = Consolidation(enabled=True)
+        p.spec.ttl_seconds_after_empty = 30
+        assert any("exactly one" in e for e in errs(p))
+
+    def test_consolidation_off_with_empty_ttl_ok(self):
+        p = make()
+        p.spec.consolidation = Consolidation(enabled=False)
+        p.spec.ttl_seconds_after_empty = 30
+        assert not errs(p)
+
+    def test_consolidation_on_without_empty_ttl_ok(self):
+        p = make(consolidation_enabled=True)
+        assert not errs(p)
+
+
+class TestLabelRules:
+    """suite_test.go:109-147."""
+
+    def test_unrecognized_labels_allowed(self):
+        p = make()
+        p.spec.labels = {"team": "a", "my-domain.io/tier": "gold"}
+        assert not errs(p)
+
+    def test_provisioner_name_label_fails(self):
+        p = make()
+        p.spec.labels = {labels_api.PROVISIONER_NAME_LABEL_KEY: "x"}
+        assert errs(p)
+
+    def test_invalid_label_key_fails(self):
+        p = make()
+        p.spec.labels = {"not a valid key!": "v"}
+        assert errs(p)
+
+    def test_invalid_label_value_fails(self):
+        p = make()
+        p.spec.labels = {"team": "not valid!"}
+        assert errs(p)
+
+    def test_restricted_domain_fails(self):
+        p = make()
+        p.spec.labels = {"kubernetes.io/hostname": "h"}
+        assert errs(p)
+
+
+class TestTaintRules:
+    """suite_test.go:148-195."""
+
+    def test_valid_taints_ok(self):
+        p = make(taints=[Taint("dedicated", "db"), Taint("other", "x", effect="NoExecute")])
+        assert not errs(p)
+
+    def test_missing_taint_key_fails(self):
+        p = make(taints=[Taint("", "v")])
+        assert any("required" in e for e in errs(p))
+
+    def test_invalid_taint_key_fails(self):
+        p = make(taints=[Taint("not a key!", "v")])
+        assert errs(p)
+
+    def test_invalid_taint_value_fails(self):
+        p = make(taints=[Taint("k", "bad value!")])
+        assert errs(p)
+
+    def test_invalid_taint_effect_fails(self):
+        p = make(taints=[Taint("k", "v", effect="Sideways")])
+        assert errs(p)
+
+    def test_same_key_different_effects_ok(self):
+        p = make(
+            taints=[
+                Taint("k", "v", effect="NoSchedule"),
+                Taint("k", "v", effect="NoExecute"),
+            ]
+        )
+        assert not errs(p)
+
+    def test_duplicate_key_effect_fails(self):
+        p = make(taints=[Taint("k", "v"), Taint("k", "other")])
+        assert any("duplicate" in e for e in errs(p))
+
+    def test_duplicate_across_startup_taints_fails(self):
+        p = make(taints=[Taint("k", "v")], startup_taints=[Taint("k", "v")])
+        assert any("duplicate" in e for e in errs(p))
+
+
+class TestRequirementRules:
+    """suite_test.go:196-271."""
+
+    def test_supported_ops_allowed(self):
+        for op in (OP_IN, OP_NOT_IN, OP_EXISTS):
+            p = make(requirements=[NodeSelectorRequirement("team", op, ["a"])])
+            assert not errs(p), op
+
+    def test_gt_lt_require_single_nonnegative_int(self):
+        ok = make(
+            requirements=[
+                NodeSelectorRequirement("team", OP_GT, ["1"]),
+                NodeSelectorRequirement("tier", OP_LT, ["10"]),
+            ]
+        )
+        assert not errs(ok)
+        for values in (["a"], ["-1"], ["1", "2"], []):
+            p = make(requirements=[NodeSelectorRequirement("team", OP_GT, values)])
+            assert errs(p), values
+
+    def test_unsupported_op_fails(self):
+        p = make(requirements=[NodeSelectorRequirement("team", "Sideways", ["a"])])
+        assert errs(p)
+
+    def test_provisioner_name_requirement_fails(self):
+        p = make(
+            requirements=[
+                NodeSelectorRequirement(
+                    labels_api.PROVISIONER_NAME_LABEL_KEY, OP_IN, ["x"]
+                )
+            ]
+        )
+        assert errs(p)
+
+    def test_restricted_domain_requirement_fails(self):
+        p = make(
+            requirements=[
+                NodeSelectorRequirement("kubernetes.io/some-key", OP_IN, ["x"])
+            ]
+        )
+        assert errs(p)
+
+    def test_well_known_exceptions_allowed(self):
+        for key in (
+            labels_api.LABEL_TOPOLOGY_ZONE,
+            labels_api.LABEL_ARCH_STABLE,
+            labels_api.LABEL_OS_STABLE,
+            labels_api.LABEL_INSTANCE_TYPE_STABLE,
+            labels_api.LABEL_CAPACITY_TYPE,
+        ):
+            p = make(requirements=[NodeSelectorRequirement(key, OP_EXISTS, [])])
+            assert not errs(p), key
+
+    def test_empty_requirements_allowed(self):
+        assert not errs(make(requirements=[]))
+
+
+class TestKubeletThresholds:
+    """suite_test.go:272-451 — the eviction threshold matrix."""
+
+    def _with_kubelet(self, **kwargs):
+        p = make()
+        p.spec.kubelet_configuration = KubeletConfiguration(**kwargs)
+        return p
+
+    def test_negative_kube_reserved_fails(self):
+        p = self._with_kubelet(kube_reserved={"cpu": -1.0})
+        assert any("negative" in e for e in errs(p))
+
+    def test_negative_system_reserved_fails(self):
+        p = self._with_kubelet(system_reserved={"memory": -5.0})
+        assert any("negative" in e for e in errs(p))
+
+    def test_valid_reserved_ok(self):
+        p = self._with_kubelet(
+            kube_reserved={"cpu": 0.5}, system_reserved={"memory": 1024.0}
+        )
+        assert not errs(p)
+
+    def test_eviction_hard_percentage_ok(self):
+        p = self._with_kubelet(eviction_hard={"memory.available": "5%"})
+        assert not errs(p)
+
+    def test_eviction_hard_quantity_ok(self):
+        p = self._with_kubelet(eviction_hard={"memory.available": "100Mi"})
+        assert not errs(p)
+
+    def test_eviction_hard_bad_percentage_fails(self):
+        p = self._with_kubelet(eviction_hard={"memory.available": "x%"})
+        assert errs(p)
+
+    def test_eviction_hard_over_100_percent_fails(self):
+        p = self._with_kubelet(eviction_hard={"memory.available": "150%"})
+        assert errs(p)
+
+    def test_eviction_hard_negative_percent_fails(self):
+        p = self._with_kubelet(eviction_hard={"memory.available": "-5%"})
+        assert errs(p)
+
+    def test_eviction_hard_bad_quantity_fails(self):
+        p = self._with_kubelet(eviction_hard={"memory.available": "123xyz"})
+        assert errs(p)
+
+    def test_eviction_soft_same_rules(self):
+        assert not errs(self._with_kubelet(eviction_soft={"memory.available": "10%"}))
+        assert errs(self._with_kubelet(eviction_soft={"memory.available": "101%"}))
+
+    def test_negative_max_pods_fails(self):
+        p = self._with_kubelet(max_pods=-1)
+        assert errs(p)
+
+    def test_negative_pods_per_core_fails(self):
+        p = self._with_kubelet(pods_per_core=-2)
+        assert errs(p)
+
+
+class TestKubeletPropagation:
+    """The core's kubelet contract: carried provisioner -> template ->
+    machine so the cloud provider can apply it (the reference's provider
+    applies maxPods/reserved inside GetInstanceTypes)."""
+
+    def test_kubelet_reaches_machine(self):
+        from karpenter_core_tpu.solver.machinetemplate import MachineTemplate
+
+        p = make()
+        p.spec.kubelet_configuration = KubeletConfiguration(
+            max_pods=42, kube_reserved={"cpu": 0.25}
+        )
+        template = MachineTemplate.from_provisioner(p)
+        assert template.kubelet is not None
+        assert template.kubelet.max_pods == 42
+        machine = template.to_machine(p)
+        assert machine.spec.kubelet is not None
+        assert machine.spec.kubelet.max_pods == 42
+        assert machine.spec.kubelet.kube_reserved == {"cpu": 0.25}
+
+
+class TestDefaulting:
+    """set_defaults (webhook defaulting path)."""
+
+    def test_defaults_applied_idempotently(self):
+        p = make()
+        d1 = validation.set_defaults(p)
+        d2 = validation.set_defaults(d1)
+        assert errs(d2) == []
+
+
+class TestAdmissionPath:
+    """Webhook admission wiring (operator/webhooks.py): invalid provisioners
+    are rejected at create/update, valid ones are defaulted."""
+
+    def test_invalid_provisioner_rejected_on_create(self):
+        import pytest
+
+        from karpenter_core_tpu.operator.kubeclient import KubeClient
+        from karpenter_core_tpu.operator.webhooks import AdmissionError, Webhooks
+
+        kube = KubeClient()
+        Webhooks().install(kube)
+        bad = make()
+        bad.spec.ttl_seconds_until_expired = -1
+        with pytest.raises(AdmissionError):
+            kube.create(bad)
+
+    def test_invalid_update_rejected(self):
+        import pytest
+
+        from karpenter_core_tpu.operator.kubeclient import KubeClient
+        from karpenter_core_tpu.operator.webhooks import AdmissionError, Webhooks
+
+        kube = KubeClient()
+        Webhooks().install(kube)
+        good = make()
+        kube.create(good)
+        good.spec.taints = [Taint("", "v")]
+        with pytest.raises(AdmissionError):
+            kube.update(good)
+
+    def test_valid_provisioner_admitted(self):
+        from karpenter_core_tpu.operator.kubeclient import KubeClient
+        from karpenter_core_tpu.operator.webhooks import Webhooks
+
+        kube = KubeClient()
+        Webhooks().install(kube)
+        kube.create(make(consolidation_enabled=True))
+        assert len(kube.list_provisioners()) == 1

@@ -87,11 +87,17 @@ class _Walker(ast.NodeVisitor):
     def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
         if not any(isinstance(v, ast.FormattedValue) for v in node.values):
             self.findings.append((node.lineno, "f-string-no-field", "drop the f prefix"))
-        # visit interpolated expressions but NOT format specs (they are inner
-        # JoinedStrs with no fields and would false-positive)
-        for value in node.values:
-            if isinstance(value, ast.FormattedValue):
-                self.visit(value.value)
+        # visit interpolated expressions — including those inside dynamic
+        # format specs — but never a spec's JoinedStr itself (a field-less
+        # inner JoinedStr would false-positive the no-field check)
+        def visit_fields(joined: ast.JoinedStr) -> None:
+            for value in joined.values:
+                if isinstance(value, ast.FormattedValue):
+                    self.visit(value.value)
+                    if isinstance(value.format_spec, ast.JoinedStr):
+                        visit_fields(value.format_spec)
+
+        visit_fields(node)
 
 
 def lint_file(path: Path) -> list[str]:
@@ -110,9 +116,11 @@ def lint_file(path: Path) -> list[str]:
         return out + [f"{path}:{e.lineno}: syntax-error: {e.msg}"]
     walker = _Walker()
     walker.visit(tree)
-    # string-annotation references ("Optional[Clock]") count as uses —
-    # identifier-boundary matches only, or docstring prose would exempt
-    # short names like np/os from the check
+    # string-annotation references ("Optional[Clock]") count as uses.
+    # Identifier-boundary matching over ALL string constants is a known
+    # over-approximation: prose like "the os module" in a docstring also
+    # exempts `os` — accepted to keep forward-reference annotations working
+    # without tracking annotation positions
     import re as _re
 
     for node in ast.walk(tree):
