@@ -289,6 +289,31 @@ class Statics(NamedTuple):
     key_has_bounds: Tuple[bool, ...]  # python tuple -> static per-key branching
 
 
+class StaticArrays(NamedTuple):
+    """The array part of Statics (everything but the static key_has_bounds
+    tuple) — the pytree prepare_host returns and pad_planes transforms.  Field
+    order MUST match Statics so ``Statics(*static_arrays, key_has_bounds=...)``
+    stays valid."""
+
+    it: mask_ops.ReqTensor
+    it_alloc: jnp.ndarray
+    it_avail: jnp.ndarray
+    tmpl: mask_ops.ReqTensor
+    tmpl_zone: jnp.ndarray
+    tmpl_ct: jnp.ndarray
+    tmpl_it: jnp.ndarray
+    tmpl_daemon: jnp.ndarray
+    tmpl_limits0: jnp.ndarray
+    it_capacity: jnp.ndarray
+    valid: jnp.ndarray
+    is_custom: jnp.ndarray
+    vocab_ints: jnp.ndarray
+    grp_skew: jnp.ndarray
+    grp_is_zone: jnp.ndarray
+    grp_is_anti: jnp.ndarray
+    grp_member: jnp.ndarray
+
+
 class ClassTensors(NamedTuple):
     mask: jnp.ndarray
     defined: jnp.ndarray
@@ -1112,24 +1137,24 @@ def prepare_host(snapshot: EncodedSnapshot):
         snapshot.tmpl_gt,
         snapshot.tmpl_lt,
     )
-    statics_arrays = (
-        it_t,
-        snapshot.it_alloc,
-        snapshot.it_avail,
-        tmpl_t,
-        snapshot.tmpl_zone,
-        snapshot.tmpl_ct,
-        snapshot.tmpl_it,
-        snapshot.tmpl_daemon,
-        snapshot.tmpl_limits,
-        snapshot.it_capacity,
-        snapshot.valid,
-        snapshot.is_custom,
-        snapshot.vocab_ints,
-        snapshot.grp_skew,
-        snapshot.grp_is_zone,
-        snapshot.grp_is_anti,
-        snapshot.grp_member,
+    statics_arrays = StaticArrays(
+        it=it_t,
+        it_alloc=snapshot.it_alloc,
+        it_avail=snapshot.it_avail,
+        tmpl=tmpl_t,
+        tmpl_zone=snapshot.tmpl_zone,
+        tmpl_ct=snapshot.tmpl_ct,
+        tmpl_it=snapshot.tmpl_it,
+        tmpl_daemon=snapshot.tmpl_daemon,
+        tmpl_limits0=snapshot.tmpl_limits,
+        it_capacity=snapshot.it_capacity,
+        valid=snapshot.valid,
+        is_custom=snapshot.is_custom,
+        vocab_ints=snapshot.vocab_ints,
+        grp_skew=snapshot.grp_skew,
+        grp_is_zone=snapshot.grp_is_zone,
+        grp_is_anti=snapshot.grp_is_anti,
+        grp_member=snapshot.grp_member,
     )
     key_has_bounds = tuple(
         bool(np.isfinite(snapshot.cls_gt[:, k]).any() or np.isfinite(snapshot.cls_lt[:, k]).any()
@@ -1160,3 +1185,179 @@ def estimate_slots(snapshot: EncodedSnapshot) -> int:
         best = max(1.0, min(best, host_cap))
         total += int(np.ceil(float(snapshot.cls_count[c]) / best)) + snapshot.cls_zone.shape[1]
     return int(2 ** np.ceil(np.log2(max(total, 16))))
+
+# -- shape-bucket padding -----------------------------------------------------
+#
+# The compile cache keys on every input shape, so a one-class change in the
+# pod mix (or one node joining the cluster) would recompile an identical
+# program.  Steady-state reconciles instead pad the variable axes -- C classes,
+# E existing nodes, G topology groups, P port pairs, K keys, V vocabulary
+# values, D CSI drivers -- up to a bucket grid (powers of two and 1.5x powers
+# of two, <=33% waste).  Padding is semantically invisible:
+#
+#   - padded classes have count=0: every phase is a lax.cond no-op and the
+#     record step adds zero to all topology counts
+#   - padded existing nodes are closed (open_=False): never eligible, never
+#     seed counts
+#   - padded groups clone the dummy "none" row (skew=UNLIMITED, no members);
+#     the class sentinel index is remapped to the new last row
+#   - padded keys are undefined on every side: Compatible/Intersects skip them
+#   - padded value slots sit before the "unseen" slot with mask=False and
+#     valid=False: no real value maps to them, no reduction counts them
+#   - padded drivers have vol_limit=UNLIMITED and zero usage
+#
+# The reference has no analog (Go recompiles nothing); this is TPU operational
+# parity, same motive as utils.compilecache.
+
+
+def bucket(n: int, floor: int = 8) -> int:
+    """Smallest grid value >= max(n, floor); the grid is the powers of two
+    and 1.5x powers of two starting at 2 (2, 3, 4, 6, 8, 12, ...)."""
+    target = max(int(n), int(floor), 2)
+    b = 2
+    while b < target:
+        b = b * 3 // 2 if (b & (b - 1)) == 0 else (b // 3) * 4
+    return b
+
+
+def _pad_axis(a: np.ndarray, axis: int, target: int, value) -> np.ndarray:
+    cur = a.shape[axis]
+    if cur >= target:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, target - cur)
+    return np.pad(a, widths, constant_values=value)
+
+
+def _widen_mask(mask: np.ndarray, v_new: int) -> np.ndarray:
+    """Insert always-False value slots before the trailing "unseen" slot."""
+    v = mask.shape[-1] - 1
+    if v >= v_new:
+        return mask
+    block = np.zeros(mask.shape[:-1] + (v_new - v,), dtype=mask.dtype)
+    return np.concatenate([mask[..., :v], block, mask[..., v:]], axis=-1)
+
+
+def _pad_req(t: mask_ops.ReqTensor, k_new: int, v_new: int) -> mask_ops.ReqTensor:
+    """Pad a ReqTensor's K axis (undefined keys, mask=ones) and vocabulary
+    width (False slots before "unseen")."""
+    mask = _widen_mask(np.asarray(t.mask), v_new)
+    mask = _pad_axis(mask, -2, k_new, True)
+    return mask_ops.ReqTensor(
+        mask=mask,
+        defined=_pad_axis(np.asarray(t.defined), -1, k_new, False),
+        negative=_pad_axis(np.asarray(t.negative), -1, k_new, False),
+        gt=_pad_axis(np.asarray(t.gt), -1, k_new, -np.inf),
+        lt=_pad_axis(np.asarray(t.lt), -1, k_new, np.inf),
+    )
+
+
+def pad_planes(cls, statics_arrays, key_has_bounds, ex_state=None, ex_static=None):
+    """Bucket-pad kernel inputs (host numpy pytrees from prepare_host /
+    TPUSolver.encode_existing).  Returns (cls, statics_arrays, key_has_bounds,
+    ex_state, ex_static) with stable shapes across nearby problem sizes."""
+    sa = StaticArrays(*statics_arrays)
+
+    c_old = cls.count.shape[0]
+    k_old = sa.valid.shape[0]
+    v_old = sa.valid.shape[1] - 1
+    g1_old = sa.grp_skew.shape[0]
+    p_old = cls.ports.shape[-1]
+
+    c_new = bucket(c_old)
+    k_new = bucket(k_old)
+    v_new = bucket(v_old)
+    g1_new = bucket(g1_old - 1, floor=4) + 1
+    p_new = bucket(p_old, floor=4)
+
+    groups = np.asarray(cls.groups)
+    groups = np.where(groups >= g1_old - 1, g1_new - 1, groups)
+    cls_t = _pad_req(
+        mask_ops.ReqTensor(cls.mask, cls.defined, cls.negative, cls.gt, cls.lt),
+        k_new, v_new,
+    )
+    cls = ClassTensors(
+        mask=_pad_axis(cls_t.mask, 0, c_new, True),
+        defined=_pad_axis(cls_t.defined, 0, c_new, False),
+        negative=_pad_axis(cls_t.negative, 0, c_new, False),
+        gt=_pad_axis(cls_t.gt, 0, c_new, -np.inf),
+        lt=_pad_axis(cls_t.lt, 0, c_new, np.inf),
+        zone=_pad_axis(np.asarray(cls.zone), 0, c_new, True),
+        ct=_pad_axis(np.asarray(cls.ct), 0, c_new, True),
+        it=_pad_axis(np.asarray(cls.it), 0, c_new, True),
+        requests=_pad_axis(np.asarray(cls.requests), 0, c_new, 0),
+        count=_pad_axis(np.asarray(cls.count), 0, c_new, 0),
+        tol=_pad_axis(np.asarray(cls.tol), 0, c_new, False),
+        ports=_pad_axis(_pad_axis(np.asarray(cls.ports), -1, p_new, False), 0, c_new, False),
+        groups=_pad_axis(groups, 0, c_new, g1_new - 1),
+    )
+
+    statics_arrays = sa._replace(
+        it=_pad_req(sa.it, k_new, v_new),
+        tmpl=_pad_req(sa.tmpl, k_new, v_new),
+        valid=_pad_axis(_widen_mask(np.asarray(sa.valid), v_new), 0, k_new, False),
+        is_custom=_pad_axis(np.asarray(sa.is_custom), 0, k_new, False),
+        vocab_ints=_pad_axis(
+            _pad_axis(np.asarray(sa.vocab_ints), -1, v_new, np.inf), 0, k_new, np.inf
+        ),
+        grp_skew=_pad_axis(np.asarray(sa.grp_skew), 0, g1_new, UNLIMITED),
+        grp_is_zone=_pad_axis(np.asarray(sa.grp_is_zone), 0, g1_new, False),
+        grp_is_anti=_pad_axis(np.asarray(sa.grp_is_anti), 0, g1_new, False),
+        grp_member=_pad_axis(
+            _pad_axis(np.asarray(sa.grp_member), -1, g1_new, False), 0, c_new, False
+        ),
+    )
+    key_has_bounds = tuple(key_has_bounds) + (False,) * (k_new - k_old)
+
+    if ex_state is not None:
+        e_old = ex_state.pod_count.shape[0]
+        d_old = ex_state.vol_used.shape[-1]
+        e_new = bucket(e_old, floor=4)
+        d_new = bucket(d_old, floor=2)
+        ex_req = _pad_req(
+            mask_ops.ReqTensor(
+                ex_state.kmask, ex_state.kdef, ex_state.kneg, ex_state.kgt, ex_state.klt
+            ),
+            k_new, v_new,
+        )
+        ex_state = ExistingState(
+            used=_pad_axis(np.asarray(ex_state.used), 0, e_new, 0),
+            kmask=_pad_axis(ex_req.mask, 0, e_new, True),
+            kdef=_pad_axis(ex_req.defined, 0, e_new, False),
+            kneg=_pad_axis(ex_req.negative, 0, e_new, False),
+            kgt=_pad_axis(ex_req.gt, 0, e_new, -np.inf),
+            klt=_pad_axis(ex_req.lt, 0, e_new, np.inf),
+            zone=_pad_axis(np.asarray(ex_state.zone), 0, e_new, True),
+            ct=_pad_axis(np.asarray(ex_state.ct), 0, e_new, True),
+            ports=_pad_axis(_pad_axis(np.asarray(ex_state.ports), -1, p_new, False), 0, e_new, False),
+            vol_used=_pad_axis(_pad_axis(np.asarray(ex_state.vol_used), -1, d_new, 0), 0, e_new, 0),
+            pod_count=_pad_axis(np.asarray(ex_state.pod_count), 0, e_new, 0),
+            open_=_pad_axis(np.asarray(ex_state.open_), 0, e_new, False),
+        )
+        ex_static = ExistingStatic(
+            alloc=_pad_axis(np.asarray(ex_static.alloc), 0, e_new, 0),
+            init=_pad_axis(np.asarray(ex_static.init), 0, e_new, False),
+            tol=_pad_axis(_pad_axis(np.asarray(ex_static.tol), -1, e_new, False), 0, c_new, False),
+            grp_node_member=_pad_axis(
+                _pad_axis(np.asarray(ex_static.grp_node_member), -1, e_new, 0), 0, g1_new, 0
+            ),
+            grp_node_owner=_pad_axis(
+                _pad_axis(np.asarray(ex_static.grp_node_owner), -1, e_new, 0), 0, g1_new, 0
+            ),
+            node_capacity=_pad_axis(np.asarray(ex_static.node_capacity), 0, e_new, 0),
+            node_tmpl=_pad_axis(np.asarray(ex_static.node_tmpl), 0, e_new, 0),
+            node_owned=_pad_axis(np.asarray(ex_static.node_owned), 0, e_new, False),
+            vol_limit=_pad_axis(
+                _pad_axis(np.asarray(ex_static.vol_limit), -1, d_new, UNLIMITED), 0, e_new, UNLIMITED
+            ),
+            cls_vol_add=_pad_axis(
+                _pad_axis(
+                    _pad_axis(np.asarray(ex_static.cls_vol_add), -1, d_new, 0), -2, e_new, 0
+                ),
+                0, c_new, 0,
+            ),
+            cls_vol_per_pod=_pad_axis(
+                _pad_axis(np.asarray(ex_static.cls_vol_per_pod), -1, d_new, 0), 0, c_new, 0
+            ),
+        )
+    return cls, statics_arrays, key_has_bounds, ex_state, ex_static

@@ -83,6 +83,12 @@ class TPUConsolidationSearch:
         ex_state, ex_static = self.solver.encode_existing(
             snapshot, state_nodes, bound_pods
         )
+        # encode_existing returns host numpy (so the provisioning path can
+        # bucket-pad before upload); the sweep runs up to twice (coarse +
+        # refine) on the same planes, so pin them device-resident once here
+        import jax
+
+        ex_state, ex_static = jax.device_put((ex_state, ex_static))
 
         # split class counts: pending (base) vs on-candidate (per-node)
         node_index = {n.node.name: e for e, n in enumerate(state_nodes)}

@@ -380,8 +380,6 @@ class TPUSolver:
         taint-filtered toleration checks; and topology countDomains
         (topology.go:231-276) for pre-existing matching pods.
         """
-        import jax.numpy as jnp
-
         from karpenter_core_tpu.apis import labels as labels_api
         from karpenter_core_tpu.scheduling import Taints
 
@@ -538,32 +536,35 @@ class TPUSolver:
                     if new:
                         cls_vol_add[c, e, i] = len(new - have)
 
+        # planes stay numpy: utils.compilecache bucket-pads them before the
+        # device upload (ops/solve.pad_planes), so converting here would cost
+        # an extra round trip over the relay
         ex_state = solve_ops.ExistingState(
-            used=jnp.asarray(used),
-            kmask=jnp.asarray(kmask),
-            kdef=jnp.asarray(kdef),
-            kneg=jnp.asarray(kneg),
-            kgt=jnp.asarray(kgt),
-            klt=jnp.asarray(klt),
-            zone=jnp.asarray(zone),
-            ct=jnp.asarray(ct),
-            ports=jnp.asarray(ports),
-            vol_used=jnp.asarray(vol_used),
-            pod_count=jnp.asarray(pod_count),
-            open_=jnp.asarray(open_),
+            used=np.asarray(used),
+            kmask=np.asarray(kmask),
+            kdef=np.asarray(kdef),
+            kneg=np.asarray(kneg),
+            kgt=np.asarray(kgt),
+            klt=np.asarray(klt),
+            zone=np.asarray(zone),
+            ct=np.asarray(ct),
+            ports=np.asarray(ports),
+            vol_used=np.asarray(vol_used),
+            pod_count=np.asarray(pod_count),
+            open_=np.asarray(open_),
         )
         ex_static = solve_ops.ExistingStatic(
-            alloc=jnp.asarray(alloc),
-            init=jnp.asarray(init),
-            tol=jnp.asarray(tol),
-            grp_node_member=jnp.asarray(grp_node_member),
-            grp_node_owner=jnp.asarray(grp_node_owner),
-            node_capacity=jnp.asarray(node_capacity),
-            node_tmpl=jnp.asarray(node_tmpl),
-            node_owned=jnp.asarray(node_owned),
-            vol_limit=jnp.asarray(vol_limit),
-            cls_vol_add=jnp.asarray(cls_vol_add),
-            cls_vol_per_pod=jnp.asarray(cls_vol_per_pod),
+            alloc=np.asarray(alloc),
+            init=np.asarray(init),
+            tol=np.asarray(tol),
+            grp_node_member=np.asarray(grp_node_member),
+            grp_node_owner=np.asarray(grp_node_owner),
+            node_capacity=np.asarray(node_capacity),
+            node_tmpl=np.asarray(node_tmpl),
+            node_owned=np.asarray(node_owned),
+            vol_limit=np.asarray(vol_limit),
+            cls_vol_add=np.asarray(cls_vol_add),
+            cls_vol_per_pod=np.asarray(cls_vol_per_pod),
         )
         return ex_state, ex_static
 

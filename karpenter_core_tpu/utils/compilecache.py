@@ -68,6 +68,7 @@ def enable() -> None:
             for t in (
                 solve_ops.ClassTensors,
                 solve_ops.Statics,
+                solve_ops.StaticArrays,
                 solve_ops.NodeState,
                 solve_ops.ExistingState,
                 solve_ops.ExistingStatic,
@@ -219,12 +220,18 @@ def run_solve(
 
     from karpenter_core_tpu.ops import solve as solve_ops
 
+    if os.environ.get("KC_TPU_SHAPE_BUCKETS", "1") != "0":
+        cls, statics_arrays, key_has_bounds, ex_state, ex_static = solve_ops.pad_planes(
+            cls, statics_arrays, key_has_bounds, ex_state, ex_static
+        )
     with ThreadPoolExecutor(max_workers=1) as pool:
-        upload = pool.submit(jax.device_put, (cls, statics_arrays))
+        upload = pool.submit(
+            jax.device_put, (cls, statics_arrays, ex_state, ex_static)
+        )
         fn = solve_callable(
             cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static, n_passes
         )
-        cls, statics_arrays = upload.result()
+        cls, statics_arrays, ex_state, ex_static = upload.result()
     if fn is None:
         return solve_ops._solve_jit(
             cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,

@@ -99,3 +99,115 @@ class TestExportCache:
         assert sum(len(n.pods) for n in res.new_nodes) == 8
         entries = [f for f in os.listdir(cache_dir) if f.endswith(".stablehlo")]
         assert entries, "TPUSolver.solve must populate the export cache"
+
+
+class TestShapeBuckets:
+    """ops/solve.pad_planes: nearby problem sizes share one executable and
+    padding is semantically invisible (ROADMAP compile-reuse item)."""
+
+    @staticmethod
+    def _solve_sig(results):
+        return (
+            sorted((d.provisioner_name, len(d.pods)) for d in results.new_nodes),
+            sorted((name, len(pods)) for name, pods in results.existing_assignments.items()),
+            len(results.failed_pods),
+        )
+
+    def test_bucket_grid(self):
+        assert solve_ops.bucket(1) == 8
+        assert solve_ops.bucket(8) == 8
+        assert solve_ops.bucket(9) == 12
+        assert solve_ops.bucket(13) == 16
+        assert solve_ops.bucket(17) == 24
+        assert solve_ops.bucket(25) == 32
+        assert solve_ops.bucket(100) == 128
+        assert solve_ops.bucket(3, floor=2) == 3
+        assert solve_ops.bucket(5, floor=4) == 6
+
+    def test_padding_parity(self, cache_dir, monkeypatch):
+        from karpenter_core_tpu.apis.objects import LabelSelector, TopologySpreadConstraint
+        from karpenter_core_tpu.testing import make_pod
+
+        provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(10))
+        solver = TPUSolver(provider, [make_provisioner()])
+        pods = (
+            make_pods(9, requests={"cpu": "1"})
+            + make_pods(4, requests={"cpu": "2", "memory": "1Gi"})
+            + [
+                make_pod(
+                    requests={"cpu": "500m"},
+                    labels={"app": "spread"},
+                    topology_spread=[
+                        TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key="topology.kubernetes.io/zone",
+                            label_selector=LabelSelector(match_labels={"app": "spread"}),
+                        )
+                    ],
+                )
+                for _ in range(6)
+            ]
+        )
+        sigs = {}
+        for buckets in ("0", "1"):
+            monkeypatch.setenv("KC_TPU_SHAPE_BUCKETS", buckets)
+            sigs[buckets] = self._solve_sig(solver.solve(pods))
+        assert sigs["0"] == sigs["1"]
+
+    def test_nearby_sizes_share_executable(self, cache_dir, monkeypatch):
+        monkeypatch.setenv("KC_TPU_SHAPE_BUCKETS", "1")
+        provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(10))
+        solver = TPUSolver(provider, [make_provisioner()])
+        solver.solve(
+            make_pods(11, requests={"cpu": "1"}) + make_pods(5, requests={"cpu": "2"})
+        )
+        first = len(compilecache._memo)
+        # different pod counts, one more class — same C/K/V buckets
+        solver.solve(
+            make_pods(14, requests={"cpu": "1"})
+            + make_pods(3, requests={"cpu": "2"})
+            + make_pods(2, requests={"memory": "512Mi"})
+        )
+        assert len(compilecache._memo) == first
+
+    def test_padded_groups_and_existing_nodes(self, cache_dir, monkeypatch):
+        """Topology groups + existing nodes keep exact results under padding."""
+        from karpenter_core_tpu.apis import labels as labels_api
+        from karpenter_core_tpu.apis.objects import LabelSelector, PodAffinityTerm
+        from karpenter_core_tpu.testing import make_node, make_pod
+        from karpenter_core_tpu.testing.harness import make_environment
+
+        env = make_environment()
+        node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+                labels_api.LABEL_CAPACITY_TYPE: "spot",
+                labels_api.LABEL_NODE_INITIALIZED: "true",
+                labels_api.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            },
+            allocatable={"cpu": 16, "memory": "64Gi", "pods": 110},
+        )
+        env.kube.create(node)
+        solver = TPUSolver(env.provider, [make_provisioner()])
+        pods = make_pods(6, requests={"cpu": "1"}) + [
+            make_pod(
+                requests={"cpu": "500m"},
+                labels={"app": "anti"},
+                pod_anti_affinity=[
+                    PodAffinityTerm(
+                        topology_key="kubernetes.io/hostname",
+                        label_selector=LabelSelector(match_labels={"app": "anti"}),
+                    )
+                ],
+            )
+            for _ in range(3)
+        ]
+        sigs = {}
+        for buckets in ("0", "1"):
+            monkeypatch.setenv("KC_TPU_SHAPE_BUCKETS", buckets)
+            sigs[buckets] = self._solve_sig(
+                solver.solve(pods, state_nodes=env.cluster.snapshot_nodes())
+            )
+        assert sigs["0"] == sigs["1"]
+        assert sigs["1"][1], "some pods should land on the existing node"
