@@ -395,7 +395,7 @@ class ProvisioningController:
             KernelUnsupported,
             PodClass,
             _class_signature,
-            build_pod_class,
+            build_pod_ladder,
         )
 
         supported: Dict[tuple, List[Pod]] = {}
@@ -405,7 +405,7 @@ class ProvisioningController:
             sig = _class_signature(pod)
             if sig not in protos:
                 try:
-                    protos[sig] = build_pod_class(pod)
+                    protos[sig] = build_pod_ladder(pod)
                 except KernelUnsupported:
                     protos[sig] = None
             (supported if protos[sig] is not None else unsupported).setdefault(

@@ -111,7 +111,7 @@ class PodIngest:
         from karpenter_core_tpu.models.snapshot import (
             KernelUnsupported,
             _class_signature,
-            build_pod_class,
+            build_pod_ladder,
         )
 
         if pod.uid in self._by_uid:
@@ -121,7 +121,7 @@ class PodIngest:
         if slot is None:
             proto, error = None, None
             try:
-                proto = build_pod_class(pod)
+                proto = build_pod_ladder(pod)
             except KernelUnsupported as e:
                 error = e
             slot = _ClassSlot(proto, error)

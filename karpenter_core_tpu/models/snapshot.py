@@ -23,7 +23,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from karpenter_core_tpu.apis import labels as labels_api
-from karpenter_core_tpu.apis.objects import Pod
+from karpenter_core_tpu.apis.objects import SCHEDULE_ANYWAY, Pod
 from karpenter_core_tpu.apis.v1alpha5 import Provisioner
 from karpenter_core_tpu.cloudprovider import InstanceType
 from karpenter_core_tpu.models.vocab import Vocabulary, encode_value_set
@@ -68,6 +68,17 @@ class PodClass:
     host_anti: Optional[GroupSpec] = None
     # selector objects per owned group (for membership evaluation)
     selectors: Dict[GroupSpec, object] = field(default_factory=dict)
+    # preference ladder (preferences.go:38-46 pre-applied): the next, more
+    # relaxed variant of this shape.  The kernel rolls failed counts down the
+    # chain between scan passes; variants carry one relaxed representative
+    # pod and schedule pods from the root's list (solver.tpu.decode)
+    relax_to: Optional["PodClass"] = None
+    is_ladder_variant: bool = False
+    # anti-affinity slots filled from a PREFERRED term: the owner still seeks
+    # zero-count domains, but never registers inverse counts — the reference
+    # intentionally doesn't track inverse anti preferences (topology.go:203-206)
+    zone_anti_soft: bool = False
+    host_anti_soft: bool = False
 
     @property
     def count(self) -> int:
@@ -131,6 +142,9 @@ class EncodedSnapshot:
     cls_it: np.ndarray = None  # bool[C, I]
     cls_requests: np.ndarray = None  # f32[C, R]
     cls_count: np.ndarray = None  # i32[C]
+    cls_relax_next: np.ndarray = None  # i32[C] ladder successor index (-1 none)
+    cls_anti_soft: np.ndarray = None  # bool[C, 2] (zone, host) anti slot is preferred
+    cls_root: np.ndarray = None  # i32[C] ladder root index (self when not a variant)
     cls_tol: np.ndarray = None  # bool[C, T] tolerates template taints
     # host ports [P axis: distinct (port, protocol) pairs in play]
     ports: List[tuple] = None
@@ -274,7 +288,8 @@ class KernelUnsupported(Exception):
 
 def build_pod_class(pod: Pod) -> PodClass:
     """Build the class-level derived state (requirements, requests, owned
-    topology groups) from one representative pod.  Raises KernelUnsupported
+    topology groups) from one representative pod's CURRENT spec — soft
+    constraints still on the spec count as hard.  Raises KernelUnsupported
     for shapes the kernel doesn't model."""
     cls = PodClass(
         pods=[],
@@ -285,16 +300,108 @@ def build_pod_class(pod: Pod) -> PodClass:
     return cls
 
 
+MAX_LADDER_VARIANTS = 5
+
+
+def build_pod_ladder(pod: Pod) -> PodClass:
+    """The root of a strict-to-bare variant chain for one pod shape.
+
+    The reference schedules with every soft constraint treated as hard, then
+    relaxes one constraint per failed round (preferences.go:38-46,
+    scheduler.go:117-123).  The kernel can't mutate specs mid-scan, so the
+    ladder is materialized ahead of time: apply Preferences.relax stepwise to
+    a copied representative and build one PodClass per step.  Variants whose
+    shape the kernel can't model are skipped (their preference level is
+    silently forfeited — a soft-placement-quality deviation only); if no
+    variant is representable the whole shape routes to the host path.  The
+    kernel rolls failed counts down the chain between scan passes
+    (ops/solve.solve_core), which is the tensor form of relax-and-requeue.
+
+    Returns the first (strictest representable) variant with an empty pods
+    list; successors hang off ``relax_to`` carrying one relaxed representative
+    each."""
+    import copy
+
+    from karpenter_core_tpu.solver.preferences import Preferences
+
+    specs = [pod]  # build_pod_class only reads the spec
+    if _has_relaxable(pod):
+        rep = copy.deepcopy(pod)
+        prefs = Preferences()
+        while prefs.relax(rep):
+            specs.append(copy.deepcopy(rep))
+    variants: List[PodClass] = []
+    last_error: Optional[KernelUnsupported] = None
+    for spec_pod in specs:
+        try:
+            cls = build_pod_class(spec_pod)
+        except KernelUnsupported as e:
+            last_error = e
+            continue
+        cls.pods = [spec_pod]
+        variants.append(cls)
+    if not variants:
+        raise last_error or KernelUnsupported("no kernel-supported variant")
+    if len(variants) > MAX_LADDER_VARIANTS:
+        raise KernelUnsupported(
+            f"preference ladder depth {len(variants)} exceeds the kernel's "
+            f"{MAX_LADDER_VARIANTS}-variant cap"
+        )
+    for parent, child in zip(variants, variants[1:]):
+        parent.relax_to = child
+    for child in variants[1:]:
+        child.is_ladder_variant = True
+    root = variants[0]
+    root.pods = []
+    return root
+
+
+def _has_relaxable(pod: Pod) -> bool:
+    """Whether Preferences.relax would find anything — cheap pre-check so the
+    dominant no-soft-constraint shape skips the ladder deepcopies."""
+    if any(
+        c.when_unsatisfiable == SCHEDULE_ANYWAY
+        for c in pod.spec.topology_spread_constraints
+    ):
+        return True
+    affinity = pod.spec.affinity
+    if affinity is None:
+        return False
+    na = affinity.node_affinity
+    if na is not None and (
+        na.preferred
+        or (na.required is not None and len(na.required.node_selector_terms) > 1)
+    ):
+        return True
+    return bool(
+        (affinity.pod_affinity is not None and affinity.pod_affinity.preferred)
+        or (affinity.pod_anti_affinity is not None and affinity.pod_anti_affinity.preferred)
+    )
+
+
+def ladder_chain(root: PodClass) -> List[PodClass]:
+    """[root, variant1, ...] in relax order."""
+    chain = [root]
+    node = root.relax_to
+    while node is not None:
+        chain.append(node)
+        node = node.relax_to
+    return chain
+
+
 def finalize_classes(classes: List[PodClass]) -> List[PodClass]:
-    """Order classes for the kernel scan (mutates in place, returns them).
-    FFD: cpu desc, then memory desc (queue.go:74-110)."""
-    classes.sort(
+    """Order classes for the kernel scan (mutates in place, returns a new
+    flattened list).  FFD over ladder roots: cpu desc, then memory desc
+    (queue.go:74-110); each root's relaxation variants follow it immediately
+    so failed counts roll forward in scan order."""
+    roots = [c for c in classes if not c.is_ladder_variant]
+    roots.sort(
         key=lambda c: (
             -c.requests.get(resources_util.CPU, 0.0),
             -c.requests.get(resources_util.MEMORY, 0.0),
         )
     )
-    return classes
+    return [cls for root in roots for cls in ladder_chain(root)]
 
 
 MAX_SCAN_PASSES = 3
@@ -350,7 +457,7 @@ def classify_pods(pods: List[Pod]) -> List[PodClass]:
         sig = _class_signature(pod)
         cls = groups.get(sig)
         if cls is None:
-            cls = build_pod_class(pod)
+            cls = build_pod_ladder(pod)
             groups[sig] = cls
             order.append(sig)
         cls.pods.append(pod)
@@ -376,12 +483,15 @@ def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
         setattr(cls, attr, spec)
         cls.selectors[spec] = selector
 
+    # ALL spreads — ScheduleAnyway included — and both required and preferred
+    # affinity terms act as hard constraints while present on the spec
+    # (topology.go:280-320 builds groups from soft terms too); build_pod_ladder
+    # materializes the relaxed variants by removing soft terms stepwise, so
+    # strictness lives in the spec, not here.
+    # Self-selecting spreads water-fill (counts move with each placement);
+    # non-self-selecting ones reduce to a static within-skew domain mask —
+    # the kernel handles both (ops/solve.py zone-spread phases, host caps)
     for constraint in pod.spec.topology_spread_constraints:
-        if constraint.when_unsatisfiable != "DoNotSchedule":
-            continue  # ScheduleAnyway spreads relax away on failure
-        # self-selecting spreads water-fill (counts move with each placement);
-        # non-self-selecting ones reduce to a static within-skew domain mask —
-        # the kernel handles both (ops/solve.py zone-spread phases, host caps)
         spec = _group_spec(
             GRP_SPREAD, constraint.topology_key, constraint.label_selector, constraint.max_skew
         )
@@ -389,15 +499,25 @@ def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
     affinity = pod.spec.affinity
     if affinity is not None:
         if affinity.pod_affinity is not None:
-            for term in affinity.pod_affinity.required:
+            terms = list(affinity.pod_affinity.required) + [
+                w.pod_affinity_term for w in affinity.pod_affinity.preferred
+            ]
+            for term in terms:
                 spec = _group_spec(GRP_AFFINITY, term.topology_key, term.label_selector, UNLIMITED)
                 set_slot(
                     "zone_affinity" if spec.is_zone else "host_affinity", spec, term.label_selector
                 )
         if affinity.pod_anti_affinity is not None:
-            for term in affinity.pod_anti_affinity.required:
+            n_required = len(affinity.pod_anti_affinity.required)
+            terms = list(affinity.pod_anti_affinity.required) + [
+                w.pod_affinity_term for w in affinity.pod_anti_affinity.preferred
+            ]
+            for i, term in enumerate(terms):
                 spec = _group_spec(GRP_ANTI, term.topology_key, term.label_selector, UNLIMITED)
-                set_slot("zone_anti" if spec.is_zone else "host_anti", spec, term.label_selector)
+                slot = "zone_anti" if spec.is_zone else "host_anti"
+                set_slot(slot, spec, term.label_selector)
+                if i >= n_required:
+                    setattr(cls, f"{slot}_soft", True)
     for container in pod.spec.containers:
         for p in container.ports:
             if p.host_port and p.host_ip not in ("", "0.0.0.0", "::"):
@@ -430,7 +550,13 @@ def encode_snapshot(
     classes incrementally (models.columnar.PodIngest)."""
     if classes is None:
         classes = classify_pods(pods)
-    scan_passes = affinity_scan_passes(classes)
+    # each relax step needs its own scan pass for the rolled counts to be
+    # retried (the host path's fail -> Relax -> re-push round)
+    ladder_extra = max(
+        (len(ladder_chain(c)) - 1 for c in classes if not c.is_ladder_variant),
+        default=0,
+    )
+    scan_passes = affinity_scan_passes(classes) + ladder_extra
 
     # -- axes -----------------------------------------------------------------
     all_its: List[InstanceType] = []
@@ -602,6 +728,20 @@ def encode_snapshot(
     snap.cls_it = np.zeros((C, I), dtype=bool)
     snap.cls_requests = np.zeros((C, R), dtype=np.float32)
     snap.cls_count = np.zeros(C, dtype=np.int32)
+    snap.cls_relax_next = np.full(C, -1, dtype=np.int32)
+    snap.cls_anti_soft = np.zeros((C, 2), dtype=bool)
+    for c, cls in enumerate(classes):
+        snap.cls_anti_soft[c, 0] = cls.zone_anti_soft
+        snap.cls_anti_soft[c, 1] = cls.host_anti_soft
+    snap.cls_root = np.arange(C, dtype=np.int32)
+    for c in range(C):
+        nxt = snap.cls_relax_next[c]
+        if nxt >= 0:  # successors always follow their root
+            snap.cls_root[nxt] = snap.cls_root[c]
+    index_of = {id(cls): c for c, cls in enumerate(classes)}
+    for c, cls in enumerate(classes):
+        if cls.relax_to is not None:
+            snap.cls_relax_next[c] = index_of[id(cls.relax_to)]
     snap.cls_tol = np.zeros((C, T), dtype=bool)
     # -- topology groups (hash-deduped, topologygroup.go:137-153) -------------
     group_index: Dict[GroupSpec, int] = {}
@@ -659,7 +799,9 @@ def encode_snapshot(
         requests[resources_util.PODS] = 1.0
         for r, name in enumerate(resources):
             snap.cls_requests[c, r] = requests.get(name, 0.0)
-        snap.cls_count[c] = cls.count
+        # variants start empty: the kernel rolls failed root counts into
+        # them between scan passes (one relax step per pass)
+        snap.cls_count[c] = 0 if cls.is_ladder_variant else cls.count
         example = cls.pods[0]
         for t, tmpl in enumerate(templates):
             snap.cls_tol[c, t] = Taints.of(tmpl.taints).tolerates(example) is None

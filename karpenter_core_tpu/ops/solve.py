@@ -329,6 +329,13 @@ class ClassTensors(NamedTuple):
     ports: jnp.ndarray  # bool[C, P] host ports each pod of the class binds
     groups: jnp.ndarray  # i32[C, 6]: owned group per kind (G = none):
     # [zone_spread, host_spread, zone_aff, host_aff, zone_anti, host_anti]
+    relax_next: jnp.ndarray  # i32[C] preference-ladder successor (-1 none):
+    # failed counts roll to the successor class between scan passes
+    anti_soft: jnp.ndarray  # bool[C, 2] (zone, host) anti slot came from a
+    # preferred term: owner seeks zero-count domains but registers no inverse
+    # counts (topology.go:203-206 skips inverse tracking for preferences)
+    root: jnp.ndarray  # i32[C] ladder root index (self when not a variant):
+    # shared-volume adds are once-per-(LADDER, node), tracked at the root
 
 
 def _phase_existing(
@@ -868,15 +875,19 @@ def _class_step(
     member_zone_pos = member_row & statics.grp_is_zone & ~statics.grp_is_anti
     member_zone_anti = member_row & statics.grp_is_zone & statics.grp_is_anti
     member_host = member_row & ~statics.grp_is_zone
+    # preferred-anti owners register no inverse counts (the reference skips
+    # inverse tracking for preferences, topology.go:203-206)
+    own_zan_inv = jnp.where(cls.anti_soft[0], 0, own_onehot(g_zan).astype(jnp.int32))
+    own_han_inv = jnp.where(cls.anti_soft[1], 0, own_onehot(g_han).astype(jnp.int32))
     topo = TopoCounts(
         zone_fwd=topo.zone_fwd
         + member_zone_pos[:, None] * zone_sing[None, :]
         + member_zone_anti[:, None] * zone_full[None, :],
-        zone_inv=topo.zone_inv + own_onehot(g_zan)[:, None] * zone_full[None, :],
+        zone_inv=topo.zone_inv + own_zan_inv[:, None] * zone_full[None, :],
         host_fwd_ex=topo.host_fwd_ex + member_host[:, None] * a_ex_f[None, :],
-        host_inv_ex=topo.host_inv_ex + own_onehot(g_han)[:, None] * a_ex_f[None, :],
+        host_inv_ex=topo.host_inv_ex + own_han_inv[:, None] * a_ex_f[None, :],
         host_fwd_new=topo.host_fwd_new + member_host[:, None] * a_new_f[None, :],
-        host_inv_new=topo.host_inv_new + own_onehot(g_han)[:, None] * a_new_f[None, :],
+        host_inv_new=topo.host_inv_new + own_han_inv[:, None] * a_new_f[None, :],
     )
 
     failed = m - placed_total
@@ -977,15 +988,27 @@ def solve_core(
         )
         assign = assign + a
         assign_ex = assign_ex + a_ex
-        count_left = failed
+        # roll failed counts one step down the preference ladder (the host
+        # path's fail -> Preferences.Relax -> re-push round); classes with no
+        # successor retry as themselves (late-affinity re-scan)
+        roll_to = jnp.where(
+            class_tensors.relax_next >= 0, class_tensors.relax_next, cls_indices
+        )
+        count_left = jnp.zeros_like(failed).at[roll_to].add(failed)
         if p + 1 < n_passes:
-            # shared volume adds are once-per-(class, node): a class placing on
-            # the same node again in the next pass must not re-add its PVC
-            # set, so rebuild vol_used from the accumulated assignment
+            # shared volume adds are once-per-(LADDER, node): ladder rows
+            # share one claim profile, so a root placing in pass 1 and its
+            # variant landing on the same node in pass 2 must count the claim
+            # set once — collapse placements to the root row before the add
             state_c, ex_c, topo_c, rem_c = carry
             placed_any = (assign_ex > 0).astype(jnp.int32)  # [C, E]
+            placed_root = (
+                jnp.zeros_like(placed_any).at[class_tensors.root].max(placed_any)
+            )
+            is_root = (class_tensors.root == cls_indices)[:, None].astype(jnp.int32)
             shared = jnp.sum(
-                placed_any[:, :, None] * existing_static.cls_vol_add, axis=0
+                (placed_root * is_root)[:, :, None] * existing_static.cls_vol_add,
+                axis=0,
             )
             per_pod = jnp.sum(
                 assign_ex[:, :, None] * existing_static.cls_vol_per_pod[:, None, :],
@@ -1122,6 +1145,9 @@ def prepare_host(snapshot: EncodedSnapshot):
         tol=snapshot.cls_tol,
         ports=snapshot.cls_ports,
         groups=snapshot.cls_groups,
+        relax_next=snapshot.cls_relax_next,
+        anti_soft=snapshot.cls_anti_soft,
+        root=snapshot.cls_root,
     )
     it_t = mask_ops.ReqTensor(
         snapshot.it_mask,
@@ -1290,6 +1316,10 @@ def pad_planes(cls, statics_arrays, key_has_bounds, ex_state=None, ex_static=Non
         tol=_pad_axis(np.asarray(cls.tol), 0, c_new, False),
         ports=_pad_axis(_pad_axis(np.asarray(cls.ports), -1, p_new, False), 0, c_new, False),
         groups=_pad_axis(groups, 0, c_new, g1_new - 1),
+        relax_next=_pad_axis(np.asarray(cls.relax_next), 0, c_new, -1),
+        anti_soft=_pad_axis(np.asarray(cls.anti_soft), 0, c_new, False),
+        # padded rows never place (count 0), so any root value is inert
+        root=_pad_axis(np.asarray(cls.root), 0, c_new, 0),
     )
 
     statics_arrays = sa._replace(

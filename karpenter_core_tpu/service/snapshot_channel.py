@@ -136,7 +136,7 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
         return provisioners, daemonset_pods, state_nodes, bound, resolver
 
     def _solve_classes(self, request: bytes, context) -> bytes:
-        from karpenter_core_tpu.models.snapshot import build_pod_class
+        from karpenter_core_tpu.models.snapshot import build_pod_ladder
 
         try:
             req = msgpack.unpackb(request)
@@ -144,7 +144,7 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             reps = [codec.pod_from_dict(e["pod"]) for e in entries]
             classes = []
             for rep, entry in zip(reps, entries):
-                cls = build_pod_class(rep)
+                cls = build_pod_ladder(rep)
                 cls.pods = [rep] * int(entry["count"])
                 classes.append(cls)
             req_idx = {id(rep): i for i, rep in enumerate(reps)}

@@ -98,6 +98,8 @@ class TPUConsolidationSearch:
         ex_cls_count = np.zeros((C, E), dtype=np.int32)
         base_counts = np.zeros(C, dtype=np.int32)
         for c, cls in enumerate(snapshot.classes):
+            if cls.is_ladder_variant:
+                continue  # variants hold one representative copy, not real pods
             for pod in cls.pods:
                 if pod.spec.node_name and pod.spec.node_name in candidate_names:
                     ex_cls_count[c, node_index[pod.spec.node_name]] += 1

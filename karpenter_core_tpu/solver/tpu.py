@@ -332,6 +332,12 @@ class TPUSolver:
         class_volumes = []
         seen: Dict[str, int] = {}  # pvc id -> class index
         for c, cls in enumerate(classes):
+            if cls.is_ladder_variant:
+                # ladder variants schedule the ROOT's pods, so they carry the
+                # root's volume profile — resolving their lone representative
+                # would misread the shared claims as cross-class sharing
+                class_volumes.append(None)
+                continue
             member_sets = [resolve(pod) for pod in cls.pods]
             first = member_sets[0]
             for ids in first.values():
@@ -364,6 +370,12 @@ class TPUSolver:
                             )
                         all_ids.add(pvc_id)
             class_volumes.append({"shared": {}, "per_pod": counts})
+        # backfill variants with their root's profile (chain order: the root
+        # always precedes its variants in the finalized class list)
+        index_of = {id(cls): c for c, cls in enumerate(classes)}
+        for c, cls in enumerate(classes):
+            if cls.relax_to is not None:
+                class_volumes[index_of[id(cls.relax_to)]] = class_volumes[c]
         return class_volumes
 
     def encode_existing(
@@ -651,23 +663,38 @@ class TPUSolver:
             )
 
         state_nodes = state_nodes or []
+        # preference-ladder variants schedule pods from their ROOT's list: all
+        # rows of one ladder share a cursor into the root's (identical) pods
+        relax_next = snapshot.cls_relax_next
+        n_classes = len(snapshot.classes)
+        root_of = list(range(n_classes))
+        if relax_next is not None:
+            for c in range(n_classes):  # successors always follow their root
+                nxt = int(relax_next[c])
+                if nxt >= 0:
+                    root_of[nxt] = root_of[c]
+        cursors = [0] * n_classes  # keyed by root index
         for c, cls in enumerate(snapshot.classes):
-            cursor = 0
+            r = root_of[c]
+            pods, cursor = snapshot.classes[r].pods, cursors[r]
             # existing-node placements first (they were tried first in-kernel)
             ex_idx = np.nonzero(assign_ex[c] > 0)[0]
             for e, take in zip(ex_idx.tolist(), assign_ex[c][ex_idx].tolist()):
                 if e < len(state_nodes):
                     name = state_nodes[e].node.name
                     results.existing_assignments.setdefault(name, []).extend(
-                        cls.pods[cursor : cursor + take]
+                        pods[cursor : cursor + take]
                     )
                 cursor += take
             node_idx = np.nonzero(assign[c] > 0)[0]
             counts = assign[c][node_idx]
             for n, take in zip(node_idx.tolist(), counts.tolist()):
-                nodes[n].pods.extend(cls.pods[cursor : cursor + take])
+                nodes[n].pods.extend(pods[cursor : cursor + take])
                 cursor += take
-            results.failed_pods.extend(cls.pods[cursor:])
+            cursors[r] = cursor
+        for c, cls in enumerate(snapshot.classes):
+            if root_of[c] == c:
+                results.failed_pods.extend(cls.pods[cursors[c] :])
         results.new_nodes = [nodes[n] for n in sorted(nodes)]
         return results
 

@@ -1,0 +1,277 @@
+"""Kernel preference ladder: soft constraints are tried strictly, then
+relaxed one step per scan pass by rolling failed counts down pre-built
+variant classes (models.snapshot.build_pod_ladder, ops/solve.solve_core roll).
+
+Mirrors the reference's fail -> Preferences.Relax -> re-push round
+(preferences.go:38-46, scheduler.go:117-123) and its soft-term treatment:
+preferred pod (anti)affinity and ALL spreads act as hard while on the spec
+(topology.go:280-320), preferred anti never registers inverse counts
+(topology.go:203-206), the heaviest preferred node-affinity term folds into
+requirements (requirements.go:61-78).
+"""
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_IN,
+    SCHEDULE_ANYWAY,
+    LabelSelector,
+    NodeSelectorRequirement,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from karpenter_core_tpu.models.snapshot import (
+    build_pod_ladder,
+    classify_pods,
+    ladder_chain,
+)
+from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
+
+from tests.test_tpu_solver import ZONE, compare, tpu_solve
+
+HOSTNAME = labels_api.LABEL_HOSTNAME
+
+
+def anyway_spread(app, key=ZONE, max_skew=1):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=key,
+        when_unsatisfiable=SCHEDULE_ANYWAY,
+        label_selector=LabelSelector(match_labels={"app": app}),
+    )
+
+
+def preferred_anti(app, key=HOSTNAME, weight=1):
+    return WeightedPodAffinityTerm(
+        weight=weight,
+        pod_affinity_term=PodAffinityTerm(
+            topology_key=key,
+            label_selector=LabelSelector(match_labels={"app": app}),
+        ),
+    )
+
+
+class TestLadderConstruction:
+    def test_plain_pod_single_variant(self):
+        root = build_pod_ladder(make_pod(requests={"cpu": "1"}))
+        assert len(ladder_chain(root)) == 1
+        assert root.relax_to is None and not root.is_ladder_variant
+
+    def test_preferred_node_affinity_two_variants(self):
+        root = build_pod_ladder(
+            make_pod(
+                requests={"cpu": "1"},
+                node_preferences=[NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-2"])],
+            )
+        )
+        chain = ladder_chain(root)
+        assert len(chain) == 2
+        assert chain[0].requirements.has(ZONE)
+        assert not chain[1].requirements.has(ZONE)
+        assert chain[1].is_ladder_variant
+
+    def test_schedule_anyway_spread_two_variants(self):
+        root = build_pod_ladder(
+            make_pod(
+                requests={"cpu": "1"},
+                labels={"app": "w"},
+                topology_spread=[anyway_spread("w")],
+            )
+        )
+        chain = ladder_chain(root)
+        assert len(chain) == 2
+        assert chain[0].zone_spread is not None
+        assert chain[1].zone_spread is None
+
+    def test_preferred_anti_marks_soft(self):
+        root = build_pod_ladder(
+            make_pod(requests={"cpu": "1"}, labels={"app": "c"},
+                     pod_anti_affinity_preferred=[preferred_anti("c", key=ZONE)])
+        )
+        chain = ladder_chain(root)
+        assert chain[0].zone_anti is not None and chain[0].zone_anti_soft
+        assert chain[1].zone_anti is None
+
+    def test_unsupported_strict_variant_skipped(self):
+        # region-key ScheduleAnyway spread: the strict shape is not kernel
+        # representable; the ladder starts at the relaxed (bare) variant
+        root = build_pod_ladder(
+            make_pod(
+                requests={"cpu": "1"},
+                labels={"app": "r"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key="topology.kubernetes.io/region",
+                        when_unsatisfiable=SCHEDULE_ANYWAY,
+                        label_selector=LabelSelector(match_labels={"app": "r"}),
+                    )
+                ],
+            )
+        )
+        chain = ladder_chain(root)
+        assert len(chain) == 1
+        assert chain[0].zone_spread is None
+
+    def test_classify_flattens_ladders_root_first(self):
+        pods = make_pods(
+            3, requests={"cpu": "1"}, labels={"app": "x"},
+            topology_spread=[anyway_spread("x")],
+        ) + make_pods(2, requests={"cpu": "2"})
+        classes = classify_pods(pods)
+        # big plain class first (FFD), then the ladder root + variant
+        assert [c.is_ladder_variant for c in classes] == [False, False, True]
+        assert classes[1].relax_to is classes[2]
+        assert classes[1].count == 3 and not classes[2].pods == classes[1].pods
+
+
+class TestLadderSolves:
+    def test_impossible_preferred_node_affinity_relaxes(self):
+        host, tpu = compare(
+            lambda: make_pods(
+                4, requests={"cpu": "1"},
+                node_preferences=[
+                    NodeSelectorRequirement("no-such-label", OP_IN, ["x"])
+                ],
+            )
+        )
+        assert not tpu.failed_pods
+
+    def test_satisfiable_preferred_node_affinity_honored(self):
+        results = tpu_solve(
+            make_pods(
+                4, requests={"cpu": "1"},
+                node_preferences=[NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-2"])],
+            ),
+            [make_provisioner()],
+        )
+        assert not results.failed_pods
+        assert {z for n in results.new_nodes for z in n.zones} == {"test-zone-2"}
+
+    def test_schedule_anyway_spread_honored_when_possible(self):
+        results = tpu_solve(
+            make_pods(
+                9, requests={"cpu": "10m"}, labels={"app": "w"},
+                topology_spread=[anyway_spread("w")],
+            ),
+            [make_provisioner()],
+        )
+        assert not results.failed_pods
+        counts = {}
+        for node in results.new_nodes:
+            assert len(node.zones) == 1
+            counts[node.zones[0]] = counts.get(node.zones[0], 0) + len(node.pods)
+        assert sorted(counts.values()) == [3, 3, 3]
+
+    def test_schedule_anyway_spread_relaxes_against_pinned_zone(self):
+        host, tpu = compare(
+            lambda: make_pods(
+                6, requests={"cpu": "1"}, labels={"app": "d"},
+                node_requirements=[NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1"])],
+                topology_spread=[anyway_spread("d")],
+            )
+        )
+        assert not tpu.failed_pods
+
+    def test_preferred_hostname_anti_one_per_node(self):
+        results = tpu_solve(
+            make_pods(
+                3, requests={"cpu": "100m"}, labels={"app": "c"},
+                pod_anti_affinity_preferred=[preferred_anti("c")],
+            ),
+            [make_provisioner()],
+        )
+        assert [len(n.pods) for n in results.new_nodes] == [1, 1, 1]
+
+    def test_preferred_zone_anti_violation_allowed_parity(self):
+        # topology_test.go:1478: soft anti never blocks scheduling outright
+        compare(
+            lambda: make_pods(
+                4, requests={"cpu": "10m"}, labels={"app": "x"},
+                pod_anti_affinity_preferred=[preferred_anti("x", key=ZONE)],
+            )
+        )
+
+    def test_preferred_pod_affinity_groups_then_relaxes(self):
+        # followers prefer the target's zone; when the target class is absent
+        # the preference relaxes away instead of stranding the followers
+        def pods():
+            return make_pods(
+                5, requests={"cpu": "1"},
+                pod_affinity=None,
+                pod_affinity_preferred=[
+                    WeightedPodAffinityTerm(
+                        weight=10,
+                        pod_affinity_term=PodAffinityTerm(
+                            topology_key=ZONE,
+                            label_selector=LabelSelector(match_labels={"app": "absent"}),
+                        ),
+                    )
+                ],
+            )
+
+        host, tpu = compare(pods)
+        assert not tpu.failed_pods
+
+    def test_ladder_counts_conserved(self):
+        # mixed batch: every pod is either scheduled or failed exactly once
+        pods = (
+            make_pods(7, requests={"cpu": "1"}, labels={"app": "a"},
+                      topology_spread=[anyway_spread("a")])
+            + make_pods(5, requests={"cpu": "2"})
+            + make_pods(3, requests={"cpu": "100m"}, labels={"app": "c"},
+                        pod_anti_affinity_preferred=[preferred_anti("c")])
+        )
+        results = tpu_solve(pods, [make_provisioner()])
+        placed = sum(len(n.pods) for n in results.new_nodes)
+        placed += sum(len(v) for v in results.existing_assignments.values())
+        assert placed + len(results.failed_pods) == len(pods)
+        uids = [p.uid for n in results.new_nodes for p in n.pods]
+        uids += [p.uid for p in results.failed_pods]
+        assert len(uids) == len(set(uids)), "a pod was placed twice"
+
+
+class TestLadderConsolidation:
+    def test_soft_constraint_pods_do_not_block_consolidation(self):
+        """Ladder variant rows carry representative copies, not real pods —
+        consolidation's displaced-pod accounting must skip them or empty
+        candidates grow phantom pods and never consolidate."""
+        from karpenter_core_tpu.controllers.deprovisioning import (
+            Action,
+            candidate_nodes,
+        )
+        from karpenter_core_tpu.solver.consolidation import TPUConsolidationSearch
+        from karpenter_core_tpu.testing.harness import (
+            expect_provisioned,
+            make_environment,
+        )
+
+        env = make_environment()
+        env.kube.create(make_provisioner(consolidation_enabled=True))
+        pods = make_pods(
+            2, requests={"cpu": "600m"}, labels={"app": "s"},
+            topology_spread=[anyway_spread("s", key=HOSTNAME, max_skew=1)],
+        )
+        for pod in pods:
+            expect_provisioned(env, pod)
+            env.make_all_nodes_ready()
+        for pod in env.kube.list_pods():
+            env.kube.delete(pod, force=True)
+        env.clock.step(21)
+        dep = env.deprovisioning
+        candidates = sorted(
+            candidate_nodes(
+                env.cluster, env.kube, env.clock, env.provider,
+                dep.multi_node_consolidation.should_deprovision,
+            ),
+            key=lambda c: c.disruption_cost,
+        )
+        assert len(candidates) == 2
+        search = TPUConsolidationSearch(env.provider, env.kube.list_provisioners())
+        cmd = search.compute_command(
+            candidates, pending_pods=[],
+            state_nodes=env.cluster.snapshot_nodes(),
+            bound_pods=env.kube.list_pods(),
+        )
+        assert cmd.action == Action.DELETE
+        assert len(cmd.nodes_to_remove) == 2
