@@ -42,13 +42,31 @@ GRP_ANTI = 2
 @dataclass(frozen=True)
 class GroupSpec:
     """A topology group: the hash-deduped identity the reference tracks
-    (topologygroup.go:137-153) — one per distinct (type, key, selector, skew)
-    across the whole batch, shared by every class that owns or matches it."""
+    (topologygroup.go:137-153) — one per distinct (type, key, namespaces,
+    selector, skew) across the whole batch, shared by every class that owns
+    or matches it.  ``namespaces`` scopes membership exactly as the
+    reference's group namespace set does: spreads count only the owner's
+    namespace (topology.go:280-282), affinity terms count term.namespaces or
+    the owner's namespace (topology.go:287-320 buildNamespaceList)."""
 
     gtype: int  # GRP_SPREAD | GRP_AFFINITY | GRP_ANTI
     is_zone: bool  # zone key vs hostname key
     selector_sig: tuple
     skew: int
+    namespaces: frozenset = frozenset()
+
+
+@dataclass(frozen=True)
+class GroupScope:
+    """Membership test for a group: label selector AND namespace scope."""
+
+    selector: object  # Optional[LabelSelector]
+    namespaces: frozenset
+
+    def matches_pod(self, pod: Pod) -> bool:
+        if (pod.namespace or "") not in self.namespaces:
+            return False
+        return self.selector is not None and self.selector.matches(pod.metadata.labels)
 
 
 @dataclass
@@ -66,8 +84,9 @@ class PodClass:
     host_affinity: Optional[GroupSpec] = None
     zone_anti: Optional[GroupSpec] = None
     host_anti: Optional[GroupSpec] = None
-    # selector objects per owned group (for membership evaluation)
-    selectors: Dict[GroupSpec, object] = field(default_factory=dict)
+    # GroupScope (selector + namespace set) per owned group, for
+    # membership evaluation
+    selectors: Dict[GroupSpec, "GroupScope"] = field(default_factory=dict)
     # preference ladder (preferences.go:38-46 pre-applied): the next, more
     # relaxed variant of this shape.  The kernel rolls failed counts down the
     # chain between scan passes; variants carry one relaxed representative
@@ -245,7 +264,8 @@ def _class_signature(pod: Pod) -> tuple:
                     ("anti-pref", w.weight, t.topology_key, _selector_sig(t.label_selector))
                 )
         affinity_sig = tuple(sorted(terms))
-    labels_sig = tuple(sorted(pod.metadata.labels.items()))
+    # namespace is part of identity: group membership is (namespace, labels)
+    labels_sig = (pod.namespace or "", tuple(sorted(pod.metadata.labels.items())))
     ports_sig = tuple(
         sorted(
             (p.host_port, p.protocol, p.host_ip)
@@ -379,6 +399,53 @@ def _has_relaxable(pod: Pod) -> bool:
     )
 
 
+def _with_prefer_no_schedule_rungs(
+    classes: List[PodClass], templates: List[MachineTemplate]
+) -> List[PodClass]:
+    """Append the host path's final relaxation rung — tolerate PreferNoSchedule
+    taints — to every ladder when some template carries one (the same gate as
+    solver.scheduler and preferences.go ToleratePreferNoSchedule).  Chains are
+    shallow-copied before relinking so shared class prototypes (columnar
+    slots) are never mutated with template-specific state."""
+    import copy
+    from dataclasses import replace as dc_replace
+
+    from karpenter_core_tpu.apis.objects import TAINT_EFFECT_PREFER_NO_SCHEDULE
+
+    if not any(
+        taint.effect == TAINT_EFFECT_PREFER_NO_SCHEDULE
+        for tmpl in templates
+        for taint in tmpl.taints
+    ):
+        return classes
+    from karpenter_core_tpu.solver.preferences import Preferences
+
+    prefs = Preferences(tolerate_prefer_no_schedule=True)
+    out: List[PodClass] = []
+    for cls in classes:
+        if cls.is_ladder_variant:
+            continue  # re-emitted with its (possibly extended) chain below
+        chain = ladder_chain(cls)
+        rep = copy.deepcopy(chain[-1].pods[0] if chain[-1].pods else cls.pods[0])
+        if prefs._tolerate_prefer_no_schedule_taints(rep) is None:
+            out.extend(chain)
+            continue  # already tolerates
+        try:
+            rung = build_pod_class(rep)
+        except KernelUnsupported:
+            out.extend(chain)
+            continue
+        rung.pods = [rep]
+        rung.is_ladder_variant = True
+        new_chain = [dc_replace(c) for c in chain]
+        for parent, child in zip(new_chain, new_chain[1:]):
+            parent.relax_to = child
+        new_chain[-1].relax_to = rung
+        out.extend(new_chain)
+        out.append(rung)
+    return out
+
+
 def ladder_chain(root: PodClass) -> List[PodClass]:
     """[root, variant1, ...] in relax order."""
     chain = [root]
@@ -417,19 +484,19 @@ def affinity_scan_passes(classes: List[PodClass]) -> int:
     cross-group dependencies) route to the host path."""
     n = len(classes)
     passes = [1] * n
-    labels = [cls.pods[0].metadata.labels for cls in classes]
+    reps = [cls.pods[0] for cls in classes]
     for _ in range(n + 1):
         changed = False
         for i, cls in enumerate(classes):
             for spec in (cls.zone_affinity, cls.host_affinity):
                 if spec is None:
                     continue
-                selector = cls.selectors[spec]
-                if selector is None or selector.matches(labels[i]):
+                scope = cls.selectors[spec]
+                if scope.selector is None or scope.matches_pod(reps[i]):
                     continue  # self-affinity bootstraps in-pass
                 need = passes[i]
                 for j in range(n):
-                    if j != i and selector.matches(labels[j]):
+                    if j != i and scope.matches_pod(reps[j]):
                         need = max(need, passes[j] + (1 if j > i else 0))
                 if need > MAX_SCAN_PASSES:
                     raise KernelUnsupported(
@@ -464,7 +531,9 @@ def classify_pods(pods: List[Pod]) -> List[PodClass]:
     return finalize_classes([groups[sig] for sig in order])
 
 
-def _group_spec(gtype: int, topology_key: str, selector, skew: int) -> GroupSpec:
+def _group_spec(
+    gtype: int, topology_key: str, selector, skew: int, namespaces: frozenset
+) -> GroupSpec:
     if topology_key == labels_api.LABEL_TOPOLOGY_ZONE:
         is_zone = True
     elif topology_key == labels_api.LABEL_HOSTNAME:
@@ -472,8 +541,20 @@ def _group_spec(gtype: int, topology_key: str, selector, skew: int) -> GroupSpec
     else:
         raise KernelUnsupported(f"topology on {topology_key} not kernel-supported")
     return GroupSpec(
-        gtype=gtype, is_zone=is_zone, selector_sig=_selector_sig(selector), skew=skew
+        gtype=gtype, is_zone=is_zone, selector_sig=_selector_sig(selector), skew=skew,
+        namespaces=namespaces,
     )
+
+
+def term_namespaces(pod: Pod, term) -> frozenset:
+    """The namespace scope of an affinity term (topology.go buildNamespaceList):
+    explicit term.namespaces, else the owner pod's namespace.  A live
+    namespaceSelector needs an apiserver listing — host path only."""
+    if term.namespace_selector is not None:
+        raise KernelUnsupported("affinity namespaceSelector not kernel-supported")
+    if term.namespaces:
+        return frozenset(term.namespaces)
+    return frozenset({pod.namespace or ""})
 
 
 def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
@@ -481,7 +562,7 @@ def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
         if getattr(cls, attr) is not None:
             raise KernelUnsupported(f"multiple {attr} constraints not kernel-supported")
         setattr(cls, attr, spec)
-        cls.selectors[spec] = selector
+        cls.selectors[spec] = GroupScope(selector, spec.namespaces)
 
     # ALL spreads — ScheduleAnyway included — and both required and preferred
     # affinity terms act as hard constraints while present on the spec
@@ -491,9 +572,11 @@ def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
     # Self-selecting spreads water-fill (counts move with each placement);
     # non-self-selecting ones reduce to a static within-skew domain mask —
     # the kernel handles both (ops/solve.py zone-spread phases, host caps)
+    own_ns = frozenset({pod.namespace or ""})
     for constraint in pod.spec.topology_spread_constraints:
         spec = _group_spec(
-            GRP_SPREAD, constraint.topology_key, constraint.label_selector, constraint.max_skew
+            GRP_SPREAD, constraint.topology_key, constraint.label_selector,
+            constraint.max_skew, own_ns,
         )
         set_slot("zone_spread" if spec.is_zone else "host_spread", spec, constraint.label_selector)
     affinity = pod.spec.affinity
@@ -503,7 +586,10 @@ def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
                 w.pod_affinity_term for w in affinity.pod_affinity.preferred
             ]
             for term in terms:
-                spec = _group_spec(GRP_AFFINITY, term.topology_key, term.label_selector, UNLIMITED)
+                spec = _group_spec(
+                    GRP_AFFINITY, term.topology_key, term.label_selector, UNLIMITED,
+                    term_namespaces(pod, term),
+                )
                 set_slot(
                     "zone_affinity" if spec.is_zone else "host_affinity", spec, term.label_selector
                 )
@@ -513,7 +599,10 @@ def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
                 w.pod_affinity_term for w in affinity.pod_anti_affinity.preferred
             ]
             for i, term in enumerate(terms):
-                spec = _group_spec(GRP_ANTI, term.topology_key, term.label_selector, UNLIMITED)
+                spec = _group_spec(
+                    GRP_ANTI, term.topology_key, term.label_selector, UNLIMITED,
+                    term_namespaces(pod, term),
+                )
                 slot = "zone_anti" if spec.is_zone else "host_anti"
                 set_slot(slot, spec, term.label_selector)
                 if i >= n_required:
@@ -550,6 +639,7 @@ def encode_snapshot(
     classes incrementally (models.columnar.PodIngest)."""
     if classes is None:
         classes = classify_pods(pods)
+    classes = _with_prefer_no_schedule_rungs(classes, templates)
     # each relax step needs its own scan pass for the rolled counts to be
     # retried (the host path's fail -> Relax -> re-push round)
     ladder_extra = max(
@@ -733,15 +823,15 @@ def encode_snapshot(
     for c, cls in enumerate(classes):
         snap.cls_anti_soft[c, 0] = cls.zone_anti_soft
         snap.cls_anti_soft[c, 1] = cls.host_anti_soft
+    index_of = {id(cls): c for c, cls in enumerate(classes)}
+    for c, cls in enumerate(classes):
+        if cls.relax_to is not None:
+            snap.cls_relax_next[c] = index_of[id(cls.relax_to)]
     snap.cls_root = np.arange(C, dtype=np.int32)
     for c in range(C):
         nxt = snap.cls_relax_next[c]
         if nxt >= 0:  # successors always follow their root
             snap.cls_root[nxt] = snap.cls_root[c]
-    index_of = {id(cls): c for c, cls in enumerate(classes)}
-    for c, cls in enumerate(classes):
-        if cls.relax_to is not None:
-            snap.cls_relax_next[c] = index_of[id(cls.relax_to)]
     snap.cls_tol = np.zeros((C, T), dtype=bool)
     # -- topology groups (hash-deduped, topologygroup.go:137-153) -------------
     group_index: Dict[GroupSpec, int] = {}
@@ -756,7 +846,7 @@ def encode_snapshot(
     for spec, selector in extra_anti_groups or []:
         if spec not in group_index:
             group_index[spec] = len(group_index)
-            group_selectors.append(selector)
+            group_selectors.append(GroupScope(selector, spec.namespaces))
     G = len(group_index)
     snap.groups = list(group_index)
     snap.group_selectors = group_selectors
@@ -770,9 +860,9 @@ def encode_snapshot(
         snap.grp_is_zone[g] = spec.is_zone
         snap.grp_is_anti[g] = spec.gtype == GRP_ANTI
     for c, cls in enumerate(classes):
-        labels = cls.pods[0].metadata.labels
-        for g, selector in enumerate(group_selectors):
-            snap.grp_member[c, g] = selector is not None and selector.matches(labels)
+        rep = cls.pods[0]
+        for g, scope in enumerate(group_selectors):
+            snap.grp_member[c, g] = scope is not None and scope.matches_pod(rep)
         for slot, spec in enumerate(
             (cls.zone_spread, cls.host_spread, cls.zone_affinity,
              cls.host_affinity, cls.zone_anti, cls.host_anti)

@@ -233,6 +233,8 @@ class TPUSolver:
         extra = [
             Requirements.from_labels(n.node.metadata.labels) for n in (state_nodes or [])
         ]
+        from karpenter_core_tpu.models.snapshot import term_namespaces
+
         extra_anti = []
         for pod in bound_pods or []:
             affinity = pod.spec.affinity
@@ -240,12 +242,24 @@ class TPUSolver:
                 continue
             for term in affinity.pod_anti_affinity.required:
                 try:
-                    spec = _group_spec(GRP_ANTI, term.topology_key, term.label_selector, UNLIMITED)
+                    spec = _group_spec(
+                        GRP_ANTI, term.topology_key, term.label_selector, UNLIMITED,
+                        term_namespaces(pod, term),
+                    )
                 except KernelUnsupported:
-                    # an unrepresentable anti key only matters if it can gate
-                    # a scheduling pod
+                    # an unrepresentable anti key/scope only matters if it can
+                    # gate a scheduling pod: selector match within the term's
+                    # static scope (or any pod when the scope is dynamic)
+                    scope_ns = frozenset(term.namespaces) or frozenset(
+                        {pod.namespace or ""}
+                    )
+                    scoped = [
+                        p for p in pods
+                        if term.namespace_selector is not None
+                        or (p.namespace or "") in scope_ns
+                    ]
                     if term.label_selector is not None and any(
-                        term.label_selector.matches(p.metadata.labels) for p in pods
+                        term.label_selector.matches(p.metadata.labels) for p in scoped
                     ):
                         raise
                     continue
@@ -475,7 +489,12 @@ class TPUSolver:
         # pre-existing pod counts per topology group (countDomains semantics,
         # topology.go:231-276): members (forward) and anti-term owners
         # (inverse); pods being scheduled this solve are excluded
-        from karpenter_core_tpu.models.snapshot import GRP_ANTI, UNLIMITED, _group_spec
+        from karpenter_core_tpu.models.snapshot import (
+            GRP_ANTI,
+            UNLIMITED,
+            _group_spec,
+            term_namespaces,
+        )
 
         node_index = {n.node.name: e for e, n in enumerate(state_nodes)}
         group_of = {spec: g for g, spec in enumerate(snapshot.groups)}
@@ -484,22 +503,22 @@ class TPUSolver:
             e = node_index.get(pod.spec.node_name)
             if e is None or pod.uid in scheduling_uids:
                 continue
-            labels = pod.metadata.labels
             from karpenter_core_tpu.models.snapshot import pod_port_keys as _ppk
 
             for key in _ppk(pod):
                 i = port_idx.get(key)
                 if i is not None:
                     ports[e, i] = True
-            for g, selector in enumerate(snapshot.group_selectors):
-                if selector is not None and selector.matches(labels):
+            for g, scope in enumerate(snapshot.group_selectors):
+                if scope is not None and scope.matches_pod(pod):
                     grp_node_member[g, e] += 1
             affinity = pod.spec.affinity
             if affinity is not None and affinity.pod_anti_affinity is not None:
                 for term in affinity.pod_anti_affinity.required:
                     try:
                         spec = _group_spec(
-                            GRP_ANTI, term.topology_key, term.label_selector, UNLIMITED
+                            GRP_ANTI, term.topology_key, term.label_selector,
+                            UNLIMITED, term_namespaces(pod, term),
                         )
                     except Exception:  # noqa: BLE001 - unsupported keys don't track
                         continue
@@ -665,14 +684,11 @@ class TPUSolver:
         state_nodes = state_nodes or []
         # preference-ladder variants schedule pods from their ROOT's list: all
         # rows of one ladder share a cursor into the root's (identical) pods
-        relax_next = snapshot.cls_relax_next
         n_classes = len(snapshot.classes)
-        root_of = list(range(n_classes))
-        if relax_next is not None:
-            for c in range(n_classes):  # successors always follow their root
-                nxt = int(relax_next[c])
-                if nxt >= 0:
-                    root_of[nxt] = root_of[c]
+        if snapshot.cls_root is not None:
+            root_of = [int(r) for r in snapshot.cls_root]
+        else:
+            root_of = list(range(n_classes))
         cursors = [0] * n_classes  # keyed by root index
         for c, cls in enumerate(snapshot.classes):
             r = root_of[c]

@@ -1,0 +1,139 @@
+"""Namespace scoping of kernel topology groups.
+
+The reference scopes every topology group to a namespace set: spreads count
+only the owner's namespace (topology.go:280-282), affinity/anti terms count
+term.namespaces or the owner's namespace (buildNamespaceList,
+topology.go:287-320), and the namespace set is part of group identity
+(topologygroup.go:137-153).  namespaceSelector needs a live namespace
+listing, so those pods route to the host path.
+"""
+
+import pytest
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    LabelSelector,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.models.snapshot import KernelUnsupported, classify_pods
+from karpenter_core_tpu.testing import make_pod, make_pods
+
+from tests.test_tpu_solver import ZONE, compare
+
+HOSTNAME = labels_api.LABEL_HOSTNAME
+
+
+def spread(app, key=ZONE, max_skew=1):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=key,
+        label_selector=LabelSelector(match_labels={"app": app}),
+    )
+
+
+def anti(app, key=HOSTNAME, namespaces=None, namespace_selector=None):
+    return PodAffinityTerm(
+        topology_key=key,
+        label_selector=LabelSelector(match_labels={"app": app}),
+        namespaces=list(namespaces or []),
+        namespace_selector=namespace_selector,
+    )
+
+
+class TestNamespaceScoping:
+    def test_identical_shapes_split_by_namespace(self):
+        pods = make_pods(3, requests={"cpu": "1"}) + [
+            make_pod(requests={"cpu": "1"}, namespace="other") for _ in range(2)
+        ]
+        classes = classify_pods(pods)
+        assert sorted(c.count for c in classes) == [2, 3]
+
+    def test_spread_counts_only_own_namespace(self):
+        # 6 spread pods in ns A + 3 same-label pods in ns B pinned... the B
+        # pods don't own or join A's spread group, so A still balances 2/2/2
+        def pods():
+            return make_pods(
+                6, requests={"cpu": "10m"}, labels={"app": "w"},
+                topology_spread=[spread("w")],
+            ) + [
+                make_pod(
+                    requests={"cpu": "10m"}, labels={"app": "w"}, namespace="other"
+                )
+                for _ in range(3)
+            ]
+
+        host, tpu = compare(pods)
+        assert not tpu.failed_pods
+
+    def test_anti_affinity_scoped_to_own_namespace(self):
+        # anti pods in ns A don't repel same-label pods in ns B: all schedule,
+        # and the B pods can share a node
+        def pods():
+            return [
+                make_pod(requests={"cpu": "100m"}, labels={"app": "db"},
+                         pod_anti_affinity=[anti("db")])
+                for _ in range(2)
+            ] + [
+                make_pod(requests={"cpu": "100m"}, labels={"app": "db"},
+                         namespace="other")
+                for _ in range(4)
+            ]
+
+        host, tpu = compare(pods)
+        assert not tpu.failed_pods
+
+    def test_explicit_term_namespaces_cross_namespace(self):
+        # anti term explicitly naming the other namespace DOES repel its pods:
+        # the kernel must match the host's blocking behavior
+        def pods():
+            return [
+                make_pod(requests={"cpu": "100m"}, labels={"app": "db"},
+                         namespace="other")
+                for _ in range(2)
+            ] + [
+                make_pod(requests={"cpu": "100m"}, labels={"app": "db"},
+                         pod_anti_affinity=[anti("db", namespaces=["other", "default"])])
+            ]
+
+        host, tpu = compare(pods)
+
+    def test_namespace_selector_routes_to_host(self):
+        with pytest.raises(KernelUnsupported):
+            classify_pods(
+                [
+                    make_pod(
+                        requests={"cpu": "1"},
+                        pod_anti_affinity=[
+                            anti("x", namespace_selector=LabelSelector(
+                                match_labels={"team": "a"}))
+                        ],
+                    )
+                ]
+            )
+
+    def test_cross_namespace_affinity_parity(self):
+        # follower's affinity (own-namespace scope) can't target pods in the
+        # other namespace; both paths must agree on the outcome
+        def pods():
+            return [
+                make_pod(requests={"cpu": "1"}, labels={"app": "target"},
+                         namespace="other")
+                for _ in range(2)
+            ] + [
+                make_pod(
+                    requests={"cpu": "1"},
+                    pod_affinity=[
+                        PodAffinityTerm(
+                            topology_key=ZONE,
+                            label_selector=LabelSelector(
+                                match_labels={"app": "target"}),
+                        )
+                    ],
+                    labels={"app": "target"},  # self-match bootstraps in-ns
+                )
+                for _ in range(2)
+            ]
+
+        host, tpu = compare(pods)
+        assert not tpu.failed_pods

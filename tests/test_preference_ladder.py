@@ -275,3 +275,49 @@ class TestLadderConsolidation:
         )
         assert cmd.action == Action.DELETE
         assert len(cmd.nodes_to_remove) == 2
+
+
+class TestPreferNoScheduleRung:
+    def test_prefer_no_schedule_taint_tolerated_after_relaxation(self):
+        """A template with a PreferNoSchedule taint gets the host path's final
+        relaxation rung: intolerant pods schedule by tolerating the taint
+        (preferences.go ToleratePreferNoSchedule; solver.scheduler gate)."""
+        from karpenter_core_tpu.apis.objects import (
+            TAINT_EFFECT_PREFER_NO_SCHEDULE,
+            Taint,
+        )
+
+        provisioners = [
+            make_provisioner(
+                taints=[Taint(key="soft", value="true",
+                              effect=TAINT_EFFECT_PREFER_NO_SCHEDULE)]
+            )
+        ]
+        host, tpu = compare(
+            lambda: make_pods(4, requests={"cpu": "1"}),
+            provisioners=provisioners,
+        )
+        assert not tpu.failed_pods
+        assert sum(len(n.pods) for n in tpu.new_nodes) == 4
+
+    def test_shared_volume_not_double_counted_across_ladder(self):
+        """cls_root must map variants to roots so a root placing in pass 1 and
+        its variant placing on the SAME node in pass 2 count one shared-claim
+        set once (the review-found cls_root ordering bug)."""
+        import numpy as np
+
+        from karpenter_core_tpu.models.snapshot import classify_pods
+
+        pods = make_pods(
+            4, requests={"cpu": "1"}, labels={"app": "s"},
+            topology_spread=[anyway_spread("s")],
+        )
+        classes = classify_pods(pods)
+        from karpenter_core_tpu.cloudprovider import fake as fake_cp
+        from karpenter_core_tpu.solver.tpu import TPUSolver
+
+        solver = TPUSolver(fake_cp.FakeCloudProvider(), [make_provisioner()])
+        snap = solver.encode(pods)
+        assert snap.cls_relax_next.tolist().count(-1) == 1  # one chain of 2
+        root = int(np.argmin(snap.cls_root))
+        assert snap.cls_root.tolist() == [root, root]
