@@ -247,21 +247,32 @@ def _class_signature(pod: Pod) -> tuple:
     if pod.spec.affinity is not None:
         aff = pod.spec.affinity
         terms = []
+        # namespace scope is part of term identity: same-selector terms over
+        # different explicit namespaces (or a live namespaceSelector) must not
+        # collapse into one class, or the first pod's scope silently wins
+        def ns_sig(t):
+            return (
+                tuple(sorted(t.namespaces or ())),
+                _selector_sig(t.namespace_selector)
+                if t.namespace_selector is not None
+                else None,
+            )
+
         if aff.pod_affinity is not None:
             for t in aff.pod_affinity.required:
-                terms.append(("aff", t.topology_key, _selector_sig(t.label_selector)))
+                terms.append(("aff", t.topology_key, _selector_sig(t.label_selector), ns_sig(t)))
             for w in aff.pod_affinity.preferred:
                 t = w.pod_affinity_term
                 terms.append(
-                    ("aff-pref", w.weight, t.topology_key, _selector_sig(t.label_selector))
+                    ("aff-pref", w.weight, t.topology_key, _selector_sig(t.label_selector), ns_sig(t))
                 )
         if aff.pod_anti_affinity is not None:
             for t in aff.pod_anti_affinity.required:
-                terms.append(("anti", t.topology_key, _selector_sig(t.label_selector)))
+                terms.append(("anti", t.topology_key, _selector_sig(t.label_selector), ns_sig(t)))
             for w in aff.pod_anti_affinity.preferred:
                 t = w.pod_affinity_term
                 terms.append(
-                    ("anti-pref", w.weight, t.topology_key, _selector_sig(t.label_selector))
+                    ("anti-pref", w.weight, t.topology_key, _selector_sig(t.label_selector), ns_sig(t))
                 )
         affinity_sig = tuple(sorted(terms))
     # namespace is part of identity: group membership is (namespace, labels)
@@ -426,10 +437,12 @@ def _with_prefer_no_schedule_rungs(
         if cls.is_ladder_variant:
             continue  # re-emitted with its (possibly extended) chain below
         chain = ladder_chain(cls)
-        rep = copy.deepcopy(chain[-1].pods[0] if chain[-1].pods else cls.pods[0])
-        if prefs._tolerate_prefer_no_schedule_taints(rep) is None:
+        source = chain[-1].pods[0] if chain[-1].pods else cls.pods[0]
+        if Preferences.tolerates_prefer_no_schedule(source):
             out.extend(chain)
-            continue  # already tolerates
+            continue  # deepcopy only when a rung must actually be built
+        rep = copy.deepcopy(source)
+        prefs._tolerate_prefer_no_schedule_taints(rep)
         try:
             rung = build_pod_class(rep)
         except KernelUnsupported:
@@ -618,6 +631,14 @@ def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
         raise KernelUnsupported("combined zone affinity + spread/anti not kernel-supported")
     if cls.host_affinity is not None and (cls.host_spread is not None or cls.host_anti is not None):
         raise KernelUnsupported("combined hostname affinity + spread/anti not kernel-supported")
+    # the kernel schedules each class through exactly one phase family; these
+    # combos need intersected phase plans (and under the reference's
+    # pessimistic new-node committal they schedule ~1 pod before deadlocking,
+    # topology_test.go:1896) — the host path keeps exact per-pod semantics
+    if cls.zone_spread is not None and cls.zone_anti is not None:
+        raise KernelUnsupported("combined zone spread + zone anti-affinity not kernel-supported")
+    if cls.host_affinity is not None and (cls.zone_spread is not None or cls.zone_anti is not None):
+        raise KernelUnsupported("combined hostname affinity + zonal spread/anti not kernel-supported")
 
 
 def encode_snapshot(

@@ -338,36 +338,45 @@ class ClassTensors(NamedTuple):
     # shared-volume adds are once-per-(LADDER, node), tracked at the root
 
 
-def _phase_existing(
+class ExClassPrep(NamedTuple):
+    """Per-(class, existing-node) quantities constant across one class step's
+    phases: intake capacity, merged requirement tensors, zone/capacity-type
+    masks, and the class's volume rows.  Computing them once per step is safe
+    because a step's phases touch disjoint existing-node sets: committed-zone
+    phases narrow a taken node's live ex.zone to their zone (so later zone
+    phases exclude it — _phase_existing checks the LIVE mask), and the other
+    phase families run at most one capacity-consuming phase per step."""
+
+    cap: jnp.ndarray  # i32[E] intake for this class; 0 = ineligible node
+    merged: mask_ops.ReqTensor  # node ∩ class requirements, per node
+    zone_full: jnp.ndarray  # bool[E, Z] node zone ∩ class zone
+    ct_ok: jnp.ndarray  # bool[E, C2] node capacity-type ∩ class
+    vol_add: jnp.ndarray  # i32[E, D]
+    vol_per_pod: jnp.ndarray  # i32[D]
+
+
+def _prep_existing(
     ex: ExistingState,
     ex_static: ExistingStatic,
     cls: ClassTensors,
     statics: Statics,
-    quota: jnp.ndarray,
-    zone_restrict: jnp.ndarray,
     host_cap_vec: jnp.ndarray,
     tol_row: jnp.ndarray,
     vol_add_row: jnp.ndarray,
     vol_per_pod_row: jnp.ndarray,
-    extra_elig: Optional[jnp.ndarray] = None,
-    single_node: bool = False,
-) -> Tuple[ExistingState, jnp.ndarray, jnp.ndarray]:
-    """Place up to ``quota`` pods of the class onto existing nodes, in index
-    order (the reference iterates existing nodes first, in order, and takes the
-    first that accepts — scheduler.go:176-180).  ``host_cap_vec`` carries the
-    per-node pods-of-this-class cap from hostname topology groups;
-    ``extra_elig`` restricts to a node subset (affinity targets / inverse
-    anti-affinity blocks); ``single_node`` pins the whole quota to the first
-    eligible node (hostname self-affinity bootstrap)."""
-    n_ex = ex.used.shape[0]
-
+) -> ExClassPrep:
+    """How many pods of the class each existing node can still take — min over
+    resource fit, CSI attach limits, host-port exclusivity, and hostname-group
+    caps; 0 for ineligible nodes (closed, key-incompatible, intolerable
+    taints, port conflicts, volume-blocked).  The same intake the reference
+    derives per pod in existingnode.go:77-130, hoisted to class granularity."""
     node_t = mask_ops.ReqTensor(ex.kmask, ex.kdef, ex.kneg, ex.kgt, ex.klt)
     cls_t = mask_ops.ReqTensor(
         cls.mask[None], cls.defined[None], cls.negative[None], cls.gt[None], cls.lt[None]
     )
     key_ok = mask_ops.compatible(node_t, cls_t, statics.is_custom, statics.vocab_ints)
     merged = mask_ops.add(node_t, cls_t, statics.valid, statics.vocab_ints)
-    zone_ok = ex.zone & zone_restrict[None, :] & cls.zone[None, :]
+    zone_full = ex.zone & cls.zone[None, :]
     ct_ok = ex.ct & cls.ct[None, :]
 
     # fixed-capacity fit: min over resources of floor((available - used)/size)
@@ -403,12 +412,41 @@ def _phase_existing(
         axis=-1,
     ).astype(jnp.int32)
     cap = jnp.minimum(cap, jnp.maximum(cap_vol, 0))
-    elig = ex.open_ & key_ok & tol_row & jnp.any(zone_ok, axis=-1) & jnp.any(ct_ok, axis=-1)
+    elig = ex.open_ & key_ok & tol_row & jnp.any(zone_full, axis=-1) & jnp.any(ct_ok, axis=-1)
     elig = elig & ~port_conflict & vol_ok
-    if extra_elig is not None:
-        elig = elig & extra_elig
     cap = jnp.minimum(cap, jnp.where(has_ports, 1, UNLIMITED))
     cap = jnp.where(elig, jnp.minimum(cap, host_cap_vec), 0)
+    return ExClassPrep(
+        cap=cap, merged=merged, zone_full=zone_full, ct_ok=ct_ok,
+        vol_add=vol_add_row, vol_per_pod=vol_per_pod_row,
+    )
+
+
+def _phase_existing(
+    ex: ExistingState,
+    prep: ExClassPrep,
+    cls: ClassTensors,
+    quota: jnp.ndarray,
+    zone_restrict: jnp.ndarray,
+    extra_elig: Optional[jnp.ndarray] = None,
+    single_node: bool = False,
+) -> Tuple[ExistingState, jnp.ndarray, jnp.ndarray]:
+    """Place up to ``quota`` pods of the class onto existing nodes, in index
+    order (the reference iterates existing nodes first, in order, and takes the
+    first that accepts — scheduler.go:176-180).  ``prep`` carries the step-wide
+    intake/merge tensors; ``extra_elig`` restricts to a node subset (affinity
+    targets / inverse anti-affinity blocks); ``single_node`` pins the whole
+    quota to the first eligible node (hostname self-affinity bootstrap)."""
+    n_ex = ex.used.shape[0]
+    merged = prep.merged
+    # zone eligibility reads the LIVE state, not the prep snapshot: an
+    # unknown-zone node (all-zones mask) that took pods in an earlier
+    # committed-zone phase narrowed its ex.zone there, which is what excludes
+    # it here — prep.cap would otherwise be stale for it (double-placement)
+    zone_ok = ex.zone & cls.zone[None, :] & zone_restrict[None, :]
+    cap = jnp.where(jnp.any(zone_ok, axis=-1), prep.cap, 0)
+    if extra_elig is not None:
+        cap = jnp.where(extra_elig, cap, 0)
     if single_node:
         first = jnp.argmax(cap > 0)
         cap = jnp.where(jnp.arange(n_ex) == first, cap, 0)
@@ -427,11 +465,11 @@ def _phase_existing(
         kgt=jnp.where(sel, merged.gt, ex.kgt),
         klt=jnp.where(sel, merged.lt, ex.klt),
         zone=jnp.where(sel, zone_ok, ex.zone),
-        ct=jnp.where(sel, ct_ok, ex.ct),
+        ct=jnp.where(sel, prep.ct_ok, ex.ct),
         ports=jnp.where(sel, ex.ports | cls.ports[None, :], ex.ports),
         vol_used=jnp.where(
             sel,
-            ex.vol_used + vol_add_row + assigned[:, None] * vol_per_pod_row[None, :],
+            ex.vol_used + prep.vol_add + assigned[:, None] * prep.vol_per_pod[None, :],
             ex.vol_used,
         ),
         pod_count=ex.pod_count + assigned,
@@ -707,6 +745,13 @@ def _class_step(
         jnp.where((g_han < g_dummy) & member_han, 1, UNLIMITED),
     ).astype(jnp.int32)
 
+    # step-wide existing-node intake/merge tensors (valid across this step's
+    # phases — they touch disjoint node sets; see ExClassPrep)
+    ex_prep = _prep_existing(
+        ex, ex_static, cls, statics, host_cap_ex, tol_row,
+        vol_add_row, vol_per_pod_row,
+    )
+
     assigned_total = jnp.zeros_like(state.pod_count)
     assigned_ex_total = jnp.zeros_like(ex.pod_count)
     placed_total = jnp.int32(0)
@@ -721,8 +766,7 @@ def _class_step(
             extra_ex = ok_ex if targets_ex is None else (ok_ex & targets_ex)
             extra_new = ok_new if targets_new is None else (ok_new & targets_new)
             ex_o, a_ex, placed_ex = _phase_existing(
-                ex_i, ex_static, cls, statics, quota, restrict,
-                host_cap_ex, tol_row, vol_add_row, vol_per_pod_row,
+                ex_i, ex_prep, cls, quota, restrict,
                 extra_elig=extra_ex, single_node=single_node,
             )
             q_new = quota - placed_ex
@@ -756,9 +800,9 @@ def _class_step(
         placed_total = placed_total + placed
 
     # -- zone spread phases (one committed zone per phase) --------------------
-    # zones some template can actually serve for this class (or an open
-    # existing node sits in) — used by spread quotas and the affinity
-    # bootstrap below
+    # zones some template can actually serve for this class (or an eligible
+    # existing node with intake left sits in) — used by spread quotas and the
+    # affinity bootstrap below
     tmpl_offers = jnp.einsum(
         "ti,izc,tz,tc->z",
         statics.tmpl_it.astype(jnp.bfloat16),
@@ -767,28 +811,52 @@ def _class_step(
         (statics.tmpl_ct & cls.ct[None, :]).astype(jnp.bfloat16),
         preferred_element_type=jnp.float32,
     ) > 0.5  # [Z]
-    ex_offers = jnp.any(ex.open_[:, None] & ex.zone, axis=0)  # [Z]
-    fillable = tmpl_offers | ex_offers
-
     counts_zs = topo.zone_fwd[g_zs]  # [Z]
     member_zs = member_row[g_zs]
+    # per-zone intake for this class: existing nodes contribute their
+    # remaining intake; template zones open new nodes on demand (unbounded)
+    ex_cap_z = jnp.sum(
+        jnp.minimum(jnp.where(ok_ex, ex_prep.cap, 0), m)[:, None]
+        * ex_prep.zone_full.astype(jnp.int32),
+        axis=0,
+    )  # i32[Z]
+    fillable = tmpl_offers | (ex_cap_z > 0)
+    cap_pods_z = jnp.where(tmpl_offers, UNLIMITED, jnp.minimum(ex_cap_z, UNLIMITED))
+
     # the reference's per-pod skew check measures against the min over ALL the
-    # pod's domains, including zones no template can serve — those stay at
-    # their current count forever, capping every reachable zone at
-    # min_unreachable + skew (topology_test.go:124-162 "existing pod" case).
-    # The water-fill only fills reachable zones, with that cap applied.
+    # pod's domains, including zones that cannot take this class — their
+    # counts stay frozen, capping every fillable zone at frozen_min + maxSkew
+    # (topology_test.go:124-162 "existing pod" case).  A zone whose intake
+    # runs out MID-fill freezes the same way (nextDomainTopologySpread keeps
+    # measuring it, topologygroup.go:155-182), so the water-fill proceeds in
+    # rounds: each round fills min-first up to the nearest saturation level,
+    # then the saturated zone joins the frozen set and bounds the rest.
     unreachable = allowed_zone & ~fillable
-    min_unreachable = jnp.min(
-        jnp.where(unreachable, counts_zs, jnp.int32(1 << 30))
-    )
-    zone_cap = jnp.clip(
-        min_unreachable + statics.grp_skew[g_zs] - counts_zs, 0, UNLIMITED
-    )
-    quotas = jnp.where(
-        member_zs,
-        jnp.minimum(_water_fill(counts_zs, allowed_zone & fillable, m), zone_cap),
-        0,
-    )
+    skew_zs = statics.grp_skew[g_zs]
+    BIGI = jnp.int32(1 << 30)
+    finite_cap = cap_pods_z < UNLIMITED
+    quotas = jnp.zeros(n_zones, dtype=jnp.int32)
+    sat = jnp.zeros(n_zones, dtype=bool)
+    m_rem = m
+    # worst case: one round per sequentially-saturating finite-cap zone, plus
+    # a final redistribution round for the unbounded zones
+    for _ in range(n_zones + 1):
+        counts_now = counts_zs + quotas
+        min_frozen = jnp.min(jnp.where(unreachable | sat, counts_now, BIGI))
+        skew_cap = jnp.clip(min_frozen + skew_zs - counts_now, 0, UNLIMITED)
+        active = allowed_zone & fillable & ~sat
+        cap_rem = jnp.clip(cap_pods_z - quotas, 0, UNLIMITED)
+        # level where the nearest capacity-bounded active zone saturates;
+        # fills stop there so its frozen count bounds the next round
+        lvl_sat = jnp.min(jnp.where(active & finite_cap, counts_now + cap_rem, BIGI))
+        q = _water_fill(counts_now, active, m_rem)
+        q = jnp.minimum(q, jnp.clip(lvl_sat - counts_now, 0, UNLIMITED))
+        q = jnp.minimum(q, jnp.minimum(skew_cap, cap_rem))
+        q = jnp.where(active, q, 0)
+        quotas = quotas + q
+        m_rem = m_rem - jnp.sum(q)
+        sat = sat | (active & finite_cap & (quotas >= cap_pods_z))
+    quotas = jnp.where(member_zs, quotas, 0)
     for z in range(n_zones):
         restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
         q = jnp.where(has_zs, quotas[z], 0)

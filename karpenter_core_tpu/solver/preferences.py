@@ -107,15 +107,20 @@ class Preferences:
                 return f"removing: topologySpreadConstraint {tsc.topology_key}"
         return None
 
+    @staticmethod
+    def tolerates_prefer_no_schedule(pod: Pod) -> bool:
+        return any(
+            t.operator == "Exists"
+            and t.effect == TAINT_EFFECT_PREFER_NO_SCHEDULE
+            and not t.key
+            and not t.value
+            for t in pod.spec.tolerations
+        )
+
     def _tolerate_prefer_no_schedule_taints(self, pod: Pod) -> Optional[str]:
-        wanted = Toleration(operator="Exists", effect=TAINT_EFFECT_PREFER_NO_SCHEDULE)
-        for t in pod.spec.tolerations:
-            if (
-                t.operator == wanted.operator
-                and t.effect == wanted.effect
-                and not t.key
-                and not t.value
-            ):
-                return None
-        pod.spec.tolerations = pod.spec.tolerations + [wanted]
+        if self.tolerates_prefer_no_schedule(pod):
+            return None
+        pod.spec.tolerations = pod.spec.tolerations + [
+            Toleration(operator="Exists", effect=TAINT_EFFECT_PREFER_NO_SCHEDULE)
+        ]
         return "adding: toleration for PreferNoSchedule taints"

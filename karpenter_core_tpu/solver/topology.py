@@ -116,7 +116,11 @@ class TopologyGroup:
             if topology_type == TopologyType.SPREAD and pod is not None
             else TopologyNodeFilter()
         )
-        self.domains: Dict[str, int] = {domain: 0 for domain in domains}
+        # sorted for deterministic tie-breaks: the reference iterates a Go map
+        # (random order, so count-tied domain picks flap run to run,
+        # topologygroup.go:163-176); fixing a total order is a deterministic
+        # refinement of the same semantics and keeps the oracle reproducible
+        self.domains: Dict[str, int] = {domain: 0 for domain in sorted(domains)}
         self.owners: Set[str] = set()  # pod UIDs that have this topology as a rule
 
     # -- counting -------------------------------------------------------------

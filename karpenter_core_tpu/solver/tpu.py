@@ -250,14 +250,11 @@ class TPUSolver:
                     # an unrepresentable anti key/scope only matters if it can
                     # gate a scheduling pod: selector match within the term's
                     # static scope (or any pod when the scope is dynamic)
-                    scope_ns = frozenset(term.namespaces) or frozenset(
-                        {pod.namespace or ""}
-                    )
-                    scoped = [
-                        p for p in pods
-                        if term.namespace_selector is not None
-                        or (p.namespace or "") in scope_ns
-                    ]
+                    if term.namespace_selector is not None:
+                        scoped = list(pods)
+                    else:
+                        scope_ns = term_namespaces(pod, term)
+                        scoped = [p for p in pods if (p.namespace or "") in scope_ns]
                     if term.label_selector is not None and any(
                         term.label_selector.matches(p.metadata.labels) for p in scoped
                     ):

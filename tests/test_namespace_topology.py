@@ -137,3 +137,42 @@ class TestNamespaceScoping:
 
         host, tpu = compare(pods)
         assert not tpu.failed_pods
+
+
+class TestNamespaceScopeInClassSignature:
+    """Pods whose affinity terms differ only in namespace scope must land in
+    distinct classes — group identity includes the namespace set
+    (topologygroup.go:137-153), so collapsing them would solve the second pod
+    under the first pod's scope."""
+
+    def test_explicit_namespace_sets_split_classes(self):
+        a = make_pod(
+            name="a", namespace="a", labels={"app": "web"},
+            pod_anti_affinity=[anti("web", namespaces=["x"])],
+        )
+        b = make_pod(
+            name="b", namespace="a", labels={"app": "web"},
+            pod_anti_affinity=[anti("web", namespaces=["y"])],
+        )
+        classes = classify_pods([a, b])
+        roots = [c for c in classes if not c.is_ladder_variant]
+        assert len(roots) == 2
+        scopes = {
+            next(iter(c.selectors.values())).namespaces for c in roots
+        }
+        assert scopes == {frozenset({"x"}), frozenset({"y"})}
+
+    def test_namespace_selector_pod_does_not_collapse_into_static_class(self):
+        static = make_pod(
+            name="s", namespace="a", labels={"app": "web"},
+            pod_anti_affinity=[anti("web", namespaces=["x"])],
+        )
+        dynamic = make_pod(
+            name="d", namespace="a", labels={"app": "web"},
+            pod_anti_affinity=[
+                anti("web", namespace_selector=LabelSelector(match_labels={"team": "t"}))
+            ],
+        )
+        # the dynamic pod must raise (host path), not ride the static class
+        with pytest.raises(KernelUnsupported):
+            classify_pods([static, dynamic])

@@ -135,3 +135,48 @@ class TestTPUConsolidation:
         # minimum the sweep must not propose an invalid removal
         if cmd.action == Action.DELETE:
             raise AssertionError("full node must not be deleted")
+
+
+class TestSearchLargestPrefix:
+    """The lane-sweep search must pin the exact boundary in ceil(log64(n))
+    passes, whatever the candidate count."""
+
+    def _run(self, n, boundary):
+        from karpenter_core_tpu.solver.consolidation import search_largest_prefix
+
+        passes = []
+
+        def evaluate(sizes):
+            passes.append(len(sizes))
+            valid = [int(k) for k in sizes if k <= boundary]
+            if not valid:
+                return None, 0
+            return ("cmd", max(valid)), max(valid)
+
+        best = search_largest_prefix(n, evaluate)
+        return best, passes
+
+    def test_small_exact_single_pass(self):
+        best, passes = self._run(40, boundary=17)
+        assert best == ("cmd", 17)
+        assert len(passes) == 1
+
+    def test_coarse_gap_refined_exactly(self):
+        best, passes = self._run(500, boundary=123)
+        assert best == ("cmd", 123)
+        assert len(passes) <= 2
+
+    def test_beyond_4096_multi_round(self):
+        best, passes = self._run(300_000, boundary=123_456)
+        assert best == ("cmd", 123_456)
+        assert len(passes) <= 4
+        assert all(p <= 64 for p in passes)
+
+    def test_no_valid_prefix(self):
+        best, passes = self._run(100_000, boundary=0)
+        assert best is None
+        assert len(passes) == 1
+
+    def test_all_valid(self):
+        best, _ = self._run(100_000, boundary=100_000)
+        assert best == ("cmd", 100_000)

@@ -727,3 +727,157 @@ class TestNonSelfSelectingSpread:
             bound_pods=env.kube.list_pods(),
         )
         assert len(res.failed_pods) == 1
+
+
+class TestCapacityAwareSpread:
+    """Spread quotas must anticipate per-zone intake: a zone reachable only
+    through existing nodes saturates mid-fill, freezing its count, which then
+    bounds every other zone at frozen+maxSkew — the reference measures skew
+    against the min over ALL the pod's domains each placement
+    (topologygroup.go:155-182), so an exhausted zone keeps gating the rest."""
+
+    def _catalog_z1_only_launchable(self, cpu=4.0):
+        """One instance type whose universe spans zone-1+zone-2 but whose
+        zone-2 offering is unavailable: zone-2 participates in skew math yet
+        only pre-existing nodes can absorb pods there."""
+        it = fake_cp.new_instance_type(
+            "cap-it",
+            resources={"cpu": cpu, "memory": 8 * fake_cp.GI, "pods": 32.0},
+            offerings=[
+                fake_cp.Offering("spot", "test-zone-1", 1.0),
+                fake_cp.Offering("spot", "test-zone-2", 1.0),
+            ],
+        )
+        from dataclasses import replace as dc_replace
+
+        idx = next(
+            i for i, o in enumerate(it.offerings) if o.zone == "test-zone-2"
+        )
+        it.offerings[idx] = dc_replace(it.offerings[idx], available=False)
+        return [it]
+
+    def _spread_pods(self, n):
+        from karpenter_core_tpu.apis.objects import LabelSelector, TopologySpreadConstraint
+
+        return [
+            make_pod(
+                name=f"web-{i}", labels={"app": "web"}, requests={"cpu": "1"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=ZONE,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ],
+            )
+            for i in range(n)
+        ]
+
+    def _solve_both(self, node_cpu, n_pods):
+        from karpenter_core_tpu.solver.builder import build_scheduler
+
+        def build():
+            env = make_environment(instance_types=self._catalog_z1_only_launchable())
+            env.kube.create(make_provisioner())
+            owned_ready_node(
+                env, cpu=node_cpu, zone="test-zone-2", instance_type="cap-it"
+            )
+            return env, self._spread_pods(n_pods)
+
+        env, pods = build()
+        host = build_scheduler(
+            env.kube, env.provider, cluster=None, pods=pods,
+            state_nodes=env.cluster.snapshot_nodes(), daemonset_pods=[],
+        ).solve(pods)
+        env, pods = build()
+        tpu = TPUSolver(env.provider, env.kube.list_provisioners()).solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(),
+            bound_pods=env.kube.list_pods(),
+        )
+        return host, tpu
+
+    @staticmethod
+    def _placed(host, tpu):
+        host_placed = sum(len(n.pods) for n in host.new_nodes) + sum(
+            len(e.pods) for e in host.existing_nodes
+        )
+        tpu_placed = sum(len(n.pods) for n in tpu.new_nodes) + sum(
+            len(v) for v in tpu.existing_assignments.values()
+        )
+        return host_placed, tpu_placed
+
+    def test_existing_only_zone_saturates_and_bounds_skew(self):
+        host, tpu = self._solve_both(node_cpu=2, n_pods=10)
+        host_placed, tpu_placed = self._placed(host, tpu)
+        assert tpu_placed == host_placed == 5
+        assert len(tpu.failed_pods) == len(host.failed_pods) == 5
+        # zone-2 intake is 2; frozen there, zone-1 rises to 2+skew = 3
+        assert sum(len(v) for v in tpu.existing_assignments.values()) == 2
+        assert sum(len(n.pods) for n in tpu.new_nodes) == 3
+
+    def test_zero_intake_zone_freezes_min_at_zero(self):
+        # the zone-2 node can't fit even one pod: its count freezes at 0 and
+        # caps zone-1 at maxSkew
+        host, tpu = self._solve_both(node_cpu="500m", n_pods=10)
+        host_placed, tpu_placed = self._placed(host, tpu)
+        assert tpu_placed == host_placed == 1
+        assert len(tpu.failed_pods) == len(host.failed_pods) == 9
+
+
+class TestUnknownZoneNode:
+    """An existing node WITHOUT a zone label encodes as an all-zones mask.
+    Committed-zone spread phases must not tap it twice with stale intake:
+    once it takes pods in one zone phase its live mask narrows, excluding it
+    from the rest (the reference places on label-less nodes through the
+    DoesNotExist branch of nextDomainTopologySpread, topologygroup.go:176-180,
+    without ever counting them twice)."""
+
+    def test_no_double_placement_on_label_less_node(self):
+        from karpenter_core_tpu.apis.objects import LabelSelector, TopologySpreadConstraint
+        from karpenter_core_tpu.solver.builder import build_scheduler
+
+        def build():
+            env = make_environment()
+            env.kube.create(make_provisioner())
+            node = make_node(
+                labels={
+                    labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                    labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+                    labels_api.LABEL_CAPACITY_TYPE: "spot",
+                    labels_api.LABEL_NODE_INITIALIZED: "true",
+                },  # no zone label
+                allocatable={"cpu": 2, "memory": "4Gi", "pods": 10},
+            )
+            env.kube.create(node)
+            sel = LabelSelector(match_labels={"app": "web"})
+            pods = [
+                make_pod(
+                    name=f"w{i}", labels={"app": "web"}, requests={"cpu": "1"},
+                    topology_spread=[
+                        TopologySpreadConstraint(
+                            max_skew=1, topology_key=ZONE, label_selector=sel
+                        )
+                    ],
+                )
+                for i in range(6)
+            ]
+            return env, pods
+
+        env, pods = build()
+        host = build_scheduler(
+            env.kube, env.provider, cluster=None, pods=pods,
+            state_nodes=env.cluster.snapshot_nodes(), daemonset_pods=[],
+        ).solve(pods)
+        env, pods = build()
+        tpu = TPUSolver(env.provider, env.kube.list_provisioners()).solve(
+            pods, state_nodes=env.cluster.snapshot_nodes(),
+            bound_pods=env.kube.list_pods(),
+        )
+        tpu_existing = sum(len(v) for v in tpu.existing_assignments.values())
+        host_existing = sum(len(e.pods) for e in host.existing_nodes)
+        # intake is 2 cpu: more than 2 pods on the node means a phase re-read
+        # stale capacity
+        assert tpu_existing == host_existing == 2
+        assert len(tpu.failed_pods) == len(host.failed_pods) == 0
+        assert sum(len(n.pods) for n in tpu.new_nodes) == sum(
+            len(n.pods) for n in host.new_nodes
+        ) == 4

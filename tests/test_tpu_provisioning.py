@@ -231,3 +231,71 @@ class TestMixedBatchSplit:
             f"{total_cpu_capacity} across {len(results.new_nodes)} nodes"
         )
         assert results.failed_pods  # the exotic pod had no budget left
+
+
+class TestCustomTopologyKeySplit:
+    """Topologies on keys the kernel doesn't model (region-class / custom
+    labels, models.snapshot._group_spec) route through the mixed-batch split:
+    the bulk stays on the kernel, the custom-key pods solve on the host with
+    shared capacity accounting — capability parity without a kernel plane per
+    ad-hoc key (topology_test.go:492-783 exercises these keys on the host)."""
+
+    def test_capacity_type_spread_pod_splits_to_host(self):
+        from karpenter_core_tpu.apis.objects import LabelSelector, TopologySpreadConstraint
+
+        kube, provider, cluster, recorder, controller = tpu_env()
+        kube.create(make_provisioner())
+        plain = make_pods(8, requests={"cpu": "900m"})
+        ct_spread = [
+            make_pod(
+                name=f"ct-{i}", labels={"app": "edge"}, requests={"cpu": "100m"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=labels_api.LABEL_CAPACITY_TYPE,
+                        label_selector=LabelSelector(match_labels={"app": "edge"}),
+                    )
+                ],
+            )
+            for i in range(2)
+        ]
+        for pod in plain + ct_spread:
+            kube.create(pod)
+        pods = controller.get_pending_pods()
+        split = controller._split_batch(pods)
+        assert split is not None
+        _, tpu_pods, host_pods = split
+        assert len(tpu_pods) == 8
+        assert len(host_pods) == 2
+        err = controller.reconcile(wait_for_batch=False)
+        assert err is None
+        nominated = [e for e in recorder.events if e.reason == "Nominated"]
+        assert len(nominated) == 10
+
+    def test_cross_group_custom_key_anti_stays_whole_batch_host(self):
+        """A custom-key ANTI term selecting the kernel pods' labels would
+        desynchronize counts across a split — the whole batch must host-route."""
+        from karpenter_core_tpu.apis.objects import LabelSelector, PodAffinityTerm
+
+        kube, provider, cluster, recorder, controller = tpu_env()
+        kube.create(make_provisioner())
+        plain = make_pods(4, labels={"app": "web"}, requests={"cpu": "500m"})
+        guard = make_pod(
+            labels={"app": "edge"}, requests={"cpu": "100m"},
+            pod_anti_affinity=[
+                PodAffinityTerm(
+                    topology_key=labels_api.LABEL_ARCH_STABLE,
+                    label_selector=LabelSelector(match_labels={"app": "web"}),
+                )
+            ],
+        )
+        for pod in plain + [guard]:
+            kube.create(pod)
+        pods = controller.get_pending_pods()
+        assert controller._split_batch(pods) is None
+        err = controller.reconcile(wait_for_batch=False)
+        assert err is None
+        nominated = [e for e in recorder.events if e.reason == "Nominated"]
+        # the single-arch catalog leaves no zero-count arch domain once the
+        # web pods land, so the guard itself correctly fails to schedule
+        assert len(nominated) == 4

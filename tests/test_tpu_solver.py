@@ -560,3 +560,57 @@ class TestKernelLimits:
             provisioners=[limited, fallback],
         )
         assert all(n.provisioner_name == "fallback" for n in tpu.new_nodes if n.pods)
+
+
+class TestPhaseFamilyCombos:
+    """Constraint combos that would need intersected phase plans route to the
+    host path (mixed-batch split); under the reference's pessimistic new-node
+    committal they schedule ~1 pod before deadlocking (topology_test.go:1896),
+    so exact per-pod semantics matter more than kernel throughput here."""
+
+    def _combo_pod(self, zone_spread=False, zone_anti=False, host_aff=False):
+        sel = LabelSelector(match_labels={"app": "x"})
+        return make_pod(
+            labels={"app": "x"},
+            topology_spread=(
+                [TopologySpreadConstraint(max_skew=1, topology_key=ZONE, label_selector=sel)]
+                if zone_spread else None
+            ),
+            pod_anti_affinity=(
+                [PodAffinityTerm(topology_key=ZONE, label_selector=sel)]
+                if zone_anti else None
+            ),
+            pod_affinity=(
+                [PodAffinityTerm(topology_key=HOSTNAME, label_selector=sel)]
+                if host_aff else None
+            ),
+        )
+
+    def test_zone_spread_plus_zone_anti_routes_to_host(self):
+        with pytest.raises(KernelUnsupported):
+            classify_pods([self._combo_pod(zone_spread=True, zone_anti=True)])
+
+    def test_zone_spread_plus_host_affinity_routes_to_host(self):
+        with pytest.raises(KernelUnsupported):
+            classify_pods([self._combo_pod(zone_spread=True, host_aff=True)])
+
+    def test_zone_anti_plus_host_affinity_routes_to_host(self):
+        with pytest.raises(KernelUnsupported):
+            classify_pods([self._combo_pod(zone_anti=True, host_aff=True)])
+
+    def test_zone_spread_plus_hostname_anti_stays_on_kernel(self):
+        # composes through per-node hostname caps — must NOT route to host
+        sel = LabelSelector(match_labels={"app": "x"})
+        pods = [
+            make_pod(
+                name=f"p{i}", labels={"app": "x"}, requests={"cpu": "10m"},
+                topology_spread=[
+                    TopologySpreadConstraint(max_skew=1, topology_key=ZONE, label_selector=sel)
+                ],
+                pod_anti_affinity=[PodAffinityTerm(topology_key=HOSTNAME, label_selector=sel)],
+            )
+            for i in range(4)
+        ]
+        classify_pods(list(pods))  # no KernelUnsupported
+        host, tpu = compare(lambda: list(pods))
+        assert all(len(n.pods) <= 1 for n in tpu.new_nodes)
