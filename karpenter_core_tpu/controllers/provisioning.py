@@ -557,12 +557,20 @@ class ProvisioningController:
         except Exception as e:  # noqa: BLE001 - cloud errors surface as strings
             return None, f"creating cloud provider instance, {e}"
 
+        # merge the template's node view into the provider's (provisioner.go:
+        # 331-335 mergo.Merge): provider-resolved labels win, the template
+        # backfills the rest — including single-valued requirement labels
+        # (e.g. custom provisioner requirements) and annotations
+        template_node = template.to_node()
         node = Node(
             metadata=created.metadata,
-            spec=machine_node.template.to_node().spec,
+            spec=template_node.spec,
             status=NodeStatus(),
         )
-        node.metadata.labels.update(template.labels)
+        for key, value in template_node.metadata.labels.items():
+            node.metadata.labels.setdefault(key, value)
+        for key, value in template_node.metadata.annotations.items():
+            node.metadata.annotations.setdefault(key, value)
         node.metadata.finalizers = [labels_api.TERMINATION_FINALIZER]
         node.spec.provider_id = created.status.provider_id
 

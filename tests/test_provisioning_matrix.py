@@ -1,0 +1,421 @@
+"""Provisioning scenario matrix, ported case-for-case from the reference's
+controller suite (/root/reference/pkg/controllers/provisioning/suite_test.go).
+
+Each class mirrors a Context() block; cites are to suite_test.go lines.  The
+cases run the real controller loop (batch -> snapshot -> solve -> launch ->
+nominate) against the in-memory apiserver with the fake cloud provider.
+"""
+
+import datetime
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_IN,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimSpec,
+    PersistentVolumeClaimVolumeSource,
+    PersistentVolumeSpec,
+    StorageClass,
+    Taint,
+    Toleration,
+    Volume,
+)
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.testing import (
+    make_daemonset_pod,
+    make_pod,
+    make_pods,
+    make_provisioner,
+)
+from karpenter_core_tpu.testing.harness import (
+    expect_not_scheduled,
+    expect_provisioned,
+    expect_scheduled,
+    make_environment,
+)
+
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+ITYPE = labels_api.LABEL_INSTANCE_TYPE_STABLE
+ARCH = labels_api.LABEL_ARCH_STABLE
+OS = labels_api.LABEL_OS_STABLE
+CT = labels_api.LABEL_CAPACITY_TYPE
+PROV = labels_api.PROVISIONER_NAME_LABEL_KEY
+
+
+class TestProvisionerSelection:
+    """suite_test.go:114,1129-1175 — which provisioner serves a pod."""
+
+    def test_deleting_provisioner_ignored(self):
+        # suite_test.go:114
+        env = make_environment()
+        prov = make_provisioner()
+        prov.metadata.deletion_timestamp = datetime.datetime.now(datetime.timezone.utc)
+        env.kube.create(prov)
+        pod = make_pod()
+        result = expect_provisioned(env, pod)
+        expect_not_scheduled(env, result, pod)
+        assert not env.provider.create_calls
+
+    def test_explicit_provisioner_selector(self):
+        # suite_test.go:1129
+        env = make_environment()
+        env.kube.create(make_provisioner(name="default"))
+        env.kube.create(make_provisioner(name="chosen"))
+        pod = make_pod(node_selector={PROV: "chosen"})
+        result = expect_provisioned(env, pod)
+        node = expect_scheduled(env, result, pod)
+        assert node.metadata.labels[PROV] == "chosen"
+
+    def test_provisioner_matched_by_labels(self):
+        # suite_test.go:1138
+        env = make_environment()
+        env.kube.create(make_provisioner(name="default"))
+        env.kube.create(make_provisioner(name="labeled", labels={"team": "infra"}))
+        pod = make_pod(node_selector={"team": "infra"})
+        result = expect_provisioned(env, pod)
+        node = expect_scheduled(env, result, pod)
+        assert node.metadata.labels[PROV] == "labeled"
+
+    def test_prefer_no_schedule_taint_avoided_when_alternative_exists(self):
+        # suite_test.go:1147
+        env = make_environment()
+        env.kube.create(
+            make_provisioner(
+                name="soft-tainted", weight=100,
+                taints=[Taint("dedicated", "x", effect="PreferNoSchedule")],
+            )
+        )
+        env.kube.create(make_provisioner(name="clean", weight=1))
+        pod = make_pod()
+        result = expect_provisioned(env, pod)
+        node = expect_scheduled(env, result, pod)
+        assert node.metadata.labels[PROV] == "clean"
+
+    def test_weighted_provisioner_priority(self):
+        # suite_test.go:1155
+        env = make_environment()
+        env.kube.create(make_provisioner(name="light", weight=1))
+        env.kube.create(make_provisioner(name="heavy", weight=100))
+        pod = make_pod()
+        result = expect_provisioned(env, pod)
+        node = expect_scheduled(env, result, pod)
+        assert node.metadata.labels[PROV] == "heavy"
+
+
+class TestNodeSelectors:
+    """suite_test.go:126-176 — well-known selector support + accelerators."""
+
+    def test_well_known_selectors(self):
+        # suite_test.go:126-163: valid well-known selector values schedule,
+        # unknown values (or undefined custom labels) do not
+        schedulable = {
+            PROV: "default",
+            ZONE: "test-zone-2",
+            ITYPE: "default-instance-type",
+            ARCH: labels_api.ARCHITECTURE_ARM64,
+            OS: "linux",
+            CT: "spot",
+        }
+        unschedulable = {
+            PROV: "unknown",
+            ZONE: "unknown",
+            ITYPE: "unknown",
+            ARCH: "unknown",
+            OS: "unknown",
+            CT: "unknown",
+            "foo": "bar",
+        }
+        for key, value in schedulable.items():
+            env = make_environment()
+            env.kube.create(make_provisioner())
+            pod = make_pod(node_selector={key: value})
+            result = expect_provisioned(env, pod)
+            expect_scheduled(env, result, pod)
+        for key, value in unschedulable.items():
+            env = make_environment()
+            env.kube.create(make_provisioner())
+            pod = make_pod(node_selector={key: value})
+            result = expect_provisioned(env, pod)
+            expect_not_scheduled(env, result, pod)
+
+    def test_unknown_custom_label_fails_unless_provisioner_defines_it(self):
+        # requirements.go:123-133 custom labels denied-if-undefined
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(node_selector={"example.com/rack": "r1"})
+        result = expect_provisioned(env, pod)
+        expect_not_scheduled(env, result, pod)
+
+        env2 = make_environment()
+        env2.kube.create(
+            make_provisioner(
+                requirements=[NodeSelectorRequirement("example.com/rack", OP_IN, ["r1"])]
+            )
+        )
+        pod2 = make_pod(node_selector={"example.com/rack": "r1"})
+        result2 = expect_provisioned(env2, pod2)
+        node = expect_scheduled(env2, result2, pod2)
+        assert node.metadata.labels["example.com/rack"] == "r1"
+
+    def test_accelerator_resources(self):
+        # suite_test.go:164-176
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(requests={fake_cp.RESOURCE_GPU_VENDOR_A: 1})
+        result = expect_provisioned(env, pod)
+        node = expect_scheduled(env, result, pod)
+        assert node.metadata.labels[ITYPE] == "gpu-vendor-instance-type"
+
+    def test_max_pods_opens_multiple_nodes(self):
+        # suite_test.go:177-197: a pods=1 instance type forces one node per pod
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pods = [
+            make_pod(node_selector={ITYPE: "single-pod-instance-type"})
+            for _ in range(3)
+        ]
+        result = expect_provisioned(env, *pods)
+        nodes = {result[p.uid].name for p in pods if result[p.uid] is not None}
+        assert len(nodes) == 3
+
+
+class TestResourceLimits:
+    """suite_test.go:237-358 — provisioner limit enforcement."""
+
+    def _pinned_pod(self, cpu="3"):
+        # pin to the 4-cpu type so the pessimistic reservation is exactly 4
+        return make_pod(
+            requests={"cpu": cpu}, node_selector={ITYPE: "default-instance-type"}
+        )
+
+    def test_partial_scheduling_at_limit(self):
+        # suite_test.go:264-308
+        env = make_environment()
+        env.kube.create(make_provisioner(limits={"cpu": 4}))
+        pods = [self._pinned_pod(), self._pinned_pod()]
+        result = expect_provisioned(env, *pods)
+        scheduled = [p for p in pods if result[p.uid] is not None]
+        assert len(scheduled) == 1
+        assert len(env.provider.create_calls) == 1
+
+    def test_limit_persists_across_rounds(self):
+        # suite_test.go:334-358
+        env = make_environment()
+        env.kube.create(make_provisioner(limits={"cpu": 4}))
+        first = self._pinned_pod()
+        result = expect_provisioned(env, first)
+        assert result[first.uid] is not None
+        env.make_all_nodes_ready()
+        late = self._pinned_pod()
+        result = expect_provisioned(env, late)
+        assert result[late.uid] is None
+        assert len(env.provider.create_calls) == 1
+
+    def test_gpu_limit_blocks_gpu_pods(self):
+        # suite_test.go:321-333
+        env = make_environment()
+        env.kube.create(make_provisioner(limits={fake_cp.RESOURCE_GPU_VENDOR_A: 0}))
+        pod = make_pod(requests={fake_cp.RESOURCE_GPU_VENDOR_A: 1})
+        result = expect_provisioned(env, pod)
+        expect_not_scheduled(env, result, pod)
+
+
+class TestDaemonOverhead:
+    """suite_test.go:359-529 — daemonset accounting edge cases."""
+
+    def test_daemonset_without_matching_toleration_ignored(self):
+        # suite_test.go:475-493
+        env = make_environment()
+        env.kube.create(
+            make_provisioner(name="tainted", taints=[Taint("dedicated", "x")])
+        )
+        # daemon does NOT tolerate the provisioner taint: its overhead must
+        # not reserve capacity on this provisioner's nodes
+        env.kube.create(make_daemonset_pod(requests={"cpu": 1}, unschedulable=False))
+        pod = make_pod(
+            requests={"cpu": "3500m"},
+            tolerations=[Toleration(key="dedicated", operator="Exists")],
+        )
+        result = expect_provisioned(env, pod)
+        node = expect_scheduled(env, result, pod)
+        # 3.5 cpu fits the 4-cpu default type only if the daemon was ignored
+        assert node.metadata.labels[ITYPE] == "default-instance-type"
+
+    def test_daemonset_with_startup_taint_toleration_counted(self):
+        # suite_test.go:377-397
+        env = make_environment()
+        env.kube.create(
+            make_provisioner(
+                name="boot", startup_taints=[Taint("boot.sh/agent", "", effect="NoSchedule")]
+            )
+        )
+        env.kube.create(
+            make_daemonset_pod(
+                requests={"cpu": 1}, unschedulable=False,
+                tolerations=[Toleration(key="boot.sh/agent", operator="Exists")],
+            )
+        )
+        pod = make_pod(requests={"cpu": "3500m"})
+        result = expect_provisioned(env, pod)
+        node = expect_scheduled(env, result, pod)
+        # daemon tolerates the startup taint, so it reserves 1 cpu: the pod
+        # must land on the bigger arm shape
+        assert node.metadata.labels[ITYPE] == "arm-instance-type"
+
+    def test_daemonset_not_in_unspecified_key_counted(self):
+        # suite_test.go:511-528: NotIn on a key the template doesn't set still
+        # matches (the label is absent), so the daemon counts
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        env.kube.create(
+            make_daemonset_pod(
+                requests={"cpu": 1}, unschedulable=False,
+                node_requirements=[
+                    NodeSelectorRequirement("example.com/unset", "NotIn", ["never"])
+                ],
+            )
+        )
+        pod = make_pod(requests={"cpu": "3500m"})
+        result = expect_provisioned(env, pod)
+        node = expect_scheduled(env, result, pod)
+        assert node.metadata.labels[ITYPE] == "arm-instance-type"
+
+
+class TestMachineCreation:
+    """suite_test.go:542-901 — the launched Machine/Node artifacts."""
+
+    def test_provisioner_labels_and_annotations_propagate(self):
+        # suite_test.go:531-567
+        env = make_environment()
+        prov = make_provisioner(labels={"team": "infra"})
+        prov.spec.annotations["example.com/note"] = "hello"
+        env.kube.create(prov)
+        pod = make_pod()
+        result = expect_provisioned(env, pod)
+        node = expect_scheduled(env, result, pod)
+        assert node.metadata.labels["team"] == "infra"
+        assert node.metadata.annotations["example.com/note"] == "hello"
+        machine = env.provider.create_calls[0]
+        assert machine.metadata.labels["team"] == "infra"
+        assert machine.metadata.annotations["example.com/note"] == "hello"
+
+    def test_machine_requirements_restrict_instance_types_on_arch(self):
+        # suite_test.go:691-722
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(node_selector={ARCH: labels_api.ARCHITECTURE_ARM64})
+        result = expect_provisioned(env, pod)
+        expect_scheduled(env, result, pod)
+        machine = env.provider.create_calls[0]
+        type_req = next(
+            r for r in machine.spec.requirements if r.key == ITYPE
+        )
+        assert type_req.values == ["arm-instance-type"]
+
+    def test_machine_owner_reference(self):
+        # suite_test.go:783-800
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        expect_provisioned(env, make_pod())
+        machine = env.provider.create_calls[0]
+        owner = machine.metadata.owner_references[0]
+        assert (owner.kind, owner.name) == ("Provisioner", "default")
+
+    def test_machine_resource_requests_include_daemon_overhead(self):
+        # suite_test.go:878-901
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        env.kube.create(make_daemonset_pod(requests={"cpu": 1}, unschedulable=False))
+        pod = make_pod(requests={"cpu": 1})
+        expect_provisioned(env, pod)
+        machine = env.provider.create_calls[0]
+        assert machine.spec.resources_requests.get("cpu", 0) >= 2.0
+
+
+class TestVolumeTopologyMatrix:
+    """suite_test.go:902-1021 — PV/StorageClass zone requirements."""
+
+    def _storage_class(self, env, name="sc", zones=None):
+        env.kube.create(
+            StorageClass(
+                metadata=ObjectMeta(name=name, namespace=""),
+                provisioner="ebs",
+                allowed_topologies=(
+                    [
+                        NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(ZONE, OP_IN, list(zones))
+                            ]
+                        )
+                    ]
+                    if zones
+                    else []
+                ),
+            )
+        )
+
+    def _claim(self, env, name="claim", sc="sc", volume_name=""):
+        env.kube.create(
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name=name, namespace="default"),
+                spec=PersistentVolumeClaimSpec(
+                    volume_name=volume_name, storage_class_name=sc
+                ),
+            )
+        )
+
+    def _pod_with_claim(self, claim="claim"):
+        pod = make_pod()
+        pod.spec.volumes.append(
+            Volume(name="data", persistent_volume_claim=PersistentVolumeClaimVolumeSource(claim))
+        )
+        return pod
+
+    def test_unbound_claim_uses_storage_class_zones(self):
+        # suite_test.go:943-954
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        self._storage_class(env, zones=["test-zone-3"])
+        self._claim(env)
+        pod = self._pod_with_claim()
+        result = expect_provisioned(env, pod)
+        node = expect_scheduled(env, result, pod)
+        assert node.metadata.labels[ZONE] == "test-zone-3"
+
+    def test_incompatible_storage_class_zones_fail(self):
+        # suite_test.go:955-965
+        env = make_environment()
+        env.kube.create(
+            make_provisioner(
+                requirements=[NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1"])]
+            )
+        )
+        self._storage_class(env, zones=["test-zone-3"])
+        self._claim(env)
+        pod = self._pod_with_claim()
+        result = expect_provisioned(env, pod)
+        expect_not_scheduled(env, result, pod)
+
+    def test_volume_zone_not_relaxed_away(self):
+        # suite_test.go:988-1021: the injected volume zone is ANDed into the
+        # required terms, so preference relaxation can never drop it
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        self._storage_class(env, zones=["test-zone-2"])
+        self._claim(env)
+        # a preferred node affinity for a different zone: relaxation may drop
+        # the preference, never the injected volume requirement
+        pod = make_pod(
+            node_preferences=[NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1"])]
+        )
+        pod.spec.volumes.append(
+            Volume(name="data", persistent_volume_claim=PersistentVolumeClaimVolumeSource("claim"))
+        )
+        result = expect_provisioned(env, pod)
+        node = expect_scheduled(env, result, pod)
+        assert node.metadata.labels[ZONE] == "test-zone-2"
