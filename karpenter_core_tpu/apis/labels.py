@@ -102,9 +102,13 @@ def is_restricted_label(key: str) -> "str | None":
 
 
 def is_restricted_node_label(key: str) -> bool:
-    """True for labels that may not appear on nodes (labels.go:127-138)."""
+    """True for labels Karpenter must not inject onto nodes itself
+    (labels.go:123-138): well-known labels are the CLOUD PROVIDER's to stamp
+    (it knows the resolved zone/instance type; rendering them from a
+    multi-valued requirement would pick an arbitrary value), restricted
+    labels/domains are owned by other software."""
     if key in WELL_KNOWN_LABELS:
-        return False
+        return True
     if key in RESTRICTED_LABELS:
         return True
     domain = _domain(key)

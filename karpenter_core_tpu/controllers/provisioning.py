@@ -574,11 +574,16 @@ class ProvisioningController:
         node.metadata.finalizers = [labels_api.TERMINATION_FINALIZER]
         node.spec.provider_id = created.status.provider_id
 
-        # idempotent node pre-create (provisioner.go:338-348)
+        # idempotent node pre-create (provisioner.go:338-348): only
+        # already-exists is tolerable; any other failure fails the launch
+        from karpenter_core_tpu.operator.kubeclient import ConflictError
+
         try:
             self.kube_client.create(node)
-        except Exception:
+        except ConflictError:
             log.debug("node already registered")
+        except Exception as e:  # noqa: BLE001 - surfaced to the caller
+            return None, f"creating node {node.name}, {e}"
         err = self.cluster.update_node(node)
         if err is not None:
             return None, f"updating cluster state, {err}"

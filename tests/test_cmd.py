@@ -23,6 +23,10 @@ class TestEntrypoints:
             METRICS_PORT="0", HEALTH_PROBE_PORT="18281",
             KC_SOLVER_LISTEN="127.0.0.1:18980", JAX_PLATFORMS="cpu",
         )
+        # the pair runs on CPU here; drop the accelerator-tunnel trigger so
+        # child interpreters don't block in the tunnel's sitecustomize
+        # registration when the device link is down
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         # metrics port must be fixed for curl; pick distinct ephemeral-ish ones
         env["METRICS_PORT"] = "18280"
         proc = subprocess.run(

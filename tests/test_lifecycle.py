@@ -340,3 +340,100 @@ class TestInflightChecksMatrix:
             [e for e in env.recorder.events if e.reason == "FailedInflightCheck"]
         )
         assert count_after_second == count_after_first
+
+
+class TestTerminationMatrix:
+    """termination/suite_test.go:88-620 — the drain decision table."""
+
+    def _node_with(self, env, *pods):
+        env.kube.create(make_provisioner())
+        anchor = make_pod(requests={"cpu": "100m"})
+        result = expect_provisioned(env, anchor)
+        node = result[anchor.uid]
+        env.kube.delete(anchor, force=True)
+        for pod in pods:
+            pod.spec.node_name = node.name
+            env.kube.create(pod)
+        return node
+
+    def test_exclude_balancers_label_on_cordon(self):
+        # suite_test.go:122-140
+        env = make_environment()
+        blocker = make_pod(
+            annotations={labels_api.DO_NOT_EVICT_POD_ANNOTATION_KEY: "true"},
+            unschedulable=False,
+        )
+        node = self._node_with(env, blocker)
+        env.kube.delete(node)
+        live = env.kube.get_node(node.name)
+        assert live is not None  # do-not-evict keeps it alive to inspect
+        assert live.metadata.labels[labels_api.LABEL_NODE_EXCLUDE_BALANCERS] == "karpenter"
+
+    def test_do_not_evict_static_pod_blocks(self):
+        # suite_test.go:254-303: a static (node-owned) do-not-evict pod still
+        # blocks the drain; deleting it unblocks
+        env = make_environment()
+        static_blocker = make_pod(
+            name="static-block",
+            annotations={labels_api.DO_NOT_EVICT_POD_ANNOTATION_KEY: "true"},
+            owner_kind="Node",
+            unschedulable=False,
+        )
+        node = self._node_with(env, static_blocker)
+        env.kube.delete(node)
+        assert env.kube.get_node(node.name) is not None
+        env.kube.delete(static_blocker, force=True)
+        # the watch loop re-reconciles the still-deleting node
+        env.termination.reconcile(env.kube.get_node(node.name))
+        assert env.kube.get_node(node.name) is None
+
+    def test_pods_without_owner_ref_evicted(self):
+        # suite_test.go:304-332
+        env = make_environment()
+        orphan = make_pod(name="orphan", unschedulable=False)
+        node = self._node_with(env, orphan)
+        env.kube.delete(node)
+        assert env.kube.get_node(node.name) is None
+        assert env.kube.get_pod(orphan.namespace, orphan.name) is None
+
+    def test_do_not_evict_orphan_blocks(self):
+        # suite_test.go:333-376
+        env = make_environment()
+        orphan = make_pod(
+            name="orphan-block",
+            annotations={labels_api.DO_NOT_EVICT_POD_ANNOTATION_KEY: "true"},
+            unschedulable=False,
+        )
+        node = self._node_with(env, orphan)
+        env.kube.delete(node)
+        assert env.kube.get_node(node.name) is not None
+
+    def test_terminal_pods_do_not_block(self):
+        # suite_test.go:377-393
+        env = make_environment()
+        done = make_pod(name="done", phase="Succeeded", unschedulable=False)
+        failed = make_pod(name="failed", phase="Failed", unschedulable=False)
+        node = self._node_with(env, done, failed)
+        env.kube.delete(node)
+        assert env.kube.get_node(node.name) is None
+
+    def test_do_not_evict_ignored_where_it_does_not_apply(self):
+        # suite_test.go:394-428: do-not-evict on an already-deleting pod does
+        # not block the drain once it is stuck terminating
+        env = make_environment()
+        leaving = make_pod(
+            name="leaving",
+            annotations={labels_api.DO_NOT_EVICT_POD_ANNOTATION_KEY: "true"},
+            unschedulable=False,
+        )
+        node = self._node_with(env, leaving)
+        env.kube.delete(leaving)  # graceful: deletion timestamp set
+        env.kube.delete(node)
+        # the do-not-evict annotation must not abort the drain (the pod is
+        # already deleting); the drain waits on its termination, and the
+        # 1-minute stuck-terminating bypass stops even that wait
+        env.clock.step(120)
+        live = env.kube.get_node(node.name)
+        if live is not None:
+            env.termination.reconcile(live)
+        assert env.kube.get_node(node.name) is None

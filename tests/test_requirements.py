@@ -255,17 +255,24 @@ class TestRequirementsCompatibility:
 
 class TestLabels:
     def test_labels_skips_restricted(self):
+        # well-known labels are the cloud provider's to stamp; rendering them
+        # from requirements would pick arbitrary values from multi-valued
+        # sets (labels.go:127-129: IsRestrictedNodeLabel is true for
+        # WellKnownLabels).  Only custom single-valued requirements render.
         r = Requirements(
             Requirement(labels_api.LABEL_HOSTNAME, OP_IN, ["h1"]),
             Requirement(labels_api.LABEL_TOPOLOGY_ZONE, OP_IN, ["z1"]),
+            Requirement("example.com/team", OP_IN, ["infra"]),
         )
         labels = r.labels()
         assert labels_api.LABEL_HOSTNAME not in labels
-        assert labels[labels_api.LABEL_TOPOLOGY_ZONE] == "z1"
+        assert labels_api.LABEL_TOPOLOGY_ZONE not in labels
+        assert labels["example.com/team"] == "infra"
 
     def test_restricted_label_taxonomy(self):
         assert labels_api.is_restricted_node_label(labels_api.LABEL_HOSTNAME)
-        assert not labels_api.is_restricted_node_label(labels_api.LABEL_TOPOLOGY_ZONE)
+        # well-known labels must not be self-injected either (labels.go:127-129)
+        assert labels_api.is_restricted_node_label(labels_api.LABEL_TOPOLOGY_ZONE)
         assert labels_api.is_restricted_node_label("karpenter.sh/custom")
         assert not labels_api.is_restricted_node_label("example.com/team")
         assert not labels_api.is_restricted_node_label("kops.k8s.io/instancegroup")

@@ -123,3 +123,171 @@ class TestClusterState:
         env.kube.apply(node)
         state_node = env.cluster.snapshot_nodes()[0]
         assert len(state_node.taints()) == 1
+
+
+class TestResourceLevelMatrix:
+    """state/suite_test.go:92-565 — the resource accounting table."""
+
+    def test_inflight_capacity_combines_node_and_instance_type(self):
+        # suite_test.go:105-133: values the node reports win; the instance
+        # type stands in for the rest until the kubelet catches up
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        owned_node(env, allocatable={"cpu": 2}, capacity={"cpu": 2})
+        state_node = env.cluster.snapshot_nodes()[0]
+        assert state_node.allocatable()["cpu"] == 2  # node-reported wins
+        assert state_node.allocatable()["memory"] > 0  # instance-type stand-in
+
+    def test_unbound_pods_not_counted(self):
+        # suite_test.go:135-165
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        owned_node(env)
+        env.kube.create(make_pod(requests={"cpu": 2}))  # pending, unbound
+        state_node = env.cluster.snapshot_nodes()[0]
+        assert state_node.pod_requests_total().get("cpu", 0) == 0
+
+    def test_terminal_pods_not_counted(self):
+        # suite_test.go:280-317
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_node(env)
+        for phase in ("Succeeded", "Failed"):
+            env.kube.create(
+                make_pod(
+                    name=f"done-{phase.lower()}", requests={"cpu": 1},
+                    node_name=node.name, unschedulable=False, phase=phase,
+                )
+            )
+        state_node = env.cluster.snapshot_nodes()[0]
+        assert state_node.pod_requests_total().get("cpu", 0) == 0
+
+    def test_pod_rebind_moves_usage(self):
+        # suite_test.go:356-427: a missed delete shows up as the same pod
+        # bound elsewhere; usage must move, not double-count
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node1 = owned_node(env, name="n1")
+        node2 = owned_node(env, name="n2")
+        pod = make_pod(requests={"cpu": 2}, node_name=node1.name, unschedulable=False)
+        env.kube.create(pod)
+        pod.spec.node_name = node2.name
+        env.kube.apply(pod)
+        by_name = {n.node.name: n for n in env.cluster.snapshot_nodes()}
+        assert by_name["n1"].pod_requests_total().get("cpu", 0) == 0
+        assert by_name["n2"].pod_requests_total()["cpu"] == 2
+
+    def test_usage_correct_across_churn(self):
+        # suite_test.go:428-492
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_node(env)
+        pods = [
+            make_pod(name=f"churn-{i}", requests={"cpu": 1},
+                     node_name=node.name, unschedulable=False)
+            for i in range(5)
+        ]
+        for p in pods:
+            env.kube.create(p)
+        for p in pods[:3]:
+            env.kube.delete(p, force=True)
+        state_node = env.cluster.snapshot_nodes()[0]
+        assert state_node.pod_requests_total()["cpu"] == 2
+        assert state_node.pod_count() == 2
+
+    def test_daemonset_requests_tracked_separately(self):
+        # suite_test.go:493-567
+        from karpenter_core_tpu.testing import make_daemonset_pod
+
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_node(env)
+        env.kube.create(
+            make_daemonset_pod(
+                requests={"cpu": 1}, node_name=node.name, unschedulable=False
+            )
+        )
+        env.kube.create(
+            make_pod(requests={"cpu": 2}, node_name=node.name, unschedulable=False)
+        )
+        state_node = env.cluster.snapshot_nodes()[0]
+        assert state_node.daemon_set_requests()["cpu"] == 1
+        assert state_node.pod_requests_total()["cpu"] == 3  # daemons count too
+
+
+class TestAntiAffinityTracking:
+    """state/suite_test.go:617-792 — the anti-affinity pod index."""
+
+    def _anti_pod(self, preferred=False, **kwargs):
+        from karpenter_core_tpu.apis.objects import (
+            LabelSelector,
+            PodAffinityTerm,
+            WeightedPodAffinityTerm,
+        )
+
+        term = PodAffinityTerm(
+            topology_key=labels_api.LABEL_HOSTNAME,
+            label_selector=LabelSelector(match_labels={"app": "a"}),
+        )
+        if preferred:
+            kwargs["pod_anti_affinity_preferred"] = [
+                WeightedPodAffinityTerm(weight=1, pod_affinity_term=term)
+            ]
+        else:
+            kwargs["pod_anti_affinity"] = [term]
+        return make_pod(labels={"app": "a"}, unschedulable=False, **kwargs)
+
+    def _tracked(self, env):
+        visited = []
+        env.cluster.for_pods_with_anti_affinity(
+            lambda p, n: visited.append(p.name) or True
+        )
+        return visited
+
+    def test_preferred_anti_affinity_not_tracked(self):
+        # suite_test.go:657-698
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_node(env)
+        env.kube.create(self._anti_pod(preferred=True, node_name=node.name))
+        assert self._tracked(env) == []
+
+    def test_deleted_anti_pod_untracked(self):
+        # suite_test.go:699-747
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = owned_node(env)
+        pod = self._anti_pod(node_name=node.name)
+        env.kube.create(pod)
+        assert self._tracked(env) == [pod.name]
+        env.kube.delete(pod, force=True)
+        assert self._tracked(env) == []
+
+    def test_anti_pod_bound_before_node_registers(self):
+        # suite_test.go:748-792: the pod watch can fire before the node's;
+        # the index must still resolve once the node arrives
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = self._anti_pod(node_name="late-node")
+        env.kube.create(pod)
+        owned_node(env, name="late-node")
+        visited = []
+        env.cluster.for_pods_with_anti_affinity(
+            lambda p, n: visited.append((p.name, n.name)) or True
+        )
+        assert visited == [(pod.name, "late-node")]
+
+
+class TestConsolidationStateTriggers:
+    def test_provisioner_update_changes_state(self):
+        # state/suite_test.go:793-820 (generation-change filter lives in the
+        # informer: only spec updates count)
+        env = make_environment()
+        prov = make_provisioner()
+        env.kube.create(prov)
+        state0 = env.cluster.cluster_consolidation_state()
+        env.clock.step(1)
+        prov.spec.weight = 50
+        prov.metadata.generation += 1
+        env.kube.apply(prov)
+        assert env.cluster.cluster_consolidation_state() != state0
