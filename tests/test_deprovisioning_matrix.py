@@ -344,3 +344,144 @@ class TestConsolidationInterplay:
         n1 = env.kube.get_pod(anti[0].namespace, anti[0].name).spec.node_name
         n2 = env.kube.get_pod(anti[1].namespace, anti[1].name).spec.node_name
         assert n1 != n2
+
+
+class TestDriftAnnotationEdges:
+    """suite_test.go:182-242 — only the exact drifted annotation value acts."""
+
+    def _drift_env(self):
+        from karpenter_core_tpu.operator.settings import Settings
+
+        env = make_environment(settings=Settings(drift_enabled=True))
+        env.kube.create(make_provisioner())
+        return env
+
+    def test_wrong_annotation_value_ignored(self):
+        # suite_test.go:182: the voluntary-disruption key with a non-drifted
+        # value must not deprovision
+        env = self._drift_env()
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        node = env.kube.list_nodes()[0]
+        node.metadata.annotations[
+            labels_api.VOLUNTARY_DISRUPTION_ANNOTATION_KEY
+        ] = "not-drifted"
+        env.kube.apply(node)
+        env.deprovisioning.reconcile()
+        assert len(env.kube.list_nodes()) == 1
+
+    def test_no_annotation_ignored(self):
+        # suite_test.go:214: provider says drifted but the node controller
+        # has not stamped the annotation yet — deprovisioning must not act
+        env = self._drift_env()
+        pod = make_pod(requests={"cpu": "100m"})
+        provision_and_ready(env, pod)
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        env.provider.drifted = True  # annotation NOT stamped
+        env.deprovisioning.reconcile()
+        assert len(env.kube.list_nodes()) == 1
+
+
+class TestMultiNodeReplacement:
+    """suite_test.go:332-423,725-817 — one disrupted node can need several
+    replacements when its pods no longer fit one shape."""
+
+    def test_expired_node_replaced_with_multiple_nodes(self):
+        # pods land on one 16-cpu node; the replacement catalog is capped at
+        # 4-cpu shapes, so expiring it must launch several nodes
+        big_first = [
+            fake_cp.new_instance_type(
+                "big", resources={"cpu": 16.0, "memory": 64 * fake_cp.GI, "pods": 32.0}
+            ),
+            fake_cp.new_instance_type(
+                "small", resources={"cpu": 4.0, "memory": 16 * fake_cp.GI, "pods": 32.0}
+            ),
+        ]
+        env = make_environment(instance_types=big_first)
+        env.kube.create(make_provisioner(ttl_seconds_until_expired=100))
+        pods = [make_pod(name=f"w{i}", requests={"cpu": 3}) for i in range(3)]
+        provision_and_ready(env, *pods)
+        assert len(env.kube.list_nodes()) == 1
+        # make the big shape unlaunchable (offerings unavailable) so the
+        # replacement cannot be a single big node; the type stays in the
+        # catalog so the candidate remains eligible (helpers.go:171-249)
+        from dataclasses import replace as dc_replace
+
+        big = env.provider.get_instance_types(None)[0]
+        for i, o in enumerate(big.offerings):
+            big.offerings[i] = dc_replace(o, available=False)
+        env.clock.step(150)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.SUCCESS
+        nodes = env.kube.list_nodes()
+        assert len(nodes) >= 2
+        assert all(
+            n.metadata.labels[labels_api.LABEL_INSTANCE_TYPE_STABLE] == "small"
+            for n in nodes
+        )
+
+
+class TestLifetimeConsideration:
+    """suite_test.go:1745-1826 — disruption cost scales with lifetime
+    remaining, so nearly-expired nodes are disrupted first."""
+
+    def test_older_node_consolidated_first(self):
+        env = make_environment()
+        env.kube.create(
+            make_provisioner(
+                consolidation_enabled=True, ttl_seconds_until_expired=1000
+            )
+        )
+        first = make_pod(name="old-pod", requests={"cpu": 9})
+        provision_and_ready(env, first)
+        old_node = env.kube.list_nodes()[0]
+        env.clock.step(600)  # old node has 40% lifetime left
+        second = make_pod(name="new-pod", requests={"cpu": 9})
+        provision_and_ready(env, second)
+        # drop both pods so both nodes become empty-consolidatable; the older
+        # node must be acted on first (lower lifetime-scaled cost)
+        for p in (first, second):
+            env.kube.delete(env.kube.get_pod(p.namespace, p.name), force=True)
+        env.clock.step(30)
+        env.deprovisioning.reconcile()
+        remaining = {n.name for n in env.kube.list_nodes()}
+        assert old_node.name not in remaining or len(remaining) == 0
+
+
+class TestTopologyOnReplace:
+    """suite_test.go:1827-1935 — replacement must keep the zonal spread."""
+
+    def test_replace_maintains_zonal_spread(self):
+        from karpenter_core_tpu.apis.objects import TopologySpreadConstraint
+
+        env = make_environment()
+        env.kube.create(make_provisioner(consolidation_enabled=True))
+        sel = LabelSelector(match_labels={"app": "web"})
+        pods = [
+            make_pod(
+                name=f"s{i}", labels={"app": "web"}, requests={"cpu": 9},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=ZONE, label_selector=sel
+                    )
+                ],
+            )
+            for i in range(3)
+        ]
+        provision_and_ready(env, *pods)
+        nodes = env.kube.list_nodes()
+        assert len(nodes) == 3
+        zones = {n.metadata.labels[ZONE] for n in nodes}
+        assert len(zones) == 3  # spread across all three zones
+        env.clock.step(30)
+        result, _ = env.deprovisioning.reconcile()
+        # any replacement (cheaper shape) must land in the vacated zone so
+        # skew stays <= 1; with 1 pod per zone, deleting without replacement
+        # would break the spread, so nothing may reduce zone coverage
+        live_zones = [
+            n.metadata.labels[ZONE]
+            for n in env.kube.list_nodes()
+            if n.metadata.labels.get(ZONE)
+        ]
+        assert len(set(live_zones)) == 3
