@@ -10,13 +10,113 @@ scheduler (scheduling_benchmark_test.go:48,178-182) — the only published
 performance number the reference has.  vs_baseline is our pods/sec over that
 floor (higher is better).  The measured value is warm end-to-end wall time:
 snapshot encode (host) + kernel solve (device) + decode (host).
+
+Environment resilience: the reference's perf gate runs anywhere, every time
+(scheduling_benchmark_test.go:48).  This bench's preferred backend is a real
+TPU behind a relay that can flap — and whose observed failure mode is a HANG,
+not a fast error.  So backend bring-up happens through bounded fresh-process
+probes with hard timeouts and backoff (`acquire_backend`); if every probe
+fails, the process pins itself to CPU and still emits an honestly-stamped
+number (`detail.platform`), and any unrecoverable error prints one structured
+JSON failure line instead of a traceback.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
+# written by acquire_backend; stamped into every output line (success or not)
+_BACKEND = {"platform": None, "attempts": 0, "fell_back": False, "probe_failures": []}
+
+_PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp;"
+    "jnp.ones((8, 8)).sum().block_until_ready();"
+    "print('PLATFORM=' + jax.default_backend())"
+)
+
+
+def _probe_once(timeout_s: float):
+    """One fresh-interpreter device probe: init backend + run a tiny op.
+
+    Returns (platform, "") on success, (None, reason) on failure.  A fresh
+    process per attempt matters twice over: JAX caches a failed backend init
+    for the life of a process, and the axon relay's failure mode is a hang
+    that only a subprocess timeout can bound.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SNIPPET],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"probe hung past {timeout_s:.0f}s (killed)"
+    if proc.returncode == 0:
+        for line in proc.stdout.splitlines():
+            if line.startswith("PLATFORM="):
+                return line.split("=", 1)[1].strip(), ""
+        return None, "probe exited 0 but printed no platform"
+    tail = (proc.stderr or proc.stdout).strip().splitlines()
+    return None, (tail[-1][:300] if tail else f"probe rc={proc.returncode}")
+
+
+def acquire_backend(max_attempts: int = 5, probe_timeout_s: float = 60.0,
+                    deadline_s: float = 360.0) -> None:
+    """Bounded-retry backend bring-up; never raises.
+
+    Up to ``max_attempts`` probes with exponential backoff under an overall
+    deadline.  First success wins — the backend is then known-healthy and this
+    process imports jax normally.  All-fail re-execs this process onto CPU
+    (``_reexec_on_cpu``) so the bench still produces a verified number with
+    ``platform: "cpu"`` stamped, rather than dying the way round 2's run did
+    when the relay was down.
+
+    If a previous incarnation of this process already ran the probes and
+    re-exec'd, its verdict arrives via KC_BENCH_BACKEND_STATE and no probes
+    run again.
+    """
+    pinned = os.environ.pop("KC_BENCH_BACKEND_STATE", None)
+    if pinned:
+        _BACKEND.update(json.loads(pinned))
+        return
+    t0 = time.monotonic()
+    attempt = 0
+    while attempt < max_attempts:
+        attempt += 1
+        platform, err = _probe_once(probe_timeout_s)
+        if platform is not None:
+            _BACKEND.update(platform=platform, attempts=attempt, fell_back=False)
+            return
+        _BACKEND["probe_failures"].append(f"attempt {attempt}: {err}")
+        print(f"backend probe {attempt}/{max_attempts} failed: {err}", file=sys.stderr)
+        if attempt < max_attempts and time.monotonic() - t0 < deadline_s:
+            time.sleep(min(5.0 * 2 ** (attempt - 1), 60.0))
+        elif time.monotonic() - t0 >= deadline_s:
+            _BACKEND["probe_failures"].append(f"deadline {deadline_s:.0f}s exhausted")
+            break
+    _BACKEND.update(platform="cpu", attempts=attempt, fell_back=True)
+    _reexec_on_cpu()
+
+
+def _reexec_on_cpu() -> None:
+    """Replace this process with a CPU-pinned copy of itself.
+
+    Scrubbing the axon env vars after startup is not enough: the environment's
+    sitecustomize installs the axon backend hook at *interpreter start*, so a
+    process born with PALLAS_AXON_POOL_IPS set routes device ops to the (dead)
+    relay no matter what JAX_PLATFORMS says later.  Same-pid exec keeps the
+    driver's stdout capture intact.
+    """
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize skips axon registration
+    env.pop("AXON_POOL_SVC_OVERRIDE", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["KC_BENCH_BACKEND_STATE"] = json.dumps(_BACKEND)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env)
 
 
 def _listdir(path: str):
@@ -128,9 +228,120 @@ def restart_probe(n_pods: int, n_its: int) -> None:
     print(json.dumps({"restart_cold_s": round(elapsed, 2), "scheduled": scheduled}))
 
 
+def scale_line_100k(n_its: int) -> dict:
+    """BASELINE.md scale config: 100k pods × n_its types, cold + warm
+    (VERDICT r2 #7 — the real-chip datum for ROADMAP's virtual-mesh 3.3 s)."""
+    from karpenter_core_tpu.models.columnar import PodIngest
+    from karpenter_core_tpu.ops import solve as solve_ops
+
+    solver, pods = build_inputs(100_000, n_its, n_provisioners=5)
+    t0 = time.perf_counter()
+    ingest = PodIngest()
+    ingest.add_all(pods)
+    snapshot = solver.encode(ingest)
+    out = solve_ops.solve(snapshot)
+    results = solver.decode(snapshot, out)
+    cold_s = time.perf_counter() - t0
+    warm_s = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        snapshot = solver.encode(ingest)
+        out = solve_ops.solve(snapshot)
+        results = solver.decode(snapshot, out)
+        warm_s = min(warm_s, time.perf_counter() - t0)
+    scheduled = sum(len(n.pods) for n in results.new_nodes)
+    return {
+        "warm_s": round(warm_s, 4),
+        "cold_s": round(cold_s, 2),
+        "scheduled": scheduled,
+        "failed": len(results.failed_pods),
+        "nodes": len(results.new_nodes),
+        "pods_per_sec": round(scheduled / warm_s) if warm_s > 0 else 0,
+    }
+
+
+def consolidation_sweep_line(n_nodes: int = 1000, pods_per_node: int = 3) -> dict:
+    """1000-candidate multi-node consolidation sweep (BASELINE.md config 4).
+
+    Builds the cluster synthetically — nodes and bound pods pushed straight
+    through the informer plane, no provisioning round trips — then times
+    ``TPUConsolidationSearch.compute_command`` end to end (encode + device
+    prefix sweep + re-grid passes + decode), the path the deprovisioning
+    controller runs (multinodeconsolidation.go:74-114 analog).
+    """
+    from karpenter_core_tpu.apis import labels as labels_api
+    from karpenter_core_tpu.cloudprovider import fake as fake_cp
+    from karpenter_core_tpu.controllers.deprovisioning import candidate_nodes
+    from karpenter_core_tpu.solver.consolidation import TPUConsolidationSearch
+    from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+    from karpenter_core_tpu.testing.harness import make_environment
+    from karpenter_core_tpu.utils import resources as resources_util
+
+    env = make_environment(instance_types=fake_cp.instance_types(64))
+    env.kube.create(make_provisioner(name="default", consolidation_enabled=True))
+    # a roomy on-demand instance type: bound pods use a sliver of it, so most
+    # prefixes consolidate (the interesting, full-cost sweep shape)
+    choices = [
+        it for it in env.provider.get_instance_types(None)
+        if resources_util.parse_quantity(it.capacity.get("cpu", 0)) >= 8
+        and any(o.capacity_type == labels_api.CAPACITY_TYPE_ON_DEMAND and o.available
+                for o in it.offerings)
+    ]
+    it = choices[len(choices) // 2]
+    offering = next(
+        o for o in it.offerings
+        if o.capacity_type == labels_api.CAPACITY_TYPE_ON_DEMAND and o.available
+    )
+    for i in range(n_nodes):
+        node = make_node(
+            name=f"sweep-node-{i}",
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: it.name,
+                labels_api.LABEL_TOPOLOGY_ZONE: offering.zone,
+                labels_api.LABEL_CAPACITY_TYPE: offering.capacity_type,
+                labels_api.LABEL_NODE_INITIALIZED: "true",
+            },
+            allocatable=it.allocatable(),
+            capacity=dict(it.capacity),
+            provider_id=f"fake://sweep-node-{i}",
+        )
+        env.kube.create(node)
+        for _ in range(pods_per_node):
+            pod = make_pod(requests={"cpu": "100m", "memory": "64Mi"})
+            env.kube.create(pod)
+            env.bind(pod, node.name)
+    env.clock.step(30)
+    dep = env.deprovisioning
+    candidates = sorted(
+        candidate_nodes(
+            env.cluster, env.kube, env.clock, env.provider,
+            dep.multi_node_consolidation.should_deprovision,
+        ),
+        key=lambda c: c.disruption_cost,
+    )
+    search = TPUConsolidationSearch(env.provider, env.kube.list_provisioners())
+    t0 = time.perf_counter()
+    cmd = search.compute_command(
+        candidates,
+        pending_pods=[],
+        state_nodes=env.cluster.snapshot_nodes(),
+        bound_pods=env.kube.list_pods(),
+    )
+    sweep_s = time.perf_counter() - t0
+    return {
+        "sweep_s": round(sweep_s, 3),
+        "candidates": len(candidates),
+        "action": cmd.action.value,
+        "nodes_removed": len(cmd.nodes_to_remove),
+    }
+
+
 def main() -> None:
     n_pods = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
     n_its = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000
+
+    acquire_backend()
 
     from karpenter_core_tpu.models.columnar import PodIngest
     from karpenter_core_tpu.ops import solve as solve_ops
@@ -182,9 +393,8 @@ def main() -> None:
     materialize_s = time.perf_counter() - t0
 
     # restart cold: a fresh process with the persistent caches this process
-    # just populated — the cost every operator restart actually pays
-    import subprocess
-
+    # just populated — the cost every operator restart actually pays.  The
+    # child inherits os.environ, so a CPU fallback pins it too.
     cold_s = first_boot_cold_s
     try:
         probe = subprocess.run(
@@ -198,35 +408,72 @@ def main() -> None:
 
     scheduled = sum(len(n.pods) for n in results.new_nodes)
     pods_per_sec = scheduled / warm_s if warm_s > 0 else 0.0
+    detail = {
+        "scheduled": scheduled,
+        "failed": len(results.failed_pods),
+        "nodes": len(results.new_nodes),
+        "pods_per_sec": round(pods_per_sec),
+        "cold_s": round(cold_s, 2),
+        "first_boot_cold_s": round(first_boot_cold_s, 2),
+        "caches_warm_at_start": cache_warm_at_start,
+        "ingest_s": round(ingest_s, 3),
+        "encode_s": round(encode_s, 4),
+        "dispatch_s": round(dispatch_s, 4),
+        "solve_decode_s": round(solve_decode_s, 4),
+        "materialize_s": round(materialize_s, 4),
+        "platform": _BACKEND["platform"],
+        "backend_attempts": _BACKEND["attempts"],
+        "backend_fell_back_to_cpu": _BACKEND["fell_back"],
+        "baseline": "reference CI floor: 100 pods/sec (scheduling_benchmark_test.go:48)",
+    }
+    if _BACKEND["probe_failures"]:
+        detail["backend_probe_failures"] = _BACKEND["probe_failures"]
+
+    # scale lines (BASELINE.md configs 3-4): on by default on a real
+    # accelerator, opt-in/out via KC_BENCH_SCALE=1/0 (CPU runs them only on
+    # request — minutes of compute that say nothing about the chip)
+    scale = os.environ.get("KC_BENCH_SCALE", "auto")
+    if scale == "1" or (scale == "auto" and _BACKEND["platform"] != "cpu"):
+        for key, fn in (("scale_100k", lambda: scale_line_100k(n_its)),
+                        ("consolidation_sweep_1000", consolidation_sweep_line)):
+            try:
+                detail[key] = fn()
+            except Exception as e:  # noqa: BLE001 - scale lines never kill the headline
+                detail[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     line = {
         "metric": f"solve_{n_pods // 1000}k_pods_{n_its}_types_wall_clock",
         "value": round(warm_s, 4),
         "unit": "s",
         "vs_baseline": round(pods_per_sec / 100.0, 1),
-        "detail": {
-            "scheduled": scheduled,
-            "failed": len(results.failed_pods),
-            "nodes": len(results.new_nodes),
-            "pods_per_sec": round(pods_per_sec),
-            "cold_s": round(cold_s, 2),
-            "first_boot_cold_s": round(first_boot_cold_s, 2),
-            "caches_warm_at_start": cache_warm_at_start,
-            "ingest_s": round(ingest_s, 3),
-            "encode_s": round(encode_s, 4),
-            "dispatch_s": round(dispatch_s, 4),
-            "solve_decode_s": round(solve_decode_s, 4),
-            "materialize_s": round(materialize_s, 4),
-            "baseline": "reference CI floor: 100 pods/sec (scheduling_benchmark_test.go:48)",
-        },
+        "detail": detail,
     }
     print(json.dumps(line))
 
 
 if __name__ == "__main__":
     if "--restart-probe" in sys.argv:
+        # child of main(): backend already acquired (or pinned) by the parent
         restart_probe(
             int(sys.argv[1]) if len(sys.argv) > 1 else 50_000,
             int(sys.argv[2]) if len(sys.argv) > 2 else 1_000,
         )
     else:
-        main()
+        try:
+            main()
+        except Exception as e:  # noqa: BLE001 - one structured record, not a traceback
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "bench_failed",
+                "value": None,
+                "unit": "s",
+                "vs_baseline": 0.0,
+                "error": {"type": type(e).__name__, "message": str(e)[:500]},
+                "platform": _BACKEND["platform"],
+                "backend_attempts": _BACKEND["attempts"],
+                "backend_fell_back_to_cpu": _BACKEND["fell_back"],
+                "backend_probe_failures": _BACKEND["probe_failures"][:5],
+            }))
+            sys.exit(1)

@@ -35,6 +35,7 @@ from karpenter_core_tpu.cloudprovider import CloudProvider
 from karpenter_core_tpu.events import events as evt
 from karpenter_core_tpu.metrics import REGISTRY, measure
 from karpenter_core_tpu.operator.settings import Settings
+from karpenter_core_tpu.scheduling import Requirement, Requirements
 from karpenter_core_tpu.solver.builder import NoProvisionersError, build_scheduler
 from karpenter_core_tpu.solver.scheduler import SchedulerOptions, SchedulingResults
 from karpenter_core_tpu.state.cluster import Cluster
@@ -371,14 +372,27 @@ class ProvisioningController:
                 self.recorder.publish(
                     evt.pod_failed_to_schedule(pod, "no capacity (tpu solve)")
                 )
-        if host_pods:
-            log.debug(
-                "solving %d kernel-unsupported pods on the host path "
-                "(%d solved on tpu)", len(host_pods), len(tpu_pods),
+        # spread residuals: the kernel flagged these classes as possibly
+        # under-placed vs the host oracle (water-fill round bound / intake
+        # overestimate) — re-solve their leftover pods on the host with the
+        # kernel's placements seeded into the topology counts, so no batch
+        # shape schedules fewer pods than the host would (VERDICT r2 #2)
+        residual_pods = list(tpu_results.spread_residual_pods)
+        if residual_pods:
+            log.info(
+                "re-routing %d spread-residual pods to the host oracle",
+                len(residual_pods),
             )
+        if host_pods or residual_pods:
+            if host_pods:
+                log.debug(
+                    "solving %d kernel-unsupported pods on the host path "
+                    "(%d solved on tpu)", len(host_pods), len(tpu_pods),
+                )
             host_results = self._solve_host_remainder(
-                host_pods, state_nodes, tpu_results, results.new_nodes,
-                daemonset_pods,
+                host_pods + residual_pods, state_nodes, tpu_results,
+                results.new_nodes, daemonset_pods,
+                seed_topology=bool(residual_pods),
             )
             results.new_nodes.extend(host_results.new_nodes)
             results.failed_pods.extend(host_results.failed_pods)
@@ -467,7 +481,7 @@ class ProvisioningController:
 
     def _solve_host_remainder(
         self, host_pods: List[Pod], state_nodes, tpu_results, tpu_new_nodes,
-        daemonset_pods: List[Pod],
+        daemonset_pods: List[Pod], seed_topology: bool = False,
     ) -> SchedulingResults:
         """Host-oracle solve for the kernel-unsupported remainder, with the
         kernel's existing-node placements applied so capacity is not
@@ -475,7 +489,13 @@ class ProvisioningController:
         remainder (they are not launched yet); the remainder opens its own,
         but the kernel nodes' pessimistic capacity is charged against the
         provisioner limits first (subtractMax, scheduler.go:273-290) so the
-        two solves cannot jointly overspend a limit."""
+        two solves cannot jointly overspend a limit.
+
+        ``seed_topology`` records every kernel placement into the host
+        topology's shared counts first (topology.go:120-143 semantics), which
+        spread-residual pods need: unlike the encode-time split (isolated by
+        construction), residuals share groups with kernel-placed pods, so the
+        host's skew/affinity math must see where those pods landed."""
         from karpenter_core_tpu.solver.scheduler import _subtract_max
 
         adjusted = []
@@ -485,6 +505,18 @@ class ProvisioningController:
                 state_node = state_node.deep_copy()
                 for pod in placed:
                     state_node.update_for_pod(pod)
+                # a zone-less node the kernel committed (by placing pods under
+                # a zone restriction) must read as committed here too — else
+                # the two engines could pin the same node to different zones
+                committed = tpu_results.existing_committed_zones.get(
+                    state_node.node.name
+                )
+                if committed and labels_api.LABEL_TOPOLOGY_ZONE not in (
+                    state_node.node.metadata.labels
+                ):
+                    state_node.node.metadata.labels[
+                        labels_api.LABEL_TOPOLOGY_ZONE
+                    ] = committed
             adjusted.append(state_node)
         scheduler = build_scheduler(
             self.kube_client,
@@ -502,7 +534,54 @@ class ProvisioningController:
                     scheduler.remaining_resources[node.provisioner_name],
                     node.instance_type_options,
                 )
+        if seed_topology:
+            self._seed_topology_from_kernel(
+                scheduler.topology, tpu_results, tpu_new_nodes, adjusted
+            )
         return scheduler.solve(host_pods)
+
+    def _seed_topology_from_kernel(
+        self, topology, tpu_results, tpu_new_nodes, adjusted_state_nodes
+    ) -> None:
+        """Commit the kernel's placements into the host topology counts.
+
+        Existing-node placements record under the node's labels; new-node
+        placements under the launchable's requirements (zone already pinned by
+        decode) plus a synthetic unique hostname per pending node — hostname
+        groups then see each kernel node as a frozen-count domain, exactly how
+        an already-launched node would read.  Multi-zone nodes skip zone counts
+        (domains.len() != 1), matching the reference's record rule
+        (topology.go:129-136).  Kernel pods carrying anti-affinity terms also
+        register inverse counts so residual pods they repel are blocked
+        (topology.go:202-227)."""
+        def seed(pod: Pod, requirements: Requirements, domains: dict) -> None:
+            topology.record(pod, requirements)
+            if pod_util.has_pod_anti_affinity(pod):
+                topology._update_inverse_anti_affinity(pod, domains)
+
+        # adjusted nodes carry the kernel's zone stamps — seed from those
+        # labels, not the store's, so counts land in the committed zone
+        by_name = {n.node.name: n.node for n in adjusted_state_nodes}
+        for node_name, placed in tpu_results.existing_assignments.items():
+            node = by_name.get(node_name) or self.kube_client.get_node(node_name)
+            if node is None:
+                continue
+            requirements = Requirements.from_labels(node.metadata.labels)
+            for pod in placed:
+                seed(pod, requirements, node.metadata.labels)
+        for i, launchable in enumerate(tpu_new_nodes):
+            requirements = Requirements(*launchable.requirements.values())
+            hostname = f"tpu-pending-{i}"
+            requirements.add(
+                Requirement(labels_api.LABEL_HOSTNAME, OP_IN, [hostname])
+            )
+            domains = {labels_api.LABEL_HOSTNAME: hostname}
+            if requirements.has(labels_api.LABEL_TOPOLOGY_ZONE):
+                zones = requirements.get(labels_api.LABEL_TOPOLOGY_ZONE)
+                if zones.len() == 1:
+                    domains[labels_api.LABEL_TOPOLOGY_ZONE] = zones.values_list()[0]
+            for pod in launchable.pods:
+                seed(pod, requirements, domains)
 
     def get_daemonset_pods(self) -> List[Pod]:
         """Representative daemonset pods for overhead calculation.  The

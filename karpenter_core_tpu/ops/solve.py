@@ -126,6 +126,10 @@ class SolveOutputs(NamedTuple):
     failed: jnp.ndarray  # i32[C]
     state: NodeState
     ex_state: ExistingState
+    # bool[C]: the zone-spread water-fill could not prove host parity for this
+    # class (round bound hit with headroom left, or quota unrealized in-phase);
+    # failed pods of flagged classes re-route to the host oracle (VERDICT r2 #2)
+    spread_suspect: jnp.ndarray = None
 
 
 def _water_fill(count0: jnp.ndarray, allowed: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
@@ -814,7 +818,13 @@ def _class_step(
     counts_zs = topo.zone_fwd[g_zs]  # [Z]
     member_zs = member_row[g_zs]
     # per-zone intake for this class: existing nodes contribute their
-    # remaining intake; template zones open new nodes on demand (unbounded)
+    # remaining intake; template zones open new nodes on demand (unbounded).
+    # A multi-zone (unknown-zone) node's intake deliberately counts into EVERY
+    # zone of its mask: the estimate must be optimistic, because an over-grant
+    # surfaces as a phase shortfall (the spread_suspect sentinel below routes
+    # it to the host oracle), whereas pinning the intake to one zone would
+    # under-estimate the others and under-place with no detectable signal —
+    # the host can commit such a node to whichever zone the fill needs.
     ex_cap_z = jnp.sum(
         jnp.minimum(jnp.where(ok_ex, ex_prep.cap, 0), m)[:, None]
         * ex_prep.zone_full.astype(jnp.int32),
@@ -857,10 +867,31 @@ def _class_step(
         m_rem = m_rem - jnp.sum(q)
         sat = sat | (active & finite_cap & (quotas >= cap_pods_z))
     quotas = jnp.where(member_zs, quotas, 0)
+    # under-placement sentinel (host-oracle parity, topologygroup.go:155-182):
+    # the round bound can exhaust with quota still unallocated while some
+    # active zone retains both skew and capacity headroom — the shape ROADMAP
+    # gap 5 documented as silent.  Flag it; the shell re-routes the class's
+    # leftover pods through the host path instead of quietly failing them.
+    counts_end = counts_zs + quotas
+    min_frozen_end = jnp.min(jnp.where(unreachable | sat, counts_end, BIGI))
+    skew_headroom = (counts_end - min_frozen_end) < skew_zs
+    cap_headroom = (cap_pods_z - quotas) > 0
+    fill_residual = (m_rem > 0) & jnp.any(
+        allowed_zone & fillable & ~sat & skew_headroom & cap_headroom
+    )
+    placed_zs = jnp.int32(0)
     for z in range(n_zones):
         restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
         q = jnp.where(has_zs, quotas[z], 0)
-        accumulate(run_phase(state, ex, remaining, q, restrict))
+        results_z = run_phase(state, ex, remaining, q, restrict)
+        placed_zs = placed_zs + results_z[4]
+        accumulate(results_z)
+    # quota granted but not realized in-phase: the water-fill's per-zone
+    # intake estimate (ex_cap_z) is optimistic — e.g. a multi-zone node's
+    # capacity counts into every zone of its mask — so a phase can place
+    # fewer pods than its quota with no later round to redistribute them
+    quota_shortfall = placed_zs < jnp.sum(quotas)
+    spread_suspect = has_zs & member_zs & (fill_residual | quota_shortfall)
 
     # non-self-selecting zone spread: the pod never increments its own group's
     # counts, so the skew formula (count + 0 - min <= maxSkew,
@@ -959,7 +990,10 @@ def _class_step(
     )
 
     failed = m - placed_total
-    return (state, ex, topo, remaining), (assigned_total, assigned_ex_total, failed)
+    return (
+        (state, ex, topo, remaining),
+        (assigned_total, assigned_ex_total, failed, spread_suspect),
+    )
 
 
 def solve_core(
@@ -1049,13 +1083,15 @@ def solve_core(
     assign_ex = jnp.zeros((n_classes, n_ex), dtype=jnp.int32)
     count_left = class_tensors.count
     failed = count_left
+    suspect = jnp.zeros(n_classes, dtype=bool)
     for p in range(max(n_passes, 1)):
         cls_pass = class_tensors._replace(count=count_left)
-        carry, (a, a_ex, failed) = jax.lax.scan(
+        carry, (a, a_ex, failed, suspect_p) = jax.lax.scan(
             step, carry, (cls_pass, cls_indices)
         )
         assign = assign + a
         assign_ex = assign_ex + a_ex
+        suspect = suspect | suspect_p
         # roll failed counts one step down the preference ladder (the host
         # path's fail -> Preferences.Relax -> re-push round); classes with no
         # successor retry as themselves (late-affinity re-scan)
@@ -1091,6 +1127,7 @@ def solve_core(
         failed=failed,
         state=final_state,
         ex_state=final_ex,
+        spread_suspect=suspect,
     )
 
 
