@@ -757,6 +757,12 @@ class MultiNodeConsolidation(_ConsolidationBase):
         except KernelUnsupported as e:
             log.debug("TPU consolidation unsupported for cluster shape, %s", e)
             return None
+        except Exception as e:  # backend init/relay faults: host binary search
+            log.warning(
+                "TPU consolidation sweep failed (%s: %s); falling back to the "
+                "host binary search", type(e).__name__, e,
+            )
+            return None
 
     def first_n_consolidation_option(
         self, candidates: List[CandidateNode], max_parallel: int

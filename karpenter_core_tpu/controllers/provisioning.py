@@ -52,6 +52,16 @@ SCHEDULING_DURATION = REGISTRY.histogram(
 NODES_CREATED = REGISTRY.counter(
     "karpenter_nodes_created", "Number of nodes created in total by Karpenter.", ("reason",)
 )
+TPU_KERNEL_FALLBACK = REGISTRY.counter(
+    "karpenter_tpu_kernel_fallback",
+    "Batches that fell back from the TPU kernel to the host scheduler.",
+    ("reason",),
+)
+
+# consecutive unexpected kernel failures (backend init/relay faults, not
+# KernelUnsupported routing) before the controller stops trying the device
+# path for the rest of the process lifetime
+TPU_KERNEL_MAX_FAILURES = 2
 
 
 class Batcher:
@@ -201,6 +211,7 @@ class ProvisioningController:
         self.volume_topology = VolumeTopology(kube_client)
         self.use_tpu_kernel = use_tpu_kernel
         self.tpu_kernel_min_pods = tpu_kernel_min_pods
+        self._tpu_failures = 0
         from karpenter_core_tpu.utils.pretty import ChangeMonitor
 
         self._change_monitor = ChangeMonitor(ttl_seconds=3600.0)
@@ -293,7 +304,28 @@ class ProvisioningController:
                 if err is not None:
                     return None, err
             if self.use_tpu_kernel and len(pods) >= self.tpu_kernel_min_pods:
-                results = self._schedule_tpu(pods, state_nodes)
+                try:
+                    results = self._schedule_tpu(pods, state_nodes)
+                except NoProvisionersError:
+                    raise
+                except Exception as e:  # backend init/relay faults, not routing
+                    self._tpu_failures += 1
+                    TPU_KERNEL_FALLBACK.labels("backend-error").inc()
+                    log.warning(
+                        "TPU kernel solve failed (%s: %s); falling back to the "
+                        "host scheduler (%d/%d consecutive failures)",
+                        type(e).__name__, e, self._tpu_failures,
+                        TPU_KERNEL_MAX_FAILURES,
+                    )
+                    if self._tpu_failures >= TPU_KERNEL_MAX_FAILURES:
+                        log.warning(
+                            "disabling the TPU kernel path for this process "
+                            "after %d consecutive failures", self._tpu_failures,
+                        )
+                        self.use_tpu_kernel = False
+                    results = None
+                else:
+                    self._tpu_failures = 0
                 if results is not None:
                     return results, None
             scheduler = build_scheduler(

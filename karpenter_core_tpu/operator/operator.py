@@ -54,7 +54,12 @@ class Operator:
     clock: Clock = field(default_factory=Clock)
     kube_client: Optional[KubeClient] = None
     recorder: Optional[Recorder] = None
-    use_tpu_kernel: bool = False
+    # TPU-first by default: large batches route through the device kernel
+    # (host oracle handles small/exotic shapes, and the provisioning
+    # controller self-disables the device path after repeated backend
+    # failures — see TPU_KERNEL_MAX_FAILURES), so the library facade matches
+    # the binary (cmd/operator.py KC_TPU_KERNEL default)
+    use_tpu_kernel: bool = True
     # serve /metrics (+ /debug/pprof with --enable-profiling) and health
     # probes over HTTP; off by default so embedded/test operators don't bind
     serve_http: bool = False
