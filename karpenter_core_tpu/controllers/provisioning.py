@@ -212,12 +212,54 @@ class ProvisioningController:
         self.use_tpu_kernel = use_tpu_kernel
         self.tpu_kernel_min_pods = tpu_kernel_min_pods
         self._tpu_failures = 0
+        self._warmup_started = False
         from karpenter_core_tpu.utils.pretty import ChangeMonitor
 
         self._change_monitor = ChangeMonitor(ttl_seconds=3600.0)
 
     def trigger(self) -> None:
         self.batcher.trigger()
+        self._maybe_start_warmup()
+
+    def _maybe_start_warmup(self) -> None:
+        """First trigger kicks a background speculative compile of the solve
+        executable for the standard shape buckets (TPUSolver.warmup), so the
+        first real batch's compile overlaps the batch window instead of
+        following it (VERDICT r2 #3).  Once per process; kernel path only;
+        KC_TPU_WARMUP=0 opts out (tests do — they meter compiles)."""
+        if self._warmup_started or not self.use_tpu_kernel:
+            return
+        import os
+
+        if os.environ.get("KC_TPU_WARMUP", "1") == "0":
+            self._warmup_started = True
+            return
+        if not self.kube_client.list_provisioners():
+            return  # nothing to compile against yet; retry on a later trigger
+        self._warmup_started = True
+
+        def run() -> None:
+            try:
+                from karpenter_core_tpu.solver.tpu import TPUSolver
+
+                provisioners = self.kube_client.list_provisioners()
+                if not provisioners:
+                    return
+                solver = TPUSolver(
+                    self.cloud_provider, provisioners,
+                    daemonset_pods=self.get_daemonset_pods(),
+                    kube_client=self.kube_client,
+                )
+                pending = max(len(self.get_pending_pods()), self.tpu_kernel_min_pods)
+                solver.warmup(
+                    n_pods=pending,
+                    state_nodes=[n for n in self.cluster.snapshot_nodes() if not n.marked()],
+                    bound_pods=self.kube_client.list_pods(),
+                )
+            except Exception as e:  # noqa: BLE001 - warmup is best-effort
+                log.debug("speculative kernel warmup failed: %s", e)
+
+        threading.Thread(target=run, name="kc-tpu-warmup", daemon=True).start()
 
     # -- reconcile ------------------------------------------------------------
 
@@ -326,6 +368,10 @@ class ProvisioningController:
                     results = None
                 else:
                     self._tpu_failures = 0
+                    if results is None:
+                        # shape routing (unsupported/entangled/under-min): the
+                        # batch runs on the host path by design, not by fault
+                        TPU_KERNEL_FALLBACK.labels("unsupported").inc()
                 if results is not None:
                     return results, None
             scheduler = build_scheduler(

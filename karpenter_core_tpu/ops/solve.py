@@ -1300,7 +1300,11 @@ def estimate_slots(snapshot: EncodedSnapshot) -> int:
     """Optimistic node-count estimate: per class, best pods-per-node over the
     catalog, plus slack for zone phases; rounded up to a power of two for
     compile-cache friendliness."""
-    total = 16
+    # zone-phase slack scales with the PADDED class count (the bucket the
+    # executable is compiled for), not the actual one — otherwise a one-class
+    # wobble in the pod mix moves the total across a power-of-two boundary
+    # and recompiles an otherwise-identical program (VERDICT r2 #3)
+    total = 16 + bucket(len(snapshot.classes)) * snapshot.cls_zone.shape[1]
     alloc = snapshot.it_alloc  # [I, R]
     for c, cls in enumerate(snapshot.classes):
         size = snapshot.cls_requests[c]  # [R]
@@ -1314,8 +1318,13 @@ def estimate_slots(snapshot: EncodedSnapshot) -> int:
         if cls.host_anti is not None:
             host_cap = 1.0
         best = max(1.0, min(best, host_cap))
-        total += int(np.ceil(float(snapshot.cls_count[c]) / best)) + snapshot.cls_zone.shape[1]
-    return int(2 ** np.ceil(np.log2(max(total, 16))))
+        total += int(np.ceil(float(snapshot.cls_count[c]) / best))
+    estimate = int(2 ** np.ceil(np.log2(max(total, 16))))
+    # hysteresis at the shared derivation point so every caller (provisioning
+    # solve, consolidation sweep, mesh studies) reuses covering executables
+    from karpenter_core_tpu.utils import compilecache
+
+    return compilecache.snap_slots(estimate)
 
 # -- shape-bucket padding -----------------------------------------------------
 #
@@ -1447,7 +1456,9 @@ def pad_planes(cls, statics_arrays, key_has_bounds, ex_state=None, ex_static=Non
     if ex_state is not None:
         e_old = ex_state.pod_count.shape[0]
         d_old = ex_state.vol_used.shape[-1]
-        e_new = bucket(e_old, floor=4)
+        # floor 8: node churn below eight existing nodes must not change the
+        # plane shape (the bucket grid's 4->6->8 steps are too fine there)
+        e_new = bucket(e_old, floor=8)
         d_new = bucket(d_old, floor=2)
         ex_req = _pad_req(
             mask_ops.ReqTensor(

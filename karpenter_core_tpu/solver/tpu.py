@@ -616,6 +616,71 @@ class TPUSolver:
         snapshot = self.encode(pods, state_nodes, bound_pods)
         return self.solve_encoded(snapshot, state_nodes, bound_pods, n_slots)
 
+    def warmup(
+        self,
+        n_pods: int = 4096,
+        state_nodes: Optional[list] = None,
+        bound_pods: Optional[List[Pod]] = None,
+    ) -> bool:
+        """Speculatively build the solve executable for the standard shape
+        buckets before the first real batch needs it (the compile hides under
+        the batcher's 10 s max window, settings.go:39-40 parity).
+
+        The synthetic mix covers the common class shapes — several request
+        sizes, a zonal spread, a hostname spread — against the REAL catalog
+        and templates, so the padded buckets (ops/solve.pad_planes) this
+        compiles are the ones steady-state batches land in.  Runs end to end
+        (encode → compile → tiny device solve).  Purely an optimization: any
+        failure returns False and the first real solve compiles as before.
+        """
+        from karpenter_core_tpu.apis import labels as labels_api
+        from karpenter_core_tpu.apis.objects import (
+            Container,
+            LabelSelector,
+            ObjectMeta,
+            PodSpec,
+            ResourceRequirements,
+            TopologySpreadConstraint,
+        )
+
+        def pod(requests, labels=None, spread_key=None):
+            spec = PodSpec(
+                containers=[Container(resources=ResourceRequirements(requests=dict(requests)))]
+            )
+            if spread_key is not None:
+                spec.topology_spread_constraints = [
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=spread_key,
+                        label_selector=LabelSelector(match_labels=dict(labels)),
+                    )
+                ]
+            return Pod(
+                metadata=ObjectMeta(name="warmup", labels=dict(labels or {})),
+                spec=spec,
+            )
+
+        protos = [
+            pod({"cpu": 0.5, "memory": 512 * 2**20}),
+            pod({"cpu": 1.0, "memory": 2 * 2**30}),
+            pod({"cpu": 0.25, "memory": 256 * 2**20}, {"app": "warm-zspread"},
+                labels_api.LABEL_TOPOLOGY_ZONE),
+            pod({"cpu": 0.25, "memory": 256 * 2**20}, {"app": "warm-hspread"},
+                labels_api.LABEL_HOSTNAME),
+        ]
+        per = max(n_pods // len(protos), 1)
+        pods: List[Pod] = []
+        for proto in protos:
+            pods.extend([proto] * per)  # shared objects: shapes, not identity
+        try:
+            self.solve(pods, state_nodes, bound_pods)
+            return True
+        except Exception as e:  # noqa: BLE001 - warmup must never surface
+            import logging
+
+            logging.getLogger(__name__).debug("kernel warmup failed: %s", e)
+            return False
+
     def solve_encoded(
         self,
         snapshot: EncodedSnapshot,
@@ -626,9 +691,10 @@ class TPUSolver:
         ex_state = ex_static = None
         if state_nodes:
             ex_state, ex_static = self.encode_existing(snapshot, state_nodes, bound_pods)
-        if n_slots <= 0:
-            n_slots = solve_ops.estimate_slots(snapshot)
         from karpenter_core_tpu.utils import compilecache
+
+        if n_slots <= 0:
+            n_slots = solve_ops.estimate_slots(snapshot)  # snap_slots applied inside
 
         cls, statics_arrays, key_has_bounds = solve_ops.prepare_host(snapshot)
         outputs = compilecache.run_solve(

@@ -37,6 +37,52 @@ _lock = threading.Lock()
 _enabled = False
 _registered = False
 _memo: Dict[tuple, object] = {}
+_in_flight: Dict[tuple, threading.Event] = {}
+# in-process executable reuse accounting: "builds" counts solve_callable
+# misses that had to lower+compile (even if the disk caches made it cheap),
+# "memo_hits" counts solves served by an already-built executable.  The
+# steady-state contract (tests/test_compile_reuse.py) is builds==constant
+# across varied reconcile batches within the same shape buckets.
+_stats = {"builds": 0, "memo_hits": 0}
+
+
+_slots_seen: set = set()
+
+
+def snap_slots(estimate: int, max_waste: int = 4) -> int:
+    """Stabilize the solve's static slot count across nearby batches.
+
+    n_slots is a compile-time constant; a batch whose estimate lands just past
+    a power-of-two boundary would recompile even though an already-built
+    executable has room.  Reuse the smallest previously-used slot count that
+    covers the estimate within ``max_waste``x (slots cost solve compute, so
+    unbounded reuse would trade a compile for a permanently slower solve)."""
+    with _lock:
+        covering = [s for s in _slots_seen if estimate <= s <= max_waste * estimate]
+        if covering:
+            return min(covering)
+        _slots_seen.add(estimate)
+        return estimate
+
+
+def stats() -> Dict[str, int]:
+    with _lock:
+        return dict(_stats)
+
+
+def reset_stats() -> None:
+    with _lock:
+        _stats.update(builds=0, memo_hits=0)
+
+
+def reset_memo() -> None:
+    """Simulate a process restart for tests: clear the executable memo AND the
+    slot-count hysteresis — a stale _slots_seen entry with no backing
+    executable would snap later solves to a permanently oversized shape."""
+    with _lock:
+        _memo.clear()
+        _slots_seen.clear()
+        _stats.update(builds=0, memo_hits=0)
 
 
 def cache_dir() -> str:
@@ -133,8 +179,6 @@ def solve_callable(
 
     try:
         enable()
-        from karpenter_core_tpu.ops import solve as solve_ops
-
         has_ex = ex_state is not None
         key = (
             _kernel_src_hash(),
@@ -148,55 +192,84 @@ def solve_callable(
             _leaf_sig(ex_state) if has_ex else None,
             _leaf_sig(ex_static) if has_ex else None,
         )
-        with _lock:
-            fn = _memo.get(key)
-        if fn is not None:
-            return fn
+        # in-flight dedup: the warmup thread and the first real batch race to
+        # build the same key; the loser waits on the winner's build instead of
+        # lowering+compiling the identical program twice
+        while True:
+            with _lock:
+                fn = _memo.get(key)
+                if fn is not None:
+                    _stats["memo_hits"] += 1
+                    return fn
+                building = _in_flight.get(key)
+                if building is None:
+                    building = _in_flight[key] = threading.Event()
+                    break  # this thread builds
+            building.wait(timeout=600.0)
 
-        digest = hashlib.sha256(repr(key).encode()).hexdigest()[:24]
-        path = os.path.join(cache_dir(), f"solve-{digest}.stablehlo")
-        structs = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-            (cls, statics_arrays, ex_state, ex_static) if has_ex
-            else (cls, statics_arrays),
-        )
-        fn = None
-        if os.path.exists(path):
-            try:
-                with open(path, "rb") as f:
-                    exported = jax.export.deserialize(f.read())
-                fn = jax.jit(exported.call)
-            except Exception as e:  # noqa: BLE001 - stale/corrupt entry
-                log.warning("export cache load failed (%s), re-exporting", e)
-                fn = None
-        if fn is None:
-            if has_ex:
-                base = jax.jit(
-                    lambda c, s, exs, exst: solve_ops.solve_core(
-                        c, s, n_slots, key_has_bounds, exs, exst, n_passes=n_passes
-                    )
-                )
-            else:
-                base = jax.jit(
-                    lambda c, s: solve_ops.solve_core(
-                        c, s, n_slots, key_has_bounds, n_passes=n_passes
-                    )
-                )
-            exported = jax.export.export(base)(*structs)
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
-                f.write(exported.serialize())
-            os.replace(tmp, path)
-            fn = jax.jit(exported.call)
-        # AOT-compile from shape structs so no device data is needed — callers
-        # overlap the (slow, relay-bound) input upload with this compile
-        compiled = fn.lower(*structs).compile()
-        with _lock:
-            _memo[key] = compiled
-        return compiled
+        try:
+            return _build_and_memo(key, cls, statics_arrays, n_slots,
+                                   key_has_bounds, ex_state, ex_static, n_passes)
+        finally:
+            with _lock:
+                _in_flight.pop(key, None)
+            building.set()
     except Exception as e:  # noqa: BLE001 - never break the solve path
         log.warning("export cache unavailable (%s), using plain jit", e)
         return None
+
+
+def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
+                    ex_state, ex_static, n_passes):
+    """Build one executable for ``key``: export-cache load (or trace+export),
+    then AOT compile, then memoize.  Callers hold the key's in-flight slot."""
+    import jax
+
+    from karpenter_core_tpu.ops import solve as solve_ops
+
+    has_ex = ex_state is not None
+    digest = hashlib.sha256(repr(key).encode()).hexdigest()[:24]
+    path = os.path.join(cache_dir(), f"solve-{digest}.stablehlo")
+    structs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+        (cls, statics_arrays, ex_state, ex_static) if has_ex
+        else (cls, statics_arrays),
+    )
+    fn = None
+    if os.path.exists(path):
+        try:
+            with open(path, "rb") as f:
+                exported = jax.export.deserialize(f.read())
+            fn = jax.jit(exported.call)
+        except Exception as e:  # noqa: BLE001 - stale/corrupt entry
+            log.warning("export cache load failed (%s), re-exporting", e)
+            fn = None
+    if fn is None:
+        if has_ex:
+            base = jax.jit(
+                lambda c, s, exs, exst: solve_ops.solve_core(
+                    c, s, n_slots, key_has_bounds, exs, exst, n_passes=n_passes
+                )
+            )
+        else:
+            base = jax.jit(
+                lambda c, s: solve_ops.solve_core(
+                    c, s, n_slots, key_has_bounds, n_passes=n_passes
+                )
+            )
+        exported = jax.export.export(base)(*structs)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(exported.serialize())
+        os.replace(tmp, path)
+        fn = jax.jit(exported.call)
+    # AOT-compile from shape structs so no device data is needed — callers
+    # overlap the (slow, relay-bound) input upload with this compile
+    compiled = fn.lower(*structs).compile()
+    with _lock:
+        _memo[key] = compiled
+        _stats["builds"] += 1
+    return compiled
 
 
 def run_solve(

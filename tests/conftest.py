@@ -9,6 +9,10 @@ the override must go through jax.config before the backend initializes.
 
 import os
 
+# no speculative background compiles in tests: suites meter compile counts
+# (test_compile_reuse) and a stray warmup thread would race the meters
+os.environ.setdefault("KC_TPU_WARMUP", "0")
+
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()

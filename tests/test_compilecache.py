@@ -14,15 +14,17 @@ from karpenter_core_tpu.solver.tpu import TPUSolver
 from karpenter_core_tpu.testing import make_pods, make_provisioner
 from karpenter_core_tpu.utils import compilecache
 
+# exercises the export/XLA caches by compiling -- the slow tier (`make test-all`)
+pytestmark = pytest.mark.compile
 
 @pytest.fixture()
 def cache_dir(tmp_path, monkeypatch):
     monkeypatch.setenv("KC_TPU_COMPILE_CACHE", str(tmp_path))
-    # reset module state so the fixture dir is picked up
-    compilecache._memo.clear()
+    # reset module state (memo + slot hysteresis) so the fixture dir is
+    # picked up and no stale slot count outlives its executable
+    compilecache.reset_memo()
     yield tmp_path
-    compilecache._memo.clear()
-
+    compilecache.reset_memo()
 
 def _inputs():
     provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(10))
@@ -32,7 +34,6 @@ def _inputs():
     snap = solver.encode(ingest)
     host_cls, host_statics, khb = solve_ops.prepare_host(snap)
     return snap, host_cls, host_statics, khb
-
 
 class TestExportCache:
     def test_roundtrip_matches_plain_jit(self, cache_dir):
@@ -58,7 +59,7 @@ class TestExportCache:
         compilecache.solve_callable(cls, statics, n_slots, khb)
         before = {f: os.path.getmtime(os.path.join(cache_dir, f))
                   for f in os.listdir(cache_dir) if f.endswith(".stablehlo")}
-        compilecache._memo.clear()  # simulate a process restart
+        compilecache.reset_memo()  # simulate a process restart
         fn = compilecache.solve_callable(cls, statics, n_slots, khb)
         assert fn is not None
         after = {f: os.path.getmtime(os.path.join(cache_dir, f))
@@ -99,7 +100,6 @@ class TestExportCache:
         assert sum(len(n.pods) for n in res.new_nodes) == 8
         entries = [f for f in os.listdir(cache_dir) if f.endswith(".stablehlo")]
         assert entries, "TPUSolver.solve must populate the export cache"
-
 
 class TestShapeBuckets:
     """ops/solve.pad_planes: nearby problem sizes share one executable and

@@ -12,7 +12,7 @@ import pytest
 from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
 from karpenter_core_tpu.controllers import provisioning as prov_mod
 from karpenter_core_tpu.operator.operator import Operator
-from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
+from karpenter_core_tpu.testing import make_pods, make_provisioner
 from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
 
 
@@ -72,6 +72,7 @@ class TestGracefulFallback:
         assert _ExplodingSolver.calls == prov_mod.TPU_KERNEL_MAX_FAILURES
         assert env.provisioning.use_tpu_kernel is False
 
+    @pytest.mark.compile  # the restored real solver compiles -- slow tier
     def test_success_resets_failure_counter(self, env, monkeypatch):
         import karpenter_core_tpu.solver.tpu as tpu_mod
 
