@@ -11,6 +11,8 @@ the constraints (node.go:143-159 — the launch path picks the cheapest of the
 surviving options).
 """
 
+import pytest
+
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import (
     OP_IN,
@@ -20,13 +22,15 @@ from karpenter_core_tpu.cloudprovider import fake as fake_cp
 from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
 from tests.test_tpu_solver import compare
 
+# compare() parity runs the kernel per case -- the slow tier (`make test-all`)
+pytestmark = pytest.mark.compile
+
 ZONE = labels_api.LABEL_TOPOLOGY_ZONE
 CT = labels_api.LABEL_CAPACITY_TYPE
 ARCH = labels_api.LABEL_ARCH_STABLE
 OS = labels_api.LABEL_OS_STABLE
 
 CATALOG = fake_cp.instance_types_assorted()
-
 
 def cheapest_price(requirements=None, zones=None, cts=None):
     """Min offering price over catalog entries compatible with constraints."""
@@ -51,9 +55,7 @@ def cheapest_price(requirements=None, zones=None, cts=None):
             best = min(best, off.price)
     return best
 
-
 _BY_NAME = {it.name: it for it in CATALOG}
-
 
 def node_min_price(node, zones=None, cts=None):
     """Min offering price across a node decision's surviving options — works
@@ -81,7 +83,6 @@ def node_min_price(node, zones=None, cts=None):
             best = min(best, off.price)
     return best
 
-
 def assert_cheapest(result, requirements=None, zones=None, cts=None):
     assert not result.failed_pods
     floor = cheapest_price(requirements, zones, cts)
@@ -90,7 +91,6 @@ def assert_cheapest(result, requirements=None, zones=None, cts=None):
             f"node can launch at {node_min_price(node, zones, cts)}, "
             f"catalog floor is {floor}"
         )
-
 
 def node_instance_types(node, catalog=None):
     """Instance-type objects for either node flavor."""
@@ -101,11 +101,9 @@ def node_instance_types(node, catalog=None):
     )
     return [by_name[name] for name in node.instance_type_names if name in by_name]
 
-
 def tiny(n=1, **kwargs):
     kwargs.setdefault("requests", {"cpu": "10m"})
     return make_pods(n, **kwargs)
-
 
 class TestCheapestSelection:
     """instance_selection_test.go:72-397 — every constraint combination must
@@ -240,7 +238,6 @@ class TestCheapestSelection:
             zones=["test-zone-1"],
         )
 
-
 class TestNoMatch:
     """instance_selection_test.go:398-475 — unsatisfiable selectors fail."""
 
@@ -276,7 +273,6 @@ class TestNoMatch:
         )
         assert len(tpu.failed_pods) == 1
 
-
 class TestResourceFit:
     """instance_selection_test.go:476-527 — pick an instance with room."""
 
@@ -309,7 +305,6 @@ class TestResourceFit:
             instance_types=CATALOG,
         )
         assert len(tpu.new_nodes) < 10
-
 
 class TestOfferingExhaustion:
     """instance_selection_test.go:528+ — availability drives selection."""

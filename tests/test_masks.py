@@ -25,6 +25,9 @@ from karpenter_core_tpu.models.vocab import Vocabulary
 from karpenter_core_tpu.ops import masks as mask_ops
 from karpenter_core_tpu.scheduling import Requirement, Requirements
 
+# requirement-algebra jits compile per dtype/shape -- the slow tier (`make test-all`)
+pytestmark = pytest.mark.compile
+
 KEYS = [
     labels_api.LABEL_ARCH_STABLE,  # well-known
     labels_api.LABEL_OS_STABLE,  # well-known
@@ -38,7 +41,6 @@ VALUES = {
     "integer": ["1", "2", "4", "8", "16"],
 }
 
-
 def random_requirement(rng: random.Random, key: str) -> Requirement:
     op = rng.choice([OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST, OP_GT, OP_LT])
     if op in (OP_GT, OP_LT):
@@ -51,12 +53,10 @@ def random_requirement(rng: random.Random, key: str) -> Requirement:
         return Requirement(key, op, rng.sample(VALUES[key], k))
     return Requirement(key, op)
 
-
 def random_requirements(rng: random.Random) -> Requirements:
     n = rng.randint(0, len(KEYS))
     keys = rng.sample(KEYS, n)
     return Requirements(*(random_requirement(rng, k) for k in keys))
-
 
 @pytest.fixture(scope="module")
 def vocab():
@@ -66,7 +66,6 @@ def vocab():
     ]
     return Vocabulary.build(base)
 
-
 def encode(vocab, reqs):
     mask, defined, negative, gt, lt = vocab.encode_requirements(reqs)
     return mask_ops.ReqTensor(
@@ -74,18 +73,14 @@ def encode(vocab, reqs):
         jnp.asarray(gt), jnp.asarray(lt),
     )
 
-
 N_TRIALS = 500
-
 
 def _encode_np(vocab, reqs):
     return vocab.encode_requirements(reqs)
 
-
 def _stack(vocab, reqs_list):
     planes = [vocab.encode_requirements(r) for r in reqs_list]
     return mask_ops.ReqTensor(*(jnp.asarray(np.stack(p)) for p in zip(*planes)))
-
 
 def test_intersects_parity(vocab):
     rng = random.Random(42)
@@ -98,7 +93,6 @@ def test_intersects_parity(vocab):
         oracle = a.intersects(b) is None
         assert bool(got[i]) == oracle, f"trial {i}: {a!r} vs {b!r}: oracle={oracle}"
 
-
 def test_compatible_parity(vocab):
     rng = random.Random(43)
     is_custom = jnp.asarray(vocab.is_custom())
@@ -110,7 +104,6 @@ def test_compatible_parity(vocab):
     for i, (a, b) in enumerate(pairs):
         oracle = a.compatible(b) is None
         assert bool(got[i]) == oracle, f"trial {i}: {a!r} vs {b!r}: oracle={oracle}"
-
 
 def test_add_then_check_parity(vocab):
     """Sequential accumulation (node requirements absorbing pods) stays exact.
@@ -147,7 +140,6 @@ def test_add_then_check_parity(vocab):
             if oracle[i]:
                 nodes[i].add(*pods[i].values())
 
-
 def test_single_value(vocab):
     valid = jnp.asarray(vocab.valid_mask())
     r = encode(vocab, Requirements(Requirement("example.com/team", OP_IN, ["a"])))
@@ -159,7 +151,6 @@ def test_single_value(vocab):
     r3 = encode(vocab, Requirements(Requirement("example.com/team", OP_NOT_IN, ["a", "b", "c"])))
     # complement allows unseen values -> not single
     assert not bool(mask_ops.single_value(r3)[k])
-
 
 def test_batched_broadcasting(vocab):
     """Mask ops broadcast over leading axes (the kernel's [N] and [C] dims)."""

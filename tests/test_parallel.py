@@ -1,5 +1,7 @@
 """Mesh-sharding tests on the virtual 8-device CPU platform."""
 
+import pytest
+
 import jax
 import numpy as np
 
@@ -8,13 +10,14 @@ from karpenter_core_tpu.parallel import mesh as mesh_ops
 from karpenter_core_tpu.solver.tpu import TPUSolver
 from karpenter_core_tpu.testing import make_pods, make_provisioner
 
+# the virtual-mesh sharding suite traces + compiles study grids -- the slow tier (`make test-all`)
+pytestmark = pytest.mark.compile
 
 def build(n_pods=24, n_types=6):
     provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(n_types))
     solver = TPUSolver(provider, [make_provisioner()])
     pods = make_pods(n_pods, requests={"cpu": "500m"})
     return solver, pods
-
 
 class TestMonteCarloMesh:
     def test_replicas_shard_across_devices(self):
@@ -61,7 +64,6 @@ class TestMonteCarloMesh:
         import __graft_entry__ as graft
 
         graft._dryrun_multichip_subprocess(2)
-
 
 class TestCrossedStudy:
     """2D (replica x lane) mesh: Monte-Carlo scenarios x consolidation

@@ -10,6 +10,8 @@ preferred pod (anti)affinity and ALL spreads act as hard while on the spec
 requirements (requirements.go:61-78).
 """
 
+import pytest
+
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import (
     OP_IN,
@@ -29,8 +31,10 @@ from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
 
 from tests.test_tpu_solver import ZONE, compare, tpu_solve
 
-HOSTNAME = labels_api.LABEL_HOSTNAME
+# ladder solves compile multi-pass programs -- the slow tier (`make test-all`)
+pytestmark = pytest.mark.compile
 
+HOSTNAME = labels_api.LABEL_HOSTNAME
 
 def anyway_spread(app, key=ZONE, max_skew=1):
     return TopologySpreadConstraint(
@@ -40,7 +44,6 @@ def anyway_spread(app, key=ZONE, max_skew=1):
         label_selector=LabelSelector(match_labels={"app": app}),
     )
 
-
 def preferred_anti(app, key=HOSTNAME, weight=1):
     return WeightedPodAffinityTerm(
         weight=weight,
@@ -49,7 +52,6 @@ def preferred_anti(app, key=HOSTNAME, weight=1):
             label_selector=LabelSelector(match_labels={"app": app}),
         ),
     )
-
 
 class TestLadderConstruction:
     def test_plain_pod_single_variant(self):
@@ -123,7 +125,6 @@ class TestLadderConstruction:
         assert [c.is_ladder_variant for c in classes] == [False, False, True]
         assert classes[1].relax_to is classes[2]
         assert classes[1].count == 3 and not classes[2].pods == classes[1].pods
-
 
 class TestLadderSolves:
     def test_impossible_preferred_node_affinity_relaxes(self):
@@ -230,7 +231,6 @@ class TestLadderSolves:
         uids += [p.uid for p in results.failed_pods]
         assert len(uids) == len(set(uids)), "a pod was placed twice"
 
-
 class TestLadderConsolidation:
     def test_soft_constraint_pods_do_not_block_consolidation(self):
         """Ladder variant rows carry representative copies, not real pods —
@@ -275,7 +275,6 @@ class TestLadderConsolidation:
         )
         assert cmd.action == Action.DELETE
         assert len(cmd.nodes_to_remove) == 2
-
 
 class TestPreferNoScheduleRung:
     def test_prefer_no_schedule_taint_tolerated_after_relaxation(self):

@@ -11,15 +11,12 @@ import datetime
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import (
     OP_IN,
-    NodeSelector,
     NodeSelectorRequirement,
     NodeSelectorTerm,
     ObjectMeta,
-    PersistentVolume,
     PersistentVolumeClaim,
     PersistentVolumeClaimSpec,
     PersistentVolumeClaimVolumeSource,
-    PersistentVolumeSpec,
     StorageClass,
     Taint,
     Toleration,
@@ -29,7 +26,6 @@ from karpenter_core_tpu.cloudprovider import fake as fake_cp
 from karpenter_core_tpu.testing import (
     make_daemonset_pod,
     make_pod,
-    make_pods,
     make_provisioner,
 )
 from karpenter_core_tpu.testing.harness import (

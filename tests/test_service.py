@@ -324,6 +324,7 @@ class TestSettingsStore:
         assert store.batch_max_duration == 10.0
 
 
+@pytest.mark.compile  # the device sweep compiles -- slow tier (`make test-all`)
 class TestTPUConsolidationInController:
     def test_controller_uses_tpu_sweep(self):
         from tests.test_tpu_consolidation import build_cluster

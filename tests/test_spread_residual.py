@@ -11,6 +11,8 @@ schedules fewer pods than the host path without an explicit route or event
 (topologygroup.go:155-182 is the semantics both engines must meet).
 """
 
+import pytest
+
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import (
     OP_IN,
@@ -29,8 +31,10 @@ from karpenter_core_tpu.state.informer import start_informers
 from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
 from karpenter_core_tpu.utils.clock import FakeClock
 
-ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+# residual re-route cases run kernel solves -- the slow tier (`make test-all`)
+pytestmark = pytest.mark.compile
 
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
 
 def spread_pod(app: str = "residual", cpu: str = "500m"):
     return make_pod(
@@ -45,7 +49,6 @@ def spread_pod(app: str = "residual", cpu: str = "500m"):
         ],
     )
 
-
 def build_env(use_tpu_kernel: bool):
     clock = FakeClock()
     kube = KubeClient(clock)
@@ -59,7 +62,6 @@ def build_env(use_tpu_kernel: bool):
         use_tpu_kernel=use_tpu_kernel, tpu_kernel_min_pods=1,
     )
     return kube, provider, cluster, recorder, controller
-
 
 def zoneless_node(name: str, cpu: float, provisioner: str = "default"):
     """An owned, initialized node with NO zone label: its zone mask is
@@ -78,7 +80,6 @@ def zoneless_node(name: str, cpu: float, provisioner: str = "default"):
         allocatable={"cpu": cpu, "memory": "16Gi", "pods": 110},
     )
 
-
 def zone1_provisioner():
     """Templates serve only test-zone-1: the other zones are template-less,
     so their only intake is existing-node capacity."""
@@ -86,7 +87,6 @@ def zone1_provisioner():
         name="default",
         requirements=[NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1"])],
     )
-
 
 class TestDecodeResidualSplit:
     def test_unknown_zone_shortfall_flags_residual(self):
@@ -146,7 +146,6 @@ class TestDecodeResidualSplit:
         if results.existing_assignments.get("fuzzy"):
             committed = results.existing_committed_zones.get("fuzzy")
             assert committed in ("test-zone-1", "test-zone-2", "test-zone-3")
-
 
 class TestEndToEndParity:
     def scheduled_count(self, use_tpu_kernel: bool, n_pods: int = 12):

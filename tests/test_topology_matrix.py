@@ -25,11 +25,13 @@ from karpenter_core_tpu.models.snapshot import KernelUnsupported, classify_pods
 from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
 from tests.test_tpu_solver import compare, host_solve, tpu_solve
 
+# compare() parity runs the kernel per case -- the slow tier (`make test-all`)
+pytestmark = pytest.mark.compile
+
 ZONE = labels_api.LABEL_TOPOLOGY_ZONE
 HOSTNAME = labels_api.LABEL_HOSTNAME
 CT = labels_api.LABEL_CAPACITY_TYPE
 ARCH = labels_api.LABEL_ARCH_STABLE
-
 
 def spread(key=ZONE, skew=1, labels=None, when="DoNotSchedule", expressions=None):
     selector = LabelSelector(
@@ -40,14 +42,12 @@ def spread(key=ZONE, skew=1, labels=None, when="DoNotSchedule", expressions=None
         max_skew=skew, topology_key=key, when_unsatisfiable=when, label_selector=selector
     )
 
-
 def zone_counts(result):
     counts = {}
     for node in result.new_nodes:
         assert len(node.zones) == 1, "spread nodes must commit to one zone"
         counts[node.zones[0]] = counts.get(node.zones[0], 0) + len(node.pods)
     return counts
-
 
 class TestZonalSpread:
     """topology_test.go:66-378 — the zonal skew matrix."""
@@ -197,7 +197,6 @@ class TestZonalSpread:
         host, tpu = compare(pods)
         assert sorted(zone_counts(tpu).values()) == [2, 2, 2]
 
-
 class TestHostnameSpread:
     """topology_test.go:380-490."""
 
@@ -237,7 +236,6 @@ class TestHostnameSpread:
                 app = pod.metadata.labels["app"]
                 per_app[app] = per_app.get(app, 0) + 1
             assert all(v <= 1 for v in per_app.values())
-
 
 class TestCapacityTypeAndArchSpread:
     """topology_test.go:492-783 — spreads over capacity-type and arch keys
@@ -283,7 +281,6 @@ class TestCapacityTypeAndArchSpread:
             pods(), [make_provisioner()], fake_cp.instance_types_assorted()[:200]
         )
         assert not host.failed_pods
-
 
 class TestCombinedConstraints:
     """topology_test.go:785-1029 — zone and hostname spreads together."""
@@ -337,7 +334,6 @@ class TestCombinedConstraints:
         assert sorted(zone_counts(tpu).values()) == [1, 1]
         assert len(tpu.failed_pods) == 2
 
-
 class TestSpreadLimitedByNodeConstraints:
     """topology_test.go:1031-1194 — the pod's own node constraints shrink the
     spread's domain universe."""
@@ -378,7 +374,6 @@ class TestSpreadLimitedByNodeConstraints:
             )
         )
         assert "test-zone-3" not in zone_counts(tpu)
-
 
 class TestPodAffinity:
     """topology_test.go:1196-1510."""
@@ -567,7 +562,6 @@ class TestPodAffinity:
         host, tpu = compare(pods)
         assert not tpu.failed_pods
 
-
 class TestPodAntiAffinity:
     """topology_test.go:1511-1923."""
 
@@ -664,7 +658,6 @@ class TestPodAntiAffinity:
         host, tpu = compare(pods)
         assert all(len(n.pods) == 1 for n in tpu.new_nodes)
 
-
 class TestTolerationsAndTaints:
     """topology_test.go:2210-2256 tail cases."""
 
@@ -689,7 +682,6 @@ class TestTolerationsAndTaints:
             provisioners=[prov],
         )
         assert not tpu.failed_pods
-
 
 class TestExistingPodCounting:
     """topology_test.go:124-162, 308-340 — countDomains seeding: pre-existing

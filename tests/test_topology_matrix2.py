@@ -1,0 +1,516 @@
+"""Topology matrix, part 2: the spread tail of the reference suite.
+
+Ports the multi-reconcile / existing-node spread cases of
+/root/reference/pkg/controllers/provisioning/scheduling/topology_test.go that
+part 1 (test_topology_matrix.py) does not cover: minimum-domain selection,
+skew recovery, domain discovery, running-pod count filters, capacity-type and
+arch spreads, combined-constraint families, and custom-key spreads across
+provisioners.  Cases run through the full environment (controller + cluster
+state + informers) so bound pods and launched nodes seed counts exactly as
+countDomains does (topology.go:231-276).
+"""
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_IN,
+    LabelSelector,
+    NodeSelectorRequirement,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.testing import make_node, make_pod, make_pods, make_provisioner
+from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
+
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+HOSTNAME = labels_api.LABEL_HOSTNAME
+CT = labels_api.LABEL_CAPACITY_TYPE
+ARCH = labels_api.LABEL_ARCH_STABLE
+LABELS = {"test": "test"}
+
+
+def spread(key=ZONE, skew=1, labels=LABELS, when="DoNotSchedule"):
+    return TopologySpreadConstraint(
+        max_skew=skew,
+        topology_key=key,
+        when_unsatisfiable=when,
+        label_selector=LabelSelector(match_labels=dict(labels)) if labels is not None else None,
+    )
+
+
+def expect_skew(env, key, labels=LABELS, namespace="default"):
+    """Reference ExpectSkew (expectations.go): count scheduled, non-terminal,
+    non-terminating pods matching the selector, grouped by the node's domain
+    value; nodes without the key don't count."""
+    counts = {}
+    for pod in env.kube.list_pods():
+        if pod.namespace != namespace:
+            continue
+        if pod.metadata.deletion_timestamp is not None:
+            continue
+        if pod.status.phase in ("Failed", "Succeeded"):
+            continue
+        if not pod.spec.node_name:
+            continue
+        if labels is not None and any(
+            pod.metadata.labels.get(k) != v for k, v in labels.items()
+        ):
+            continue
+        node = env.kube.get_node(pod.spec.node_name)
+        if node is None:
+            continue
+        domain = node.metadata.labels.get(key)
+        if domain is None:
+            continue
+        counts[domain] = counts.get(domain, 0) + 1
+    return sorted(counts.values())
+
+
+def provision(env, *pods):
+    return expect_provisioned(env, *pods)
+
+
+def pods_with(n, topology=None, requests=None, node_requirements=None,
+              node_selector=None, labels=LABELS):
+    return make_pods(
+        n,
+        labels=dict(labels),
+        requests=requests or {"cpu": "10m"},
+        topology_spread=[topology] if topology else None,
+        node_requirements=node_requirements,
+        node_selector=node_selector,
+    )
+
+
+class TestZonalSpreadTail:
+    """topology_test.go:52-340 — the multi-reconcile zonal cases."""
+
+    def test_invalid_label_selector_does_not_spread(self):
+        # topology_test.go:52-64: a selector that matches nothing makes skew
+        # vacuous (interdependent-selector semantics) — pods pack together
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        topo = TopologySpreadConstraint(
+            max_skew=1, topology_key=ZONE, when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(
+                match_labels={"app.kubernetes.io/name": "{{ zqfmgb }}"}
+            ),
+        )
+        pods = make_pods(2, labels=LABELS, requests={"cpu": "10m"}, topology_spread=[topo])
+        result = provision(env, *pods)
+        assert all(result[p.uid] is not None for p in pods)
+        assert expect_skew(env, ZONE) == [2]
+
+    def test_schedules_non_minimum_domain_if_only_one_available(self):
+        # topology_test.go:163-204: maxSkew 5; zone pinned per reconcile;
+        # final round only zone-3 allowed -> 1,1,6 and the rest fail
+        env = make_environment()
+        topo = spread(skew=5)
+        rr = {"cpu": 1.1}
+
+        env.kube.apply(make_provisioner(requirements=[
+            NodeSelectorRequirement(key=ZONE, operator=OP_IN, values=["test-zone-1"])
+        ]))
+        provision(env, *pods_with(1, topo, rr))
+        assert expect_skew(env, ZONE) == [1]
+
+        env.kube.apply(make_provisioner(requirements=[
+            NodeSelectorRequirement(key=ZONE, operator=OP_IN, values=["test-zone-2"])
+        ]))
+        provision(env, *pods_with(1, topo, rr))
+        assert expect_skew(env, ZONE) == [1, 1]
+
+        env.kube.apply(make_provisioner(requirements=[
+            NodeSelectorRequirement(key=ZONE, operator=OP_IN, values=["test-zone-3"])
+        ]))
+        provision(env, *pods_with(10, topo, rr))
+        assert expect_skew(env, ZONE) == [1, 1, 6]
+
+    def test_only_minimum_domains_when_already_violating_skew(self):
+        # topology_test.go:205-242: delete to create skew, then recover
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        topo = spread(skew=1)
+        rr = {"cpu": 1.1}
+
+        first = pods_with(9, topo, rr)
+        result = provision(env, *first)
+        assert expect_skew(env, ZONE) == [3, 3, 3]
+
+        for pod in first:
+            node = result[pod.uid]
+            assert node is not None
+            if node.metadata.labels.get(ZONE) != "test-zone-1":
+                env.kube.delete(pod, force=True)
+        assert expect_skew(env, ZONE) == [3]
+
+        provision(env, *pods_with(3, topo, rr))
+        assert expect_skew(env, ZONE) == [1, 2, 3]
+
+    def test_do_not_schedule_discovers_domains_from_unconstrained_pods(self):
+        # topology_test.go:276-307: the first pod carries no constraint but its
+        # labels seed the zone-1 domain for the later spread
+        env = make_environment()
+        topo = spread(skew=1)
+        rr = {"cpu": 1.1}
+
+        env.kube.apply(make_provisioner(requirements=[
+            NodeSelectorRequirement(key=ZONE, operator=OP_IN, values=["test-zone-1"])
+        ]))
+        provision(env, *pods_with(1, None, rr))
+
+        env.kube.apply(make_provisioner(requirements=[
+            NodeSelectorRequirement(key=ZONE, operator=OP_IN,
+                                    values=["test-zone-2", "test-zone-3"])
+        ]))
+        provision(env, *pods_with(10, topo, rr))
+        assert expect_skew(env, ZONE) == [1, 2, 2]
+
+    def test_only_counts_running_scheduled_matching_domain_pods(self):
+        # topology_test.go:308-340: pending/terminating/failed/succeeded/
+        # wrong-namespace/missing-domain pods are all invisible to skew
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        first = make_node(name="first", labels={ZONE: "test-zone-1"})
+        second = make_node(name="second", labels={ZONE: "test-zone-2"})
+        third = make_node(name="third")  # no topology domain
+        for n in (first, second, third):
+            env.kube.create(n)
+
+        bind = dict(requests={"cpu": "1m"}, unschedulable=False)
+        env.kube.create(make_pod(node_name="first", **bind))  # missing labels
+        env.kube.create(make_pod(labels=LABELS, **bind))  # pending (no node)
+        env.kube.create(make_pod(labels=LABELS, node_name="third", **bind))  # no domain
+        env.kube.create(make_pod(labels=LABELS, namespace="wrong-ns", node_name="first", **bind))
+        terminating = make_pod(labels=LABELS, node_name="first", **bind)
+        env.kube.create(terminating)
+        env.kube.delete(terminating)  # terminating: deletion timestamp set
+        env.kube.create(make_pod(labels=LABELS, node_name="first", phase="Failed", **bind))
+        env.kube.create(make_pod(labels=LABELS, node_name="first", phase="Succeeded", **bind))
+        for name in ("first", "first", "second"):
+            env.kube.create(make_pod(labels=LABELS, node_name=name, **bind))
+
+        provision(env, *pods_with(2, spread(skew=1)))
+        assert expect_skew(env, ZONE) == [1, 2, 2]
+
+
+class TestHostnameSpreadTail:
+    def test_hostname_spread_with_varying_arch(self):
+        # topology_test.go:447-491 (issue #1425): same hostname spread, two
+        # deployments on different architectures -> four nodes
+        env = make_environment()
+        env.kube.create(make_provisioner())
+
+        def spread_pod(app, arch):
+            return make_pod(
+                labels={"app": app},
+                requests={"cpu": "10m"},
+                node_requirements=[
+                    NodeSelectorRequirement(key=ARCH, operator=OP_IN, values=[arch])
+                ],
+                topology_spread=[spread(HOSTNAME, 1, {"app": app})],
+            )
+
+        pods = [
+            spread_pod("app1", labels_api.ARCHITECTURE_AMD64),
+            spread_pod("app1", labels_api.ARCHITECTURE_AMD64),
+            spread_pod("app2", labels_api.ARCHITECTURE_ARM64),
+            spread_pod("app2", labels_api.ARCHITECTURE_ARM64),
+        ]
+        result = provision(env, *pods)
+        assert all(result[p.uid] is not None for p in pods)
+        assert len(env.kube.list_nodes()) == 4
+
+
+class TestCapacityTypeSpreadTail:
+    """topology_test.go:492-784 — the capacity-type family."""
+
+    def test_respects_provisioner_capacity_type_constraints(self):
+        env = make_environment()
+        env.kube.create(make_provisioner(requirements=[
+            NodeSelectorRequirement(key=CT, operator=OP_IN, values=["spot", "on-demand"])
+        ]))
+        provision(env, *pods_with(4, spread(CT, 1)))
+        assert expect_skew(env, CT) == [2, 2]
+
+    def test_ct_do_not_schedule_respects_skew(self):
+        # topology_test.go:526-560: one spot pod, then on-demand only; skew 1
+        # allows 2 on-demand, the other 3 fail
+        env = make_environment()
+        topo = spread(CT, 1)
+        rr = {"cpu": 1.1}
+        env.kube.apply(make_provisioner(requirements=[
+            NodeSelectorRequirement(key=CT, operator=OP_IN, values=["spot"])
+        ]))
+        provision(env, *pods_with(1, topo, rr))
+        assert expect_skew(env, CT) == [1]
+
+        env.kube.apply(make_provisioner(requirements=[
+            NodeSelectorRequirement(key=CT, operator=OP_IN, values=["on-demand"])
+        ]))
+        provision(env, *pods_with(5, topo, rr))
+        assert expect_skew(env, CT) == [1, 2]
+
+    def test_ct_schedule_anyway_violates_when_needed(self):
+        # topology_test.go:561-591
+        env = make_environment()
+        topo = spread(CT, 1, when="ScheduleAnyway")
+        rr = {"cpu": 1.1}
+        env.kube.apply(make_provisioner(requirements=[
+            NodeSelectorRequirement(key=CT, operator=OP_IN, values=["spot"])
+        ]))
+        provision(env, *pods_with(1, topo, rr))
+        assert expect_skew(env, CT) == [1]
+
+        env.kube.apply(make_provisioner(requirements=[
+            NodeSelectorRequirement(key=CT, operator=OP_IN, values=["on-demand"])
+        ]))
+        provision(env, *pods_with(5, topo, rr))
+        assert expect_skew(env, CT) == [1, 5]
+
+    def test_ct_only_counts_running_scheduled_matching_domain_pods(self):
+        # topology_test.go:592-624
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        first = make_node(name="first", labels={CT: "spot"})
+        second = make_node(name="second", labels={CT: "on-demand"})
+        third = make_node(name="third")
+        for n in (first, second, third):
+            env.kube.create(n)
+
+        bind = dict(requests={"cpu": "1m"}, unschedulable=False)
+        env.kube.create(make_pod(node_name="first", **bind))
+        env.kube.create(make_pod(labels=LABELS, **bind))
+        env.kube.create(make_pod(labels=LABELS, node_name="third", **bind))
+        env.kube.create(make_pod(labels=LABELS, namespace="wrong-ns", node_name="first", **bind))
+        terminating = make_pod(labels=LABELS, node_name="first", **bind)
+        env.kube.create(terminating)
+        env.kube.delete(terminating)
+        env.kube.create(make_pod(labels=LABELS, node_name="first", phase="Failed", **bind))
+        env.kube.create(make_pod(labels=LABELS, node_name="first", phase="Succeeded", **bind))
+        for name in ("first", "first", "second"):
+            env.kube.create(make_pod(labels=LABELS, node_name=name, **bind))
+
+        provision(env, *pods_with(2, spread(CT, 1)))
+        assert expect_skew(env, CT) == [2, 3]
+
+    def test_ct_no_label_selector_matches_all(self):
+        # topology_test.go:625-636
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": "10m"})
+        result = provision(env, pod)
+        assert result[pod.uid] is not None
+        assert expect_skew(env, CT, labels=None) == [1]
+
+    def test_hostname_interdependent_selectors_pack_one_node(self):
+        # topology_test.go:637-660: no pods match the selector, skew is
+        # vacuous, all five pods share one node
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pods = make_pods(5, requests={"cpu": "10m"},
+                         topology_spread=[spread(HOSTNAME, 1)])  # pods lack LABELS
+        result = provision(env, *pods)
+        names = {result[p.uid].name for p in pods}
+        assert len(names) == 1
+
+    def test_ct_spread_with_node_affinity_constrained(self):
+        # topology_test.go:661-696: the zone-2/spot node-selector excludes the
+        # existing on-demand pod from the topology, so all 5 pack onto spot
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        seed = make_pod(
+            labels=LABELS, requests={"cpu": "10m"},
+            node_requirements=[
+                NodeSelectorRequirement(key=ZONE, operator=OP_IN, values=["test-zone-1"]),
+                NodeSelectorRequirement(key=CT, operator=OP_IN, values=["on-demand"]),
+            ],
+        )
+        result = provision(env, seed)
+        assert result[seed.uid] is not None
+
+        pods = make_pods(
+            5, labels=LABELS, requests={"cpu": "10m"},
+            node_requirements=[
+                NodeSelectorRequirement(key=ZONE, operator=OP_IN, values=["test-zone-2"]),
+                NodeSelectorRequirement(key=CT, operator=OP_IN, values=["spot"]),
+            ],
+            topology_spread=[spread(CT, 1)],
+        )
+        provision(env, *pods)
+        assert expect_skew(env, CT) == [1, 5]
+
+    def test_ct_spread_sees_unconstrained_existing_pod(self):
+        # topology_test.go:697-739: the on-demand pod IS visible without a node
+        # selector, capping spot at 2 before violating skew
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        seed = make_pod(
+            labels=LABELS, requests={"cpu": 2},
+            node_selector={labels_api.LABEL_INSTANCE_TYPE_STABLE: "single-pod-instance-type"},
+            node_requirements=[
+                NodeSelectorRequirement(key=CT, operator=OP_IN, values=["on-demand"]),
+            ],
+        )
+        result = provision(env, seed)
+        assert result[seed.uid] is not None
+
+        env.kube.apply(make_provisioner(requirements=[
+            NodeSelectorRequirement(key=CT, operator=OP_IN, values=["spot"])
+        ]))
+        provision(env, *pods_with(5, spread(CT, 1), {"cpu": 2}))
+        assert expect_skew(env, CT) == [1, 2]
+
+    def test_arch_spread_sees_unconstrained_existing_pod(self):
+        # topology_test.go:740-784: same shape over the arch key
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        seed = make_pod(
+            labels=LABELS, requests={"cpu": 2},
+            node_selector={labels_api.LABEL_INSTANCE_TYPE_STABLE: "single-pod-instance-type"},
+            node_requirements=[
+                NodeSelectorRequirement(key=ARCH, operator=OP_IN, values=["amd64"]),
+            ],
+        )
+        result = provision(env, seed)
+        assert result[seed.uid] is not None
+
+        env.kube.apply(make_provisioner(requirements=[
+            NodeSelectorRequirement(key=ARCH, operator=OP_IN, values=["arm64"])
+        ]))
+        provision(env, *pods_with(5, spread(ARCH, 1), {"cpu": 2}))
+        assert expect_skew(env, ARCH) == [1, 2]
+
+
+class TestCombinedConstraintFamilies:
+    """topology_test.go:785-1030 — multi-constraint spread rounds."""
+
+    def test_zone_and_hostname_rounds(self):
+        # topology_test.go:785-824
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        topos = [spread(ZONE, 1), spread(HOSTNAME, 3)]
+
+        def round_(n):
+            pods = make_pods(n, labels=LABELS, requests={"cpu": "10m"},
+                             topology_spread=list(topos))
+            provision(env, *pods)
+            # kubelet registration stamps the hostname label (the reference's
+            # launched nodes carry it from the machine name immediately)
+            env.make_all_nodes_ready()
+
+        round_(2)
+        assert expect_skew(env, ZONE) == [1, 1]
+        assert max(expect_skew(env, HOSTNAME)) <= 3
+        round_(3)
+        assert expect_skew(env, ZONE) == [1, 2, 2]
+        assert max(expect_skew(env, HOSTNAME)) <= 3
+        round_(5)
+        assert expect_skew(env, ZONE) == [3, 3, 4]
+        assert max(expect_skew(env, HOSTNAME)) <= 3
+        round_(11)
+        assert expect_skew(env, ZONE) == [7, 7, 7]
+        assert max(expect_skew(env, HOSTNAME)) <= 3
+
+    def test_zone_required_with_hostname_schedule_anyway(self):
+        # topology_test.go:882-909: zone spread (DoNotSchedule) + hostname
+        # spread (ScheduleAnyway), provisioner limited to zones 1-2.  The
+        # reference schedules exactly one pod per zone: the hostname
+        # preference puts each pod alone on a host, and the zone skew bound
+        # (min-domain includes the empty, unreachable hostname domains'
+        # interplay) stops the rest.
+        env = make_environment()
+        env.kube.apply(make_provisioner(requirements=[
+            NodeSelectorRequirement(key=ZONE, operator=OP_IN,
+                                    values=["test-zone-1", "test-zone-2"])
+        ]))
+        topos = [spread(ZONE, 1), spread(HOSTNAME, 1, when="ScheduleAnyway")]
+        pods = make_pods(10, labels=LABELS, requests={"cpu": "10m"},
+                         topology_spread=list(topos))
+        provision(env, *pods)
+        env.make_all_nodes_ready()
+        assert expect_skew(env, ZONE) == [1, 1]
+        assert expect_skew(env, HOSTNAME) == [1, 1]
+
+    def test_custom_key_spread_across_provisioners(self):
+        # topology_test.go:825-881: a 4:1 capacity.spread custom domain forces
+        # a 4:1 spot to on-demand split across two provisioners
+        env = make_environment()
+        env.kube.create(make_provisioner(name="spot", requirements=[
+            NodeSelectorRequirement(key=CT, operator=OP_IN, values=["spot"]),
+            NodeSelectorRequirement(key="capacity.spread.4-1", operator=OP_IN,
+                                    values=["2", "3", "4", "5"]),
+        ]))
+        env.kube.create(make_provisioner(name="on-demand", requirements=[
+            NodeSelectorRequirement(key=CT, operator=OP_IN, values=["on-demand"]),
+            NodeSelectorRequirement(key="capacity.spread.4-1", operator=OP_IN,
+                                    values=["1"]),
+        ]))
+        topo = spread("capacity.spread.4-1", 1)
+        pods = pods_with(20, topo)
+        result = provision(env, *pods)
+        assert all(result[p.uid] is not None for p in pods)
+        assert expect_skew(env, "capacity.spread.4-1") == [4, 4, 4, 4, 4]
+        assert expect_skew(env, CT) == [4, 16]
+
+    def test_hostname_and_ct_rounds(self):
+        # topology_test.go:910-952
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        topos = [spread(CT, 1), spread(HOSTNAME, 3)]
+
+        def round_(n):
+            pods = make_pods(n, labels=LABELS, requests={"cpu": "10m"},
+                             topology_spread=list(topos))
+            provision(env, *pods)
+            env.make_all_nodes_ready()
+
+        round_(2)
+        assert expect_skew(env, CT) == [1, 1]
+        assert max(expect_skew(env, HOSTNAME)) <= 3
+        round_(3)
+        assert expect_skew(env, CT) == [2, 3]
+        assert max(expect_skew(env, HOSTNAME)) <= 3
+        round_(5)
+        assert expect_skew(env, CT) == [5, 5]
+        assert max(expect_skew(env, HOSTNAME)) <= 3
+        round_(11)
+        assert expect_skew(env, CT) == [10, 11]
+        assert max(expect_skew(env, HOSTNAME)) <= 3
+
+    def test_zone_and_ct_rounds_bounded(self):
+        # topology_test.go:953-992: upper bounds only (exact split is
+        # implementation-defined across the 2x3 domain grid)
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        topos = [spread(CT, 1), spread(ZONE, 1)]
+
+        def round_(n, ct_max, zone_max):
+            pods = make_pods(n, labels=LABELS, requests={"cpu": "10m"},
+                             topology_spread=list(topos))
+            provision(env, *pods)
+            assert max(expect_skew(env, CT)) <= ct_max
+            assert max(expect_skew(env, ZONE)) <= zone_max
+
+        round_(2, 1, 1)
+        round_(3, 3, 2)
+        round_(5, 5, 4)
+        round_(11, 11, 7)
+
+    def test_hostname_zone_and_ct_rounds(self):
+        # topology_test.go:993-1030: every constraint's max skew holds through
+        # 14 incremental rounds over the assorted (all zone x ct) catalog
+        env = make_environment(instance_types=fake_cp.instance_types_assorted())
+        env.kube.create(make_provisioner())
+        topos = [spread(CT, 1), spread(ZONE, 2), spread(HOSTNAME, 3)]
+
+        def max_skew(counts):
+            return max(counts) - min(counts) if counts else 0
+
+        for i in range(1, 10):
+            pods = make_pods(i, labels=LABELS, requests={"cpu": "10m"},
+                             topology_spread=list(topos))
+            result = provision(env, *pods)
+            assert all(result[p.uid] is not None for p in pods)
+            assert max_skew(expect_skew(env, CT)) <= 1
+            assert max_skew(expect_skew(env, ZONE)) <= 2
+            assert max_skew(expect_skew(env, HOSTNAME)) <= 3

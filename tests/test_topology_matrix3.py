@@ -1,0 +1,312 @@
+"""Topology matrix, part 3: the pod-affinity/anti-affinity tail.
+
+Ports the remaining affinity cases of
+/root/reference/pkg/controllers/provisioning/scheduling/topology_test.go:
+spread-options limited by node affinity (the improve-skew rule), hostname and
+arch affinity targets, first-empty-domain self-affinity, the inverse and
+Schrödinger anti-affinity batches, existing-node inverse anti-affinity, and
+topology counting across provisioners.
+"""
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_IN,
+    LabelSelector,
+    NodeSelectorRequirement,
+    PodAffinityTerm,
+    WeightedPodAffinityTerm,
+)
+from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
+from karpenter_core_tpu.testing.harness import make_environment
+from tests.test_topology_matrix2 import (
+    LABELS,
+    expect_skew,
+    pods_with,
+    provision,
+    spread,
+)
+
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+HOSTNAME = labels_api.LABEL_HOSTNAME
+CT = labels_api.LABEL_CAPACITY_TYPE
+ARCH = labels_api.LABEL_ARCH_STABLE
+AFF_LABELS = {"security": "s2"}
+
+
+def aff_term(key=ZONE, labels=AFF_LABELS):
+    return PodAffinityTerm(
+        topology_key=key, label_selector=LabelSelector(match_labels=dict(labels))
+    )
+
+
+def weighted(key=ZONE, labels=AFF_LABELS, weight=10):
+    return WeightedPodAffinityTerm(weight=weight, pod_affinity_term=aff_term(key, labels))
+
+
+def delete_unscheduled(env):
+    """ExpectDeleteAllUnscheduledPods (topology_test.go:2203-2209)."""
+    for pod in env.kube.list_pods():
+        if not pod.spec.node_name:
+            env.kube.delete(pod, force=True)
+
+
+class TestSpreadLimitedByNodeAffinity:
+    def test_limit_spread_by_node_affinity_improves_skew(self):
+        # topology_test.go:1079-1125: zone-3 opens later; scheduling there
+        # "violates" max-skew numerically but improves it, so it's allowed
+        env = make_environment()
+        topo = spread(ZONE, 1)
+        env.kube.apply(make_provisioner())
+        provision(env, *pods_with(6, topo, node_requirements=[
+            NodeSelectorRequirement(key=ZONE, operator=OP_IN,
+                                    values=["test-zone-1", "test-zone-2"])
+        ]))
+        assert expect_skew(env, ZONE) == [3, 3]
+
+        provision(env, *pods_with(1, topo, node_requirements=[
+            NodeSelectorRequirement(key=ZONE, operator=OP_IN,
+                                    values=["test-zone-2", "test-zone-3"])
+        ]))
+        assert expect_skew(env, ZONE) == [1, 3, 3]
+
+        provision(env, *pods_with(5, topo))
+        assert expect_skew(env, ZONE) == [4, 4, 4]
+
+    def test_limit_ct_spread_by_node_selector_schedule_anyway(self):
+        # topology_test.go:1127-1150
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        topo = spread(CT, 1, when="ScheduleAnyway")
+        spot = pods_with(5, topo, node_selector={CT: "spot"})
+        od = pods_with(5, topo, node_selector={CT: "on-demand"})
+        provision(env, *(spot + od))
+        assert expect_skew(env, CT) == [5, 5]
+
+    def test_limit_ct_spread_by_node_affinity_improves_skew(self):
+        # topology_test.go:1151-1195
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        topo = spread(CT, 1)
+        provision(env, *pods_with(3, topo, node_requirements=[
+            NodeSelectorRequirement(key=CT, operator=OP_IN, values=["spot"])
+        ]))
+        assert expect_skew(env, CT) == [3]
+
+        provision(env, *pods_with(1, topo, node_requirements=[
+            NodeSelectorRequirement(key=CT, operator=OP_IN,
+                                    values=["on-demand", "spot"])
+        ]))
+        assert expect_skew(env, CT) == [1, 3]
+
+        provision(env, *pods_with(5, topo))
+        assert expect_skew(env, CT) == [4, 5]
+
+
+class TestPodAffinityTargets:
+    def test_pod_affinity_hostname_lands_together(self):
+        # topology_test.go:1205-1238
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        aff1 = make_pod(labels=AFF_LABELS, requests={"cpu": "10m"})
+        aff2 = make_pod(requests={"cpu": "10m"}, pod_affinity=[aff_term(HOSTNAME)])
+        spread_pods = pods_with(10, spread(HOSTNAME, 1))
+        result = provision(env, *(spread_pods + [aff1, aff2]))
+        n1, n2 = result[aff1.uid], result[aff2.uid]
+        assert n1 is not None and n2 is not None
+        assert n1.name == n2.name
+
+    def test_pod_affinity_arch_same_arch_different_nodes(self):
+        # topology_test.go:1239-1281: affinity on the arch key + hostname
+        # spread: same architecture, different hosts
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        tsc = [spread(HOSTNAME, 1, AFF_LABELS)]
+        aff1 = make_pod(
+            labels=AFF_LABELS, requests={"cpu": 2},
+            node_selector={ARCH: "arm64"}, topology_spread=list(tsc),
+        )
+        aff2 = make_pod(
+            labels=AFF_LABELS, requests={"cpu": 1},
+            topology_spread=list(tsc), pod_affinity=[aff_term(ARCH)],
+        )
+        result = provision(env, aff1, aff2)
+        n1, n2 = result[aff1.uid], result[aff2.uid]
+        assert n1 is not None and n2 is not None
+        assert n1.metadata.labels[ARCH] == n2.metadata.labels[ARCH] == "arm64"
+        assert n1.name != n2.name
+
+    def test_self_affinity_first_empty_domain_only(self):
+        # topology_test.go:1306-1345: the group commits to ONE hostname; the
+        # 5-pod node fills and the rest fail, across batches
+        env = make_environment()
+        env.kube.create(make_provisioner())
+
+        def batch():
+            return make_pods(10, labels=AFF_LABELS, requests={"cpu": "10m"},
+                             pod_affinity=[aff_term(HOSTNAME)])
+
+        pods = batch()
+        result = provision(env, *pods)
+        scheduled = [p for p in pods if result[p.uid] is not None]
+        nodes = {result[p.uid].name for p in scheduled}
+        assert len(nodes) == 1
+        assert len(scheduled) == 5  # default-instance-type caps at 5 pods
+        assert sum(1 for p in pods if result[p.uid] is None) == 5
+
+        pods2 = batch()
+        result2 = provision(env, *pods2)
+        assert all(result2[p.uid] is None for p in pods2)
+
+    def test_self_affinity_first_domain_constrained_zones(self):
+        # topology_test.go:1346-1389: the hostname domain committed in
+        # zone-1; later pods restricted to zones 2/3 can never join it
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        seed = make_pod(
+            labels=AFF_LABELS, requests={"cpu": "10m"},
+            node_selector={ZONE: "test-zone-1"},
+            pod_affinity=[aff_term(HOSTNAME)],
+        )
+        result = provision(env, seed)
+        assert result[seed.uid] is not None
+
+        pods = make_pods(
+            10, labels=AFF_LABELS, requests={"cpu": "10m"},
+            node_requirements=[
+                NodeSelectorRequirement(key=ZONE, operator=OP_IN,
+                                        values=["test-zone-2", "test-zone-3"])
+            ],
+            pod_affinity=[aff_term(HOSTNAME)],
+        )
+        result = provision(env, *pods)
+        assert all(result[p.uid] is None for p in pods)
+
+
+class TestZoneAntiAffinityVariants:
+    def test_anti_affinity_other_schedules_first(self):
+        # topology_test.go:1572-1593: the avoided pod schedules somewhere
+        # unknown, so the anti pod can't commit to any zone
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(labels=AFF_LABELS, requests={"cpu": 2})
+        anti = make_pod(requests={"cpu": "10m"}, pod_anti_affinity=[aff_term(ZONE)])
+        result = provision(env, pod, anti)
+        assert result[pod.uid] is not None
+        assert result[anti.uid] is None
+
+    def test_preferred_anti_affinity_inverse_violates(self):
+        # topology_test.go:1637-1676: preferences never block the target
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        zone_pods = [
+            make_pod(requests={"cpu": 2}, node_selector={ZONE: z},
+                     pod_anti_affinity_preferred=[weighted(ZONE)])
+            for z in ("test-zone-1", "test-zone-2", "test-zone-3")
+        ]
+        aff = make_pod(labels=AFF_LABELS, requests={"cpu": "10m"})
+        result = provision(env, *zone_pods, aff)
+        assert all(result[p.uid] is not None for p in zone_pods)
+        assert result[aff.uid] is not None
+
+    def test_anti_affinity_schroedinger(self):
+        # topology_test.go:1713-1744: an uncommitted anti pod poisons every
+        # zone this batch; the next batch sees its committed zone
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        anywhere = make_pod(requests={"cpu": 2}, pod_anti_affinity=[aff_term(ZONE)])
+        aff = make_pod(labels=AFF_LABELS, requests={"cpu": "10m"})
+        result = provision(env, anywhere, aff)
+        node1 = result[anywhere.uid]
+        assert node1 is not None
+        assert result[aff.uid] is None
+
+        result2 = provision(env, aff)
+        node2 = result2[aff.uid]
+        assert node2 is not None
+        assert (node1.metadata.labels.get(ZONE) != node2.metadata.labels.get(ZONE))
+
+    def test_anti_affinity_inverse_with_existing_nodes(self):
+        # topology_test.go:1745-1794: every zone holds a bound pod whose
+        # anti-affinity repels the new pod — nothing can schedule
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        zone_pods = [
+            make_pod(requests={"cpu": 2}, node_selector={ZONE: z},
+                     pod_anti_affinity=[aff_term(ZONE)])
+            for z in ("test-zone-1", "test-zone-2", "test-zone-3")
+        ]
+        result = provision(env, *zone_pods)
+        assert all(result[p.uid] is not None for p in zone_pods)
+        env.make_all_nodes_ready()
+
+        aff = make_pod(labels=AFF_LABELS, requests={"cpu": "10m"})
+        result = provision(env, aff)
+        assert result[aff.uid] is None
+
+    def test_preferred_anti_affinity_inverse_with_existing_nodes(self):
+        # topology_test.go:1795-1844: preferred inverse does not repel
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        zone_pods = [
+            make_pod(requests={"cpu": 2}, node_selector={ZONE: z},
+                     pod_anti_affinity_preferred=[weighted(ZONE)])
+            for z in ("test-zone-1", "test-zone-2", "test-zone-3")
+        ]
+        result = provision(env, *zone_pods)
+        assert all(result[p.uid] is not None for p in zone_pods)
+        env.make_all_nodes_ready()
+
+        aff = make_pod(labels=AFF_LABELS, requests={"cpu": "10m"})
+        result = provision(env, aff)
+        assert result[aff.uid] is not None
+
+    def test_affinity_preference_with_conflicting_required_constraint(self):
+        # topology_test.go:1845-1878: the hostname-affinity preference loses
+        # to the hostname spread; everything still schedules on 3 hosts
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        aff1 = make_pod(labels=AFF_LABELS, requests={"cpu": "10m"})
+        aff_pods = make_pods(
+            3, labels=LABELS, requests={"cpu": "10m"},
+            topology_spread=[spread(HOSTNAME, 1)],
+            pod_affinity_preferred=[weighted(HOSTNAME, AFF_LABELS, weight=50)],
+        )
+        result = provision(env, *aff_pods, aff1)
+        assert all(result[p.uid] is not None for p in aff_pods + [aff1])
+        env.make_all_nodes_ready()  # register hostname labels for the skew
+        assert expect_skew(env, HOSTNAME) == [1, 1, 1]
+
+    def test_zone_anti_affinity_batches_to_one_per_zone(self):
+        # topology_test.go:1879-1923: late committal resolves one zone per
+        # batch; after 3 batches all zones are poisoned
+        env = make_environment()
+        env.kube.create(make_provisioner())
+
+        def batch():
+            return make_pods(3, labels=AFF_LABELS, requests={"cpu": "10m"},
+                             pod_anti_affinity=[aff_term(ZONE)])
+
+        for expected in ([1], [1, 1], [1, 1, 1], [1, 1, 1]):
+            provision(env, *batch())
+            assert expect_skew(env, ZONE, labels=AFF_LABELS) == expected
+            delete_unscheduled(env)
+            env.make_all_nodes_ready()
+
+
+class TestMultiProvisionerCounting:
+    def test_counts_topology_across_provisioners(self):
+        # topology_test.go:2174-2199
+        env = make_environment()
+        env.kube.create(make_provisioner(name="zone1", requirements=[
+            NodeSelectorRequirement(key=ZONE, operator=OP_IN, values=["test-zone-1"])
+        ]))
+        env.kube.create(make_provisioner(name="zone23", requirements=[
+            NodeSelectorRequirement(key=ZONE, operator=OP_IN,
+                                    values=["test-zone-2", "test-zone-3"])
+        ]))
+        labels = {"foo": "bar"}
+        pods = make_pods(10, labels=labels, requests={"cpu": "10m"},
+                         topology_spread=[spread(ZONE, 1, labels)])
+        result = provision(env, *pods)
+        assert all(result[p.uid] is not None for p in pods)
+        assert expect_skew(env, ZONE, labels=labels) == [3, 3, 4]

@@ -1,5 +1,6 @@
 """TPU consolidation sweep vs the host consolidation logic."""
 
+import pytest
 
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import OP_IN, NodeSelectorRequirement
@@ -12,8 +13,10 @@ from karpenter_core_tpu.solver.consolidation import TPUConsolidationSearch
 from karpenter_core_tpu.testing import make_pod, make_provisioner
 from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
 
-CT = labels_api.LABEL_CAPACITY_TYPE
+# device subset sweeps compile per cluster shape -- the slow tier (`make test-all`)
+pytestmark = pytest.mark.compile
 
+CT = labels_api.LABEL_CAPACITY_TYPE
 
 def build_cluster(n_nodes, pods_per_node, pod_cpu="600m", instance_types=5, oversize=False):
     """Provision n_nodes one at a time so each lands on its own node.
@@ -45,7 +48,6 @@ def build_cluster(n_nodes, pods_per_node, pod_cpu="600m", instance_types=5, over
     env.clock.step(21)
     return env
 
-
 def get_candidates(env):
     dep = env.deprovisioning
     return sorted(
@@ -55,7 +57,6 @@ def get_candidates(env):
         ),
         key=lambda c: c.disruption_cost,
     )
-
 
 class TestTPUConsolidation:
     def test_empty_candidates_deleted(self):
@@ -135,7 +136,6 @@ class TestTPUConsolidation:
         # minimum the sweep must not propose an invalid removal
         if cmd.action == Action.DELETE:
             raise AssertionError("full node must not be deleted")
-
 
 class TestSearchLargestPrefix:
     """The lane-sweep search must pin the exact boundary in ceil(log64(n))

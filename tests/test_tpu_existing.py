@@ -1,5 +1,6 @@
 """Parity tests: kernel existing-node placement vs the host ExistingNode path."""
 
+import pytest
 
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.cloudprovider import fake as fake_cp
@@ -7,8 +8,10 @@ from karpenter_core_tpu.solver.tpu import TPUSolver
 from karpenter_core_tpu.testing import make_node, make_pod, make_pods, make_provisioner
 from karpenter_core_tpu.testing.harness import make_environment
 
-ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+# kernel existing-node solves compile per plane shape -- the slow tier (`make test-all`)
+pytestmark = pytest.mark.compile
 
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
 
 def owned_ready_node(env, cpu=4, zone="test-zone-1", instance_type="default-instance-type", name=None):
     node = make_node(
@@ -24,7 +27,6 @@ def owned_ready_node(env, cpu=4, zone="test-zone-1", instance_type="default-inst
     )
     env.kube.create(node)
     return node
-
 
 class TestExistingNodes:
     def test_pods_fill_existing_before_new(self):
@@ -174,7 +176,6 @@ class TestExistingNodes:
         tpu_new = sum(len(n.pods) for n in tpu.new_nodes)
         assert (tpu_existing, tpu_new) == (host_existing, host_new)
         assert len(tpu.failed_pods) == len(host.failed_pods) == 0
-
 
 class TestReviewRegressions:
     """Scenarios from review: kernel/host divergences that are now fixed."""
@@ -355,7 +356,6 @@ class TestReviewRegressions:
         )
         assert len(res.failed_pods) == 1
         assert not res.new_nodes
-
 
 class TestVolumeLimits:
     """Kernel volume attach-limit plane vs the host ExistingNode path
@@ -589,7 +589,6 @@ class TestVolumeLimits:
         assert sum(len(v) for v in res.existing_assignments.values()) == 2
         assert not res.failed_pods
 
-
 class TestNonSelfSelectingSpread:
     """Spreads whose own pods don't match the selector: the skew formula
     (count + 0 - min <= maxSkew) reduces to a static admissible-domain mask
@@ -728,7 +727,6 @@ class TestNonSelfSelectingSpread:
         )
         assert len(res.failed_pods) == 1
 
-
 class TestCapacityAwareSpread:
     """Spread quotas must anticipate per-zone intake: a zone reachable only
     through existing nodes saturates mid-fill, freezing its count, which then
@@ -821,7 +819,6 @@ class TestCapacityAwareSpread:
         host_placed, tpu_placed = self._placed(host, tpu)
         assert tpu_placed == host_placed == 1
         assert len(tpu.failed_pods) == len(host.failed_pods) == 9
-
 
 class TestUnknownZoneNode:
     """An existing node WITHOUT a zone label encodes as an all-zones mask.

@@ -1,5 +1,7 @@
 """End-to-end provisioning through the TPU kernel path (use_tpu_kernel=True)."""
 
+import pytest
+
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
 from karpenter_core_tpu.controllers.provisioning import ProvisioningController
@@ -11,6 +13,8 @@ from karpenter_core_tpu.state.informer import start_informers
 from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
 from karpenter_core_tpu.utils.clock import FakeClock
 
+# end-to-end kernel provisioning compiles the solve -- the slow tier (`make test-all`)
+pytestmark = pytest.mark.compile
 
 def tpu_env(min_pods=1):
     clock = FakeClock()
@@ -25,7 +29,6 @@ def tpu_env(min_pods=1):
         use_tpu_kernel=True, tpu_kernel_min_pods=min_pods,
     )
     return kube, provider, cluster, recorder, controller
-
 
 class TestTPUProvisioningPath:
     def test_kernel_path_launches_nodes(self):
@@ -80,7 +83,6 @@ class TestTPUProvisioningPath:
         controller.reconcile(wait_for_batch=False)
         assert len(provider.create_calls) == 1
         assert len(kube.list_nodes()) == 1
-
 
 class TestMixedBatchSplit:
     """Kernel-unsupported pods no longer drag the whole batch to the host
@@ -231,7 +233,6 @@ class TestMixedBatchSplit:
             f"{total_cpu_capacity} across {len(results.new_nodes)} nodes"
         )
         assert results.failed_pods  # the exotic pod had no budget left
-
 
 class TestCustomTopologyKeySplit:
     """Topologies on keys the kernel doesn't model (region-class / custom

@@ -27,9 +27,11 @@ from karpenter_core_tpu.solver.builder import build_scheduler
 from karpenter_core_tpu.solver.tpu import TPUSolver
 from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
 
+# kernel/oracle parity compiles many solve shapes -- the slow tier (`make test-all`)
+pytestmark = pytest.mark.compile
+
 ZONE = labels_api.LABEL_TOPOLOGY_ZONE
 HOSTNAME = labels_api.LABEL_HOSTNAME
-
 
 def host_solve(pods, provisioners, instance_types=None):
     kube = KubeClient()
@@ -41,12 +43,10 @@ def host_solve(pods, provisioners, instance_types=None):
     )
     return scheduler.solve(pods)
 
-
 def tpu_solve(pods, provisioners, instance_types=None):
     provider = fake_cp.FakeCloudProvider(instance_types)
     solver = TPUSolver(provider, provisioners)
     return solver.solve(pods)
-
 
 def compare(pods_factory, provisioners=None, instance_types=None):
     """Run both paths on identical inputs; compare aggregates."""
@@ -65,7 +65,6 @@ def compare(pods_factory, provisioners=None, instance_types=None):
         f"nodes: tpu={len(tpu.new_nodes)} host={len(host.new_nodes)}"
     )
     return host, tpu
-
 
 class TestKernelParity:
     def test_homogeneous_batch(self):
@@ -156,7 +155,6 @@ class TestKernelParity:
         )
         assert len(tpu.failed_pods) == 2
 
-
 def spread_pods(n, key=ZONE, max_skew=1, requests=None):
     return [
         make_pod(
@@ -173,7 +171,6 @@ def spread_pods(n, key=ZONE, max_skew=1, requests=None):
         for _ in range(n)
     ]
 
-
 def anti_pods(n, key=HOSTNAME, requests=None):
     return [
         make_pod(
@@ -188,7 +185,6 @@ def anti_pods(n, key=HOSTNAME, requests=None):
         )
         for _ in range(n)
     ]
-
 
 class TestKernelTopologyParity:
     def test_zonal_spread(self):
@@ -255,7 +251,6 @@ class TestKernelTopologyParity:
 
         compare(pods)
 
-
 def affinity_pods(n, key=HOSTNAME, requests=None):
     return [
         make_pod(
@@ -270,7 +265,6 @@ def affinity_pods(n, key=HOSTNAME, requests=None):
         )
         for _ in range(n)
     ]
-
 
 class TestKernelSelfAffinity:
     def test_hostname_self_affinity_colocates(self):
@@ -416,7 +410,6 @@ class TestKernelSelfAffinity:
             apps = {p.metadata.labels.get("app") or p.metadata.labels.get("role") for p in node.pods}
             assert not ({"lonely", "noisy"} <= apps), "guard and noisy pods must not share a node"
 
-
 class TestKernelUnsupported:
     def test_affinity_to_absent_group_fails_everywhere(self):
         # affinity to a group with no pods anywhere: unsatisfiable, and not a
@@ -515,7 +508,6 @@ class TestKernelUnsupported:
             )
         )
 
-
 class TestClassify:
     def test_identical_pods_one_class(self):
         classes = classify_pods(make_pods(10, requests={"cpu": 1}))
@@ -530,7 +522,6 @@ class TestClassify:
         )
         cpus = [c.requests.get("cpu") for c in classes]
         assert cpus == sorted(cpus, reverse=True)
-
 
 class TestKernelLimits:
     def test_limits_constrain_instance_choice(self):
@@ -560,7 +551,6 @@ class TestKernelLimits:
             provisioners=[limited, fallback],
         )
         assert all(n.provisioner_name == "fallback" for n in tpu.new_nodes if n.pods)
-
 
 class TestPhaseFamilyCombos:
     """Constraint combos that would need intersected phase plans route to the
