@@ -44,25 +44,40 @@ class LeaderElector:
     def __init__(
         self,
         kube_client,
+        lease_store=None,
         clock: Optional[Clock] = None,
         identity: Optional[str] = None,
         lease_name: str = LEASE_NAME,
         namespace: Optional[str] = None,
         lease_duration: float = 15.0,
+        renew_deadline: Optional[float] = None,
         retry_period: float = 2.0,
         on_started_leading: Optional[Callable[[], None]] = None,
         on_stopped_leading: Optional[Callable[[], None]] = None,
     ) -> None:
         self.kube_client = kube_client
+        # where the Lease object lives: the process-local KubeClient (single
+        # replica / tests) or a RemoteLeaseStore against the shared solver
+        # service, which is what makes CROSS-process election real — each
+        # replica's in-memory store can only ever elect itself
+        self.lease_store = lease_store if lease_store is not None else kube_client
         self.clock = clock or Clock()
         self.identity = identity or default_identity()
         self.lease_name = lease_name
         self.namespace = namespace or os.environ.get("SYSTEM_NAMESPACE", "kube-system")
         self.lease_duration = lease_duration
+        # client-go RenewDeadline analog: a leader that hasn't SUCCESSFULLY
+        # renewed within this window self-demotes — without it, a leader
+        # partitioned from a remote lease store would keep running controllers
+        # while a standby (who can still reach the store) promotes: split-brain
+        self.renew_deadline = (
+            renew_deadline if renew_deadline is not None else lease_duration * 2 / 3
+        )
         self.retry_period = retry_period
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self.is_leader = False
+        self._last_renew = 0.0  # clock time of the last successful acquire/renew
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -94,7 +109,19 @@ class LeaderElector:
                 self.tick()
             except Exception:  # noqa: BLE001 - the elector loop never dies
                 log.exception("leader election tick")
+                self._check_renew_deadline()
             self._stop.wait(timeout=self.retry_period)
+
+    def _check_renew_deadline(self) -> None:
+        """Self-demote when renewal hasn't landed within the deadline (the
+        lease store may be unreachable; a standby may already have promoted —
+        stop acting BEFORE the staleness window hands leadership over)."""
+        if self.is_leader and self.clock.now() - self._last_renew > self.renew_deadline:
+            log.warning(
+                "leader election: %s renew deadline (%.0fs) exceeded, demoting",
+                self.identity, self.renew_deadline,
+            )
+            self._demote()
 
     # -- protocol --------------------------------------------------------------
 
@@ -102,7 +129,7 @@ class LeaderElector:
         """One acquire/renew attempt; returns is_leader.  Callable directly in
         tests for deterministic stepping."""
         now = self.clock.now()
-        stored = self.kube_client.get(Lease, self.lease_name, self.namespace)
+        stored = self.lease_store.get(Lease, self.lease_name, self.namespace)
         # the in-memory client hands out live references: mutate a COPY and
         # CAS with the version snapshotted at read time, or two electors
         # racing through the same object would both "win"
@@ -119,18 +146,26 @@ class LeaderElector:
                 ),
             )
             try:
-                self.kube_client.create(created)
+                self.lease_store.create(created)
             except ConflictError:
-                return self.is_leader  # lost the create race
+                # lost the create race; if we were leading, the lease vanished
+                # under us (store restart) and someone else now holds it
+                self._demote()
+                return self._deadline_checked()
+            self._last_renew = now
             self._promote()
             return True
 
         if lease.spec.holder_identity == self.identity:
             lease.spec.renew_time = now
             try:
-                self.kube_client.update_with_version(lease, seen_version)
+                self.lease_store.update_with_version(lease, seen_version)
             except ConflictError:
-                return self.is_leader
+                # only another writer can bump the version under our identity:
+                # a takeover or a store reset — either way we no longer hold it
+                self._demote()
+                return self._deadline_checked()
+            self._last_renew = now
             self._promote()
             return True
 
@@ -140,13 +175,14 @@ class LeaderElector:
             lease.spec.renew_time = now
             lease.spec.lease_transitions += 1
             try:
-                self.kube_client.update_with_version(lease, seen_version)
+                self.lease_store.update_with_version(lease, seen_version)
             except ConflictError:
-                return self.is_leader  # another standby won the takeover
+                return self._deadline_checked()  # another standby won the takeover
             log.info(
                 "leader election: %s took over (transition %d)",
                 self.identity, lease.spec.lease_transitions,
             )
+            self._last_renew = now
             self._promote()
             return True
 
@@ -154,18 +190,25 @@ class LeaderElector:
         self._demote()
         return False
 
+    def _deadline_checked(self) -> bool:
+        self._check_renew_deadline()
+        return self.is_leader
+
     def _release(self) -> None:
-        stored = self.kube_client.get(Lease, self.lease_name, self.namespace)
-        if stored is not None and stored.spec.holder_identity == self.identity:
-            lease = copy.deepcopy(stored)
-            lease.spec.holder_identity = ""
-            lease.spec.renew_time = 0.0
-            try:
-                self.kube_client.update_with_version(
+        try:
+            stored = self.lease_store.get(Lease, self.lease_name, self.namespace)
+            if stored is not None and stored.spec.holder_identity == self.identity:
+                lease = copy.deepcopy(stored)
+                lease.spec.holder_identity = ""
+                lease.spec.renew_time = 0.0
+                self.lease_store.update_with_version(
                     lease, stored.metadata.resource_version
                 )
-            except ConflictError:
-                pass
+        except ConflictError:
+            pass
+        except Exception as e:  # noqa: BLE001 - a failed release must not
+            # abort shutdown; the standby waits out lease staleness instead
+            log.warning("leader election: lease release failed (%s)", e)
 
     def _promote(self) -> None:
         if not self.is_leader:

@@ -176,11 +176,32 @@ class Operator:
                 ready=self.ready,
             ).start()
         self._started = True
+        # export the gauge from boot: a standby that never led must still
+        # report 0 (dashboards and the HA failover test poll it)
+        LEADER_GAUGE.labels().set(0.0)
         if self.options.enable_leader_election:
+            import os
+
             from karpenter_core_tpu.operator.leaderelection import LeaderElector
 
+            # cross-replica election needs a SHARED lease store: the solver
+            # service hosts the lease plane (deploy/manifests — the solver is
+            # the deployment's singleton), the in-process store only elects
+            # within one process (tests / replicas:1)
+            lease_store = None
+            endpoint = os.environ.get(
+                "KC_LEASE_ENDPOINT", os.environ.get("KC_SOLVER_ADDRESS", "")
+            )
+            if endpoint:
+                from karpenter_core_tpu.service.snapshot_channel import (
+                    RemoteLeaseStore,
+                )
+
+                lease_store = RemoteLeaseStore(endpoint)
+                log.info("leader election through shared lease plane at %s", endpoint)
             self.leader_elector = LeaderElector(
                 self.kube_client,
+                lease_store=lease_store,
                 clock=self.clock,
                 on_started_leading=self._start_controllers,
                 on_stopped_leading=self._stop_controllers,

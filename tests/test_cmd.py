@@ -14,25 +14,26 @@ class TestEntrypoints:
         assert isinstance(provider, FakeCloudProvider)
 
     def test_run_local_check(self):
-        """deploy/run_local.sh --check brings up the operator + solver pair
-        from scratch and probes both — the deploy artifact's contract."""
+        """deploy/run_local.sh --check brings up the deployed topology (one
+        shared solver + leader-elected operator replicas) from scratch and
+        probes everything — the deploy artifact's contract."""
         import os
 
         env = dict(os.environ)
         env.update(
-            METRICS_PORT="0", HEALTH_PROBE_PORT="18281",
+            BASE_METRICS_PORT="18280",
             KC_SOLVER_LISTEN="127.0.0.1:18980", JAX_PLATFORMS="cpu",
+            KC_TPU_KERNEL="0", KC_TPU_WARMUP="0",
         )
-        # the pair runs on CPU here; drop the accelerator-tunnel trigger so
-        # child interpreters don't block in the tunnel's sitecustomize
+        # the topology runs on CPU here; drop the accelerator-tunnel trigger
+        # so child interpreters don't block in the tunnel's sitecustomize
         # registration when the device link is down
         env.pop("PALLAS_AXON_POOL_IPS", None)
-        # metrics port must be fixed for curl; pick distinct ephemeral-ish ones
-        env["METRICS_PORT"] = "18280"
         proc = subprocess.run(
             ["deploy/run_local.sh", "--check"],
-            capture_output=True, text=True, timeout=120, env=env,
+            capture_output=True, text=True, timeout=180, env=env,
             cwd=subprocess.os.path.dirname(subprocess.os.path.dirname(__file__)) or ".",
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
-        assert "pair is up" in proc.stdout
+        assert "topology is up" in proc.stdout
+        assert "one leader elected" in proc.stdout
