@@ -12,6 +12,9 @@ REPO="$(cd "$(dirname "$0")/.." && pwd)"
 export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
 export KC_SOLVER_LISTEN="${KC_SOLVER_LISTEN:-127.0.0.1:8980}"
 export KC_LEASE_ENDPOINT="${KC_LEASE_ENDPOINT:-$KC_SOLVER_LISTEN}"
+# per-run lease state: a stale lease from a killed previous run would make
+# every bring-up wait out the 15 s staleness window (and leak into ~/.cache)
+export KC_LEASE_STATE="${KC_LEASE_STATE:-$(mktemp -d)/leases.json}"
 export LEADER_ELECT="${LEADER_ELECT:-true}"
 KC_REPLICAS="${KC_REPLICAS:-2}"
 BASE_METRICS_PORT="${BASE_METRICS_PORT:-8080}"

@@ -717,6 +717,10 @@ class MultiNodeConsolidation(_ConsolidationBase):
 
     name = "consolidation"
     use_tpu_kernel = False
+    # consecutive unexpected sweep failures before the device path disables
+    # for the process (mirrors provisioning.TPU_KERNEL_MAX_FAILURES)
+    _tpu_failures = 0
+    _TPU_MAX_FAILURES = 2
 
     def compute_command(self, candidates: List[CandidateNode]) -> Command:
         if not self.should_attempt():
@@ -748,7 +752,7 @@ class MultiNodeConsolidation(_ConsolidationBase):
             search = TPUConsolidationSearch(
                 self.cloud_provider, self.kube_client.list_provisioners()
             )
-            return search.compute_command(
+            cmd = search.compute_command(
                 candidates,
                 pending_pods=self.provisioning.get_pending_pods(),
                 state_nodes=self.cluster.snapshot_nodes(),
@@ -758,11 +762,18 @@ class MultiNodeConsolidation(_ConsolidationBase):
             log.debug("TPU consolidation unsupported for cluster shape, %s", e)
             return None
         except Exception as e:  # backend init/relay faults: host binary search
+            self._tpu_failures += 1
             log.warning(
                 "TPU consolidation sweep failed (%s: %s); falling back to the "
-                "host binary search", type(e).__name__, e,
+                "host binary search (%d/%d consecutive failures)",
+                type(e).__name__, e, self._tpu_failures, self._TPU_MAX_FAILURES,
             )
+            if self._tpu_failures >= self._TPU_MAX_FAILURES:
+                log.warning("disabling the device consolidation sweep for this process")
+                self.use_tpu_kernel = False
             return None
+        self._tpu_failures = 0
+        return cmd
 
     def first_n_consolidation_option(
         self, candidates: List[CandidateNode], max_parallel: int
@@ -865,7 +876,14 @@ class DeprovisioningController:
         self.emptiness = Emptiness(clock, kube_client, cluster)
         self.empty_node_consolidation = EmptyNodeConsolidation(*base_args)
         self.multi_node_consolidation = MultiNodeConsolidation(*base_args)
-        self.multi_node_consolidation.use_tpu_kernel = use_tpu_kernel
+        # the consolidation sweep has no remote-solve path yet: when device
+        # solves ship to a shared solver service (KC_SOLVER_ADDRESS — CPU
+        # controller replicas, deploy/manifests), keep consolidation on the
+        # host binary search rather than compiling the sweep in-process
+        import os
+
+        remote_solver = bool(os.environ.get("KC_SOLVER_ADDRESS", ""))
+        self.multi_node_consolidation.use_tpu_kernel = use_tpu_kernel and not remote_solver
         self.single_node_consolidation = SingleNodeConsolidation(*base_args)
         # test hook: invoked after replacements launch so suites can initialize
         # the nodes that the readiness wait polls for

@@ -271,6 +271,15 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                     for name, placed in results.existing_assignments.items()
                 },
                 "failedClassCounts": class_counts(results.failed_pods),
+                # spread residuals: classes the kernel may have under-placed
+                # vs the host oracle — the controller plane re-routes them
+                # through its host scheduler with seeded topology counts
+                # (provisioning._solve_host_remainder), so the wire path keeps
+                # the same no-shape-schedules-fewer guarantee as in-process
+                "residualClassCounts": class_counts(results.spread_residual_pods),
+                # zone commitments the solve stamped onto zone-less existing
+                # nodes: the re-route must see the same pins
+                "existingCommittedZones": dict(results.existing_committed_zones),
             }
             return msgpack.packb(response)
         except KernelUnsupported as e:
@@ -312,6 +321,11 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 "failedPodIndices": [
                     pod_index[p.uid] for p in results.failed_pods if p.uid in pod_index
                 ],
+                "residualPodIndices": [
+                    pod_index[p.uid]
+                    for p in results.spread_residual_pods if p.uid in pod_index
+                ],
+                "existingCommittedZones": dict(results.existing_committed_zones),
             }
             return msgpack.packb(response)
         except KernelUnsupported as e:
@@ -445,6 +459,8 @@ class SnapshotSolverClient:
                 for name, counts in response["existingAssignments"].items()
             },
             "failedPodIndices": take(response["failedClassCounts"]),
+            "residualPodIndices": take(response.get("residualClassCounts", [])),
+            "existingCommittedZones": response.get("existingCommittedZones", {}),
         }
 
     def close(self) -> None:

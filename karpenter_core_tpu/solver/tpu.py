@@ -824,29 +824,48 @@ class TPUSolver:
         """Convert a kernel node decision into a launch-path object: the
         provisioner's template with zone/capacity-type pinned to the decision's
         surviving domains and the viable instance-type list attached."""
+        return self._build_launchable(
+            decision.provisioner_name, decision.zones,
+            decision.instance_type_names, decision.requests, decision.pods,
+        )
+
+    def launchable_from_wire(self, entry: dict, pods: List[Pod]) -> LaunchableNode:
+        """to_launchable for a remote solve: the snapshot channel's newNodes
+        entry ({provisioner, instanceTypes, zones, requests}) instead of an
+        in-process decision.  No encode ran locally, so instance types resolve
+        against this solver's catalog by name (wire order preserved — it is
+        the decision's viability order from the serving side)."""
+        return self._build_launchable(
+            entry["provisioner"], list(entry.get("zones") or ()),
+            list(entry.get("instanceTypes") or ()),
+            {k: float(v) for k, v in (entry.get("requests") or {}).items()},
+            pods,
+        )
+
+    def _build_launchable(self, provisioner_name, zones, instance_type_names,
+                          requests, pods) -> LaunchableNode:
         from dataclasses import replace as dc_replace
 
         from karpenter_core_tpu.apis.objects import OP_IN
 
         template = next(
-            t for t in self.templates if t.provisioner_name == decision.provisioner_name
+            t for t in self.templates if t.provisioner_name == provisioner_name
         )
         requirements = Requirements(*template.requirements.values())
-        zones = decision.zones
         if zones:
             requirements.add(
-                Requirement(labels_api.LABEL_TOPOLOGY_ZONE, OP_IN, zones)
+                Requirement(labels_api.LABEL_TOPOLOGY_ZONE, OP_IN, list(zones))
             )
         options = [
             self._it_by_name[name]
-            for name in decision.instance_type_names
+            for name in instance_type_names
             if name in self._it_by_name
         ]
         return LaunchableNode(
             template=dc_replace(template, requirements=requirements),
             instance_type_options=options,
-            requests=dict(decision.requests),
-            pods=list(decision.pods),
+            requests=dict(requests),
+            pods=list(pods),
         )
 
 

@@ -1,0 +1,110 @@
+"""Controller → remote solver routing (the deployed topology, end to end).
+
+In-cluster, controller replicas run on CPU nodes and ship device solves over
+the snapshot channel to the one shared TPU solver
+(deploy/manifests/deployment.yaml, KC_SOLVER_ADDRESS).  These tests run that
+exact path in-process: a real gRPC solver service, a ProvisioningController
+with solver_endpoint pointed at it, real pods through reconcile.
+"""
+
+import pytest
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import LabelSelector, TopologySpreadConstraint
+from karpenter_core_tpu.service.snapshot_channel import serve
+from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
+from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
+
+pytestmark = pytest.mark.compile  # the service compiles the solve kernel
+
+
+@pytest.fixture()
+def remote_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("KC_LEASE_STATE", str(tmp_path / "leases.json"))
+    env = make_environment()
+    server, port = serve(env.provider, address="127.0.0.1:0")
+    env.provisioning.use_tpu_kernel = True
+    env.provisioning.tpu_kernel_min_pods = 4
+    env.provisioning.solver_endpoint = f"127.0.0.1:{port}"
+    env.kube.create(make_provisioner())
+    yield env
+    server.stop(grace=0)
+
+
+class TestRemoteSolveRouting:
+    def test_batch_solves_through_the_service(self, remote_env):
+        env = remote_env
+        pods = make_pods(12, requests={"cpu": "100m"})
+        result = expect_provisioned(env, *pods)
+        assert all(result[p.uid] is not None for p in pods)
+        assert env.provider.create_calls, "remote solve must still launch machines"
+        # the in-process kernel never ran: the remote client was built
+        assert env.provisioning._solver_client is not None
+
+    def test_spread_batch_matches_host_semantics(self, remote_env):
+        env = remote_env
+        topo = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+            label_selector=LabelSelector(match_labels={"app": "web"}),
+        )
+        pods = make_pods(6, labels={"app": "web"}, requests={"cpu": "100m"},
+                         topology_spread=[topo])
+        result = expect_provisioned(env, *pods)
+        assert all(result[p.uid] is not None for p in pods)
+        zones = {}
+        for p in pods:
+            z = result[p.uid].metadata.labels.get(labels_api.LABEL_TOPOLOGY_ZONE)
+            zones[z] = zones.get(z, 0) + 1
+        assert sorted(zones.values()) == [2, 2, 2]
+
+    def test_existing_nodes_nominate_over_the_wire(self, remote_env):
+        env = remote_env
+        first = make_pods(6, requests={"cpu": "100m"})
+        result = expect_provisioned(env, *first)
+        assert all(result[p.uid] is not None for p in first)
+        env.make_all_nodes_ready()
+
+        first_nodes = {result[p.uid].name for p in first}
+
+        second = make_pods(6, requests={"cpu": "100m"})
+        result2 = expect_provisioned(env, *second)
+        assert all(result2[p.uid] is not None for p in second)
+        # capacity existed on the already-launched nodes: at least part of the
+        # second batch must have been nominated onto them via the wire's
+        # existingAssignments
+        second_nodes = {result2[p.uid].name for p in second}
+        assert second_nodes & first_nodes, (
+            f"no existing-node reuse over the wire: {second_nodes} vs {first_nodes}"
+        )
+
+    def test_unsupported_batch_falls_back_to_host(self, remote_env):
+        env = remote_env
+        from karpenter_core_tpu.apis.objects import ContainerPort
+
+        # specific-IP host port: kernel-unsupported per classify, and the
+        # whole batch shares the shape so the split cannot isolate it
+        pods = []
+        for _ in range(6):
+            pod = make_pod(requests={"cpu": "100m"})
+            pod.spec.containers[0].ports.append(
+                ContainerPort(host_port=80, host_ip="10.0.0.1")
+            )
+            pods.append(pod)
+        result = expect_provisioned(env, *pods)
+        # host path still schedules them (one per node: the port collides)
+        assert all(result[p.uid] is not None for p in pods)
+
+    def test_transport_fault_trips_the_circuit_breaker(self, tmp_path, monkeypatch):
+        env = make_environment()
+        env.provisioning.use_tpu_kernel = True
+        env.provisioning.tpu_kernel_min_pods = 2
+        env.provisioning.solver_endpoint = "127.0.0.1:1"  # nothing listens
+        env.kube.create(make_provisioner())
+        from karpenter_core_tpu.controllers import provisioning as prov_mod
+
+        for _ in range(prov_mod.TPU_KERNEL_MAX_FAILURES):
+            pods = make_pods(3, requests={"cpu": "100m"})
+            result = expect_provisioned(env, *pods)
+            assert all(result[p.uid] is not None for p in pods)  # host fallback
+        assert env.provisioning.use_tpu_kernel is False

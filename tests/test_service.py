@@ -280,7 +280,10 @@ class TestWireSchema:
         client = SnapshotSolverClient(f"127.0.0.1:{port}")
         try:
             response = client.solve(make_pods(2, requests={"cpu": 1}), [make_provisioner()])
-            assert set(response) == {"newNodes", "existingAssignments", "failedPodIndices"}
+            assert set(response) == {
+                "newNodes", "existingAssignments", "failedPodIndices",
+                "residualPodIndices", "existingCommittedZones",
+            }
             node = response["newNodes"][0]
             assert set(node) == {
                 "provisioner", "instanceTypes", "zones", "requests", "podIndices",
