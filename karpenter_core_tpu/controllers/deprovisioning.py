@@ -877,12 +877,11 @@ class DeprovisioningController:
         self.empty_node_consolidation = EmptyNodeConsolidation(*base_args)
         self.multi_node_consolidation = MultiNodeConsolidation(*base_args)
         # the consolidation sweep has no remote-solve path yet: when device
-        # solves ship to a shared solver service (KC_SOLVER_ADDRESS — CPU
-        # controller replicas, deploy/manifests), keep consolidation on the
-        # host binary search rather than compiling the sweep in-process
-        import os
-
-        remote_solver = bool(os.environ.get("KC_SOLVER_ADDRESS", ""))
+        # solves ship to a shared solver service (provisioning.solver_endpoint,
+        # from KC_SOLVER_ADDRESS or set programmatically — CPU controller
+        # replicas, deploy/manifests), keep consolidation on the host binary
+        # search rather than compiling the sweep in-process
+        remote_solver = bool(getattr(provisioning, "solver_endpoint", ""))
         self.multi_node_consolidation.use_tpu_kernel = use_tpu_kernel and not remote_solver
         self.single_node_consolidation = SingleNodeConsolidation(*base_args)
         # test hook: invoked after replacements launch so suites can initialize

@@ -441,8 +441,8 @@ class ProvisioningController:
             # the deployed topology: CPU controller replicas, one shared TPU
             # solver service — ship the snapshot over the channel
             remote = self._solve_remote(
-                solver, tpu_pods, state_nodes, daemonset_pods, provisioners,
-                bound_pods,
+                solver, tpu_classes, tpu_pods, state_nodes, daemonset_pods,
+                provisioners, bound_pods,
             )
             if remote is None:
                 return None  # service judged the batch kernel-unsupported
@@ -504,8 +504,8 @@ class ProvisioningController:
             results.errors.update(host_results.errors)
         return results
 
-    def _solve_remote(self, solver, tpu_pods, state_nodes, daemonset_pods,
-                      provisioners, bound_pods):
+    def _solve_remote(self, solver, tpu_classes, tpu_pods, state_nodes,
+                      daemonset_pods, provisioners, bound_pods):
         """One snapshot solve over the gRPC channel (service.snapshot_channel,
         SolveClasses — O(distinct shapes) on the wire).
 
@@ -547,12 +547,28 @@ class ProvisioningController:
             }
             for sn in (state_nodes or [])
         ]
+        # resolve claims for the BOUND pods too: the server counts existing
+        # volume attachments from them, and an unresolvable claim reads as
+        # zero attachments (VolumeUsage.add drops resolution errors) — the
+        # node would look empty and over-admit new PVC pods
+        shipped_bound = [
+            p for sn in (state_nodes or [])
+            for p in bound_by_node.get(sn.node.name, [])
+        ]
+        # _split_batch laid tpu_pods out class-by-class: membership is the
+        # running offsets, no second O(pods) signature pass
+        members: List[List[int]] = []
+        offset = 0
+        for cls in tpu_classes:
+            members.append(list(range(offset, offset + len(cls.pods))))
+            offset += len(cls.pods)
         try:
             response = client.solve_classes(
                 tpu_pods, provisioners,
                 nodes=nodes,
                 daemonset_pods=daemonset_pods,
-                claim_drivers=self._claim_drivers(tpu_pods),
+                claim_drivers=self._claim_drivers(tpu_pods + shipped_bound),
+                members=members,
             )
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
@@ -561,19 +577,31 @@ class ProvisioningController:
             raise  # transport/backend fault: the circuit breaker counts it
 
         tpu_results = TPUSolveResults()
-        launchables = [
-            solver.launchable_from_wire(
+        launchables = []
+        for entry in response["newNodes"]:
+            node = solver.launchable_from_wire(
                 entry, [tpu_pods[i] for i in entry["podIndices"]]
             )
-            for entry in response["newNodes"]
-        ]
+            if not node.instance_type_options:
+                # catalog skew between this replica and the solver (image
+                # rollout): nothing launchable — fail the pods this round
+                # rather than launching an unconstrained machine; catalogs
+                # converge as the rollout completes
+                log.warning(
+                    "remote solve returned instance types unknown to this "
+                    "catalog (%s); failing %d pods for this batch",
+                    entry.get("instanceTypes", [])[:3], len(node.pods),
+                )
+                tpu_results.failed_pods.extend(node.pods)
+                continue
+            launchables.append(node)
         tpu_results.existing_assignments = {
             name: [tpu_pods[i] for i in indices]
             for name, indices in response["existingAssignments"].items()
         }
-        tpu_results.failed_pods = [
+        tpu_results.failed_pods.extend(
             tpu_pods[i] for i in response["failedPodIndices"]
-        ]
+        )
         tpu_results.spread_residual_pods = [
             tpu_pods[i] for i in response.get("residualPodIndices", [])
         ]

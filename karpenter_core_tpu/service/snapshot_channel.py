@@ -408,18 +408,25 @@ class SnapshotSolverClient:
         nodes: Optional[List[Dict]] = None,
         daemonset_pods: Optional[List] = None,
         claim_drivers: Optional[Dict[str, str]] = None,
+        members: Optional[List[List[int]]] = None,
         timeout: float = 60.0,
     ) -> Dict:
         """Class-columnar solve: dedup ``pods`` into shape classes locally,
         ship one representative + count per class, and expand the per-node
         class counts back into this caller's pod objects.  Returns the same
-        dict shape as solve() (podIndices refer to the ``pods`` argument)."""
-        from karpenter_core_tpu.models.snapshot import _class_signature
+        dict shape as solve() (podIndices refer to the ``pods`` argument).
 
-        by_sig: Dict[tuple, List[int]] = {}
-        for i, pod in enumerate(pods):
-            by_sig.setdefault(_class_signature(pod), []).append(i)
-        members = list(by_sig.values())
+        ``members`` — precomputed class membership (lists of indices into
+        ``pods``), for callers that already classified the batch (the
+        provisioning controller's split does) so the O(pods) signature pass
+        doesn't run twice on the hot path."""
+        if members is None:
+            from karpenter_core_tpu.models.snapshot import _class_signature
+
+            by_sig: Dict[tuple, List[int]] = {}
+            for i, pod in enumerate(pods):
+                by_sig.setdefault(_class_signature(pod), []).append(i)
+            members = list(by_sig.values())
         request = msgpack.packb(
             {
                 "podClasses": [

@@ -1,0 +1,163 @@
+"""Deprovisioning suite tail: the remaining suite_test.go scenarios.
+
+Ports the cases of /root/reference/pkg/controllers/deprovisioning/suite_test.go
+that the main suites (test_deprovisioning*.py) do not cover: multi-node
+replacement for drift, blocked node deletion (foreign finalizer), scheduling
+while a consolidation is in flight, and the deleting-node relaunch protection.
+"""
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.controllers.deprovisioning import Result
+from karpenter_core_tpu.testing import make_pod, make_pods, make_provisioner
+from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
+
+CT = labels_api.LABEL_CAPACITY_TYPE
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+ITYPE = labels_api.LABEL_INSTANCE_TYPE_STABLE
+
+
+def settled(env, *pods):
+    result = expect_provisioned(env, *pods)
+    env.make_all_nodes_ready()
+    env.clock.step(21)  # past the nomination window
+    return result
+
+
+class TestDriftMultiNodeReplace:
+    def test_can_replace_drifted_node_with_multiple_nodes(self):
+        # suite_test.go:332-423: one drifted node, pods that only fit across
+        # several smaller shapes -> drift replaces 1 with N
+        from karpenter_core_tpu.operator.settings import Settings
+
+        env = make_environment(
+            instance_types=fake_cp.instance_types(5),
+            settings=Settings(drift_enabled=True),
+        )
+        env.kube.create(make_provisioner())
+        # a hand-registered 32-cpu node (suite_test.go creates it the same
+        # way): the catalog's biggest shape is ~5 cpu, so re-scheduling the
+        # three 3-cpu pods off it MUST fan out to multiple nodes
+        from karpenter_core_tpu.testing import make_node
+
+        it = env.provider.get_instance_types(None)[-1]
+        offering = next(o for o in it.offerings if o.available)
+        old = make_node(
+            name="big-drifted",
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                ITYPE: it.name,
+                ZONE: offering.zone,
+                CT: offering.capacity_type,
+            },
+            allocatable={"cpu": 32, "memory": "64Gi", "pods": 100},
+            capacity={"cpu": 32, "memory": "64Gi", "pods": 100},
+            provider_id="fake://big-drifted",
+        )
+        env.kube.create(old)
+        env.make_all_nodes_ready()
+        pods = make_pods(3, requests={"cpu": 3})
+        for pod in pods:
+            env.kube.create(pod)
+            env.bind(pod, old.name)
+        env.clock.step(21)
+
+        env.provider.drifted = True
+        env.node_lifecycle.reconcile_all()  # stamps the drifted annotation
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.SUCCESS
+        nodes = env.kube.list_nodes()
+        assert old.name not in {n.name for n in nodes}
+        assert len(nodes) >= 2, "drift replacement should fan out to multiple nodes"
+
+
+class TestBlockedDeletion:
+    def test_waits_for_node_deletion_to_finish(self):
+        # suite_test.go:1346-1421: a foreign finalizer blocks the old node's
+        # deletion; consolidation launches the replacement, the old node
+        # survives the bounded deletion wait, and goes away once the
+        # finalizer is removed
+        from karpenter_core_tpu.apis.objects import NodeSelectorRequirement, OP_IN
+
+        env = make_environment(instance_types=fake_cp.instance_types(5))
+        env.kube.create(
+            make_provisioner(
+                consolidation_enabled=True,
+                requirements=[
+                    NodeSelectorRequirement(CT, OP_IN, [labels_api.CAPACITY_TYPE_ON_DEMAND])
+                ],
+            )
+        )
+        big = make_pod(requests={"cpu": 4})
+        small = make_pod(requests={"cpu": "500m"})
+        settled(env, big, small)
+        (old,) = env.kube.list_nodes()
+        old.metadata.finalizers.append("unit-test.com/block-deletion")
+        env.kube.apply(old)
+
+        env.kube.delete(env.kube.get_pod(big.namespace, big.name), force=True)
+        result, _ = env.deprovisioning.reconcile()
+        assert result == Result.SUCCESS
+        # the replacement launched, but the old node is still there: its
+        # foreign finalizer blocks the delete past the bounded wait
+        names = {n.name for n in env.kube.list_nodes()}
+        assert old.name in names
+        assert len(names) == 2
+
+        # clearing the finalizer lets the pending delete finish
+        env.kube.remove_finalizer(env.kube.get_node(old.name), "unit-test.com/block-deletion")
+        assert env.kube.get_node(old.name) is None
+
+
+class TestSchedulingDuringConsolidation:
+    def test_pending_pods_schedule_away_from_deleting_node(self):
+        # suite_test.go:2397-2466: while the old node is being consolidated
+        # away (marked deleting), a new pending pod must land on a NEW node
+        from karpenter_core_tpu.apis.objects import NodeSelectorRequirement, OP_IN
+
+        env = make_environment(instance_types=fake_cp.instance_types(5))
+        env.kube.create(
+            make_provisioner(
+                consolidation_enabled=True,
+                requirements=[
+                    NodeSelectorRequirement(CT, OP_IN, [labels_api.CAPACITY_TYPE_ON_DEMAND])
+                ],
+            )
+        )
+        big = make_pod(requests={"cpu": 4})
+        small = make_pod(requests={"cpu": "500m"})
+        settled(env, big, small)
+        (old,) = env.kube.list_nodes()
+
+        # consolidation starts: old node cordoned + marked for deletion
+        env.cluster.mark_for_deletion(old.name)
+        old.spec.unschedulable = True
+        env.kube.apply(old)
+
+        pending = make_pod(requests={"cpu": "100m"})
+        result = expect_provisioned(env, pending)
+        node = result[pending.uid]
+        assert node is not None
+        assert node.name != old.name
+
+    def test_node_launched_for_deleting_nodes_pods_not_consolidated(self):
+        # suite_test.go:2467-2554: pods on a deleting node re-provision onto a
+        # fresh node; that fresh node is nomination-protected and must not be
+        # consolidated by the next pass
+        env = make_environment(instance_types=fake_cp.instance_types(5))
+        env.kube.create(make_provisioner(consolidation_enabled=True))
+        pods = make_pods(4, requests={"cpu": 1})
+        settled(env, *pods)
+        (old,) = env.kube.list_nodes()
+
+        # the old node starts deleting; its pods need homes
+        env.cluster.mark_for_deletion(old.name)
+        env.provisioning.reconcile(wait_for_batch=False)
+        nodes = env.kube.list_nodes()
+        assert len(nodes) == 2
+        new = next(n for n in nodes if n.name != old.name)
+
+        # the fresh node was nominated for the displaced pods: consolidation
+        # must leave it alone even though it currently looks empty
+        result, _ = env.deprovisioning.reconcile()
+        assert env.kube.get_node(new.name) is not None

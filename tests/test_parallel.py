@@ -147,3 +147,78 @@ class TestCrossedStudy:
         )
         assert stormy["safe_prefix_all"] <= calm["safe_prefix_all"]
         assert (stormy["failed"] >= calm["failed"]).all()
+
+class TestTwoSliceDCN:
+    """Virtual 2-slice layout (SURVEY §7.8 / VERDICT r2 missing #5): an
+    8-device mesh built as (2 slices × 4 devices) with the replica axis on
+    the OUTER dim — the dim that maps to DCN on multi-slice hardware.  The
+    crossed study must partition with NO cross-device collectives: both batch
+    axes are embarrassingly parallel, outputs stay sharded, and the only
+    data movement is the host-side result fetch.  Proven by inspecting the
+    compiled HLO for collective ops."""
+
+    def _study_args(self, mesh, n_replicas=4, n_prefixes=4):
+        import jax.numpy as jnp
+
+        from karpenter_core_tpu.ops import solve as solve_ops
+
+        solver, pods = build()
+        snapshot = solver.encode(pods)
+        n_classes = len(snapshot.classes)
+        ex_state = solve_ops.empty_existing_state(
+            len(snapshot.resources), snapshot.vocab.n_keys, snapshot.vocab.width,
+            len(snapshot.zones), len(snapshot.capacity_types),
+        )
+        ex_static = solve_ops.empty_existing_static(
+            len(snapshot.resources), n_classes, len(snapshot.groups) + 1
+        )
+        # mirror crossed_consolidation_study's own argument construction
+        cls, statics_arrays, key_has_bounds = solve_ops.prepare(snapshot)
+        avail_r = mesh_ops.perturb_spot_availability(
+            snapshot, n_replicas, seed=0, interruption_rate=0.0
+        )
+        avail_idx = solve_ops.Statics._fields.index("it_avail")
+        sizes = jnp.arange(1, n_prefixes + 1, dtype=jnp.int32)
+        rank = jnp.full(1, 1 << 30, dtype=jnp.int32)
+        counts = jnp.zeros((n_classes, 1), dtype=jnp.int32)
+        fn = mesh_ops._crossed_grid_fn(
+            mesh, key_has_bounds, 16, snapshot.scan_passes, avail_idx
+        )
+        return fn, (avail_r, sizes, cls, statics_arrays, ex_state, ex_static,
+                    rank, counts), len(pods)
+
+    def test_compiled_hlo_has_no_collectives(self):
+        import re
+
+        mesh = mesh_ops.default_mesh_2d((2, 4))
+        assert mesh.devices.shape == (2, 4)
+        assert mesh.axis_names == ("replica", "lane")  # replica outer = DCN
+        fn, args, _ = self._study_args(mesh)
+        with mesh:
+            hlo = fn.lower(*args).compile().as_text()
+        collectives = re.findall(
+            r"\b(all-reduce|all-gather|all-to-all|collective-permute|"
+            r"reduce-scatter|collective-broadcast)\b",
+            hlo,
+        )
+        assert not collectives, f"cross-device collectives in the study: {set(collectives)}"
+
+    def test_outputs_stay_sliced_per_device(self):
+        import numpy as np
+
+        mesh = mesh_ops.default_mesh_2d((2, 4))
+        fn, args, n_pods = self._study_args(mesh, n_replicas=4, n_prefixes=4)
+        with mesh:
+            failed, n_new = fn(*args)
+        # each device holds exactly its (replica-block, lane-block) tile:
+        # nothing was gathered cross-slice
+        assert failed.sharding.is_equivalent_to(
+            jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("replica", "lane")
+            ),
+            ndim=2,
+        )
+        for shard in failed.addressable_shards:
+            assert shard.data.shape == (2, 1)  # [4/2 replicas, 4/4 lanes]
+        # rate 0 + no real candidates: nothing fails in any cell
+        assert int(np.asarray(jax.device_get(failed)).sum()) == 0
