@@ -717,6 +717,10 @@ class MultiNodeConsolidation(_ConsolidationBase):
 
     name = "consolidation"
     use_tpu_kernel = False
+    # remote sweep: ship /Consolidate to the solver service instead of
+    # compiling in-process (set alongside use_tpu_kernel by the controller)
+    solver_endpoint = ""
+    _solver_client = None
     # consecutive unexpected sweep failures before the device path disables
     # for the process (mirrors provisioning.TPU_KERNEL_MAX_FAILURES)
     _tpu_failures = 0
@@ -742,22 +746,29 @@ class MultiNodeConsolidation(_ConsolidationBase):
         return cmd
 
     def _tpu_search(self, candidates: List[CandidateNode]) -> Optional[Command]:
-        """Device subset sweep; None falls back to the host binary search."""
+        """Device subset sweep — remote over the snapshot channel when a
+        solver service is configured, in-process otherwise; None falls back
+        to the host binary search."""
         from karpenter_core_tpu.models.snapshot import KernelUnsupported
         from karpenter_core_tpu.solver.consolidation import TPUConsolidationSearch
 
         if len(candidates) < 2:
             return Command(Action.DO_NOTHING)
         try:
-            search = TPUConsolidationSearch(
-                self.cloud_provider, self.kube_client.list_provisioners()
-            )
-            cmd = search.compute_command(
-                candidates,
-                pending_pods=self.provisioning.get_pending_pods(),
-                state_nodes=self.cluster.snapshot_nodes(),
-                bound_pods=self.kube_client.list_pods(),
-            )
+            if self.solver_endpoint:
+                cmd = self._remote_search(candidates)
+                if cmd is None:
+                    return None  # service judged the shape kernel-unsupported
+            else:
+                search = TPUConsolidationSearch(
+                    self.cloud_provider, self.kube_client.list_provisioners()
+                )
+                cmd = search.compute_command(
+                    candidates,
+                    pending_pods=self.provisioning.get_pending_pods(),
+                    state_nodes=self.cluster.snapshot_nodes(),
+                    bound_pods=self.kube_client.list_pods(),
+                )
         except KernelUnsupported as e:
             log.debug("TPU consolidation unsupported for cluster shape, %s", e)
             return None
@@ -774,6 +785,101 @@ class MultiNodeConsolidation(_ConsolidationBase):
             return None
         self._tpu_failures = 0
         return cmd
+
+    def _remote_search(self, candidates: List[CandidateNode]) -> Optional[Command]:
+        """Ship the sweep to the solver service (/Consolidate).  Returns None
+        on FAILED_PRECONDITION (host binary search takes over); transport
+        faults propagate to _tpu_search's failure breaker."""
+        import grpc
+
+        from karpenter_core_tpu.apis import codec
+
+        client = self._solver_client
+        if client is None:
+            from karpenter_core_tpu.service.snapshot_channel import (
+                SnapshotSolverClient,
+            )
+
+            client = self._solver_client = SnapshotSolverClient(self.solver_endpoint)
+
+        provisioners = self.kube_client.list_provisioners()
+        state_nodes = self.cluster.snapshot_nodes()
+        bound_pods = self.kube_client.list_pods()
+        bound_by_node: Dict[str, List[Pod]] = {}
+        for pod in bound_pods:
+            if (
+                pod.spec.node_name
+                and not pod_util.is_terminal(pod)
+                and not pod_util.is_terminating(pod)
+            ):
+                bound_by_node.setdefault(pod.spec.node_name, []).append(pod)
+        nodes = [
+            {
+                "node": codec.node_to_dict(sn.node),
+                "pods": [codec.pod_to_dict(p) for p in bound_by_node.get(sn.node.name, [])],
+                "volumeLimits": dict(sn.volume_limits()),
+            }
+            for sn in state_nodes
+        ]
+        pending = self.provisioning.get_pending_pods()
+        daemonset_pods = self.provisioning.get_daemonset_pods()
+        wire_candidates = [
+            {
+                "name": c.node.name,
+                "instanceType": c.instance_type.name if c.instance_type else "",
+                "capacityType": c.capacity_type,
+                "zone": c.zone,
+                "provisioner": c.provisioner.name,
+                "disruptionCost": float(c.disruption_cost),
+            }
+            for c in candidates
+        ]
+        try:
+            response = client.consolidate(
+                wire_candidates, pending, provisioners,
+                nodes=nodes,
+                claim_drivers=self.provisioning._claim_drivers(bound_pods + pending),
+            )
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
+                log.debug("remote consolidation: kernel unsupported (%s)", e.details())
+                return None
+            raise
+
+        action = Action(response["action"])
+        if action == Action.DO_NOTHING:
+            return Command(Action.DO_NOTHING)
+        nodes_to_remove = [
+            node for name in response["nodesToRemove"]
+            if (node := self.kube_client.get_node(name)) is not None
+        ]
+        replacements = []
+        if response.get("replacements"):
+            # templates + catalogs are only needed to rebuild launchables —
+            # the common DELETE outcome skips the construction entirely
+            from karpenter_core_tpu.solver.tpu import TPUSolver
+
+            solver = TPUSolver(
+                self.cloud_provider, provisioners,
+                daemonset_pods=daemonset_pods,
+                kube_client=self.kube_client,
+            )
+            for entry in response["replacements"]:
+                pods = [
+                    bound_by_node[name][i]
+                    for name, i in entry.get("podRefs", [])
+                    if name in bound_by_node and i < len(bound_by_node[name])
+                ]
+                node = solver.launchable_from_wire(entry, pods)
+                if not node.instance_type_options:
+                    log.warning(
+                        "remote consolidation returned instance types unknown "
+                        "to this catalog; skipping the command this round"
+                    )
+                    return Command(Action.DO_NOTHING)
+                replacements.append(node)
+        return Command(action, nodes_to_remove=nodes_to_remove,
+                       replacement_nodes=replacements)
 
     def first_n_consolidation_option(
         self, candidates: List[CandidateNode], max_parallel: int
@@ -876,13 +982,14 @@ class DeprovisioningController:
         self.emptiness = Emptiness(clock, kube_client, cluster)
         self.empty_node_consolidation = EmptyNodeConsolidation(*base_args)
         self.multi_node_consolidation = MultiNodeConsolidation(*base_args)
-        # the consolidation sweep has no remote-solve path yet: when device
-        # solves ship to a shared solver service (provisioning.solver_endpoint,
-        # from KC_SOLVER_ADDRESS or set programmatically — CPU controller
-        # replicas, deploy/manifests), keep consolidation on the host binary
-        # search rather than compiling the sweep in-process
-        remote_solver = bool(getattr(provisioning, "solver_endpoint", ""))
-        self.multi_node_consolidation.use_tpu_kernel = use_tpu_kernel and not remote_solver
+        # device sweeps follow the provisioning controller's routing: with a
+        # solver service configured (KC_SOLVER_ADDRESS / solver_endpoint), the
+        # sweep ships over /Consolidate instead of compiling in-process on a
+        # CPU-only controller replica
+        self.multi_node_consolidation.use_tpu_kernel = use_tpu_kernel
+        self.multi_node_consolidation.solver_endpoint = getattr(
+            provisioning, "solver_endpoint", ""
+        )
         self.single_node_consolidation = SingleNodeConsolidation(*base_args)
         # test hook: invoked after replacements launch so suites can initialize
         # the nodes that the readiness wait polls for

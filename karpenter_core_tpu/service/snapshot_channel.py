@@ -141,6 +141,8 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             return grpc.unary_unary_rpc_method_handler(self._solve_classes)
         if method == f"/{SERVICE}/Health":
             return grpc.unary_unary_rpc_method_handler(self._health)
+        if method == f"/{SERVICE}/Consolidate":
+            return grpc.unary_unary_rpc_method_handler(self._consolidate)
         if method == f"/{SERVICE}/LeaseGet":
             return grpc.unary_unary_rpc_method_handler(self._lease_get)
         if method == f"/{SERVICE}/LeaseApply":
@@ -151,6 +153,105 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
 
     def _health(self, request: bytes, context) -> bytes:
         return msgpack.packb({"status": "ok"})
+
+    def _consolidate(self, request: bytes, context) -> bytes:
+        """Multi-node consolidation sweep on the device: every prefix of the
+        disruption-sorted candidate list simulated in parallel
+        (solver.consolidation.TPUConsolidationSearch).  Candidates reference
+        nodes shipped in the ``nodes`` envelope by name; replacements come
+        back as launchable entries whose pods are (nodeName, podIndex) refs
+        into the shipped per-node pod lists."""
+        from karpenter_core_tpu.controllers.deprovisioning import CandidateNode
+        from karpenter_core_tpu.solver.consolidation import TPUConsolidationSearch
+
+        try:
+            req = msgpack.unpackb(request)
+            provisioners, daemonset_pods, state_nodes, bound, resolver, node_pods = (
+                self._decode_common(req)
+            )
+            pending = [codec.pod_from_dict(p) for p in req.get("pendingPods", [])]
+            by_name = {sn.node.name: sn for sn in state_nodes}
+            prov_by_name = {p.name: p for p in provisioners}
+            its = {it.name: it for it in self.cloud_provider.get_instance_types(None)}
+            from karpenter_core_tpu.utils import pod as pod_util
+
+            def reschedulable(pods):
+                # node_util.get_node_pods parity: the envelope ships ALL pods
+                # (node utilization needs them) but CandidateNode.pods must be
+                # only what a deletion would actually displace
+                return [
+                    p for p in pods
+                    if not (
+                        pod_util.is_owned_by_node(p)
+                        or pod_util.is_owned_by_daemon_set(p)
+                        or pod_util.is_terminal(p)
+                        or pod_util.is_terminating(p)
+                    )
+                ]
+
+            candidates = []
+            for c in req.get("candidates", []):
+                sn = by_name.get(c["name"])
+                provisioner = prov_by_name.get(c["provisioner"])
+                if sn is None or provisioner is None:
+                    continue
+                candidates.append(CandidateNode(
+                    node=sn.node,
+                    state_node=sn,
+                    instance_type=its.get(c["instanceType"]),
+                    capacity_type=c.get("capacityType", ""),
+                    zone=c.get("zone", ""),
+                    provisioner=provisioner,
+                    disruption_cost=float(c.get("disruptionCost", 0.0)),
+                    pods=reschedulable(node_pods.get(c["name"], [])),
+                ))
+
+            search = TPUConsolidationSearch(self.cloud_provider, provisioners)
+            cmd = search.compute_command(
+                candidates, pending_pods=pending,
+                state_nodes=state_nodes, bound_pods=bound,
+            )
+
+            pod_ref = {}
+            for name, pods in node_pods.items():
+                for i, pod in enumerate(pods):
+                    pod_ref[id(pod)] = (name, i)
+
+            def domain_of(replacement, key) -> list:
+                requirements = replacement.requirements
+                if requirements.has(key):
+                    return list(requirements.get(key).values_list())
+                return []
+
+            from karpenter_core_tpu.apis import labels as labels_api
+
+            response = {
+                "action": cmd.action.value,
+                "nodesToRemove": [n.name for n in cmd.nodes_to_remove],
+                "replacements": [
+                    {
+                        "provisioner": r.provisioner_name,
+                        "instanceTypes": [it.name for it in r.instance_type_options],
+                        "zones": domain_of(r, labels_api.LABEL_TOPOLOGY_ZONE),
+                        # the sweep's price rules may pin spot-only
+                        # (consolidation.go:227-267 parity) — the launch must
+                        # keep that narrowing or an on-demand machine could
+                        # cost more than the nodes it replaces
+                        "capacityTypes": domain_of(r, labels_api.LABEL_CAPACITY_TYPE),
+                        "requests": {k: float(v) for k, v in r.requests.items()},
+                        "podRefs": [
+                            pod_ref[id(p)] for p in r.pods if id(p) in pod_ref
+                        ],
+                    }
+                    for r in cmd.replacement_nodes
+                ],
+            }
+            return msgpack.packb(response)
+        except KernelUnsupported as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, f"kernel unsupported: {e}")
+        except Exception as e:  # noqa: BLE001 - surface as INTERNAL
+            log.exception("consolidate request failed")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
 
     def _lease_get(self, request: bytes, context) -> bytes:
         req = msgpack.unpackb(request)
@@ -211,16 +312,20 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
         ]
         state_nodes = []
         bound = []
+        node_pods = {}
         for n in req.get("nodes", []):
             state_node = StateNode(codec.node_from_dict(n["node"]), resolver)
             for driver, limit in (n.get("volumeLimits") or {}).items():
                 state_node._volume_limits[driver] = int(limit)
+            pods_here = []
             for p in n.get("pods", []):
                 pod = codec.pod_from_dict(p)
                 state_node.update_for_pod(pod)
                 bound.append(pod)
+                pods_here.append(pod)
+            node_pods[state_node.node.name] = pods_here
             state_nodes.append(state_node)
-        return provisioners, daemonset_pods, state_nodes, bound, resolver
+        return provisioners, daemonset_pods, state_nodes, bound, resolver, node_pods
 
     def _solve_classes(self, request: bytes, context) -> bytes:
         from karpenter_core_tpu.models.snapshot import build_pod_ladder
@@ -235,7 +340,7 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 cls.pods = [rep] * int(entry["count"])
                 classes.append(cls)
             req_idx = {id(rep): i for i, rep in enumerate(reps)}
-            provisioners, daemonset_pods, state_nodes, bound, resolver = (
+            provisioners, daemonset_pods, state_nodes, bound, resolver, _ = (
                 self._decode_common(req)
             )
 
@@ -292,7 +397,7 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
         try:
             req = msgpack.unpackb(request)
             pods = [codec.pod_from_dict(p) for p in req.get("pods", [])]
-            provisioners, daemonset_pods, state_nodes, bound, resolver = (
+            provisioners, daemonset_pods, state_nodes, bound, resolver, _ = (
                 self._decode_common(req)
             )
 
@@ -356,11 +461,39 @@ class SnapshotSolverClient:
         self._solve = self.channel.unary_unary(f"/{SERVICE}/Solve")
         self._solve_classes = self.channel.unary_unary(f"/{SERVICE}/SolveClasses")
         self._health = self.channel.unary_unary(f"/{SERVICE}/Health")
+        self._consolidate = self.channel.unary_unary(f"/{SERVICE}/Consolidate")
         self._lease_get = self.channel.unary_unary(f"/{SERVICE}/LeaseGet")
         self._lease_apply = self.channel.unary_unary(f"/{SERVICE}/LeaseApply")
 
     def health(self) -> Dict:
         return msgpack.unpackb(self._health(msgpack.packb({})))
+
+    def consolidate(
+        self,
+        candidates: List[Dict],
+        pending_pods: List,
+        provisioners: List,
+        nodes: Optional[List[Dict]] = None,
+        claim_drivers: Optional[Dict[str, str]] = None,
+        timeout: float = 120.0,
+    ) -> Dict:
+        """Remote multi-node consolidation sweep.
+
+        ``candidates``: [{name, instanceType, capacityType, zone, provisioner,
+        disruptionCost}] in disruption order, referencing ``nodes`` entries by
+        name.  Returns the raw response: {action, nodesToRemove: [name],
+        replacements: [{provisioner, instanceTypes, zones, capacityTypes,
+        requests, podRefs: [[nodeName, podIndex]]}]}."""
+        request = msgpack.packb(
+            {
+                "candidates": candidates,
+                "pendingPods": [codec.pod_to_dict(p) for p in pending_pods],
+                "provisioners": [codec.provisioner_to_dict(p) for p in provisioners],
+                "nodes": nodes or [],
+                "claimDrivers": claim_drivers or {},
+            }
+        )
+        return msgpack.unpackb(self._consolidate(request, timeout=timeout))
 
     def lease_get(self, name: str, namespace: str = "", timeout: float = 5.0):
         response = msgpack.unpackb(

@@ -831,19 +831,21 @@ class TPUSolver:
 
     def launchable_from_wire(self, entry: dict, pods: List[Pod]) -> LaunchableNode:
         """to_launchable for a remote solve: the snapshot channel's newNodes
-        entry ({provisioner, instanceTypes, zones, requests}) instead of an
-        in-process decision.  No encode ran locally, so instance types resolve
-        against this solver's catalog by name (wire order preserved — it is
-        the decision's viability order from the serving side)."""
+        entry ({provisioner, instanceTypes, zones, capacityTypes?, requests})
+        instead of an in-process decision.  No encode ran locally, so instance
+        types resolve against this solver's catalog by name (wire order
+        preserved — it is the decision's viability order from the serving
+        side)."""
         return self._build_launchable(
             entry["provisioner"], list(entry.get("zones") or ()),
             list(entry.get("instanceTypes") or ()),
             {k: float(v) for k, v in (entry.get("requests") or {}).items()},
             pods,
+            capacity_types=list(entry.get("capacityTypes") or ()),
         )
 
     def _build_launchable(self, provisioner_name, zones, instance_type_names,
-                          requests, pods) -> LaunchableNode:
+                          requests, pods, capacity_types=()) -> LaunchableNode:
         from dataclasses import replace as dc_replace
 
         from karpenter_core_tpu.apis.objects import OP_IN
@@ -855,6 +857,11 @@ class TPUSolver:
         if zones:
             requirements.add(
                 Requirement(labels_api.LABEL_TOPOLOGY_ZONE, OP_IN, list(zones))
+            )
+        if capacity_types:
+            # consolidation's price rules may have pinned spot-only
+            requirements.add(
+                Requirement(labels_api.LABEL_CAPACITY_TYPE, OP_IN, list(capacity_types))
             )
         options = [
             self._it_by_name[name]

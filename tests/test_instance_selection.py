@@ -362,3 +362,92 @@ class TestOfferingExhaustion:
         # the kernel's node must launch at the on-demand floor, not the
         # cheaper spot price
         assert_cheapest(tpu, cts=["on-demand"])
+
+
+class TestResourceFitSweep:
+    """instance_selection_test.go:476-527 — the 7x7 cpu x mem grid: three
+    identical pods must always share ONE node whose every surviving instance
+    type has capacity for all three plus overhead, and scheduling must never
+    mutate the catalog's capacity maps."""
+
+    def test_enough_resources_grid(self):
+        import copy
+
+        from karpenter_core_tpu.operator.kubeclient import KubeClient
+        from karpenter_core_tpu.solver.builder import build_scheduler
+        from karpenter_core_tpu.utils import resources as resources_util
+
+        provider = fake_cp.FakeCloudProvider(fake_cp.instance_types_assorted())
+        catalog = provider.get_instance_types(None)
+        capacity_before = {it.name: copy.deepcopy(it.capacity) for it in catalog}
+
+        GI = 2**30
+        for cpu in (0.1, 1.0, 2, 2.5, 4, 8):
+            for mem_gi in (0.1, 1.0, 2, 4, 8, 16):
+                kube = KubeClient()
+                kube.create(make_provisioner())
+                pods = make_pods(3, requests={"cpu": cpu, "memory": mem_gi * GI})
+                scheduler = build_scheduler(
+                    kube, provider, None, pods, [], daemonset_pods=[]
+                )
+                results = scheduler.solve(pods)
+                if results.failed_pods:
+                    # 3x the largest shapes genuinely exceed the catalog
+                    assert 3 * cpu > 16 or 3 * mem_gi > 32
+                    continue
+                assert len(results.new_nodes) == 1, (cpu, mem_gi)
+                node = results.new_nodes[0]
+                total = {"cpu": 3 * cpu, "memory": 3 * mem_gi * GI}
+                for it in node.instance_type_options:
+                    alloc = it.allocatable()
+                    for r, want in total.items():
+                        have = resources_util.parse_quantity(alloc.get(r, 0))
+                        assert have >= want, (it.name, r, cpu, mem_gi)
+
+        for it in provider.get_instance_types(None):
+            assert it.capacity == capacity_before[it.name], (
+                f"scheduling mutated {it.name}'s capacity map"
+            )
+
+
+class TestSpotPriceOrdering:
+    """instance_selection_test.go:528-600 — an on-demand-only provisioner must
+    pick by ON-DEMAND price even when spot prices would order the catalog the
+    other way."""
+
+    def test_cheaper_on_demand_wins_despite_spot_ordering(self):
+        from karpenter_core_tpu.cloudprovider.types import Offering
+
+        catalog = [
+            fake_cp.new_instance_type(
+                "test-instance1",
+                architecture="amd64",
+                offerings=[
+                    Offering(capacity_type="on-demand", zone="test-zone-1", price=1.0, available=True),
+                    Offering(capacity_type="spot", zone="test-zone-1", price=0.2, available=True),
+                ],
+            ),
+            fake_cp.new_instance_type(
+                "test-instance2",
+                architecture="amd64",
+                offerings=[
+                    Offering(capacity_type="on-demand", zone="test-zone-1", price=1.3, available=True),
+                    Offering(capacity_type="spot", zone="test-zone-1", price=0.1, available=True),
+                ],
+            ),
+        ]
+        provisioners = [make_provisioner(requirements=[
+            NodeSelectorRequirement(CT, OP_IN, ["on-demand"]),
+        ])]
+        host, tpu = compare(lambda: tiny(1), provisioners=provisioners,
+                            instance_types=catalog)
+        for results in (host, tpu):
+            node = results.new_nodes[0]
+            names = (
+                [it.name for it in node.instance_type_options]
+                if hasattr(node, "instance_type_options")
+                else list(node.instance_type_names)
+            )
+            assert "test-instance1" in names, (
+                "the cheaper ON-DEMAND shape must survive selection"
+            )

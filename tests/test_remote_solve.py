@@ -95,6 +95,30 @@ class TestRemoteSolveRouting:
         # host path still schedules them (one per node: the port collides)
         assert all(result[p.uid] is not None for p in pods)
 
+    def test_consolidation_sweep_over_the_wire(self, tmp_path, monkeypatch):
+        """The deployed topology's consolidation path: the device subset
+        sweep runs on the solver service (/Consolidate), the controller
+        reconstructs and executes the command (suite parity with the
+        in-process sweep in test_service.py::TestTPUConsolidationInController)."""
+        from tests.test_tpu_consolidation import build_cluster
+        from karpenter_core_tpu.controllers.deprovisioning import Result
+        from karpenter_core_tpu.service.snapshot_channel import serve
+
+        monkeypatch.setenv("KC_LEASE_STATE", str(tmp_path / "leases.json"))
+        env = build_cluster(n_nodes=2, pods_per_node=1, pod_cpu="500m", oversize=True)
+        server, port = serve(env.provider, address="127.0.0.1:0")
+        try:
+            mnc = env.deprovisioning.multi_node_consolidation
+            mnc.use_tpu_kernel = True
+            mnc.solver_endpoint = f"127.0.0.1:{port}"
+            result, _ = env.deprovisioning.reconcile()
+            assert result == Result.SUCCESS
+            assert mnc._solver_client is not None, "sweep must have gone remote"
+            # consolidated: fewer nodes than before
+            assert len(env.kube.list_nodes()) == 1
+        finally:
+            server.stop(grace=0)
+
     def test_transport_fault_trips_the_circuit_breaker(self, tmp_path, monkeypatch):
         env = make_environment()
         env.provisioning.use_tpu_kernel = True

@@ -261,7 +261,7 @@ class TestWireSchema:
 
         assert SERVICE == "karpenter.v1.SnapshotSolver"
         service = SnapshotSolverService(FakeCloudProvider())
-        for method in ("Solve", "SolveClasses", "Health", "LeaseGet", "LeaseApply"):
+        for method in ("Solve", "SolveClasses", "Health", "Consolidate", "LeaseGet", "LeaseApply"):
 
             class _Details:
                 pass
