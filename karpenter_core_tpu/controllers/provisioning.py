@@ -223,6 +223,7 @@ class ProvisioningController:
         self._solver_client = None
         self._tpu_failures = 0
         self._warmup_started = False
+        self._warmup_thread: Optional[threading.Thread] = None
         from karpenter_core_tpu.utils.pretty import ChangeMonitor
 
         self._change_monitor = ChangeMonitor(ttl_seconds=3600.0)
@@ -274,7 +275,35 @@ class ProvisioningController:
             except Exception as e:  # noqa: BLE001 - warmup is best-effort
                 log.debug("speculative kernel warmup failed: %s", e)
 
-        threading.Thread(target=run, name="kc-tpu-warmup", daemon=True).start()
+        self._warmup_thread = threading.Thread(
+            target=run, name="kc-tpu-warmup", daemon=True
+        )
+        self._warmup_thread.start()
+        # interpreter finalization while the thread sits inside an XLA compile
+        # aborts the process (native exception during thread teardown); a
+        # bounded join at exit lets the compile finish first.  Registered
+        # through a weakref so a discarded controller isn't pinned (and its
+        # handler becomes a no-op) — Operator.stop() joins explicitly anyway.
+        import atexit
+        import weakref
+
+        ref = weakref.WeakMethod(self.join_warmup)
+
+        def _backstop() -> None:
+            join = ref()
+            if join is not None:
+                join()
+
+        atexit.register(_backstop)
+
+    def join_warmup(self, timeout: float = 120.0) -> None:
+        """Wait out an in-flight speculative compile.  Deployed shutdown paths
+        must pass a timeout below the pod's terminationGracePeriodSeconds or
+        the kubelet's SIGKILL lands mid-compile anyway (Operator.stop passes
+        15 s against the manifest's 30 s grace)."""
+        thread = self._warmup_thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout)
 
     # -- reconcile ------------------------------------------------------------
 

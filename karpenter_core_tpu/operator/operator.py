@@ -232,6 +232,12 @@ class Operator:
         if self.leader_elector is not None:
             self.leader_elector.stop()  # releases the lease for standbys
         self._stop_controllers()
+        # let an in-flight speculative compile finish: tearing the process
+        # down mid-compile aborts in native code.  Bounded WELL below the
+        # manifest's terminationGracePeriodSeconds (30 s) so the rest of
+        # shutdown always runs before the kubelet's SIGKILL.
+        if getattr(self, "provisioning", None) is not None:
+            self.provisioning.join_warmup(timeout=15.0)
         if self.http is not None:
             self.http.stop()
         self._started = False
