@@ -119,6 +119,100 @@ class TestRemoteSolveRouting:
         finally:
             server.stop(grace=0)
 
+    def test_wire_replacements_keep_capacity_type_pinning(self, tmp_path, monkeypatch):
+        """The sweep's price rules narrow a both-viable replacement to
+        SPOT-ONLY (consolidation.go:227-267 parity: on-demand candidates, an
+        unrestricted template); the wire round-trip must preserve that
+        narrowing, or the launch could buy on-demand above the replaced cost.
+        The scenario is constructed so the pin actually fires: hand-built
+        ON-DEMAND nodes under a provisioner that allows both capacity types —
+        the local command's CT set is strictly {spot}, not the template's."""
+        from karpenter_core_tpu.apis import labels as labels_api
+        from karpenter_core_tpu.cloudprovider import fake as fake_cp
+        from karpenter_core_tpu.controllers.deprovisioning import (
+            Action,
+            candidate_nodes,
+        )
+        from karpenter_core_tpu.service.snapshot_channel import serve
+        from karpenter_core_tpu.testing import make_node
+        from karpenter_core_tpu.testing.harness import make_environment
+
+        monkeypatch.setenv("KC_LEASE_STATE", str(tmp_path / "leases.json"))
+        env = make_environment(instance_types=fake_cp.instance_types(5))
+        env.kube.create(make_provisioner(consolidation_enabled=True))  # spot AND on-demand
+
+        # two oversized ON-DEMAND nodes, each holding one small pod
+        catalog = env.provider.get_instance_types(None)
+        big = catalog[-1]
+        offering = next(
+            o for o in big.offerings
+            if o.capacity_type == labels_api.CAPACITY_TYPE_ON_DEMAND and o.available
+        )
+        for i in range(2):
+            node = make_node(
+                name=f"od-{i}",
+                labels={
+                    labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                    labels_api.LABEL_INSTANCE_TYPE_STABLE: big.name,
+                    labels_api.LABEL_TOPOLOGY_ZONE: offering.zone,
+                    labels_api.LABEL_CAPACITY_TYPE: labels_api.CAPACITY_TYPE_ON_DEMAND,
+                },
+                allocatable=big.allocatable(),
+                capacity=dict(big.capacity),
+                provider_id=f"fake://od-{i}",
+            )
+            env.kube.create(node)
+            pod = make_pod(requests={"cpu": "300m"})
+            env.kube.create(pod)
+            env.bind(pod, node.name)
+        env.make_all_nodes_ready()
+        env.clock.step(21)
+
+        server, port = serve(env.provider, address="127.0.0.1:0")
+        try:
+            mnc = env.deprovisioning.multi_node_consolidation
+            mnc.use_tpu_kernel = True
+            candidates = sorted(
+                candidate_nodes(
+                    env.cluster, env.kube, env.clock, env.provider,
+                    mnc.should_deprovision,
+                ),
+                key=lambda c: c.disruption_cost,
+            )
+            assert len(candidates) == 2
+
+            from karpenter_core_tpu.solver.consolidation import TPUConsolidationSearch
+
+            local_cmd = TPUConsolidationSearch(
+                env.provider, env.kube.list_provisioners()
+            ).compute_command(
+                candidates,
+                pending_pods=[],
+                state_nodes=env.cluster.snapshot_nodes(),
+                bound_pods=env.kube.list_pods(),
+            )
+            assert local_cmd.action == Action.REPLACE
+
+            def cts(cmd):
+                requirements = cmd.replacement_nodes[0].requirements
+                if requirements.has(labels_api.LABEL_CAPACITY_TYPE):
+                    return set(requirements.get(labels_api.LABEL_CAPACITY_TYPE).values_list())
+                return set()
+
+            # the guard's precondition: the pin FIRED locally — the set is
+            # strictly narrower than the template's {spot, on-demand}
+            assert cts(local_cmd) == {labels_api.CAPACITY_TYPE_SPOT}
+
+            mnc.solver_endpoint = f"127.0.0.1:{port}"
+            remote_cmd = mnc._tpu_search(candidates)
+            assert remote_cmd is not None and remote_cmd.action == Action.REPLACE
+            assert cts(remote_cmd) == {labels_api.CAPACITY_TYPE_SPOT}
+            assert {n.name for n in remote_cmd.nodes_to_remove} == {
+                n.name for n in local_cmd.nodes_to_remove
+            }
+        finally:
+            server.stop(grace=0)
+
     def test_transport_fault_trips_the_circuit_breaker(self, tmp_path, monkeypatch):
         env = make_environment()
         env.provisioning.use_tpu_kernel = True

@@ -294,3 +294,54 @@ class TestEvictionQueueConcurrency:
         queue.drain_queue()
         for pod in pods:
             assert env.kube.get_pod(pod.namespace, pod.name) is None
+
+
+class TestLeasePlaneRaces:
+    """The solver-hosted lease plane under contention (snapshot_channel
+    /LeaseApply): CAS must serialize concurrent writers over real gRPC the
+    way the in-memory KubeClient does in-process — monotonic versions, at
+    most one winner per expected-version token."""
+
+    def test_concurrent_cas_single_winner_per_version(self, tmp_path, monkeypatch):
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_core_tpu.service.snapshot_channel import (
+            SnapshotSolverClient,
+            serve,
+        )
+
+        monkeypatch.setenv("KC_LEASE_STATE", str(tmp_path / "leases.json"))
+        server, port = serve(FakeCloudProvider(), address="127.0.0.1:0", max_workers=8)
+        try:
+            seed = SnapshotSolverClient(f"127.0.0.1:{port}")
+            assert seed.lease_apply({"name": "kc-hammer", "holderIdentity": "seed"})["ok"]
+
+            n_threads, rounds = 6, 30
+            wins = [0] * n_threads
+
+            def hammer(i: int) -> None:
+                client = SnapshotSolverClient(f"127.0.0.1:{port}")
+                for _ in range(rounds):
+                    stored = client.lease_get("kc-hammer")
+                    response = client.lease_apply(
+                        {"name": "kc-hammer", "holderIdentity": f"t{i}",
+                         "renewTime": float(stored["resourceVersion"])},
+                        expected_version=stored["resourceVersion"],
+                    )
+                    if response["ok"]:
+                        wins[i] += 1
+                        # the server must have advanced exactly one step
+                        assert response["lease"]["resourceVersion"] == (
+                            stored["resourceVersion"] + 1
+                        )
+
+            run_threads(hammer, n=n_threads)
+
+            final = seed.lease_get("kc-hammer")
+            # every successful CAS advanced the version exactly once: the final
+            # version is the seed's 1 plus the total number of wins
+            assert final["resourceVersion"] == 1 + sum(wins)
+            # no thread starved out entirely (all-30-round starvation needs an
+            # intervening write in every single get->apply window)
+            assert min(wins) >= 1
+        finally:
+            server.stop(grace=0)
