@@ -9,6 +9,7 @@ taints, host ports, topology spread, pod (anti-)affinity, relaxation, limits.
 
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import (
+    OP_DOES_NOT_EXIST,
     OP_EXISTS,
     OP_GT,
     OP_IN,
@@ -464,3 +465,74 @@ class TestInstanceSelection:
         results = solve([pod])
         assert scheduled_count(results) == 1
         assert results.new_nodes[0].requirements.get(CT).values_list() == ["spot"]
+
+
+class TestSelectorOperatorSemantics:
+    """suite_test.go:400-470 — the undefined/defined-key × operator grid.
+    An undefined key (no provisioner requirement, no well-known label) is
+    schedulable only under NotIn/DoesNotExist; a defined key flips every
+    outcome."""
+
+    def _solves(self, requirement) -> bool:
+        pod = make_pod(requests={"cpu": "100m"}, node_requirements=[requirement])
+        results = solve([pod])
+        return not results.failed_pods
+
+    def test_in_with_undefined_key_fails(self):
+        assert not self._solves(
+            NodeSelectorRequirement("undefined.example/key", OP_IN, ["v"])
+        )
+
+    def test_not_in_with_undefined_key_schedules(self):
+        assert self._solves(
+            NodeSelectorRequirement("undefined.example/key", OP_NOT_IN, ["v"])
+        )
+
+    def test_exists_with_undefined_key_fails(self):
+        assert not self._solves(
+            NodeSelectorRequirement("undefined.example/key", OP_EXISTS)
+        )
+
+    def test_does_not_exist_with_undefined_key_schedules(self):
+        assert self._solves(
+            NodeSelectorRequirement("undefined.example/key", OP_DOES_NOT_EXIST)
+        )
+
+    def _solves_with_defined_key(self, requirement) -> bool:
+        pod = make_pod(requests={"cpu": "100m"}, node_requirements=[requirement])
+        provisioner = make_provisioner(requirements=[
+            NodeSelectorRequirement("defined.example/key", OP_IN, ["v1", "v2"])
+        ])
+        results = solve([pod], provisioners=[provisioner])
+        return not results.failed_pods
+
+    def test_in_matching_value_schedules(self):
+        assert self._solves_with_defined_key(
+            NodeSelectorRequirement("defined.example/key", OP_IN, ["v1"])
+        )
+
+    def test_in_different_value_fails(self):
+        assert not self._solves_with_defined_key(
+            NodeSelectorRequirement("defined.example/key", OP_IN, ["other"])
+        )
+
+    def test_not_in_matching_value_narrows_but_schedules(self):
+        # NotIn v1 leaves v2: still schedulable
+        assert self._solves_with_defined_key(
+            NodeSelectorRequirement("defined.example/key", OP_NOT_IN, ["v1"])
+        )
+
+    def test_not_in_all_values_fails(self):
+        assert not self._solves_with_defined_key(
+            NodeSelectorRequirement("defined.example/key", OP_NOT_IN, ["v1", "v2"])
+        )
+
+    def test_exists_with_defined_key_schedules(self):
+        assert self._solves_with_defined_key(
+            NodeSelectorRequirement("defined.example/key", OP_EXISTS)
+        )
+
+    def test_does_not_exist_with_defined_key_fails(self):
+        assert not self._solves_with_defined_key(
+            NodeSelectorRequirement("defined.example/key", OP_DOES_NOT_EXIST)
+        )

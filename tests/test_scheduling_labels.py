@@ -1,0 +1,186 @@
+"""Well-known-labels grid from the reference's main scheduling suite
+(/root/reference/pkg/controllers/provisioning/scheduling/suite_test.go:159-346):
+provisioner constraints flow onto launched node labels, selectors compose
+with requirements and preferences, incompatible preferences relax away, and
+multidimensional combinations intersect.
+"""
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    NodeSelectorRequirement,
+)
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
+
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+ITYPE = labels_api.LABEL_INSTANCE_TYPE_STABLE
+INTEGER_KEY = fake_cp.INTEGER_INSTANCE_LABEL_KEY
+
+
+def env_with(requirements=None):
+    env = make_environment()
+    env.kube.create(make_provisioner(requirements=requirements))
+    return env
+
+
+def scheduled_node(env, **pod_kwargs):
+    pod = make_pod(requests={"cpu": "100m"}, **pod_kwargs)
+    result = expect_provisioned(env, pod)
+    return result[pod.uid]
+
+
+class TestWellKnownLabels:
+    def test_provisioner_constraints_flow_to_node_labels(self):
+        env = env_with([NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-2"])])
+        node = scheduled_node(env)
+        assert node is not None
+        assert node.metadata.labels[ZONE] == "test-zone-2"
+
+    def test_node_selector_narrows_provisioner_constraints(self):
+        env = env_with([NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1", "test-zone-2"])])
+        node = scheduled_node(env, node_selector={ZONE: "test-zone-2"})
+        assert node is not None
+        assert node.metadata.labels[ZONE] == "test-zone-2"
+
+    def test_hostname_selector_never_schedules(self):
+        env = env_with()
+        node = scheduled_node(
+            env, node_selector={labels_api.LABEL_HOSTNAME: "red-node"}
+        )
+        assert node is None
+
+    def test_unknown_selector_value_fails(self):
+        env = env_with([NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1"])])
+        assert scheduled_node(env, node_selector={ZONE: "unknown"}) is None
+
+    def test_selector_outside_provisioner_constraints_fails(self):
+        env = env_with([NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1"])])
+        assert scheduled_node(env, node_selector={ZONE: "test-zone-2"}) is None
+
+    def test_compatible_in_requirement_schedules(self):
+        env = env_with()
+        node = scheduled_node(env, node_requirements=[
+            NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-3"])
+        ])
+        assert node is not None and node.metadata.labels[ZONE] == "test-zone-3"
+
+    def test_gt_requirement_picks_larger_integer_label(self):
+        # suite_test.go:214-222: Gt 8 over the catalog's {2, 4, 16} -> 16
+        env = env_with([NodeSelectorRequirement(INTEGER_KEY, OP_GT, ["8"])])
+        node = scheduled_node(env)
+        assert node is not None
+        assert int(node.metadata.labels[INTEGER_KEY]) == 16
+
+    def test_lt_requirement_picks_smaller_integer_label(self):
+        # suite_test.go:223-231: Lt 8 over {2, 4, 16} -> the cheapest (2)
+        env = env_with([NodeSelectorRequirement(INTEGER_KEY, OP_LT, ["8"])])
+        node = scheduled_node(env)
+        assert node is not None
+        assert int(node.metadata.labels[INTEGER_KEY]) == 2
+
+    def test_incompatible_in_requirement_fails(self):
+        env = env_with()
+        assert scheduled_node(env, node_requirements=[
+            NodeSelectorRequirement(ZONE, OP_IN, ["unknown"])
+        ]) is None
+
+    def test_not_in_requirement_leaves_remaining_zone(self):
+        env = env_with()
+        node = scheduled_node(env, node_requirements=[
+            NodeSelectorRequirement(ZONE, OP_NOT_IN,
+                                    ["test-zone-1", "test-zone-2", "unknown"])
+        ])
+        assert node is not None and node.metadata.labels[ZONE] == "test-zone-3"
+
+    def test_not_in_requirement_excluding_all_fails(self):
+        env = env_with()
+        assert scheduled_node(env, node_requirements=[
+            NodeSelectorRequirement(
+                ZONE, OP_NOT_IN,
+                ["test-zone-1", "test-zone-2", "test-zone-3", "unknown"],
+            )
+        ]) is None
+
+
+class TestPreferenceRequirementInterplay:
+    """suite_test.go:260-346 — preferences narrow when compatible, relax away
+    when they would make the pod unschedulable."""
+
+    def test_compatible_in_preference_narrows(self):
+        env = env_with()
+        node = scheduled_node(
+            env,
+            node_requirements=[NodeSelectorRequirement(
+                ZONE, OP_IN, ["test-zone-1", "test-zone-2", "test-zone-3", "unknown"])],
+            node_preferences=[NodeSelectorRequirement(
+                ZONE, OP_IN, ["test-zone-2", "unknown"])],
+        )
+        assert node is not None and node.metadata.labels[ZONE] == "test-zone-2"
+
+    def test_incompatible_in_preference_relaxes_away(self):
+        env = env_with()
+        node = scheduled_node(
+            env,
+            node_requirements=[NodeSelectorRequirement(
+                ZONE, OP_IN, ["test-zone-1", "test-zone-2", "test-zone-3", "unknown"])],
+            node_preferences=[NodeSelectorRequirement(ZONE, OP_IN, ["unknown"])],
+        )
+        assert node is not None
+
+    def test_compatible_not_in_preference_narrows(self):
+        env = env_with()
+        node = scheduled_node(
+            env,
+            node_requirements=[NodeSelectorRequirement(
+                ZONE, OP_IN, ["test-zone-1", "test-zone-2", "test-zone-3", "unknown"])],
+            node_preferences=[NodeSelectorRequirement(
+                ZONE, OP_NOT_IN, ["test-zone-1", "test-zone-3"])],
+        )
+        assert node is not None and node.metadata.labels[ZONE] == "test-zone-2"
+
+    def test_incompatible_not_in_preference_relaxes_away(self):
+        env = env_with()
+        node = scheduled_node(
+            env,
+            node_requirements=[NodeSelectorRequirement(
+                ZONE, OP_IN, ["test-zone-1", "test-zone-2", "test-zone-3", "unknown"])],
+            node_preferences=[NodeSelectorRequirement(
+                ZONE, OP_NOT_IN, ["test-zone-1", "test-zone-2", "test-zone-3"])],
+        )
+        assert node is not None
+
+    def test_selector_preferences_and_requirements_compose(self):
+        env = env_with()
+        node = scheduled_node(
+            env,
+            node_selector={ZONE: "test-zone-3"},
+            node_requirements=[NodeSelectorRequirement(
+                ZONE, OP_IN, ["test-zone-1", "test-zone-2", "test-zone-3"])],
+            node_preferences=[NodeSelectorRequirement(
+                ZONE, OP_IN, ["test-zone-1", "test-zone-2", "test-zone-3"])],
+        )
+        assert node is not None and node.metadata.labels[ZONE] == "test-zone-3"
+
+    def test_multidimensional_combination(self):
+        env = env_with()
+        node = scheduled_node(
+            env,
+            node_selector={ZONE: "test-zone-3", ITYPE: "arm-instance-type"},
+            node_requirements=[
+                NodeSelectorRequirement(ZONE, OP_IN, ["test-zone-1", "test-zone-3"]),
+                NodeSelectorRequirement(
+                    ITYPE, OP_IN, ["default-instance-type", "arm-instance-type"]),
+            ],
+            node_preferences=[
+                NodeSelectorRequirement(ZONE, OP_NOT_IN, ["unknown"]),
+                NodeSelectorRequirement(ITYPE, OP_NOT_IN, ["unknown"]),
+            ],
+        )
+        assert node is not None
+        assert node.metadata.labels[ZONE] == "test-zone-3"
+        assert node.metadata.labels[ITYPE] == "arm-instance-type"
