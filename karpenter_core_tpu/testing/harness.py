@@ -126,6 +126,16 @@ def make_environment(
     return env
 
 
+def nominations(recorder) -> Dict[str, str]:
+    """pod uid -> nominated node name, parsed from the recorder's Nominated
+    events (the one place the event-message format is interpreted)."""
+    out: Dict[str, str] = {}
+    for event in recorder.events:
+        if event.reason == "Nominated":
+            out[event.involved_object.uid] = event.message.rsplit(" ", 1)[-1]
+    return out
+
+
 def expect_provisioned(env: Environment, *pods: Pod) -> Dict[str, Optional[Node]]:
     """Create pods, run one provisioning pass, bind nominated pods; returns
     pod uid -> bound Node (None when unscheduled)."""
@@ -135,16 +145,11 @@ def expect_provisioned(env: Environment, *pods: Pod) -> Dict[str, Optional[Node]
     env.recorder.reset()
     env.provisioning.reconcile(wait_for_batch=False)
 
-    nominations: Dict[str, str] = {}
-    for event in env.recorder.events:
-        if event.reason == "Nominated":
-            pod = event.involved_object
-            node_name = event.message.rsplit(" ", 1)[-1]
-            nominations[pod.uid] = node_name
+    nominations_by_uid = nominations(env.recorder)
 
     out: Dict[str, Optional[Node]] = {}
     for pod in pods:
-        node_name = nominations.get(pod.uid)
+        node_name = nominations_by_uid.get(pod.uid)
         if node_name is None:
             out[pod.uid] = None
             continue

@@ -345,3 +345,82 @@ class TestLeasePlaneRaces:
             assert sum(wins) >= n_threads
         finally:
             server.stop(grace=0)
+
+
+class TestOperatorSoak:
+    """Sustained churn through the REAL operator on real threads: waves of
+    pods arrive, get scheduled and bound, then vanish — the loop the
+    reference's controllers run for days.  Asserts every wave converges with
+    no warning events and that the operator's own threads wind down at stop
+    (leak detection for the singleton/watch plumbing)."""
+
+    def test_churn_waves_stay_healthy(self):
+        import time as wall
+
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_core_tpu.operator.operator import Operator
+        from karpenter_core_tpu.operator.settings import Settings
+        from karpenter_core_tpu.testing.harness import nominations
+
+        operator = Operator(
+            cloud_provider=FakeCloudProvider(),
+            settings=Settings(batch_idle_duration=0.05, batch_max_duration=0.2),
+        ).with_controllers()
+        operator.start()
+        try:
+            operator.kube_client.create(make_provisioner())
+            for wave in range(5):
+                pods = [
+                    make_pod(name=f"soak-{wave}-{i}", requests={"cpu": "200m"})
+                    for i in range(12)
+                ]
+                for pod in pods:
+                    operator.kube_client.create(pod)
+
+                # count only THIS wave's pods: a late event for a deleted
+                # prior-wave pod must not fake convergence
+                wave_uids = {p.uid for p in pods}
+                deadline = wall.time() + 15
+                nominated = {}
+                while wall.time() < deadline and len(nominated) < len(pods):
+                    nominated = {
+                        uid: name
+                        for uid, name in nominations(operator.recorder).items()
+                        if uid in wave_uids
+                    }
+                    wall.sleep(0.05)
+                assert len(nominated) == len(pods), (
+                    f"wave {wave}: only {len(nominated)} of {len(pods)} nominated"
+                )
+                warnings = [e for e in operator.recorder.events if e.type == "Warning"]
+                assert not warnings, f"wave {wave}: {warnings[:3]}"
+                # bind like kube-scheduler, then clear the wave
+                for pod in pods:
+                    node_name = nominated.get(pod.uid)
+                    if node_name and operator.kube_client.get_node(node_name):
+                        pod.spec.node_name = node_name
+                        operator.kube_client.apply(pod)
+                for pod in pods:
+                    operator.kube_client.delete(pod, force=True)
+                operator.recorder.reset()
+                assert operator.healthy() and operator.ready()
+        finally:
+            operator.stop()
+
+        # the operator's own (daemon, controller-named) threads must wind
+        # down after stop; allow the loops a moment to observe their events
+        operator_thread_names = {
+            "provisioning", "deprovisioning", "metrics_state", "inflightchecks",
+            "node", "provisioning_trigger", "counter", "leader-election",
+        }
+        deadline = wall.time() + 5
+        leaked = set()
+        while wall.time() < deadline:
+            leaked = {
+                t.name for t in threading.enumerate()
+                if t.is_alive() and t.name in operator_thread_names
+            }
+            if not leaked:
+                break
+            wall.sleep(0.1)
+        assert not leaked, f"operator threads still alive after stop: {leaked}"
