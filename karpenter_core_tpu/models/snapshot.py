@@ -639,6 +639,19 @@ def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
         raise KernelUnsupported("combined zone spread + zone anti-affinity not kernel-supported")
     if cls.host_affinity is not None and (cls.zone_spread is not None or cls.zone_anti is not None):
         raise KernelUnsupported("combined hostname affinity + zonal spread/anti not kernel-supported")
+    # required zonal anti-affinity routes to the host oracle outright: the
+    # host's iterative pass keeps narrowing an anti node's possible zones as
+    # later pods co-locate onto it, retroactively de-poisoning other zones —
+    # the forward scan snapshots "could be in any zone" at the class's own
+    # step (zone_full recording) and can schedule fewer pods whenever that
+    # narrowing would have helped (found by tests/test_parity_fuzz.py; the
+    # no-shape-schedules-fewer contract demands the explicit route).  These
+    # classes are intrinsically tiny — pessimistic committal caps them near
+    # one pod per batch — so the host path costs nothing at scale.  Soft
+    # (preferred) zonal anti stays in-kernel: preferences never block, so
+    # there is nothing to under-schedule.
+    if cls.zone_anti is not None and not cls.zone_anti_soft:
+        raise KernelUnsupported("required zonal anti-affinity not kernel-supported")
 
 
 def encode_snapshot(

@@ -788,7 +788,11 @@ class TPUSolver:
             cursors[r] = cursor
         # leftovers: spread_suspect classes (any ladder row) hand their pods to
         # the host re-route instead of failing them outright — the kernel could
-        # not prove the water-fill matched the host oracle for those shapes
+        # not prove the water-fill matched the host oracle for those shapes.
+        # (Required zonal anti never reaches the kernel: the iterative host
+        # retroactively narrows anti nodes' zones as other pods co-locate,
+        # which the forward scan cannot replay — classify routes it,
+        # models/snapshot.py.)
         suspect_root = [False] * n_classes
         if suspect is not None:
             for c in range(n_classes):

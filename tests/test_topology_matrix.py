@@ -581,9 +581,12 @@ class TestPodAntiAffinity:
         assert all(len(n.pods) == 1 for n in tpu.new_nodes)
 
     def test_zone_anti_affinity_not_violated(self):
-        # pessimistic late committal: one zonal-anti pod schedules per batch
-        # on both paths (verify-doc expected quirk, matching the reference's
-        # "could be in any zone" domain recording)
+        # required zonal anti routes to the host oracle outright: the host's
+        # iterative pass retroactively narrows anti nodes' zones as other
+        # pods co-locate, which the forward scan cannot replay (the explicit
+        # route the no-shape-schedules-fewer contract demands; found by
+        # tests/test_parity_fuzz.py).  Host behavior: pessimistic late
+        # committal, one pod per batch, no two placed pods share a zone.
         def pods():
             return make_pods(
                 2, labels={"app": "db"}, requests={"cpu": "10m"},
@@ -595,11 +598,11 @@ class TestPodAntiAffinity:
                 ],
             )
 
-        host, tpu = compare(pods)
-        placed_zones = [n.zones for n in tpu.new_nodes if n.pods]
-        # no two scheduled anti pods share a zone
-        flat = [z for zones in placed_zones for z in zones]
-        assert len(flat) == len(set(flat))
+        with pytest.raises(KernelUnsupported):
+            classify_pods(pods())
+        host = host_solve(pods(), [make_provisioner()])
+        assert sum(len(n.pods) for n in host.new_nodes) == 1
+        assert len(host.failed_pods) == 1
 
     def test_inverse_anti_affinity_blocks_target(self):
         # topology_test.go:1677 — an anti-affinity OWNER repels the pods its
