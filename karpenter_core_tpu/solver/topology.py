@@ -321,15 +321,27 @@ class Topology:
         """Tighten node requirements with each matching topology's next-domain
         selection (topology.go:149-167)."""
         requirements = Requirements(*node_requirements.values())
-        for topology in self._matching_topologies(p, node_requirements):
+        # deliberate refinement over topology.go:149-167: each group reads the
+        # ACCUMULATED requirements (not the original nodeRequirements), and
+        # exclusion groups (anti / inverse) apply before min-picking spreads.
+        # The reference hands every group the original domains and iterates a
+        # Go map, so a spread sharing a key with an anti exclusion picks its
+        # min domain blind — whether the pod schedules depends on random map
+        # order (a spread min-pick inside the excluded zone intersects to
+        # empty).  Threading the narrowing makes the coin toss deterministic
+        # in the direction that schedules; every group's constraint is still
+        # applied exactly.
+        matching = self._matching_topologies(p, node_requirements)
+        matching.sort(key=lambda tc: 0 if tc.type == TopologyType.POD_ANTI_AFFINITY else 1)
+        for topology in matching:
             pod_domains = (
                 pod_requirements.get(topology.key)
                 if pod_requirements.has(topology.key)
                 else Requirement(topology.key, OP_EXISTS)
             )
             node_domains = (
-                node_requirements.get(topology.key)
-                if node_requirements.has(topology.key)
+                requirements.get(topology.key)
+                if requirements.has(topology.key)
                 else Requirement(topology.key, OP_EXISTS)
             )
             domains = topology.get(p, pod_domains, node_domains)

@@ -56,26 +56,14 @@ class Environment:
             node.status.conditions.append(NodeCondition(type="Ready", status="True"))
         else:
             ready.status = "True"
-        it_name = node.metadata.labels.get(labels_api.LABEL_INSTANCE_TYPE_STABLE)
-        it = next(
-            (t for t in self.provider.get_instance_types(None) if t.name == it_name),
-            None,
-        )
-        if it is not None and (not node.status.allocatable or not node.status.capacity):
-            node.status.capacity = dict(it.capacity)
-            node.status.allocatable = it.allocatable()
+        if not node.status.allocatable or not node.status.capacity:
+            it_name = node.metadata.labels.get(labels_api.LABEL_INSTANCE_TYPE_STABLE)
+            for it in self.provider.get_instance_types(None):
+                if it.name == it_name:
+                    node.status.capacity = dict(it.capacity)
+                    node.status.allocatable = it.allocatable()
+                    break
         node.metadata.labels.setdefault(labels_api.LABEL_HOSTNAME, node.name)
-        # kubelet registration also stamps the concrete topology domain: a
-        # node launched under a multi-zone requirement lands in ONE real zone
-        # (the provider picks it).  Without this, a Schrödinger anti node
-        # never resolves and cross-batch convergence (topology_test.go:1713's
-        # second batch) cannot happen in the harness.  Only zones the
-        # provider could actually have launched in qualify (available
-        # offerings — an ICE'd zone must not resolve the node).
-        if it is not None and labels_api.LABEL_TOPOLOGY_ZONE not in node.metadata.labels:
-            available = it.offerings.available()
-            if available:
-                node.metadata.labels[labels_api.LABEL_TOPOLOGY_ZONE] = available[0].zone
         self.kube.apply(node)
         self.node_lifecycle.reconcile(node)
 

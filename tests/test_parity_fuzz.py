@@ -151,7 +151,7 @@ def controller_solve(seed: int, use_kernel: bool):
     return env, pods, scheduled
 
 
-@pytest.mark.parametrize("seed", range(24))
+@pytest.mark.parametrize("seed", range(40))
 def test_fuzzed_batch_parity(seed):
     """The contract the controller ships: per class, the kernel path (split +
     residual re-route) schedules exactly as many pods as the host oracle.
@@ -205,4 +205,53 @@ def test_fuzzed_batch_parity(seed):
             assert second.get(cls, 0) >= host.get(cls, 0), (
                 f"seed {seed} {cls}: anti class did not converge by batch two: "
                 f"{second.get(cls, 0)} < host's {host.get(cls, 0)}"
+            )
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzzed_batch_parity_with_existing_nodes(seed):
+    """Same contract over a WARM cluster: wave one provisions through the
+    host path in both environments (identical starting nodes, made ready so
+    zones/hostnames are registered), then wave two — a fresh fuzzed batch —
+    runs kernel-path vs host-path.  This exercises the existing-node planes
+    (encode_existing: capacity deltas, zone commitments, port/volume usage,
+    bound-pod topology seeding), which the empty-cluster fuzz never touches."""
+    wave_one = 100 + seed  # a different deterministic batch than wave two
+    anti_classes, host_aff_classes = committal_classes(seed)
+
+    def warm_env(use_kernel: bool):
+        env = make_environment()
+        for provisioner in provisioners_for(seed):
+            env.kube.create(provisioner)
+        env.provisioning.use_tpu_kernel = False  # identical wave-one clusters
+        first = random_batch(wave_one)
+        expect_provisioned(env, *first)
+        env.make_all_nodes_ready()
+        env.clock.step(21)
+        env.provisioning.use_tpu_kernel = use_kernel
+        env.provisioning.tpu_kernel_min_pods = 1
+        pods = random_batch(seed)
+        result = expect_provisioned(env, *pods)
+        scheduled = Counter()
+        for pod in pods:
+            if result[pod.uid] is not None:
+                scheduled[pod.metadata.labels["app"]] += 1
+        return scheduled
+
+    host = warm_env(use_kernel=False)
+    tpu = warm_env(use_kernel=True)
+    for cls in set(host) | set(tpu):
+        if cls in anti_classes:
+            assert tpu.get(cls, 0) <= host.get(cls, 0), (
+                f"seed {seed} {cls}: anti class over host on warm cluster: "
+                f"tpu={tpu.get(cls, 0)} host={host.get(cls, 0)}"
+            )
+        elif cls in host_aff_classes:
+            assert (tpu.get(cls, 0) > 0) == (host.get(cls, 0) > 0), (
+                f"seed {seed} {cls}: warm hostname-affinity schedulability "
+                f"diverged: tpu={tpu.get(cls, 0)} host={host.get(cls, 0)}"
+            )
+        else:
+            assert tpu.get(cls, 0) == host.get(cls, 0), (
+                f"seed {seed} {cls}: warm tpu={dict(tpu)} host={dict(host)}"
             )
