@@ -2,7 +2,7 @@
 
 PYTEST ?= python -m pytest
 
-presubmit: verify test kernel-smoke  ## everything a PR needs to pass
+presubmit: verify test kernel-smoke perf-gate  ## everything a PR needs to pass
 
 verify:  ## static checks: bytecode-compile, lint gate, build the native library
 	python -m compileall -q karpenter_core_tpu tests bench.py __graft_entry__.py
@@ -18,8 +18,11 @@ test-all:  ## everything incl. the compile-heavy kernel/parity tier (~25 min)
 kernel-smoke:  ## bounded kernel gate for presubmit: a parity slice compiles + solves (~1 min)
 	$(PYTEST) tests/test_tpu_solver.py -x -q -k "homogeneous or two_sizes or pod_count_limit"
 
-perf:  ## performance-gated tests (reference: //go:build test_performance)
+perf: perf-gate  ## performance-gated tests (reference: //go:build test_performance)
 	KC_TPU_PERF=1 $(PYTEST) tests/test_performance.py -q
+
+perf-gate:  ## round-over-round drift gate: bench vs last same-platform BENCH_r*.json
+	python tools/perfgate.py
 
 bench:  ## headline benchmark on the available accelerator
 	python bench.py
@@ -27,4 +30,4 @@ bench:  ## headline benchmark on the available accelerator
 graft-check:  ## driver contract: compile check + multi-chip dry run
 	python __graft_entry__.py
 
-.PHONY: presubmit verify test test-all kernel-smoke perf bench graft-check
+.PHONY: presubmit verify test test-all kernel-smoke perf perf-gate bench graft-check

@@ -714,11 +714,15 @@ def encode_snapshot(
                 resources.append(name)
 
     # -- vocabulary -----------------------------------------------------------
-    req_sets = [cls.requirements for cls in classes]
-    req_sets += [it.requirements for it in all_its]
-    req_sets += [tmpl.requirements for tmpl in templates]
-    req_sets += list(extra_requirement_sets or [])
-    vocab = Vocabulary.build(req_sets)
+    # demand side defines the keys; catalog/node labels only widen the value
+    # lists of keys the demand side references (Vocabulary.build docstring) —
+    # the kernel's mask compute scales with the widest key, so supply-only
+    # label families (e.g. a per-instance serial label) must not enter
+    demand_sets = [cls.requirements for cls in classes]
+    demand_sets += [tmpl.requirements for tmpl in templates]
+    supply_sets = [it.requirements for it in all_its]
+    supply_sets += list(extra_requirement_sets or [])
+    vocab = Vocabulary.build(demand_sets, supply_sets=supply_sets)
 
     snap = EncodedSnapshot(
         vocab=vocab,

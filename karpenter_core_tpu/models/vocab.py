@@ -76,23 +76,43 @@ class Vocabulary:
         cls,
         requirement_sets: Iterable[Requirements],
         exclude_keys: Tuple[str, ...] = STRUCTURAL_KEYS,
+        supply_sets: Iterable[Requirements] = (),
     ) -> "Vocabulary":
+        """``requirement_sets`` are demand-side (pods/classes, provisioner
+        templates): they define which keys exist.  ``supply_sets`` are
+        supply-side (instance-type requirements, existing-node labels): they
+        only widen the value lists of keys the demand side already references.
+
+        A key no demand-side set defines can never deny compatibility — the
+        reference's denial paths (empty intersection, or the custom-key
+        denied-if-undefined rule, requirements.go:115-131) both require the
+        pod/template side to carry the key — so admitting supply-only keys
+        would spend mask width (and kernel compute, which is quadratic in the
+        widest key) on planes whose checks are vacuously true.  The fake
+        catalog's per-instance ``integer`` label is the canonical offender:
+        1000 instance types otherwise cost a [*, K, 1001] mask encoding."""
+
+        def widen(bucket: Dict[str, None], r: Requirement) -> None:
+            for v in r.values:
+                bucket.setdefault(v, None)
+            # materialize small finite Gt/Lt ranges so bounded-integer
+            # requirements stay exact under the mask encoding
+            if r.greater_than is not None and r.less_than is not None:
+                lo, hi = r.greater_than + 1, r.less_than
+                if 0 < hi - lo <= 64:
+                    for i in range(lo, hi):
+                        bucket.setdefault(str(i), None)
+
         values: Dict[str, Dict[str, None]] = {}
         for reqs in requirement_sets:
             for key in reqs.keys():
                 if key in exclude_keys:
                     continue
-                bucket = values.setdefault(key, {})
-                r = reqs.get(key)
-                for v in r.values:
-                    bucket.setdefault(v, None)
-                # materialize small finite Gt/Lt ranges so bounded-integer
-                # requirements stay exact under the mask encoding
-                if r.greater_than is not None and r.less_than is not None:
-                    lo, hi = r.greater_than + 1, r.less_than
-                    if 0 < hi - lo <= 64:
-                        for i in range(lo, hi):
-                            bucket.setdefault(str(i), None)
+                widen(values.setdefault(key, {}), reqs.get(key))
+        for reqs in supply_sets:
+            for key in reqs.keys():
+                if key in values:
+                    widen(values[key], reqs.get(key))
         keys = sorted(values)
         return cls(keys=keys, values={k: list(v) for k, v in values.items()})
 

@@ -92,6 +92,27 @@ def cache_dir() -> str:
     )
 
 
+def _machine_tag() -> str:
+    """Fingerprint of the host's CPU capability set.  XLA:CPU executables in
+    the persistent cache are AOT-compiled for the build machine's features;
+    loading one on a different microarchitecture logs cpu_aot_loader errors
+    and risks SIGILL or gather/scatter-averse code generated for the other
+    machine.  The XLA cache is therefore segmented per machine tag, while the
+    exported-StableHLO cache stays shared (StableHLO is portable)."""
+    import platform as platform_mod
+
+    basis = platform_mod.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    basis += line
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(basis.encode()).hexdigest()[:10]
+
+
 def enable() -> None:
     """Idempotently turn on the persistent XLA compilation cache and register
     the kernel pytree types for jax.export serialization."""
@@ -100,7 +121,7 @@ def enable() -> None:
 
     with _lock:
         if not _enabled:
-            directory = cache_dir()
+            directory = os.path.join(cache_dir(), f"xla-{_machine_tag()}")
             os.makedirs(directory, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", directory)
             # persist even fast compiles: over the axon relay a "fast" compile

@@ -1,0 +1,59 @@
+"""Vocabulary demand/supply split (models/vocab.py).
+
+The kernel's mask compute is quadratic in the widest key, so supply-side
+label families (catalog labels no requirement references — e.g. the fake
+provider's per-instance ``integer`` label, fake.go's integer instance label
+analog) must not define vocabulary keys; they may only widen the value lists
+of keys the demand side (pods/classes, provisioner templates) references.
+An unreferenced key can never deny compatibility: both denial paths (empty
+intersection; the custom-key denied-if-undefined rule, requirements.go:115-131)
+require the demand side to carry the key.
+"""
+
+from karpenter_core_tpu.models.vocab import Vocabulary
+from karpenter_core_tpu.scheduling import Requirement, Requirements
+from karpenter_core_tpu.apis.objects import OP_IN, OP_GT, OP_NOT_IN
+
+
+def reqs(*rs: Requirement) -> Requirements:
+    return Requirements(*rs)
+
+
+class TestDemandSupplySplit:
+    def test_supply_only_keys_are_excluded(self):
+        demand = [reqs(Requirement("team", OP_IN, ["a"]))]
+        supply = [
+            reqs(Requirement("integer", OP_IN, [str(i)])) for i in range(100)
+        ]
+        v = Vocabulary.build(demand, supply_sets=supply)
+        assert v.keys == ["team"]
+        assert v.width == 2  # one value + other slot, not 101
+
+    def test_supply_widens_demand_referenced_keys(self):
+        # NotIn/Gt exactness needs node/catalog values representable once the
+        # demand side references the key
+        demand = [reqs(Requirement("integer", OP_GT, ["30"]))]
+        supply = [reqs(Requirement("integer", OP_IN, [str(i)])) for i in (10, 40)]
+        v = Vocabulary.build(demand, supply_sets=supply)
+        assert v.keys == ["integer"]
+        assert set(v.values["integer"]) >= {"10", "40"}
+
+    def test_demand_values_always_present(self):
+        demand = [reqs(Requirement("size", OP_NOT_IN, ["small"]))]
+        v = Vocabulary.build(demand, supply_sets=[])
+        assert v.values["size"] == ["small"]
+
+    def test_templates_count_as_demand(self):
+        # encode_snapshot passes template requirements as demand: a
+        # provisioner restricting a custom key keeps that key exact
+        from karpenter_core_tpu.cloudprovider import fake as fake_cp
+        from karpenter_core_tpu.models.snapshot import encode_snapshot
+        from karpenter_core_tpu.testing import make_pod, make_provisioner
+        from karpenter_core_tpu.solver.tpu import TPUSolver
+
+        provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(30))
+        solver = TPUSolver(provider, [make_provisioner()])
+        snapshot = solver.encode([make_pod(requests={"cpu": "1"})])
+        # the catalog's per-instance integer label must not define a key
+        assert "integer" not in snapshot.vocab.keys
+        assert snapshot.vocab.width <= 8

@@ -1,0 +1,140 @@
+"""Per-round performance regression gate (VERDICT r4 #1c).
+
+Runs the headline bench and compares pods/sec against the most recent
+``BENCH_r*.json`` recorded on the same platform; fails (exit 1) on a drop
+beyond the tolerance.  The reference gates every CI run the same way
+(scheduling_benchmark_test.go:178-182); its floor check alone is meaningless
+here — a 50x cushion never trips — so this gate tracks drift round-over-round.
+
+Cross-machine honesty: bench records carry a ``machine`` fingerprint
+(utils/compilecache._machine_tag).  When the last same-platform record came
+from a different machine the tolerance widens (observed cross-machine spread
+on the same code is ~15%), so the gate still catches collapses without
+flagging hardware variance as regressions.
+
+Usage: python tools/perfgate.py [--tolerance 0.05] [--record path.json]
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+sys.path.insert(0, REPO)
+from bench import _probe_once  # noqa: E402 - canonical bounded backend probe
+
+
+def _probe_platform(timeout_s: float = 45.0):
+    """One bounded probe for a live accelerator; None means dead/hung."""
+    platform, _ = _probe_once(timeout_s)
+    return platform
+
+
+def run_bench() -> dict:
+    """Run bench.py with backend pre-pinned by a single bounded probe (the
+    bench's own 5x60s probe ladder is for the driver's unattended run)."""
+    env = dict(os.environ)
+    platform = _probe_platform()
+    if platform is None:
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("AXON_POOL_SVC_OVERRIDE", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["KC_BENCH_BACKEND_STATE"] = json.dumps({
+            "platform": "cpu", "attempts": 1, "fell_back": True,
+            "probe_failures": ["perfgate probe found no live accelerator"],
+        })
+    else:
+        env["KC_BENCH_BACKEND_STATE"] = json.dumps({
+            "platform": platform, "attempts": 1, "fell_back": False,
+            "probe_failures": [],
+        })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=1800, env=env, cwd=REPO,
+    )
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    try:
+        return json.loads(line)
+    except json.JSONDecodeError:
+        sys.stderr.write(proc.stderr[-2000:])
+        raise SystemExit(f"bench produced no JSON line (rc={proc.returncode})")
+
+
+def last_record(platform: str):
+    """Newest BENCH_r*.json whose detail.platform matches, by round number."""
+    best = None
+    for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        # driver-written records wrap the bench line under "parsed"
+        rec = rec.get("parsed") or rec
+        detail = rec.get("detail") or {}
+        if detail.get("platform") != platform:
+            continue
+        if detail.get("pods_per_sec") is None:
+            continue
+        rnd = int(m.group(1))
+        if best is None or rnd > best[0]:
+            best = (rnd, path, rec)
+    return best
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="allowed fractional drop vs last same-platform, same-machine record")
+    ap.add_argument("--cross-machine-tolerance", type=float, default=0.20,
+                    help="allowed drop when the last record came from another machine")
+    ap.add_argument("--record", default=None,
+                    help="also write the fresh bench line to this path")
+    args = ap.parse_args()
+
+    rec = run_bench()
+    detail = rec.get("detail") or {}
+    platform = detail.get("platform")
+    pods_per_sec = detail.get("pods_per_sec")
+    if pods_per_sec is None:
+        print(json.dumps(rec))
+        print("perfgate: FAIL (bench produced no pods_per_sec)")
+        return 1
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump(rec, f)
+
+    prior = last_record(platform)
+    if prior is None:
+        print(f"perfgate: PASS (no prior {platform} record; "
+              f"current {pods_per_sec} pods/s)")
+        return 0
+    rnd, path, prev = prior
+    prev_pps = prev["detail"]["pods_per_sec"]
+    same_machine = (
+        detail.get("machine") is not None
+        and detail.get("machine") == (prev.get("detail") or {}).get("machine")
+    )
+    tol = args.tolerance if same_machine else args.cross_machine_tolerance
+    floor = prev_pps * (1.0 - tol)
+    verdict = "PASS" if pods_per_sec >= floor else "FAIL"
+    print(
+        f"perfgate: {verdict} — {pods_per_sec} pods/s on {platform} vs "
+        f"{prev_pps} in {os.path.basename(path)} (round {rnd}, "
+        f"{'same' if same_machine else 'different'} machine, "
+        f"tolerance {tol:.0%}, floor {floor:.0f})"
+    )
+    return 0 if verdict == "PASS" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
