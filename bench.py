@@ -99,6 +99,45 @@ def acquire_backend(max_attempts: int = 5, probe_timeout_s: float = 60.0,
     _reexec_on_cpu()
 
 
+def run_pinned(platform: str, timeout_s: float = 1800.0, extra_env=None) -> dict:
+    """Run this bench in a subprocess with the backend verdict pre-pinned
+    (skipping the probe ladder) and parse its one JSON line.  The shared
+    helper behind tools/perfgate.py and tools/tpu_watch.py — the pinning
+    contract and output format live in exactly one place.
+
+    ``platform="cpu"`` also scrubs the relay env vars and forces
+    JAX_PLATFORMS=cpu (same scrub as ``_reexec_on_cpu``).  Returns an
+    ``{"error": ...}`` dict instead of raising on a dead/hung/garbled run.
+    """
+    env = dict(os.environ)
+    fell_back = platform == "cpu"
+    if fell_back:
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("AXON_POOL_SVC_OVERRIDE", None)
+        env["JAX_PLATFORMS"] = "cpu"
+    env["KC_BENCH_BACKEND_STATE"] = json.dumps({
+        "platform": platform, "attempts": 1, "fell_back": fell_back,
+        "probe_failures": ["pinned by caller"] if fell_back else [],
+    })
+    env.update(extra_env or {})
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"bench hung past {timeout_s:.0f}s (killed)"}
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    try:
+        return json.loads(line)
+    except json.JSONDecodeError:
+        return {
+            "error": f"bench produced no JSON line (rc={proc.returncode})",
+            "stderr": proc.stderr[-1000:],
+        }
+
+
 def _reexec_on_cpu() -> None:
     """Replace this process with a CPU-pinned copy of itself.
 

@@ -35,7 +35,10 @@ import numpy as np
 from karpenter_core_tpu.models.snapshot import EncodedSnapshot, UNLIMITED
 from karpenter_core_tpu.ops import masks as mask_ops
 
-BIG = jnp.float32(1e30)
+# plain numpy scalar: a jnp literal here would initialize the device backend
+# at import time (and hang any process whose preferred backend is unreachable
+# before it can pin itself to CPU — __graft_entry__._ensure_live_backend)
+BIG = np.float32(1e30)
 
 
 class NodeState(NamedTuple):

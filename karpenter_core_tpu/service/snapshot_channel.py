@@ -366,6 +366,7 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                         "provisioner": n.provisioner_name,
                         "instanceTypes": n.instance_type_names,
                         "zones": n.zones,
+                        "capacityTypes": n.capacity_types,
                         "requests": n.requests,
                         "classCounts": class_counts(n.pods),
                     }
@@ -414,6 +415,7 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                         "provisioner": n.provisioner_name,
                         "instanceTypes": n.instance_type_names,
                         "zones": n.zones,
+                        "capacityTypes": n.capacity_types,
                         "requests": n.requests,
                         "podIndices": [pod_index[p.uid] for p in n.pods if p.uid in pod_index],
                     }

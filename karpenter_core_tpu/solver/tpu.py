@@ -38,21 +38,23 @@ class _LazyPlanes:
     the host-side pod-assignment decode; the big bool planes ship bit-packed
     (the device link is a tunnel — bandwidth, not latency, is the cost)."""
 
-    __slots__ = ("_viable_p", "_zone_p", "_used_d", "_n_it", "_n_zones",
-                 "_viable", "_zone", "_used")
+    __slots__ = ("_viable_p", "_zone_p", "_ct_p", "_used_d", "_n_it",
+                 "_n_zones", "_n_ct", "_viable", "_zone", "_ct", "_used")
 
     def __init__(self, state) -> None:
         self._n_it = state.viable.shape[-1]
         self._n_zones = state.zone.shape[-1]
+        self._n_ct = state.ct.shape[-1]
         self._viable_p = solve_ops.pack_bool(state.viable)
         self._zone_p = solve_ops.pack_bool(state.zone)
+        self._ct_p = solve_ops.pack_bool(state.ct)
         self._used_d = state.used
-        self._viable = self._zone = self._used = None
+        self._viable = self._zone = self._ct = self._used = None
 
     def prefetch(self) -> None:
         """Start async device→host copies.  Called *after* the solve's eager
         fetch so the big planes don't queue ahead of it on the relay."""
-        for arr in (self._viable_p, self._zone_p, self._used_d):
+        for arr in (self._viable_p, self._zone_p, self._ct_p, self._used_d):
             try:
                 arr.copy_to_host_async()
             except AttributeError:  # non-jax (already host) arrays
@@ -60,15 +62,16 @@ class _LazyPlanes:
 
     def _fetch(self) -> None:
         if self._viable is None:
-            viable_p, zone_p, used = jax.device_get(
-                (self._viable_p, self._zone_p, self._used_d)
+            viable_p, zone_p, ct_p, used = jax.device_get(
+                (self._viable_p, self._zone_p, self._ct_p, self._used_d)
             )
             self._viable = solve_ops.unpack_bool(viable_p, self._n_it)
             self._zone = solve_ops.unpack_bool(zone_p, self._n_zones)
+            self._ct = solve_ops.unpack_bool(ct_p, self._n_ct)
             self._used = used
             # release the device buffers — node decisions can outlive the
             # solve (launch path), and holding both copies doubles memory
-            self._viable_p = self._zone_p = self._used_d = None
+            self._viable_p = self._zone_p = self._ct_p = self._used_d = None
 
     @property
     def viable(self) -> np.ndarray:
@@ -79,6 +82,11 @@ class _LazyPlanes:
     def zone(self) -> np.ndarray:
         self._fetch()
         return self._zone
+
+    @property
+    def ct(self) -> np.ndarray:
+        self._fetch()
+        return self._ct
 
     @property
     def used(self) -> np.ndarray:
@@ -111,6 +119,11 @@ class TPUNodeDecision:
     def zones(self) -> List[str]:
         row = self._planes.zone[self._slot]
         return [self._snapshot.zones[z] for z in np.nonzero(row)[0]]
+
+    @property
+    def capacity_types(self) -> List[str]:
+        row = self._planes.ct[self._slot]
+        return [self._snapshot.capacity_types[c] for c in np.nonzero(row)[0]]
 
     @property
     def requests(self) -> resources_util.ResourceList:
@@ -831,6 +844,11 @@ class TPUSolver:
         return self._build_launchable(
             decision.provisioner_name, decision.zones,
             decision.instance_type_names, decision.requests, decision.pods,
+            # the pods' merged capacity-type requirement must ride the launch
+            # exactly like zones (node.go:62-117 merge): without it the
+            # provider's cheapest-offering pick can land an on-demand-required
+            # pod on spot (found by testing/validator.py over fuzz seeds)
+            capacity_types=decision.capacity_types,
         )
 
     def launchable_from_wire(self, entry: dict, pods: List[Pod]) -> LaunchableNode:

@@ -105,7 +105,8 @@ def _machine_tag() -> str:
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
-                if line.startswith("flags"):
+                # x86 spells it "flags", aarch64 "Features"
+                if line.startswith(("flags", "Features")):
                     basis += line
                     break
     except OSError:

@@ -26,6 +26,7 @@ from karpenter_core_tpu.apis.objects import (
 )
 from karpenter_core_tpu.testing import make_pod, make_provisioner
 from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
+from karpenter_core_tpu.testing.validator import expect_valid_placements
 
 pytestmark = pytest.mark.compile  # every seed compiles + solves both engines
 
@@ -150,7 +151,11 @@ def committal_classes(seed: int):
 def controller_solve(seed: int, use_kernel: bool):
     """One provisioning pass through the REAL controller (split + kernel +
     residual re-route when use_kernel, pure host oracle otherwise); returns
-    (env, pods, per-class scheduled counts)."""
+    (env, pods, per-class scheduled counts).  Every decoded placement from
+    EITHER engine must pass the independent validity oracle
+    (testing/validator.py) — count parity alone would accept the right number
+    of pods in the wrong places (VERDICT r4 #2; the oracle's first run caught
+    the kernel launching on-demand-required pods on spot offerings)."""
     env = make_environment()
     for provisioner in provisioners_for(seed):
         env.kube.create(provisioner)
@@ -158,6 +163,7 @@ def controller_solve(seed: int, use_kernel: bool):
     env.provisioning.tpu_kernel_min_pods = 1
     pods = random_batch(seed)
     result = expect_provisioned(env, *pods)
+    expect_valid_placements(env, pods)
     scheduled = Counter()
     for pod in pods:
         if result[pod.uid] is not None:
@@ -228,6 +234,7 @@ def test_fuzzed_batch_parity(seed):
         env.make_all_nodes_ready()
         env.clock.step(21)
         result = expect_provisioned(env, *pods)
+        expect_valid_placements(env, pods)
         second = Counter(tpu)  # batch-one placements stay bound...
         for pod in pods:
             if result[pod.uid] is not None:  # ...plus batch-two's new ones
@@ -263,6 +270,7 @@ def test_fuzzed_batch_parity_with_existing_nodes(seed):
         env.provisioning.tpu_kernel_min_pods = 1
         pods = random_batch(seed)
         result = expect_provisioned(env, *pods)
+        expect_valid_placements(env, pods)
         scheduled = Counter()
         for pod in pods:
             if result[pod.uid] is not None:

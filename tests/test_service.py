@@ -286,7 +286,8 @@ class TestWireSchema:
             }
             node = response["newNodes"][0]
             assert set(node) == {
-                "provisioner", "instanceTypes", "zones", "requests", "podIndices",
+                "provisioner", "instanceTypes", "zones", "capacityTypes",
+                "requests", "podIndices",
             }
         finally:
             client.close()

@@ -1,0 +1,161 @@
+"""Self-tests for the independent placement-validity oracle
+(testing/validator.py).
+
+The oracle guards the fuzzer's count-parity contract against
+right-count-wrong-place failures, so it must itself be proven in both
+directions: clean placements pass (the fuzz/parity suites assert that on
+every seed), and — these tests — deliberately broken bindings FAIL.  A
+validator that never fires is indistinguishable from no validator.
+"""
+
+import pytest
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_IN,
+    LabelSelector,
+    NodeSelectorRequirement,
+    PodAffinityTerm,
+    Taint,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+from karpenter_core_tpu.testing.harness import make_environment
+from karpenter_core_tpu.testing.validator import validate_placements
+
+ZONE = labels_api.LABEL_TOPOLOGY_ZONE
+CT = labels_api.LABEL_CAPACITY_TYPE
+
+
+def env_with_node(zone="test-zone-1", ct="on-demand", taints=None, cpu="4"):
+    env = make_environment()
+    env.kube.create(make_provisioner())
+    node = make_node(
+        labels={
+            labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+            ZONE: zone,
+            CT: ct,
+        },
+        allocatable={"cpu": cpu, "memory": "8Gi", "pods": 110},
+        taints=taints or [],
+    )
+    env.kube.create(node)
+    return env, node
+
+
+def bind(env, pod, node):
+    env.kube.create(pod)
+    env.bind(pod, node.name)
+
+
+class TestValidatorCatches:
+    def test_clean_placement_passes(self):
+        env, node = env_with_node()
+        bind(env, make_pod(requests={"cpu": "1"}), node)
+        assert validate_placements(env) == []
+
+    def test_over_capacity(self):
+        env, node = env_with_node(cpu="2")
+        for _ in range(3):
+            bind(env, make_pod(requests={"cpu": "1"}), node)
+        violations = validate_placements(env)
+        assert any("over allocatable" in v for v in violations), violations
+
+    def test_untolerated_taint(self):
+        env, node = env_with_node(taints=[Taint(key="gpu", value="true")])
+        bind(env, make_pod(requests={"cpu": "1"}), node)
+        violations = validate_placements(env)
+        assert any("not tolerated" in v for v in violations), violations
+
+    def test_node_requirement_mismatch(self):
+        env, node = env_with_node(ct="spot")
+        pod = make_pod(
+            requests={"cpu": "1"},
+            node_requirements=[NodeSelectorRequirement(CT, OP_IN, ["on-demand"])],
+        )
+        bind(env, pod, node)
+        violations = validate_placements(env)
+        assert any("node affinity unsatisfied" in v for v in violations), violations
+
+    def test_host_port_conflict(self):
+        env, node = env_with_node()
+        for _ in range(2):
+            bind(env, make_pod(requests={"cpu": "1"}, host_ports=[8080]), node)
+        violations = validate_placements(env)
+        assert any("host port" in v for v in violations), violations
+
+    def test_zone_anti_affinity_colocated(self):
+        env, node = env_with_node()
+        term = PodAffinityTerm(
+            topology_key=ZONE,
+            label_selector=LabelSelector(match_labels={"app": "x"}),
+        )
+        for _ in range(2):
+            bind(
+                env,
+                make_pod(
+                    labels={"app": "x"}, requests={"cpu": "1"},
+                    pod_anti_affinity=[term],
+                ),
+                node,
+            )
+        violations = validate_placements(env)
+        assert any("anti-affinity" in v for v in violations), violations
+
+    def test_affinity_without_target(self):
+        env, node_a = env_with_node(zone="test-zone-1")
+        node_b = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                ZONE: "test-zone-2",
+                CT: "on-demand",
+            },
+            allocatable={"cpu": "4", "memory": "8Gi", "pods": 110},
+        )
+        env.kube.create(node_b)
+        bind(env, make_pod(labels={"app": "target"}, requests={"cpu": "1"}), node_a)
+        follower = make_pod(
+            requests={"cpu": "1"},
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key=ZONE,
+                    label_selector=LabelSelector(match_labels={"app": "target"}),
+                )
+            ],
+        )
+        bind(env, follower, node_b)  # wrong zone: target lives in zone-1
+        violations = validate_placements(env)
+        assert any("pod affinity" in v for v in violations), violations
+
+    def test_zone_spread_skew_violation(self):
+        env, node_a = env_with_node(zone="test-zone-1")
+        constraint = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=ZONE,
+            label_selector=LabelSelector(match_labels={"app": "s"}),
+        )
+        # all three spread members piled into one zone while zone-2/3 offer
+        # capacity -> skew 3 > maxSkew 1
+        for _ in range(3):
+            bind(
+                env,
+                make_pod(
+                    labels={"app": "s"}, requests={"cpu": "100m"},
+                    topology_spread=[constraint],
+                ),
+                node_a,
+            )
+        violations = validate_placements(env)
+        assert any("zone spread skew" in v for v in violations), violations
+
+
+@pytest.mark.parametrize("seed", [0, 4, 11])
+def test_clean_controller_output_validates(seed):
+    """End-to-end sanity on the real controller (host path, fast tier)."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_parity_fuzz import controller_solve
+
+    env, pods, _ = controller_solve(seed, use_kernel=False)
+    assert validate_placements(env, pods) == []

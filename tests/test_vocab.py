@@ -47,7 +47,6 @@ class TestDemandSupplySplit:
         # encode_snapshot passes template requirements as demand: a
         # provisioner restricting a custom key keeps that key exact
         from karpenter_core_tpu.cloudprovider import fake as fake_cp
-        from karpenter_core_tpu.models.snapshot import encode_snapshot
         from karpenter_core_tpu.testing import make_pod, make_provisioner
         from karpenter_core_tpu.solver.tpu import TPUSolver
 

@@ -20,50 +20,24 @@ import glob
 import json
 import os
 import re
-import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 sys.path.insert(0, REPO)
-from bench import _probe_once  # noqa: E402 - canonical bounded backend probe
-
-
-def _probe_platform(timeout_s: float = 45.0):
-    """One bounded probe for a live accelerator; None means dead/hung."""
-    platform, _ = _probe_once(timeout_s)
-    return platform
+from bench import _probe_once, run_pinned  # noqa: E402 - shared probe/run contract
 
 
 def run_bench() -> dict:
     """Run bench.py with backend pre-pinned by a single bounded probe (the
     bench's own 5x60s probe ladder is for the driver's unattended run)."""
-    env = dict(os.environ)
-    platform = _probe_platform()
-    if platform is None:
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.pop("AXON_POOL_SVC_OVERRIDE", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["KC_BENCH_BACKEND_STATE"] = json.dumps({
-            "platform": "cpu", "attempts": 1, "fell_back": True,
-            "probe_failures": ["perfgate probe found no live accelerator"],
-        })
-    else:
-        env["KC_BENCH_BACKEND_STATE"] = json.dumps({
-            "platform": platform, "attempts": 1, "fell_back": False,
-            "probe_failures": [],
-        })
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py")],
-        capture_output=True, text=True, timeout=1800, env=env, cwd=REPO,
-    )
-    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
-    try:
-        return json.loads(line)
-    except json.JSONDecodeError:
-        sys.stderr.write(proc.stderr[-2000:])
-        raise SystemExit(f"bench produced no JSON line (rc={proc.returncode})")
+    platform, _ = _probe_once(45.0)
+    rec = run_pinned(platform or "cpu")
+    if "error" in rec:
+        sys.stderr.write(rec.get("stderr", "") + "\n")
+        raise SystemExit(f"perfgate bench run failed: {rec['error']}")
+    return rec
 
 
 def last_record(platform: str):

@@ -15,7 +15,6 @@ Run it in the background for the round; it exits after --max-hours.
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -24,29 +23,12 @@ OUT = os.path.join(REPO, "BENCH_TPU_OPPORTUNISTIC.json")
 
 
 sys.path.insert(0, REPO)
-from bench import _probe_once  # noqa: E402 - canonical bounded backend probe
+from bench import _probe_once, run_pinned  # noqa: E402 - shared probe/run contract
 
 
 def probe(timeout_s: float = 60.0):
     platform, _ = _probe_once(timeout_s)
     return platform
-
-
-def run_bench(platform: str):
-    env = dict(os.environ)
-    env["KC_BENCH_BACKEND_STATE"] = json.dumps({
-        "platform": platform, "attempts": 1, "fell_back": False,
-        "probe_failures": [],
-    })
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py")],
-        capture_output=True, text=True, timeout=3600, env=env, cwd=REPO,
-    )
-    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
-    try:
-        return json.loads(line)
-    except json.JSONDecodeError:
-        return {"error": f"bench rc={proc.returncode}", "stderr": proc.stderr[-1000:]}
 
 
 def main() -> int:
@@ -56,20 +38,24 @@ def main() -> int:
     args = ap.parse_args()
     deadline = time.monotonic() + args.max_hours * 3600
     recorded = 0
+
+    def sleep_until(seconds: float) -> None:
+        time.sleep(max(0.0, min(seconds, deadline - time.monotonic())))
+
     while time.monotonic() < deadline:
         platform = probe()
         if platform and platform != "cpu":
             print(f"[tpu_watch] live {platform} backend; running bench", flush=True)
-            rec = run_bench(platform)
+            rec = run_pinned(platform)  # error-dict on hang/garble, never raises
             rec["recorded_at_unix"] = int(time.time())
             with open(OUT, "a") as f:
                 f.write(json.dumps(rec) + "\n")
             recorded += 1
             print(f"[tpu_watch] appended record {recorded} to {OUT}", flush=True)
             # one good record per hour is plenty; back off hard
-            time.sleep(3600)
+            sleep_until(3600)
         else:
-            time.sleep(args.interval)
+            sleep_until(args.interval)
     print(f"[tpu_watch] done: {recorded} TPU-stamped records", flush=True)
     return 0
 
