@@ -223,6 +223,7 @@ class ProvisioningController:
         self._solver_client = None
         self._tpu_failures = 0
         self._warmup_started = False
+        self._warmup_lock = threading.Lock()
         self._warmup_thread: Optional[threading.Thread] = None
         from karpenter_core_tpu.utils.pretty import ChangeMonitor
 
@@ -240,19 +241,26 @@ class ProvisioningController:
         KC_TPU_WARMUP=0 opts out (tests do — they meter compiles)."""
         if self._warmup_started or not self.use_tpu_kernel:
             return
-        if self.solver_endpoint:
-            # remote solves: the solver service owns (and persists) its own
-            # compiled executables; nothing to warm in this process
-            self._warmup_started = True
-            return
-        import os
+        # test-and-set under a lock: trigger() runs concurrently from watch
+        # and batcher threads, and an unguarded check-then-set could start two
+        # warmup compiles and track (and later join) only one — leaving the
+        # other inside an XLA compile at interpreter teardown (ADVICE r4 #3)
+        with self._warmup_lock:
+            if self._warmup_started:
+                return
+            if self.solver_endpoint:
+                # remote solves: the solver service owns (and persists) its
+                # own compiled executables; nothing to warm in this process
+                self._warmup_started = True
+                return
+            import os
 
-        if os.environ.get("KC_TPU_WARMUP", "1") == "0":
+            if os.environ.get("KC_TPU_WARMUP", "1") == "0":
+                self._warmup_started = True
+                return
+            if not self.kube_client.list_provisioners():
+                return  # nothing to compile against yet; retry later
             self._warmup_started = True
-            return
-        if not self.kube_client.list_provisioners():
-            return  # nothing to compile against yet; retry on a later trigger
-        self._warmup_started = True
 
         def run() -> None:
             try:
@@ -275,10 +283,9 @@ class ProvisioningController:
             except Exception as e:  # noqa: BLE001 - warmup is best-effort
                 log.debug("speculative kernel warmup failed: %s", e)
 
-        self._warmup_thread = threading.Thread(
-            target=run, name="kc-tpu-warmup", daemon=True
-        )
-        self._warmup_thread.start()
+        thread = threading.Thread(target=run, name="kc-tpu-warmup", daemon=True)
+        self._warmup_thread = thread
+        thread.start()
         # interpreter finalization while the thread sits inside an XLA compile
         # aborts the process (native exception during thread teardown); a
         # bounded join at exit lets the compile finish first.  Registered
@@ -607,21 +614,24 @@ class ProvisioningController:
 
         tpu_results = TPUSolveResults()
         launchables = []
+        catalog_skew_pods: List[Pod] = []
         for entry in response["newNodes"]:
             node = solver.launchable_from_wire(
                 entry, [tpu_pods[i] for i in entry["podIndices"]]
             )
             if not node.instance_type_options:
                 # catalog skew between this replica and the solver (image
-                # rollout): nothing launchable — fail the pods this round
-                # rather than launching an unconstrained machine; catalogs
-                # converge as the rollout completes
+                # rollout): nothing launchable from the wire's instance-type
+                # names.  Re-route the pods through the host residual path —
+                # the local oracle can still place them with whatever catalog
+                # THIS replica has — rather than failing real workload pods
+                # every reconcile until the rollout converges (ADVICE r4 #4)
                 log.warning(
                     "remote solve returned instance types unknown to this "
-                    "catalog (%s); failing %d pods for this batch",
+                    "catalog (%s); re-routing %d pods to the host oracle",
                     entry.get("instanceTypes", [])[:3], len(node.pods),
                 )
-                tpu_results.failed_pods.extend(node.pods)
+                catalog_skew_pods.extend(node.pods)
                 continue
             launchables.append(node)
         tpu_results.existing_assignments = {
@@ -633,7 +643,7 @@ class ProvisioningController:
         )
         tpu_results.spread_residual_pods = [
             tpu_pods[i] for i in response.get("residualPodIndices", [])
-        ]
+        ] + catalog_skew_pods
         tpu_results.existing_committed_zones = dict(
             response.get("existingCommittedZones", {})
         )

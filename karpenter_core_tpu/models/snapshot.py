@@ -639,19 +639,13 @@ def _derive_topology_spec(pod: Pod, cls: PodClass) -> None:
         raise KernelUnsupported("combined zone spread + zone anti-affinity not kernel-supported")
     if cls.host_affinity is not None and (cls.zone_spread is not None or cls.zone_anti is not None):
         raise KernelUnsupported("combined hostname affinity + zonal spread/anti not kernel-supported")
-    # required zonal anti-affinity routes to the host oracle outright: the
-    # host's iterative pass keeps narrowing an anti node's possible zones as
-    # later pods co-locate onto it, retroactively de-poisoning other zones —
-    # the forward scan snapshots "could be in any zone" at the class's own
-    # step (zone_full recording) and can schedule fewer pods whenever that
-    # narrowing would have helped (found by tests/test_parity_fuzz.py; the
-    # no-shape-schedules-fewer contract demands the explicit route).  These
-    # classes are intrinsically tiny — pessimistic committal caps them near
-    # one pod per batch — so the host path costs nothing at scale.  Soft
-    # (preferred) zonal anti stays in-kernel: preferences never block, so
-    # there is nothing to under-schedule.
-    if cls.zone_anti is not None and not cls.zone_anti_soft:
-        raise KernelUnsupported("required zonal anti-affinity not kernel-supported")
+    # required zonal anti-affinity IS kernel-supported (since round 5): the
+    # scan derives per-zone counts from nodes' CURRENT zone masks at every
+    # class step (ops/solve.TopoCounts) and the owned-anti phases are
+    # zone-committal (one member per admissible zone, the node pinned to it),
+    # reaching the host's batch-two fixpoint in batch one.  encode_snapshot
+    # adds min(count, zones) scan passes for these classes so later
+    # de-poisoning (co-location narrowing) is replayed to quiescence.
 
 
 def encode_snapshot(
@@ -702,6 +696,23 @@ def encode_snapshot(
                 capacity_types.append(off.capacity_type)
     zones = sorted(zones)
     capacity_types = sorted(capacity_types)
+
+    # required zonal anti-affinity converges one pod per pass (pessimistic
+    # committal: a placed member poisons every zone its node could be in until
+    # co-location narrows the mask) — give each such class enough passes to
+    # reach the host's retry-to-quiescence fixpoint; progress caps at one pod
+    # per distinct zone, so min(count, |zones|) bounds the chain depth
+    anti_extra = max(
+        (
+            min(len(c.pods), max(len(zones), 1)) - 1
+            for c in classes
+            if not c.is_ladder_variant
+            and c.zone_anti is not None
+            and not c.zone_anti_soft
+        ),
+        default=0,
+    )
+    scan_passes += anti_extra
 
     resources: List[str] = [resources_util.CPU, resources_util.MEMORY, resources_util.PODS]
     for cls in classes:

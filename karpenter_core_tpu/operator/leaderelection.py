@@ -4,9 +4,12 @@ Mirror of the reference's leader-elected replicas
 (/root/reference/pkg/operator/operator.go:111-126, options.go:64 — client-go
 leaderelection with a Lease lock): one replica holds the lease and runs the
 controllers; standbys retry acquisition every ``retry_period`` and take over
-when the holder's renew time goes stale.  Acquisition is a CAS on the lease's
-resourceVersion (KubeClient.update_with_version), so two racing electors can
-never both win a term.
+when the lease stops changing for ``lease_duration`` of the STANDBY'S clock
+time (client-go's observedTime discipline — never a comparison against the
+renewTime the holder's clock wrote, which would make the safety margin
+clock-skew-sensitive).  Acquisition is a CAS on the lease's resourceVersion
+(KubeClient.update_with_version), so two racing electors can never both win
+a term.
 
 The reference process exits when it loses leadership (client-go's default
 OnStoppedLeading is a fatal); the in-process equivalent is the
@@ -78,6 +81,13 @@ class LeaderElector:
         self.on_stopped_leading = on_stopped_leading
         self.is_leader = False
         self._last_renew = 0.0  # clock time of the last successful acquire/renew
+        # client-go style observation tracking: staleness is judged against
+        # the LOCAL clock time at which this elector last saw the lease
+        # change, never against the renewTime the holder's clock wrote —
+        # otherwise ~renew-margin seconds of clock skew between replicas lets
+        # a standby promote while the old leader still acts (ADVICE r4 #1)
+        self._observed_key: Optional[tuple] = None
+        self._observed_at = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -169,7 +179,19 @@ class LeaderElector:
             self._promote()
             return True
 
-        if now - lease.spec.renew_time > self.lease_duration:
+        # stale-holder takeover: the holder is deemed dead when the lease has
+        # not CHANGED for lease_duration of OUR clock time (each change —
+        # holder, version, renewTime — restamps the local observation time).
+        # A released lease (empty holder) is free immediately.
+        obs_key = (lease.spec.holder_identity, seen_version, lease.spec.renew_time)
+        if obs_key != self._observed_key:
+            self._observed_key = obs_key
+            self._observed_at = now
+        holder_stale = (
+            not lease.spec.holder_identity
+            or now - self._observed_at > self.lease_duration
+        )
+        if holder_stale:
             lease.spec.holder_identity = self.identity
             lease.spec.acquire_time = now
             lease.spec.renew_time = now

@@ -113,14 +113,23 @@ class TopoCounts(NamedTuple):
     Forward counts track selector-matching (member) pods — they gate spread
     skew, affinity targets, and anti-affinity owners.  Inverse counts track
     anti-term *owners* — they gate the pods those owners repel
-    (topology.go:44-47 inverse topologies)."""
+    (topology.go:44-47 inverse topologies).
 
-    zone_fwd: jnp.ndarray  # i32[G1, Z]
-    zone_inv: jnp.ndarray  # i32[G1, Z]
-    host_fwd_ex: jnp.ndarray  # i32[G1, E]
-    host_inv_ex: jnp.ndarray  # i32[G1, E]
-    host_fwd_new: jnp.ndarray  # i32[G1, N]
-    host_inv_new: jnp.ndarray  # i32[G1, N]
+    All four planes count pods PER NODE; per-zone counts are DERIVED at each
+    class step from the nodes' *current* zone masks (``_derive_zone_counts``).
+    This is the kernel analog of the host recounting domains from live node
+    state every push: when a later pod narrows a node's zone set (node.go
+    merge), every earlier resident's zone contribution narrows with it —
+    in particular a multi-zone anti owner stops poisoning the zones it can no
+    longer be in, which is what lets required zonal anti-affinity converge
+    inside one batch exactly like the iterative host (r4 fuzzer finding (a);
+    accumulating per-zone snapshots at record time could never replay that
+    narrowing)."""
+
+    fwd_ex: jnp.ndarray  # i32[G1, E] member pods per existing node
+    inv_ex: jnp.ndarray  # i32[G1, E] anti-owner pods per existing node
+    fwd_new: jnp.ndarray  # i32[G1, N] member pods per new slot
+    inv_new: jnp.ndarray  # i32[G1, N] anti-owner pods per new slot
 
 
 class SolveOutputs(NamedTuple):
@@ -705,22 +714,46 @@ def _class_step(
     has_haf = g_haf < g_dummy
     has_zan = g_zan < g_dummy
 
+    # -- derived per-zone counts (TopoCounts docstring): positive groups
+    # count pods on zone-COMMITTED (singleton-mask) nodes, the committed-zone
+    # rule of topology.go:231-276; anti groups count every zone a resident
+    # node could still be in (pessimistic).  Reading the CURRENT masks — not
+    # record-time snapshots — replays the host's retroactive narrowing.
+    ex_zone_i = ex.zone.astype(jnp.int32) * ex.open_.astype(jnp.int32)[:, None]
+    new_zone_i = state.zone.astype(jnp.int32) * state.open_.astype(jnp.int32)[:, None]
+    ex_sing_zone = jnp.where(
+        jnp.sum(ex_zone_i, axis=-1, keepdims=True) == 1, ex_zone_i, 0
+    )
+    new_sing_zone = jnp.where(
+        jnp.sum(new_zone_i, axis=-1, keepdims=True) == 1, new_zone_i, 0
+    )
+    zone_fwd_sing = jnp.einsum("ge,ez->gz", topo.fwd_ex, ex_sing_zone) + jnp.einsum(
+        "gn,nz->gz", topo.fwd_new, new_sing_zone
+    )  # [G1, Z]
+    zone_fwd_full = jnp.einsum("ge,ez->gz", topo.fwd_ex, ex_zone_i) + jnp.einsum(
+        "gn,nz->gz", topo.fwd_new, new_zone_i
+    )
+    zone_inv_full = jnp.einsum("ge,ez->gz", topo.inv_ex, ex_zone_i) + jnp.einsum(
+        "gn,nz->gz", topo.inv_new, new_zone_i
+    )
+    zone_fwd = jnp.where(statics.grp_is_anti[:, None], zone_fwd_full, zone_fwd_sing)
+
     # -- inverse anti-affinity blocks (topology.go:44-47): members of anti
     # groups avoid every domain the group's owners could occupy
     mem_anti_zone = member_row & statics.grp_is_anti & statics.grp_is_zone
-    blocked_z = jnp.any(mem_anti_zone[:, None] & (topo.zone_inv > 0), axis=0)  # [Z]
+    blocked_z = jnp.any(mem_anti_zone[:, None] & (zone_inv_full > 0), axis=0)  # [Z]
     allowed_zone = cls.zone & ~blocked_z
     mem_anti_host = member_row & statics.grp_is_anti & ~statics.grp_is_zone
-    ok_ex = ~jnp.any(mem_anti_host[:, None] & (topo.host_inv_ex > 0), axis=0)  # [E]
-    ok_new = ~jnp.any(mem_anti_host[:, None] & (topo.host_inv_new > 0), axis=0)  # [N]
+    ok_ex = ~jnp.any(mem_anti_host[:, None] & (topo.inv_ex > 0), axis=0)  # [E]
+    ok_new = ~jnp.any(mem_anti_host[:, None] & (topo.inv_new > 0), axis=0)  # [N]
 
     # -- per-node caps from hostname groups -----------------------------------
     # spread (topologygroup.go:184-188: hostname min-count is 0, so cap=skew):
     # members consume cap; non-members only need count <= skew
     skew_hs = statics.grp_skew[g_hs]
     member_hs = member_row[g_hs]
-    hs_fwd_ex = topo.host_fwd_ex[g_hs]
-    hs_fwd_new = topo.host_fwd_new[g_hs]
+    hs_fwd_ex = topo.fwd_ex[g_hs]
+    hs_fwd_new = topo.fwd_new[g_hs]
     cap_hs_ex = jnp.where(
         member_hs,
         jnp.maximum(skew_hs - hs_fwd_ex, 0),
@@ -732,8 +765,8 @@ def _class_step(
         jnp.where(hs_fwd_new <= skew_hs, UNLIMITED, 0),
     )
     # owned hostname anti-affinity: only zero-count nodes; self-members cap 1
-    han_fwd_ex = topo.host_fwd_ex[g_han]
-    han_fwd_new = topo.host_fwd_new[g_han]
+    han_fwd_ex = topo.fwd_ex[g_han]
+    han_fwd_new = topo.fwd_new[g_han]
     member_han = member_row[g_han]
     cap_han_ex = jnp.where(
         g_han < g_dummy,
@@ -818,7 +851,7 @@ def _class_step(
         (statics.tmpl_ct & cls.ct[None, :]).astype(jnp.bfloat16),
         preferred_element_type=jnp.float32,
     ) > 0.5  # [Z]
-    counts_zs = topo.zone_fwd[g_zs]  # [Z]
+    counts_zs = zone_fwd[g_zs]  # [Z]
     member_zs = member_row[g_zs]
     # per-zone intake for this class: existing nodes contribute their
     # remaining intake; template zones open new nodes on demand (unbounded).
@@ -906,12 +939,41 @@ def _class_step(
     accumulate(run_phase(state, ex, remaining, q_nm, admissible_zs))
 
     # -- owned zone anti-affinity: zero-forward-count zones only --------------
-    # self-members block every domain they might occupy (pessimistic late
-    # committal): one pod per step; non-member owners don't repel each other
-    zero_zones = allowed_zone & (topo.zone_fwd[g_zan] == 0)
+    # self-members place one pod per currently-unpoisoned zone, each phase
+    # COMMITTING its node to that single zone (the restrict narrows the node
+    # mask to a singleton on merge).  This reaches the host's converged
+    # fixpoint — one member per admissible zone — in batch one: the host's
+    # record-time domain snapshots only get there over batches/retries as
+    # co-location luck narrows masks (topology_test.go:1879-1923), so the
+    # fuzzer contract is kernel >= host batch-one, equal at the fixpoint.
+    # Non-member owners don't repel each other: plain multi-zone phase.
+    # soft (preferred) anti keeps the single pessimistic multi-zone phase:
+    # the reference relaxes failing preference pods onto existing nodes and
+    # never revisits them, so one-per-zone committal would permanently
+    # diverge from its packing (topology_test.go:1478 — co-location allowed);
+    # required anti commits because the reference CONVERGES to one-per-zone
+    # over batches (pods stay pending until zones register)
+    zero_zones = allowed_zone & (zone_fwd[g_zan] == 0)
+    anti_member = member_row[g_zan]
+    anti_required = has_zan & anti_member & ~cls.anti_soft[0]
+    placed_anti = jnp.int32(0)
+    for z in range(n_zones):
+        restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
+        q = jnp.where(
+            anti_required & zero_zones[z] & (placed_anti < m),
+            jnp.int32(1),
+            jnp.int32(0),
+        )
+        results_a = run_phase(state, ex, remaining, q, restrict)
+        placed_anti = placed_anti + results_a[4]
+        accumulate(results_a)
     anti_quota = jnp.where(
         has_zan & jnp.any(zero_zones),
-        jnp.where(member_row[g_zan], jnp.minimum(m, 1), m),
+        jnp.where(
+            anti_member,
+            jnp.where(cls.anti_soft[0], jnp.minimum(m, 1), 0),
+            m,
+        ),
         0,
     )
     accumulate(run_phase(state, ex, remaining, anti_quota, zero_zones))
@@ -922,7 +984,7 @@ def _class_step(
     # lands where a node is viable): restrict to zones some template offers
     # for this class, or where an open existing node sits
     bootstrap_allowed = allowed_zone & fillable
-    nonzero_zones = allowed_zone & (topo.zone_fwd[g_zaf] > 0)
+    nonzero_zones = allowed_zone & (zone_fwd[g_zaf] > 0)
     bootstrap_zone = (
         jnp.zeros(n_zones, dtype=bool)
         .at[jnp.argmax(bootstrap_allowed)]
@@ -936,8 +998,8 @@ def _class_step(
     # planes; else self-members bootstrap exactly one node
     all_zones = jnp.ones(n_zones, dtype=bool)
     host_restrict = jnp.where(has_zaf, zone_aff_restrict, all_zones) & allowed_zone
-    targets_ex = (topo.host_fwd_ex[g_haf] > 0) & ex.open_
-    targets_new = (topo.host_fwd_new[g_haf] > 0) & state.open_
+    targets_ex = (topo.fwd_ex[g_haf] > 0) & ex.open_
+    targets_new = (topo.fwd_new[g_haf] > 0) & state.open_
     targets_exist = jnp.any(targets_ex) | jnp.any(targets_new)
     host_quota = jnp.where(has_haf, m, 0)
     q_targets = jnp.where(targets_exist, host_quota, 0)
@@ -959,37 +1021,23 @@ def _class_step(
     any_quota = jnp.where(has_zs | has_zan | has_zaf | has_haf, 0, m)
     accumulate(run_phase(state, ex, remaining, any_quota, allowed_zone))
 
-    # -- record (topology.go:120-143): update shared counts -------------------
-    # committed zone per node: singleton masks count for spread/affinity;
-    # anti members/owners record every zone the node could be in
-    ex_sing = jnp.sum(ex.zone.astype(jnp.int32), axis=-1) == 1
-    new_sing = jnp.sum(state.zone.astype(jnp.int32), axis=-1) == 1
+    # -- record (topology.go:120-143): update shared PER-NODE counts ----------
+    # zone projections happen at read time from live masks (derivation above),
+    # so recording is pure bookkeeping: each placed pod adds its class's
+    # membership/ownership to its node's row in every relevant group
     a_ex_f = assigned_ex_total.astype(jnp.int32)
     a_new_f = assigned_total.astype(jnp.int32)
-    zone_sing = (
-        jnp.einsum("e,ez->z", jnp.where(ex_sing, a_ex_f, 0), ex.zone.astype(jnp.int32))
-        + jnp.einsum("n,nz->z", jnp.where(new_sing, a_new_f, 0), state.zone.astype(jnp.int32))
-    )
-    zone_full = (
-        jnp.einsum("e,ez->z", a_ex_f, ex.zone.astype(jnp.int32))
-        + jnp.einsum("n,nz->z", a_new_f, state.zone.astype(jnp.int32))
-    )
-    member_zone_pos = member_row & statics.grp_is_zone & ~statics.grp_is_anti
-    member_zone_anti = member_row & statics.grp_is_zone & statics.grp_is_anti
-    member_host = member_row & ~statics.grp_is_zone
+    member_i = member_row.astype(jnp.int32)
     # preferred-anti owners register no inverse counts (the reference skips
     # inverse tracking for preferences, topology.go:203-206)
     own_zan_inv = jnp.where(cls.anti_soft[0], 0, own_onehot(g_zan).astype(jnp.int32))
     own_han_inv = jnp.where(cls.anti_soft[1], 0, own_onehot(g_han).astype(jnp.int32))
+    own_inv = own_zan_inv + own_han_inv
     topo = TopoCounts(
-        zone_fwd=topo.zone_fwd
-        + member_zone_pos[:, None] * zone_sing[None, :]
-        + member_zone_anti[:, None] * zone_full[None, :],
-        zone_inv=topo.zone_inv + own_zan_inv[:, None] * zone_full[None, :],
-        host_fwd_ex=topo.host_fwd_ex + member_host[:, None] * a_ex_f[None, :],
-        host_inv_ex=topo.host_inv_ex + own_han_inv[:, None] * a_ex_f[None, :],
-        host_fwd_new=topo.host_fwd_new + member_host[:, None] * a_new_f[None, :],
-        host_inv_new=topo.host_inv_new + own_han_inv[:, None] * a_new_f[None, :],
+        fwd_ex=topo.fwd_ex + member_i[:, None] * a_ex_f[None, :],
+        inv_ex=topo.inv_ex + own_inv[:, None] * a_ex_f[None, :],
+        fwd_new=topo.fwd_new + member_i[:, None] * a_new_f[None, :],
+        inv_new=topo.inv_new + own_inv[:, None] * a_new_f[None, :],
     )
 
     failed = m - placed_total
@@ -1050,21 +1098,16 @@ def solve_core(
 
     # seed topology counts from pre-existing pods (topology.go:231-276
     # countDomains): forward from selector-matching pods, inverse from
-    # anti-term owners — closed nodes (consolidation subsets) drop out here
+    # anti-term owners — closed nodes (consolidation subsets) drop out at
+    # derivation time (the zone projection multiplies by the open mask)
     open_i = existing_state.open_.astype(jnp.int32)
-    ex_sing = jnp.sum(existing_state.zone.astype(jnp.int32), axis=-1) == 1
-    zone_onehot = jnp.where(
-        ex_sing[:, None], existing_state.zone, False
-    ).astype(jnp.int32)
     member_open = existing_static.grp_node_member * open_i[None, :]
     owner_open = existing_static.grp_node_owner * open_i[None, :]
     topo = TopoCounts(
-        zone_fwd=jnp.einsum("ge,ez->gz", member_open, zone_onehot),
-        zone_inv=jnp.einsum("ge,ez->gz", owner_open, zone_onehot),
-        host_fwd_ex=member_open,
-        host_inv_ex=owner_open,
-        host_fwd_new=jnp.zeros((g1, n_slots), dtype=jnp.int32),
-        host_inv_new=jnp.zeros((g1, n_slots), dtype=jnp.int32),
+        fwd_ex=member_open,
+        inv_ex=owner_open,
+        fwd_new=jnp.zeros((g1, n_slots), dtype=jnp.int32),
+        inv_new=jnp.zeros((g1, n_slots), dtype=jnp.int32),
     )
 
     def step(carry, cls_with_index):

@@ -57,6 +57,108 @@ def default_mesh_2d(
     return Mesh(np.array(devices[: r * l]).reshape(r, l), axes)
 
 
+def _pad_i_axis(arr, axis: int, target: int, value):
+    pad = target - arr.shape[axis]
+    if pad <= 0:
+        return jnp.asarray(arr)
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(jnp.asarray(arr), widths, constant_values=value)
+
+
+def solve_catalog_sharded(
+    snapshot: EncodedSnapshot,
+    mesh: Optional[Mesh] = None,
+    axis: str = "lane",
+    n_slots: int = 0,
+):
+    """The PROVISIONING solve with the catalog (instance-type) axis sharded
+    across the mesh (VERDICT r4 #7 / BASELINE config 4).
+
+    Why the catalog axis: class dedup collapses the pod axis to ~a dozen
+    classes regardless of pod count (models/snapshot.py docstring), and the
+    class scan's carry is inherently sequential — but per class step the hot
+    planes are [N slots, I instance types] with per-I independence
+    (_it_intersects, _capacity, _offering_ok) and only max/any reductions
+    over I.  Annotating the I-indexed inputs with a NamedSharding and letting
+    GSPMD propagate yields per-device [N, I/D] compute with one small
+    collective per reduction — the scaling-book recipe (mesh + annotations,
+    XLA inserts collectives), no kernel changes.
+
+    The catalog pads to a device multiple with inert instance types (no
+    availability, zero allocatable, excluded from every template/class mask).
+    Returns SolveOutputs identical to the single-device solve — decode sees
+    the same planes (padded I tail is never viable).
+    """
+    if mesh is None:
+        mesh = default_mesh(axis=axis)
+    if axis not in mesh.axis_names:
+        axis = mesh.axis_names[-1]
+    # pad to the SHARDING axis size, not the total device count — on a 2D
+    # mesh P(axis) only splits the catalog that many ways
+    axis_size = int(mesh.shape[axis])
+    if n_slots <= 0:
+        n_slots = solve_ops.estimate_slots(snapshot)
+
+    cls, statics_arrays, key_has_bounds = solve_ops.prepare_host(snapshot)
+    i0 = statics_arrays.it_alloc.shape[0]
+    i_pad = -(-i0 // axis_size) * axis_size
+
+    it = statics_arrays.it
+    it_padded = type(it)(
+        mask=_pad_i_axis(it.mask, 0, i_pad, False),
+        defined=_pad_i_axis(it.defined, 0, i_pad, False),
+        negative=_pad_i_axis(it.negative, 0, i_pad, False),
+        gt=_pad_i_axis(it.gt, 0, i_pad, -np.inf),
+        lt=_pad_i_axis(it.lt, 0, i_pad, np.inf),
+    )
+    statics_padded = statics_arrays._replace(
+        it=it_padded,
+        it_alloc=_pad_i_axis(statics_arrays.it_alloc, 0, i_pad, 0.0),
+        it_avail=_pad_i_axis(statics_arrays.it_avail, 0, i_pad, False),
+        tmpl_it=_pad_i_axis(statics_arrays.tmpl_it, 1, i_pad, False),
+        it_capacity=_pad_i_axis(statics_arrays.it_capacity, 0, i_pad, 0.0),
+    )
+    cls_padded = cls._replace(it=_pad_i_axis(cls.it, 1, i_pad, False))
+
+    shard_i = NamedSharding(mesh, P(axis))
+    shard_i_ax1 = NamedSharding(mesh, P(None, axis))
+    replicated = NamedSharding(mesh, P())
+
+    # sharding pytrees mirroring the inputs: I-indexed leaves partitioned,
+    # everything else replicated (GSPMD propagates through the scan)
+    statics_shardings = jax.tree_util.tree_map(
+        lambda _: replicated, statics_padded
+    )._replace(
+        it=type(it)(
+            mask=shard_i, defined=shard_i, negative=shard_i, gt=shard_i, lt=shard_i
+        ),
+        it_alloc=shard_i,
+        it_avail=shard_i,
+        tmpl_it=shard_i_ax1,
+        it_capacity=shard_i,
+    )
+    cls_shardings = jax.tree_util.tree_map(
+        lambda _: replicated, cls_padded
+    )._replace(it=shard_i_ax1)
+
+    with mesh:
+        cls_dev = jax.device_put(cls_padded, cls_shardings)
+        statics_dev = jax.device_put(statics_padded, statics_shardings)
+        fn = jax.jit(
+            functools.partial(
+                solve_ops.solve_core,
+                n_slots=n_slots,
+                key_has_bounds=key_has_bounds,
+                n_passes=snapshot.scan_passes,
+            ),
+            in_shardings=(cls_shardings, statics_shardings),
+        )
+        out = fn(cls_dev, statics_dev)
+        jax.block_until_ready(out)
+    return out
+
+
 def perturb_spot_availability(
     snapshot: EncodedSnapshot, n_replicas: int, seed: int = 0, interruption_rate: float = 0.3
 ) -> jnp.ndarray:

@@ -122,12 +122,34 @@ def enable() -> None:
 
     with _lock:
         if not _enabled:
-            directory = os.path.join(cache_dir(), f"xla-{_machine_tag()}")
-            os.makedirs(directory, exist_ok=True)
-            jax.config.update("jax_compilation_cache_dir", directory)
-            # persist even fast compiles: over the axon relay a "fast" compile
-            # still costs a round trip, and helpers like pack_bool add up
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            # The XLA persistent cache's CPU executable serialize/deserialize
+            # path segfaults intermittently at suite scale (observed three
+            # ways in one day: put_executable_and_time, get_executable_and
+            # _time, and compile_or_get_cached — jaxlib's own cpu_aot_loader
+            # warns its AOT results may SIGILL).  The executable cache is a
+            # cold-start optimization for the RELAY-bound TPU backend; on CPU
+            # the exported-StableHLO cache below already skips tracing, so
+            # the crash risk buys little — leave it off unless forced
+            # (KC_TPU_XLA_CACHE=1 forces on, =0 forces off on any backend).
+            # Platform read from config/env, NOT jax.default_backend(): that
+            # call would initialize the backend eagerly inside Operator.start
+            # (multi-second TPU bring-up on the startup critical path, even
+            # for remote-solve-only replicas that never solve locally).
+            forced = os.environ.get("KC_TPU_XLA_CACHE")
+            platform = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
+            use_xla_cache = (
+                forced != "0" if forced is not None
+                else not platform.startswith("cpu")
+            )
+            if use_xla_cache:
+                directory = os.path.join(cache_dir(), f"xla-{_machine_tag()}")
+                os.makedirs(directory, exist_ok=True)
+                jax.config.update("jax_compilation_cache_dir", directory)
+                # persist even fast compiles: over the axon relay a "fast"
+                # compile still costs a round trip, and helpers add up
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0
+                )
             _enabled = True
         if not _registered:
             from karpenter_core_tpu.ops import masks as mask_ops

@@ -1,0 +1,229 @@
+"""Seeded consolidation-plane fuzzing: host binary search vs TPU prefix
+sweep vs remote sweep (VERDICT r4 #3).
+
+Warm clusters drawn from a seeded shape space (utilization mix, oversized
+nodes, catalog size, spot/on-demand mix, PDB/do-not-evict blockers) run
+MultiNodeConsolidation three ways and must agree:
+
+  - the host binary search over disruption-sorted prefixes
+    (first_n_consolidation_option, multinodeconsolidation.go:86-113)
+  - the TPU subset sweep (solver/consolidation.py: every prefix simulated in
+    parallel lanes, re-grid until exact)
+  - the remote sweep over the snapshot channel (/Consolidate), exactly as the
+    controller ships it (_remote_search)
+
+Contract (the sweep is a documented refinement of the binary search — it
+examines EVERY prefix while the binary search assumes monotone validity —
+so counts can only grow):
+
+  1. the sweep never removes fewer nodes than the host search;
+  2. whatever prefix the sweep picks, the HOST simulation of that same
+     subset must independently validate it with the same action and, for
+     REPLACE, the same post-filter instance-type option set (this is the
+     cross-engine soundness bar: the sweep may be smarter about WHICH prefix,
+     never about what a prefix means);
+  3. equal-size prefixes agree exactly (same action, same nodes);
+  4. the remote sweep returns the same command as the in-process sweep.
+"""
+
+import random
+
+import pytest
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    OP_IN,
+    NodeSelectorRequirement,
+)
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.controllers.deprovisioning import (
+    Action,
+    MultiNodeConsolidation,
+    candidate_nodes,
+)
+from karpenter_core_tpu.models.snapshot import KernelUnsupported
+from karpenter_core_tpu.solver.consolidation import TPUConsolidationSearch
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
+
+pytestmark = pytest.mark.compile  # every seed compiles device sweeps
+
+CT = labels_api.LABEL_CAPACITY_TYPE
+
+POD_SIZES = ("100m", "250m", "400m", "600m", "900m")
+
+
+def build_cluster(seed: int):
+    """One seeded warm cluster, consolidation-enabled."""
+    rng = random.Random(seed * 6271)
+    n_types = rng.randrange(3, 9)
+    env = make_environment(instance_types=fake_cp.instance_types(n_types))
+    requirements = []
+    if rng.random() < 0.5:
+        # on-demand-only provisioner: exercises the ct-restricted pricing
+        requirements.append(
+            NodeSelectorRequirement(CT, OP_IN, [labels_api.CAPACITY_TYPE_ON_DEMAND])
+        )
+    env.kube.create(
+        make_provisioner(consolidation_enabled=True, requirements=requirements)
+    )
+    n_nodes = rng.randrange(2, 7)
+    big_pods = []
+    for _ in range(n_nodes):
+        pods = [
+            make_pod(requests={"cpu": rng.choice(POD_SIZES)})
+            for _ in range(rng.randrange(1, 4))
+        ]
+        if rng.random() < 0.6:
+            # transient large pod leaves an oversized node behind: the shape
+            # where replacement is strictly cheaper
+            big = make_pod(requests={"cpu": 4})
+            pods.append(big)
+            big_pods.append(big)
+        if rng.random() < 0.25:
+            # do-not-evict blocker: the node must drop out of candidacy
+            pods.append(
+                make_pod(
+                    requests={"cpu": "100m"},
+                    annotations={"karpenter.sh/do-not-evict": "true"},
+                )
+            )
+        expect_provisioned(env, *pods)
+        env.make_all_nodes_ready()
+    for big in big_pods:
+        env.kube.delete(env.kube.get_pod(big.namespace, big.name), force=True)
+    env.clock.step(21)
+    return env
+
+
+def get_candidates(env):
+    dep = env.deprovisioning
+    return sorted(
+        candidate_nodes(
+            env.cluster, env.kube, env.clock, env.provider,
+            dep.multi_node_consolidation.should_deprovision,
+        ),
+        key=lambda c: c.disruption_cost,
+    )
+
+
+def option_names(command) -> set:
+    return {
+        it.name
+        for r in (command.replacement_nodes or [])
+        for it in r.instance_type_options
+    }
+
+
+def node_names(command) -> list:
+    return [n.name for n in command.nodes_to_remove]
+
+
+def assert_host_validates(env, candidates, tpu_cmd):
+    """Contract #2: the host's own simulation of the sweep-chosen subset must
+    agree on action and post-filter options."""
+    k = len(tpu_cmd.nodes_to_remove)
+    subset = candidates[:k]
+    assert node_names(tpu_cmd) == [c.node.name for c in subset], (
+        "sweep removed a non-prefix set"
+    )
+    mnc = env.deprovisioning.multi_node_consolidation
+    host_cmd = mnc.compute_consolidation(*subset)
+    if host_cmd.action == Action.REPLACE:
+        host_cmd.replacement_nodes[0].instance_type_options = (
+            MultiNodeConsolidation.filter_out_same_type(
+                host_cmd.replacement_nodes[0], subset
+            )
+        )
+        if not host_cmd.replacement_nodes[0].instance_type_options:
+            host_cmd = type(host_cmd)(Action.DO_NOTHING)
+    assert host_cmd.action == tpu_cmd.action, (
+        f"host re-simulation of the sweep's {k}-prefix disagrees: "
+        f"host={host_cmd.action} tpu={tpu_cmd.action}"
+    )
+    if tpu_cmd.action == Action.REPLACE:
+        assert option_names(tpu_cmd) == option_names(host_cmd), (
+            f"replacement option sets diverge on the same subset: "
+            f"tpu={sorted(option_names(tpu_cmd))} host={sorted(option_names(host_cmd))}"
+        )
+        tpu_pods = {p.uid for r in tpu_cmd.replacement_nodes for p in r.pods}
+        host_pods = {p.uid for r in host_cmd.replacement_nodes for p in r.pods}
+        assert tpu_pods == host_pods, "replacement pod sets diverge"
+
+
+@pytest.mark.parametrize("seed", range(50))
+def test_fuzzed_consolidation_parity(seed):
+    env = build_cluster(seed)
+    candidates = get_candidates(env)
+    if len(candidates) < 2:
+        pytest.skip("seed yields <2 candidates (all blocked or initializing)")
+
+    mnc = env.deprovisioning.multi_node_consolidation
+    host_cmd = mnc.first_n_consolidation_option(candidates, len(candidates))
+
+    search = TPUConsolidationSearch(env.provider, env.kube.list_provisioners())
+    try:
+        tpu_cmd = search.compute_command(
+            candidates,
+            pending_pods=[],
+            state_nodes=env.cluster.snapshot_nodes(),
+            bound_pods=env.kube.list_pods(),
+        )
+    except KernelUnsupported:
+        pytest.skip("cluster shape routes to the host path by design")
+
+    # contract #1: the exhaustive sweep never removes fewer
+    assert len(tpu_cmd.nodes_to_remove) >= len(host_cmd.nodes_to_remove), (
+        f"seed {seed}: sweep removed {node_names(tpu_cmd)} "
+        f"< host {node_names(host_cmd)}"
+    )
+    # contract #3: equal prefixes agree exactly
+    if len(tpu_cmd.nodes_to_remove) == len(host_cmd.nodes_to_remove):
+        assert tpu_cmd.action == host_cmd.action, (
+            f"seed {seed}: same prefix size, different action "
+            f"(tpu={tpu_cmd.action} host={host_cmd.action})"
+        )
+        assert node_names(tpu_cmd) == node_names(host_cmd)
+    # contract #2: host validates the sweep's subset
+    if tpu_cmd.action in (Action.DELETE, Action.REPLACE):
+        assert_host_validates(env, candidates, tpu_cmd)
+
+
+@pytest.mark.parametrize("seed", [1, 7, 19, 33])
+def test_fuzzed_remote_sweep_matches_in_process(seed):
+    """Contract #4 on a sample of seeds: the /Consolidate wire path returns
+    the same command as the in-process sweep (the wire ships candidates by
+    name and replacements as launchable entries; any lossy field shows up as
+    a divergence here)."""
+    from karpenter_core_tpu.service.snapshot_channel import serve
+
+    env = build_cluster(seed)
+    candidates = get_candidates(env)
+    if len(candidates) < 2:
+        pytest.skip("seed yields <2 candidates")
+
+    search = TPUConsolidationSearch(env.provider, env.kube.list_provisioners())
+    try:
+        local_cmd = search.compute_command(
+            candidates,
+            pending_pods=[],
+            state_nodes=env.cluster.snapshot_nodes(),
+            bound_pods=env.kube.list_pods(),
+        )
+    except KernelUnsupported:
+        pytest.skip("cluster shape routes to the host path by design")
+
+    server, port = serve(env.provider)
+    try:
+        mnc = env.deprovisioning.multi_node_consolidation
+        mnc.use_tpu_kernel = True
+        mnc.solver_endpoint = f"127.0.0.1:{port}"
+        mnc._solver_client = None
+        remote_cmd = mnc._tpu_search(candidates)
+    finally:
+        server.stop(0)
+    assert remote_cmd is not None, "remote sweep fell back unexpectedly"
+    assert remote_cmd.action == local_cmd.action
+    assert node_names(remote_cmd) == node_names(local_cmd)
+    if local_cmd.action == Action.REPLACE:
+        assert option_names(remote_cmd) == option_names(local_cmd)

@@ -41,6 +41,10 @@ class TestLeaseProtocol:
         a = elector(kube, clock, "a", lease_duration=15.0)
         b = elector(kube, clock, "b", lease_duration=15.0)
         assert a.tick()
+        # client-go observation discipline: the standby times staleness from
+        # its OWN first sight of the lease, never from the renewTime the
+        # holder's clock wrote (ADVICE r4 #1 — skew immunity)
+        assert b.tick() is False  # first observation starts the local timer
         clock.step(16.0)  # holder went silent past the lease duration
         assert b.tick() is True
         assert b.is_leader
@@ -52,6 +56,30 @@ class TestLeaseProtocol:
         a.on_stopped_leading = lambda: lost.append(True)
         assert a.tick() is False
         assert lost
+
+    def test_clock_skew_does_not_promote_standby(self):
+        """ADVICE r4 #1: a standby whose wall clock runs far ahead of the
+        leader's must NOT promote while the leader is renewing.  Staleness is
+        judged against the standby's locally-observed time of the last lease
+        CHANGE (client-go observedTime), never against the renewTime written
+        by the leader's clock — under the old renewTime comparison, 30s of
+        skew promotes b on its very first tick here (split-brain)."""
+        clock_a, clock_b = FakeClock(), FakeClock()
+        kube = KubeClient(clock_a)
+        a = elector(kube, clock_a, "a", lease_duration=15.0)
+        b = elector(kube, clock_b, "b", lease_duration=15.0)
+        clock_b.step(30.0)  # b's clock is 30s ahead — 2x the lease duration
+        assert a.tick() is True
+        for _ in range(10):
+            clock_a.step(2.0)
+            clock_b.step(2.0)
+            assert a.tick() is True
+            assert b.tick() is False, (
+                "skewed standby promoted while the leader renews"
+            )
+        # and once the leader actually goes silent, b still takes over
+        clock_b.step(16.0)
+        assert b.tick() is True
 
     def test_renewal_keeps_leadership(self):
         clock = FakeClock()
@@ -180,9 +208,10 @@ class TestCAS:
         kube = KubeClient(clock)
         a = elector(kube, clock, "a", lease_duration=5.0)
         assert a.tick()
-        clock.step(10.0)
         b = elector(kube, clock, "b", lease_duration=5.0)
         c = elector(kube, clock, "c", lease_duration=5.0)
+        assert b.tick() is False and c.tick() is False  # observe first
+        clock.step(10.0)  # holder silent past both standbys' local timers
         winners = [e for e in (b, c) if e.tick()]
         assert len(winners) == 1
         # the loser stays standby on its next tick (fresh lease now)

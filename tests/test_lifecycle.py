@@ -437,3 +437,100 @@ class TestTerminationMatrix:
         if live is not None:
             env.termination.reconcile(live)
         assert env.kube.get_node(node.name) is None
+
+
+class TestTerminationEvictionOrder:
+    def test_critical_pods_evicted_last(self):
+        """suite_test.go:470-501: non-critical pods drain first; the critical
+        pod only enters the eviction queue once no non-critical remain."""
+        env = make_environment()
+        normal = make_pod(unschedulable=False)
+        critical = make_pod(unschedulable=False)
+        critical.spec.priority_class_name = "system-cluster-critical"
+        node = make_node(labels={labels_api.PROVISIONER_NAME_LABEL_KEY: "default"},
+                         finalizers=[labels_api.TERMINATION_FINALIZER])
+        env.kube.create(node)
+        for pod in (normal, critical):
+            pod.spec.node_name = node.name
+            env.kube.create(pod)
+
+        err = env.termination.terminator.drain(node)
+        assert err is not None  # pods still present
+        assert env.kube.get_pod(normal.namespace, normal.name) is None, (
+            "non-critical pod should evict in the first round"
+        )
+        assert env.kube.get_pod(critical.namespace, critical.name) is not None, (
+            "critical pod must wait for non-critical pods"
+        )
+        err = env.termination.terminator.drain(node)
+        assert env.kube.get_pod(critical.namespace, critical.name) is None
+
+
+class TestEmptinessReadinessGate:
+    def test_not_ready_nodes_never_get_emptiness_ttl(self):
+        """suite_test.go:337-362 (node): nodes whose readiness is unknown or
+        false — here: not yet initialized — must not be stamped with the
+        emptiness TTL even when empty."""
+        env = make_environment()
+        env.kube.create(make_provisioner(ttl_seconds_after_empty=30))
+        pod = make_pod(requests={"cpu": "1"})
+        expect_provisioned(env, pod)
+        node = env.kube.list_nodes()[0]
+        # not initialized (kubelet never registered): delete the pod so the
+        # node is empty, reconcile — no emptiness annotation may appear
+        env.kube.delete(env.kube.get_pod(pod.namespace, pod.name), force=True)
+        env.node_lifecycle.reconcile(node)
+        live = env.kube.get_node(node.name)
+        assert labels_api.EMPTINESS_TIMESTAMP_ANNOTATION_KEY not in live.metadata.annotations
+        # initialize (Ready), let the nomination window lapse, reconcile
+        # again: now it stamps
+        env.make_node_ready(live)
+        env.clock.step(21)
+        env.node_lifecycle.reconcile(env.kube.get_node(node.name))
+        live = env.kube.get_node(node.name)
+        assert labels_api.EMPTINESS_TIMESTAMP_ANNOTATION_KEY in live.metadata.annotations
+
+
+class TestInflightStuckTermination:
+    def test_stuck_deleting_node_with_pdb_reported(self):
+        """inflightchecks suite_test.go:134-163: a node stuck deleting because
+        a PDB blocks its pods' eviction must surface a FailedInflightCheck."""
+        from karpenter_core_tpu.apis.objects import (
+            LabelSelector,
+            PodDisruptionBudget,
+            PodDisruptionBudgetSpec,
+        )
+        from karpenter_core_tpu.controllers.inflightchecks import (
+            InflightChecksController,
+        )
+
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        node = make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+            },
+            finalizers=[labels_api.TERMINATION_FINALIZER],
+        )
+        env.kube.create(node)
+        pod = make_pod(labels={"app": "guarded"}, unschedulable=False)
+        pod.spec.node_name = node.name
+        env.kube.create(pod)
+        env.kube.create(
+            PodDisruptionBudget(
+                spec=PodDisruptionBudgetSpec(
+                    selector=LabelSelector(match_labels={"app": "guarded"}),
+                    max_unavailable=0,
+                )
+            )
+        )
+        env.kube.delete(node)  # deletion timestamp set; finalizer holds it
+        stuck = env.kube.get_node(node.name)
+        assert stuck is not None and stuck.metadata.deletion_timestamp is not None
+        checks = InflightChecksController(env.clock, env.kube, env.provider, env.recorder)
+        checks.reconcile(stuck)
+        messages = [
+            e.message for e in env.recorder.events if e.reason == "FailedInflightCheck"
+        ]
+        assert any("PDB" in m for m in messages), messages

@@ -13,6 +13,23 @@ from karpenter_core_tpu.testing import make_pods, make_provisioner
 # the virtual-mesh sharding suite traces + compiles study grids -- the slow tier (`make test-all`)
 pytestmark = pytest.mark.compile
 
+
+@pytest.fixture(autouse=True, scope="module")
+def _fresh_compiler_state():
+    """XLA:CPU's compiler can segfault when the 2D-mesh study grids compile
+    in a process already holding hundreds of executables (observed 3x at the
+    same suite position, in compile/serialize/deserialize paths; isolated
+    runs always pass).  Dropping jax's in-process caches before this module
+    gives the compiler a clean slate; the same crash class is why
+    dryrun_multichip coverage rides the subprocess path below."""
+    jax.clear_caches()
+    from karpenter_core_tpu.utils import compilecache
+
+    compilecache.reset_memo()
+    yield
+    jax.clear_caches()
+
+
 def build(n_pods=24, n_types=6):
     provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(n_types))
     solver = TPUSolver(provider, [make_provisioner()])
@@ -54,9 +71,13 @@ class TestMonteCarloMesh:
         assert int(np.asarray(out.assign).sum()) > 0
 
     def test_dryrun_multichip(self):
+        # run the FULL dry run (monte-carlo, catalog-sharded solve,
+        # consolidation lanes, crossed 2D grid) in a fresh interpreter — the
+        # same way the driver invokes it, and immune to the accumulated
+        # compiler state this suite builds up (_fresh_compiler_state)
         import __graft_entry__ as graft
 
-        graft.dryrun_multichip(8)
+        graft._dryrun_multichip_subprocess(8)
 
     def test_dryrun_multichip_subprocess(self):
         # The driver's process is bound to the real-TPU axon platform; the

@@ -178,14 +178,20 @@ def test_fuzzed_batch_parity(seed):
 
     Three families are exempt from single-batch equality, because the
     reference's own semantics make their counts depend on packing luck its
-    unstable sort does not guarantee (the first two) or because the kernel
-    is a documented refinement over the reference (the third):
+    unstable sort does not guarantee (the second) or because the kernel is a
+    documented refinement over the reference (the first and third):
 
-    - required zonal anti-affinity: pessimistic late committal schedules ~1
-      per batch and converges over BATCHES (topology_test.go:1879 "it takes
-      multiple batches ... to work themselves out"; 1713's second batch).
-      Contract: never more than the host in batch one, full convergence by
-      the next reconcile once batch-one nodes hold registered zones.
+    - required zonal anti-affinity: both engines use pessimistic late
+      committal (a placed member poisons every zone its node could be in;
+      topology_test.go:1879 "it takes multiple batches ... to work
+      themselves out").  The kernel derives anti domains from nodes' CURRENT
+      zone masks each pass, so co-location narrowing de-poisons zones
+      mid-batch — the host's record-time domain snapshots only see that
+      narrowing on the NEXT reconcile.  Contract: never fewer than the host
+      in batch one (the kernel reaches the fixpoint faster, never a
+      different fixpoint — asserted by re-reconciling the HOST environment
+      to batch two and requiring it to catch up), and every placement passes
+      the independent validity oracle (no two anti pods share a zone).
     - required hostname self-affinity: the group pins to the FIRST empty
       domain only (topology_test.go:1306) — how many pods fit is decided by
       which node the group happened to pin.  Contract: the kernel path
@@ -198,13 +204,13 @@ def test_fuzzed_batch_parity(seed):
       pod (topologygroup.go:163-176; ROADMAP r2 #9).  Contract: never fewer
       than the reference."""
     anti_classes, host_aff_classes, narrowed_spreads = committal_classes(seed)
-    _, _, host = controller_solve(seed, use_kernel=False)
+    host_env, host_pods, host = controller_solve(seed, use_kernel=False)
     env, pods, tpu = controller_solve(seed, use_kernel=True)
 
     for cls in set(host) | set(tpu):
         if cls in anti_classes:
-            assert tpu.get(cls, 0) <= host.get(cls, 0), (
-                f"seed {seed} {cls}: anti class scheduled MORE than host: "
+            assert tpu.get(cls, 0) >= host.get(cls, 0), (
+                f"seed {seed} {cls}: anti class scheduled FEWER than host: "
                 f"tpu={tpu.get(cls, 0)} host={host.get(cls, 0)}"
             )
         elif cls in host_aff_classes:
@@ -228,21 +234,30 @@ def test_fuzzed_batch_parity(seed):
                 f"seed {seed} {cls}: tpu={dict(tpu)} host={dict(host)}"
             )
 
-    if any(tpu.get(cls, 0) < host.get(cls, 0) for cls in anti_classes):
-        # batch-two convergence: make batch-one nodes real (kubelet registers
-        # zones) and re-reconcile the leftover anti pods
-        env.make_all_nodes_ready()
-        env.clock.step(21)
-        result = expect_provisioned(env, *pods)
-        expect_valid_placements(env, pods)
-        second = Counter(tpu)  # batch-one placements stay bound...
-        for pod in pods:
-            if result[pod.uid] is not None:  # ...plus batch-two's new ones
-                second[pod.metadata.labels["app"]] += 1
+    if any(tpu.get(cls, 0) > host.get(cls, 0) for cls in anti_classes):
+        # same-fixpoint check: where the kernel got ahead (its zone-committal
+        # anti phases place one member per admissible zone in batch one), the
+        # HOST must catch up over subsequent batches — it converges one pod
+        # per batch as each batch's node registers its zone
+        # (topology_test.go:1879-1923) — proving the kernel reached the
+        # host's own fixpoint early, not a different one
+        total = Counter(host)
+        for _ in range(4):  # >= zone count + slack; each batch adds >= 1
+            host_env.make_all_nodes_ready()
+            host_env.clock.step(21)
+            result = expect_provisioned(host_env, *host_pods)
+            expect_valid_placements(host_env, host_pods)
+            progressed = False
+            for pod in host_pods:
+                if result[pod.uid] is not None:
+                    total[pod.metadata.labels["app"]] += 1
+                    progressed = True
+            if not progressed:
+                break
         for cls in anti_classes:
-            assert second.get(cls, 0) >= host.get(cls, 0), (
-                f"seed {seed} {cls}: anti class did not converge by batch two: "
-                f"{second.get(cls, 0)} < host's {host.get(cls, 0)}"
+            assert total.get(cls, 0) >= tpu.get(cls, 0), (
+                f"seed {seed} {cls}: host converged to {total.get(cls, 0)} "
+                f"< the kernel's batch-one count ({tpu.get(cls, 0)})"
             )
 
 
@@ -281,8 +296,8 @@ def test_fuzzed_batch_parity_with_existing_nodes(seed):
     tpu = warm_env(use_kernel=True)
     for cls in set(host) | set(tpu):
         if cls in anti_classes:
-            assert tpu.get(cls, 0) <= host.get(cls, 0), (
-                f"seed {seed} {cls}: anti class over host on warm cluster: "
+            assert tpu.get(cls, 0) >= host.get(cls, 0), (
+                f"seed {seed} {cls}: anti class under host on warm cluster: "
                 f"tpu={tpu.get(cls, 0)} host={host.get(cls, 0)}"
             )
         elif cls in host_aff_classes:

@@ -206,3 +206,44 @@ class TestBatcher:
         start = clock.now()
         assert batcher.wait()
         assert 3.0 <= clock.now() - start < 5.0
+
+
+class TestWarmupSingleStart:
+    def test_concurrent_triggers_spawn_one_warmup(self, monkeypatch):
+        """ADVICE r4 #3: _maybe_start_warmup's test-and-set is lock-guarded —
+        concurrent trigger() calls from watch/batcher threads must spawn at
+        most ONE warmup thread, and every spawned thread must be tracked for
+        join_warmup (an untracked thread inside an XLA compile at interpreter
+        teardown aborts the process)."""
+        import threading
+
+        from karpenter_core_tpu.testing.harness import make_environment
+        from karpenter_core_tpu.testing import make_provisioner
+
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        ctrl = env.provisioning
+        ctrl.use_tpu_kernel = True
+        monkeypatch.setenv("KC_TPU_WARMUP", "1")
+        started = []
+        release = threading.Event()
+
+        def fake_warmup(self, **kwargs):
+            started.append(threading.current_thread())
+            release.wait(5.0)
+            return True
+
+        from karpenter_core_tpu.solver.tpu import TPUSolver
+
+        monkeypatch.setattr(TPUSolver, "warmup", fake_warmup)
+        threads = [
+            threading.Thread(target=ctrl._maybe_start_warmup) for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5.0)
+        release.set()
+        ctrl.join_warmup(timeout=5.0)
+        assert len(started) == 1, "check-then-set raced: multiple warmups ran"
+        assert not ctrl._warmup_thread.is_alive()

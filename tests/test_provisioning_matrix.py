@@ -415,3 +415,15 @@ class TestVolumeTopologyMatrix:
         result = expect_provisioned(env, pod)
         node = expect_scheduled(env, result, pod)
         assert node.metadata.labels[ZONE] == "test-zone-2"
+
+
+class TestOverheadTooLarge:
+    def test_daemon_overhead_too_large_blocks_scheduling(self):
+        # suite_test.go:398-406: overhead bigger than every instance type's
+        # allocatable leaves nothing for the pod — it must not schedule
+        env = make_environment()
+        env.kube.create(make_provisioner())
+        env.kube.create(make_daemonset_pod(requests={"cpu": 10000}, unschedulable=False))
+        pod = make_pod(requests={"cpu": "100m"})
+        result = expect_provisioned(env, pod)
+        expect_not_scheduled(env, result, pod)

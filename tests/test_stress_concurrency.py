@@ -259,6 +259,10 @@ class TestLeaderElectionRaces:
         ]
         electors[0].tick()
         assert electors[0].is_leader
+        # observation discipline (ADVICE r4 #1): standbys time staleness from
+        # their OWN first sight of the lease — observe before the silence
+        for elector in electors[1:]:
+            elector.tick()
         clock.step(10.0)  # leader goes silent
 
         def worker(i):

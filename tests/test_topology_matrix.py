@@ -581,12 +581,12 @@ class TestPodAntiAffinity:
         assert all(len(n.pods) == 1 for n in tpu.new_nodes)
 
     def test_zone_anti_affinity_not_violated(self):
-        # required zonal anti routes to the host oracle outright: the host's
-        # iterative pass retroactively narrows anti nodes' zones as other
-        # pods co-locate, which the forward scan cannot replay (the explicit
-        # route the no-shape-schedules-fewer contract demands; found by
-        # tests/test_parity_fuzz.py).  Host behavior: pessimistic late
-        # committal, one pod per batch, no two placed pods share a zone.
+        # required zonal anti is in-kernel since round 5 with zone-committal
+        # phases: the kernel places one member per admissible zone in batch
+        # one (nodes pinned to distinct zones — never a violation), while
+        # the host's record-time snapshots reach that fixpoint one pod per
+        # batch (topology_test.go:1879-1923).  The parity fuzzer pins the
+        # >=-with-validity contract; here the placements themselves matter.
         def pods():
             return make_pods(
                 2, labels={"app": "db"}, requests={"cpu": "10m"},
@@ -598,11 +598,14 @@ class TestPodAntiAffinity:
                 ],
             )
 
-        with pytest.raises(KernelUnsupported):
-            classify_pods(pods())
         host = host_solve(pods(), [make_provisioner()])
         assert sum(len(n.pods) for n in host.new_nodes) == 1
         assert len(host.failed_pods) == 1
+        tpu = tpu_solve(pods(), [make_provisioner()])
+        placed = [n for n in tpu.new_nodes if n.pods]
+        assert sum(len(n.pods) for n in placed) == 2
+        zones = [tuple(n.zones) for n in placed]
+        assert all(len(z) == 1 for z in zones) and len(set(zones)) == 2, zones
 
     def test_inverse_anti_affinity_blocks_target(self):
         # topology_test.go:1677 — an anti-affinity OWNER repels the pods its

@@ -210,16 +210,23 @@ class TestKernelTopologyParity:
         host, tpu = compare(lambda: anti_pods(4))
         assert all(len(n.pods) == 1 for n in tpu.new_nodes)
 
-    def test_zonal_anti_affinity_routes_to_host(self):
-        # required zonal anti is classifier-routed to the host oracle: the
-        # iterative host keeps narrowing an anti node's zones as later pods
-        # co-locate onto it, which the forward scan cannot replay
-        # (tests/test_parity_fuzz.py found the under-scheduling interaction).
-        # Host semantics: one per batch, the rest fail (topology_test.go:1896)
-        with pytest.raises(KernelUnsupported):
-            classify_pods(anti_pods(4, key=ZONE))
+    def test_zonal_anti_affinity_in_kernel(self):
+        # required zonal anti is in-kernel since round 5 with ZONE-COMMITTAL
+        # phases: batch one places one member per admissible zone, each node
+        # pinned to its zone — the fixpoint the host only reaches over
+        # batches (one per batch as each node's zone registers,
+        # topology_test.go:1879-1923).  Contract (test_parity_fuzz): never
+        # fewer than the host, same fixpoint, placements validity-checked.
         host = host_solve(anti_pods(4, key=ZONE), [make_provisioner()])
+        assert sum(len(n.pods) for n in host.new_nodes) == 1
         assert len(host.failed_pods) == 3
+        tpu = tpu_solve(anti_pods(4, key=ZONE), [make_provisioner()])
+        placed = [n for n in tpu.new_nodes if n.pods]
+        assert sum(len(n.pods) for n in placed) == 3  # one per zone
+        zones = [tuple(n.zones) for n in placed]
+        assert all(len(z) == 1 for z in zones), zones  # committed singletons
+        assert len(set(zones)) == 3, zones  # all distinct
+        assert len(tpu.failed_pods) + len(tpu.spread_residual_pods) == 1
 
     def test_spread_with_zone_restriction(self):
         def pods():

@@ -1,0 +1,288 @@
+"""Minimal Helm-template renderer for chart validation in CI (no helm binary
+in the image).
+
+Implements the subset of Go-template/Sprig the charts under deploy/charts/
+use: {{ }} actions with whitespace chomping ({{- -}}), `.Values/.Release/
+.Chart` lookups, `include`, `define`, `if/else/end`, `with/end`, and the
+pipe functions quote, nindent, indent, trunc, trimSuffix, toYaml, default.
+NOT a general Helm implementation — tests/test_charts.py renders every
+template with the chart's default values and yaml-parses the output, which
+is exactly the guarantee `helm template | kubectl apply --dry-run` gives a
+chart author.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+ACTION = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.DOTALL)
+
+
+def _chomp(text: str, left: bool) -> str:
+    """Trim whitespace (incl. one newline run) adjacent to a chomping action."""
+    return text.rstrip(" \t\n") if left else text.lstrip(" \t\n")
+
+
+def _to_yaml(value: Any) -> str:
+    return yaml.safe_dump(value, default_flow_style=False, sort_keys=False).rstrip("\n")
+
+
+def _truthy(value: Any) -> bool:
+    return bool(value) and value != {}
+
+
+class Renderer:
+    def __init__(self, values: dict, release_namespace: str, chart_name: str):
+        self.values = values
+        self.release = {"Namespace": release_namespace, "Name": chart_name}
+        self.chart = {"Name": chart_name}
+        self.defines: Dict[str, List[Tuple]] = {}
+
+    # -- parsing --------------------------------------------------------------
+
+    def _tokenize(self, src: str) -> List[Tuple]:
+        """[(kind, payload)]: kind in {text, action}."""
+        out: List[Tuple] = []
+        pos = 0
+        for m in ACTION.finditer(src):
+            text = src[pos : m.start()]
+            if m.group(1) == "-":
+                text = _chomp(text, left=True)
+            if out and out[-1][0] == "chomp-next":
+                out.pop()
+                text = _chomp(text, left=False)
+            if text:
+                out.append(("text", text))
+            out.append(("action", m.group(2)))
+            if m.group(3) == "-":
+                out.append(("chomp-next", None))
+            pos = m.end()
+        tail = src[pos:]
+        if out and out[-1][0] == "chomp-next":
+            out.pop()
+            tail = _chomp(tail, left=False)
+        if tail:
+            out.append(("text", tail))
+        return [t for t in out if t[0] != "chomp-next"]
+
+    def _parse_block(self, tokens: List[Tuple], i: int, stop: Tuple[str, ...]):
+        """Parse until one of `stop` actions; returns (nodes, stop_action, next_i)."""
+        nodes: List[Tuple] = []
+        while i < len(tokens):
+            kind, payload = tokens[i]
+            if kind == "text":
+                nodes.append(("text", payload))
+                i += 1
+                continue
+            action = payload.strip()
+            word = action.split()[0] if action else ""
+            if word in stop:
+                return nodes, action, i + 1
+            if word == "if":
+                body, stopped, i = self._parse_block(tokens, i + 1, ("else", "end"))
+                alt: List[Tuple] = []
+                if stopped.startswith("else"):
+                    alt, _, i = self._parse_block(tokens, i, ("end",))
+                nodes.append(("if", action[2:].strip(), body, alt))
+            elif word == "with":
+                body, _, i = self._parse_block(tokens, i + 1, ("end",))
+                nodes.append(("with", action[4:].strip(), body))
+            elif word == "define":
+                name = action.split('"')[1]
+                body, _, i = self._parse_block(tokens, i + 1, ("end",))
+                self.defines[name] = body
+                # define emits nothing
+            elif action.startswith("/*"):
+                i += 1  # comment
+            else:
+                nodes.append(("expr", action))
+                i += 1
+        return nodes, "", i
+
+    # -- evaluation -----------------------------------------------------------
+
+    def _lookup(self, path: str, dot: Any) -> Any:
+        if path == ".":
+            return dot
+        obj: Any
+        parts = path.lstrip(".").split(".")
+        if parts[0] == "Values":
+            obj, parts = self.values, parts[1:]
+        elif parts[0] == "Release":
+            obj, parts = self.release, parts[1:]
+        elif parts[0] == "Chart":
+            obj, parts = self.chart, parts[1:]
+        else:
+            obj = dot
+        for p in parts:
+            if obj is None:
+                return None
+            obj = obj.get(p) if isinstance(obj, dict) else getattr(obj, p, None)
+        return obj
+
+    def _split_args(self, s: str) -> List[str]:
+        args, buf, depth, in_str = [], "", 0, False
+        for ch in s:
+            if ch == '"':
+                in_str = not in_str
+                buf += ch
+            elif ch == "(" and not in_str:
+                depth += 1
+                buf += ch
+            elif ch == ")" and not in_str:
+                depth -= 1
+                buf += ch
+            elif ch == " " and not in_str and depth == 0:
+                if buf:
+                    args.append(buf)
+                    buf = ""
+            else:
+                buf += ch
+        if buf:
+            args.append(buf)
+        return args
+
+    def _eval_term(self, term: str, dot: Any) -> Any:
+        term = term.strip()
+        if term.startswith("(") and term.endswith(")"):
+            return self._eval_expr(term[1:-1], dot)
+        if term.startswith('"') and term.endswith('"'):
+            return term[1:-1]
+        if re.fullmatch(r"-?\d+", term):
+            return int(term)
+        args = self._split_args(term)
+        fn = args[0]
+        if fn == "include":
+            name = self._eval_term(args[1], dot)
+            body = self.defines.get(name)
+            if body is None:
+                raise KeyError(f"include of undefined template {name!r}")
+            return self._render_nodes(body, dot).strip("\n")
+        if fn == "default":
+            fallback = self._eval_term(args[1], dot)
+            value = self._eval_term(args[2], dot) if len(args) > 2 else None
+            return value if _truthy(value) else fallback
+        if fn == "toYaml":
+            return _to_yaml(self._eval_term(args[1], dot))
+        if fn.startswith("."):
+            return self._lookup(fn, dot)
+        raise ValueError(f"unsupported term: {term!r}")
+
+    def _eval_expr(self, expr: str, dot: Any) -> Any:
+        stages = [s.strip() for s in expr.split("|")]
+        value = self._eval_term(stages[0], dot)
+        for stage in stages[1:]:
+            args = self._split_args(stage)
+            fn = args[0]
+            if fn == "quote":
+                rendered = "true" if value is True else "false" if value is False else str(value)
+                value = '"' + rendered.replace('"', '\\"') + '"'
+            elif fn == "nindent":
+                pad = " " * int(args[1])
+                value = "\n" + "\n".join(
+                    pad + line if line else line for line in str(value).splitlines()
+                )
+            elif fn == "indent":
+                pad = " " * int(args[1])
+                value = "\n".join(
+                    pad + line if line else line for line in str(value).splitlines()
+                )
+            elif fn == "trunc":
+                value = str(value)[: int(args[1])]
+            elif fn == "trimSuffix":
+                suffix = self._eval_term(args[1], dot)
+                value = str(value)
+                if value.endswith(suffix):
+                    value = value[: -len(suffix)]
+            elif fn == "toYaml":
+                value = _to_yaml(value)
+            elif fn == "default":
+                fallback = self._eval_term(args[1], dot)
+                value = value if _truthy(value) else fallback
+            else:
+                raise ValueError(f"unsupported pipe function: {fn!r}")
+        return value
+
+    def _render_nodes(self, nodes: List[Tuple], dot: Any) -> str:
+        out: List[str] = []
+        for node in nodes:
+            kind = node[0]
+            if kind == "text":
+                out.append(node[1])
+            elif kind == "expr":
+                value = self._eval_expr(node[1], dot)
+                if value is not None:
+                    out.append(str(value))
+            elif kind == "if":
+                _, cond, body, alt = node
+                branch = body if _truthy(self._eval_expr(cond, dot)) else alt
+                out.append(self._render_nodes(branch, dot))
+            elif kind == "with":
+                _, expr, body = node
+                value = self._eval_expr(expr, dot)
+                if _truthy(value):
+                    out.append(self._render_nodes(body, value))
+        return "".join(out)
+
+    def render(self, src: str) -> str:
+        tokens = self._tokenize(src)
+        nodes, _, _ = self._parse_block(tokens, 0, ())
+        return self._render_nodes(nodes, None)
+
+
+def render_chart(
+    chart_dir: str,
+    namespace: str = "karpenter",
+    value_overrides: Optional[dict] = None,
+) -> Dict[str, List[dict]]:
+    """Render every template with the chart's default values (plus overrides);
+    returns {template filename: [parsed yaml documents]}."""
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        chart = yaml.safe_load(f)
+    values_path = os.path.join(chart_dir, "values.yaml")
+    values: dict = {}
+    if os.path.exists(values_path):
+        with open(values_path) as f:
+            values = yaml.safe_load(f) or {}
+
+    def deep_merge(base: dict, extra: dict) -> dict:
+        for k, v in extra.items():
+            if isinstance(v, dict) and isinstance(base.get(k), dict):
+                deep_merge(base[k], v)
+            else:
+                base[k] = v
+        return base
+
+    deep_merge(values, value_overrides or {})
+    renderer = Renderer(values, namespace, chart["name"])
+    tmpl_dir = os.path.join(chart_dir, "templates")
+    names = sorted(os.listdir(tmpl_dir))
+    # helpers first: defines must exist before includes evaluate
+    for name in names:
+        if name.endswith(".tpl"):
+            with open(os.path.join(tmpl_dir, name)) as f:
+                renderer.render(f.read())
+    out: Dict[str, List[dict]] = {}
+    for name in names:
+        if not name.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(tmpl_dir, name)) as f:
+            rendered = renderer.render(f.read())
+        docs = [d for d in yaml.safe_load_all(rendered) if d]
+        out[name] = docs
+    return out
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    result = render_chart(sys.argv[1] if len(sys.argv) > 1 else "deploy/charts/karpenter-core-tpu")
+    for tmpl, docs in result.items():
+        for doc in docs:
+            print(f"# {tmpl}: {doc.get('kind')}/{doc.get('metadata', {}).get('name')}")
+    print(json.dumps({k: len(v) for k, v in result.items()}))
