@@ -14,6 +14,9 @@
 {{- define "karpenter.labels" -}}
 app.kubernetes.io/name: {{ include "karpenter.name" . }}
 app.kubernetes.io/managed-by: Helm
+{{- with .Values.additionalLabels }}
+{{ toYaml . }}
+{{- end }}
 {{- end }}
 
 {{- define "karpenter.selectorLabels" -}}

@@ -316,6 +316,8 @@ class PlacementValidator:
         if not limits:
             return []
         attached = defaultdict(set)
+        scoped_uids = {p.uid for p in self.scoped}
+        scoped_drivers = set()  # drivers this validation's own pods attach
         for p in pods:
             for volume in getattr(p.spec, "volumes", None) or []:
                 src = volume.persistent_volume_claim
@@ -337,10 +339,16 @@ class PlacementValidator:
                         driver = sc.provisioner
                 if driver:
                     attached[driver].add((p.metadata.namespace, src.claim_name))
+                    if p.uid in scoped_uids:
+                        scoped_drivers.add(driver)
         out = []
         for driver, claims in attached.items():
             limit = limits.get(driver)
-            if limit is not None and len(claims) > limit:
+            if limit is not None and len(claims) > limit and driver in scoped_drivers:
+                # attach limits constrain placements made AGAINST them: only a
+                # violation when a pod under validation contributed — a limit
+                # registered after earlier pods were bound doesn't invalidate
+                # those earlier placements (volumeusage.go validates per add)
                 out.append(
                     f"node {name}: {len(claims)} {driver} attachments > limit {limit}"
                 )

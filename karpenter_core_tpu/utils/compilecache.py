@@ -135,11 +135,15 @@ def enable() -> None:
             # call would initialize the backend eagerly inside Operator.start
             # (multi-second TPU bring-up on the startup critical path, even
             # for remote-solve-only replicas that never solve locally).
+            # ...and an EMPTY platform (auto-detection) counts as CPU: the
+            # unpinned case is exactly the dev/CI box this guard protects,
+            # while every deployed accelerator path names its platform
+            # (JAX_PLATFORMS=axon/tpu in the env or a pinned config).
             forced = os.environ.get("KC_TPU_XLA_CACHE")
             platform = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
             use_xla_cache = (
                 forced != "0" if forced is not None
-                else not platform.startswith("cpu")
+                else bool(platform) and not platform.startswith("cpu")
             )
             if use_xla_cache:
                 directory = os.path.join(cache_dir(), f"xla-{_machine_tag()}")

@@ -97,11 +97,25 @@ class TestMainChart:
         assert resources["requests"]["cpu"] == "2"
 
     def test_solver_has_disruption_budget(self):
-        # a solver outage halts lease renewal — the PDB keeps voluntary
-        # disruptions bounded (ADVICE r4 #2)
+        # a solver outage halts lease renewal (ADVICE r4 #2): the singleton
+        # must BLOCK voluntary evictions — maxUnavailable 1 on replicas 1
+        # would permit every eviction and protect nothing
         solver = render_chart(CHART)["solver.yaml"]
         pdb = next(d for d in solver if d["kind"] == "PodDisruptionBudget")
-        assert pdb["spec"]["maxUnavailable"] == 1
+        assert pdb["spec"] == {
+            "minAvailable": 1,
+            "selector": {
+                "matchLabels": {
+                    "app.kubernetes.io/name": "karpenter-core-tpu-solver"
+                }
+            },
+        }
+
+    def test_additional_labels_render_everywhere(self):
+        docs = render_chart(CHART, value_overrides={"additionalLabels": {"team": "x"}})
+        for tmpl in ("deployment.yaml", "service.yaml", "configmap.yaml"):
+            for doc in docs[tmpl]:
+                assert doc["metadata"]["labels"]["team"] == "x", (tmpl, doc)
 
     def test_logging_configmap(self):
         docs = render_chart(CHART)["configmap-logging.yaml"]

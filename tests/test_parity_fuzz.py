@@ -88,9 +88,24 @@ def random_class(rng: random.Random, index: int):
         kwargs["tolerations"] = [Toleration(key="fuzz-taint", operator="Exists")]
     if rng.random() < 0.15:
         kwargs["host_ports"] = [8000 + rng.randrange(4)]
+    # CSI volumes: shared claim (the whole class mounts one PVC — counts once
+    # per node) or statefulset-style per-pod claims (each pod its own — the
+    # attach-limit caps pods per node); exercises the claim-driver encode and
+    # the kernel's volume planes against the host's VolumeUsage
+    pvc_mode = None
+    if rng.random() < 0.2:
+        pvc_mode = rng.choice(("shared", "per-pod"))
 
     count = rng.randrange(1, 9)
-    return [make_pod(**kwargs) for _ in range(count)]
+    pods = []
+    for i in range(count):
+        kw = dict(kwargs)
+        if pvc_mode == "shared":
+            kw["pvcs"] = [f"fuzz-claim-{group}"]
+        elif pvc_mode == "per-pod":
+            kw["pvcs"] = [f"fuzz-claim-{group}-{i}"]
+        pods.append(make_pod(**kw))
+    return pods
 
 
 def random_batch(seed: int):
@@ -100,6 +115,58 @@ def random_batch(seed: int):
         pods.extend(random_class(rng, index))
     rng.shuffle(pods)
     return pods
+
+
+def create_volume_objects(env, pods, seed: int) -> None:
+    """StorageClass + a PVC per claim referenced by the batch, and — on warm
+    clusters — a CSINode with a small attach limit per ready node."""
+    from karpenter_core_tpu.apis.objects import (
+        ObjectMeta,
+        PersistentVolumeClaim,
+        PersistentVolumeClaimSpec,
+        StorageClass,
+    )
+
+    claims = {
+        v.persistent_volume_claim.claim_name
+        for p in pods
+        for v in p.spec.volumes or []
+        if v.persistent_volume_claim is not None
+    }
+    if not claims:
+        return
+    if env.kube.get_storage_class("fuzz-sc") is None:
+        env.kube.create(
+            StorageClass(metadata=ObjectMeta(name="fuzz-sc"), provisioner="csi.fuzz")
+        )
+    for name in sorted(claims):
+        if env.kube.get_persistent_volume_claim("default", name) is None:
+            env.kube.create(
+                PersistentVolumeClaim(
+                    metadata=ObjectMeta(name=name, namespace="default"),
+                    spec=PersistentVolumeClaimSpec(storage_class_name="fuzz-sc"),
+                )
+            )
+
+
+def create_csinodes(env, seed: int) -> None:
+    """Attach limits on every ready node (statefulset fuzz shapes hit them)."""
+    from karpenter_core_tpu.apis.objects import CSINode, CSINodeDriver, ObjectMeta
+
+    rng = random.Random(seed * 104729)
+    for node in env.kube.list_nodes():
+        if env.kube.get_csi_node(node.name) is None:
+            env.kube.create(
+                CSINode(
+                    metadata=ObjectMeta(name=node.name),
+                    drivers=[
+                        CSINodeDriver(
+                            name="csi.fuzz",
+                            allocatable_count=rng.randrange(1, 4),
+                        )
+                    ],
+                )
+            )
 
 
 def provisioners_for(seed: int):
@@ -162,6 +229,7 @@ def controller_solve(seed: int, use_kernel: bool):
     env.provisioning.use_tpu_kernel = use_kernel
     env.provisioning.tpu_kernel_min_pods = 1
     pods = random_batch(seed)
+    create_volume_objects(env, pods, seed)
     result = expect_provisioned(env, *pods)
     expect_valid_placements(env, pods)
     scheduled = Counter()
@@ -278,12 +346,15 @@ def test_fuzzed_batch_parity_with_existing_nodes(seed):
             env.kube.create(provisioner)
         env.provisioning.use_tpu_kernel = False  # identical wave-one clusters
         first = random_batch(wave_one)
+        create_volume_objects(env, first, wave_one)
         expect_provisioned(env, *first)
         env.make_all_nodes_ready()
+        create_csinodes(env, seed)  # attach limits on the warm nodes
         env.clock.step(21)
         env.provisioning.use_tpu_kernel = use_kernel
         env.provisioning.tpu_kernel_min_pods = 1
         pods = random_batch(seed)
+        create_volume_objects(env, pods, seed)
         result = expect_provisioned(env, *pods)
         expect_valid_placements(env, pods)
         scheduled = Counter()

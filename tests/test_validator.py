@@ -127,6 +127,45 @@ class TestValidatorCatches:
         violations = validate_placements(env)
         assert any("pod affinity" in v for v in violations), violations
 
+    def test_volume_attach_limit_violation(self):
+        from karpenter_core_tpu.apis.objects import (
+            CSINode,
+            CSINodeDriver,
+            ObjectMeta,
+            PersistentVolumeClaim,
+            PersistentVolumeClaimSpec,
+            StorageClass,
+        )
+
+        env, node = env_with_node()
+        env.kube.create(
+            StorageClass(metadata=ObjectMeta(name="sc"), provisioner="csi.x")
+        )
+        env.kube.create(
+            CSINode(
+                metadata=ObjectMeta(name=node.name),
+                drivers=[CSINodeDriver(name="csi.x", allocatable_count=1)],
+            )
+        )
+        pods = []
+        for i in range(2):
+            env.kube.create(
+                PersistentVolumeClaim(
+                    metadata=ObjectMeta(name=f"c{i}", namespace="default"),
+                    spec=PersistentVolumeClaimSpec(storage_class_name="sc"),
+                )
+            )
+            pod = make_pod(requests={"cpu": "100m"}, pvcs=[f"c{i}"])
+            bind(env, pod, node)
+            pods.append(pod)
+        violations = validate_placements(env, pods)
+        assert any("attachments > limit" in v for v in violations), violations
+        # but the same overage is NOT a violation of a batch that didn't
+        # contribute to it (limits constrain placements made against them)
+        other = make_pod(requests={"cpu": "100m"})
+        bind(env, other, node)
+        assert validate_placements(env, [other]) == []
+
     def test_zone_spread_skew_violation(self):
         env, node_a = env_with_node(zone="test-zone-1")
         constraint = TopologySpreadConstraint(
