@@ -22,51 +22,28 @@ JSON failure line instead of a traceback.
 """
 
 import json
+import logging
 import os
 import subprocess
 import sys
 import time
 
-# written by acquire_backend; stamped into every output line (success or not)
-_BACKEND = {"platform": None, "attempts": 0, "fell_back": False, "probe_failures": []}
-
-_PROBE_SNIPPET = (
-    "import jax, jax.numpy as jnp;"
-    "jnp.ones((8, 8)).sum().block_until_ready();"
-    "print('PLATFORM=' + jax.default_backend())"
-)
-
-
-def _probe_once(timeout_s: float):
-    """One fresh-interpreter device probe: init backend + run a tiny op.
-
-    Returns (platform, "") on success, (None, reason) on failure.  A fresh
-    process per attempt matters twice over: JAX caches a failed backend init
-    for the life of a process, and the axon relay's failure mode is a hang
-    that only a subprocess timeout can bound.
-    """
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _PROBE_SNIPPET],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        return None, f"probe hung past {timeout_s:.0f}s (killed)"
-    if proc.returncode == 0:
-        for line in proc.stdout.splitlines():
-            if line.startswith("PLATFORM="):
-                return line.split("=", 1)[1].strip(), ""
-        return None, "probe exited 0 but printed no platform"
-    tail = (proc.stderr or proc.stdout).strip().splitlines()
-    return None, (tail[-1][:300] if tail else f"probe rc={proc.returncode}")
+# written by acquire_backend; stamped into every output line (success or not).
+# ``probes`` carries one record per attempt (outcome + duration) so relay
+# hangs are visible in the bench JSON instead of silently burning minutes.
+_BACKEND = {
+    "platform": None, "attempts": 0, "fell_back": False,
+    "probe_failures": [], "probes": [],
+}
 
 
 def acquire_backend(max_attempts: int = 5, probe_timeout_s: float = 60.0,
                     deadline_s: float = 360.0) -> None:
     """Bounded-retry backend bring-up; never raises.
 
-    Up to ``max_attempts`` probes with exponential backoff under an overall
-    deadline.  First success wins — the backend is then known-healthy and this
+    Delegates to solver.backendprobe (fresh-interpreter probes with hard
+    timeouts, each attempt recorded as a counter + histogram + structured log
+    line).  First success wins — the backend is then known-healthy and this
     process imports jax normally.  All-fail re-execs this process onto CPU
     (``_reexec_on_cpu``) so the bench still produces a verified number with
     ``platform: "cpu"`` stamped, rather than dying the way round 2's run did
@@ -80,23 +57,24 @@ def acquire_backend(max_attempts: int = 5, probe_timeout_s: float = 60.0,
     if pinned:
         _BACKEND.update(json.loads(pinned))
         return
-    t0 = time.monotonic()
-    attempt = 0
-    while attempt < max_attempts:
-        attempt += 1
-        platform, err = _probe_once(probe_timeout_s)
-        if platform is not None:
-            _BACKEND.update(platform=platform, attempts=attempt, fell_back=False)
-            return
-        _BACKEND["probe_failures"].append(f"attempt {attempt}: {err}")
-        print(f"backend probe {attempt}/{max_attempts} failed: {err}", file=sys.stderr)
-        if attempt < max_attempts and time.monotonic() - t0 < deadline_s:
-            time.sleep(min(5.0 * 2 ** (attempt - 1), 60.0))
-        elif time.monotonic() - t0 >= deadline_s:
-            _BACKEND["probe_failures"].append(f"deadline {deadline_s:.0f}s exhausted")
-            break
-    _BACKEND.update(platform="cpu", attempts=attempt, fell_back=True)
-    _reexec_on_cpu()
+    # surface backendprobe's structured per-attempt log lines on stderr
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    from karpenter_core_tpu.solver import backendprobe
+
+    state = backendprobe.acquire_backend(
+        max_attempts=max_attempts,
+        probe_timeout_s=probe_timeout_s,
+        deadline_s=deadline_s,
+    )
+    _BACKEND.update(
+        platform=state.platform,
+        attempts=state.attempts,
+        fell_back=state.fell_back,
+        probe_failures=state.probe_failures,
+        probes=state.probes,
+    )
+    if state.fell_back:
+        _reexec_on_cpu()
 
 
 def run_pinned(platform: str, timeout_s: float = 1800.0, extra_env=None) -> dict:
@@ -376,6 +354,43 @@ def consolidation_sweep_line(n_nodes: int = 1000, pods_per_node: int = 3) -> dic
     }
 
 
+def _traced_solve(solver, pods) -> dict:
+    """One fully-traced ingest → encode → dispatch → solve → decode →
+    materialize pass; returns {"trace_id", "stages"} for the bench line."""
+    from karpenter_core_tpu import tracing
+    from karpenter_core_tpu.models.columnar import PodIngest
+    from karpenter_core_tpu.ops import solve as solve_ops
+
+    was_enabled = tracing.enabled()
+    tracing.enable()
+    try:
+        with tracing.span("bench.solve", pods=len(pods)):
+            ingest = PodIngest()
+            ingest.add_all(pods)
+            snapshot = solver.encode(ingest)
+            out = solve_ops.solve(snapshot)
+            results = solver.decode(snapshot, out)
+            if results.new_nodes:
+                results.new_nodes[0].instance_type_names  # noqa: B018 - materialize
+        trace = tracing.TRACE_STORE.last(1)[-1]
+        dump_path = os.environ.get("KC_BENCH_TRACE", "")
+        if dump_path:
+            with open(dump_path, "w") as f:
+                json.dump(tracing.to_chrome([trace]), f)
+        return {
+            "trace_id": trace.trace_id,
+            "stages": {
+                name: round(duration, 4)
+                for name, duration in sorted(trace.stage_durations().items())
+            },
+        }
+    except Exception as e:  # noqa: BLE001 - the trace never kills the headline
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+    finally:
+        if not was_enabled:
+            tracing.disable()
+
+
 def main() -> None:
     n_pods = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
     n_its = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000
@@ -431,6 +446,13 @@ def main() -> None:
         results.new_nodes[0].instance_type_names  # noqa: B018 - forces the fetch
     materialize_s = time.perf_counter() - t0
 
+    # per-stage trace: ONE extra solve with tracing on (span close syncs the
+    # device, so stage attribution is exact) — run OUTSIDE the timed loop so
+    # the sync points can't perturb the headline number.  The trace rides the
+    # output line; KC_BENCH_TRACE=path additionally dumps Chrome trace-event
+    # JSON loadable in chrome://tracing / Perfetto.
+    trace_detail = _traced_solve(solver, pods)
+
     # restart cold: a fresh process with the persistent caches this process
     # just populated — the cost every operator restart actually pays.  The
     # child inherits os.environ, so a CPU fallback pins it too.
@@ -460,6 +482,7 @@ def main() -> None:
         "dispatch_s": round(dispatch_s, 4),
         "solve_decode_s": round(solve_decode_s, 4),
         "materialize_s": round(materialize_s, 4),
+        "trace": trace_detail,
         "platform": _BACKEND["platform"],
         "backend_attempts": _BACKEND["attempts"],
         "backend_fell_back_to_cpu": _BACKEND["fell_back"],
@@ -471,6 +494,8 @@ def main() -> None:
     }
     if _BACKEND["probe_failures"]:
         detail["backend_probe_failures"] = _BACKEND["probe_failures"]
+    if _BACKEND["probes"]:
+        detail["backend_probes"] = _BACKEND["probes"]
 
     # scale lines (BASELINE.md configs 3-4): on by default on a real
     # accelerator, opt-in/out via KC_BENCH_SCALE=1/0 (CPU runs them only on

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from karpenter_core_tpu import tracing
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.v1alpha5 import Provisioner
 from karpenter_core_tpu.state.cluster import Cluster
@@ -22,6 +23,7 @@ class CounterController:
         self.kube_client = kube_client
         self.cluster = cluster
 
+    @tracing.traced("counter.reconcile")
     def reconcile(self, provisioner: Provisioner) -> Optional[float]:
         stored = self.kube_client.get(Provisioner, provisioner.name)
         if stored is None:

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable, List, Optional, Tuple
 
+from karpenter_core_tpu import tracing
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import Node, Pod, PodDisruptionBudget
 from karpenter_core_tpu.apis.v1alpha5 import Provisioner
@@ -1000,6 +1001,12 @@ class DeprovisioningController:
         """(result, requeue_after_seconds) — controller.go:107-128.  RETRY and
         FAILED back off exponentially (the reference's rate-limited workqueue
         requeue) instead of spinning."""
+        with tracing.span("deprovisioning.reconcile") as sp:
+            result, requeue = self._reconcile()
+            sp.set(result=result.name)
+            return result, requeue
+
+    def _reconcile(self) -> Tuple[Result, float]:
         current_state = self.cluster.cluster_consolidation_state()
         result, err = self.process_cluster()
         if result == Result.FAILED:

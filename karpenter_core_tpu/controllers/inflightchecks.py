@@ -13,6 +13,7 @@ import logging
 from dataclasses import dataclass
 from typing import List, Optional
 
+from karpenter_core_tpu import tracing
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import Node
 from karpenter_core_tpu.apis.v1alpha5 import Provisioner
@@ -137,6 +138,7 @@ class InflightChecksController:
         self._last_scan = {}
         self._reported = {}
 
+    @tracing.traced("inflightchecks.reconcile")
     def reconcile(self, node: Node) -> Optional[float]:
         provisioner_name = node.metadata.labels.get(labels_api.PROVISIONER_NAME_LABEL_KEY)
         if not provisioner_name:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Dict
 
+from karpenter_core_tpu import tracing
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import POD_RUNNING, Pod
 from karpenter_core_tpu.metrics import REGISTRY
@@ -123,6 +124,7 @@ class PodScraper:
         if event_type == "DELETED":
             self._started.pop(pod.uid, None)
 
+    @tracing.traced("metrics_pod.reconcile")
     def reconcile(self, pod: Pod) -> None:
         node = self.kube_client.get_node(pod.spec.node_name) if pod.spec.node_name else None
         node_labels = node.metadata.labels if node is not None else {}

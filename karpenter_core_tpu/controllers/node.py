@@ -10,6 +10,7 @@ from __future__ import annotations
 import logging
 from typing import Optional, Tuple
 
+from karpenter_core_tpu import tracing
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import Node, OwnerReference
 from karpenter_core_tpu.apis.v1alpha5 import Machine, MachineSpec, MachineStatus, Provisioner
@@ -199,6 +200,7 @@ class NodeController:
         self.finalizer = Finalizer()
         self.drift = DriftDetector(cloud_provider, settings)
 
+    @tracing.traced("node.reconcile")
     def reconcile(self, node: Node) -> Optional[float]:
         stored = self.kube_client.get_node(node.name)
         if stored is None or stored.metadata.deletion_timestamp is not None:

@@ -18,6 +18,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+from karpenter_core_tpu import tracing
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import (
     OP_IN,
@@ -177,6 +178,7 @@ class PodController:
     def __init__(self, provisioner: "ProvisioningController") -> None:
         self.provisioner = provisioner
 
+    @tracing.traced("provisioning_trigger.reconcile")
     def reconcile(self, pod: Pod) -> None:
         if pod_util.is_provisionable(pod):
             self.provisioner.trigger()
@@ -315,8 +317,14 @@ class ProvisioningController:
     # -- reconcile ------------------------------------------------------------
 
     def reconcile(self, wait_for_batch: bool = True) -> Optional[str]:
+        # the span opens after the batch window so idle wait time doesn't
+        # masquerade as reconcile latency in the stage histogram
         if wait_for_batch and not self.batcher.wait():
             return None
+        with tracing.span("provisioning.reconcile"):
+            return self._reconcile_batch()
+
+    def _reconcile_batch(self) -> Optional[str]:
         state_nodes = []
         deleting_nodes = []
         for node in self.cluster.snapshot_nodes():
@@ -390,6 +398,10 @@ class ProvisioningController:
                 break
 
     def schedule(self, pods: List[Pod], state_nodes) -> Tuple[Optional[SchedulingResults], Optional[str]]:
+        with tracing.span("schedule", pods=len(pods), state_nodes=len(state_nodes)):
+            return self._schedule(pods, state_nodes)
+
+    def _schedule(self, pods: List[Pod], state_nodes) -> Tuple[Optional[SchedulingResults], Optional[str]]:
         done = measure(SCHEDULING_DURATION.labels("default"))
         try:
             for pod in pods:

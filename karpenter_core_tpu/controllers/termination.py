@@ -14,6 +14,7 @@ import logging
 import threading
 from typing import List, Optional, Set, Tuple
 
+from karpenter_core_tpu import tracing
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import Node, Pod
 from karpenter_core_tpu.cloudprovider import MachineNotFoundError
@@ -196,6 +197,7 @@ class TerminationController:
         self.eviction_queue = EvictionQueue(kube_client, recorder, clock)
         self.terminator = Terminator(clock, kube_client, cloud_provider, self.eviction_queue)
 
+    @tracing.traced("termination.reconcile")
     def reconcile(self, node: Node) -> Optional[float]:
         """Requeue seconds while draining, None when finalized."""
         stored = self.kube_client.get_node(node.name)

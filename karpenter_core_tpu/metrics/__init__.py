@@ -6,6 +6,7 @@ from karpenter_core_tpu.metrics.registry import (
     Summary,
     REGISTRY,
     DURATION_BUCKETS,
+    SOLVE_STAGE_DURATION,
     measure,
 )
 
@@ -17,5 +18,6 @@ __all__ = [
     "Registry",
     "REGISTRY",
     "DURATION_BUCKETS",
+    "SOLVE_STAGE_DURATION",
     "measure",
 ]

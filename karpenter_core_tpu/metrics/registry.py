@@ -57,6 +57,11 @@ class _Metric:
     def samples(self) -> List[Tuple[str, Dict[str, str], float]]:
         raise NotImplementedError
 
+    def samples_with_exemplars(self):
+        """samples() widened with a per-sample exemplar slot (None for metric
+        kinds without exemplar support)."""
+        return [(name, labels, value, None) for name, labels, value in self.samples()]
+
     def _label_dicts(self):
         with self._lock:
             return [
@@ -126,12 +131,30 @@ class _HistogramChild:
         self.counts = [0] * (len(buckets) + 1)
         self.total = 0.0
         self.count = 0
+        # bucket idx -> (exemplar labels, observed value, wall time): the last
+        # observation per bucket that carried an exemplar (e.g. a trace id)
+        self.exemplars: Dict[int, Tuple[Dict[str, str], float, float]] = {}
+        # exposition emits cumulative buckets that must satisfy +Inf == _count;
+        # an unlocked mid-observe scrape would transiently violate it
+        self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[Dict[str, str]] = None) -> None:
         idx = bisect.bisect_left(self.buckets, value)
-        self.counts[idx] += 1
-        self.total += value
-        self.count += 1
+        with self._lock:
+            self.counts[idx] += 1
+            self.total += value
+            self.count += 1
+            if exemplar:
+                self.exemplars[idx] = (dict(exemplar), float(value), time.time())
+
+    def snapshot(self):
+        """(counts, total, count, exemplars) read atomically for exposition."""
+        with self._lock:
+            return list(self.counts), self.total, self.count, dict(self.exemplars)
+
+
+def _format_bound(bound: float) -> str:
+    return format(bound, "g")
 
 
 class Histogram(_Metric):
@@ -144,14 +167,37 @@ class Histogram(_Metric):
     def _new_child(self):
         return _HistogramChild(self.buckets)
 
-    def observe(self, value: float) -> None:
-        self.labels().observe(value)
+    def observe(self, value: float, exemplar: Optional[Dict[str, str]] = None) -> None:
+        self.labels().observe(value, exemplar=exemplar)
 
     def samples(self):
+        return [(name, labels, value) for name, labels, value, _ in self.samples_with_exemplars()]
+
+    def samples_with_exemplars(self):
+        """Prometheus histogram exposition: cumulative ``_bucket{le=...}``
+        lines (including ``le="+Inf"``) plus ``_count``/``_sum``.  The fourth
+        element carries the bucket's exemplar (or None)."""
         out = []
         for labels, h in self._label_dicts():
-            out.append((self.name + "_count", labels, float(h.count)))
-            out.append((self.name + "_sum", labels, h.total))
+            counts, total, count, exemplars = h.snapshot()
+            cumulative = 0
+            for i, bound in enumerate(h.buckets):
+                cumulative += counts[i]
+                out.append((
+                    self.name + "_bucket",
+                    {**labels, "le": _format_bound(bound)},
+                    float(cumulative),
+                    exemplars.get(i),
+                ))
+            cumulative += counts[-1]
+            out.append((
+                self.name + "_bucket",
+                {**labels, "le": "+Inf"},
+                float(cumulative),
+                exemplars.get(len(h.buckets)),
+            ))
+            out.append((self.name + "_count", labels, float(count), None))
+            out.append((self.name + "_sum", labels, total, None))
         return out
 
 
@@ -224,24 +270,45 @@ class Registry:
             for metric in self._metrics.values():
                 metric.clear()
 
-    def render(self) -> str:
-        """Prometheus text exposition."""
+    def render(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition.  With ``exemplars=True`` bucket lines
+        carry their exemplar in OpenMetrics syntax
+        (``... # {trace_id="..."} value timestamp``)."""
         lines = []
         with self._lock:
             metrics = list(self._metrics.values())
         for metric in metrics:
             lines.append(f"# HELP {metric.name} {metric.help}")
             lines.append(f"# TYPE {metric.name} {metric.kind}")
-            for name, labels, value in metric.samples():
+            for name, labels, value, exemplar in metric.samples_with_exemplars():
                 if labels:
                     rendered = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
-                    lines.append(f"{name}{{{rendered}}} {value}")
+                    line = f"{name}{{{rendered}}} {value}"
                 else:
-                    lines.append(f"{name} {value}")
+                    line = f"{name} {value}"
+                if exemplars and exemplar is not None:
+                    ex_labels, ex_value, ex_wall = exemplar
+                    ex_rendered = ",".join(
+                        f'{k}="{v}"' for k, v in sorted(ex_labels.items())
+                    )
+                    line += f" # {{{ex_rendered}}} {ex_value} {ex_wall:.3f}"
+                lines.append(line)
         return "\n".join(lines) + "\n"
 
 
 REGISTRY = Registry()
+
+# Per-stage solve-pipeline histogram: one series per tracing span name
+# (ingest/encode/dispatch/solve/decode/materialize plus the controller
+# reconcile spans), observed at span close by tracing/trace.py with a
+# trace_id exemplar — a latency outlier on a scrape links straight back to
+# the trace that produced it (render(exemplars=True)).
+SOLVE_STAGE_DURATION = Histogram(
+    NAMESPACE + "_solve_stage_duration_seconds",
+    "Duration of solve-pipeline stages, labeled by tracing span name.",
+    ("stage",),
+)
+REGISTRY.register(SOLVE_STAGE_DURATION)
 
 
 def measure(observer, clock=None):

@@ -130,8 +130,12 @@ class PodIngest:
         self._by_uid[pod.uid] = sig
 
     def add_all(self, pods: List[Pod]) -> None:
-        for pod in pods:
-            self.add(pod)
+        from karpenter_core_tpu import tracing
+
+        with tracing.span("ingest", pods=len(pods)) as sp:
+            for pod in pods:
+                self.add(pod)
+            sp.set(classes=len(self._slots))
 
     def remove(self, uid: str) -> bool:
         sig = self._by_uid.pop(uid, None)

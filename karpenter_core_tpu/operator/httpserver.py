@@ -14,11 +14,16 @@ Mirror of the reference's serving surface:
       /debug/pprof/heap               tracemalloc top allocations (started on
                                       first request)
       /debug/pprof/device             accelerator memory stats (jax)
+  - /debug/traces on the metrics port — the last N completed solve traces
+    (tracing.TRACE_STORE) with their decision audits; ``?n=K`` limits,
+    ``?format=chrome`` emits Chrome trace-event JSON for chrome://tracing /
+    Perfetto.  See docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
 
 import collections
+import json
 import logging
 import sys
 import threading
@@ -27,6 +32,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
+from karpenter_core_tpu import tracing
 from karpenter_core_tpu.metrics import REGISTRY
 
 log = logging.getLogger(__name__)
@@ -105,7 +111,26 @@ class OperatorHTTP:
             def do_GET(self) -> None:  # noqa: N802 - http.server contract
                 parsed = urlparse(self.path)
                 if parsed.path == "/metrics":
+                    query = parse_qs(parsed.query)
+                    if query.get("exemplars", ["0"])[0] == "1":
+                        # exemplar suffixes use OpenMetrics *syntax* but this
+                        # registry's families are not strict-OpenMetrics
+                        # conformant (counter _total suffix rules), so the
+                        # content type stays text/plain: ?exemplars=1 is the
+                        # human/debug view for trace correlation — point
+                        # scrapers at the default /metrics
+                        return self._text(200, REGISTRY.render(exemplars=True))
                     return self._text(200, REGISTRY.render())
+                if parsed.path == "/debug/traces":
+                    # same posture as /debug/pprof: debug data (pod names,
+                    # failure strings) is not exposed on a default deployment —
+                    # but enabling tracing (KC_TRACE=1 / tracing.enable()) IS
+                    # the opt-in, so either flag unlocks the endpoint
+                    if not (outer.enable_profiling or tracing.enabled()):
+                        return self._text(
+                            403, "tracing disabled (KC_TRACE=1 or --enable-profiling)\n"
+                        )
+                    return self._traces(parse_qs(parsed.query))
                 if parsed.path.startswith("/debug/pprof"):
                     if not outer.enable_profiling:
                         return self._text(403, "profiling disabled (--enable-profiling)\n")
@@ -123,6 +148,38 @@ class OperatorHTTP:
                     if parsed.path == "/debug/pprof/device":
                         return self._text(200, device_profile())
                 return self._text(404, "not found\n")
+
+            def _traces(self, query) -> None:
+                """The last N solve traces as JSON; ``format=chrome`` emits
+                trace-event JSON loadable in chrome://tracing / Perfetto."""
+                try:
+                    n = int(query.get("n", ["0"])[0])
+                except ValueError:
+                    return self._text(400, f"bad n: {query.get('n')!r}\n")
+                traces = tracing.TRACE_STORE.last(n if n > 0 else None)
+                if query.get("format", [""])[0] == "chrome":
+                    return self._json(200, tracing.to_chrome(traces))
+                return self._json(
+                    200,
+                    {
+                        "enabled": tracing.enabled(),
+                        "capacity": tracing.TRACE_STORE.capacity,
+                        "traces": [t.to_dict() for t in traces],
+                        "audits": [
+                            {"traceId": t.trace_id, **audit}
+                            for t in traces
+                            for audit in t.audits()
+                        ],
+                    },
+                )
+
+            def _json(self, status: int, payload) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
 
             def _text(self, status: int, body: str) -> None:
                 data = body.encode()
@@ -160,7 +217,7 @@ class OperatorHTTP:
         for server in (self._metrics_server, self._health_server):
             threading.Thread(target=server.serve_forever, daemon=True).start()
         log.info(
-            "serving /metrics%s on :%d, probes on :%d",
+            "serving /metrics + /debug/traces%s on :%d, probes on :%d",
             " + /debug/pprof" if self.enable_profiling else "",
             self.metrics_port, self.health_port,
         )

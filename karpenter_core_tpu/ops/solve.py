@@ -1258,11 +1258,13 @@ def solve(snapshot: EncodedSnapshot, n_slots: int = 0) -> SolveOutputs:
     """Run the kernel on an encoded snapshot.  ``n_slots`` defaults to a
     rounded estimate; if slots run out (failed>0 with n_next==n_slots) the
     caller should retry with more (solver.tpu handles this)."""
-    if n_slots <= 0:
-        n_slots = estimate_slots(snapshot)
+    from karpenter_core_tpu import tracing
     from karpenter_core_tpu.utils import compilecache
 
-    host_cls, host_statics, key_has_bounds = prepare_host(snapshot)
+    with tracing.span("prepare", classes=len(snapshot.classes)):
+        if n_slots <= 0:
+            n_slots = estimate_slots(snapshot)
+        host_cls, host_statics, key_has_bounds = prepare_host(snapshot)
     return compilecache.run_solve(
         host_cls, host_statics, n_slots, key_has_bounds,
         n_passes=snapshot.scan_passes,

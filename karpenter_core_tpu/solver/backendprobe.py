@@ -1,0 +1,171 @@
+"""Instrumented TPU backend probing.
+
+The accelerator behind the axon relay fails by HANGING, not by erroring —
+BENCH_r05 burned 5 × 60 s in probe timeouts with zero telemetry (the only
+evidence was the wall clock).  This module is the shared, *observable* probe
+primitive: every attempt records
+
+  - a counter  ``karpenter_backend_probe_total{outcome}``
+  - a histogram ``karpenter_backend_probe_duration_seconds{outcome}``
+    (buckets reach past the 60 s hang regime)
+  - one structured JSON log line (``event: backend_probe``)
+  - a ``backend.probe`` event on the active tracing span, if any
+
+Each probe runs a tiny device op in a FRESH interpreter: JAX caches a failed
+backend init for the life of a process, and a relay hang can only be bounded
+by a subprocess timeout.  ``bench.py`` drives this from its bring-up ladder;
+an operator process can call ``acquire_backend`` the same way.
+
+This module must stay importable before any backend decision is made: nothing
+here imports jax (the probe child does).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from karpenter_core_tpu import tracing
+from karpenter_core_tpu.metrics import REGISTRY
+
+log = logging.getLogger(__name__)
+
+PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp;"
+    "jnp.ones((8, 8)).sum().block_until_ready();"
+    "print('PLATFORM=' + jax.default_backend())"
+)
+
+# durations cluster at either "fast success" (<5 s) or "full hang" (the
+# caller's timeout, typically 60 s) — the buckets must resolve both regimes
+PROBE_BUCKETS = [0.5, 1, 2.5, 5, 10, 20, 30, 45, 60, 90, 120]
+
+PROBE_TOTAL = REGISTRY.counter(
+    "karpenter_backend_probe_total",
+    "Backend bring-up probe attempts by outcome (ok/timeout/error).",
+    ("outcome",),
+)
+PROBE_DURATION = REGISTRY.histogram(
+    "karpenter_backend_probe_duration_seconds",
+    "Duration of backend bring-up probes by outcome.",
+    ("outcome",),
+    buckets=PROBE_BUCKETS,
+)
+
+
+@dataclass
+class ProbeResult:
+    platform: Optional[str]  # e.g. "tpu"/"cpu" on success, None on failure
+    outcome: str  # "ok" | "timeout" | "error"
+    error: str  # empty on success
+    duration_s: float
+    attempt: int = 0
+
+
+@dataclass
+class BackendState:
+    """The verdict of one bring-up ladder (bench JSON ``detail`` shape)."""
+
+    platform: Optional[str] = None
+    attempts: int = 0
+    fell_back: bool = False
+    probe_failures: List[str] = field(default_factory=list)
+    probes: List[dict] = field(default_factory=list)  # per-attempt records
+
+
+def probe_once(timeout_s: float, attempt: int = 0) -> ProbeResult:
+    """One fresh-interpreter device probe: init backend + run a tiny op.
+
+    Never raises; the outcome (including a killed hang) lands in metrics, a
+    structured log line, and the active tracing span."""
+    t0 = time.perf_counter()
+    platform, outcome, error = None, "error", ""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_SNIPPET],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        outcome, error = "timeout", f"probe hung past {timeout_s:.0f}s (killed)"
+    except Exception as e:  # noqa: BLE001 - spawn failures must not surface
+        error = f"probe spawn failed: {e}"
+    else:
+        if proc.returncode == 0:
+            for line in proc.stdout.splitlines():
+                if line.startswith("PLATFORM="):
+                    platform, outcome = line.split("=", 1)[1].strip(), "ok"
+                    break
+            else:
+                error = "probe exited 0 but printed no platform"
+        else:
+            tail = (proc.stderr or proc.stdout).strip().splitlines()
+            error = tail[-1][:300] if tail else f"probe rc={proc.returncode}"
+    duration_s = time.perf_counter() - t0
+
+    PROBE_TOTAL.labels(outcome).inc()
+    PROBE_DURATION.labels(outcome).observe(duration_s)
+    record = {
+        "event": "backend_probe",
+        "attempt": attempt,
+        "outcome": outcome,
+        "platform": platform,
+        "duration_s": round(duration_s, 3),
+        "error": error,
+    }
+    log.info("%s", json.dumps(record))
+    tracing.add_event("backend.probe", **record)
+    return ProbeResult(
+        platform=platform, outcome=outcome, error=error,
+        duration_s=duration_s, attempt=attempt,
+    )
+
+
+def acquire_backend(
+    max_attempts: int = 5,
+    probe_timeout_s: float = 60.0,
+    deadline_s: float = 360.0,
+    sleep=time.sleep,
+) -> BackendState:
+    """Bounded-retry backend bring-up; never raises.
+
+    Probes with exponential backoff under an overall deadline; the first
+    success wins.  All-fail returns ``platform="cpu", fell_back=True`` — the
+    caller decides how to pin itself there (bench re-execs the process).
+    Every attempt is individually visible in ``state.probes``, /metrics, and
+    the log."""
+    state = BackendState()
+    t0 = time.monotonic()
+    attempt = 0
+    while attempt < max_attempts:
+        attempt += 1
+        result = probe_once(probe_timeout_s, attempt=attempt)
+        state.probes.append(
+            {
+                "attempt": attempt,
+                "outcome": result.outcome,
+                "duration_s": round(result.duration_s, 3),
+                "error": result.error,
+            }
+        )
+        if result.platform is not None:
+            state.platform = result.platform
+            state.attempts = attempt
+            return state
+        state.probe_failures.append(f"attempt {attempt}: {result.error}")
+        log.warning(
+            "backend probe %d/%d failed: %s", attempt, max_attempts, result.error
+        )
+        if attempt < max_attempts and time.monotonic() - t0 < deadline_s:
+            sleep(min(5.0 * 2 ** (attempt - 1), 60.0))
+        elif time.monotonic() - t0 >= deadline_s:
+            state.probe_failures.append(f"deadline {deadline_s:.0f}s exhausted")
+            break
+    state.platform = "cpu"
+    state.attempts = attempt
+    state.fell_back = True
+    return state

@@ -14,6 +14,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from karpenter_core_tpu import tracing
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import (
     TAINT_EFFECT_PREFER_NO_SCHEDULE,
@@ -84,6 +85,10 @@ class Scheduler:
         self.daemon_overhead = _daemon_overhead(machine_templates, daemonset_pods)
         self.new_nodes: List[SchedulingNode] = []
         self.existing_nodes: List[ExistingNode] = []
+        # decision audit (tracing enabled only): pod uid -> the most recent
+        # attempt's per-candidate rejections, attached as a decision.audit
+        # span event for pods that end the solve unschedulable
+        self._audit: Dict[str, List[dict]] = {}
         self._calculate_existing_machines(state_nodes, daemonset_pods)
 
     # -- the solve loop -------------------------------------------------------
@@ -93,47 +98,77 @@ class Scheduler:
         (scheduler.go:96-133).  Requeue-with-relaxation handles batch
         pod-affinity and order-dependent skew constraints.
         """
-        errors: Dict[str, str] = {}
-        q = Queue(*pods)
-        while True:
-            pod = q.pop()
-            if pod is None:
-                break
-            err = self._add(pod)
-            errors[pod.uid] = err
-            if err is None:
-                continue
-            relaxed = self.preferences.relax(pod)
-            q.push(pod, relaxed)
-            if relaxed:
-                update_err = self.topology.update(pod)
-                if update_err is not None:
-                    log.error("updating topology, %s", update_err)
+        with tracing.span("scheduler.solve", pods=len(pods)) as sp:
+            errors: Dict[str, str] = {}
+            q = Queue(*pods)
+            while True:
+                pod = q.pop()
+                if pod is None:
+                    break
+                err = self._add(pod)
+                errors[pod.uid] = err
+                if err is None:
+                    continue
+                relaxed = self.preferences.relax(pod)
+                q.push(pod, relaxed)
+                if relaxed:
+                    update_err = self.topology.update(pod)
+                    if update_err is not None:
+                        log.error("updating topology, %s", update_err)
 
-        for n in self.new_nodes:
-            n.finalize_scheduling()
+            for n in self.new_nodes:
+                n.finalize_scheduling()
 
-        failed = q.list()
-        if not self.opts.simulation_mode:
-            self._record_results(pods, failed, errors)
-        return SchedulingResults(
-            new_nodes=self.new_nodes,
-            existing_nodes=self.existing_nodes,
-            errors={uid: e for uid, e in errors.items() if e is not None},
-            failed_pods=failed,
-        )
+            failed = q.list()
+            if tracing.enabled():
+                for pod in failed:
+                    tracing.record_unschedulable(
+                        pod,
+                        rejections=self._audit.get(pod.uid, []),
+                        error=errors.get(pod.uid),
+                        engine="host",
+                    )
+                sp.set(
+                    new_nodes=len(self.new_nodes),
+                    failed=len(failed),
+                )
+            if not self.opts.simulation_mode:
+                self._record_results(pods, failed, errors)
+            return SchedulingResults(
+                new_nodes=self.new_nodes,
+                existing_nodes=self.existing_nodes,
+                errors={uid: e for uid, e in errors.items() if e is not None},
+                failed_pods=failed,
+            )
 
     def _add(self, pod: Pod) -> Optional[str]:
         """existing nodes → open new nodes (fewest pods first) → a fresh node
         per weighted template (scheduler.go:174-219)."""
+        # rejection audit, kept per attempt (the LAST attempt's rejections —
+        # post-relaxation — are what a failed pod's audit reports)
+        rejections: Optional[List[dict]] = [] if tracing.enabled() else None
+
+        def reject(candidate: str, err: str) -> None:
+            if rejections is not None and len(rejections) < tracing.audit.MAX_REJECTIONS_PER_POD:
+                rejections.append(tracing.rejection(candidate, err))
+
+        def fail(err: Optional[str]) -> Optional[str]:
+            if rejections is not None:
+                self._audit[pod.uid] = rejections
+            return err
+
         for node in self.existing_nodes:
-            if node.add(pod) is None:
+            err = node.add(pod)
+            if err is None:
                 return None
+            reject(f"existing/{node.name}", err)
 
         self.new_nodes.sort(key=lambda n: len(n.pods))
         for node in self.new_nodes:
-            if node.add(pod) is None:
+            err = node.add(pod)
+            if err is None:
                 return None
+            reject(f"inflight/{node.hostname}", err)
 
         errs: List[str] = []
         for template in self.machine_templates:
@@ -143,6 +178,10 @@ class Scheduler:
                 filtered = _filter_by_remaining_resources(instance_types, remaining)
                 if not filtered:
                     errs.append("all available instance types exceed provisioner limits")
+                    reject(
+                        f"template/{template.provisioner_name}",
+                        "all available instance types exceed provisioner limits",
+                    )
                     continue
                 if len(filtered) != len(instance_types) and not self.opts.simulation_mode:
                     log.debug(
@@ -162,6 +201,7 @@ class Scheduler:
             err = node.add(pod)
             if err is not None:
                 errs.append(f"incompatible with provisioner {template.provisioner_name!r}, {err}")
+                reject(f"template/{template.provisioner_name}", err)
                 continue
             self.new_nodes.append(node)
             # pessimistic limit tracking: assume the largest surviving instance
@@ -172,7 +212,7 @@ class Scheduler:
                     node.instance_type_options,
                 )
             return None
-        return "; ".join(errs) if errs else "no provisioner available"
+        return fail("; ".join(errs) if errs else "no provisioner available")
 
     # -- setup ----------------------------------------------------------------
 

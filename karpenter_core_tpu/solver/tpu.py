@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
+from karpenter_core_tpu import tracing
 from karpenter_core_tpu.apis.objects import Pod
 from karpenter_core_tpu.apis.v1alpha5 import Provisioner, order_by_weight
 from karpenter_core_tpu.cloudprovider import CloudProvider, InstanceType
@@ -62,16 +63,17 @@ class _LazyPlanes:
 
     def _fetch(self) -> None:
         if self._viable is None:
-            viable_p, zone_p, ct_p, used = jax.device_get(
-                (self._viable_p, self._zone_p, self._ct_p, self._used_d)
-            )
-            self._viable = solve_ops.unpack_bool(viable_p, self._n_it)
-            self._zone = solve_ops.unpack_bool(zone_p, self._n_zones)
-            self._ct = solve_ops.unpack_bool(ct_p, self._n_ct)
-            self._used = used
-            # release the device buffers — node decisions can outlive the
-            # solve (launch path), and holding both copies doubles memory
-            self._viable_p = self._zone_p = self._ct_p = self._used_d = None
+            with tracing.span("materialize"):
+                viable_p, zone_p, ct_p, used = jax.device_get(
+                    (self._viable_p, self._zone_p, self._ct_p, self._used_d)
+                )
+                self._viable = solve_ops.unpack_bool(viable_p, self._n_it)
+                self._zone = solve_ops.unpack_bool(zone_p, self._n_zones)
+                self._ct = solve_ops.unpack_bool(ct_p, self._n_ct)
+                self._used = used
+                # release the device buffers — node decisions can outlive the
+                # solve (launch path), and holding both copies doubles memory
+                self._viable_p = self._zone_p = self._ct_p = self._used_d = None
 
     @property
     def viable(self) -> np.ndarray:
@@ -240,6 +242,22 @@ class TPUSolver:
         return self._encode_with_classes(reps, classes, state_nodes, bound_pods)
 
     def _encode_with_classes(
+        self,
+        pods: List[Pod],
+        classes: Optional[list],
+        state_nodes: Optional[list],
+        bound_pods: Optional[List[Pod]],
+    ) -> EncodedSnapshot:
+        with tracing.span(
+            "encode",
+            classes=len(classes) if classes is not None else None,
+            state_nodes=len(state_nodes or ()),
+        ):
+            return self._encode_with_classes_impl(
+                pods, classes, state_nodes, bound_pods
+            )
+
+    def _encode_with_classes_impl(
         self,
         pods: List[Pod],
         classes: Optional[list],
@@ -626,8 +644,9 @@ class TPUSolver:
         bound_pods: Optional[List[Pod]] = None,
         n_slots: int = 0,
     ) -> TPUSolveResults:
-        snapshot = self.encode(pods, state_nodes, bound_pods)
-        return self.solve_encoded(snapshot, state_nodes, bound_pods, n_slots)
+        with tracing.span("tpu.solve"):
+            snapshot = self.encode(pods, state_nodes, bound_pods)
+            return self.solve_encoded(snapshot, state_nodes, bound_pods, n_slots)
 
     def warmup(
         self,
@@ -703,7 +722,10 @@ class TPUSolver:
     ) -> TPUSolveResults:
         ex_state = ex_static = None
         if state_nodes:
-            ex_state, ex_static = self.encode_existing(snapshot, state_nodes, bound_pods)
+            with tracing.span("encode.existing", state_nodes=len(state_nodes)):
+                ex_state, ex_static = self.encode_existing(
+                    snapshot, state_nodes, bound_pods
+                )
         from karpenter_core_tpu.utils import compilecache
 
         if n_slots <= 0:
@@ -728,6 +750,21 @@ class TPUSolver:
         return self.decode(snapshot, outputs, state_nodes or [])
 
     def decode(
+        self,
+        snapshot: EncodedSnapshot,
+        outputs: solve_ops.SolveOutputs,
+        state_nodes: Optional[list] = None,
+    ) -> TPUSolveResults:
+        with tracing.span("decode") as sp:
+            results = self._decode_impl(snapshot, outputs, state_nodes)
+            sp.set(
+                new_nodes=len(results.new_nodes),
+                failed=len(results.failed_pods),
+                residual=len(results.spread_residual_pods),
+            )
+            return results
+
+    def _decode_impl(
         self,
         snapshot: EncodedSnapshot,
         outputs: solve_ops.SolveOutputs,
@@ -823,6 +860,17 @@ class TPUSolver:
                 results.spread_residual_pods.extend(leftover)
             else:
                 results.failed_pods.extend(leftover)
+                if tracing.enabled():
+                    # the kernel reports failure per class, not per predicate:
+                    # identical pods fail identically, so one audit entry
+                    # covers the class (decode cannot see which gate zeroed
+                    # the capacity — the host oracle's audit can)
+                    tracing.record_unschedulable(
+                        leftover[0],
+                        engine="kernel",
+                        count=len(leftover),
+                        error="no viable placement for pod class (kernel solve)",
+                    )
         # kernel zone commitments on existing nodes (singleton post-solve
         # masks): the host re-route stamps these onto zone-less nodes
         ex_zone_h = np.asarray(ex_zone, dtype=bool)

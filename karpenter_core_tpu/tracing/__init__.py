@@ -1,0 +1,47 @@
+"""Tracing: spans over the solve pipeline, a ring-buffer trace store,
+decision audits, and exporters (JSON-lines + Chrome trace-event format).
+
+See docs/OBSERVABILITY.md for the operator surface (``/debug/traces``).
+"""
+
+from karpenter_core_tpu.tracing.trace import (
+    MAX_EVENTS_PER_SPAN,
+    Span,
+    Trace,
+    TraceStore,
+    TRACE_STORE,
+    add_event,
+    current,
+    disable,
+    enable,
+    enabled,
+    span,
+    traced,
+)
+from karpenter_core_tpu.tracing.export import from_jsonl, to_chrome, to_jsonl
+from karpenter_core_tpu.tracing.audit import (
+    classify_rejection,
+    record_unschedulable,
+    rejection,
+)
+
+__all__ = [
+    "MAX_EVENTS_PER_SPAN",
+    "Span",
+    "Trace",
+    "TraceStore",
+    "TRACE_STORE",
+    "add_event",
+    "classify_rejection",
+    "current",
+    "disable",
+    "enable",
+    "enabled",
+    "from_jsonl",
+    "record_unschedulable",
+    "rejection",
+    "span",
+    "to_chrome",
+    "to_jsonl",
+    "traced",
+]

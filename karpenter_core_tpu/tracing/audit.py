@@ -1,0 +1,89 @@
+"""Per-solve decision audit: *why* a pod failed to schedule.
+
+The reference treats scheduling-decision explainability as a product surface
+(pod events carry the failure string; karpenter's FAQ is largely "why is my
+pod unschedulable").  Here the host scheduler's per-candidate rejection
+strings are classified into the predicate that fired — resources, taints,
+affinity, topology, host ports, volumes, requirements — and attached to the
+active trace as structured ``decision.audit`` span events, one per
+unschedulable pod, listing each candidate and the predicate that rejected it.
+``/debug/traces`` surfaces them; ``Trace.audits()`` collects them.
+
+Audits are recorded only while tracing is enabled — the rejection lists are
+debug artifacts and the hot path must not accumulate them unconditionally.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from karpenter_core_tpu.tracing import trace as _trace
+
+# most-specific first: the first matching needle names the predicate
+_PREDICATE_NEEDLES = (
+    ("tolerate", "taints"),
+    ("taint", "taints"),
+    (" port=", "host-ports"),
+    ("host port", "host-ports"),
+    ("volume", "volumes"),
+    ("exceeds node resources", "resources"),
+    ("no instance type satisfied", "resources"),
+    ("pod anti-affinity", "affinity"),
+    ("pod affinity", "affinity"),
+    ("anti-affinit", "affinity"),
+    ("topology spread", "topology"),
+    ("topology", "topology"),
+    ("incompatible requirements", "requirements"),
+    ("does not have known values", "requirements"),
+    ("not in", "requirements"),
+    ("provisioner limits", "limits"),
+)
+
+# cap the per-pod candidate list: on a 1000-node cluster one unschedulable
+# pod would otherwise record 1000 rejections per relaxation attempt
+MAX_REJECTIONS_PER_POD = 40
+
+
+def classify_rejection(err: Optional[str]) -> str:
+    """Map a scheduler rejection string to the predicate that fired."""
+    if not err:
+        return "unknown"
+    lowered = err.lower()
+    for needle, predicate in _PREDICATE_NEEDLES:
+        if needle in lowered:
+            return predicate
+    return "other"
+
+
+def rejection(candidate: str, err: str) -> Dict[str, Any]:
+    """One structured candidate-rejection entry."""
+    return {
+        "candidate": candidate,
+        "predicate": classify_rejection(err),
+        "error": err[:200],
+    }
+
+
+def record_unschedulable(
+    pod,
+    rejections: Optional[List[Dict[str, Any]]] = None,
+    error: Optional[str] = None,
+    engine: str = "host",
+    count: int = 1,
+) -> None:
+    """Attach one ``decision.audit`` event for an unschedulable pod (or, for
+    the kernel path, a whole class of identical pods) to the active span."""
+    rejections = rejections or []
+    predicates = sorted({r["predicate"] for r in rejections})
+    _trace.add_event(
+        "decision.audit",
+        pod=getattr(pod.metadata, "name", "") or "",
+        namespace=pod.namespace or "",
+        uid=pod.uid,
+        engine=engine,
+        count=count,
+        error=(error or "")[:300],
+        predicates=predicates,
+        rejections=rejections[:MAX_REJECTIONS_PER_POD],
+        truncated=len(rejections) > MAX_REJECTIONS_PER_POD,
+    )

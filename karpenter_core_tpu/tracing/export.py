@@ -1,0 +1,109 @@
+"""Trace exporters: JSON-lines and Chrome trace-event format.
+
+JSON-lines is the archival/round-trip format (one span per line, prefixed by
+one trace-header line) — greppable, streamable, and loadable back into
+``Trace`` objects with ``from_jsonl``.
+
+The Chrome format (``to_chrome``) emits the trace-event JSON that
+``chrome://tracing`` and Perfetto's legacy loader read: complete events
+(``ph: "X"``, microsecond ``ts``/``dur``) per span, instant events
+(``ph: "i"``) per span event, one ``tid`` lane per trace so concurrent
+solves render side by side.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List
+
+from karpenter_core_tpu.tracing.trace import Trace
+
+
+def to_jsonl(trace: Trace) -> str:
+    """One header line + one line per span; ends with a newline."""
+    lines = [
+        json.dumps(
+            {
+                "kind": "trace",
+                "traceId": trace.trace_id,
+                "name": trace.name,
+                "startWall": trace.start_wall,
+                "durationS": trace.duration_s,
+            }
+        )
+    ]
+    for rec in trace.spans:
+        lines.append(json.dumps({"kind": "span", **rec}))
+    return "\n".join(lines) + "\n"
+
+
+def from_jsonl(text: str) -> List[Trace]:
+    """Inverse of ``to_jsonl`` over a concatenation of exported traces."""
+    traces: List[Trace] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        rec = json.loads(line)
+        if rec.get("kind") == "trace":
+            traces.append(
+                Trace(
+                    trace_id=rec["traceId"],
+                    name=rec["name"],
+                    start_wall=rec["startWall"],
+                    duration_s=rec["durationS"],
+                )
+            )
+        elif rec.get("kind") == "span" and traces:
+            rec.pop("kind")
+            traces[-1].spans.append(rec)
+    return traces
+
+
+def to_chrome(traces: Iterable[Trace]) -> Dict[str, Any]:
+    """Chrome trace-event JSON object for a set of traces (load the dumped
+    file in chrome://tracing or ui.perfetto.dev)."""
+    events: List[Dict[str, Any]] = []
+    for tid, trace in enumerate(traces, start=1):
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": tid,
+                "args": {"name": f"{trace.name} {trace.trace_id}"},
+            }
+        )
+        for rec in trace.spans:
+            ts_us = rec["startWall"] * 1e6
+            events.append(
+                {
+                    "name": rec["name"],
+                    "cat": "solve",
+                    "ph": "X",
+                    "ts": ts_us,
+                    "dur": (rec.get("durationS") or 0.0) * 1e6,
+                    "pid": 1,
+                    "tid": tid,
+                    "args": {
+                        "traceId": rec["traceId"],
+                        "spanId": rec["spanId"],
+                        "parentId": rec.get("parentId"),
+                        **(rec.get("attrs") or {}),
+                    },
+                }
+            )
+            for event in rec.get("events") or ():
+                events.append(
+                    {
+                        "name": event["name"],
+                        "cat": "event",
+                        "ph": "i",
+                        "s": "t",
+                        "ts": event["wall"] * 1e6,
+                        "pid": 1,
+                        "tid": tid,
+                        "args": event.get("attrs") or {},
+                    }
+                )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -1,0 +1,334 @@
+"""Span-based solve tracing: contexts, ids, and the in-memory trace store.
+
+The role the reference delegates to controller-runtime's logging/tracing
+context (knative logging + the scheduling loop's structured messages) is
+re-centered here as explicit spans, because the hot path this repo cares
+about is a *pipeline* (ingest → encode → dispatch → solve → decode →
+materialize) whose cost attribution is invisible in wall-clock logging —
+BENCH_r05 showed `solve_decode_s` at 98% of warm time with no internal
+breakdown.
+
+Design constraints:
+
+  - Near-zero cost when disabled (one module-global check per ``span()``).
+    Tracing is opt-in: ``enable()``, or the ``KC_TRACE=1`` environment
+    variable at import time.
+  - Thread-aware: the current span propagates through a ``contextvars``
+    context, so concurrent reconciles interleave without clobbering each
+    other.  A span opened on a worker thread with no inherited context
+    becomes the root of its own trace.
+  - JAX-aware: device work is asynchronously dispatched, so a naive span
+    around a kernel call measures dispatch, not compute — and the cost
+    folds into whichever later span first touches the result.  A span
+    given a ``sync`` target calls ``jax.block_until_ready`` on it at close
+    so device time lands in the span that dispatched it.
+  - Bounded memory: completed traces land in a thread-safe ring buffer
+    (``TraceStore``); old traces fall off the end.
+
+Spans also feed ``metrics.registry.SOLVE_STAGE_DURATION`` (one histogram
+time series per span name) with a ``trace_id`` exemplar, so a scrape can
+link a latency outlier back to the exact trace that produced it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import functools
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+_enabled = os.environ.get("KC_TRACE", "") == "1"
+# completion-order appends can arrive from several threads of one trace
+_finish_lock = threading.Lock()
+_current: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "kc_tracing_current", default=None
+)
+
+# span-event payloads are debug artifacts, not a database: cap the per-span
+# event count so a pathological solve (50k failed pods) cannot balloon a trace
+MAX_EVENTS_PER_SPAN = 256
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(capacity: Optional[int] = None) -> None:
+    global _enabled
+    if capacity is not None:
+        TRACE_STORE.set_capacity(capacity)
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def _new_id(nbytes: int) -> str:
+    return uuid.uuid4().hex[: nbytes * 2]
+
+
+class Span:
+    """One timed operation.  Created by ``span()``; closed spans serialize to
+    plain dicts (the exchange format of the exporters and ``/debug/traces``)."""
+
+    __slots__ = (
+        "trace_id", "span_id", "parent_id", "name", "attrs", "events",
+        "start_wall", "_t0", "duration_s", "_root", "_finished", "_sync",
+    )
+
+    def __init__(self, name: str, parent: Optional["Span"], attrs: Dict[str, Any]) -> None:
+        self.name = name
+        self.attrs = dict(attrs)
+        self.events: List[Dict[str, Any]] = []
+        self.parent_id = parent.span_id if parent is not None else None
+        self.trace_id = parent.trace_id if parent is not None else _new_id(8)
+        self.span_id = _new_id(4)
+        self._root = parent._root if parent is not None else self
+        self._finished: List[Dict[str, Any]] = [] if parent is None else None
+        self._sync = None
+        self.duration_s = None
+        self.start_wall = time.time()
+        self._t0 = time.perf_counter()
+
+    def set(self, **attrs: Any) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def event(self, name: str, **attrs: Any) -> None:
+        if len(self.events) >= MAX_EVENTS_PER_SPAN:
+            return
+        self.events.append({"name": name, "wall": time.time(), "attrs": attrs})
+
+    def sync_on(self, value: Any) -> Any:
+        """Register a (possibly still-dispatching) jax pytree to block on at
+        span close, so async device work is attributed to THIS span."""
+        self._sync = value
+        return value
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "name": self.name,
+            "startWall": self.start_wall,
+            "durationS": self.duration_s,
+            "attrs": self.attrs,
+            "events": self.events,
+        }
+
+    def _finish(self) -> None:
+        if self._sync is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(self._sync)
+            except Exception:  # noqa: BLE001 - tracing must never break the solve
+                pass
+            self._sync = None
+        self.duration_s = time.perf_counter() - self._t0
+        record = self.to_dict()
+        root = self._root
+        with _finish_lock:
+            if root._finished is not None:
+                root._finished.append(record)
+        try:
+            from karpenter_core_tpu.metrics.registry import SOLVE_STAGE_DURATION
+
+            SOLVE_STAGE_DURATION.labels(self.name).observe(
+                self.duration_s,
+                exemplar={"trace_id": self.trace_id, "span_id": self.span_id},
+            )
+        except Exception:  # noqa: BLE001 - metrics failures must not surface
+            pass
+        if root is self:
+            spans, self._finished = self._finished, None
+            TRACE_STORE.add(
+                Trace(
+                    trace_id=self.trace_id,
+                    name=self.name,
+                    start_wall=self.start_wall,
+                    duration_s=self.duration_s,
+                    spans=spans,
+                )
+            )
+
+
+class _NoopSpan:
+    """The disabled-path span: every method is a cheap no-op."""
+
+    __slots__ = ()
+    trace_id = ""
+    span_id = ""
+    parent_id = None
+    name = ""
+    duration_s = None
+
+    def set(self, **attrs: Any) -> "_NoopSpan":
+        return self
+
+    def event(self, name: str, **attrs: Any) -> None:
+        pass
+
+    def sync_on(self, value: Any) -> Any:
+        return value
+
+
+_NOOP = _NoopSpan()
+
+
+@contextlib.contextmanager
+def span(name: str, sync: Any = None, **attrs: Any) -> Iterator[object]:
+    """Open a span under the current one (or start a new trace).  ``sync``
+    (or a later ``sp.sync_on(x)``) blocks on a jax pytree at close so device
+    time is attributed here.  When tracing is disabled this is one branch."""
+    if not _enabled:
+        yield _NOOP
+        return
+    parent = _current.get()
+    sp = Span(name, parent, attrs)
+    if sync is not None:
+        sp.sync_on(sync)
+    token = _current.set(sp)
+    try:
+        yield sp
+    except BaseException as e:
+        sp.attrs.setdefault("error", f"{type(e).__name__}: {e}"[:200])
+        raise
+    finally:
+        _current.reset(token)
+        sp._finish()
+
+
+def current() -> Optional[Span]:
+    """The active span, or None (also None when tracing is disabled)."""
+    return _current.get()
+
+
+def add_event(name: str, **attrs: Any) -> None:
+    """Attach a structured event to the active span (no-op without one)."""
+    sp = _current.get()
+    if sp is not None:
+        sp.event(name, **attrs)
+
+
+def traced(name: str, **attrs: Any):
+    """Decorator form of ``span()`` for controller entry points; the static
+    gate (tools/check_instrumented.py) accepts either spelling."""
+
+    def decorate(fn):
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any):
+            with span(name, **attrs):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorate
+
+
+@dataclass
+class Trace:
+    """One completed trace: the root span's identity plus every span that
+    closed under it, in completion order (sort by ``startWall`` to replay)."""
+
+    trace_id: str
+    name: str
+    start_wall: float
+    duration_s: float
+    spans: List[Dict[str, Any]] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "traceId": self.trace_id,
+            "name": self.name,
+            "startWall": self.start_wall,
+            "durationS": self.duration_s,
+            "spans": self.spans,
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Trace":
+        return cls(
+            trace_id=data["traceId"],
+            name=data["name"],
+            start_wall=data["startWall"],
+            duration_s=data["durationS"],
+            spans=list(data.get("spans") or ()),
+        )
+
+    def stage_durations(self) -> Dict[str, float]:
+        """span name -> summed duration (seconds) across the trace."""
+        out: Dict[str, float] = {}
+        for rec in self.spans:
+            if rec.get("durationS") is not None:
+                out[rec["name"]] = out.get(rec["name"], 0.0) + rec["durationS"]
+        return out
+
+    def audits(self) -> List[Dict[str, Any]]:
+        """Every decision-audit event in the trace (tracing.audit)."""
+        out = []
+        for rec in self.spans:
+            for event in rec.get("events") or ():
+                if event.get("name") == "decision.audit":
+                    out.append(event.get("attrs") or {})
+        return out
+
+
+class TraceStore:
+    """Thread-safe ring buffer of the last N completed traces."""
+
+    def __init__(self, capacity: int = 64) -> None:
+        self._lock = threading.Lock()
+        self._traces: deque = deque(maxlen=max(capacity, 1))
+
+    def add(self, trace: Trace) -> None:
+        with self._lock:
+            self._traces.append(trace)
+
+    def last(self, n: Optional[int] = None) -> List[Trace]:
+        """The most recent ``n`` traces (all when None), oldest first."""
+        with self._lock:
+            traces = list(self._traces)
+        return traces if n is None or n <= 0 else traces[-n:]
+
+    def find(self, trace_id: str) -> Optional[Trace]:
+        with self._lock:
+            for trace in reversed(self._traces):
+                if trace.trace_id == trace_id:
+                    return trace
+        return None
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._traces = deque(self._traces, maxlen=max(capacity, 1))
+
+    @property
+    def capacity(self) -> int:
+        return self._traces.maxlen
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+
+def _capacity_from_env() -> int:
+    try:
+        return int(os.environ.get("KC_TRACE_CAPACITY", "64") or 64)
+    except ValueError:
+        return 64  # a tuning-knob typo must not take the operator down
+
+
+TRACE_STORE = TraceStore(_capacity_from_env())

@@ -176,6 +176,15 @@ def enable() -> None:
                     )
                 except ValueError:
                     pass  # already registered
+                except AttributeError:
+                    # this jax predates jax.export: solve_callable degrades to
+                    # the plain jit on its own, but enable() is also called
+                    # from operator.start()/bench bring-up and must not crash
+                    # the process over a missing cache backend
+                    log.warning(
+                        "jax.export unavailable; persistent export cache disabled"
+                    )
+                    break
             _registered = True
 
 
@@ -339,25 +348,36 @@ def run_solve(
 
     import jax
 
+    from karpenter_core_tpu import tracing
     from karpenter_core_tpu.ops import solve as solve_ops
 
-    if os.environ.get("KC_TPU_SHAPE_BUCKETS", "1") != "0":
-        cls, statics_arrays, key_has_bounds, ex_state, ex_static = solve_ops.pad_planes(
-            cls, statics_arrays, key_has_bounds, ex_state, ex_static
-        )
-    with ThreadPoolExecutor(max_workers=1) as pool:
-        upload = pool.submit(
-            jax.device_put, (cls, statics_arrays, ex_state, ex_static)
-        )
-        fn = solve_callable(
-            cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static, n_passes
-        )
-        cls, statics_arrays, ex_state, ex_static = upload.result()
-    if fn is None:
-        return solve_ops._solve_jit(
-            cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
-            n_passes=n_passes,
-        )
-    if ex_state is not None:
-        return fn(cls, statics_arrays, ex_state, ex_static)
-    return fn(cls, statics_arrays)
+    # "dispatch" covers pad + upload + executable lookup + async kernel launch;
+    # the separate "solve" span blocks on the outputs (tracing only) so device
+    # compute is attributed to the solve, not to whichever span first touches
+    # the result — the JAX-aware boundary docs/OBSERVABILITY.md describes.
+    with tracing.span("dispatch", n_slots=n_slots, n_passes=n_passes):
+        if os.environ.get("KC_TPU_SHAPE_BUCKETS", "1") != "0":
+            cls, statics_arrays, key_has_bounds, ex_state, ex_static = solve_ops.pad_planes(
+                cls, statics_arrays, key_has_bounds, ex_state, ex_static
+            )
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            upload = pool.submit(
+                jax.device_put, (cls, statics_arrays, ex_state, ex_static)
+            )
+            fn = solve_callable(
+                cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static, n_passes
+            )
+            cls, statics_arrays, ex_state, ex_static = upload.result()
+        if fn is None:
+            out = solve_ops._solve_jit(
+                cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
+                n_passes=n_passes,
+            )
+        elif ex_state is not None:
+            out = fn(cls, statics_arrays, ex_state, ex_static)
+        else:
+            out = fn(cls, statics_arrays)
+    if tracing.enabled():
+        with tracing.span("solve", sync=out):
+            pass
+    return out

@@ -26,13 +26,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 sys.path.insert(0, REPO)
-from bench import _probe_once, run_pinned  # noqa: E402 - shared probe/run contract
+from bench import run_pinned  # noqa: E402 - shared run contract
+from karpenter_core_tpu.solver.backendprobe import probe_once  # noqa: E402
 
 
 def run_bench() -> dict:
     """Run bench.py with backend pre-pinned by a single bounded probe (the
     bench's own 5x60s probe ladder is for the driver's unattended run)."""
-    platform, _ = _probe_once(45.0)
+    platform = probe_once(45.0).platform
     rec = run_pinned(platform or "cpu")
     if "error" in rec:
         sys.stderr.write(rec.get("stderr", "") + "\n")

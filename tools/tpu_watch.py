@@ -23,12 +23,12 @@ OUT = os.path.join(REPO, "BENCH_TPU_OPPORTUNISTIC.json")
 
 
 sys.path.insert(0, REPO)
-from bench import _probe_once, run_pinned  # noqa: E402 - shared probe/run contract
+from bench import run_pinned  # noqa: E402 - shared run contract
+from karpenter_core_tpu.solver.backendprobe import probe_once  # noqa: E402
 
 
 def probe(timeout_s: float = 60.0):
-    platform, _ = _probe_once(timeout_s)
-    return platform
+    return probe_once(timeout_s).platform
 
 
 def main() -> int:
