@@ -22,7 +22,7 @@ kernel-smoke:  ## bounded kernel gate for presubmit: a parity slice compiles + s
 perf: perf-gate  ## performance-gated tests (reference: //go:build test_performance)
 	KC_TPU_PERF=1 $(PYTEST) tests/test_performance.py -q
 
-perf-gate:  ## round-over-round drift gate: bench vs last same-platform BENCH_r*.json
+perf-gate:  ## round-over-round drift check: bench vs last same-platform BENCH_r*.json (advisory; KC_PERF_GATE_STRICT=1 to enforce)
 	python tools/perfgate.py
 
 bench:  ## headline benchmark on the available accelerator

@@ -1,8 +1,12 @@
 """Wire codec for API objects: dict ⇄ dataclass.
 
 The snapshot channel (service.snapshot_channel) ships pods/provisioners/nodes
-between the controller plane and the solver sidecar; this codec keeps the wire
-format explicit and versionable.  Only solver-relevant fields travel.
+between the controller plane and the solver sidecar, and the apiserver-backed
+KubeClient (kubeapi/) round-trips every stored kind through these dicts; this
+codec keeps the wire format explicit and versionable.  The snapshot channel
+consumes only the solver-relevant subset; the kubeapi backend needs full
+durability metadata (resourceVersion, finalizers, deletionTimestamp,
+ownerReferences) so controller state survives a process restart.
 """
 
 from __future__ import annotations
@@ -13,25 +17,41 @@ from karpenter_core_tpu.apis.objects import (
     Affinity,
     Container,
     ContainerPort,
+    CSINode,
+    CSINodeDriver,
     LabelSelector,
     LabelSelectorRequirement,
+    Lease,
+    LeaseSpec,
+    Namespace,
     Node,
     NodeAffinity,
+    NodeCondition,
     NodeSelector,
     NodeSelectorRequirement,
     NodeSelectorTerm,
     NodeSpec,
     NodeStatus,
     ObjectMeta,
+    OwnerReference,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimSpec,
+    PersistentVolumeClaimVolumeSource,
+    PersistentVolumeSpec,
     Pod,
     PodAffinity,
     PodAffinityTerm,
     PodAntiAffinity,
-    PersistentVolumeClaimVolumeSource,
+    PodCondition,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodDisruptionBudgetStatus,
     PodSpec,
     PodStatus,
     PreferredSchedulingTerm,
     ResourceRequirements,
+    StorageClass,
     Taint,
     Toleration,
     TopologySpreadConstraint,
@@ -41,20 +61,42 @@ from karpenter_core_tpu.apis.objects import (
 from karpenter_core_tpu.apis.v1alpha5 import (
     Consolidation,
     Limits,
+    Machine,
+    MachineSpec,
+    MachineStatus,
+    ProviderRef,
     Provisioner,
     ProvisionerSpec,
 )
 
 
 def _meta_to_dict(meta: ObjectMeta) -> Dict[str, Any]:
-    return {
+    out = {
         "name": meta.name,
         "namespace": meta.namespace,
         "uid": meta.uid,
         "labels": dict(meta.labels),
         "annotations": dict(meta.annotations),
         "creationTimestamp": meta.creation_timestamp,
+        "resourceVersion": meta.resource_version,
+        "generation": meta.generation,
     }
+    if meta.deletion_timestamp is not None:
+        out["deletionTimestamp"] = meta.deletion_timestamp
+    if meta.finalizers:
+        out["finalizers"] = list(meta.finalizers)
+    if meta.owner_references:
+        out["ownerReferences"] = [
+            {
+                "apiVersion": r.api_version,
+                "kind": r.kind,
+                "name": r.name,
+                "uid": r.uid,
+                "controller": r.controller,
+            }
+            for r in meta.owner_references
+        ]
+    return out
 
 
 def _meta_from_dict(d: Dict[str, Any]) -> ObjectMeta:
@@ -65,6 +107,20 @@ def _meta_from_dict(d: Dict[str, Any]) -> ObjectMeta:
         labels=dict(d.get("labels", {})),
         annotations=dict(d.get("annotations", {})),
         creation_timestamp=d.get("creationTimestamp", 0.0),
+        resource_version=int(d.get("resourceVersion", 0) or 0),
+        generation=int(d.get("generation", 0) or 0),
+        deletion_timestamp=d.get("deletionTimestamp"),
+        finalizers=list(d.get("finalizers", [])),
+        owner_references=[
+            OwnerReference(
+                api_version=r.get("apiVersion", ""),
+                kind=r.get("kind", ""),
+                name=r.get("name", ""),
+                uid=r.get("uid", ""),
+                controller=r.get("controller", False),
+            )
+            for r in d.get("ownerReferences", [])
+        ],
     )
 
 
@@ -149,13 +205,22 @@ def pod_to_dict(pod: Pod) -> Dict[str, Any]:
                 for c in spec.topology_spread_constraints
             ],
             "priority": spec.priority,
+            "priorityClassName": spec.priority_class_name,
             "pvcs": [
                 v.persistent_volume_claim.claim_name
                 for v in spec.volumes
                 if v.persistent_volume_claim is not None
             ],
         },
-        "status": {"phase": pod.status.phase},
+        "status": {
+            "phase": pod.status.phase,
+            "conditions": [
+                {"type": c.type, "status": c.status, "reason": c.reason}
+                for c in pod.status.conditions
+            ],
+            "startTime": pod.status.start_time,
+            "nominatedNodeName": pod.status.nominated_node_name,
+        },
     }
     if spec.affinity is not None:
         affinity: Dict[str, Any] = {}
@@ -291,6 +356,7 @@ def pod_from_dict(d: Dict[str, Any]) -> Pod:
                 for c in spec_d.get("topologySpreadConstraints", [])
             ],
             priority=spec_d.get("priority"),
+            priority_class_name=spec_d.get("priorityClassName", ""),
             volumes=[
                 Volume(
                     name=f"vol-{claim}",
@@ -301,7 +367,19 @@ def pod_from_dict(d: Dict[str, Any]) -> Pod:
                 for claim in spec_d.get("pvcs", [])
             ],
         ),
-        status=PodStatus(phase=d.get("status", {}).get("phase", "Pending")),
+        status=PodStatus(
+            phase=d.get("status", {}).get("phase", "Pending"),
+            conditions=[
+                PodCondition(
+                    type=c.get("type", ""),
+                    status=c.get("status", ""),
+                    reason=c.get("reason", ""),
+                )
+                for c in d.get("status", {}).get("conditions", [])
+            ],
+            start_time=d.get("status", {}).get("startTime"),
+            nominated_node_name=d.get("status", {}).get("nominatedNodeName", ""),
+        ),
     )
 
 
@@ -374,6 +452,10 @@ def node_to_dict(n: Node) -> Dict[str, Any]:
         "status": {
             "capacity": dict(n.status.capacity),
             "allocatable": dict(n.status.allocatable),
+            "conditions": [
+                {"type": c.type, "status": c.status} for c in n.status.conditions
+            ],
+            "phase": n.status.phase,
         },
     }
 
@@ -394,5 +476,253 @@ def node_from_dict(d: Dict[str, Any]) -> Node:
         status=NodeStatus(
             capacity=dict(status_d.get("capacity", {})),
             allocatable=dict(status_d.get("allocatable", {})),
+            conditions=[
+                NodeCondition(type=c.get("type", ""), status=c.get("status", ""))
+                for c in status_d.get("conditions", [])
+            ],
+            phase=status_d.get("phase", ""),
+        ),
+    )
+
+
+# -- kubeapi-only kinds -------------------------------------------------------
+# Everything the in-memory KubeClient stores must survive an apiserver
+# round-trip for restart rebuild (kubeapi/); these kinds never ride the
+# snapshot channel, so their codecs carry full (not solver-subset) state.
+
+
+def machine_to_dict(m: Machine) -> Dict[str, Any]:
+    return {
+        "metadata": _meta_to_dict(m.metadata),
+        "spec": {
+            "taints": [
+                {"key": t.key, "value": t.value, "effect": t.effect} for t in m.spec.taints
+            ],
+            "startupTaints": [
+                {"key": t.key, "value": t.value, "effect": t.effect}
+                for t in m.spec.startup_taints
+            ],
+            "requirements": [_nsr_to_dict(r) for r in m.spec.requirements],
+            "resourceRequests": dict(m.spec.resources_requests),
+            "machineTemplateRef": (
+                {
+                    "apiVersion": m.spec.machine_template_ref.api_version,
+                    "kind": m.spec.machine_template_ref.kind,
+                    "name": m.spec.machine_template_ref.name,
+                }
+                if m.spec.machine_template_ref is not None
+                else None
+            ),
+        },
+        "status": {
+            "providerID": m.status.provider_id,
+            "capacity": dict(m.status.capacity),
+            "allocatable": dict(m.status.allocatable),
+        },
+    }
+
+
+def machine_from_dict(d: Dict[str, Any]) -> Machine:
+    spec_d = d.get("spec", {})
+    status_d = d.get("status", {})
+    ref_d = spec_d.get("machineTemplateRef")
+    return Machine(
+        metadata=_meta_from_dict(d.get("metadata", {})),
+        spec=MachineSpec(
+            taints=[
+                Taint(t["key"], t.get("value", ""), t.get("effect", "NoSchedule"))
+                for t in spec_d.get("taints", [])
+            ],
+            startup_taints=[
+                Taint(t["key"], t.get("value", ""), t.get("effect", "NoSchedule"))
+                for t in spec_d.get("startupTaints", [])
+            ],
+            requirements=[_nsr_from_dict(r) for r in spec_d.get("requirements", [])],
+            resources_requests=dict(spec_d.get("resourceRequests", {})),
+            machine_template_ref=(
+                ProviderRef(
+                    api_version=ref_d.get("apiVersion", ""),
+                    kind=ref_d.get("kind", ""),
+                    name=ref_d.get("name", ""),
+                )
+                if ref_d
+                else None
+            ),
+        ),
+        status=MachineStatus(
+            provider_id=status_d.get("providerID", ""),
+            capacity=dict(status_d.get("capacity", {})),
+            allocatable=dict(status_d.get("allocatable", {})),
+        ),
+    )
+
+
+def namespace_to_dict(ns: Namespace) -> Dict[str, Any]:
+    return {"metadata": _meta_to_dict(ns.metadata)}
+
+
+def namespace_from_dict(d: Dict[str, Any]) -> Namespace:
+    return Namespace(metadata=_meta_from_dict(d.get("metadata", {})))
+
+
+def pdb_to_dict(pdb: PodDisruptionBudget) -> Dict[str, Any]:
+    return {
+        "metadata": _meta_to_dict(pdb.metadata),
+        "spec": {
+            "selector": _selector_to_dict(pdb.spec.selector),
+            "minAvailable": pdb.spec.min_available,
+            "maxUnavailable": pdb.spec.max_unavailable,
+        },
+        "status": {"disruptionsAllowed": pdb.status.disruptions_allowed},
+    }
+
+
+def pdb_from_dict(d: Dict[str, Any]) -> PodDisruptionBudget:
+    spec_d = d.get("spec", {})
+    return PodDisruptionBudget(
+        metadata=_meta_from_dict(d.get("metadata", {})),
+        spec=PodDisruptionBudgetSpec(
+            selector=_selector_from_dict(spec_d.get("selector")),
+            min_available=spec_d.get("minAvailable"),
+            max_unavailable=spec_d.get("maxUnavailable"),
+        ),
+        status=PodDisruptionBudgetStatus(
+            disruptions_allowed=d.get("status", {}).get("disruptionsAllowed", 0)
+        ),
+    )
+
+
+def pvc_to_dict(pvc: PersistentVolumeClaim) -> Dict[str, Any]:
+    return {
+        "metadata": _meta_to_dict(pvc.metadata),
+        "spec": {
+            "storageClassName": pvc.spec.storage_class_name,
+            "volumeName": pvc.spec.volume_name,
+        },
+    }
+
+
+def pvc_from_dict(d: Dict[str, Any]) -> PersistentVolumeClaim:
+    spec_d = d.get("spec", {})
+    return PersistentVolumeClaim(
+        metadata=_meta_from_dict(d.get("metadata", {})),
+        spec=PersistentVolumeClaimSpec(
+            storage_class_name=spec_d.get("storageClassName"),
+            volume_name=spec_d.get("volumeName", ""),
+        ),
+    )
+
+
+def _node_selector_to_dict(ns: Optional[NodeSelector]) -> Optional[list]:
+    if ns is None:
+        return None
+    return [
+        [_nsr_to_dict(e) for e in term.match_expressions]
+        for term in ns.node_selector_terms
+    ]
+
+
+def _node_selector_from_dict(terms: Optional[list]) -> Optional[NodeSelector]:
+    if terms is None:
+        return None
+    return NodeSelector(
+        node_selector_terms=[
+            NodeSelectorTerm(match_expressions=[_nsr_from_dict(e) for e in term])
+            for term in terms
+        ]
+    )
+
+
+def pv_to_dict(pv: PersistentVolume) -> Dict[str, Any]:
+    return {
+        "metadata": _meta_to_dict(pv.metadata),
+        "spec": {
+            "nodeAffinityRequired": _node_selector_to_dict(pv.spec.node_affinity_required),
+            "csiDriver": pv.spec.csi_driver,
+        },
+    }
+
+
+def pv_from_dict(d: Dict[str, Any]) -> PersistentVolume:
+    spec_d = d.get("spec", {})
+    return PersistentVolume(
+        metadata=_meta_from_dict(d.get("metadata", {})),
+        spec=PersistentVolumeSpec(
+            node_affinity_required=_node_selector_from_dict(
+                spec_d.get("nodeAffinityRequired")
+            ),
+            csi_driver=spec_d.get("csiDriver", ""),
+        ),
+    )
+
+
+def storageclass_to_dict(sc: StorageClass) -> Dict[str, Any]:
+    return {
+        "metadata": _meta_to_dict(sc.metadata),
+        "provisioner": sc.provisioner,
+        "allowedTopologies": [
+            [_nsr_to_dict(e) for e in term.match_expressions]
+            for term in sc.allowed_topologies
+        ],
+    }
+
+
+def storageclass_from_dict(d: Dict[str, Any]) -> StorageClass:
+    return StorageClass(
+        metadata=_meta_from_dict(d.get("metadata", {})),
+        provisioner=d.get("provisioner", ""),
+        allowed_topologies=[
+            NodeSelectorTerm(match_expressions=[_nsr_from_dict(e) for e in term])
+            for term in d.get("allowedTopologies", [])
+        ],
+    )
+
+
+def csinode_to_dict(cn: CSINode) -> Dict[str, Any]:
+    return {
+        "metadata": _meta_to_dict(cn.metadata),
+        "drivers": [
+            {"name": drv.name, "allocatableCount": drv.allocatable_count}
+            for drv in cn.drivers
+        ],
+    }
+
+
+def csinode_from_dict(d: Dict[str, Any]) -> CSINode:
+    return CSINode(
+        metadata=_meta_from_dict(d.get("metadata", {})),
+        drivers=[
+            CSINodeDriver(
+                name=drv.get("name", ""),
+                allocatable_count=drv.get("allocatableCount"),
+            )
+            for drv in d.get("drivers", [])
+        ],
+    )
+
+
+def lease_to_dict(lease: Lease) -> Dict[str, Any]:
+    return {
+        "metadata": _meta_to_dict(lease.metadata),
+        "spec": {
+            "holderIdentity": lease.spec.holder_identity,
+            "leaseDurationSeconds": lease.spec.lease_duration_seconds,
+            "acquireTime": lease.spec.acquire_time,
+            "renewTime": lease.spec.renew_time,
+            "leaseTransitions": lease.spec.lease_transitions,
+        },
+    }
+
+
+def lease_from_dict(d: Dict[str, Any]) -> Lease:
+    spec_d = d.get("spec", {})
+    return Lease(
+        metadata=_meta_from_dict(d.get("metadata", {})),
+        spec=LeaseSpec(
+            holder_identity=spec_d.get("holderIdentity", ""),
+            lease_duration_seconds=spec_d.get("leaseDurationSeconds", 15),
+            acquire_time=spec_d.get("acquireTime", 0.0),
+            renew_time=spec_d.get("renewTime", 0.0),
+            lease_transitions=spec_d.get("leaseTransitions", 0),
         ),
     )

@@ -10,7 +10,9 @@ and ships the incremental ``instance_types(n)`` and 1,344-type cartesian
 from __future__ import annotations
 
 import itertools
+import os
 import threading
+import uuid
 from typing import List, Optional
 
 from karpenter_core_tpu.apis import labels as labels_api
@@ -159,6 +161,14 @@ def instance_types_assorted() -> List[InstanceType]:
 
 
 _node_names = itertools.count(1)
+# real clouds mint globally-unique instance ids; with the durable apiserver
+# backend node objects outlive the process, so a restarted operator's fresh
+# counter must not re-mint a previous life's name+provider-id (the launch
+# pre-create would silently adopt the stale node).  The per-process tag keeps
+# within-process names deterministic and ordered while making identities
+# unique across operator lifetimes.  KC_FAKE_NODE_TAG pins it (tests that
+# deliberately simulate a same-identity relaunch).
+_run_tag = os.environ.get("KC_FAKE_NODE_TAG") or uuid.uuid4().hex[:6]
 
 
 class FakeCloudProvider(CloudProvider):
@@ -221,7 +231,7 @@ class FakeCloudProvider(CloudProvider):
                 labels[labels_api.LABEL_CAPACITY_TYPE] = offering.capacity_type
                 break
         labels.update(machine.metadata.labels)
-        name = f"fake-node-{next(_node_names):05d}"
+        name = f"fake-node-{_run_tag}-{next(_node_names):05d}"
         machine.status.provider_id = f"fake://{name}"
         machine.status.capacity = dict(instance_type.capacity)
         machine.status.allocatable = instance_type.allocatable()

@@ -53,10 +53,13 @@ def main(argv=None) -> int:
         .start()
     )
     logging.getLogger(__name__).info(
-        "operator up: metrics :%d, probes :%d, leader-election %s",
+        "operator up: metrics :%d, probes :%d, leader-election %s, "
+        "kube-backend %s%s",
         operator.http.metrics_port,
         operator.http.health_port,
         "on" if options.enable_leader_election else "off",
+        options.kube_backend,
+        f" ({options.kube_apiserver})" if options.kube_backend == "apiserver" else "",
     )
 
     stop = threading.Event()

@@ -224,6 +224,7 @@ class ProvisioningController:
         )
         self._solver_client = None
         self._tpu_failures = 0
+        self._requeue_failures = 0
         self._warmup_started = False
         self._warmup_lock = threading.Lock()
         self._warmup_thread: Optional[threading.Thread] = None
@@ -322,7 +323,23 @@ class ProvisioningController:
         if wait_for_batch and not self.batcher.wait():
             return None
         with tracing.span("provisioning.reconcile"):
-            return self._reconcile_batch()
+            err = self._reconcile_batch()
+        if err is not None:
+            # requeue-on-error (controller-runtime semantics): the batcher
+            # only wakes on pod events, so a failed launch would otherwise
+            # sit unretried until unrelated work arrives.  Exponential
+            # backoff on consecutive failures — a deterministic error (e.g.
+            # exhausted cloud quota) must not become a 1 Hz hot loop of
+            # cloud calls (controller-runtime's rate-limited requeue queue).
+            self._requeue_failures += 1
+            delay = min(0.5 * 2 ** min(self._requeue_failures - 1, 7), 60.0)
+            log.warning("provisioning reconcile: %s (retry in %.1fs)", err, delay)
+            timer = threading.Timer(delay, self.batcher.trigger)
+            timer.daemon = True
+            timer.start()
+        else:
+            self._requeue_failures = 0
+        return err
 
     def _reconcile_batch(self) -> Optional[str]:
         state_nodes = []
@@ -944,13 +961,30 @@ class ProvisioningController:
         node.metadata.finalizers = [labels_api.TERMINATION_FINALIZER]
         node.spec.provider_id = created.status.provider_id
 
-        # idempotent node pre-create (provisioner.go:338-348): only
-        # already-exists is tolerable; any other failure fails the launch
+        # idempotent node pre-create (provisioner.go:338-348): already-exists
+        # is tolerable only when it IS this machine (same provider id).  With
+        # the durable apiserver backend, node objects outlive the process
+        # while a fresh fake/cloud name sequence restarts — adopting a
+        # same-name-different-instance node would corrupt cluster state with
+        # a phantom, so that collision fails the launch (the next attempt
+        # draws a fresh name)
         from karpenter_core_tpu.operator.kubeclient import ConflictError
 
         try:
             self.kube_client.create(node)
         except ConflictError:
+            # a 409 with no cached object means the conflicting node hasn't
+            # reached the watch cache yet (apiserver backend lag) — its
+            # identity is unknown, so adopting it would be exactly the
+            # corruption this guard exists to prevent; error out and let the
+            # requeue retry once the cache catches up
+            existing = self.kube_client.get_node(node.name)
+            if existing is None or existing.spec.provider_id != node.spec.provider_id:
+                return None, (
+                    f"node name {node.name} already taken by "
+                    f"{existing.spec.provider_id if existing else 'an unsynced object'}; "
+                    f"launch produced {node.spec.provider_id}"
+                )
             log.debug("node already registered")
         except Exception as e:  # noqa: BLE001 - surfaced to the caller
             return None, f"creating node {node.name}, {e}"

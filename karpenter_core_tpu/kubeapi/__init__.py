@@ -1,0 +1,57 @@
+"""Apiserver-backed watch/list plane (the reference's controller-runtime role).
+
+``make_kube_client`` is the backend selector the operator composes through:
+``--kube-backend=memory`` (default; hermetic in-process store) or
+``--kube-backend=apiserver`` (real list/watch protocol against
+``--kube-apiserver`` / ``KC_KUBE_APISERVER``).  See docs/KUBEAPI.md.
+"""
+
+from __future__ import annotations
+
+from karpenter_core_tpu.kubeapi.client import ApiServerClient, ApiServerError
+from karpenter_core_tpu.kubeapi.reflector import Reflector
+from karpenter_core_tpu.kubeapi.resources import spec_for
+
+BACKEND_MEMORY = "memory"
+BACKEND_APISERVER = "apiserver"
+
+
+def make_kube_client(options, clock=None):
+    """Build the KubeClient implementation ``options.kube_backend`` names.
+
+    The in-memory client stays the default so every embedded/test composition
+    is unchanged unless the backend is asked for explicitly."""
+    backend = getattr(options, "kube_backend", BACKEND_MEMORY) or BACKEND_MEMORY
+    if backend == BACKEND_MEMORY:
+        from karpenter_core_tpu.operator.kubeclient import KubeClient
+
+        return KubeClient(
+            clock,
+            qps=options.kube_client_qps,
+            burst=options.kube_client_burst,
+        )
+    if backend == BACKEND_APISERVER:
+        url = getattr(options, "kube_apiserver", "")
+        if not url:
+            raise ValueError(
+                "--kube-backend=apiserver needs --kube-apiserver (or "
+                "KC_KUBE_APISERVER) naming the endpoint"
+            )
+        return ApiServerClient(
+            url,
+            clock,
+            qps=options.kube_client_qps,
+            burst=options.kube_client_burst,
+        )
+    raise ValueError(f"unknown kube backend {backend!r} (memory|apiserver)")
+
+
+__all__ = [
+    "ApiServerClient",
+    "ApiServerError",
+    "BACKEND_APISERVER",
+    "BACKEND_MEMORY",
+    "Reflector",
+    "make_kube_client",
+    "spec_for",
+]

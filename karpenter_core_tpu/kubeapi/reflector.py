@@ -1,0 +1,269 @@
+"""Reflector: the list/watch pump behind the apiserver-backed KubeClient.
+
+Mirror of client-go's reflector/informer pair (the plane the reference gets
+from controller-runtime, operator.go:91-133): one thread per kind runs
+
+    LIST (capture resourceVersion) → WATCH from it → apply events → repeat
+
+with the full robustness ladder:
+
+  - exponential backoff with jitter on stream drops / connection errors
+  - BOOKMARK events advance the resume resourceVersion without dispatch
+  - ``410 Gone`` (compacted history, as an ERROR event or HTTP status)
+    triggers a relist that DIFFS against the local store — vanished objects
+    get synthesized DELETED events, changed ones MODIFIED — so downstream
+    caches (state.Cluster) reconverge without a process restart
+  - per-key resourceVersion guards drop stale/duplicate events, which lets
+    the client deliver self-originated mutations synchronously (in-memory
+    KubeClient semantics) while the watch stream replays them later
+
+The store the reflector maintains is the read path for get/list, which is
+what makes a fresh process warm-start from a LIST: start() blocks until the
+initial sync completes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from karpenter_core_tpu.kubeapi.resources import ResourceSpec
+from karpenter_core_tpu.metrics import REGISTRY
+
+log = logging.getLogger(__name__)
+
+WATCH_RESTARTS = REGISTRY.counter(
+    "karpenter_kubeapi_watch_restarts_total",
+    "Watch stream restarts by kind and reason (drop/gone/error).",
+    ("kind", "reason"),
+)
+RELISTS = REGISTRY.counter(
+    "karpenter_kubeapi_relists_total",
+    "Full relists by kind (initial sync and 410-Gone recoveries).",
+    ("kind",),
+)
+
+
+class Reflector:
+    """One kind's list/watch loop feeding a keyed store + watch callbacks."""
+
+    def __init__(
+        self,
+        spec: ResourceSpec,
+        transport,  # kubeapi.client._Transport
+        *,
+        backoff_base_s: float = 0.2,
+        backoff_cap_s: float = 30.0,
+        watch_timeout_s: float = 60.0,
+    ) -> None:
+        self.spec = spec
+        self.transport = transport
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.watch_timeout_s = watch_timeout_s
+
+        self.lock = threading.RLock()
+        # serializes callback DISPATCH (not store access): a watch()
+        # registration snapshot-replays ADDED events under this lock so a
+        # concurrent live DELETED/MODIFIED can't interleave with (or precede)
+        # the stale replay and resurrect an object downstream.  RLock because
+        # callbacks re-enter the client (informer -> controller -> write ->
+        # self-delivery -> apply_event) on the same thread.
+        self.dispatch_lock = threading.RLock()
+        self.store: Dict[tuple, object] = {}  # key -> decoded object
+        # per-key applied-resourceVersion high-water marks; deleted keys keep
+        # a tombstone so a late watch replay of the pre-delete MODIFIED can't
+        # resurrect the object (pruned on relist)
+        self.applied_rv: Dict[tuple, int] = {}
+        self.callbacks: List[Callable[[str, object], None]] = []
+        self._resume_rv = 0
+        self._stop = threading.Event()
+        self._synced = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._current_response = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self, sync_timeout_s: float = 30.0) -> "Reflector":
+        self._thread = threading.Thread(
+            target=self._run, name=f"reflector-{self.spec.plural}", daemon=True
+        )
+        self._thread.start()
+        if not self._synced.wait(timeout=sync_timeout_s):
+            raise TimeoutError(
+                f"reflector for {self.spec.kind_name} failed initial LIST "
+                f"within {sync_timeout_s}s"
+            )
+        return self
+
+    def wait_synced(self, timeout_s: float = 30.0) -> None:
+        """Block until the initial LIST has been applied (no-op once set)."""
+        if not self._synced.wait(timeout=timeout_s):
+            raise TimeoutError(
+                f"reflector for {self.spec.kind_name} not synced within {timeout_s}s"
+            )
+
+    def stop(self) -> None:
+        self._stop.set()
+        resp = self._current_response
+        if resp is not None:
+            try:
+                resp.close()
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- key helpers -----------------------------------------------------------
+
+    def key_of(self, obj) -> tuple:
+        meta = obj.metadata
+        return (meta.namespace, meta.name) if self.spec.namespaced else (meta.name,)
+
+    # -- event application (shared with the client's self-delivery path) -------
+
+    def apply_event(self, event_type: str, obj, rv: int) -> bool:
+        """Apply one event to the store and dispatch callbacks; returns False
+        when the event is stale (per-key rv guard) and was dropped.  Callbacks
+        run outside the store lock (in-memory KubeClient discipline: informer
+        callbacks take Cluster locks whose holders call back into the
+        client)."""
+        key = self.key_of(obj)
+        with self.dispatch_lock:
+            with self.lock:
+                if rv <= self.applied_rv.get(key, 0):
+                    return False
+                self.applied_rv[key] = rv
+                if event_type == "DELETED":
+                    self.store.pop(key, None)
+                else:
+                    self.store[key] = obj
+                callbacks = list(self.callbacks)
+            for cb in callbacks:
+                cb(event_type, obj)
+        return True
+
+    # -- the loop --------------------------------------------------------------
+
+    def _run(self) -> None:
+        failures = 0
+        while not self._stop.is_set():
+            try:
+                self._list_and_sync()
+                self._synced.set()
+                failures = 0
+                self._watch()
+            except _Gone:
+                WATCH_RESTARTS.labels(self.spec.kind_name, "gone").inc()
+                log.info("watch %s: history compacted (410), relisting",
+                         self.spec.plural)
+                self._resume_rv = 0  # force a fresh LIST next round
+                continue  # no backoff: a relist is the designed recovery
+            except Exception as e:  # noqa: BLE001 - stream drops are routine
+                if self._stop.is_set():
+                    return
+                failures += 1
+                WATCH_RESTARTS.labels(self.spec.kind_name, "drop").inc()
+                delay = min(
+                    self.backoff_base_s * (2 ** min(failures - 1, 16)),
+                    self.backoff_cap_s,
+                ) * (0.5 + random.random())  # full jitter
+                log.warning(
+                    "watch %s dropped (%s: %s); retry %d in %.2fs",
+                    self.spec.plural, type(e).__name__, e, failures, delay,
+                )
+                self._stop.wait(delay)
+
+    def _list_and_sync(self) -> None:
+        """LIST and reconcile the store against it: the initial sync and every
+        410 recovery.  Objects present only locally get DELETED synthesized;
+        listed objects apply through the per-key rv guard (so a relist racing
+        a concurrent self-delivered write can't regress the store)."""
+        if self._resume_rv and self._synced.is_set():
+            return  # healthy resume: watch continues from the last-seen rv
+        RELISTS.labels(self.spec.kind_name).inc()
+        body = self.transport.request("GET", self.spec.base_path())
+        listed = body.get("items", [])
+        list_rv = int(body.get("metadata", {}).get("resourceVersion", 0) or 0)
+        decoded = [self.spec.from_dict(item) for item in listed]
+        listed_keys = {self.key_of(obj) for obj in decoded}
+        with self.lock:
+            vanished = [
+                (key, obj) for key, obj in self.store.items() if key not in listed_keys
+            ]
+            # prune tombstones of keys the server no longer knows: their
+            # history is gone, so no stale replay can arrive for them
+            for key in list(self.applied_rv):
+                if key not in listed_keys and key not in self.store:
+                    del self.applied_rv[key]
+        for key, obj in vanished:
+            with self.lock:
+                rv = self.applied_rv.get(key, 0)
+            self.apply_event("DELETED", obj, max(rv + 1, list_rv))
+        for obj in decoded:
+            event = "MODIFIED" if self.key_of(obj) in self.store else "ADDED"
+            self.apply_event(event, obj, obj.metadata.resource_version)
+        self._resume_rv = max(self._resume_rv, list_rv)
+
+    def _watch(self) -> None:
+        path = (
+            f"{self.spec.base_path()}?watch=true&resourceVersion={self._resume_rv}"
+            f"&allowWatchBookmarks=true"
+        )
+        resp = self.transport.stream("GET", path, timeout=self.watch_timeout_s)
+        if resp.status == 410:
+            resp.close()
+            raise _Gone()
+        if resp.status != 200:
+            body = resp.read()
+            resp.close()
+            raise IOError(f"watch {self.spec.plural}: HTTP {resp.status} {body[:200]!r}")
+        self._current_response = resp
+        try:
+            while not self._stop.is_set():
+                line = resp.readline()
+                if not line:
+                    WATCH_RESTARTS.labels(self.spec.kind_name, "eof").inc()
+                    return  # orderly end of stream: re-watch from resume rv
+                line = line.strip()
+                if not line:
+                    continue
+                event = json.loads(line)
+                etype, wire = event.get("type"), event.get("object", {})
+                rv = int(wire.get("metadata", {}).get("resourceVersion", 0) or 0)
+                if etype == "BOOKMARK":
+                    self._resume_rv = max(self._resume_rv, rv)
+                    continue
+                if etype == "ERROR":
+                    if wire.get("code") == 410:
+                        raise _Gone()
+                    raise IOError(f"watch error event: {wire}")
+                self.apply_event(etype, self.spec.from_dict(wire), rv)
+                self._resume_rv = max(self._resume_rv, rv)
+        finally:
+            self._current_response = None
+            try:
+                resp.close()
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+
+    # -- read surface ----------------------------------------------------------
+
+    def get(self, key: tuple):
+        with self.lock:
+            return self.store.get(key)
+
+    def snapshot(self) -> List[object]:
+        with self.lock:
+            return list(self.store.values())
+
+    def items(self) -> List[Tuple[tuple, object]]:
+        with self.lock:
+            return list(self.store.items())
+
+
+class _Gone(Exception):
+    """Watch history compacted past the resume rv (HTTP/event 410)."""

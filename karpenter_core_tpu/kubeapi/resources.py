@@ -1,0 +1,143 @@
+"""Kind registry for the apiserver-backed KubeClient.
+
+Maps every dataclass kind the framework stores to its Kubernetes REST
+coordinates (group/version/plural, namespaced-ness) and its wire codec
+(apis.codec dict round-trip).  Both sides of the protocol share this table:
+the client (kubeapi.client) builds request paths from it, and the hermetic
+fake apiserver (testing.fakeapiserver) serves exactly these routes — so a
+path-construction bug cannot hide behind a matching server-side bug for a
+kind the real apiserver would route differently.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from karpenter_core_tpu.apis import codec
+from karpenter_core_tpu.apis.objects import (
+    CSINode,
+    Lease,
+    Namespace,
+    Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    PodDisruptionBudget,
+    StorageClass,
+)
+from karpenter_core_tpu.apis.v1alpha5 import Machine, Provisioner
+from karpenter_core_tpu.operator.settingsstore import ConfigMap
+
+
+@dataclass(frozen=True)
+class ResourceSpec:
+    kind: type
+    kind_name: str
+    group: str  # "" = core
+    version: str
+    plural: str
+    namespaced: bool
+    to_dict: Callable[[Any], Dict[str, Any]]
+    from_dict: Callable[[Dict[str, Any]], Any]
+
+    @property
+    def api_version(self) -> str:
+        return self.version if not self.group else f"{self.group}/{self.version}"
+
+    def base_path(self, namespace: Optional[str] = None) -> str:
+        """Collection path: /api/v1/pods, /api/v1/namespaces/{ns}/pods,
+        /apis/karpenter.sh/v1alpha5/provisioners, ..."""
+        root = "/api/v1" if not self.group else f"/apis/{self.group}/{self.version}"
+        if self.namespaced and namespace is not None:
+            return f"{root}/namespaces/{namespace}/{self.plural}"
+        return f"{root}/{self.plural}"
+
+    def object_path(self, name: str, namespace: Optional[str] = None) -> str:
+        return f"{self.base_path(namespace)}/{name}"
+
+
+def _configmap_to_dict(cm: ConfigMap) -> Dict[str, Any]:
+    return {"metadata": codec._meta_to_dict(cm.metadata), "data": dict(cm.data)}
+
+
+def _configmap_from_dict(d: Dict[str, Any]) -> ConfigMap:
+    return ConfigMap(
+        metadata=codec._meta_from_dict(d.get("metadata", {})),
+        data=dict(d.get("data", {})),
+    )
+
+
+_SPECS = [
+    ResourceSpec(Pod, "Pod", "", "v1", "pods", True,
+                 codec.pod_to_dict, codec.pod_from_dict),
+    ResourceSpec(Node, "Node", "", "v1", "nodes", False,
+                 codec.node_to_dict, codec.node_from_dict),
+    ResourceSpec(Namespace, "Namespace", "", "v1", "namespaces", False,
+                 codec.namespace_to_dict, codec.namespace_from_dict),
+    ResourceSpec(ConfigMap, "ConfigMap", "", "v1", "configmaps", True,
+                 _configmap_to_dict, _configmap_from_dict),
+    ResourceSpec(PersistentVolumeClaim, "PersistentVolumeClaim", "", "v1",
+                 "persistentvolumeclaims", True,
+                 codec.pvc_to_dict, codec.pvc_from_dict),
+    ResourceSpec(PersistentVolume, "PersistentVolume", "", "v1",
+                 "persistentvolumes", False,
+                 codec.pv_to_dict, codec.pv_from_dict),
+    ResourceSpec(Provisioner, "Provisioner", "karpenter.sh", "v1alpha5",
+                 "provisioners", False,
+                 codec.provisioner_to_dict, codec.provisioner_from_dict),
+    ResourceSpec(Machine, "Machine", "karpenter.sh", "v1alpha5",
+                 "machines", False,
+                 codec.machine_to_dict, codec.machine_from_dict),
+    ResourceSpec(PodDisruptionBudget, "PodDisruptionBudget", "policy", "v1",
+                 "poddisruptionbudgets", True,
+                 codec.pdb_to_dict, codec.pdb_from_dict),
+    ResourceSpec(StorageClass, "StorageClass", "storage.k8s.io", "v1",
+                 "storageclasses", False,
+                 codec.storageclass_to_dict, codec.storageclass_from_dict),
+    ResourceSpec(CSINode, "CSINode", "storage.k8s.io", "v1", "csinodes", False,
+                 codec.csinode_to_dict, codec.csinode_from_dict),
+    ResourceSpec(Lease, "Lease", "coordination.k8s.io", "v1", "leases", True,
+                 codec.lease_to_dict, codec.lease_from_dict),
+]
+
+BY_KIND: Dict[type, ResourceSpec] = {s.kind: s for s in _SPECS}
+# route key the server dispatches on: (group, plural)
+BY_ROUTE: Dict[tuple, ResourceSpec] = {(s.group, s.plural): s for s in _SPECS}
+
+
+def spec_for(kind: type) -> ResourceSpec:
+    spec = BY_KIND.get(kind)
+    if spec is None:
+        raise TypeError(
+            f"{kind.__name__} is not registered with the apiserver backend "
+            f"(kubeapi.resources); the in-memory KubeClient accepts ad-hoc kinds, "
+            f"the wire protocol cannot"
+        )
+    return spec
+
+
+def parse_path(path: str):
+    """Server-side route parse → (spec, namespace, name).  ``namespace`` and
+    ``name`` are None for collection requests; raises KeyError on unknown
+    routes (the server turns that into a 404)."""
+    parts = [p for p in path.split("/") if p]
+    # /api/v1/... vs /apis/{group}/{version}/...
+    if parts and parts[0] == "api":
+        group, rest = "", parts[2:]
+    elif parts and parts[0] == "apis":
+        group, rest = parts[1], parts[3:]
+    else:
+        raise KeyError(path)
+    namespace = None
+    if len(rest) >= 2 and rest[0] == "namespaces" and (group, rest[1]) not in BY_ROUTE:
+        namespace, rest = rest[1], rest[2:]
+    if not rest:
+        # /api/v1/namespaces/{name}: the consumed segment addresses the
+        # Namespace object itself, not a scope
+        if namespace is not None and group == "":
+            return BY_ROUTE[("", "namespaces")], None, namespace
+        raise KeyError(path)
+    spec = BY_ROUTE[(group, rest[0])]
+    name = rest[1] if len(rest) > 1 else None
+    return spec, namespace, name

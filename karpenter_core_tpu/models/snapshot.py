@@ -187,6 +187,14 @@ class EncodedSnapshot:
     # re-push equivalent — affinity_scan_passes)
     scan_passes: int = 1
 
+    # static phase-plan flag: some class carries REQUIRED zonal anti-affinity,
+    # so the kernel must emit the per-zone committal phases (ops/solve.py
+    # _class_step's owned-anti loop — n_zones extra run_phase instances).
+    # False lets solve_core skip emitting them entirely: with no required
+    # zonal-anti class every committal quota is statically zero, and the
+    # phases are pure compile time + per-step cost
+    has_required_zonal_anti: bool = False
+
     # per-class resolved volumes (volumeusage.go:33-236 resolution, filled by
     # TPUSolver when a kube client is available).  Each entry:
     #   {"shared": {driver: {pvc ids}}, "per_pod": {driver: count}}
@@ -713,6 +721,11 @@ def encode_snapshot(
         default=0,
     )
     scan_passes += anti_extra
+    # any class (ladder variants included — they inherit the anti term) with
+    # required zonal anti makes the per-zone committal phases reachable
+    has_required_zonal_anti = any(
+        c.zone_anti is not None and not c.zone_anti_soft for c in classes
+    )
 
     resources: List[str] = [resources_util.CPU, resources_util.MEMORY, resources_util.PODS]
     for cls in classes:
@@ -743,6 +756,7 @@ def encode_snapshot(
         it_names=it_names,
         classes=classes,
         scan_passes=scan_passes,
+        has_required_zonal_anti=has_required_zonal_anti,
     )
     snap.valid = vocab.valid_mask()
     snap.is_custom = vocab.is_custom()

@@ -39,6 +39,44 @@ class NotFoundError(Exception):
     pass
 
 
+class RateLimiter:
+    """Client-side mutation throttle (--kube-client-qps/-burst,
+    options.go:61-62): token bucket over create/update/delete.  Shared by the
+    in-memory KubeClient and the apiserver-backed client (kubeapi.client) so
+    both backends meter writes identically.  ``qps`` None/0 disables."""
+
+    def __init__(self, qps: "Optional[float]", burst: "Optional[int]",
+                 now=None, sleep=None) -> None:
+        import time as _time
+
+        self._now = now or _time.time
+        self._sleep = sleep or _time.sleep
+        self._qps = qps
+        if qps:
+            self._burst = max(burst if burst is not None else int(qps * 1.5), 1)
+        else:
+            self._burst = None
+        self._tokens = float(self._burst or 0)
+        self._last_refill = self._now()
+        self._lock = threading.Lock()
+
+    def take(self) -> None:
+        if not self._qps:
+            return
+        while True:
+            with self._lock:
+                now = self._now()
+                self._tokens = min(
+                    float(self._burst), self._tokens + (now - self._last_refill) * self._qps
+                )
+                self._last_refill = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return
+                wait = (1.0 - self._tokens) / self._qps
+            self._sleep(wait)
+
+
 class _Store:
     """One kind's storage: keyed by (namespace, name) or name for cluster scope."""
 
@@ -58,16 +96,7 @@ class KubeClient:
 
         self._now = clock.now if clock is not None else _time.time
         self._sleep = clock.sleep if clock is not None else _time.sleep
-        # client-side mutation throttle (--kube-client-qps/-burst,
-        # options.go:61-62): token bucket over create/update/delete; None
-        # disables (direct library use / tests)
-        self._qps = qps
-        if qps:
-            self._burst = max(burst if burst is not None else int(qps * 1.5), 1)
-        else:
-            self._burst = None
-        self._tokens = float(self._burst or 0)
-        self._last_refill = self._now()
+        self._limiter = RateLimiter(qps, burst, now=self._now, sleep=self._sleep)
         self._lock = threading.RLock()
         self._stores: Dict[type, _Store] = {
             Pod: _Store(True),
@@ -92,20 +121,7 @@ class KubeClient:
         return self._stores[kind]
 
     def _throttle(self) -> None:
-        if not self._qps:
-            return
-        while True:
-            with self._lock:
-                now = self._now()
-                self._tokens = min(
-                    float(self._burst), self._tokens + (now - self._last_refill) * self._qps
-                )
-                self._last_refill = now
-                if self._tokens >= 1.0:
-                    self._tokens -= 1.0
-                    return
-                wait = (1.0 - self._tokens) / self._qps
-            self._sleep(wait)
+        self._limiter.take()
 
     def create(self, obj) -> object:
         self._throttle()

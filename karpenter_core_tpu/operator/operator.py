@@ -66,11 +66,12 @@ class Operator:
 
     def __post_init__(self) -> None:
         if self.kube_client is None:
-            self.kube_client = KubeClient(
-                self.clock,
-                qps=self.options.kube_client_qps,
-                burst=self.options.kube_client_burst,
-            )
+            # backend selector (--kube-backend=memory|apiserver): the
+            # apiserver client's reflectors warm-start cluster state from a
+            # LIST, so a restarted operator rebuilds instead of starting blind
+            from karpenter_core_tpu.kubeapi import make_kube_client
+
+            self.kube_client = make_kube_client(self.options, clock=self.clock)
         if self.recorder is None:
             self.recorder = Recorder(clock=self.clock.now)
         # live settings: controllers read through the store so ConfigMap
@@ -240,6 +241,10 @@ class Operator:
             self.provisioning.join_warmup(timeout=15.0)
         if self.http is not None:
             self.http.stop()
+        # apiserver backend: tear down reflector threads / watch streams
+        close = getattr(self.kube_client, "close", None)
+        if close is not None:
+            close()
         self._started = False
 
     def healthy(self) -> bool:

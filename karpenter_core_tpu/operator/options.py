@@ -20,6 +20,11 @@ class Options:
     enable_leader_election: bool = True
     memory_limit: int = -1  # bytes; GC soft limit at 90% (options.go:67-70)
     poll_interval: float = 10.0
+    # watch/list plane backend: "memory" (hermetic in-process store, the test
+    # default) or "apiserver" (real list/watch protocol via kubeapi/ against
+    # kube_apiserver; closes the §5.4 restart-rebuild gap — docs/KUBEAPI.md)
+    kube_backend: str = "memory"
+    kube_apiserver: str = ""  # http endpoint, e.g. http://127.0.0.1:8001
 
     @classmethod
     def parse(cls, argv: Optional[List[str]] = None) -> "Options":
@@ -46,6 +51,14 @@ class Options:
         parser.add_argument(
             "--memory-limit", type=int, default=int(_env("MEMORY_LIMIT", "-1"))
         )
+        parser.add_argument(
+            "--kube-backend",
+            choices=("memory", "apiserver"),
+            default=_env("KC_KUBE_BACKEND", "memory"),
+        )
+        parser.add_argument(
+            "--kube-apiserver", default=_env("KC_KUBE_APISERVER", "")
+        )
         # argv=None means the process command line (standard argparse contract);
         # pass [] explicitly for defaults-only parsing
         args = parser.parse_args(argv)
@@ -58,6 +71,8 @@ class Options:
             enable_profiling=args.enable_profiling,
             enable_leader_election=args.leader_elect,
             memory_limit=args.memory_limit,
+            kube_backend=args.kube_backend,
+            kube_apiserver=args.kube_apiserver,
         )
 
 

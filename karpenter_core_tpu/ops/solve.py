@@ -686,6 +686,7 @@ def _class_step(
     n_zones: int,
     carry,
     cls_with_index,
+    emit_zonal_anti: bool = True,
 ):
     """One scan step: schedule every pod of one class — existing nodes first,
     then new nodes, per phase.  Topology lives in shared group counts (the
@@ -957,7 +958,12 @@ def _class_step(
     anti_member = member_row[g_zan]
     anti_required = has_zan & anti_member & ~cls.anti_soft[0]
     placed_anti = jnp.int32(0)
-    for z in range(n_zones):
+    # the committal phases are only reachable for required-anti members; when
+    # the snapshot statically has none (emit_zonal_anti=False, from
+    # encode_snapshot's has_required_zonal_anti), every quota below is zero
+    # and the n_zones phases are skipped at trace time — they are the single
+    # largest per-class phase block, all compile time + per-step cost
+    for z in range(n_zones if emit_zonal_anti else 0):
         restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
         q = jnp.where(
             anti_required & zero_zones[z] & (placed_anti < m),
@@ -1055,6 +1061,7 @@ def solve_core(
     existing_state: "Optional[ExistingState]" = None,
     existing_static: "Optional[ExistingStatic]" = None,
     n_passes: int = 1,
+    emit_zonal_anti: bool = True,
 ):
     """Unjitted kernel core — jit/vmap/shard_map-composable (the parallel layer
     vmaps this over snapshot replicas and consolidation subsets;
@@ -1064,7 +1071,11 @@ def solve_core(
     topology counts — the kernel's equivalent of the host queue re-pushing
     failed pods until no progress (scheduler.go:117-123), needed when a
     cross-group affinity follower scans before its target
-    (models.snapshot.affinity_scan_passes)."""
+    (models.snapshot.affinity_scan_passes).
+
+    ``emit_zonal_anti`` (static) gates the owned zonal-anti committal phases;
+    pass EncodedSnapshot.has_required_zonal_anti so snapshots with no
+    required zonal-anti class skip tracing n_zones dead phases per class."""
     statics = Statics(*statics_arrays, key_has_bounds=key_has_bounds)
     n_zones = statics.tmpl_zone.shape[-1]
     n_res = statics.it_alloc.shape[-1]
@@ -1111,7 +1122,10 @@ def solve_core(
     )
 
     def step(carry, cls_with_index):
-        return _class_step(statics, existing_static, n_zones, carry, cls_with_index)
+        return _class_step(
+            statics, existing_static, n_zones, carry, cls_with_index,
+            emit_zonal_anti=emit_zonal_anti,
+        )
 
     cls_indices = jnp.arange(n_classes, dtype=jnp.int32)
     # charge open owned nodes' capacity against their provisioner's budget
@@ -1216,7 +1230,8 @@ def empty_existing_static(
 
 
 _solve_jit = functools.partial(
-    jax.jit, static_argnames=("n_slots", "key_has_bounds", "n_passes")
+    jax.jit,
+    static_argnames=("n_slots", "key_has_bounds", "n_passes", "emit_zonal_anti"),
 )(solve_core)
 
 
@@ -1268,6 +1283,7 @@ def solve(snapshot: EncodedSnapshot, n_slots: int = 0) -> SolveOutputs:
     return compilecache.run_solve(
         host_cls, host_statics, n_slots, key_has_bounds,
         n_passes=snapshot.scan_passes,
+        emit_zonal_anti=snapshot.has_required_zonal_anti,
     )
 
 

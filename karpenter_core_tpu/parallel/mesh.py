@@ -151,6 +151,7 @@ def solve_catalog_sharded(
                 n_slots=n_slots,
                 key_has_bounds=key_has_bounds,
                 n_passes=snapshot.scan_passes,
+                emit_zonal_anti=snapshot.has_required_zonal_anti,
             ),
             in_shardings=(cls_shardings, statics_shardings),
         )
@@ -207,6 +208,7 @@ def monte_carlo_solve(
         out = solve_ops.solve_core(
             cls, tuple(arrays), n_slots, key_has_bounds,
             n_passes=snapshot.scan_passes,
+            emit_zonal_anti=snapshot.has_required_zonal_anti,
         )
         scheduled = jnp.sum(out.assign)
         failed = jnp.sum(out.failed)
@@ -241,7 +243,8 @@ def monte_carlo_solve(
 
 
 @functools.lru_cache(maxsize=16)
-def _crossed_grid_fn(mesh, key_has_bounds, n_slots: int, n_passes: int, avail_idx: int):
+def _crossed_grid_fn(mesh, key_has_bounds, n_slots: int, n_passes: int, avail_idx: int,
+                     emit_zonal_anti: bool = True):
     """Cached jitted crossed grid — a fresh closure per call would defeat
     JAX's compile cache (keyed on callable identity) and recompile the whole
     vmap-of-vmap solve every study (same pattern as
@@ -257,7 +260,7 @@ def _crossed_grid_fn(mesh, key_has_bounds, n_slots: int, n_passes: int, avail_id
         cls_k = cls._replace(count=cls.count + displaced)
         out = solve_ops.solve_core(
             cls_k, tuple(arrays), n_slots, key_has_bounds, ex, ex_static,
-            n_passes=n_passes,
+            n_passes=n_passes, emit_zonal_anti=emit_zonal_anti,
         )
         return jnp.sum(out.failed), out.state.n_next
 
@@ -318,7 +321,8 @@ def crossed_consolidation_study(
         avail_r = jnp.concatenate([avail_r, avail_r[-1:].repeat(pad_r, axis=0)])
 
     fn = _crossed_grid_fn(
-        mesh, key_has_bounds, n_slots, snapshot.scan_passes, avail_idx
+        mesh, key_has_bounds, n_slots, snapshot.scan_passes, avail_idx,
+        snapshot.has_required_zonal_anti,
     )
     with mesh:
         failed, n_new = jax.device_get(

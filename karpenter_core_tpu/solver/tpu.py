@@ -735,6 +735,7 @@ class TPUSolver:
         outputs = compilecache.run_solve(
             cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
             n_passes=snapshot.scan_passes,
+            emit_zonal_anti=snapshot.has_required_zonal_anti,
         )
         # slot exhaustion: retry once with double capacity.  One batched fetch
         # (the relay costs ~67 ms per round trip); both arrays are cached on
@@ -746,6 +747,7 @@ class TPUSolver:
             outputs = compilecache.run_solve(
                 cls, statics_arrays, slots * 2, key_has_bounds, ex_state, ex_static,
                 n_passes=snapshot.scan_passes,
+                emit_zonal_anti=snapshot.has_required_zonal_anti,
             )
         return self.decode(snapshot, outputs, state_nodes or [])
 

@@ -7,14 +7,19 @@ provisioner informer records a consolidation change on spec-generation change.
 
 from __future__ import annotations
 
+import threading
+from typing import Optional
+
 from karpenter_core_tpu.apis.objects import CSINode, Node, Pod
 from karpenter_core_tpu.apis.v1alpha5 import Provisioner
 from karpenter_core_tpu.state.cluster import Cluster
 
 
 class NodeInformer:
-    def __init__(self, cluster: Cluster) -> None:
+    def __init__(self, cluster: Cluster, pod_informer: "Optional[PodInformer]" = None) -> None:
         self.cluster = cluster
+        # node arrivals re-drive pods parked on unknown nodes (see PodInformer)
+        self.pod_informer = pod_informer
 
     def start(self, kube_client) -> None:
         kube_client.watch(Node, self.on_event)
@@ -24,20 +29,54 @@ class NodeInformer:
             self.cluster.delete_node(node.name)
         else:
             self.cluster.update_node(node)
+            if self.pod_informer is not None:
+                self.pod_informer.retry_pending(node.name)
 
 
 class PodInformer:
+    """Pumps pod events into the Cluster, parking pods whose node the cluster
+    has not ingested yet.  The in-memory KubeClient delivers events in global
+    mutation order so the node always precedes its pods; the apiserver
+    backend's per-kind reflector threads give no such cross-kind ordering, and
+    a bound pod applied before its node would silently miss usage accounting
+    until the next pod event.  Parked pods re-apply when the node lands."""
+
     def __init__(self, cluster: Cluster) -> None:
         self.cluster = cluster
+        self._pending: dict = {}  # node name -> {pod key: Pod}
+        self._lock = threading.Lock()
 
     def start(self, kube_client) -> None:
         kube_client.watch(Pod, self.on_event)
 
     def on_event(self, event_type: str, pod: Pod) -> None:
+        key = (pod.namespace, pod.name)
         if event_type == "DELETED":
-            self.cluster.delete_pod((pod.namespace, pod.name))
-        else:
-            self.cluster.update_pod(pod)
+            with self._lock:
+                for parked in self._pending.values():
+                    parked.pop(key, None)
+            self.cluster.delete_pod(key)
+            return
+        err = self.cluster.update_pod(pod)
+        if err is not None and pod.spec.node_name:
+            with self._lock:
+                self._pending.setdefault(pod.spec.node_name, {})[key] = pod
+            # close the check-then-park window: the node may have landed
+            # between update_pod failing and the park (its retry_pending
+            # would have popped an empty dict) — re-drive immediately
+            self.retry_pending(pod.spec.node_name)
+
+    def retry_pending(self, node_name: str) -> None:
+        with self._lock:
+            parked = self._pending.pop(node_name, None)
+        if not parked:
+            return
+        for key, pod in parked.items():
+            if self.cluster.update_pod(pod) is not None and pod.spec.node_name:
+                # still unapplicable (node gone again mid-retry): re-park
+                # rather than dropping the pod's usage accounting
+                with self._lock:
+                    self._pending.setdefault(pod.spec.node_name, {})[key] = pod
 
 
 class ProvisionerInformer:
@@ -82,10 +121,12 @@ class CSINodeInformer:
 
 
 def start_informers(cluster: Cluster, kube_client) -> tuple:
-    node = NodeInformer(cluster)
     pod = PodInformer(cluster)
+    node = NodeInformer(cluster, pod_informer=pod)
     provisioner = ProvisionerInformer(cluster)
     csi_node = CSINodeInformer(cluster)
+    # nodes before pods: the registration replay (and the apiserver backend's
+    # warm-start LIST) must ingest nodes before the pods bound to them
     node.start(kube_client)
     pod.start(kube_client)
     provisioner.start(kube_client)

@@ -1,0 +1,393 @@
+"""Hermetic kube-apiserver speaking the list/watch subset kubeapi/ consumes.
+
+A threaded HTTP server storing raw wire dicts (no object model — the typed
+codecs live entirely client-side, so an encode bug can't be masked by a
+matching server-side decode).  Implements enough of the real protocol to
+exercise every failure path:
+
+  - typed GET/LIST/POST/PUT/DELETE with a global resourceVersion counter and
+    per-object optimistic concurrency (PUT with a nonzero resourceVersion
+    conflicts on mismatch, rv 0 replaces unconditionally — real apiserver
+    semantics for an empty resourceVersion)
+  - chunked watch streams (``?watch=true&resourceVersion=N``): JSON event
+    lines, periodic BOOKMARK keepalives, resume from any uncompacted rv
+  - forced ``410 Gone``: ``compact()`` raises the history floor so resumes
+    from older rvs get the ERROR-410 event that drives client relists
+  - abrupt connection drops: ``drop_watch_connections()`` resets every live
+    stream mid-flight (the reflector's backoff/rewatch path)
+  - injected request failures: ``fail_next(n)`` makes the next n plain
+    requests return 500 (retry/backoff paths)
+
+Usage:
+
+    server = FakeApiServer().start()
+    client = ApiServerClient(server.url, clock)
+    ...
+    server.stop()
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from karpenter_core_tpu.kubeapi import resources as resources_mod
+
+
+class _Store:
+    """One resource's objects, keyed (namespace, name) / (name,)."""
+
+    def __init__(self, spec) -> None:
+        self.spec = spec
+        self.objects: Dict[tuple, dict] = {}
+
+
+class FakeApiServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 bookmark_interval_s: float = 2.0) -> None:
+        self._host, self._port = host, port
+        self.bookmark_interval_s = bookmark_interval_s
+        self.lock = threading.Condition()
+        self._rv = 0
+        self._compacted_below = 0  # rvs < this are gone from history
+        # (rv, spec, event_type, wire) — the watch history
+        self._events: List[Tuple[int, object, str, dict]] = []
+        self._stores: Dict[tuple, _Store] = {}
+        self._fail_next = 0
+        self._fail_code = 500
+        self._drop_epoch = 0  # bumped by drop_watch_connections()
+        self._active_watches = 0
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.requests_served = 0
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "FakeApiServer":
+        server = self
+
+        class Handler(_Handler):
+            fake = server
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fakeapiserver", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self.drop_watch_connections()
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._thread.join(timeout=5)
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    @property
+    def resource_version(self) -> int:
+        with self.lock:
+            return self._rv
+
+    @property
+    def active_watch_count(self) -> int:
+        """Live watch streams — tests synchronize on this before injecting
+        drops (a drop only resets streams open at the time)."""
+        with self.lock:
+            return self._active_watches
+
+    def wait_for_watches(self, n: int = 1, timeout_s: float = 5.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        with self.lock:
+            while self._active_watches < n:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self.lock.wait(timeout=remaining)
+            return True
+
+    # -- failure injection ------------------------------------------------------
+
+    def drop_watch_connections(self) -> None:
+        """Abruptly terminate every live watch stream (stream-drop path)."""
+        with self.lock:
+            self._drop_epoch += 1
+            self.lock.notify_all()
+
+    def compact(self, below_rv: Optional[int] = None) -> None:
+        """Discard watch history below ``below_rv`` (default: everything so
+        far) — resumes from older rvs then get the 410 ERROR event."""
+        with self.lock:
+            floor = self._rv + 1 if below_rv is None else below_rv
+            self._compacted_below = max(self._compacted_below, floor)
+            self._events = [e for e in self._events if e[0] >= self._compacted_below]
+
+    def fail_next(self, n: int, code: int = 500) -> None:
+        with self.lock:
+            self._fail_next, self._fail_code = n, code
+
+    # -- store helpers (also the test-side seeding/assertion surface) ----------
+
+    def store_for(self, spec) -> _Store:
+        key = (spec.group, spec.plural)
+        store = self._stores.get(key)
+        if store is None:
+            store = self._stores[key] = _Store(spec)
+        return store
+
+    def object_count(self, kind: type) -> int:
+        spec = resources_mod.spec_for(kind)
+        with self.lock:
+            return len(self.store_for(spec).objects)
+
+    def wire_objects(self, kind: type) -> List[dict]:
+        spec = resources_mod.spec_for(kind)
+        with self.lock:
+            return [json.loads(json.dumps(o)) for o in self.store_for(spec).objects.values()]
+
+    # -- mutation core (always under self.lock) --------------------------------
+
+    def _key(self, spec, namespace: Optional[str], wire: dict) -> tuple:
+        meta = wire.get("metadata", {})
+        name = meta.get("name", "")
+        if spec.namespaced:
+            return (namespace or meta.get("namespace", "default"), name)
+        return (name,)
+
+    def _record(self, spec, event_type: str, wire: dict) -> dict:
+        self._rv += 1
+        wire = dict(wire)
+        meta = dict(wire.get("metadata", {}))
+        meta["resourceVersion"] = self._rv
+        wire["metadata"] = meta
+        self._events.append((self._rv, spec, event_type, wire))
+        self.lock.notify_all()
+        return wire
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    fake: FakeApiServer = None  # bound by FakeApiServer.start
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str) -> None:
+        self._send_json(code, {"kind": "Status", "code": code, "message": message})
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        data = self.rfile.read(length) if length else b"{}"
+        return json.loads(data or b"{}")
+
+    def _route(self):
+        parts = urlsplit(self.path)
+        spec, namespace, name = resources_mod.parse_path(parts.path)
+        query = parse_qs(parts.query)
+        return spec, namespace, name, query
+
+    def _maybe_fail(self) -> bool:
+        fake = self.fake
+        with fake.lock:
+            fake.requests_served += 1
+            if fake._fail_next > 0:
+                fake._fail_next -= 1
+                code = fake._fail_code
+            else:
+                return False
+        self._error(code, "injected failure")
+        return True
+
+    # -- verbs -----------------------------------------------------------------
+
+    def do_GET(self):
+        try:
+            spec, namespace, name, query = self._route()
+        except KeyError:
+            return self._error(404, f"unknown route {self.path}")
+        if query.get("watch", ["false"])[0] == "true":
+            return self._watch(spec, query)
+        if self._maybe_fail():
+            return
+        fake = self.fake
+        with fake.lock:
+            store = fake.store_for(spec)
+            if name is None:
+                items = [
+                    obj for key, obj in store.objects.items()
+                    if namespace is None or not spec.namespaced or key[0] == namespace
+                ]
+                return self._send_json(200, {
+                    "kind": f"{spec.kind_name}List",
+                    "apiVersion": spec.api_version,
+                    "metadata": {"resourceVersion": fake._rv},
+                    "items": items,
+                })
+            key = (namespace or "default", name) if spec.namespaced else (name,)
+            obj = store.objects.get(key)
+        if obj is None:
+            return self._error(404, f"{spec.kind_name} {name} not found")
+        self._send_json(200, obj)
+
+    def do_POST(self):
+        if self._maybe_fail():
+            return
+        try:
+            spec, namespace, name, _ = self._route()
+        except KeyError:
+            return self._error(404, f"unknown route {self.path}")
+        wire = self._read_body()
+        fake = self.fake
+        with fake.lock:
+            store = fake.store_for(spec)
+            key = fake._key(spec, namespace, wire)
+            if key in store.objects:
+                return self._error(409, f"{spec.kind_name} {key} already exists")
+            if not wire.get("metadata", {}).get("creationTimestamp"):
+                wire.setdefault("metadata", {})["creationTimestamp"] = time.time()
+            stored = fake._record(spec, "ADDED", wire)
+            store.objects[key] = stored
+        self._send_json(201, stored)
+
+    def do_PUT(self):
+        if self._maybe_fail():
+            return
+        try:
+            spec, namespace, name, _ = self._route()
+        except KeyError:
+            return self._error(404, f"unknown route {self.path}")
+        wire = self._read_body()
+        fake = self.fake
+        with fake.lock:
+            store = fake.store_for(spec)
+            key = fake._key(spec, namespace, wire)
+            stored = store.objects.get(key)
+            if stored is None:
+                return self._error(404, f"{spec.kind_name} {key} not found")
+            expected = int(wire.get("metadata", {}).get("resourceVersion", 0) or 0)
+            current = int(stored.get("metadata", {}).get("resourceVersion", 0) or 0)
+            if expected and expected != current:
+                return self._error(
+                    409,
+                    f"{spec.kind_name} {key} resourceVersion {current} != {expected}",
+                )
+            updated = fake._record(spec, "MODIFIED", wire)
+            store.objects[key] = updated
+        self._send_json(200, updated)
+
+    def do_DELETE(self):
+        if self._maybe_fail():
+            return
+        try:
+            spec, namespace, name, _ = self._route()
+        except KeyError:
+            return self._error(404, f"unknown route {self.path}")
+        fake = self.fake
+        with fake.lock:
+            store = fake.store_for(spec)
+            key = (namespace or "default", name) if spec.namespaced else (name,)
+            stored = store.objects.pop(key, None)
+            if stored is None:
+                return self._error(404, f"{spec.kind_name} {name} not found")
+            gone = fake._record(spec, "DELETED", stored)
+        self._send_json(200, gone)
+
+    # -- the watch stream ------------------------------------------------------
+
+    def _chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _send_event(self, event: dict) -> None:
+        self._chunk(json.dumps(event).encode() + b"\n")
+
+    def _watch(self, spec, query) -> None:
+        fake = self.fake
+        since_rv = int(query.get("resourceVersion", ["0"])[0] or 0)
+        with fake.lock:
+            if since_rv and since_rv + 1 < fake._compacted_below:
+                # history below the floor is gone: the resume point is stale
+                gone = True
+            else:
+                gone = False
+            epoch = fake._drop_epoch
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        if gone:
+            self._send_event({
+                "type": "ERROR",
+                "object": {"kind": "Status", "code": 410, "message": "too old resource version"},
+            })
+            self._chunk(b"")  # terminating chunk
+            return
+        last_sent = since_rv
+        last_bookmark = time.monotonic()
+        with fake.lock:
+            fake._active_watches += 1
+            fake.lock.notify_all()
+        try:
+            while True:
+                with fake.lock:
+                    if fake._drop_epoch != epoch:
+                        # abrupt drop: kill the socket without a clean close
+                        raise ConnectionAbortedError("injected watch drop")
+                    pending = [
+                        (rv, etype, wire)
+                        for rv, espec, etype, wire in fake._events
+                        if rv > last_sent and espec is spec
+                    ]
+                    if not pending:
+                        fake.lock.wait(timeout=0.1)
+                        pending = [
+                            (rv, etype, wire)
+                            for rv, espec, etype, wire in fake._events
+                            if rv > last_sent and espec is spec
+                        ]
+                    current_rv = fake._rv
+                for rv, etype, wire in pending:
+                    self._send_event({"type": etype, "object": wire})
+                    last_sent = rv
+                now = time.monotonic()
+                if now - last_bookmark >= fake.bookmark_interval_s:
+                    self._send_event({
+                        "type": "BOOKMARK",
+                        "object": {"metadata": {"resourceVersion": current_rv}},
+                    })
+                    last_sent = max(last_sent, current_rv)
+                    last_bookmark = now
+        except ConnectionAbortedError:
+            # terminate mid-stream with no terminating chunk: the client sees
+            # a truncated chunked body (IncompleteRead/EOF), i.e. a real drop
+            try:
+                self.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self.close_connection = True
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            self.close_connection = True
+        finally:
+            with fake.lock:
+                fake._active_watches -= 1
+                fake.lock.notify_all()

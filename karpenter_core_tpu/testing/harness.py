@@ -73,10 +73,13 @@ class Environment:
 
 
 def make_environment(
-    instance_types=None, settings: Optional[Settings] = None
+    instance_types=None, settings: Optional[Settings] = None, kube_factory=None
 ) -> Environment:
+    """``kube_factory(clock)`` swaps the kube backend (default: in-memory
+    KubeClient) — the apiserver-parity suites pass an ApiServerClient factory
+    bound to a fake apiserver and re-run the same scenarios byte-identically."""
     clock = FakeClock()
-    kube = KubeClient(clock)
+    kube = kube_factory(clock) if kube_factory is not None else KubeClient(clock)
     provider = FakeCloudProvider(instance_types)
     settings = settings or Settings()
     recorder = Recorder(clock=clock.now)

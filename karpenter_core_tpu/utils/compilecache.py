@@ -223,6 +223,7 @@ def solve_callable(
     ex_state=None,
     ex_static=None,
     n_passes: int = 1,
+    emit_zonal_anti: bool = True,
 ):
     """An AOT-compiled solve callable served through the export cache, or None
     when export-caching is unavailable (callers fall back to the plain jit).
@@ -243,6 +244,7 @@ def solve_callable(
             n_slots,
             tuple(key_has_bounds),
             n_passes,
+            emit_zonal_anti,
             has_ex,
             _leaf_sig(cls),
             _leaf_sig(statics_arrays),
@@ -266,7 +268,8 @@ def solve_callable(
 
         try:
             return _build_and_memo(key, cls, statics_arrays, n_slots,
-                                   key_has_bounds, ex_state, ex_static, n_passes)
+                                   key_has_bounds, ex_state, ex_static, n_passes,
+                                   emit_zonal_anti)
         finally:
             with _lock:
                 _in_flight.pop(key, None)
@@ -277,7 +280,7 @@ def solve_callable(
 
 
 def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
-                    ex_state, ex_static, n_passes):
+                    ex_state, ex_static, n_passes, emit_zonal_anti=True):
     """Build one executable for ``key``: export-cache load (or trace+export),
     then AOT compile, then memoize.  Callers hold the key's in-flight slot."""
     import jax
@@ -305,13 +308,15 @@ def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
         if has_ex:
             base = jax.jit(
                 lambda c, s, exs, exst: solve_ops.solve_core(
-                    c, s, n_slots, key_has_bounds, exs, exst, n_passes=n_passes
+                    c, s, n_slots, key_has_bounds, exs, exst, n_passes=n_passes,
+                    emit_zonal_anti=emit_zonal_anti,
                 )
             )
         else:
             base = jax.jit(
                 lambda c, s: solve_ops.solve_core(
-                    c, s, n_slots, key_has_bounds, n_passes=n_passes
+                    c, s, n_slots, key_has_bounds, n_passes=n_passes,
+                    emit_zonal_anti=emit_zonal_anti,
                 )
             )
         exported = jax.export.export(base)(*structs)
@@ -337,6 +342,7 @@ def run_solve(
     ex_state=None,
     ex_static=None,
     n_passes: int = 1,
+    emit_zonal_anti: bool = True,
 ):
     """Solve through the export cache, falling back to the plain jit.
 
@@ -365,13 +371,14 @@ def run_solve(
                 jax.device_put, (cls, statics_arrays, ex_state, ex_static)
             )
             fn = solve_callable(
-                cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static, n_passes
+                cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
+                n_passes, emit_zonal_anti,
             )
             cls, statics_arrays, ex_state, ex_static = upload.result()
         if fn is None:
             out = solve_ops._solve_jit(
                 cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
-                n_passes=n_passes,
+                n_passes=n_passes, emit_zonal_anti=emit_zonal_anti,
             )
         elif ex_state is not None:
             out = fn(cls, statics_arrays, ex_state, ex_static)

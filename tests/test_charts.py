@@ -66,6 +66,33 @@ class TestMainChart:
         assert env["LEADER_ELECT"] == "true"
         ports = {p["name"]: p["containerPort"] for p in container["ports"]}
         assert ports == {"http-metrics": 8080, "http": 8081}
+        # kube backend defaults to the hermetic in-memory store; apiserver
+        # mode adds the endpoint env (docs/KUBEAPI.md)
+        assert env["KC_KUBE_BACKEND"] == "memory"
+        assert "KC_KUBE_APISERVER" not in env
+        api = render_chart(
+            CHART,
+            value_overrides={"controller": {"kubeBackend": "apiserver",
+                                            "kubeApiserver": "http://127.0.0.1:8001"}},
+        )["deployment.yaml"][0]
+        env_api = {
+            e["name"]: e.get("value")
+            for e in api["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert env_api["KC_KUBE_BACKEND"] == "apiserver"
+        assert env_api["KC_KUBE_APISERVER"] == "http://127.0.0.1:8001"
+
+    def test_solver_pins_jax_platform_for_the_xla_cache(self):
+        # compilecache.enable() keeps the persistent XLA cache off for an
+        # unpinned/cpu platform; the deployed TPU solver must name its
+        # platform or silently lose the cache volume's benefit (ADVICE r5)
+        solver = render_chart(CHART)["solver.yaml"]
+        deploy = next(d for d in solver if d["kind"] == "Deployment")
+        env = {
+            e["name"]: e.get("value")
+            for e in deploy["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert env["JAX_PLATFORMS"] == "tpu"
 
     def test_solver_hostpath_default_and_pvc_option(self):
         solver = render_chart(CHART)["solver.yaml"]

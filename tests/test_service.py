@@ -242,12 +242,16 @@ class TestWireSchema:
         )
         d = codec.pod_to_dict(pod)
         assert set(d) == {"metadata", "spec", "status"}
+        # metadata carries durability fields since the kubeapi backend
+        # (resourceVersion/generation always; deletionTimestamp, finalizers,
+        # ownerReferences only when set — absent on this fresh pod)
         assert set(d["metadata"]) == {
             "name", "namespace", "uid", "labels", "annotations", "creationTimestamp",
+            "resourceVersion", "generation",
         }
         assert set(d["spec"]) == {
             "nodeSelector", "nodeName", "tolerations", "containers",
-            "topologySpreadConstraints", "priority", "pvcs",
+            "topologySpreadConstraints", "priority", "priorityClassName", "pvcs",
         }
         container = d["spec"]["containers"][0]
         assert set(container) == {"requests", "limits", "hostPorts"}

@@ -334,6 +334,7 @@ class TestBackendProbe:
             raise subprocess.TimeoutExpired(cmd="probe", timeout=kwargs["timeout"])
 
         monkeypatch.setattr(backendprobe.subprocess, "run", hang)
+        backendprobe.reset_fail_cache()
         before = backendprobe.PROBE_TOTAL.labels("timeout").value
         with tracing.span("bringup"):
             result = backendprobe.probe_once(60.0, attempt=1)
@@ -352,13 +353,54 @@ class TestBackendProbe:
             raise subprocess.TimeoutExpired(cmd="probe", timeout=kwargs["timeout"])
 
         monkeypatch.setattr(backendprobe.subprocess, "run", broken)
+        backendprobe.reset_fail_cache()
         state = backendprobe.acquire_backend(
             max_attempts=2, probe_timeout_s=1.0, sleep=lambda s: None
         )
         assert state.fell_back and state.platform == "cpu"
         assert state.attempts == 2
-        assert [p["outcome"] for p in state.probes] == ["timeout", "timeout"]
-        assert len(state.probe_failures) == 2
+        # one REAL probe per failure window: the first timeout is cached and
+        # the ladder short-circuits instead of re-paying the hang
+        assert [p["outcome"] for p in state.probes] == ["timeout", "cached"]
+        assert any("short-circuited" in f for f in state.probe_failures)
+
+    def test_failure_cache_expires_and_success_clears_it(self, monkeypatch):
+        from karpenter_core_tpu.solver import backendprobe
+
+        def broken(*args, **kwargs):
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=kwargs["timeout"])
+
+        monkeypatch.setattr(backendprobe.subprocess, "run", broken)
+        backendprobe.reset_fail_cache()
+        first = backendprobe.probe_once(1.0)
+        assert first.outcome == "timeout" and not first.cached
+        second = backendprobe.probe_once(1.0)
+        assert second.cached and second.platform is None
+        assert "cached failure" in second.error
+
+        # TTL 0 disables the cache entirely
+        monkeypatch.setenv("KC_PROBE_FAIL_TTL_S", "0")
+        third = backendprobe.probe_once(1.0)
+        assert third.outcome == "timeout" and not third.cached
+        monkeypatch.delenv("KC_PROBE_FAIL_TTL_S")
+
+        class FakeProc:
+            returncode = 0
+            stdout = "PLATFORM=tpu\n"
+            stderr = ""
+
+        monkeypatch.setattr(
+            backendprobe.subprocess, "run", lambda *a, **k: FakeProc()
+        )
+        # expiry: pretend the window passed, the next probe runs for real
+        backendprobe._fail_cache = (
+            backendprobe.time.monotonic() - 3600.0, first,
+        )
+        ok = backendprobe.probe_once(1.0)
+        assert ok.outcome == "ok" and ok.platform == "tpu"
+        # ...and success cleared the cache
+        assert backendprobe._cached_failure() is None
+        backendprobe.reset_fail_cache()
 
     def test_success_short_circuits(self, monkeypatch):
         from karpenter_core_tpu.solver import backendprobe
@@ -371,6 +413,7 @@ class TestBackendProbe:
         monkeypatch.setattr(
             backendprobe.subprocess, "run", lambda *a, **k: FakeProc()
         )
+        backendprobe.reset_fail_cache()
         state = backendprobe.acquire_backend(max_attempts=5, sleep=lambda s: None)
         assert state.platform == "tpu" and not state.fell_back
         assert state.attempts == 1 and len(state.probes) == 1

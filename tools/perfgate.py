@@ -12,6 +12,13 @@ from a different machine the tolerance widens (observed cross-machine spread
 on the same code is ~15%), so the gate still catches collapses without
 flagging hardware variance as regressions.
 
+Drift verdicts are ADVISORY by default (warn, exit 0): presubmit shares the
+machine with whatever else is running, and ambient-load bench noise was
+flaking unrelated changes.  Set ``KC_PERF_GATE_STRICT=1`` (CI on a quiet
+runner) to make a drift FAIL exit 1 again.  Broken-bench conditions (no
+pods_per_sec, bench error) stay hard failures in both modes — those are
+bugs, not noise.
+
 Usage: python tools/perfgate.py [--tolerance 0.05] [--record path.json]
 """
 
@@ -101,14 +108,18 @@ def main() -> int:
     )
     tol = args.tolerance if same_machine else args.cross_machine_tolerance
     floor = prev_pps * (1.0 - tol)
-    verdict = "PASS" if pods_per_sec >= floor else "FAIL"
+    strict = os.environ.get("KC_PERF_GATE_STRICT", "0") == "1"
+    verdict = "PASS" if pods_per_sec >= floor else ("FAIL" if strict else "WARN")
     print(
         f"perfgate: {verdict} — {pods_per_sec} pods/s on {platform} vs "
         f"{prev_pps} in {os.path.basename(path)} (round {rnd}, "
         f"{'same' if same_machine else 'different'} machine, "
         f"tolerance {tol:.0%}, floor {floor:.0f})"
     )
-    return 0 if verdict == "PASS" else 1
+    if verdict == "WARN":
+        print("perfgate: advisory mode — drift does not fail presubmit "
+              "(KC_PERF_GATE_STRICT=1 to enforce)")
+    return 1 if verdict == "FAIL" else 0
 
 
 if __name__ == "__main__":

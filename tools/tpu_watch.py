@@ -5,8 +5,15 @@ so a single end-of-round bench run can miss a mid-round recovery entirely.
 This watcher probes the relay on an interval (bounded, fresh-process probes:
 the same discipline as bench.acquire_backend) and the moment a real
 accelerator answers, runs the full bench once and appends the TPU-stamped
-record to ``BENCH_TPU_OPPORTUNISTIC.json``, then keeps watching (the relay
-may flap; later records append as JSON lines).
+record to ``BENCH_TPU_OPPORTUNISTIC.jsonl``, then keeps watching (the relay
+may flap; later records append).
+
+Output format: JSON Lines (https://jsonlines.org/) — one complete bench
+record per line, in append order.  Consumers must read line-by-line
+(``for line in f: json.loads(line)``), NOT ``json.load`` the whole file; the
+``.jsonl`` suffix is the contract (ADVICE r5: the old ``.json`` name broke
+array-readers as soon as a second record landed).  Each record is bench.py's
+output dict plus ``recorded_at_unix``.
 
 Usage: python tools/tpu_watch.py [--interval 180] [--max-hours 12]
 Run it in the background for the round; it exits after --max-hours.
@@ -19,7 +26,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "BENCH_TPU_OPPORTUNISTIC.json")
+OUT = os.path.join(REPO, "BENCH_TPU_OPPORTUNISTIC.jsonl")
 
 
 sys.path.insert(0, REPO)
