@@ -11,7 +11,7 @@ verify:  ## static checks: bytecode-compile, lint gate, instrumentation gate, bu
 	$(MAKE) -C native
 
 test:  ## fast behavioral tier (virtual 8-device CPU mesh, ~2 min)
-	$(PYTEST) tests/ -x -q -m "not compile"
+	$(PYTEST) tests/ -x -q -m "not compile and not slow"
 
 test-all:  ## everything incl. the compile-heavy kernel/parity tier (~25 min)
 	$(PYTEST) tests/ -x -q

@@ -37,17 +37,20 @@ _BACKEND = {
 }
 
 
-def acquire_backend(max_attempts: int = 5, probe_timeout_s: float = 60.0,
+def acquire_backend(max_attempts: int = 5, probe_timeout_s=None,
                     deadline_s: float = 360.0) -> None:
     """Bounded-retry backend bring-up; never raises.
 
     Delegates to solver.backendprobe (fresh-interpreter probes with hard
     timeouts, each attempt recorded as a counter + histogram + structured log
-    line).  First success wins — the backend is then known-healthy and this
-    process imports jax normally.  All-fail re-execs this process onto CPU
-    (``_reexec_on_cpu``) so the bench still produces a verified number with
-    ``platform: "cpu"`` stamped, rather than dying the way round 2's run did
-    when the relay was down.
+    line).  The per-attempt timeout comes from ``KC_PROBE_TIMEOUT_S``
+    (default 60 s) unless pinned here, and the retry ladder stops at the
+    FIRST failure served from the probe failure cache — a dead relay costs
+    one real probe, not max_attempts of them.  First success wins — the
+    backend is then known-healthy and this process imports jax normally.
+    All-fail re-execs this process onto CPU (``_reexec_on_cpu``) so the
+    bench still produces a verified number with ``platform: "cpu"`` stamped,
+    rather than dying the way round 2's run did when the relay was down.
 
     If a previous incarnation of this process already ran the probes and
     re-exec'd, its verdict arrives via KC_BENCH_BACKEND_STATE and no probes

@@ -195,6 +195,13 @@ class EncodedSnapshot:
     # phases are pure compile time + per-step cost
     has_required_zonal_anti: bool = False
 
+    # full static phase plan (ops/solve.SnapshotFeatures): one flag per
+    # constraint family, computed from the classes + bound-pod anti groups.
+    # has_required_zonal_anti above is its required_zone_anti bit, kept for
+    # compatibility.  volume_limits is refined at solve time (TPUSolver) —
+    # it depends on the existing-node CSI planes this encode cannot see.
+    features: object = None
+
     # per-class resolved volumes (volumeusage.go:33-236 resolution, filled by
     # TPUSolver when a kube client is available).  Each entry:
     #   {"shared": {driver: {pvc ids}}, "per_pod": {driver: count}}
@@ -972,6 +979,36 @@ def encode_snapshot(
     for c, cls in enumerate(classes):
         for key in pod_port_keys(cls.pods[0]):
             snap.cls_ports[c, port_idx[key]] = True
+
+    # -- static phase plan ----------------------------------------------------
+    # which constraint families any class can exercise; a False flag lets the
+    # kernel skip tracing the family's phases entirely (ops/solve._class_step).
+    # Deferred import: ops.solve imports this module at load time.
+    from karpenter_core_tpu.ops.solve import SnapshotFeatures
+
+    def owns(attr: str) -> bool:
+        return any(getattr(c, attr) is not None for c in classes)
+
+    extra_groups = [spec for spec, _ in (extra_anti_groups or [])]
+    snap.features = SnapshotFeatures(
+        zone_spread=owns("zone_spread"),
+        host_spread=owns("host_spread"),
+        zone_affinity=owns("zone_affinity"),
+        host_affinity=owns("host_affinity"),
+        zone_anti=owns("zone_anti"),
+        required_zone_anti=has_required_zonal_anti,
+        host_anti=owns("host_anti"),
+        # inverse planes: groups whose owners register inverse counts —
+        # required class-owned anti terms or already-bound pods' terms
+        inv_zone_anti=has_required_zonal_anti
+        or any(g.is_zone for g in extra_groups),
+        inv_host_anti=any(
+            c.host_anti is not None and not c.host_anti_soft for c in classes
+        )
+        or any(not g.is_zone for g in extra_groups),
+        host_ports=bool(snap.cls_ports.any()),
+        volume_limits=False,  # refined by TPUSolver.solve_encoded
+    ).canonical()
 
     return snap
 

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from karpenter_core_tpu.ops import solve as solve_ops
+from karpenter_core_tpu.utils import compilecache
 
 
 class SweepOutputs(NamedTuple):
@@ -49,6 +50,7 @@ def sweep(
     prefix_sizes: jnp.ndarray,  # i32[S]
     n_slots: int = 16,
     n_passes: int = 1,
+    features=None,
 ) -> SweepOutputs:
     """Simulate closing the first-k candidates for every k in prefix_sizes."""
 
@@ -66,7 +68,7 @@ def sweep(
         cls = class_tensors._replace(count=class_tensors.count + displaced)
         out = solve_ops.solve_core(
             cls, statics_arrays, n_slots, key_has_bounds, ex, ex_static,
-            n_passes=n_passes,
+            n_passes=n_passes, features=features,
         )
         n_new = out.state.n_next
         failed = jnp.sum(out.failed)
@@ -89,12 +91,13 @@ def sweep(
 
 
 _sweep_jit = functools.partial(
-    jax.jit, static_argnames=("key_has_bounds", "n_slots", "n_passes")
+    jax.jit, static_argnames=("key_has_bounds", "n_slots", "n_passes", "features")
 )(sweep)
 
 
 @functools.lru_cache(maxsize=16)
-def _sharded_sweep_fn(mesh, key_has_bounds, n_slots: int, n_passes: int = 1):
+def _sharded_sweep_fn(mesh, key_has_bounds, n_slots: int, n_passes: int = 1,
+                      features=None):
     """Cached jitted sweep with the lane axis sharded over the mesh — a fresh
     closure per call would defeat JAX's compile cache (keyed on callable
     identity) and recompile every sweep."""
@@ -106,6 +109,7 @@ def _sharded_sweep_fn(mesh, key_has_bounds, n_slots: int, n_passes: int = 1):
         return sweep(
             cls_arg, statics_arg, key_has_bounds, ex_state_arg, ex_static_arg,
             rank_arg, counts_arg, sizes_arg, n_slots=n_slots, n_passes=n_passes,
+            features=features,
         )
 
     return jax.jit(core, in_shardings=(lane_sharded, None, None, None, None, None, None))
@@ -133,7 +137,12 @@ def run_sweep(
         pad = (-len(prefix_sizes)) % n_dev
         if pad:
             sizes = jnp.concatenate([sizes, jnp.repeat(sizes[-1:], pad)])
-        fn = _sharded_sweep_fn(mesh, key_has_bounds, n_slots, snapshot.scan_passes)
+        fn = _sharded_sweep_fn(
+            mesh, key_has_bounds, n_slots, snapshot.scan_passes,
+            compilecache.snap_features(
+                solve_ops.features_with_existing(snapshot, ex_static)
+            ),
+        )
         with mesh:
             out = fn(
                 sizes, cls, statics_arrays, ex_state, ex_static,
@@ -153,4 +162,7 @@ def run_sweep(
         sizes,
         n_slots=n_slots,
         n_passes=snapshot.scan_passes,
+        features=compilecache.snap_features(
+            solve_ops.features_with_existing(snapshot, ex_static)
+        ),
     )

@@ -41,11 +41,62 @@ from karpenter_core_tpu.ops import masks as mask_ops
 BIG = np.float32(1e30)
 
 
+class SnapshotFeatures(NamedTuple):
+    """Static phase-plan flags: which constraint families the snapshot can
+    exercise at all.  Computed host-side in models.snapshot.encode_snapshot
+    from the CLASSES (plus bound-pod anti groups and, at solve time, the
+    existing-node volume planes) and threaded through solve_core as a static
+    jit argument — a False flag means the corresponding phase family is
+    provably dead for every class in the snapshot, so the kernel never traces
+    it: no compile time, no per-step lax.cond, no dead carry writes.
+
+    Soundness is one-directional: a flag may be True with the feature absent
+    from the data (the phases are then runtime no-ops, exactly the pre-flag
+    behavior), but must never be False when some class needs the family.
+    utils.compilecache.snap_features exploits that monotonicity to widen a
+    requested set to an already-built superset executable instead of
+    recompiling (and to bound the variant space).
+    """
+
+    zone_spread: bool = True  # some class owns a zonal topology-spread slot
+    host_spread: bool = True  # ... a hostname spread slot
+    zone_affinity: bool = True  # ... a zonal pod-affinity slot
+    host_affinity: bool = True  # ... a hostname pod-affinity slot
+    zone_anti: bool = True  # ... a zonal anti-affinity slot (soft or required)
+    required_zone_anti: bool = True  # ... REQUIRED zonal anti (committal phases)
+    host_anti: bool = True  # ... a hostname anti-affinity slot
+    # inverse planes: anti GROUPS whose owners can register inverse counts —
+    # required class-owned terms or bound-pod terms (extra_anti_groups)
+    inv_zone_anti: bool = True
+    inv_host_anti: bool = True
+    host_ports: bool = True  # some class binds host ports
+    volume_limits: bool = True  # existing nodes carry finite CSI attach limits
+
+    def canonical(self) -> "SnapshotFeatures":
+        """Normalize implications so equivalent requests share a cache key:
+        required zonal anti implies the zonal-anti family and inverse plane."""
+        f = self
+        if f.required_zone_anti:
+            f = f._replace(zone_anti=True, inv_zone_anti=True)
+        return f
+
+    def covers(self, other: "SnapshotFeatures") -> bool:
+        """True when an executable traced with ``self`` is sound for a
+        snapshot requesting ``other`` (self is a flag superset)."""
+        return all(a or not b for a, b in zip(self, other))
+
+    def union(self, other: "SnapshotFeatures") -> "SnapshotFeatures":
+        return SnapshotFeatures(*(a or b for a, b in zip(self, other)))
+
+
+ALL_FEATURES = SnapshotFeatures()
+
+
 class NodeState(NamedTuple):
     """Per-new-node-slot solver state (all leading dim N)."""
 
     used: jnp.ndarray  # f32[N, R] accumulated requests incl. daemon overhead
-    kmask: jnp.ndarray  # bool[N, K, V+1]
+    kmask: jnp.ndarray  # bool[N, K, V+1], or uint32[N, K, W] packed words
     kdef: jnp.ndarray  # bool[N, K]
     kneg: jnp.ndarray  # bool[N, K]
     kgt: jnp.ndarray  # f32[N, K]
@@ -185,7 +236,9 @@ def _key_compat_node_class(state: NodeState, cls, statics) -> jnp.ndarray:
     cls_t = mask_ops.ReqTensor(
         cls.mask[None], cls.defined[None], cls.negative[None], cls.gt[None], cls.lt[None]
     )
-    return mask_ops.compatible(node_t, cls_t, statics.is_custom, statics.vocab_ints)
+    return mask_ops.compatible(
+        node_t, cls_t, statics.is_custom, statics.vocab_ints, v=statics.mask_v
+    )
 
 
 def _merge_node_class(state: NodeState, cls, statics) -> mask_ops.ReqTensor:
@@ -193,29 +246,44 @@ def _merge_node_class(state: NodeState, cls, statics) -> mask_ops.ReqTensor:
     cls_t = mask_ops.ReqTensor(
         cls.mask[None], cls.defined[None], cls.negative[None], cls.gt[None], cls.lt[None]
     )
-    return mask_ops.add(node_t, cls_t, statics.valid, statics.vocab_ints)
+    return mask_ops.add(
+        node_t, cls_t, statics.valid, statics.vocab_ints,
+        v=statics.mask_v, key_has_bounds=statics.key_has_bounds,
+    )
 
 
 def _it_intersects(merged: mask_ops.ReqTensor, statics) -> jnp.ndarray:
     """bool[N, I]: InstanceType.Requirements.Intersects(nodeReqs) for every
-    (node, instance type) pair (node.go:143-145), with the mask-AND reduction
-    expressed as per-key [N,V]x[V,I] matmuls so it lands on the MXU."""
-    it = statics.it  # ReqTensor [I, K, V+1]
-    n_keys = it.mask.shape[-2]
+    (node, instance type) pair (node.go:143-145).  Packed masks reduce by a
+    word-wide AND + nonzero test per key (the hot path); the bool layout keeps
+    the per-key [N,V]x[V,I] matmul form so it lands on the MXU."""
+    it = statics.it  # ReqTensor [I, K, V+1] (or [I, K, W] packed words)
+    n_keys = it.defined.shape[-1]
+    packed = statics.packed
+    if packed:
+        vocab = jnp.asarray(mask_ops.vocab_words(statics.mask_v))
+        a_other_all = mask_ops.other_bit(merged.mask, statics.mask_v)  # [N, K]
+        b_other_all = mask_ops.other_bit(it.mask, statics.mask_v)  # [I, K]
     ok_all = None
     for k in range(n_keys):  # K is small and static: unrolled
-        a_mask = merged.mask[:, k, :]  # [N, V+1]
-        b_mask = it.mask[:, k, :]  # [I, V+1]
-        vocab_overlap = (
-            jnp.einsum(
-                "nv,iv->ni",
-                a_mask[:, :-1].astype(jnp.bfloat16),
-                b_mask[:, :-1].astype(jnp.bfloat16),
-                preferred_element_type=jnp.float32,
+        a_mask = merged.mask[:, k, :]  # [N, V+1] bools or [N, W] words
+        b_mask = it.mask[:, k, :]  # [I, V+1] bools or [I, W] words
+        if packed:
+            vocab_overlap = jnp.any(
+                (a_mask[:, None, :] & vocab & b_mask[None, :, :]) != 0, axis=-1
             )
-            > 0.5
-        )
-        both_other = a_mask[:, -1:] & b_mask[None, :, -1]
+            both_other = a_other_all[:, k, None] & b_other_all[None, :, k]
+        else:
+            vocab_overlap = (
+                jnp.einsum(
+                    "nv,iv->ni",
+                    a_mask[:, :-1].astype(jnp.bfloat16),
+                    b_mask[:, :-1].astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32,
+                )
+                > 0.5
+            )
+            both_other = a_mask[:, -1:] & b_mask[None, :, -1]
         if statics.key_has_bounds[k]:
             gt = jnp.maximum(merged.gt[:, k, None], it.gt[None, :, k])
             lt = jnp.minimum(merged.lt[:, k, None], it.lt[None, :, k])
@@ -303,6 +371,8 @@ class Statics(NamedTuple):
     grp_is_anti: jnp.ndarray  # bool[G1]
     grp_member: jnp.ndarray  # bool[C, G1]
     key_has_bounds: Tuple[bool, ...]  # python tuple -> static per-key branching
+    packed: bool = False  # mask planes are uint32 words (ops/masks.py pack_mask)
+    mask_v: int = 0  # semantic slot count V+1 (only meaningful when packed)
 
 
 class StaticArrays(NamedTuple):
@@ -380,6 +450,7 @@ def _prep_existing(
     tol_row: jnp.ndarray,
     vol_add_row: jnp.ndarray,
     vol_per_pod_row: jnp.ndarray,
+    ft: SnapshotFeatures = ALL_FEATURES,
 ) -> ExClassPrep:
     """How many pods of the class each existing node can still take — min over
     resource fit, CSI attach limits, host-port exclusivity, and hostname-group
@@ -390,8 +461,13 @@ def _prep_existing(
     cls_t = mask_ops.ReqTensor(
         cls.mask[None], cls.defined[None], cls.negative[None], cls.gt[None], cls.lt[None]
     )
-    key_ok = mask_ops.compatible(node_t, cls_t, statics.is_custom, statics.vocab_ints)
-    merged = mask_ops.add(node_t, cls_t, statics.valid, statics.vocab_ints)
+    key_ok = mask_ops.compatible(
+        node_t, cls_t, statics.is_custom, statics.vocab_ints, v=statics.mask_v
+    )
+    merged = mask_ops.add(
+        node_t, cls_t, statics.valid, statics.vocab_ints,
+        v=statics.mask_v, key_has_bounds=statics.key_has_bounds,
+    )
     zone_full = ex.zone & cls.zone[None, :]
     ct_ok = ex.ct & cls.ct[None, :]
 
@@ -409,28 +485,31 @@ def _prep_existing(
         cap = per if cap is None else jnp.minimum(cap, per)
     cap = jnp.minimum(cap, BIG).astype(jnp.int32)
 
-    # host ports: conflict blocks the node; identical pods conflict with each
-    # other, so a port-bearing class caps at one pod per node
-    # (hostportusage.go:31-56)
-    has_ports = jnp.any(cls.ports)
-    port_conflict = jnp.any(ex.ports & cls.ports[None, :], axis=-1)
-    # volume attach limits.  Shared-set classes add a fixed count on first
-    # placement (count-independent); per-pod classes add per assigned pod
-    # (disjoint claim sets), capping the node's intake like a resource
-    vol_free = ex_static.vol_limit - ex.vol_used - vol_add_row  # [E, D]
-    vol_ok = jnp.all(vol_free >= vol_per_pod_row[None, :], axis=-1)
-    cap_vol = jnp.min(
-        jnp.where(
-            vol_per_pod_row[None, :] > 0,
-            vol_free // jnp.maximum(vol_per_pod_row[None, :], 1),
-            UNLIMITED,
-        ),
-        axis=-1,
-    ).astype(jnp.int32)
-    cap = jnp.minimum(cap, jnp.maximum(cap_vol, 0))
     elig = ex.open_ & key_ok & tol_row & jnp.any(zone_full, axis=-1) & jnp.any(ct_ok, axis=-1)
-    elig = elig & ~port_conflict & vol_ok
-    cap = jnp.minimum(cap, jnp.where(has_ports, 1, UNLIMITED))
+    if ft.host_ports:
+        # host ports: conflict blocks the node; identical pods conflict with
+        # each other, so a port-bearing class caps at one pod per node
+        # (hostportusage.go:31-56)
+        has_ports = jnp.any(cls.ports)
+        port_conflict = jnp.any(ex.ports & cls.ports[None, :], axis=-1)
+        elig = elig & ~port_conflict
+        cap = jnp.minimum(cap, jnp.where(has_ports, 1, UNLIMITED))
+    if ft.volume_limits:
+        # volume attach limits.  Shared-set classes add a fixed count on first
+        # placement (count-independent); per-pod classes add per assigned pod
+        # (disjoint claim sets), capping the node's intake like a resource
+        vol_free = ex_static.vol_limit - ex.vol_used - vol_add_row  # [E, D]
+        vol_ok = jnp.all(vol_free >= vol_per_pod_row[None, :], axis=-1)
+        cap_vol = jnp.min(
+            jnp.where(
+                vol_per_pod_row[None, :] > 0,
+                vol_free // jnp.maximum(vol_per_pod_row[None, :], 1),
+                UNLIMITED,
+            ),
+            axis=-1,
+        ).astype(jnp.int32)
+        cap = jnp.minimum(cap, jnp.maximum(cap_vol, 0))
+        elig = elig & vol_ok
     cap = jnp.where(elig, jnp.minimum(cap, host_cap_vec), 0)
     return ExClassPrep(
         cap=cap, merged=merged, zone_full=zone_full, ct_ok=ct_ok,
@@ -446,6 +525,7 @@ def _phase_existing(
     zone_restrict: jnp.ndarray,
     extra_elig: Optional[jnp.ndarray] = None,
     single_node: bool = False,
+    ft: SnapshotFeatures = ALL_FEATURES,
 ) -> Tuple[ExistingState, jnp.ndarray, jnp.ndarray]:
     """Place up to ``quota`` pods of the class onto existing nodes, in index
     order (the reference iterates existing nodes first, in order, and takes the
@@ -482,12 +562,14 @@ def _phase_existing(
         klt=jnp.where(sel, merged.lt, ex.klt),
         zone=jnp.where(sel, zone_ok, ex.zone),
         ct=jnp.where(sel, prep.ct_ok, ex.ct),
-        ports=jnp.where(sel, ex.ports | cls.ports[None, :], ex.ports),
+        ports=jnp.where(sel, ex.ports | cls.ports[None, :], ex.ports)
+        if ft.host_ports else ex.ports,
         vol_used=jnp.where(
             sel,
             ex.vol_used + prep.vol_add + assigned[:, None] * prep.vol_per_pod[None, :],
             ex.vol_used,
-        ),
+        )
+        if ft.volume_limits else ex.vol_used,
         pod_count=ex.pod_count + assigned,
         open_=ex.open_,
     )
@@ -505,6 +587,7 @@ def _phase(
     remaining: jnp.ndarray,
     extra_elig: Optional[jnp.ndarray] = None,
     max_new_nodes: Optional[int] = None,
+    ft: SnapshotFeatures = ALL_FEATURES,
 ) -> Tuple[NodeState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Place up to ``quota`` pods of the class on nodes whose zone mask meets
     ``zone_restrict`` — first onto open nodes, then fresh nodes from the first
@@ -540,10 +623,11 @@ def _phase(
     )
     if extra_elig is not None:
         elig = elig & extra_elig
-    has_ports = jnp.any(cls.ports)
-    port_conflict = jnp.any(state.ports & cls.ports[None, :], axis=-1)
-    elig = elig & ~port_conflict
-    cap_n = jnp.minimum(cap_n, jnp.where(has_ports, 1, UNLIMITED))
+    if ft.host_ports:
+        has_ports = jnp.any(cls.ports)
+        port_conflict = jnp.any(state.ports & cls.ports[None, :], axis=-1)
+        elig = elig & ~port_conflict
+        cap_n = jnp.minimum(cap_n, jnp.where(has_ports, 1, UNLIMITED))
     cap_n = jnp.where(elig, jnp.minimum(cap_n, host_cap_vec), 0)
     if max_new_nodes is not None and max_new_nodes == 1:
         # hostname self-affinity bootstrap: at most one node hosts the class
@@ -573,7 +657,10 @@ def _phase(
     new_zone = jnp.where(sel, zone_ok, state.zone)
     new_ct = jnp.where(sel, ct_ok, state.ct)
     viable = jnp.where(sel, it_ok & (cap_ni >= assigned[:, None]), state.viable)
-    ports_plane = jnp.where(sel, state.ports | cls.ports[None, :], state.ports)
+    if ft.host_ports:
+        ports_plane = jnp.where(sel, state.ports | cls.ports[None, :], state.ports)
+    else:
+        ports_plane = state.ports
     pod_count = state.pod_count + assigned
 
     # -- open fresh nodes ----------------------------------------------------
@@ -585,8 +672,13 @@ def _phase(
     cls_t = mask_ops.ReqTensor(
         cls.mask[None], cls.defined[None], cls.negative[None], cls.gt[None], cls.lt[None]
     )
-    tmpl_key_ok = mask_ops.compatible(tmpl_t, cls_t, statics.is_custom, statics.vocab_ints)
-    tmpl_merged = mask_ops.add(tmpl_t, cls_t, statics.valid, statics.vocab_ints)
+    tmpl_key_ok = mask_ops.compatible(
+        tmpl_t, cls_t, statics.is_custom, statics.vocab_ints, v=statics.mask_v
+    )
+    tmpl_merged = mask_ops.add(
+        tmpl_t, cls_t, statics.valid, statics.vocab_ints,
+        v=statics.mask_v, key_has_bounds=statics.key_has_bounds,
+    )
     t_zone = statics.tmpl_zone & zone_restrict[None, :] & cls.zone[None, :]  # [T, Z]
     t_ct = statics.tmpl_ct & cls.ct[None, :]
     # provisioner limits: drop instance types whose launch would breach the
@@ -615,7 +707,8 @@ def _phase(
     t_ok = t_viable[t_star]
 
     per_node = jnp.minimum(t_cap[t_star], fresh_host_cap)
-    per_node = jnp.minimum(per_node, jnp.where(has_ports, 1, UNLIMITED))
+    if ft.host_ports:
+        per_node = jnp.minimum(per_node, jnp.where(has_ports, 1, UNLIMITED))
     per_node = jnp.maximum(per_node, 1)
     n_new = jnp.where(t_ok & (rem > 0), -(-rem // per_node), 0)
     free_slots = n_slots - state.n_next
@@ -661,9 +754,10 @@ def _phase(
     new_ct = jnp.where(seln, t_ct[t_star][None, :], new_ct)
     fresh_viable = t_it_ok[t_star][None, :] & (t_cap_ti[t_star][None, :] >= a_new[:, None])
     viable = jnp.where(seln, fresh_viable, viable)
-    ports_plane = jnp.where(
-        seln, (a_new > 0)[:, None] & cls.ports[None, :], ports_plane
-    )
+    if ft.host_ports:
+        ports_plane = jnp.where(
+            seln, (a_new > 0)[:, None] & cls.ports[None, :], ports_plane
+        )
     pod_count = jnp.where(is_new, a_new, pod_count)
     tmpl_id = jnp.where(is_new, t_star, state.tmpl_id)
     open_ = state.open_ | is_new
@@ -680,19 +774,38 @@ def _phase(
     return new_state, assigned + a_new, placed_existing + placed_new, remaining
 
 
+def _and_opt(a: Optional[jnp.ndarray], b: Optional[jnp.ndarray]):
+    """AND of two optional eligibility masks (None = unrestricted)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
 def _class_step(
     statics: Statics,
     ex_static: ExistingStatic,
     n_zones: int,
     carry,
     cls_with_index,
-    emit_zonal_anti: bool = True,
+    features: SnapshotFeatures = ALL_FEATURES,
+    fuse_zones: bool = True,
 ):
     """One scan step: schedule every pod of one class — existing nodes first,
     then new nodes, per phase.  Topology lives in shared group counts (the
     reference's hash-deduped TopologyGroups): forward counts gate spread skew /
     affinity targets / anti owners; inverse counts gate the pods anti owners
-    repel."""
+    repel.
+
+    ``features`` (static) prunes whole phase families the snapshot provably
+    cannot exercise — they are never traced, not just runtime-skipped.
+    ``fuse_zones`` (static) replaces the Z sequential zone-committal
+    ``run_phase`` sweeps (zone spread, required zonal anti) with one batched
+    multi-zone block (``committal_block``) that shares a single dense prep and
+    resolves shared-node conflicts by zone order with cumulative caps; the
+    sequential path is kept for parity fuzzing."""
+    ft = features
     state, ex, topo, remaining = carry
     cls, cls_index = cls_with_index
     m = cls.count
@@ -720,77 +833,102 @@ def _class_step(
     # rule of topology.go:231-276; anti groups count every zone a resident
     # node could still be in (pessimistic).  Reading the CURRENT masks — not
     # record-time snapshots — replays the host's retroactive narrowing.
-    ex_zone_i = ex.zone.astype(jnp.int32) * ex.open_.astype(jnp.int32)[:, None]
-    new_zone_i = state.zone.astype(jnp.int32) * state.open_.astype(jnp.int32)[:, None]
-    ex_sing_zone = jnp.where(
-        jnp.sum(ex_zone_i, axis=-1, keepdims=True) == 1, ex_zone_i, 0
-    )
-    new_sing_zone = jnp.where(
-        jnp.sum(new_zone_i, axis=-1, keepdims=True) == 1, new_zone_i, 0
-    )
-    zone_fwd_sing = jnp.einsum("ge,ez->gz", topo.fwd_ex, ex_sing_zone) + jnp.einsum(
-        "gn,nz->gz", topo.fwd_new, new_sing_zone
-    )  # [G1, Z]
-    zone_fwd_full = jnp.einsum("ge,ez->gz", topo.fwd_ex, ex_zone_i) + jnp.einsum(
-        "gn,nz->gz", topo.fwd_new, new_zone_i
-    )
-    zone_inv_full = jnp.einsum("ge,ez->gz", topo.inv_ex, ex_zone_i) + jnp.einsum(
-        "gn,nz->gz", topo.inv_new, new_zone_i
-    )
-    zone_fwd = jnp.where(statics.grp_is_anti[:, None], zone_fwd_full, zone_fwd_sing)
+    any_zone_groups = ft.zone_spread or ft.zone_affinity or ft.zone_anti
+    if any_zone_groups or ft.inv_zone_anti:
+        ex_zone_i = ex.zone.astype(jnp.int32) * ex.open_.astype(jnp.int32)[:, None]
+        new_zone_i = state.zone.astype(jnp.int32) * state.open_.astype(jnp.int32)[:, None]
+    zone_fwd = None
+    if any_zone_groups:
+        ex_sing_zone = jnp.where(
+            jnp.sum(ex_zone_i, axis=-1, keepdims=True) == 1, ex_zone_i, 0
+        )
+        new_sing_zone = jnp.where(
+            jnp.sum(new_zone_i, axis=-1, keepdims=True) == 1, new_zone_i, 0
+        )
+        zone_fwd_sing = jnp.einsum("ge,ez->gz", topo.fwd_ex, ex_sing_zone) + jnp.einsum(
+            "gn,nz->gz", topo.fwd_new, new_sing_zone
+        )  # [G1, Z]
+        if ft.zone_anti:
+            zone_fwd_full = jnp.einsum("ge,ez->gz", topo.fwd_ex, ex_zone_i) + jnp.einsum(
+                "gn,nz->gz", topo.fwd_new, new_zone_i
+            )
+            zone_fwd = jnp.where(
+                statics.grp_is_anti[:, None], zone_fwd_full, zone_fwd_sing
+            )
+        else:
+            zone_fwd = zone_fwd_sing
 
     # -- inverse anti-affinity blocks (topology.go:44-47): members of anti
     # groups avoid every domain the group's owners could occupy
-    mem_anti_zone = member_row & statics.grp_is_anti & statics.grp_is_zone
-    blocked_z = jnp.any(mem_anti_zone[:, None] & (zone_inv_full > 0), axis=0)  # [Z]
-    allowed_zone = cls.zone & ~blocked_z
-    mem_anti_host = member_row & statics.grp_is_anti & ~statics.grp_is_zone
-    ok_ex = ~jnp.any(mem_anti_host[:, None] & (topo.inv_ex > 0), axis=0)  # [E]
-    ok_new = ~jnp.any(mem_anti_host[:, None] & (topo.inv_new > 0), axis=0)  # [N]
+    if ft.inv_zone_anti:
+        zone_inv_full = jnp.einsum("ge,ez->gz", topo.inv_ex, ex_zone_i) + jnp.einsum(
+            "gn,nz->gz", topo.inv_new, new_zone_i
+        )
+        mem_anti_zone = member_row & statics.grp_is_anti & statics.grp_is_zone
+        blocked_z = jnp.any(mem_anti_zone[:, None] & (zone_inv_full > 0), axis=0)  # [Z]
+        allowed_zone = cls.zone & ~blocked_z
+    else:
+        allowed_zone = cls.zone
+    if ft.inv_host_anti:
+        mem_anti_host = member_row & statics.grp_is_anti & ~statics.grp_is_zone
+        ok_ex = ~jnp.any(mem_anti_host[:, None] & (topo.inv_ex > 0), axis=0)  # [E]
+        ok_new = ~jnp.any(mem_anti_host[:, None] & (topo.inv_new > 0), axis=0)  # [N]
+    else:
+        ok_ex = None
+        ok_new = None
 
     # -- per-node caps from hostname groups -----------------------------------
     # spread (topologygroup.go:184-188: hostname min-count is 0, so cap=skew):
     # members consume cap; non-members only need count <= skew
-    skew_hs = statics.grp_skew[g_hs]
-    member_hs = member_row[g_hs]
-    hs_fwd_ex = topo.fwd_ex[g_hs]
-    hs_fwd_new = topo.fwd_new[g_hs]
-    cap_hs_ex = jnp.where(
-        member_hs,
-        jnp.maximum(skew_hs - hs_fwd_ex, 0),
-        jnp.where(hs_fwd_ex <= skew_hs, UNLIMITED, 0),
-    )
-    cap_hs_new = jnp.where(
-        member_hs,
-        jnp.maximum(skew_hs - hs_fwd_new, 0),
-        jnp.where(hs_fwd_new <= skew_hs, UNLIMITED, 0),
-    )
-    # owned hostname anti-affinity: only zero-count nodes; self-members cap 1
-    han_fwd_ex = topo.fwd_ex[g_han]
-    han_fwd_new = topo.fwd_new[g_han]
-    member_han = member_row[g_han]
-    cap_han_ex = jnp.where(
-        g_han < g_dummy,
-        jnp.where(han_fwd_ex == 0, jnp.where(member_han, 1, UNLIMITED), 0),
-        UNLIMITED,
-    )
-    cap_han_new = jnp.where(
-        g_han < g_dummy,
-        jnp.where(han_fwd_new == 0, jnp.where(member_han, 1, UNLIMITED), 0),
-        UNLIMITED,
-    )
-    host_cap_ex = jnp.minimum(cap_hs_ex, cap_han_ex).astype(jnp.int32)
-    host_cap_new = jnp.minimum(cap_hs_new, cap_han_new).astype(jnp.int32)
-    fresh_host_cap = jnp.minimum(
-        jnp.where(member_hs, skew_hs, UNLIMITED),
-        jnp.where((g_han < g_dummy) & member_han, 1, UNLIMITED),
-    ).astype(jnp.int32)
+    cap_parts_ex = []
+    cap_parts_new = []
+    fresh_parts = []
+    if ft.host_spread:
+        skew_hs = statics.grp_skew[g_hs]
+        member_hs = member_row[g_hs]
+        hs_fwd_ex = topo.fwd_ex[g_hs]
+        hs_fwd_new = topo.fwd_new[g_hs]
+        cap_parts_ex.append(jnp.where(
+            member_hs,
+            jnp.maximum(skew_hs - hs_fwd_ex, 0),
+            jnp.where(hs_fwd_ex <= skew_hs, UNLIMITED, 0),
+        ))
+        cap_parts_new.append(jnp.where(
+            member_hs,
+            jnp.maximum(skew_hs - hs_fwd_new, 0),
+            jnp.where(hs_fwd_new <= skew_hs, UNLIMITED, 0),
+        ))
+        fresh_parts.append(jnp.where(member_hs, skew_hs, UNLIMITED))
+    if ft.host_anti:
+        # owned hostname anti-affinity: only zero-count nodes; self-members cap 1
+        han_fwd_ex = topo.fwd_ex[g_han]
+        han_fwd_new = topo.fwd_new[g_han]
+        member_han = member_row[g_han]
+        cap_parts_ex.append(jnp.where(
+            g_han < g_dummy,
+            jnp.where(han_fwd_ex == 0, jnp.where(member_han, 1, UNLIMITED), 0),
+            UNLIMITED,
+        ))
+        cap_parts_new.append(jnp.where(
+            g_han < g_dummy,
+            jnp.where(han_fwd_new == 0, jnp.where(member_han, 1, UNLIMITED), 0),
+            UNLIMITED,
+        ))
+        fresh_parts.append(jnp.where((g_han < g_dummy) & member_han, 1, UNLIMITED))
+    if cap_parts_ex:
+        host_cap_ex = functools.reduce(jnp.minimum, cap_parts_ex).astype(jnp.int32)
+        host_cap_new = functools.reduce(jnp.minimum, cap_parts_new).astype(jnp.int32)
+        fresh_host_cap = functools.reduce(jnp.minimum, fresh_parts).astype(jnp.int32)
+    else:
+        host_cap_ex = jnp.full((n_ex,), UNLIMITED, dtype=jnp.int32)
+        host_cap_new = jnp.full((n_new_slots,), UNLIMITED, dtype=jnp.int32)
+        fresh_host_cap = jnp.int32(UNLIMITED)
 
     # step-wide existing-node intake/merge tensors (valid across this step's
     # phases — they touch disjoint node sets; see ExClassPrep)
     ex_prep = _prep_existing(
         ex, ex_static, cls, statics, host_cap_ex, tol_row,
-        vol_add_row, vol_per_pod_row,
+        vol_add_row, vol_per_pod_row, ft,
     )
 
     assigned_total = jnp.zeros_like(state.pod_count)
@@ -800,15 +938,16 @@ def _class_step(
     def run_phase(state, ex, remaining, quota, restrict, targets_ex=None,
                   targets_new=None, single_node=False, max_new_nodes=None):
         """Wrapped in lax.cond so zero-quota phases (most of them: each class
-        participates in 1-2 of the Z+4 phase kinds) cost nothing on device."""
+        participates in 1-2 of the surviving phase kinds) cost nothing on
+        device."""
 
         def do(operand):
             state_i, ex_i, rem_i = operand
-            extra_ex = ok_ex if targets_ex is None else (ok_ex & targets_ex)
-            extra_new = ok_new if targets_new is None else (ok_new & targets_new)
+            extra_ex = _and_opt(ok_ex, targets_ex)
+            extra_new = _and_opt(ok_new, targets_new)
             ex_o, a_ex, placed_ex = _phase_existing(
                 ex_i, ex_prep, cls, quota, restrict,
-                extra_elig=extra_ex, single_node=single_node,
+                extra_elig=extra_ex, single_node=single_node, ft=ft,
             )
             q_new = quota - placed_ex
             if single_node:
@@ -816,7 +955,7 @@ def _class_step(
             state_o, a_new, placed_new, rem_o = _phase(
                 state_i, cls, statics, q_new, restrict,
                 host_cap_new, fresh_host_cap, rem_i, extra_elig=extra_new,
-                max_new_nodes=max_new_nodes,
+                max_new_nodes=max_new_nodes, ft=ft,
             )
             return state_o, ex_o, a_new, a_ex, placed_ex + placed_new, rem_o
 
@@ -833,6 +972,286 @@ def _class_step(
 
         return jax.lax.cond(quota > 0, do, skip, (state, ex, remaining))
 
+    def committal_block(state, ex, remaining, quota_z, cap_total):
+        """All Z zone-committal phases of one family (zone spread quotas /
+        required zonal anti), fused into ONE dense sweep.
+
+        The sequential form runs Z full ``run_phase`` passes, each re-deriving
+        the merge/compat/intersect planes and re-writing the whole carry.
+        Those planes are IDENTICAL across the block: a node that takes pods in
+        zone z narrows its zone mask to {z} and thereby leaves every later
+        zone phase, so per-node capacity is consumed at most once and the
+        per-class mask merge is idempotent for everyone else.  The fusion
+        computes the dense prep once, derives all-Z capacity planes in batch,
+        and resolves shared-node conflicts by zone order with cumulative caps
+        inside a cheap lax.scan over zones ([N]/[E]-wide fills only); the one
+        state commit at the end writes each plane once instead of Z times.
+        ``cap_total`` bounds cumulative placement across zones (the required-
+        anti family places at most ``m`` pods, one per admissible zone).
+        Parity with the sequential path is fuzzed in
+        tests/test_kernel_fusion_parity.py."""
+
+        def do(operand):
+            state_i, ex_i, rem_i = operand
+            i32max = jnp.iinfo(jnp.int32).max
+            # ---- dense prep shared by every zone --------------------------
+            merged = _merge_node_class(state_i, cls, statics)
+            key_ok = _key_compat_node_class(state_i, cls, statics)
+            ct_ok = state_i.ct & cls.ct[None, :]
+            tol_ok = cls.tol[state_i.tmpl_id]
+            it_base = state_i.viable & cls.it[None, :] & _it_intersects(merged, statics)
+            cap_ni = _capacity(state_i.used, cls.requests, statics)
+            elig = state_i.open_ & key_ok & tol_ok & jnp.any(ct_ok, axis=-1)
+            if ok_new is not None:
+                elig = elig & ok_new
+            if ft.host_ports:
+                has_ports = jnp.any(cls.ports)
+                port_conflict = jnp.any(state_i.ports & cls.ports[None, :], axis=-1)
+                elig = elig & ~port_conflict
+            zone_has_new = state_i.zone & cls.zone[None, :]  # [N, Z]
+            cap_z_list = []
+            viable_z_list = []
+            for z in range(n_zones):
+                ov = (
+                    jnp.einsum(
+                        "nc,ic->ni",
+                        ct_ok.astype(jnp.bfloat16),
+                        statics.it_avail[:, z, :].astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32,
+                    )
+                    > 0.5
+                )
+                ok_z = it_base & ov
+                viable_z_list.append(ok_z)
+                cap_z = jnp.max(jnp.where(ok_z, cap_ni, 0), axis=-1)
+                if ft.host_ports:
+                    cap_z = jnp.minimum(cap_z, jnp.where(has_ports, 1, UNLIMITED))
+                cap_z = jnp.where(
+                    elig & zone_has_new[:, z], jnp.minimum(cap_z, host_cap_new), 0
+                )
+                cap_z_list.append(cap_z)
+            cap_open_z = jnp.stack(cap_z_list)  # [Z, N]
+            viable_nzi = jnp.stack(viable_z_list, axis=1)  # [N, Z, I]
+            priority = state_i.pod_count * n_new_slots + jnp.arange(
+                n_new_slots, dtype=jnp.int32
+            )
+            # existing-node side: step prep reused, LIVE zone mask at entry
+            ex_cap = ex_prep.cap if ok_ex is None else jnp.where(ok_ex, ex_prep.cap, 0)
+            zone_has_ex = ex_i.zone & cls.zone[None, :]  # [E, Z]
+            # template side: merge/compat/intersect are zone-independent
+            cls_t = mask_ops.ReqTensor(
+                cls.mask[None], cls.defined[None], cls.negative[None],
+                cls.gt[None], cls.lt[None],
+            )
+            tmpl_key_ok = mask_ops.compatible(
+                statics.tmpl, cls_t, statics.is_custom, statics.vocab_ints,
+                v=statics.mask_v,
+            )
+            tmpl_merged = mask_ops.add(
+                statics.tmpl, cls_t, statics.valid, statics.vocab_ints,
+                v=statics.mask_v, key_has_bounds=statics.key_has_bounds,
+            )
+            t_ct = statics.tmpl_ct & cls.ct[None, :]
+            t_ct_any = jnp.any(t_ct, axis=-1)
+            t_base = statics.tmpl_it & cls.it[None, :] & _it_intersects(tmpl_merged, statics)
+            t_cap_ti0 = _capacity(statics.tmpl_daemon, cls.requests, statics)
+            ovt_z = jnp.stack([
+                jnp.einsum(
+                    "tc,ic->ti",
+                    t_ct.astype(jnp.bfloat16),
+                    statics.it_avail[:, z, :].astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32,
+                )
+                > 0.5
+                for z in range(n_zones)
+            ])  # [Z, T, I]
+            t_zone_cls = statics.tmpl_zone & cls.zone[None, :]  # [T, Z]
+
+            def zone_body(zc, xs):
+                (taken_ex, a_ex_acc, zex, taken_new, a_open_acc, zopen,
+                 fresh_t, fresh_a, fresh_z, fresh_viable, n_next, rem, placed) = zc
+                z, quota, cap_open, ovt, zh_ex, tz = xs
+                q = jnp.clip(jnp.minimum(quota, cap_total - placed), 0, None)
+                # existing nodes first, in index order (scheduler.go:176-180)
+                cap_e = jnp.where(~taken_ex & zh_ex, ex_cap, 0)
+                pri_e = jnp.where(cap_e > 0, jnp.arange(n_ex, dtype=jnp.int32), i32max)
+                a_ex = _fill_by_priority(q, cap_e, pri_e)
+                placed_ex = jnp.sum(a_ex)
+                took_e = a_ex > 0
+                taken_ex = taken_ex | took_e
+                a_ex_acc = a_ex_acc + a_ex
+                zex = jnp.where(took_e, z, zex)
+                # then open slots, emptiest first
+                q2 = q - placed_ex
+                cap_n = jnp.where(~taken_new, cap_open, 0)
+                pri_n = jnp.where(cap_n > 0, priority, i32max)
+                a_op = _fill_by_priority(q2, cap_n, pri_n)
+                placed_op = jnp.sum(a_op)
+                took_n = a_op > 0
+                taken_new = taken_new | took_n
+                a_open_acc = a_open_acc + a_op
+                zopen = jnp.where(took_n, z, zopen)
+                # then fresh nodes from the first viable template for the zone
+                rem_pods = q2 - placed_op
+                within = jnp.all(
+                    statics.it_capacity[None, :, :] <= rem[:, None, :] + 1e-4, axis=-1
+                )
+                t_it_ok = t_base & ovt & within
+                t_cap_ti = jnp.where(t_it_ok, t_cap_ti0, 0)
+                t_cap = jnp.max(t_cap_ti, axis=-1)
+                t_viable = cls.tol & tmpl_key_ok & tz & t_ct_any & (t_cap > 0)
+                t_star = jnp.argmax(t_viable)
+                t_ok = t_viable[t_star]
+                per_node = jnp.minimum(t_cap[t_star], fresh_host_cap)
+                if ft.host_ports:
+                    per_node = jnp.minimum(per_node, jnp.where(has_ports, 1, UNLIMITED))
+                per_node = jnp.maximum(per_node, 1)
+                n_new = jnp.where(t_ok & (rem_pods > 0), -(-rem_pods // per_node), 0)
+                n_new = jnp.minimum(n_new, n_new_slots - n_next)
+                max_cap_star = jnp.max(
+                    jnp.where(t_it_ok[t_star][:, None], statics.it_capacity, 0.0), axis=0
+                )
+                rem_star = rem[t_star]
+                budget_per_r = jnp.where(
+                    jnp.isfinite(rem_star) & (max_cap_star > 0),
+                    jnp.floor((rem_star + 1e-4) / jnp.maximum(max_cap_star, 1e-9)),
+                    BIG,
+                )
+                budget_nodes = jnp.maximum(jnp.min(budget_per_r), 0.0).astype(jnp.int32)
+                n_new = jnp.minimum(n_new, budget_nodes)
+                slot_idx = jnp.arange(n_new_slots)
+                is_new = (slot_idx >= n_next) & (slot_idx < n_next + n_new)
+                a_fr = jnp.where(
+                    is_new,
+                    jnp.clip(rem_pods - (slot_idx - n_next) * per_node, 0, per_node),
+                    0,
+                )
+                fresh_t = jnp.where(is_new, t_star, fresh_t)
+                fresh_a = fresh_a + a_fr
+                fresh_z = jnp.where(is_new, z, fresh_z)
+                fv_row = t_it_ok[t_star][None, :] & (
+                    t_cap_ti[t_star][None, :] >= a_fr[:, None]
+                )
+                fresh_viable = jnp.where(is_new[:, None], fv_row, fresh_viable)
+                rem = rem.at[t_star].add(-n_new.astype(jnp.float32) * max_cap_star)
+                n_next = n_next + n_new
+                placed = placed + placed_ex + placed_op + jnp.sum(a_fr)
+                return (taken_ex, a_ex_acc, zex, taken_new, a_open_acc, zopen,
+                        fresh_t, fresh_a, fresh_z, fresh_viable, n_next, rem,
+                        placed), None
+
+            n_it = state_i.viable.shape[-1]
+            zc0 = (
+                jnp.zeros(n_ex, bool), jnp.zeros(n_ex, jnp.int32),
+                jnp.zeros(n_ex, jnp.int32),
+                jnp.zeros(n_new_slots, bool), jnp.zeros(n_new_slots, jnp.int32),
+                jnp.zeros(n_new_slots, jnp.int32),
+                jnp.full(n_new_slots, -1, jnp.int32), jnp.zeros(n_new_slots, jnp.int32),
+                jnp.zeros(n_new_slots, jnp.int32),
+                jnp.zeros((n_new_slots, n_it), bool),
+                state_i.n_next, rem_i, jnp.int32(0),
+            )
+            xs = (
+                jnp.arange(n_zones, dtype=jnp.int32), quota_z.astype(jnp.int32),
+                cap_open_z, ovt_z, zone_has_ex.T, t_zone_cls.T,
+            )
+            (taken_ex, a_ex, zex, taken_new, a_open, zopen, fresh_t, fresh_a,
+             fresh_z, fresh_viable, n_next, rem_o, placed), _ = jax.lax.scan(
+                zone_body, zc0, xs
+            )
+
+            # ---- one-shot commit (each node took pods in at most one zone) --
+            took_e = a_ex > 0
+            sel_e = took_e[:, None]
+            zhot_e = (jnp.arange(n_zones)[None, :] == zex[:, None]) & sel_e
+            mex = ex_prep.merged
+            ex_o = ExistingState(
+                used=ex_i.used + a_ex[:, None].astype(jnp.float32) * cls.requests[None, :],
+                kmask=jnp.where(sel_e[..., None], mex.mask, ex_i.kmask),
+                kdef=jnp.where(sel_e, mex.defined, ex_i.kdef),
+                kneg=jnp.where(sel_e, mex.negative, ex_i.kneg),
+                kgt=jnp.where(sel_e, mex.gt, ex_i.kgt),
+                klt=jnp.where(sel_e, mex.lt, ex_i.klt),
+                zone=jnp.where(sel_e, zhot_e, ex_i.zone),
+                ct=jnp.where(sel_e, ex_prep.ct_ok, ex_i.ct),
+                ports=jnp.where(sel_e, ex_i.ports | cls.ports[None, :], ex_i.ports)
+                if ft.host_ports else ex_i.ports,
+                vol_used=jnp.where(
+                    sel_e,
+                    ex_i.vol_used + ex_prep.vol_add
+                    + a_ex[:, None] * ex_prep.vol_per_pod[None, :],
+                    ex_i.vol_used,
+                )
+                if ft.volume_limits else ex_i.vol_used,
+                pod_count=ex_i.pod_count + a_ex,
+                open_=ex_i.open_,
+            )
+            took_o = a_open > 0
+            is_fresh = fresh_t >= 0
+            tmpl_idx = jnp.maximum(fresh_t, 0)
+            sel_o = took_o[:, None]
+            sel_f = is_fresh[:, None]
+            zhot_o = (jnp.arange(n_zones)[None, :] == zopen[:, None]) & sel_o
+            zhot_f = (jnp.arange(n_zones)[None, :] == fresh_z[:, None]) & sel_f
+            used = state_i.used + a_open[:, None].astype(jnp.float32) * cls.requests[None, :]
+            used = jnp.where(
+                sel_f,
+                statics.tmpl_daemon[tmpl_idx]
+                + fresh_a[:, None].astype(jnp.float32) * cls.requests[None, :],
+                used,
+            )
+            kmask = jnp.where(sel_o[..., None], merged.mask, state_i.kmask)
+            kmask = jnp.where(sel_f[..., None], tmpl_merged.mask[tmpl_idx], kmask)
+            kdef = jnp.where(sel_o, merged.defined, state_i.kdef)
+            kdef = jnp.where(sel_f, tmpl_merged.defined[tmpl_idx], kdef)
+            kneg = jnp.where(sel_o, merged.negative, state_i.kneg)
+            kneg = jnp.where(sel_f, tmpl_merged.negative[tmpl_idx], kneg)
+            kgt = jnp.where(sel_o, merged.gt, state_i.kgt)
+            kgt = jnp.where(sel_f, tmpl_merged.gt[tmpl_idx], kgt)
+            klt = jnp.where(sel_o, merged.lt, state_i.klt)
+            klt = jnp.where(sel_f, tmpl_merged.lt[tmpl_idx], klt)
+            zone = jnp.where(sel_o, zhot_o, state_i.zone)
+            zone = jnp.where(sel_f, zhot_f, zone)
+            ct = jnp.where(sel_o, ct_ok, state_i.ct)
+            ct = jnp.where(sel_f, t_ct[tmpl_idx], ct)
+            v_open = jnp.take_along_axis(
+                viable_nzi, jnp.maximum(zopen, 0)[:, None, None], axis=1
+            )[:, 0, :]
+            viable = jnp.where(
+                sel_o, v_open & (cap_ni >= a_open[:, None]), state_i.viable
+            )
+            viable = jnp.where(sel_f, fresh_viable, viable)
+            if ft.host_ports:
+                ports_pl = jnp.where(
+                    sel_o, state_i.ports | cls.ports[None, :], state_i.ports
+                )
+                ports_pl = jnp.where(
+                    sel_f, (fresh_a > 0)[:, None] & cls.ports[None, :], ports_pl
+                )
+            else:
+                ports_pl = state_i.ports
+            pod_count = state_i.pod_count + a_open
+            pod_count = jnp.where(is_fresh, fresh_a, pod_count)
+            tmpl_id = jnp.where(is_fresh, tmpl_idx, state_i.tmpl_id)
+            state_o = NodeState(
+                used, kmask, kdef, kneg, kgt, klt, zone, ct, viable, ports_pl,
+                pod_count, tmpl_id, state_i.open_ | is_fresh, n_next,
+            )
+            return state_o, ex_o, a_open + fresh_a, a_ex, placed, rem_o
+
+        def skip(operand):
+            state_i, ex_i, rem_i = operand
+            return (
+                state_i,
+                ex_i,
+                jnp.zeros_like(state_i.pod_count),
+                jnp.zeros_like(ex_i.pod_count),
+                jnp.int32(0),
+                rem_i,
+            )
+
+        return jax.lax.cond(jnp.sum(quota_z) > 0, do, skip, (state, ex, remaining))
+
     def accumulate(results):
         nonlocal state, ex, remaining, assigned_total, assigned_ex_total, placed_total
         state, ex, assigned, assigned_ex, placed, remaining = results
@@ -840,104 +1259,119 @@ def _class_step(
         assigned_ex_total = assigned_ex_total + assigned_ex
         placed_total = placed_total + placed
 
-    # -- zone spread phases (one committed zone per phase) --------------------
     # zones some template can actually serve for this class (or an eligible
     # existing node with intake left sits in) — used by spread quotas and the
     # affinity bootstrap below
-    tmpl_offers = jnp.einsum(
-        "ti,izc,tz,tc->z",
-        statics.tmpl_it.astype(jnp.bfloat16),
-        (statics.it_avail & cls.it[:, None, None]).astype(jnp.bfloat16),
-        statics.tmpl_zone.astype(jnp.bfloat16),
-        (statics.tmpl_ct & cls.ct[None, :]).astype(jnp.bfloat16),
-        preferred_element_type=jnp.float32,
-    ) > 0.5  # [Z]
-    counts_zs = zone_fwd[g_zs]  # [Z]
-    member_zs = member_row[g_zs]
-    # per-zone intake for this class: existing nodes contribute their
-    # remaining intake; template zones open new nodes on demand (unbounded).
-    # A multi-zone (unknown-zone) node's intake deliberately counts into EVERY
-    # zone of its mask: the estimate must be optimistic, because an over-grant
-    # surfaces as a phase shortfall (the spread_suspect sentinel below routes
-    # it to the host oracle), whereas pinning the intake to one zone would
-    # under-estimate the others and under-place with no detectable signal —
-    # the host can commit such a node to whichever zone the fill needs.
-    ex_cap_z = jnp.sum(
-        jnp.minimum(jnp.where(ok_ex, ex_prep.cap, 0), m)[:, None]
-        * ex_prep.zone_full.astype(jnp.int32),
-        axis=0,
-    )  # i32[Z]
-    fillable = tmpl_offers | (ex_cap_z > 0)
-    cap_pods_z = jnp.where(tmpl_offers, UNLIMITED, jnp.minimum(ex_cap_z, UNLIMITED))
+    if ft.zone_spread or ft.zone_affinity:
+        tmpl_offers = jnp.einsum(
+            "ti,izc,tz,tc->z",
+            statics.tmpl_it.astype(jnp.bfloat16),
+            (statics.it_avail & cls.it[:, None, None]).astype(jnp.bfloat16),
+            statics.tmpl_zone.astype(jnp.bfloat16),
+            (statics.tmpl_ct & cls.ct[None, :]).astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        ) > 0.5  # [Z]
+        ex_cap_spread = ex_prep.cap if ok_ex is None else jnp.where(ok_ex, ex_prep.cap, 0)
+        # per-zone intake for this class: existing nodes contribute their
+        # remaining intake; template zones open new nodes on demand (unbounded).
+        # A multi-zone (unknown-zone) node's intake deliberately counts into
+        # EVERY zone of its mask: the estimate must be optimistic, because an
+        # over-grant surfaces as a phase shortfall (the spread_suspect sentinel
+        # below routes it to the host oracle), whereas pinning the intake to
+        # one zone would under-estimate the others and under-place with no
+        # detectable signal — the host can commit such a node to whichever
+        # zone the fill needs.
+        ex_cap_z = jnp.sum(
+            jnp.minimum(ex_cap_spread, m)[:, None]
+            * ex_prep.zone_full.astype(jnp.int32),
+            axis=0,
+        )  # i32[Z]
+        fillable = tmpl_offers | (ex_cap_z > 0)
 
-    # the reference's per-pod skew check measures against the min over ALL the
-    # pod's domains, including zones that cannot take this class — their
-    # counts stay frozen, capping every fillable zone at frozen_min + maxSkew
-    # (topology_test.go:124-162 "existing pod" case).  A zone whose intake
-    # runs out MID-fill freezes the same way (nextDomainTopologySpread keeps
-    # measuring it, topologygroup.go:155-182), so the water-fill proceeds in
-    # rounds: each round fills min-first up to the nearest saturation level,
-    # then the saturated zone joins the frozen set and bounds the rest.
-    unreachable = allowed_zone & ~fillable
-    skew_zs = statics.grp_skew[g_zs]
-    BIGI = jnp.int32(1 << 30)
-    finite_cap = cap_pods_z < UNLIMITED
-    quotas = jnp.zeros(n_zones, dtype=jnp.int32)
-    sat = jnp.zeros(n_zones, dtype=bool)
-    m_rem = m
-    # worst case: one round per sequentially-saturating finite-cap zone, plus
-    # a final redistribution round for the unbounded zones
-    for _ in range(n_zones + 1):
-        counts_now = counts_zs + quotas
-        min_frozen = jnp.min(jnp.where(unreachable | sat, counts_now, BIGI))
-        skew_cap = jnp.clip(min_frozen + skew_zs - counts_now, 0, UNLIMITED)
-        active = allowed_zone & fillable & ~sat
-        cap_rem = jnp.clip(cap_pods_z - quotas, 0, UNLIMITED)
-        # level where the nearest capacity-bounded active zone saturates;
-        # fills stop there so its frozen count bounds the next round
-        lvl_sat = jnp.min(jnp.where(active & finite_cap, counts_now + cap_rem, BIGI))
-        q = _water_fill(counts_now, active, m_rem)
-        q = jnp.minimum(q, jnp.clip(lvl_sat - counts_now, 0, UNLIMITED))
-        q = jnp.minimum(q, jnp.minimum(skew_cap, cap_rem))
-        q = jnp.where(active, q, 0)
-        quotas = quotas + q
-        m_rem = m_rem - jnp.sum(q)
-        sat = sat | (active & finite_cap & (quotas >= cap_pods_z))
-    quotas = jnp.where(member_zs, quotas, 0)
-    # under-placement sentinel (host-oracle parity, topologygroup.go:155-182):
-    # the round bound can exhaust with quota still unallocated while some
-    # active zone retains both skew and capacity headroom — the shape ROADMAP
-    # gap 5 documented as silent.  Flag it; the shell re-routes the class's
-    # leftover pods through the host path instead of quietly failing them.
-    counts_end = counts_zs + quotas
-    min_frozen_end = jnp.min(jnp.where(unreachable | sat, counts_end, BIGI))
-    skew_headroom = (counts_end - min_frozen_end) < skew_zs
-    cap_headroom = (cap_pods_z - quotas) > 0
-    fill_residual = (m_rem > 0) & jnp.any(
-        allowed_zone & fillable & ~sat & skew_headroom & cap_headroom
-    )
-    placed_zs = jnp.int32(0)
-    for z in range(n_zones):
-        restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
-        q = jnp.where(has_zs, quotas[z], 0)
-        results_z = run_phase(state, ex, remaining, q, restrict)
-        placed_zs = placed_zs + results_z[4]
-        accumulate(results_z)
-    # quota granted but not realized in-phase: the water-fill's per-zone
-    # intake estimate (ex_cap_z) is optimistic — e.g. a multi-zone node's
-    # capacity counts into every zone of its mask — so a phase can place
-    # fewer pods than its quota with no later round to redistribute them
-    quota_shortfall = placed_zs < jnp.sum(quotas)
-    spread_suspect = has_zs & member_zs & (fill_residual | quota_shortfall)
+    # -- zone spread phases (one committed zone per phase) --------------------
+    spread_suspect = jnp.array(False)
+    if ft.zone_spread:
+        counts_zs = zone_fwd[g_zs]  # [Z]
+        member_zs = member_row[g_zs]
+        cap_pods_z = jnp.where(tmpl_offers, UNLIMITED, jnp.minimum(ex_cap_z, UNLIMITED))
 
-    # non-self-selecting zone spread: the pod never increments its own group's
-    # counts, so the skew formula (count + 0 - min <= maxSkew,
-    # topologygroup.go:155-182) yields a STATIC admissible-zone mask — one
-    # plain phase over it, no per-zone quotas or committal needed
-    min_zs = jnp.min(jnp.where(cls.zone, counts_zs, jnp.int32(1 << 30)))
-    admissible_zs = allowed_zone & (counts_zs - min_zs <= statics.grp_skew[g_zs])
-    q_nm = jnp.where(has_zs & ~member_zs & jnp.any(admissible_zs), m, 0)
-    accumulate(run_phase(state, ex, remaining, q_nm, admissible_zs))
+        # the reference's per-pod skew check measures against the min over ALL
+        # the pod's domains, including zones that cannot take this class —
+        # their counts stay frozen, capping every fillable zone at
+        # frozen_min + maxSkew (topology_test.go:124-162 "existing pod" case).
+        # A zone whose intake runs out MID-fill freezes the same way
+        # (nextDomainTopologySpread keeps measuring it,
+        # topologygroup.go:155-182), so the water-fill proceeds in rounds:
+        # each round fills min-first up to the nearest saturation level, then
+        # the saturated zone joins the frozen set and bounds the rest.
+        unreachable = allowed_zone & ~fillable
+        skew_zs = statics.grp_skew[g_zs]
+        BIGI = jnp.int32(1 << 30)
+        finite_cap = cap_pods_z < UNLIMITED
+        quotas = jnp.zeros(n_zones, dtype=jnp.int32)
+        sat = jnp.zeros(n_zones, dtype=bool)
+        m_rem = m
+        # worst case: one round per sequentially-saturating finite-cap zone,
+        # plus a final redistribution round for the unbounded zones
+        for _ in range(n_zones + 1):
+            counts_now = counts_zs + quotas
+            min_frozen = jnp.min(jnp.where(unreachable | sat, counts_now, BIGI))
+            skew_cap = jnp.clip(min_frozen + skew_zs - counts_now, 0, UNLIMITED)
+            active = allowed_zone & fillable & ~sat
+            cap_rem = jnp.clip(cap_pods_z - quotas, 0, UNLIMITED)
+            # level where the nearest capacity-bounded active zone saturates;
+            # fills stop there so its frozen count bounds the next round
+            lvl_sat = jnp.min(jnp.where(active & finite_cap, counts_now + cap_rem, BIGI))
+            q = _water_fill(counts_now, active, m_rem)
+            q = jnp.minimum(q, jnp.clip(lvl_sat - counts_now, 0, UNLIMITED))
+            q = jnp.minimum(q, jnp.minimum(skew_cap, cap_rem))
+            q = jnp.where(active, q, 0)
+            quotas = quotas + q
+            m_rem = m_rem - jnp.sum(q)
+            sat = sat | (active & finite_cap & (quotas >= cap_pods_z))
+        quotas = jnp.where(member_zs, quotas, 0)
+        # under-placement sentinel (host-oracle parity,
+        # topologygroup.go:155-182): the round bound can exhaust with quota
+        # still unallocated while some active zone retains both skew and
+        # capacity headroom — the shape ROADMAP gap 5 documented as silent.
+        # Flag it; the shell re-routes the class's leftover pods through the
+        # host path instead of quietly failing them.
+        counts_end = counts_zs + quotas
+        min_frozen_end = jnp.min(jnp.where(unreachable | sat, counts_end, BIGI))
+        skew_headroom = (counts_end - min_frozen_end) < skew_zs
+        cap_headroom = (cap_pods_z - quotas) > 0
+        fill_residual = (m_rem > 0) & jnp.any(
+            allowed_zone & fillable & ~sat & skew_headroom & cap_headroom
+        )
+        quotas_gated = jnp.where(has_zs, quotas, 0)
+        if fuse_zones:
+            results_zs = committal_block(
+                state, ex, remaining, quotas_gated, jnp.int32(UNLIMITED)
+            )
+            placed_zs = results_zs[4]
+            accumulate(results_zs)
+        else:
+            placed_zs = jnp.int32(0)
+            for z in range(n_zones):
+                restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
+                results_z = run_phase(state, ex, remaining, quotas_gated[z], restrict)
+                placed_zs = placed_zs + results_z[4]
+                accumulate(results_z)
+        # quota granted but not realized in-phase: the water-fill's per-zone
+        # intake estimate (ex_cap_z) is optimistic — e.g. a multi-zone node's
+        # capacity counts into every zone of its mask — so a phase can place
+        # fewer pods than its quota with no later round to redistribute them
+        quota_shortfall = placed_zs < jnp.sum(quotas)
+        spread_suspect = has_zs & member_zs & (fill_residual | quota_shortfall)
+
+        # non-self-selecting zone spread: the pod never increments its own
+        # group's counts, so the skew formula (count + 0 - min <= maxSkew,
+        # topologygroup.go:155-182) yields a STATIC admissible-zone mask — one
+        # plain phase over it, no per-zone quotas or committal needed
+        min_zs = jnp.min(jnp.where(cls.zone, counts_zs, jnp.int32(1 << 30)))
+        admissible_zs = allowed_zone & (counts_zs - min_zs <= statics.grp_skew[g_zs])
+        q_nm = jnp.where(has_zs & ~member_zs & jnp.any(admissible_zs), m, 0)
+        accumulate(run_phase(state, ex, remaining, q_nm, admissible_zs))
 
     # -- owned zone anti-affinity: zero-forward-count zones only --------------
     # self-members place one pod per currently-unpoisoned zone, each phase
@@ -954,74 +1388,86 @@ def _class_step(
     # diverge from its packing (topology_test.go:1478 — co-location allowed);
     # required anti commits because the reference CONVERGES to one-per-zone
     # over batches (pods stay pending until zones register)
-    zero_zones = allowed_zone & (zone_fwd[g_zan] == 0)
-    anti_member = member_row[g_zan]
-    anti_required = has_zan & anti_member & ~cls.anti_soft[0]
-    placed_anti = jnp.int32(0)
-    # the committal phases are only reachable for required-anti members; when
-    # the snapshot statically has none (emit_zonal_anti=False, from
-    # encode_snapshot's has_required_zonal_anti), every quota below is zero
-    # and the n_zones phases are skipped at trace time — they are the single
-    # largest per-class phase block, all compile time + per-step cost
-    for z in range(n_zones if emit_zonal_anti else 0):
-        restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
-        q = jnp.where(
-            anti_required & zero_zones[z] & (placed_anti < m),
-            jnp.int32(1),
-            jnp.int32(0),
+    if ft.zone_anti:
+        zero_zones = allowed_zone & (zone_fwd[g_zan] == 0)
+        anti_member = member_row[g_zan]
+        anti_required = has_zan & anti_member & ~cls.anti_soft[0]
+        # the committal phases are only reachable for required-anti members;
+        # when the snapshot statically has none (features.required_zone_anti
+        # False, from encode_snapshot), they are never traced — formerly the
+        # single largest per-class phase block, all compile + per-step cost
+        if ft.required_zone_anti:
+            anti_quota_z = (anti_required & zero_zones).astype(jnp.int32)
+            if fuse_zones:
+                accumulate(committal_block(state, ex, remaining, anti_quota_z, m))
+            else:
+                placed_anti = jnp.int32(0)
+                for z in range(n_zones):
+                    restrict = jnp.zeros(n_zones, dtype=bool).at[z].set(True)
+                    q = jnp.where(
+                        anti_required & zero_zones[z] & (placed_anti < m),
+                        jnp.int32(1),
+                        jnp.int32(0),
+                    )
+                    results_a = run_phase(state, ex, remaining, q, restrict)
+                    placed_anti = placed_anti + results_a[4]
+                    accumulate(results_a)
+        anti_quota = jnp.where(
+            has_zan & jnp.any(zero_zones),
+            jnp.where(
+                anti_member,
+                jnp.where(cls.anti_soft[0], jnp.minimum(m, 1), 0),
+                m,
+            ),
+            0,
         )
-        results_a = run_phase(state, ex, remaining, q, restrict)
-        placed_anti = placed_anti + results_a[4]
-        accumulate(results_a)
-    anti_quota = jnp.where(
-        has_zan & jnp.any(zero_zones),
-        jnp.where(
-            anti_member,
-            jnp.where(cls.anti_soft[0], jnp.minimum(m, 1), 0),
-            m,
-        ),
-        0,
-    )
-    accumulate(run_phase(state, ex, remaining, anti_quota, zero_zones))
+        accumulate(run_phase(state, ex, remaining, anti_quota, zero_zones))
 
     # -- zone affinity: nonzero-count zones (the selected pods' locations),
     # else self-members bootstrap one allowed zone (topologygroup.go:202-233).
     # The bootstrap must be capacity-aware (the host's per-node bootstrap only
     # lands where a node is viable): restrict to zones some template offers
     # for this class, or where an open existing node sits
-    bootstrap_allowed = allowed_zone & fillable
-    nonzero_zones = allowed_zone & (zone_fwd[g_zaf] > 0)
-    bootstrap_zone = (
-        jnp.zeros(n_zones, dtype=bool)
-        .at[jnp.argmax(bootstrap_allowed)]
-        .set(jnp.any(bootstrap_allowed) & member_row[g_zaf])
-    )
-    zone_aff_restrict = jnp.where(jnp.any(nonzero_zones), nonzero_zones, bootstrap_zone)
-    zone_aff_quota = jnp.where(has_zaf & ~has_haf & jnp.any(zone_aff_restrict), m, 0)
-    accumulate(run_phase(state, ex, remaining, zone_aff_quota, zone_aff_restrict))
+    if ft.zone_affinity:
+        bootstrap_allowed = allowed_zone & fillable
+        nonzero_zones = allowed_zone & (zone_fwd[g_zaf] > 0)
+        bootstrap_zone = (
+            jnp.zeros(n_zones, dtype=bool)
+            .at[jnp.argmax(bootstrap_allowed)]
+            .set(jnp.any(bootstrap_allowed) & member_row[g_zaf])
+        )
+        zone_aff_restrict = jnp.where(
+            jnp.any(nonzero_zones), nonzero_zones, bootstrap_zone
+        )
+        zone_aff_quota = jnp.where(has_zaf & ~has_haf & jnp.any(zone_aff_restrict), m, 0)
+        accumulate(run_phase(state, ex, remaining, zone_aff_quota, zone_aff_restrict))
 
     # -- hostname affinity: fill target nodes (forward count > 0) on both
     # planes; else self-members bootstrap exactly one node
     all_zones = jnp.ones(n_zones, dtype=bool)
-    host_restrict = jnp.where(has_zaf, zone_aff_restrict, all_zones) & allowed_zone
-    targets_ex = (topo.fwd_ex[g_haf] > 0) & ex.open_
-    targets_new = (topo.fwd_new[g_haf] > 0) & state.open_
-    targets_exist = jnp.any(targets_ex) | jnp.any(targets_new)
-    host_quota = jnp.where(has_haf, m, 0)
-    q_targets = jnp.where(targets_exist, host_quota, 0)
-    accumulate(
-        run_phase(
-            state, ex, remaining, q_targets, host_restrict,
-            targets_ex=targets_ex, targets_new=targets_new, max_new_nodes=0,
+    if ft.host_affinity:
+        if ft.zone_affinity:
+            host_restrict = jnp.where(has_zaf, zone_aff_restrict, all_zones) & allowed_zone
+        else:
+            host_restrict = all_zones & allowed_zone
+        targets_ex = (topo.fwd_ex[g_haf] > 0) & ex.open_
+        targets_new = (topo.fwd_new[g_haf] > 0) & state.open_
+        targets_exist = jnp.any(targets_ex) | jnp.any(targets_new)
+        host_quota = jnp.where(has_haf, m, 0)
+        q_targets = jnp.where(targets_exist, host_quota, 0)
+        accumulate(
+            run_phase(
+                state, ex, remaining, q_targets, host_restrict,
+                targets_ex=targets_ex, targets_new=targets_new, max_new_nodes=0,
+            )
         )
-    )
-    q_boot = jnp.where(targets_exist | ~member_row[g_haf], 0, host_quota)
-    accumulate(
-        run_phase(
-            state, ex, remaining, q_boot, host_restrict,
-            single_node=True, max_new_nodes=1,
+        q_boot = jnp.where(targets_exist | ~member_row[g_haf], 0, host_quota)
+        accumulate(
+            run_phase(
+                state, ex, remaining, q_boot, host_restrict,
+                single_node=True, max_new_nodes=1,
+            )
         )
-    )
 
     # -- unconstrained phase for plain classes --------------------------------
     any_quota = jnp.where(has_zs | has_zan | has_zaf | has_haf, 0, m)
@@ -1030,21 +1476,25 @@ def _class_step(
     # -- record (topology.go:120-143): update shared PER-NODE counts ----------
     # zone projections happen at read time from live masks (derivation above),
     # so recording is pure bookkeeping: each placed pod adds its class's
-    # membership/ownership to its node's row in every relevant group
-    a_ex_f = assigned_ex_total.astype(jnp.int32)
-    a_new_f = assigned_total.astype(jnp.int32)
-    member_i = member_row.astype(jnp.int32)
-    # preferred-anti owners register no inverse counts (the reference skips
-    # inverse tracking for preferences, topology.go:203-206)
-    own_zan_inv = jnp.where(cls.anti_soft[0], 0, own_onehot(g_zan).astype(jnp.int32))
-    own_han_inv = jnp.where(cls.anti_soft[1], 0, own_onehot(g_han).astype(jnp.int32))
-    own_inv = own_zan_inv + own_han_inv
-    topo = TopoCounts(
-        fwd_ex=topo.fwd_ex + member_i[:, None] * a_ex_f[None, :],
-        inv_ex=topo.inv_ex + own_inv[:, None] * a_ex_f[None, :],
-        fwd_new=topo.fwd_new + member_i[:, None] * a_new_f[None, :],
-        inv_new=topo.inv_new + own_inv[:, None] * a_new_f[None, :],
-    )
+    # membership/ownership to its node's row in every relevant group.
+    # No class can own or match a group when no feature family exists, so the
+    # whole record step prunes away with them.
+    if (ft.zone_spread or ft.host_spread or ft.zone_affinity or ft.host_affinity
+            or ft.zone_anti or ft.host_anti or ft.inv_zone_anti or ft.inv_host_anti):
+        a_ex_f = assigned_ex_total.astype(jnp.int32)
+        a_new_f = assigned_total.astype(jnp.int32)
+        member_i = member_row.astype(jnp.int32)
+        # preferred-anti owners register no inverse counts (the reference skips
+        # inverse tracking for preferences, topology.go:203-206)
+        own_zan_inv = jnp.where(cls.anti_soft[0], 0, own_onehot(g_zan).astype(jnp.int32))
+        own_han_inv = jnp.where(cls.anti_soft[1], 0, own_onehot(g_han).astype(jnp.int32))
+        own_inv = own_zan_inv + own_han_inv
+        topo = TopoCounts(
+            fwd_ex=topo.fwd_ex + member_i[:, None] * a_ex_f[None, :],
+            inv_ex=topo.inv_ex + own_inv[:, None] * a_ex_f[None, :],
+            fwd_new=topo.fwd_new + member_i[:, None] * a_new_f[None, :],
+            inv_new=topo.inv_new + own_inv[:, None] * a_new_f[None, :],
+        )
 
     failed = m - placed_total
     return (
@@ -1061,7 +1511,10 @@ def solve_core(
     existing_state: "Optional[ExistingState]" = None,
     existing_static: "Optional[ExistingStatic]" = None,
     n_passes: int = 1,
-    emit_zonal_anti: bool = True,
+    emit_zonal_anti: "Optional[bool]" = None,
+    features: "Optional[SnapshotFeatures]" = None,
+    fuse_zones: bool = True,
+    packed_masks: bool = True,
 ):
     """Unjitted kernel core — jit/vmap/shard_map-composable (the parallel layer
     vmaps this over snapshot replicas and consolidation subsets;
@@ -1073,21 +1526,54 @@ def solve_core(
     cross-group affinity follower scans before its target
     (models.snapshot.affinity_scan_passes).
 
-    ``emit_zonal_anti`` (static) gates the owned zonal-anti committal phases;
-    pass EncodedSnapshot.has_required_zonal_anti so snapshots with no
-    required zonal-anti class skip tracing n_zones dead phases per class."""
-    statics = Statics(*statics_arrays, key_has_bounds=key_has_bounds)
+    ``features`` (static) is the snapshot's SnapshotFeatures phase plan —
+    pass EncodedSnapshot.features so constraint families no class can
+    exercise are never traced (docs/KERNEL_PERF.md).  ``emit_zonal_anti`` is
+    the legacy single-flag form (pre-features callers); it maps onto
+    features.required_zone_anti.  ``fuse_zones`` (static) selects the batched
+    multi-zone committal block over the sequential per-zone phases;
+    ``packed_masks`` (static) stores requirement masks as uint32 words and
+    runs the mask algebra as bitwise AND + popcount (ops/masks.py) instead of
+    bf16 einsums.  Both default on; the alternates are kept for parity
+    fuzzing."""
+    if features is None:
+        ft = ALL_FEATURES
+        if emit_zonal_anti is not None:
+            ft = ft._replace(required_zone_anti=bool(emit_zonal_anti))
+    else:
+        ft = SnapshotFeatures(*features)
+    ft = ft.canonical()
+    sa = StaticArrays(*statics_arrays)
+    width = sa.valid.shape[-1]  # semantic slot count V+1, pre-packing
+    if packed_masks:
+        sa = sa._replace(
+            it=mask_ops.pack_req(sa.it),
+            tmpl=mask_ops.pack_req(sa.tmpl),
+            valid=mask_ops.pack_mask(sa.valid),
+        )
+        class_tensors = class_tensors._replace(
+            mask=mask_ops.pack_mask(class_tensors.mask)
+        )
+    statics = Statics(
+        *sa, key_has_bounds=key_has_bounds, packed=packed_masks, mask_v=width
+    )
     n_zones = statics.tmpl_zone.shape[-1]
     n_res = statics.it_alloc.shape[-1]
-    n_keys = statics.valid.shape[0]
-    width = statics.valid.shape[1]
+    n_keys = sa.it.defined.shape[-1]
     n_it = statics.it_alloc.shape[0]
     n_ct = statics.tmpl_ct.shape[-1]
     n_classes = class_tensors.count.shape[0]
 
+    if packed_masks:
+        kmask0 = jnp.broadcast_to(
+            jnp.asarray(mask_ops.full_words(width)),
+            (n_slots, n_keys, mask_ops.words_for(width)),
+        )
+    else:
+        kmask0 = jnp.ones((n_slots, n_keys, width), dtype=bool)
     state = NodeState(
         used=jnp.zeros((n_slots, n_res), dtype=jnp.float32),
-        kmask=jnp.ones((n_slots, n_keys, width), dtype=bool),
+        kmask=kmask0,
         kdef=jnp.zeros((n_slots, n_keys), dtype=bool),
         kneg=jnp.zeros((n_slots, n_keys), dtype=bool),
         kgt=jnp.full((n_slots, n_keys), -jnp.inf, dtype=jnp.float32),
@@ -1106,6 +1592,10 @@ def solve_core(
     if existing_state is None:
         existing_state = empty_existing_state(n_res, n_keys, width, n_zones, n_ct, n_ports)
         existing_static = empty_existing_static(n_res, n_classes, g1)
+    if packed_masks and existing_state.kmask.dtype != jnp.uint32:
+        existing_state = existing_state._replace(
+            kmask=mask_ops.pack_mask(existing_state.kmask)
+        )
 
     # seed topology counts from pre-existing pods (topology.go:231-276
     # countDomains): forward from selector-matching pods, inverse from
@@ -1124,7 +1614,7 @@ def solve_core(
     def step(carry, cls_with_index):
         return _class_step(
             statics, existing_static, n_zones, carry, cls_with_index,
-            emit_zonal_anti=emit_zonal_anti,
+            features=ft, fuse_zones=fuse_zones,
         )
 
     cls_indices = jnp.arange(n_classes, dtype=jnp.int32)
@@ -1231,7 +1721,10 @@ def empty_existing_static(
 
 _solve_jit = functools.partial(
     jax.jit,
-    static_argnames=("n_slots", "key_has_bounds", "n_passes", "emit_zonal_anti"),
+    static_argnames=(
+        "n_slots", "key_has_bounds", "n_passes", "emit_zonal_anti",
+        "features", "fuse_zones", "packed_masks",
+    ),
 )(solve_core)
 
 
@@ -1269,6 +1762,32 @@ def node_prices(state: NodeState, it_price: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(state.open_ & (state.pod_count > 0), best, 0.0)
 
 
+def snapshot_features(snapshot) -> SnapshotFeatures:
+    """The snapshot's static phase plan, normalized.  Snapshots encoded before
+    the features field existed (or built by hand in tests) degrade to the
+    all-on plan, optionally narrowed by the legacy has_required_zonal_anti
+    flag — widening is always sound (SnapshotFeatures docstring)."""
+    f = getattr(snapshot, "features", None)
+    if f is None:
+        return ALL_FEATURES._replace(
+            required_zone_anti=bool(getattr(snapshot, "has_required_zonal_anti", True))
+        ).canonical()
+    return SnapshotFeatures(*f).canonical()
+
+
+def features_with_existing(snapshot, ex_static) -> SnapshotFeatures:
+    """snapshot_features refined by the existing-node planes: the volume-limit
+    family only binds when some node carries a finite CSI attach limit —
+    encode_snapshot cannot see the node planes, so solve-time callers that
+    have them (TPUSolver, the consolidation sweeps) refine the flag here."""
+    f = snapshot_features(snapshot)
+    if ex_static is not None and bool(
+        np.any(np.asarray(ex_static.vol_limit) < UNLIMITED)
+    ):
+        f = f._replace(volume_limits=True)
+    return f
+
+
 def solve(snapshot: EncodedSnapshot, n_slots: int = 0) -> SolveOutputs:
     """Run the kernel on an encoded snapshot.  ``n_slots`` defaults to a
     rounded estimate; if slots run out (failed>0 with n_next==n_slots) the
@@ -1283,7 +1802,7 @@ def solve(snapshot: EncodedSnapshot, n_slots: int = 0) -> SolveOutputs:
     return compilecache.run_solve(
         host_cls, host_statics, n_slots, key_has_bounds,
         n_passes=snapshot.scan_passes,
-        emit_zonal_anti=snapshot.has_required_zonal_anti,
+        features=snapshot_features(snapshot),
     )
 
 

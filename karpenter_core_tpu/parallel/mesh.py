@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from karpenter_core_tpu.models.snapshot import EncodedSnapshot
 from karpenter_core_tpu.ops import solve as solve_ops
+from karpenter_core_tpu.utils import compilecache
 
 
 def default_mesh(n_devices: Optional[int] = None, axis: str = "replica") -> Mesh:
@@ -89,6 +90,13 @@ def solve_catalog_sharded(
     availability, zero allocatable, excluded from every template/class mask).
     Returns SolveOutputs identical to the single-device solve — decode sees
     the same planes (padded I tail is never viable).
+
+    Bit-packed masks compose transparently: the shardings below annotate the
+    HOST-layout bool planes, and solve_core packs them to uint32 words inside
+    the jitted program — an elementwise transform over the trailing slot axis,
+    so GSPMD keeps the I-axis partition for the packed catalog words and the
+    word-wide AND reductions stay collective-free (__graft_entry__'s dry run
+    asserts exact parity vs the single-device solve).
     """
     if mesh is None:
         mesh = default_mesh(axis=axis)
@@ -151,7 +159,9 @@ def solve_catalog_sharded(
                 n_slots=n_slots,
                 key_has_bounds=key_has_bounds,
                 n_passes=snapshot.scan_passes,
-                emit_zonal_anti=snapshot.has_required_zonal_anti,
+                features=compilecache.snap_features(
+                    solve_ops.snapshot_features(snapshot)
+                ),
             ),
             in_shardings=(cls_shardings, statics_shardings),
         )
@@ -208,7 +218,9 @@ def monte_carlo_solve(
         out = solve_ops.solve_core(
             cls, tuple(arrays), n_slots, key_has_bounds,
             n_passes=snapshot.scan_passes,
-            emit_zonal_anti=snapshot.has_required_zonal_anti,
+            features=compilecache.snap_features(
+                solve_ops.snapshot_features(snapshot)
+            ),
         )
         scheduled = jnp.sum(out.assign)
         failed = jnp.sum(out.failed)
@@ -244,7 +256,7 @@ def monte_carlo_solve(
 
 @functools.lru_cache(maxsize=16)
 def _crossed_grid_fn(mesh, key_has_bounds, n_slots: int, n_passes: int, avail_idx: int,
-                     emit_zonal_anti: bool = True):
+                     features=None):
     """Cached jitted crossed grid — a fresh closure per call would defeat
     JAX's compile cache (keyed on callable identity) and recompile the whole
     vmap-of-vmap solve every study (same pattern as
@@ -260,7 +272,7 @@ def _crossed_grid_fn(mesh, key_has_bounds, n_slots: int, n_passes: int, avail_id
         cls_k = cls._replace(count=cls.count + displaced)
         out = solve_ops.solve_core(
             cls_k, tuple(arrays), n_slots, key_has_bounds, ex, ex_static,
-            n_passes=n_passes, emit_zonal_anti=emit_zonal_anti,
+            n_passes=n_passes, features=features,
         )
         return jnp.sum(out.failed), out.state.n_next
 
@@ -322,7 +334,9 @@ def crossed_consolidation_study(
 
     fn = _crossed_grid_fn(
         mesh, key_has_bounds, n_slots, snapshot.scan_passes, avail_idx,
-        snapshot.has_required_zonal_anti,
+        compilecache.snap_features(
+            solve_ops.features_with_existing(snapshot, ex_static)
+        ),
     )
     with mesh:
         failed, n_new = jax.device_get(

@@ -47,6 +47,20 @@ PROBE_SNIPPET = (
 # caller's timeout, typically 60 s) — the buckets must resolve both regimes
 PROBE_BUCKETS = [0.5, 1, 2.5, 5, 10, 20, 30, 45, 60, 90, 120]
 
+DEFAULT_PROBE_TIMEOUT_S = 60.0
+
+
+def probe_timeout_s() -> float:
+    """Per-attempt probe timeout: ``KC_PROBE_TIMEOUT_S`` (seconds), default
+    60.  A dead relay fails by hanging the FULL timeout, so this is the single
+    biggest lever on how long an unattended bench/operator bring-up burns
+    before falling back to CPU — BENCH_r05 spent 6 minutes discovering one
+    dead relay at the old fixed value."""
+    try:
+        return float(os.environ.get("KC_PROBE_TIMEOUT_S", DEFAULT_PROBE_TIMEOUT_S))
+    except ValueError:
+        return DEFAULT_PROBE_TIMEOUT_S
+
 PROBE_TOTAL = REGISTRY.counter(
     "karpenter_backend_probe_total",
     "Backend bring-up probe attempts by outcome (ok/timeout/error).",
@@ -120,14 +134,17 @@ class BackendState:
     probes: List[dict] = field(default_factory=list)  # per-attempt records
 
 
-def probe_once(timeout_s: float, attempt: int = 0) -> ProbeResult:
+def probe_once(timeout_s: Optional[float] = None, attempt: int = 0) -> ProbeResult:
     """One fresh-interpreter device probe: init backend + run a tiny op.
 
-    Never raises; the outcome (including a killed hang) lands in metrics, a
-    structured log line, and the active tracing span.  A failure within the
-    last KC_PROBE_FAIL_TTL_S seconds is served from cache (outcome "cached")
+    ``timeout_s`` defaults to KC_PROBE_TIMEOUT_S (60 s).  Never raises; the
+    outcome (including a killed hang) lands in metrics, a structured log
+    line, and the active tracing span.  A failure within the last
+    KC_PROBE_FAIL_TTL_S seconds is served from cache (outcome "cached")
     without spawning — a dead relay costs one real probe per window."""
     global _fail_cache
+    if timeout_s is None:
+        timeout_s = probe_timeout_s()
     prior = _cached_failure()
     if prior is not None:
         PROBE_TOTAL.labels("cached").inc()
@@ -193,24 +210,27 @@ def probe_once(timeout_s: float, attempt: int = 0) -> ProbeResult:
 
 def acquire_backend(
     max_attempts: int = 5,
-    probe_timeout_s: float = 60.0,
+    probe_timeout_s: Optional[float] = None,
     deadline_s: float = 360.0,
     sleep=time.sleep,
 ) -> BackendState:
     """Bounded-retry backend bring-up; never raises.
 
     Probes with exponential backoff under an overall deadline; the first
-    success wins.  All-fail returns ``platform="cpu", fell_back=True`` — the
+    success wins.  ``probe_timeout_s`` defaults to KC_PROBE_TIMEOUT_S (60 s)
+    per attempt.  All-fail returns ``platform="cpu", fell_back=True`` — the
     caller decides how to pin itself there (bench re-execs the process).
     Every attempt is individually visible in ``state.probes``, /metrics, and
     the log.
 
     Deliberate interaction with the failure TTL cache: within one window a
-    dead relay costs exactly ONE real probe — the ladder short-circuits on a
-    cache hit instead of re-paying the hang per attempt (the 5×60 s
-    VERDICT r5 regression).  The trade is that an intra-window relay
-    recovery is only noticed at the next window; set ``KC_PROBE_FAIL_TTL_S``
-    below the first backoff (or 0) to restore full intra-ladder retries."""
+    dead relay costs exactly ONE real probe — the ladder short-circuits the
+    moment a probe is served from the failure cache (the first cached hit
+    breaks the retry loop; no sleeps, no further spawns) instead of
+    re-paying the hang per attempt (the 5×60 s VERDICT r5 regression).  The
+    trade is that an intra-window relay recovery is only noticed at the next
+    window; set ``KC_PROBE_FAIL_TTL_S`` below the first backoff (or 0) to
+    restore full intra-ladder retries."""
     state = BackendState()
     t0 = time.monotonic()
     attempt = 0

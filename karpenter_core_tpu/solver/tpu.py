@@ -667,15 +667,18 @@ class TPUSolver:
         """
         from karpenter_core_tpu.apis import labels as labels_api
         from karpenter_core_tpu.apis.objects import (
+            Affinity,
             Container,
             LabelSelector,
             ObjectMeta,
+            PodAffinity,
+            PodAffinityTerm,
             PodSpec,
             ResourceRequirements,
             TopologySpreadConstraint,
         )
 
-        def pod(requests, labels=None, spread_key=None):
+        def pod(requests, labels=None, spread_key=None, affinity_key=None):
             spec = PodSpec(
                 containers=[Container(resources=ResourceRequirements(requests=dict(requests)))]
             )
@@ -687,11 +690,26 @@ class TPUSolver:
                         label_selector=LabelSelector(match_labels=dict(labels)),
                     )
                 ]
+            if affinity_key is not None:
+                spec.affinity = Affinity(
+                    pod_affinity=PodAffinity(
+                        required=[
+                            PodAffinityTerm(
+                                topology_key=affinity_key,
+                                label_selector=LabelSelector(match_labels=dict(labels)),
+                            )
+                        ]
+                    )
+                )
             return Pod(
                 metadata=ObjectMeta(name="warmup", labels=dict(labels or {})),
                 spec=spec,
             )
 
+        # the mix spans the common SnapshotFeatures tier (zone/host spread +
+        # zone self-affinity), so the feature-keyed executable this compiles
+        # is the one steady-state batches request (or a superset
+        # compilecache.snap_features widens them to)
         protos = [
             pod({"cpu": 0.5, "memory": 512 * 2**20}),
             pod({"cpu": 1.0, "memory": 2 * 2**30}),
@@ -699,6 +717,8 @@ class TPUSolver:
                 labels_api.LABEL_TOPOLOGY_ZONE),
             pod({"cpu": 0.25, "memory": 256 * 2**20}, {"app": "warm-hspread"},
                 labels_api.LABEL_HOSTNAME),
+            pod({"cpu": 0.25, "memory": 256 * 2**20}, {"app": "warm-zaff"},
+                affinity_key=labels_api.LABEL_TOPOLOGY_ZONE),
         ]
         per = max(n_pods // len(protos), 1)
         pods: List[Pod] = []
@@ -731,11 +751,13 @@ class TPUSolver:
         if n_slots <= 0:
             n_slots = solve_ops.estimate_slots(snapshot)  # snap_slots applied inside
 
+        features = solve_ops.features_with_existing(snapshot, ex_static)
+
         cls, statics_arrays, key_has_bounds = solve_ops.prepare_host(snapshot)
         outputs = compilecache.run_solve(
             cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
             n_passes=snapshot.scan_passes,
-            emit_zonal_anti=snapshot.has_required_zonal_anti,
+            features=features,
         )
         # slot exhaustion: retry once with double capacity.  One batched fetch
         # (the relay costs ~67 ms per round trip); both arrays are cached on
@@ -747,7 +769,7 @@ class TPUSolver:
             outputs = compilecache.run_solve(
                 cls, statics_arrays, slots * 2, key_has_bounds, ex_state, ex_static,
                 n_passes=snapshot.scan_passes,
-                emit_zonal_anti=snapshot.has_required_zonal_anti,
+                features=features,
             )
         return self.decode(snapshot, outputs, state_nodes or [])
 

@@ -48,6 +48,39 @@ _stats = {"builds": 0, "memo_hits": 0}
 
 _slots_seen: set = set()
 
+# feature-set hysteresis (mirror of _slots_seen): the SnapshotFeatures static
+# flags key distinct trace variants, so naive keying would recompile whenever
+# one reconcile batch happens to drop a constraint family the previous batch
+# used.  Widening a requested set to an already-built superset executable is
+# always sound (ops/solve.SnapshotFeatures docstring: enabled-but-unused
+# phase families are runtime no-ops), so snap_features reuses the smallest
+# covering variant and caps the variant count — past the cap every new set
+# widens to all-on, bounding compilation at MAX_FEATURE_VARIANTS + 1
+# executables per shape bucket (tests/test_compilecache.py asserts this).
+_features_seen: set = set()
+MAX_FEATURE_VARIANTS = 8
+
+
+def snap_features(features):
+    """Stabilize the solve's static feature set across nearby batches."""
+    from karpenter_core_tpu.ops.solve import ALL_FEATURES, SnapshotFeatures
+
+    if features is None:
+        return ALL_FEATURES
+    f = SnapshotFeatures(*features).canonical()
+    with _lock:
+        if f in _features_seen:
+            return f
+        covering = [g for g in _features_seen if g.covers(f)]
+        if covering:
+            # fewest extra flags = least superfluous traced work
+            return min(covering, key=lambda g: (sum(g), tuple(g)))
+        if len(_features_seen) >= MAX_FEATURE_VARIANTS:
+            _features_seen.add(ALL_FEATURES)
+            return ALL_FEATURES
+        _features_seen.add(f)
+        return f
+
 
 def snap_slots(estimate: int, max_waste: int = 4) -> int:
     """Stabilize the solve's static slot count across nearby batches.
@@ -77,11 +110,13 @@ def reset_stats() -> None:
 
 def reset_memo() -> None:
     """Simulate a process restart for tests: clear the executable memo AND the
-    slot-count hysteresis — a stale _slots_seen entry with no backing
-    executable would snap later solves to a permanently oversized shape."""
+    slot-count/feature-set hysteresis — a stale _slots_seen entry with no
+    backing executable would snap later solves to a permanently oversized
+    shape (and a stale feature set to a permanently wider trace)."""
     with _lock:
         _memo.clear()
         _slots_seen.clear()
+        _features_seen.clear()
         _stats.update(builds=0, memo_hits=0)
 
 
@@ -223,7 +258,9 @@ def solve_callable(
     ex_state=None,
     ex_static=None,
     n_passes: int = 1,
-    emit_zonal_anti: bool = True,
+    features=None,
+    fuse_zones: bool = True,
+    packed_masks: bool = True,
 ):
     """An AOT-compiled solve callable served through the export cache, or None
     when export-caching is unavailable (callers fall back to the plain jit).
@@ -238,13 +275,16 @@ def solve_callable(
     try:
         enable()
         has_ex = ex_state is not None
+        features = snap_features(features)
         key = (
             _kernel_src_hash(),
             jax.default_backend(),
             n_slots,
             tuple(key_has_bounds),
             n_passes,
-            emit_zonal_anti,
+            tuple(features),
+            fuse_zones,
+            packed_masks,
             has_ex,
             _leaf_sig(cls),
             _leaf_sig(statics_arrays),
@@ -269,7 +309,7 @@ def solve_callable(
         try:
             return _build_and_memo(key, cls, statics_arrays, n_slots,
                                    key_has_bounds, ex_state, ex_static, n_passes,
-                                   emit_zonal_anti)
+                                   features, fuse_zones, packed_masks)
         finally:
             with _lock:
                 _in_flight.pop(key, None)
@@ -280,7 +320,8 @@ def solve_callable(
 
 
 def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
-                    ex_state, ex_static, n_passes, emit_zonal_anti=True):
+                    ex_state, ex_static, n_passes, features=None,
+                    fuse_zones=True, packed_masks=True):
     """Build one executable for ``key``: export-cache load (or trace+export),
     then AOT compile, then memoize.  Callers hold the key's in-flight slot."""
     import jax
@@ -309,14 +350,16 @@ def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
             base = jax.jit(
                 lambda c, s, exs, exst: solve_ops.solve_core(
                     c, s, n_slots, key_has_bounds, exs, exst, n_passes=n_passes,
-                    emit_zonal_anti=emit_zonal_anti,
+                    features=features, fuse_zones=fuse_zones,
+                    packed_masks=packed_masks,
                 )
             )
         else:
             base = jax.jit(
                 lambda c, s: solve_ops.solve_core(
                     c, s, n_slots, key_has_bounds, n_passes=n_passes,
-                    emit_zonal_anti=emit_zonal_anti,
+                    features=features, fuse_zones=fuse_zones,
+                    packed_masks=packed_masks,
                 )
             )
         exported = jax.export.export(base)(*structs)
@@ -334,6 +377,16 @@ def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
     return compiled
 
 
+def kernel_flags():
+    """(fuse_zones, packed_masks) process defaults: both on, individually
+    disengageable for triage via KC_KERNEL_FUSE_ZONES=0 /
+    KC_KERNEL_PACKED_MASKS=0 (docs/KERNEL_PERF.md)."""
+    return (
+        os.environ.get("KC_KERNEL_FUSE_ZONES", "1") != "0",
+        os.environ.get("KC_KERNEL_PACKED_MASKS", "1") != "0",
+    )
+
+
 def run_solve(
     cls,
     statics_arrays,
@@ -342,14 +395,16 @@ def run_solve(
     ex_state=None,
     ex_static=None,
     n_passes: int = 1,
-    emit_zonal_anti: bool = True,
+    features=None,
 ):
     """Solve through the export cache, falling back to the plain jit.
 
     Inputs may be host (numpy) pytrees — from ops.solve.prepare_host — or
     device arrays; the device upload runs on a worker thread overlapped with
     the (cache-served) compile, since both are seconds-long over the relay and
-    independent."""
+    independent.  ``features`` is the snapshot's SnapshotFeatures phase plan
+    (None = all-on); it may be silently widened to a previously-built
+    superset executable (snap_features)."""
     from concurrent.futures import ThreadPoolExecutor
 
     import jax
@@ -357,6 +412,8 @@ def run_solve(
     from karpenter_core_tpu import tracing
     from karpenter_core_tpu.ops import solve as solve_ops
 
+    fuse_zones, packed_masks = kernel_flags()
+    features = snap_features(features)
     # "dispatch" covers pad + upload + executable lookup + async kernel launch;
     # the separate "solve" span blocks on the outputs (tracing only) so device
     # compute is attributed to the solve, not to whichever span first touches
@@ -372,13 +429,14 @@ def run_solve(
             )
             fn = solve_callable(
                 cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
-                n_passes, emit_zonal_anti,
+                n_passes, features, fuse_zones, packed_masks,
             )
             cls, statics_arrays, ex_state, ex_static = upload.result()
         if fn is None:
             out = solve_ops._solve_jit(
                 cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
-                n_passes=n_passes, emit_zonal_anti=emit_zonal_anti,
+                n_passes=n_passes, features=features, fuse_zones=fuse_zones,
+                packed_masks=packed_masks,
             )
         elif ex_state is not None:
             out = fn(cls, statics_arrays, ex_state, ex_static)

@@ -101,6 +101,94 @@ class TestExportCache:
         entries = [f for f in os.listdir(cache_dir) if f.endswith(".stablehlo")]
         assert entries, "TPUSolver.solve must populate the export cache"
 
+class TestFeatureVariants:
+    """SnapshotFeatures static pruning must map onto a BOUNDED set of trace
+    variants (cache keys): snap_features widens a requested flag set to an
+    already-built superset, and past MAX_FEATURE_VARIANTS distinct sets
+    everything widens to all-on — so feature-keyed compilation can never
+    silently explode (ISSUE 3 satellite)."""
+
+    def setup_method(self):
+        compilecache.reset_memo()
+
+    def teardown_method(self):
+        compilecache.reset_memo()
+
+    def test_variant_space_is_bounded(self):
+        import random
+
+        from karpenter_core_tpu.ops.solve import ALL_FEATURES, SnapshotFeatures
+
+        rng = random.Random(0)
+        snapped_sets = set()
+        for _ in range(500):
+            bits = [rng.random() < 0.5 for _ in range(len(ALL_FEATURES))]
+            f = SnapshotFeatures(*bits)
+            snapped = compilecache.snap_features(f)
+            # widening only: every flag the request needs stays on
+            assert snapped.covers(f.canonical()), (f, snapped)
+            snapped_sets.add(snapped)
+        assert len(snapped_sets) <= compilecache.MAX_FEATURE_VARIANTS + 1
+
+    def test_subset_request_reuses_superset_variant(self):
+        from karpenter_core_tpu.ops.solve import ALL_FEATURES, SnapshotFeatures
+
+        superset = ALL_FEATURES
+        assert compilecache.snap_features(superset) == superset
+        subset = SnapshotFeatures(*(False,) * len(superset))._replace(
+            zone_spread=True
+        )
+        # the subset request lands on the already-seen superset — one
+        # executable serves both (the extra phases are runtime no-ops)
+        assert compilecache.snap_features(subset) == superset
+
+    def test_canonicalization_collapses_implied_flags(self):
+        from karpenter_core_tpu.ops.solve import SnapshotFeatures
+
+        f = SnapshotFeatures(*(False,) * 11)._replace(required_zone_anti=True)
+        c = f.canonical()
+        assert c.zone_anti and c.inv_zone_anti
+        # equivalent requests share one cache key
+        g = f._replace(zone_anti=True, inv_zone_anti=True)
+        assert compilecache.snap_features(f) == compilecache.snap_features(g)
+
+    def test_none_means_all_on(self):
+        from karpenter_core_tpu.ops.solve import ALL_FEATURES
+
+        assert compilecache.snap_features(None) == ALL_FEATURES
+
+    def test_encoded_snapshot_features_match_workload(self):
+        from karpenter_core_tpu.apis import labels as labels_api
+        from karpenter_core_tpu.apis.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+        from karpenter_core_tpu.testing import make_pod
+
+        provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(10))
+        solver = TPUSolver(provider, [make_provisioner()])
+        pods = make_pods(4, requests={"cpu": "500m"}) + [
+            make_pod(
+                requests={"cpu": "250m"},
+                labels={"app": "s"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                        label_selector=LabelSelector(match_labels={"app": "s"}),
+                    )
+                ],
+            )
+        ]
+        snap = solver.encode(pods)
+        ft = snap.features
+        assert ft.zone_spread
+        assert not ft.host_spread
+        assert not ft.zone_affinity and not ft.host_affinity
+        assert not ft.zone_anti and not ft.required_zone_anti
+        assert not ft.host_ports and not ft.volume_limits
+
+
 class TestShapeBuckets:
     """ops/solve.pad_planes: nearby problem sizes share one executable and
     padding is semantically invisible (ROADMAP compile-reuse item)."""

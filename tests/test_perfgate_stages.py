@@ -1,0 +1,58 @@
+"""tools/perfgate.py per-stage drift comparison: a stage regression must be
+flagged even when the top-line pods/sec is flat (ISSUE 3 satellite)."""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_perfgate():
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    spec = importlib.util.spec_from_file_location(
+        "perfgate_under_test", os.path.join(REPO, "tools", "perfgate.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_stage_regression_is_flagged_with_flat_topline():
+    pg = _load_perfgate()
+    prev = {"solve_decode_s": 1.0, "ingest_s": 0.5, "cold_s": 10.0,
+            "encode_s": 0.01, "pods_per_sec": 30000}
+    # solve got 40% slower while ingest got faster: wall clock roughly flat
+    cur = {"solve_decode_s": 1.4, "ingest_s": 0.15, "cold_s": 10.2,
+           "encode_s": 0.01, "pods_per_sec": 30000}
+    rows = pg.compare_stages(cur, prev, tol=0.25)
+    by_key = {row[0]: row for row in rows}
+    assert by_key["solve_decode_s"][3], "40% solve regression must flag"
+    assert not by_key["ingest_s"][3], "improvement is not a regression"
+    assert not by_key["cold_s"][3], "2% is inside tolerance"
+
+
+def test_stage_noise_floor():
+    pg = _load_perfgate()
+    # tiny stages can double without being meaningful: absolute 50 ms floor
+    prev = {"solve_decode_s": 0.010, "ingest_s": 0.5, "cold_s": 10.0}
+    cur = {"solve_decode_s": 0.030, "ingest_s": 0.5, "cold_s": 10.0}
+    rows = pg.compare_stages(cur, prev, tol=0.25)
+    assert not any(row[3] for row in rows)
+
+
+def test_missing_stages_are_skipped():
+    pg = _load_perfgate()
+    rows = pg.compare_stages({"cold_s": 5.0}, {"pods_per_sec": 1}, tol=0.25)
+    assert rows == []
+
+
+def test_ungated_stage_never_flags():
+    pg = _load_perfgate()
+    # encode_s is reported but not load-bearing enough to gate
+    prev = {"encode_s": 0.2}
+    cur = {"encode_s": 1.2}
+    rows = pg.compare_stages(cur, prev, tol=0.25)
+    (row,) = rows
+    assert row[0] == "encode_s" and not row[3]

@@ -418,6 +418,51 @@ class TestBackendProbe:
         assert state.platform == "tpu" and not state.fell_back
         assert state.attempts == 1 and len(state.probes) == 1
 
+    def test_probe_timeout_env_var(self, monkeypatch):
+        """KC_PROBE_TIMEOUT_S drives the per-attempt subprocess timeout when
+        the caller doesn't pin one (ISSUE 3 satellite)."""
+        from karpenter_core_tpu.solver import backendprobe
+
+        seen = {}
+
+        def record(*args, **kwargs):
+            seen["timeout"] = kwargs["timeout"]
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=kwargs["timeout"])
+
+        monkeypatch.setattr(backendprobe.subprocess, "run", record)
+        backendprobe.reset_fail_cache()
+        monkeypatch.setenv("KC_PROBE_TIMEOUT_S", "7.5")
+        result = backendprobe.probe_once()
+        assert seen["timeout"] == 7.5
+        assert "hung past 8s" in result.error or "hung past 7" in result.error
+        # an explicit timeout still wins over the env
+        backendprobe.reset_fail_cache()
+        backendprobe.probe_once(3.0)
+        assert seen["timeout"] == 3.0
+        # garbage env falls back to the default
+        monkeypatch.setenv("KC_PROBE_TIMEOUT_S", "not-a-number")
+        assert backendprobe.probe_timeout_s() == backendprobe.DEFAULT_PROBE_TIMEOUT_S
+        backendprobe.reset_fail_cache()
+
+    def test_acquire_backend_honors_env_timeout(self, monkeypatch):
+        from karpenter_core_tpu.solver import backendprobe
+
+        seen = []
+
+        def record(*args, **kwargs):
+            seen.append(kwargs["timeout"])
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=kwargs["timeout"])
+
+        monkeypatch.setattr(backendprobe.subprocess, "run", record)
+        backendprobe.reset_fail_cache()
+        monkeypatch.setenv("KC_PROBE_TIMEOUT_S", "2")
+        state = backendprobe.acquire_backend(max_attempts=3, sleep=lambda s: None)
+        assert state.fell_back
+        # one real probe (at the env timeout), then the cached short-circuit
+        assert seen == [2.0]
+        assert [p["outcome"] for p in state.probes] == ["timeout", "cached"]
+        backendprobe.reset_fail_cache()
+
 
 @pytest.mark.compile
 class TestSolvePipelineSpans:

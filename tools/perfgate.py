@@ -39,8 +39,15 @@ from karpenter_core_tpu.solver.backendprobe import probe_once  # noqa: E402
 
 def run_bench() -> dict:
     """Run bench.py with backend pre-pinned by a single bounded probe (the
-    bench's own 5x60s probe ladder is for the driver's unattended run)."""
-    platform = probe_once(45.0).platform
+    bench's own probe ladder is for the driver's unattended run).  The probe
+    timeout honors KC_PROBE_TIMEOUT_S when set, else a presubmit-tight 45 s."""
+    timeout = 45.0
+    if os.environ.get("KC_PROBE_TIMEOUT_S"):
+        try:
+            timeout = float(os.environ["KC_PROBE_TIMEOUT_S"])
+        except ValueError:
+            pass
+    platform = probe_once(timeout).platform
     rec = run_pinned(platform or "cpu")
     if "error" in rec:
         sys.stderr.write(rec.get("stderr", "") + "\n")
@@ -73,12 +80,46 @@ def last_record(platform: str):
     return best
 
 
+# per-stage duration keys compared round-over-round: a stage regression must
+# not hide inside a flat top-line (e.g. solve got slower while ingest got
+# faster).  Durations — LOWER is better, unlike pods_per_sec.
+STAGE_KEYS = ("solve_decode_s", "ingest_s", "encode_s", "dispatch_s",
+              "materialize_s", "cold_s")
+# stages that matter enough to flag; the others are printed but only the
+# load-bearing three gate (sub-10ms stages WARN on scheduler-noise otherwise)
+GATED_STAGES = ("solve_decode_s", "ingest_s", "cold_s")
+
+
+def compare_stages(detail: dict, prev_detail: dict, tol: float):
+    """[(stage, current, previous, regressed)] for stages present in both
+    records.  ``regressed`` = current exceeds previous by more than ``tol``
+    (fractional) AND more than an absolute 50 ms noise floor."""
+    rows = []
+    for key in STAGE_KEYS:
+        cur, prev = detail.get(key), prev_detail.get(key)
+        if cur is None or prev is None:
+            continue
+        regressed = (
+            key in GATED_STAGES
+            and cur > prev * (1.0 + tol)
+            and cur - prev > 0.05
+        )
+        rows.append((key, float(cur), float(prev), regressed))
+    return rows
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=0.05,
                     help="allowed fractional drop vs last same-platform, same-machine record")
     ap.add_argument("--cross-machine-tolerance", type=float, default=0.20,
                     help="allowed drop when the last record came from another machine")
+    ap.add_argument("--stage-tolerance", type=float, default=0.25,
+                    help="allowed fractional increase per stage duration "
+                         "(solve_decode_s/ingest_s/cold_s) vs the last record")
+    ap.add_argument("--cross-machine-stage-tolerance", type=float, default=0.50,
+                    help="per-stage tolerance when the last record came from "
+                         "another machine")
     ap.add_argument("--record", default=None,
                     help="also write the fresh bench line to this path")
     args = ap.parse_args()
@@ -101,21 +142,37 @@ def main() -> int:
               f"current {pods_per_sec} pods/s)")
         return 0
     rnd, path, prev = prior
-    prev_pps = prev["detail"]["pods_per_sec"]
+    prev_detail = prev.get("detail") or {}
+    prev_pps = prev_detail["pods_per_sec"]
     same_machine = (
         detail.get("machine") is not None
-        and detail.get("machine") == (prev.get("detail") or {}).get("machine")
+        and detail.get("machine") == prev_detail.get("machine")
     )
     tol = args.tolerance if same_machine else args.cross_machine_tolerance
+    stage_tol = (args.stage_tolerance if same_machine
+                 else args.cross_machine_stage_tolerance)
     floor = prev_pps * (1.0 - tol)
     strict = os.environ.get("KC_PERF_GATE_STRICT", "0") == "1"
-    verdict = "PASS" if pods_per_sec >= floor else ("FAIL" if strict else "WARN")
+
+    stages = compare_stages(detail, prev_detail, stage_tol)
+    regressed = [row for row in stages if row[3]]
+    for key, cur, prev_v, bad in stages:
+        delta = (cur - prev_v) / prev_v if prev_v else 0.0
+        flag = " REGRESSED" if bad else ""
+        print(f"perfgate: stage {key}: {cur:.4f}s vs {prev_v:.4f}s "
+              f"({delta:+.0%}){flag}")
+
+    drifted = pods_per_sec < floor or bool(regressed)
+    verdict = "PASS" if not drifted else ("FAIL" if strict else "WARN")
     print(
         f"perfgate: {verdict} — {pods_per_sec} pods/s on {platform} vs "
         f"{prev_pps} in {os.path.basename(path)} (round {rnd}, "
         f"{'same' if same_machine else 'different'} machine, "
         f"tolerance {tol:.0%}, floor {floor:.0f})"
     )
+    if regressed:
+        names = ", ".join(row[0] for row in regressed)
+        print(f"perfgate: stage regression past {stage_tol:.0%}: {names}")
     if verdict == "WARN":
         print("perfgate: advisory mode — drift does not fail presubmit "
               "(KC_PERF_GATE_STRICT=1 to enforce)")

@@ -34,7 +34,8 @@ from bench import run_pinned  # noqa: E402 - shared run contract
 from karpenter_core_tpu.solver.backendprobe import probe_once  # noqa: E402
 
 
-def probe(timeout_s: float = 60.0):
+def probe(timeout_s=None):
+    # per-attempt timeout from KC_PROBE_TIMEOUT_S (default 60 s)
     return probe_once(timeout_s).platform
 
 
