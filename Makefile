@@ -4,10 +4,9 @@ PYTEST ?= python -m pytest
 
 presubmit: verify test kernel-smoke perf-gate  ## everything a PR needs to pass
 
-verify:  ## static checks: bytecode-compile, lint gate, instrumentation gate, build the native library
+verify:  ## static checks: bytecode-compile, kcanalyze (all analysis passes, baseline-aware), build the native library
 	python -m compileall -q karpenter_core_tpu tests bench.py __graft_entry__.py
-	python tools/lint.py
-	python tools/check_instrumented.py
+	python tools/kcanalyze.py
 	$(MAKE) -C native
 
 test:  ## fast behavioral tier (virtual 8-device CPU mesh, ~2 min)

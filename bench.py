@@ -394,11 +394,39 @@ def _traced_solve(solver, pods) -> dict:
             tracing.disable()
 
 
+def _register_compile_counter() -> dict:
+    """Count XLA backend compiles for the life of this process (the runtime
+    side of kcanalyze's retrace-budget pass: the manifest records how many
+    compiles a cold bench is EXPECTED to pay, and the observed count ties
+    the static budget to the measured trajectory in BENCH_r*.json).  Must
+    run after the backend decision — importing jax.monitoring is safe, but
+    this helper is only called from main() post-acquire_backend."""
+    counter = {"n": 0}
+    try:
+        import jax.monitoring
+
+        def _on_event(event: str, duration: float, **kwargs) -> None:
+            if event == "/jax/core/compile/backend_compile_duration":
+                counter["n"] += 1
+
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+    except Exception as e:  # noqa: BLE001 - metering never kills the bench
+        print(f"compile counter unavailable: {e}", file=sys.stderr)
+    return counter
+
+
+def _retrace_manifest() -> dict:
+    from karpenter_core_tpu.analysis.manifest import load_retrace_manifest
+
+    return load_retrace_manifest()
+
+
 def main() -> None:
     n_pods = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
     n_its = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000
 
     acquire_backend()
+    compile_counter = _register_compile_counter()
 
     from karpenter_core_tpu.models.columnar import PodIngest
     from karpenter_core_tpu.ops import solve as solve_ops
@@ -499,6 +527,26 @@ def main() -> None:
         detail["backend_probe_failures"] = _BACKEND["probe_failures"]
     if _BACKEND["probes"]:
         detail["backend_probes"] = _BACKEND["probes"]
+
+    # observed compile count vs the retrace-budget manifest's expectation:
+    # a bench that suddenly compiles more programs than the manifest says a
+    # cold run needs is retracing — the exact failure mode the static
+    # trace-safety/retrace-budget gates exist for, caught here on the
+    # measured trajectory too (BENCH_r*.json keeps the history)
+    detail["compiles"] = compile_counter["n"]
+    expected_compiles = int(_retrace_manifest().get("bench_cold_compiles", 0) or 0)
+    if expected_compiles:
+        detail["expected_cold_compiles"] = expected_compiles
+        if compile_counter["n"] > expected_compiles:
+            detail["compile_budget_exceeded"] = True
+            print(
+                f"bench: WARNING observed {compile_counter['n']} XLA compiles "
+                f"> expected cold-compile count {expected_compiles} "
+                "(karpenter_core_tpu/analysis/retrace_budget.json) — a jit "
+                "argument stopped being static or a compile-cache key axis "
+                "is churning",
+                file=sys.stderr,
+            )
 
     # scale lines (BASELINE.md configs 3-4): on by default on a real
     # accelerator, opt-in/out via KC_BENCH_SCALE=1/0 (CPU runs them only on

@@ -1,0 +1,46 @@
+"""Static analysis framework for the repo's domain-specific bug classes.
+
+The role golangci-lint + ``go vet -race`` play in the reference presubmit
+(Makefile:16-24) cannot be vendored here, and the generic hygiene rules in
+the old ``tools/lint.py`` walker knew nothing about the two failure modes
+that actually hurt this codebase: a host sync silently turning the 1.27 s
+warm solve back into a 30 s retrace (PR 3), and latent lock-order bugs in
+the threaded operator surfacing only by accident (PR 2).  This package is a
+small reusable stdlib-``ast`` framework — module loader (`core.Project`),
+call-graph builder (`callgraph.CallGraph`), a per-pass `core.Finding` model
+with file:line output, and a checked-in baseline/suppression file
+(`baseline.toml`, parsed by `core.Baseline`) — plus the passes under
+``analysis/passes/``:
+
+  trace-safety    host-sync / trace-breaking patterns reachable from
+                  ``jax.jit`` entry points
+  retrace-budget  static_argnums/static_argnames consistency with the
+                  compile-cache key, unhashable static args, per-call
+                  ``jax.jit`` construction
+  lock-order      inconsistent pairwise lock acquisition order, blocking
+                  calls under a held lock, raw ``.acquire()``
+  hygiene         the old lint.py rules plus assert-in-package and
+                  wallclock (Clock discipline)
+  instrumented    every controller ``reconcile`` opens a tracing span
+
+Driven by ``tools/kcanalyze.py`` from ``make verify``; see docs/ANALYSIS.md
+for the pass catalog, baseline policy, and how to add a pass.
+"""
+
+from karpenter_core_tpu.analysis.core import (  # noqa: F401 - public surface
+    Baseline,
+    BaselineError,
+    Finding,
+    Project,
+    SourceModule,
+)
+from karpenter_core_tpu.analysis.callgraph import CallGraph  # noqa: F401
+
+__all__ = [
+    "Baseline",
+    "BaselineError",
+    "CallGraph",
+    "Finding",
+    "Project",
+    "SourceModule",
+]

@@ -1,0 +1,221 @@
+"""Call-graph over the package's parsed modules.
+
+Edges are REFERENCES, not just calls: in a JAX codebase functions travel as
+values (``lax.scan(step, ...)``, ``lax.cond(p, do, skip, ...)``, ``vmap(f)``,
+``partial(f, ...)``), so any Name/Attribute load that resolves to a known
+function counts as an edge.  That over-approximates reachability, which is
+the sound direction for the trace-safety pass (a function that MIGHT be
+traced must be host-sync-free).
+
+Resolution is deliberately conservative:
+
+  - bare names resolve within the defining module (including nested and
+    sibling functions),
+  - ``mod.func`` attribute chains resolve through the module's import map,
+  - ``self.method()`` resolves within the enclosing class,
+  - anything else (duck-typed attribute calls on objects) is ignored.
+
+Functions are keyed ``module:qualname`` (e.g. ``...ops.solve:solve_core`` or
+``...solver.tpu:TPUSolver.decode``); nested functions append their name
+(``solve_core.committal_block``) and lambdas get a synthetic
+``<lambda@LINE>`` segment so jit-wrapped lambdas are first-class nodes.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set
+
+from karpenter_core_tpu.analysis.core import Project, SourceModule, import_map
+
+
+@dataclass
+class FunctionInfo:
+    key: str  # "module:qualname"
+    module: SourceModule
+    qualname: str
+    node: ast.AST  # FunctionDef / AsyncFunctionDef / Lambda
+    cls: Optional[str] = None  # enclosing class name, if a method
+    children: List[str] = field(default_factory=list)  # nested function keys
+    refs: Set[str] = field(default_factory=set)  # resolved reference edges
+
+
+class _Indexer(ast.NodeVisitor):
+    def __init__(self, graph: "CallGraph", module: SourceModule) -> None:
+        self.graph = graph
+        self.module = module
+        self.stack: List[str] = []  # qualname segments
+        self.class_stack: List[str] = []
+        self.parent_keys: List[str] = []
+
+    def _register(self, name: str, node: ast.AST) -> str:
+        qual = ".".join(self.stack + [name])
+        key = f"{self.module.name}:{qual}"
+        info = FunctionInfo(
+            key=key, module=self.module, qualname=qual, node=node,
+            cls=self.class_stack[-1] if self.class_stack else None,
+        )
+        self.graph.functions[key] = info
+        self.graph._by_node[id(node)] = key
+        if self.class_stack:
+            # methods are reachable only as Class.name / self.name — indexing
+            # them under the bare name would shadow builtins (a method called
+            # ``list`` must not capture every ``list(...)`` in the module)
+            self.graph._local.setdefault(
+                (self.module.name, f"{self.class_stack[-1]}.{name}"), []
+            ).append(key)
+        else:
+            self.graph._local.setdefault(
+                (self.module.name, name), []
+            ).append(key)
+        if self.parent_keys:
+            parent = self.graph.functions[self.parent_keys[-1]]
+            parent.children.append(key)
+        return key
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+        self.stack.pop()
+
+    def _visit_func(self, node, name: str) -> None:
+        key = self._register(name, node)
+        self.stack.append(name)
+        self.parent_keys.append(key)
+        self.generic_visit(node)
+        self.parent_keys.pop()
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_func(node, node.name)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._visit_func(node, f"<lambda@{node.lineno}>")
+
+
+class CallGraph:
+    def __init__(self, project: Project, modules: Optional[Iterable[SourceModule]] = None) -> None:
+        self.project = project
+        self.functions: Dict[str, FunctionInfo] = {}
+        self._local: Dict[tuple, List[str]] = {}
+        self._imports: Dict[str, Dict[str, str]] = {}
+        self._by_node: Dict[int, str] = {}
+        mods = list(modules) if modules is not None else project.package_modules
+        for mod in mods:
+            self._imports[mod.name] = import_map(mod.tree)
+            _Indexer(self, mod).visit(mod.tree)
+        for info in list(self.functions.values()):
+            self._collect_refs(info)
+
+    # -- resolution ------------------------------------------------------------
+
+    def key_for_node(self, node: ast.AST) -> Optional[str]:
+        """Key of a FunctionDef/Lambda ast node indexed from a project tree."""
+        return self._by_node.get(id(node))
+
+    def resolve(self, expr: ast.expr, module: SourceModule,
+                enclosing: Optional[FunctionInfo] = None) -> Optional[str]:
+        """Function key a Name/Attribute reference points at, or None."""
+        imports = self._imports.get(module.name, {})
+        if isinstance(expr, ast.Name):
+            hit = self._local.get((module.name, expr.id))
+            if hit:
+                return hit[0]
+            target = imports.get(expr.id)
+            if target:
+                mod_name, _, attr = target.rpartition(".")
+                hit = self._local.get((mod_name, attr))
+                if hit:
+                    return hit[0]
+            return None
+        if isinstance(expr, ast.Attribute):
+            # self.method() within a class
+            if (
+                enclosing is not None
+                and enclosing.cls is not None
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id in ("self", "cls")
+            ):
+                hit = self._local.get(
+                    (module.name, f"{enclosing.cls}.{expr.attr}")
+                )
+                if hit:
+                    return hit[0]
+                return None
+            # mod.func through the import map
+            base = expr.value
+            parts = [expr.attr]
+            while isinstance(base, ast.Attribute):
+                parts.append(base.attr)
+                base = base.value
+            if not isinstance(base, ast.Name):
+                return None
+            target = imports.get(base.id)
+            if target is None:
+                return None
+            full = ".".join([target] + list(reversed(parts)))
+            mod_name, _, attr = full.rpartition(".")
+            hit = self._local.get((mod_name, attr))
+            if hit:
+                return hit[0]
+            # class attribute access like mod.Class.method
+            mod_name2, _, cls_attr = mod_name.rpartition(".")
+            hit = self._local.get((mod_name2, f"{cls_attr}.{attr}"))
+            if hit:
+                return hit[0]
+            return None
+        return None
+
+    def _collect_refs(self, info: FunctionInfo) -> None:
+        """Every resolvable function reference in the body, excluding nested
+        function bodies (those are separate nodes, auto-edged as children)."""
+        nested = {id(self.functions[k].node) for k in info.children}
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if id(child) in nested:
+                    continue
+                if isinstance(child, (ast.Name, ast.Attribute)):
+                    key = self.resolve(child, info.module, info)
+                    if key is not None and key != info.key:
+                        info.refs.add(key)
+                walk(child)
+
+        body = info.node.body
+        for stmt in body if isinstance(body, list) else [body]:
+            if isinstance(stmt, (ast.Name, ast.Attribute)):
+                key = self.resolve(stmt, info.module, info)
+                if key is not None and key != info.key:
+                    info.refs.add(key)
+            walk(stmt)
+
+    # -- reachability ----------------------------------------------------------
+
+    def reachable(self, seeds: Iterable[str]) -> Set[str]:
+        """Transitive closure over reference + nested-child edges."""
+        seen: Set[str] = set()
+        frontier = [k for k in seeds if k in self.functions]
+        while frontier:
+            key = frontier.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            info = self.functions[key]
+            frontier.extend(info.children)
+            frontier.extend(info.refs)
+        return seen
+
+
+def shared_graph(project: Project) -> CallGraph:
+    """One CallGraph per Project instance — passes share the build."""
+    graph = getattr(project, "_shared_callgraph", None)
+    if graph is None:
+        graph = CallGraph(project)
+        project._shared_callgraph = graph  # type: ignore[attr-defined]
+    return graph

@@ -1,0 +1,206 @@
+"""Discovery of ``jax.jit`` sites and their static-argument declarations.
+
+Shared by the trace-safety pass (jit targets seed reachability) and the
+retrace-budget pass (each site's static_argnums/static_argnames is checked
+against the compile-cache key).  Handles the spellings this repo uses:
+
+    @jax.jit
+    @functools.partial(jax.jit, static_argnames=(...))
+    jax.jit(fn, ...)
+    jax.jit(lambda ...: ..., ...)
+    jax.jit(jax.vmap(fn), ...)
+    functools.partial(jax.jit, ...)(fn)
+
+Targets unwrap through ``vmap``/``partial`` chains to the underlying
+function expression.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu.analysis.core import (
+    SourceModule,
+    import_map,
+    resolve_call_root,
+)
+
+_JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+_UNWRAP_NAMES = {"jax.vmap", "vmap", "jax.checkpoint", "jax.remat"}
+
+
+@dataclass
+class JitSite:
+    module: SourceModule
+    lineno: int
+    target: Optional[ast.expr]  # function expression (Name/Attribute/Lambda)
+    decorated: Optional[ast.AST] = None  # FunctionDef when a decorator site
+    static_argnames: Optional[Tuple[str, ...]] = None
+    static_argnums: Optional[Tuple[int, ...]] = None
+    non_literal_statics: bool = False  # statics computed, not literal
+    enclosing: str = ""  # qualname of the function containing the site ("" = module scope)
+    jit_call: Optional[ast.Call] = None
+    kwargs: Dict[str, ast.expr] = field(default_factory=dict)
+
+
+def _literal_names(node: ast.expr) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant) and isinstance(el.value, str)):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def _literal_nums(node: ast.expr) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant) and isinstance(el.value, int)):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def _unwrap_target(
+    expr: ast.expr, imports: Dict[str, str], tree: Optional[ast.Module] = None
+) -> ast.expr:
+    """Peel vmap/partial wrappers down to the wrapped function expression.
+    A bare Name is chased through (single-assignment) local bindings so
+    ``grid = jax.vmap(one_cell); jax.jit(grid)`` still yields ``one_cell``."""
+    for _ in range(8):  # bounded: pathological chains just stop resolving
+        if isinstance(expr, ast.Call):
+            root = resolve_call_root(expr.func, imports)
+            if (root in _UNWRAP_NAMES or root in _PARTIAL_NAMES) and expr.args:
+                expr = expr.args[0]
+                continue
+            return expr
+        if isinstance(expr, ast.Name) and tree is not None:
+            bound = _assignment_value(tree, expr.id)
+            if bound is not None and isinstance(bound, ast.Call):
+                expr = bound
+                continue
+        return expr
+    return expr
+
+
+def _assignment_value(tree: ast.Module, name: str) -> Optional[ast.expr]:
+    """Value of the single ``name = <expr>`` assignment in the module, or
+    None when the name is unassigned or assigned more than once."""
+    hits: List[ast.expr] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id == name:
+                hits.append(node.value)
+    return hits[0] if len(hits) == 1 else None
+
+
+def _apply_statics(site: JitSite, call: ast.Call) -> None:
+    for kw in call.keywords:
+        if kw.arg is None:
+            continue
+        site.kwargs[kw.arg] = kw.value
+        if kw.arg == "static_argnames":
+            names = _literal_names(kw.value)
+            if names is None:
+                site.non_literal_statics = True
+            else:
+                site.static_argnames = names
+        elif kw.arg == "static_argnums":
+            nums = _literal_nums(kw.value)
+            if nums is None:
+                site.non_literal_statics = True
+            else:
+                site.static_argnums = nums
+
+
+def _is_partial_of_jit(call: ast.Call, imports: Dict[str, str]) -> bool:
+    root = resolve_call_root(call.func, imports)
+    if root not in _PARTIAL_NAMES or not call.args:
+        return False
+    return resolve_call_root(call.args[0], imports) in _JIT_NAMES
+
+
+def find_jit_sites(module: SourceModule) -> List[JitSite]:
+    imports = import_map(module.tree)
+    sites: List[JitSite] = []
+
+    # enclosing-function tracking for the per-call-jit check
+    enclosing_of: Dict[int, str] = {}
+
+    def mark(node: ast.AST, qual: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mark(child, qual + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                mark(child, qual + [child.name])
+            else:
+                enclosing_of[id(child)] = ".".join(qual)
+                mark(child, qual)
+
+    mark(module.tree, [])
+
+    for node in ast.walk(module.tree):
+        # decorator sites
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                root = resolve_call_root(
+                    dec.func if isinstance(dec, ast.Call) else dec, imports
+                )
+                if root in _JIT_NAMES:
+                    site = JitSite(
+                        module=module, lineno=node.lineno, target=None,
+                        decorated=node,
+                        enclosing=enclosing_of.get(id(node), ""),
+                    )
+                    if isinstance(dec, ast.Call):
+                        site.jit_call = dec
+                        _apply_statics(site, dec)
+                    sites.append(site)
+                elif isinstance(dec, ast.Call) and _is_partial_of_jit(dec, imports):
+                    site = JitSite(
+                        module=module, lineno=node.lineno, target=None,
+                        decorated=node, jit_call=dec,
+                        enclosing=enclosing_of.get(id(node), ""),
+                    )
+                    _apply_statics(site, dec)
+                    sites.append(site)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        root = resolve_call_root(node.func, imports)
+        if root in _JIT_NAMES and node.args:
+            site = JitSite(
+                module=module, lineno=node.lineno,
+                target=_unwrap_target(node.args[0], imports, module.tree),
+                jit_call=node,
+                enclosing=enclosing_of.get(id(node), ""),
+            )
+            _apply_statics(site, node)
+            sites.append(site)
+        elif (
+            isinstance(node.func, ast.Call)
+            and _is_partial_of_jit(node.func, imports)
+            and node.args
+        ):
+            # partial(jax.jit, ...)(fn)
+            site = JitSite(
+                module=module, lineno=node.lineno,
+                target=_unwrap_target(node.args[0], imports, module.tree),
+                jit_call=node.func,
+                enclosing=enclosing_of.get(id(node), ""),
+            )
+            _apply_statics(site, node.func)
+            sites.append(site)
+    return sites

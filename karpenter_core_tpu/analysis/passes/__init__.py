@@ -1,0 +1,18 @@
+"""Pass registry for tools/kcanalyze.py.
+
+Each pass module exposes ``NAME`` (the kebab-case pass id used in findings
+and baseline entries) and ``run(project) -> list[Finding]``.  To add a pass,
+write the module, append it here, and document it in docs/ANALYSIS.md.
+"""
+
+from karpenter_core_tpu.analysis.passes import (
+    hygiene,
+    instrumented,
+    lock_order,
+    retrace_budget,
+    trace_safety,
+)
+
+ALL_PASSES = [trace_safety, retrace_budget, lock_order, hygiene, instrumented]
+
+__all__ = ["ALL_PASSES"]

@@ -1,0 +1,95 @@
+"""instrumented: every controller ``reconcile`` opens a tracing span.
+
+Port of tools/check_instrumented.py onto the framework (that script is now
+a thin CLI over this pass).  A controller class — one carrying a literal
+string ``name`` attribute, the operator registration contract — must have
+its ``reconcile`` either decorated with ``@tracing.traced(...)`` /
+``@traced(...)`` or contain a ``with tracing.span(...)`` / ``with
+span(...)`` block, so new controllers cannot ship invisible to
+/debug/traces and the stage histograms.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Tuple
+
+from karpenter_core_tpu.analysis.core import Finding, Project, SourceModule
+
+NAME = "instrumented"
+
+
+def _is_span_call(call: ast.expr) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    func = call.func
+    if isinstance(func, ast.Name):
+        return func.id == "span"
+    if isinstance(func, ast.Attribute):
+        return func.attr == "span"
+    return False
+
+
+def _is_traced_decorator(node: ast.expr) -> bool:
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Name):
+        return node.id == "traced"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "traced"
+    return False
+
+
+def _opens_span(fn: ast.FunctionDef) -> bool:
+    if any(_is_traced_decorator(d) for d in fn.decorator_list):
+        return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.With):
+            if any(_is_span_call(item.context_expr) for item in node.items):
+                return True
+    return False
+
+
+def _controller_classes(tree: ast.Module) -> Iterator[Tuple[ast.ClassDef, str]]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "name"
+                    for t in stmt.targets
+                )
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)
+            ):
+                yield node, stmt.value.value
+                break
+
+
+def check_module(module: SourceModule) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls, controller_name in _controller_classes(module.tree):
+        for stmt in cls.body:
+            if isinstance(stmt, ast.FunctionDef) and stmt.name == "reconcile":
+                if not _opens_span(stmt):
+                    findings.append(Finding(
+                        module.relpath, stmt.lineno, "uninstrumented-reconcile",
+                        f"controller {controller_name!r} ({cls.name}."
+                        "reconcile) opens no tracing span — decorate with "
+                        "@tracing.traced(...) or wrap the body in "
+                        "`with tracing.span(...)`",
+                        NAME, symbol=f"{cls.name}.reconcile",
+                    ))
+    return findings
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    prefix = f"{project.package}.controllers"
+    for module in project.package_modules:
+        if module.name == prefix or module.name.startswith(prefix + "."):
+            findings.extend(check_module(module))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,356 @@
+"""lock-order: deadlock and lock-hygiene analysis for the threaded operator.
+
+PR 2's durability work surfaced three latent concurrency bugs by accident;
+this pass makes the statically-visible classes un-shippable:
+
+  lock-order          inconsistent pairwise acquisition order: some code
+                      path takes A then B while another takes B then A
+                      (classic ABBA deadlock), intra- or inter-procedural
+                      through resolvable calls
+  self-deadlock       a non-reentrant ``threading.Lock`` acquired while the
+                      same lock is already held on the call path (RLocks
+                      are exempt — re-entry is their point)
+  blocking-under-lock a blocking call (``.result()``, ``.wait()``,
+                      ``sleep``, subprocess, socket/HTTP,
+                      ``block_until_ready``, bare ``.join()``) made while
+                      holding a lock, directly or through a resolvable
+                      callee — every other thread needing that lock stalls
+                      for the full IO/timeout
+  lock-no-with        ``.acquire()`` / ``.release()`` on a known lock
+                      instead of ``with`` — an exception between the two
+                      leaks the lock forever
+
+Lock identity: module-global ``X = threading.Lock()`` assignments
+(``module:X``) and ``self.X = threading.Lock()`` instance attributes
+(``module:Class.X``).  Call resolution mirrors the call graph's
+conservative rules — ``self.method()``, module functions, imported package
+functions; duck-typed attribute calls (e.g. reflector callbacks) are
+invisible, so a clean report is necessary, not sufficient.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from karpenter_core_tpu.analysis.callgraph import (
+    CallGraph,
+    FunctionInfo,
+    shared_graph,
+)
+from karpenter_core_tpu.analysis.core import (
+    Finding,
+    Project,
+    import_map,
+    resolve_call_root,
+)
+
+NAME = "lock-order"
+
+_BLOCKING_ROOTS = {
+    "time.sleep", "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "urllib.request.urlopen", "socket.create_connection",
+    "jax.block_until_ready", "jax.device_get",
+}
+_BLOCKING_BARE = {"sleep", "urlopen"}
+_BLOCKING_METHODS = {
+    "result", "wait", "sleep", "block_until_ready", "urlopen", "join",
+    "request", "stream", "readline", "recv", "accept", "getresponse",
+}
+
+
+@dataclass(frozen=True)
+class LockDef:
+    key: str  # "module:X" or "module:Class.X"
+    reentrant: bool
+    path: str
+    line: int
+
+
+def _find_locks(project: Project) -> Dict[str, LockDef]:
+    locks: Dict[str, LockDef] = {}
+    for module in project.package_modules:
+        imports = import_map(module.tree)
+
+        def lock_ctor(value: ast.expr) -> Optional[bool]:
+            """True/False = RLock/Lock constructor, None = not a lock."""
+            if not isinstance(value, ast.Call):
+                return None
+            root = resolve_call_root(value.func, imports)
+            if root in ("threading.RLock",):
+                return True
+            if root in ("threading.Lock", "threading.Semaphore",
+                        "threading.BoundedSemaphore", "multiprocessing.Lock"):
+                return False
+            return None
+
+        # module-level locks
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+                isinstance(node.targets[0], ast.Name)
+            ):
+                r = lock_ctor(node.value)
+                if r is not None:
+                    key = f"{module.name}:{node.targets[0].id}"
+                    locks[key] = LockDef(key, r, module.relpath, node.lineno)
+        # instance locks (self.X = threading.Lock() anywhere in a class)
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        r = lock_ctor(node.value)
+                        if r is not None:
+                            key = f"{module.name}:{cls.name}.{t.attr}"
+                            locks[key] = LockDef(
+                                key, r, module.relpath, node.lineno
+                            )
+    return locks
+
+
+class _FnLockWalker:
+    """Per-function facts: lock acquisitions (with held-set at that point),
+    blocking calls under locks, resolvable calls under locks, raw
+    acquire/release."""
+
+    def __init__(self, info: FunctionInfo, graph: CallGraph,
+                 locks: Dict[str, LockDef], imports: Dict[str, str]) -> None:
+        self.info = info
+        self.graph = graph
+        self.locks = locks
+        self.imports = imports
+        self.held: List[str] = []
+        # (held_tuple, acquired, line)
+        self.acquisitions: List[Tuple[Tuple[str, ...], str, int]] = []
+        # (held_tuple, callee_key, line)
+        self.calls: List[Tuple[Tuple[str, ...], str, int]] = []
+        # (held_tuple, description, line)
+        self.blocking: List[Tuple[Tuple[str, ...], str, int]] = []
+        # every direct blocking call, held or not — the transitive
+        # blocking-under-lock analysis consumes these (nested function
+        # bodies excluded: DEFINING a sleeping closure is not sleeping)
+        self.direct_blocking: List[Tuple[str, int]] = []
+        self.raw: List[Tuple[str, str, int]] = []  # (lock, op, line)
+        self._nested = {
+            id(self.graph.functions[k].node) for k in info.children
+        }
+
+    def lock_of(self, expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            key = f"{self.info.module.name}:{expr.id}"
+            return key if key in self.locks else None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and self.info.cls is not None
+        ):
+            key = f"{self.info.module.name}:{self.info.cls}.{expr.attr}"
+            return key if key in self.locks else None
+        return None
+
+    def run(self) -> "_FnLockWalker":
+        body = self.info.node.body
+        for stmt in body if isinstance(body, list) else [body]:
+            self._walk(stmt)
+        return self
+
+    def _walk(self, node: ast.AST) -> None:
+        if id(node) in self._nested:
+            return
+        if isinstance(node, ast.With):
+            taken: List[str] = []
+            for item in node.items:
+                lock = self.lock_of(item.context_expr)
+                if lock is not None:
+                    self.acquisitions.append((tuple(self.held), lock, node.lineno))
+                    self.held.append(lock)
+                    taken.append(lock)
+                else:
+                    self._walk(item.context_expr)
+            for stmt in node.body:
+                self._walk(stmt)
+            for lock in reversed(taken):
+                self.held.remove(lock)
+            return
+        if isinstance(node, ast.Call):
+            self._check_call(node)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+    def _check_call(self, node: ast.Call) -> None:
+        func = node.func
+        # raw acquire/release on a known lock
+        if isinstance(func, ast.Attribute) and func.attr in ("acquire", "release"):
+            lock = self.lock_of(func.value)
+            if lock is not None:
+                self.raw.append((lock, func.attr, node.lineno))
+                return
+        held = tuple(self.held)
+        desc = None
+        root = resolve_call_root(func, self.imports)
+        if root in _BLOCKING_ROOTS or (
+            isinstance(func, ast.Name) and func.id in _BLOCKING_BARE
+        ):
+            desc = f"{root or func.id}()"
+        elif isinstance(func, ast.Attribute) and func.attr in _BLOCKING_METHODS:
+            if not (func.attr == "join" and node.args):
+                # "sep".join(parts) is not a thread join; everything else
+                # matching the method list counts
+                desc = f".{func.attr}()"
+        if desc is not None:
+            self.direct_blocking.append((desc, node.lineno))
+            if held:
+                self.blocking.append((held, desc, node.lineno))
+            return
+        callee = self.graph.resolve(func, self.info.module, self.info)
+        if callee is not None:
+            self.calls.append((held, callee, node.lineno))
+
+
+def run(project: Project) -> List[Finding]:
+    graph = shared_graph(project)
+    locks = _find_locks(project)
+    findings: List[Finding] = []
+    if not locks:
+        return findings
+
+    walkers: Dict[str, _FnLockWalker] = {}
+    imports_cache: Dict[str, Dict[str, str]] = {}
+    for key, info in graph.functions.items():
+        imports = imports_cache.setdefault(
+            info.module.name, import_map(info.module.tree)
+        )
+        walkers[key] = _FnLockWalker(info, graph, locks, imports).run()
+
+    # transitive lock acquisitions per function (fixpoint over DFS w/ memo)
+    acq_memo: Dict[str, Set[str]] = {}
+
+    def acquires(key: str, stack: Set[str]) -> Set[str]:
+        if key in acq_memo:
+            return acq_memo[key]
+        if key in stack:
+            return set()
+        stack = stack | {key}
+        w = walkers.get(key)
+        if w is None:
+            return set()
+        out = {lock for _, lock, _ in w.acquisitions}
+        for _, callee, _ in w.calls:
+            out |= acquires(callee, stack)
+        acq_memo[key] = out
+        return out
+
+    # transitive blocking behavior per function: first witness
+    blk_memo: Dict[str, Optional[Tuple[str, str, int]]] = {}
+
+    def blocks(key: str, stack: Set[str]) -> Optional[Tuple[str, str, int]]:
+        """(description, path, line) of a blocking call this function makes
+        with NO lock of its own needed — used for callee chains."""
+        if key in blk_memo:
+            return blk_memo[key]
+        if key in stack:
+            return None
+        stack = stack | {key}
+        w = walkers.get(key)
+        if w is None:
+            return None
+        info = graph.functions[key]
+        if w.direct_blocking:
+            desc, line = w.direct_blocking[0]
+            result = (desc, info.module.relpath, line)
+            blk_memo[key] = result
+            return result
+        for _held, callee, _line in w.calls:
+            sub = blocks(callee, stack)
+            if sub is not None:
+                blk_memo[key] = sub
+                return sub
+        blk_memo[key] = None
+        return None
+
+    # -- pairwise order + direct findings -------------------------------------
+    pair_witness: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def record_pair(a: str, b: str, path: str, line: int, fn: str) -> None:
+        if a == b:
+            return
+        pair_witness.setdefault((a, b), (path, line, fn))
+
+    for key, w in walkers.items():
+        info = graph.functions[key]
+        for held, lock, line in w.acquisitions:
+            for h in held:
+                record_pair(h, lock, info.module.relpath, line, info.qualname)
+            if lock in held and not locks[lock].reentrant:
+                findings.append(Finding(
+                    info.module.relpath, line, "self-deadlock",
+                    f"non-reentrant lock {lock!r} acquired while already "
+                    "held on this path — this deadlocks; use an RLock or "
+                    "restructure",
+                    NAME, symbol=info.qualname,
+                ))
+        for held, desc, line in w.blocking:
+            findings.append(Finding(
+                info.module.relpath, line, "blocking-under-lock",
+                f"blocking call {desc} while holding "
+                f"{', '.join(repr(h) for h in held)} — every thread needing "
+                "the lock stalls for the full IO/timeout; move the slow work "
+                "outside the critical section",
+                NAME, symbol=info.qualname,
+            ))
+        for lock, op, line in w.raw:
+            findings.append(Finding(
+                info.module.relpath, line, "lock-no-with",
+                f"{lock!r}.{op}() outside a with-statement: an exception "
+                "between acquire and release leaks the lock — use "
+                "`with lock:`",
+                NAME, symbol=info.qualname,
+            ))
+        # interprocedural: callee acquisitions + callee blocking under held
+        for held, callee, line in w.calls:
+            if not held:
+                continue
+            for m in sorted(acquires(callee, set())):
+                for h in held:
+                    record_pair(h, m, info.module.relpath, line, info.qualname)
+                if m in held and not locks[m].reentrant:
+                    findings.append(Finding(
+                        info.module.relpath, line, "self-deadlock",
+                        f"call into {graph.functions[callee].qualname!r} "
+                        f"re-acquires non-reentrant lock {m!r} already held "
+                        "here — this deadlocks",
+                        NAME, symbol=info.qualname,
+                    ))
+            sub = blocks(callee, set())
+            if sub is not None:
+                desc, spath, sline = sub
+                findings.append(Finding(
+                    info.module.relpath, line, "blocking-under-lock",
+                    f"call into {graph.functions[callee].qualname!r} blocks "
+                    f"({desc} at {spath}:{sline}) while holding "
+                    f"{', '.join(repr(h) for h in held)}",
+                    NAME, symbol=info.qualname,
+                ))
+
+    # -- ABBA inversions -------------------------------------------------------
+    for (a, b), (path, line, fn) in sorted(pair_witness.items()):
+        if a < b and (b, a) in pair_witness:
+            rpath, rline, rfn = pair_witness[(b, a)]
+            findings.append(Finding(
+                path, line, "lock-order",
+                f"inconsistent acquisition order: {a!r} -> {b!r} here "
+                f"(in {fn}) but {b!r} -> {a!r} at {rpath}:{rline} "
+                f"(in {rfn}) — pick one global order",
+                NAME, symbol=fn,
+            ))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,377 @@
+"""retrace-budget: static jit-declaration consistency with the compile cache.
+
+The compile cache (utils/compilecache.py) keys executables on a tuple of
+static config fields; ``jax.jit`` keys its own cache on static_argnums /
+static_argnames.  The two drift independently, and each direction of drift
+is a distinct production bug:
+
+  static-args       a compile-cache key field that is a parameter of a
+                    jitted solve entry but is NOT declared static there —
+                    jit would trace it as an array (wrong program) or
+                    silently key a retrace per value
+  cache-key-drift   a static_argname of a solve jit site that is also a
+                    ``solve_callable`` parameter but does NOT appear in the
+                    compile-cache key — two configs would collide on one
+                    memoized executable (silent wrong reuse)
+  non-literal-static  static_argnums/static_argnames computed at runtime:
+                    unauditable, and typo'd names fail only when the site
+                    first runs
+  unknown-static    a declared static name that is not a parameter of the
+                    jitted target (typo — jax raises only on first call)
+  unhashable-static a dict/list/set literal passed for a static parameter
+                    at a call site of a known jitted wrapper, or a static
+                    parameter whose default is a mutable literal — jit
+                    raises ``unhashable type`` at solve time
+  uncached-jit      ``jax.jit(...)`` constructed inside a function that is
+                    not memoized (lru_cache): every call builds a fresh
+                    wrapper with an empty jit cache, so every call retraces
+                    (the bug class ops.consolidate._sharded_sweep_fn's
+                    docstring describes)
+
+The runtime half of this pass lives in tests/conftest.py: a fixture counts
+actual XLA compilations per tier-1 test against the checked-in manifest
+``karpenter_core_tpu/analysis/retrace_budget.json``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from karpenter_core_tpu.analysis.callgraph import shared_graph
+from karpenter_core_tpu.analysis.core import (
+    Finding,
+    Project,
+    SourceModule,
+    import_map,
+    resolve_call_root,
+)
+from karpenter_core_tpu.analysis.jitsites import (
+    JitSite,
+    _PARTIAL_NAMES,
+    find_jit_sites,
+)
+
+NAME = "retrace-budget"
+
+_MEMO_DECORATORS = {
+    "functools.lru_cache", "lru_cache", "functools.cache", "cache",
+}
+_MUTABLE_LITERALS = (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp,
+                     ast.SetComp)
+
+
+def _params(fn: ast.AST) -> List[str]:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return []
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+
+
+def _param_defaults(fn: ast.AST) -> Dict[str, ast.expr]:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return {}
+    a = fn.args
+    out: Dict[str, ast.expr] = {}
+    pos = a.posonlyargs + a.args
+    for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        out[p.arg] = d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            out[p.arg] = d
+    return out
+
+
+def _fn_index(module: SourceModule) -> Dict[str, ast.AST]:
+    """qualname -> FunctionDef for the module (dotted by nesting)."""
+    out: Dict[str, ast.AST] = {}
+
+    def walk(node: ast.AST, qual: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[".".join(qual + [child.name])] = child
+                walk(child, qual + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                walk(child, qual + [child.name])
+            else:
+                walk(child, qual)
+
+    walk(module.tree, [])
+    return out
+
+
+def _static_key_names(expr: ast.expr) -> Set[str]:
+    """Parameter names the cache key STATICALLY keys on.  Names inside
+    helper calls other than ``tuple(...)`` are excluded: ``_leaf_sig(cls)``
+    keys on shapes/dtypes — those stay runtime (traced) arguments, only the
+    directly-embedded config values are static."""
+    out: Set[str] = set()
+
+    def walk(node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id == "tuple":
+                for a in node.args:
+                    walk(a)
+            return
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(expr)
+    return out
+
+
+def cache_key_fields(project: Project) -> Tuple[Set[str], Optional[SourceModule]]:
+    """Parameter names of ``solve_callable`` referenced by its ``key = (...)``
+    expression — the compile-cache's static config axis.  Empty when the
+    project has no compilecache module (temp trees in tests)."""
+    mod = project.get(f"{project.package}.utils.compilecache")
+    if mod is None:
+        return set(), None
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node.name == "solve_callable"
+        ):
+            params = set(_params(node))
+            for stmt in ast.walk(node):
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "key"
+                ):
+                    used = _static_key_names(stmt.value)
+                    return used & params, mod
+    return set(), mod
+
+
+def solve_callable_params(project: Project) -> Set[str]:
+    mod = project.get(f"{project.package}.utils.compilecache")
+    if mod is None:
+        return set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node.name == "solve_callable"
+        ):
+            return set(_params(node))
+    return set()
+
+
+def _target_binds(site: JitSite, imports: Dict[str, str]) -> Tuple[bool, Set[str]]:
+    """(went_through_partial, kwarg names bound by partial wrappers) for the
+    site's ORIGINAL (pre-unwrap) target expression."""
+    if site.jit_call is None or not getattr(site.jit_call, "args", None):
+        return False, set()
+    expr = site.jit_call.args[0]
+    via_partial = False
+    bound: Set[str] = set()
+    while isinstance(expr, ast.Call):
+        root = resolve_call_root(expr.func, imports)
+        if root in _PARTIAL_NAMES and expr.args:
+            via_partial = True
+            bound |= {kw.arg for kw in expr.keywords if kw.arg}
+            expr = expr.args[0]
+            continue
+        if root in ("jax.vmap", "vmap") and expr.args:
+            expr = expr.args[0]
+            continue
+        break
+    return via_partial, bound
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    graph = shared_graph(project)
+    key_fields, cc_mod = cache_key_fields(project)
+    sc_params = solve_callable_params(project)
+    solve_core_key = f"{project.package}.ops.solve:solve_core"
+
+    # wrapper name -> (static names, target params) for unhashable checks
+    wrappers: Dict[str, Tuple[Tuple[str, ...], List[str]]] = {}
+
+    for module in project.package_modules:
+        imports = import_map(module.tree)
+        fn_index = _fn_index(module)
+        sites = find_jit_sites(module)
+        for site in sites:
+            statics = tuple(site.static_argnames or ())
+            # resolve the jitted function node
+            if site.decorated is not None:
+                target_node: Optional[ast.AST] = site.decorated
+                target_key = graph.key_for_node(site.decorated)
+            elif site.target is not None:
+                if isinstance(site.target, ast.Lambda):
+                    target_node = site.target
+                    target_key = graph.key_for_node(site.target)
+                else:
+                    target_key = graph.resolve(site.target, module)
+                    target_node = (
+                        graph.functions[target_key].node
+                        if target_key in graph.functions
+                        else None
+                    )
+            else:
+                target_node, target_key = None, None
+
+            if site.non_literal_statics:
+                findings.append(Finding(
+                    module.relpath, site.lineno, "non-literal-static",
+                    "static_argnums/static_argnames must be literal "
+                    "constants so the declaration is auditable",
+                    NAME, symbol=site.enclosing,
+                ))
+
+            target_params = _params(target_node) if target_node is not None else []
+            if target_node is not None and statics:
+                for name in statics:
+                    if name not in target_params:
+                        findings.append(Finding(
+                            module.relpath, site.lineno, "unknown-static",
+                            f"static_argnames entry {name!r} is not a "
+                            "parameter of the jitted function",
+                            NAME, symbol=site.enclosing,
+                        ))
+                defaults = _param_defaults(target_node)
+                for name in statics:
+                    d = defaults.get(name)
+                    if d is not None and isinstance(d, _MUTABLE_LITERALS):
+                        findings.append(Finding(
+                            module.relpath, site.lineno, "unhashable-static",
+                            f"static parameter {name!r} defaults to a "
+                            "mutable literal; jit raises 'unhashable type' "
+                            "when the default is used",
+                            NAME, symbol=site.enclosing,
+                        ))
+
+            # consistency with the compile-cache key, both directions
+            if key_fields and target_node is not None:
+                relevant = target_key == solve_core_key or bool(
+                    set(statics) & key_fields
+                )
+                if relevant:
+                    via_partial, bound = _target_binds(site, imports)
+                    static_nums = site.static_argnums or ()
+                    by_pos = {
+                        target_params[i]
+                        for i in static_nums
+                        if 0 <= i < len(target_params)
+                    }
+                    declared = set(statics) | by_pos | bound
+                    defaults = _param_defaults(target_node)
+                    for f in sorted(key_fields & set(target_params)):
+                        if f in declared:
+                            continue
+                        if via_partial and f in defaults:
+                            # partial-built wrapper: the field stays at its
+                            # python default, which is a trace-time constant
+                            continue
+                        findings.append(Finding(
+                            module.relpath, site.lineno, "static-args",
+                            f"compile-cache key field {f!r} is a runtime "
+                            "argument at this jit site — declare it in "
+                            "static_argnames or bind it via partial",
+                            NAME, symbol=site.enclosing,
+                        ))
+                    if cc_mod is not None:
+                        for name in sorted(set(statics) & sc_params - key_fields):
+                            findings.append(Finding(
+                                module.relpath, site.lineno, "cache-key-drift",
+                                f"static arg {name!r} is a solve_callable "
+                                "parameter but absent from the compile-cache "
+                                "key tuple — distinct configs would share "
+                                "one memoized executable "
+                                f"({cc_mod.relpath})",
+                                NAME, symbol=site.enclosing,
+                            ))
+
+            # per-call jit construction
+            if site.enclosing:
+                enclosing_fn = fn_index.get(site.enclosing)
+                memoized = False
+                if enclosing_fn is not None:
+                    for dec in enclosing_fn.decorator_list:
+                        droot = resolve_call_root(
+                            dec.func if isinstance(dec, ast.Call) else dec,
+                            imports,
+                        )
+                        if droot in _MEMO_DECORATORS:
+                            memoized = True
+                if not memoized:
+                    findings.append(Finding(
+                        module.relpath, site.lineno, "uncached-jit",
+                        "jax.jit constructed per call inside "
+                        f"{site.enclosing!r}: each call gets a fresh wrapper "
+                        "with an empty jit cache and retraces — memoize the "
+                        "builder (functools.lru_cache) or hoist to module "
+                        "scope",
+                        NAME, symbol=site.enclosing,
+                    ))
+
+            # record module-level wrapper assignments for call-site checks
+            if statics and site.decorated is None and not site.enclosing:
+                parent = _assign_name_for(module.tree, site)
+                if parent:
+                    wrappers[f"{module.name}.{parent}"] = (statics, target_params)
+            elif statics and site.decorated is not None:
+                qual = getattr(site.decorated, "name", "")
+                if qual and not site.enclosing:
+                    wrappers[f"{module.name}.{qual}"] = (statics, target_params)
+
+    # unhashable literals at call sites of known jitted wrappers
+    for module in project.package_modules:
+        imports = import_map(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            root = resolve_call_root(node.func, imports)
+            if root is None:
+                continue
+            hit = wrappers.get(root)
+            if hit is None and "." not in root:
+                hit = wrappers.get(f"{module.name}.{root}")
+            if hit is None:
+                continue
+            statics, target_params = hit
+            for kw in node.keywords:
+                if kw.arg in statics and isinstance(kw.value, _MUTABLE_LITERALS):
+                    findings.append(Finding(
+                        module.relpath, node.lineno, "unhashable-static",
+                        f"static arg {kw.arg!r} receives a mutable literal "
+                        f"({type(kw.value).__name__.lower()}); jit raises "
+                        "'unhashable type' — pass a tuple / frozen value",
+                        NAME,
+                    ))
+            for i, arg in enumerate(node.args):
+                if i < len(target_params) and target_params[i] in statics and (
+                    isinstance(arg, _MUTABLE_LITERALS)
+                ):
+                    findings.append(Finding(
+                        module.relpath, node.lineno, "unhashable-static",
+                        f"static arg {target_params[i]!r} receives a mutable "
+                        "literal; jit raises 'unhashable type' — pass a "
+                        "tuple / frozen value",
+                        NAME,
+                    ))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def _assign_name_for(tree: ast.Module, site: JitSite) -> Optional[str]:
+    """Name a module-level ``X = jax.jit(...)`` / ``X = partial(jax.jit,
+    ...)(...)`` assignment binds, when the site is such a value."""
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        if not isinstance(node.targets[0], ast.Name):
+            continue
+        for sub in ast.walk(node.value):
+            if sub is site.jit_call or (
+                getattr(sub, "lineno", None) == site.lineno
+                and isinstance(sub, ast.Call)
+                and sub is node.value
+            ):
+                return node.targets[0].id
+    return None

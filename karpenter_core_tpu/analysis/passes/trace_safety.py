@@ -1,0 +1,250 @@
+"""trace-safety: host syncs and trace breakers inside jit-reachable code.
+
+Computes the set of functions reachable from every ``jax.jit`` entry point
+in the package (ops/solve.py, ops/masks.py, ops/consolidate.py,
+parallel/mesh.py, the compile-cache lambdas — discovery is package-wide, the
+named modules are just where the entries live today) and flags, inside that
+set:
+
+  host-sync      ``.item()`` / ``.tolist()`` / ``np.asarray`` / ``np.array``
+                 / ``jax.device_get`` / ``block_until_ready`` / ``float()``
+                 / ``int()`` / ``bool()`` applied to a traced value (a
+                 ``jnp``/``jax`` call result, directly or through a local
+                 assignment)
+  trace-branch   Python ``if``/``while`` whose test is a traced value
+                 (where detectable by the same taint rule)
+  host-effect    wall-clock (``time.*``), ``print``, and logging calls —
+                 these run at TRACE time, not run time, so they lie about
+                 when they execute and differ under retrace
+  try-in-trace   ``try/except`` around traced ops — tracer errors escape
+                 the except at trace time while runtime errors never reach
+                 it, so the handler is dead either way
+
+One accidental host sync in this set turns the 1.27 s warm solve back into
+a 30 s retrace-and-block (PR 3); nothing at runtime catches it because the
+result is still *correct*.
+
+The taint rule is deliberately shallow (calls rooted at jnp/jax aliases,
+propagated through simple ``name = <tainted>`` assignments in the same
+function): parameters of transitively-reached helpers may be static python
+values (e.g. the mask width in ops/masks.py), so "any parameter is traced"
+would drown the signal in false positives.  Real-but-undetectable syncs are
+the retrace-budget fixture's job to catch at runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from karpenter_core_tpu.analysis.callgraph import (
+    CallGraph,
+    FunctionInfo,
+    shared_graph,
+)
+from karpenter_core_tpu.analysis.core import (
+    Finding,
+    Project,
+    import_map,
+    resolve_call_root,
+)
+from karpenter_core_tpu.analysis.jitsites import find_jit_sites
+
+NAME = "trace-safety"
+
+_SYNC_ATTRS = {"item", "tolist"}
+_SYNC_CALLS = {
+    "numpy.asarray", "numpy.array", "jax.device_get", "jax.block_until_ready",
+}
+_CAST_BUILTINS = {"float", "int", "bool"}
+_TIME_ROOT = "time"
+_LOG_ROOTS = {"logging"}
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical"}
+_TRACED_ROOTS = ("jax.numpy", "jax.lax", "jax.nn", "jax.random", "jax.scipy", "jax")
+# jax.* calls that do NOT produce/consume runtime-traced values
+_TRACED_EXEMPT = {
+    "jax.numpy.dtype", "jax.tree_util.tree_map", "jax.tree_util.tree_leaves",
+}
+
+
+def _norm_numpy(root: str) -> str:
+    return "numpy" + root[2:] if root == "np" or root.startswith("np.") else root
+
+
+def _is_traced_call(node: ast.expr, imports: Dict[str, str]) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    root = resolve_call_root(node.func, imports)
+    if root is None or root in _TRACED_EXEMPT:
+        return False
+    return any(root == r or root.startswith(r + ".") for r in _TRACED_ROOTS)
+
+
+class _FnChecker:
+    def __init__(self, info: FunctionInfo, imports: Dict[str, str]) -> None:
+        self.info = info
+        self.imports = imports
+        self.findings: List[Finding] = []
+        self.tainted: Set[str] = set()
+        self._nested = set()
+
+    def _finding(self, node: ast.AST, rule: str, detail: str) -> None:
+        self.findings.append(
+            Finding(
+                self.info.module.relpath, getattr(node, "lineno", 0), rule,
+                detail, NAME, symbol=self.info.qualname,
+            )
+        )
+
+    def _expr_tainted(self, node: ast.expr) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                return True
+            if _is_traced_call(sub, self.imports):
+                return True
+        return False
+
+    def run(self, nested_nodes) -> List[Finding]:
+        self._nested = {id(n) for n in nested_nodes}
+        body = self.info.node.body
+        for stmt in body if isinstance(body, list) else [body]:
+            self._walk(stmt)
+        return self.findings
+
+    def _walk(self, node: ast.AST) -> None:
+        if id(node) in self._nested:
+            return
+        if isinstance(node, ast.Assign):
+            self._walk(node.value)
+            if self._expr_tainted(node.value):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        self.tainted.add(target.id)
+            return
+        if isinstance(node, ast.Try):
+            self._finding(
+                node, "try-in-trace",
+                "try/except around traced ops: tracer errors raise at trace "
+                "time and runtime errors never reach python — hoist the "
+                "fallible host work out of the jitted path",
+            )
+        if isinstance(node, (ast.If, ast.While)):
+            if self._expr_tainted(node.test):
+                self._finding(
+                    node, "trace-branch",
+                    "python branch on a traced value forces a host sync at "
+                    "trace time (ConcretizationTypeError or silent retrace); "
+                    "use jnp.where / lax.cond",
+                )
+        if isinstance(node, ast.Call):
+            self._check_call(node)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+    def _check_call(self, node: ast.Call) -> None:
+        func = node.func
+        root = resolve_call_root(func, self.imports)
+        root = _norm_numpy(root) if root else root
+        # .item() / .tolist() on anything in a traced context
+        if isinstance(func, ast.Attribute) and func.attr in _SYNC_ATTRS:
+            self._finding(
+                node, "host-sync",
+                f".{func.attr}() blocks on the device inside jit-reachable "
+                "code — return the array and convert outside the kernel",
+            )
+            return
+        if isinstance(func, ast.Attribute) and func.attr == "block_until_ready":
+            self._finding(
+                node, "host-sync",
+                "block_until_ready inside jit-reachable code synchronizes "
+                "the device mid-trace",
+            )
+            return
+        if root in _SYNC_CALLS:
+            if root in ("numpy.asarray", "numpy.array"):
+                # np.asarray of host/static data at trace time constant-folds
+                # and is fine; only a traced operand makes it a device fetch
+                if not any(self._expr_tainted(a) for a in node.args):
+                    return
+            self._finding(
+                node, "host-sync",
+                f"{root}(...) fetches a traced value to host inside "
+                "jit-reachable code",
+            )
+            return
+        if (
+            isinstance(func, ast.Name)
+            and func.id in _CAST_BUILTINS
+            and node.args
+            and self._expr_tainted(node.args[0])
+        ):
+            self._finding(
+                node, "host-sync",
+                f"{func.id}() on a traced value blocks on the device "
+                "(ConcretizationTypeError under jit); keep it an array",
+            )
+            return
+        if root is not None:
+            if root == _TIME_ROOT or root.startswith(_TIME_ROOT + "."):
+                self._finding(
+                    node, "host-effect",
+                    f"{root}() runs at trace time, not solve time — timing "
+                    "inside the kernel measures tracing, and the value "
+                    "freezes into the compiled program",
+                )
+                return
+            head = root.split(".")[0]
+            if head in _LOG_ROOTS or (
+                isinstance(func, ast.Attribute)
+                and func.attr in _LOG_METHODS
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("log", "logger", "logging")
+            ):
+                self._finding(
+                    node, "host-effect",
+                    "logging inside jit-reachable code fires once at trace "
+                    "time (use jax.debug.print for runtime values)",
+                )
+                return
+        if isinstance(func, ast.Name) and func.id == "print":
+            self._finding(
+                node, "host-effect",
+                "print inside jit-reachable code fires once at trace time "
+                "(use jax.debug.print)",
+            )
+
+
+def jit_entry_keys(project: Project, graph: CallGraph) -> List[str]:
+    """Function keys of every jax.jit target in the package."""
+    keys: List[str] = []
+    for module in project.package_modules:
+        for site in find_jit_sites(module):
+            if site.decorated is not None:
+                key = graph.key_for_node(site.decorated)
+            elif site.target is not None:
+                if isinstance(site.target, ast.Lambda):
+                    key = graph.key_for_node(site.target)
+                else:
+                    key = graph.resolve(site.target, module)
+            else:
+                key = None
+            if key is not None:
+                keys.append(key)
+    return keys
+
+
+def run(project: Project) -> List[Finding]:
+    graph = shared_graph(project)
+    entries = jit_entry_keys(project, graph)
+    reachable = graph.reachable(entries)
+    findings: List[Finding] = []
+    imports_cache: Dict[str, Dict[str, str]] = {}
+    for key in sorted(reachable):
+        info = graph.functions[key]
+        imports = imports_cache.setdefault(
+            info.module.name, import_map(info.module.tree)
+        )
+        nested = [graph.functions[k].node for k in info.children]
+        findings.extend(_FnChecker(info, imports).run(nested))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

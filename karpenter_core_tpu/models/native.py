@@ -24,50 +24,75 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libkc_runtime.so")
 _lock = threading.Lock()
 _lib: "Optional[ctypes.CDLL]" = None
 _build_failed = False
+# set while one thread runs the (up to 120 s) g++ build outside the lock;
+# latecomers wait on it instead of serializing behind a held mutex
+# (kcanalyze lock-order: blocking-under-lock)
+_in_flight: "Optional[threading.Event]" = None
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _build_failed
-    with _lock:
-        if _lib is not None or _build_failed:
-            return _lib
-        if not os.path.exists(_LIB_PATH):
-            try:
-                subprocess.run(
-                    ["make", "-C", _NATIVE_DIR],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except Exception as e:  # noqa: BLE001 - fall back to numpy
-                log.warning("native runtime build failed, using numpy fallback: %s", e)
+    global _lib, _build_failed, _in_flight
+    while True:
+        with _lock:
+            if _lib is not None or _build_failed:
+                return _lib
+            building = _in_flight
+            if building is None:
+                building = _in_flight = threading.Event()
+                break  # this thread builds
+        building.wait(timeout=180.0)
+    lib = None
+    try:
+        lib = _build_and_load()
+    finally:
+        with _lock:
+            if lib is None:
                 _build_failed = True
-                return None
+            else:
+                _lib = lib
+            _in_flight = None
+        building.set()
+    return lib
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and dlopen the library.  Runs with NO lock held —
+    the subprocess can take up to 120 s and must not stall other threads;
+    the caller holds the in-flight slot, so the build is still run once."""
+    if not os.path.exists(_LIB_PATH):
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError as e:
-            log.warning("native runtime load failed, using numpy fallback: %s", e)
-            _build_failed = True
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception as e:  # noqa: BLE001 - fall back to numpy
+            log.warning("native runtime build failed, using numpy fallback: %s", e)
             return None
-        lib.kc_group_rows.restype = ctypes.c_int64
-        lib.kc_group_rows.argtypes = [
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.c_int64,
-            ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.kc_class_totals.restype = ctypes.c_int64
-        lib.kc_class_totals.argtypes = [
-            ctypes.POINTER(ctypes.c_float),
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int64,
-            ctypes.c_int64,
-            ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_float),
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        _lib = lib
-        return _lib
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as e:
+        log.warning("native runtime load failed, using numpy fallback: %s", e)
+        return None
+    lib.kc_group_rows.restype = ctypes.c_int64
+    lib.kc_group_rows.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.kc_class_totals.restype = ctypes.c_int64
+    lib.kc_class_totals.argtypes = [
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    return lib
 
 
 def available() -> bool:

@@ -153,21 +153,34 @@ def solve_catalog_sharded(
     with mesh:
         cls_dev = jax.device_put(cls_padded, cls_shardings)
         statics_dev = jax.device_put(statics_padded, statics_shardings)
-        fn = jax.jit(
-            functools.partial(
-                solve_ops.solve_core,
-                n_slots=n_slots,
-                key_has_bounds=key_has_bounds,
-                n_passes=snapshot.scan_passes,
-                features=compilecache.snap_features(
-                    solve_ops.snapshot_features(snapshot)
-                ),
-            ),
-            in_shardings=(cls_shardings, statics_shardings),
+        fn = _catalog_solve_fn(
+            key_has_bounds, n_slots, snapshot.scan_passes,
+            compilecache.snap_features(solve_ops.snapshot_features(snapshot)),
+            cls_shardings, statics_shardings,
         )
         out = fn(cls_dev, statics_dev)
         jax.block_until_ready(out)
     return out
+
+
+@functools.lru_cache(maxsize=16)
+def _catalog_solve_fn(key_has_bounds, n_slots: int, n_passes: int, features,
+                      cls_shardings, statics_shardings):
+    """Cached jitted catalog-sharded solve — a fresh ``jax.jit`` per call
+    would defeat JAX's compile cache (keyed on callable identity) and retrace
+    every solve (same pattern as ops.consolidate._sharded_sweep_fn; the
+    sharding pytrees are NamedSharding namedtuples, hashable and
+    mesh-identifying, so they key the cache instead of the mesh itself)."""
+    return jax.jit(
+        functools.partial(
+            solve_ops.solve_core,
+            n_slots=n_slots,
+            key_has_bounds=key_has_bounds,
+            n_passes=n_passes,
+            features=features,
+        ),
+        in_shardings=(cls_shardings, statics_shardings),
+    )
 
 
 def perturb_spot_availability(
@@ -212,32 +225,14 @@ def monte_carlo_solve(
     # Statics tuple can't silently perturb the wrong tensor
     avail_idx = solve_ops.Statics._fields.index("it_avail")
 
-    def one_replica(avail):
-        arrays = list(statics_arrays)
-        arrays[avail_idx] = avail
-        out = solve_ops.solve_core(
-            cls, tuple(arrays), n_slots, key_has_bounds,
-            n_passes=snapshot.scan_passes,
-            features=compilecache.snap_features(
-                solve_ops.snapshot_features(snapshot)
-            ),
-        )
-        scheduled = jnp.sum(out.assign)
-        failed = jnp.sum(out.failed)
-        nodes = jnp.sum((out.state.pod_count > 0).astype(jnp.int32))
-        prices = solve_ops.node_prices(out.state, it_price)
-        cost = jnp.sum(jnp.where(jnp.isfinite(prices), prices, 0.0))
-        return scheduled, failed, nodes, cost
-
-    replicated = NamedSharding(mesh, P())
-    sharded = NamedSharding(mesh, P("replica"))
-    fn = jax.jit(
-        jax.vmap(one_replica),
-        in_shardings=(sharded,),
-        out_shardings=(sharded, sharded, sharded, sharded),
+    fn = _monte_carlo_fn(
+        mesh, key_has_bounds, n_slots, snapshot.scan_passes, avail_idx,
+        compilecache.snap_features(solve_ops.snapshot_features(snapshot)),
     )
     with mesh:
-        scheduled, failed, nodes, cost = fn(avail_r)
+        scheduled, failed, nodes, cost = fn(
+            avail_r, cls, statics_arrays, it_price
+        )
         scheduled, failed, nodes, cost = jax.device_get(
             (scheduled, failed, nodes, cost)
         )
@@ -252,6 +247,36 @@ def monte_carlo_solve(
         "cost_max": float(np.max(cost)),
         "failed_mean": float(np.mean(failed)),
     }
+
+
+@functools.lru_cache(maxsize=16)
+def _monte_carlo_fn(mesh, key_has_bounds, n_slots: int, n_passes: int,
+                    avail_idx: int, features=None):
+    """Cached jitted Monte-Carlo sweep.  The per-replica closure takes the
+    snapshot tensors as ARGUMENTS (not captured values) so the cache key is
+    the static config alone — a fresh closure per call would defeat JAX's
+    compile cache and retrace every study."""
+
+    def one_replica(avail, cls, statics_arrays, it_price):
+        arrays = list(statics_arrays)
+        arrays[avail_idx] = avail
+        out = solve_ops.solve_core(
+            cls, tuple(arrays), n_slots, key_has_bounds,
+            n_passes=n_passes, features=features,
+        )
+        scheduled = jnp.sum(out.assign)
+        failed = jnp.sum(out.failed)
+        nodes = jnp.sum((out.state.pod_count > 0).astype(jnp.int32))
+        prices = solve_ops.node_prices(out.state, it_price)
+        cost = jnp.sum(jnp.where(jnp.isfinite(prices), prices, 0.0))
+        return scheduled, failed, nodes, cost
+
+    sharded = NamedSharding(mesh, P("replica"))
+    return jax.jit(
+        jax.vmap(one_replica, in_axes=(0, None, None, None)),
+        in_shardings=(sharded, None, None, None),
+        out_shardings=(sharded, sharded, sharded, sharded),
+    )
 
 
 @functools.lru_cache(maxsize=16)

@@ -1,0 +1,924 @@
+"""Tests for the kcanalyze static-analysis framework (docs/ANALYSIS.md).
+
+Each rule gets fixture snippets — bad (must fire, with the exact rule at the
+exact file), good (must stay silent), and suppressed (baseline) — plus the
+acceptance demonstration: the driver run against a temp tree seeded with one
+host-sync, one static-arg mismatch, and one ABBA lock inversion exits
+nonzero and names all three, so `make verify` provably fails when any of
+these bug classes is introduced.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from karpenter_core_tpu.analysis.core import (
+    Baseline,
+    BaselineError,
+    Finding,
+    Project,
+    apply_baseline,
+    parse_mini_toml,
+)
+from karpenter_core_tpu.analysis.passes import (
+    hygiene,
+    instrumented,
+    lock_order,
+    retrace_budget,
+    trace_safety,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+MANIFEST_PATH = REPO / "karpenter_core_tpu" / "analysis" / "retrace_budget.json"
+
+
+@pytest.fixture(scope="module")
+def repo_project():
+    """One Project (and one shared call graph) for every current-tree test —
+    rebuilding it per test would re-parse 160+ files five times."""
+    return Project(REPO)
+
+
+@pytest.fixture(scope="module")
+def repo_baseline():
+    return Baseline.load(
+        REPO / "karpenter_core_tpu" / "analysis" / "baseline.toml"
+    )
+
+
+def make_project(tmp_path: Path, files: dict, package: str = "badpkg") -> Project:
+    """Write ``files`` (relpath -> source) under a temp package and load it."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    pkg_init = tmp_path / package / "__init__.py"
+    if not pkg_init.exists():
+        pkg_init.parent.mkdir(parents=True, exist_ok=True)
+        pkg_init.write_text("")
+    return Project(tmp_path, package=package, extra_roots=())
+
+
+def rules_of(findings) -> set:
+    return {f.rule for f in findings}
+
+
+# -- baseline / mini-toml -----------------------------------------------------
+
+
+class TestBaseline:
+    def test_parse_entries(self):
+        entries = parse_mini_toml(
+            '# comment\n'
+            '[[suppress]]\n'
+            'pass = "lock-order"\n'
+            'rule = "blocking-under-lock"\n'
+            'file = "pkg/mod.py"\n'
+            'line = 12\n'
+            'reason = "documented false positive"\n'
+        )
+        assert len(entries) == 1
+        assert entries[0]["pass"] == "lock-order"
+        assert entries[0]["line"] == 12
+
+    def test_reason_required(self):
+        with pytest.raises(BaselineError, match="reason"):
+            Baseline(parse_mini_toml('[[suppress]]\nrule = "tabs"\n'))
+
+    def test_inline_comment_after_quoted_value(self):
+        entries = parse_mini_toml(
+            '[[suppress]]\n'
+            'rule = "host-sync"  # documented FP\n'
+            'line = 3  # pinned\n'
+            'reason = "detail with a # inside"\n'
+        )
+        assert entries[0]["rule"] == "host-sync"
+        assert entries[0]["line"] == 3
+        assert entries[0]["reason"] == "detail with a # inside"
+
+    def test_garbage_after_quoted_value_rejected(self):
+        with pytest.raises(BaselineError, match="trailing"):
+            parse_mini_toml('[[suppress]]\nrule = "x" junk\n')
+
+    def test_match_and_unused(self):
+        baseline = Baseline(parse_mini_toml(
+            '[[suppress]]\nrule = "tabs"\nfile = "a.py"\nreason = "r"\n'
+            '[[suppress]]\nrule = "long-line"\nfile = "b.py"\nreason = "r"\n'
+        ))
+        hit = Finding("a.py", 3, "tabs", "use spaces", "hygiene")
+        miss = Finding("a.py", 3, "trailing-ws", "x", "hygiene")
+        kept, suppressed = apply_baseline([hit, miss], baseline)
+        assert [f.rule for f in kept] == ["trailing-ws"]
+        assert suppressed[0][1] == "r"
+        assert [e["rule"] for e in baseline.unused()] == ["long-line"]
+
+    def test_repo_baseline_parses_with_reasons(self):
+        path = REPO / "karpenter_core_tpu" / "analysis" / "baseline.toml"
+        baseline = Baseline.load(path)
+        for entry in baseline.entries:
+            assert str(entry.get("reason", "")).strip()
+
+
+# -- hygiene ------------------------------------------------------------------
+
+
+class TestHygiene:
+    def test_ported_rules_fire(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/mod.py": """\
+                import os
+                import sys
+
+                def f(x=[]):
+                    try:
+                        return sys.argv
+                    except:
+                        return f"no field"
+            """,
+        })
+        found = hygiene.run(project)
+        assert {"unused-import", "bare-except", "mutable-default",
+                "f-string-no-field"} <= rules_of(found)
+        # the unused import is os, not sys (used in body)
+        unused = [f for f in found if f.rule == "unused-import"]
+        assert len(unused) == 1 and "os" in unused[0].detail
+
+    def test_formatting_rules(self, tmp_path):
+        src = "x = 1 \ny\t= 2\nz = '" + "a" * 130 + "'\n"
+        path = tmp_path / "badpkg" / "fmt.py"
+        path.parent.mkdir(parents=True)
+        path.write_text(src)
+        (tmp_path / "badpkg" / "__init__.py").write_text("")
+        found = hygiene.run(Project(tmp_path, package="badpkg", extra_roots=()))
+        assert {"trailing-ws", "tabs", "long-line"} <= rules_of(found)
+
+    def test_assert_in_package(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/mod.py": "def f(x):\n    assert x > 0\n    return x\n",
+            # the test-harness subtree is exempt
+            "badpkg/testing/helper.py": "def g(x):\n    assert x\n    return x\n",
+        })
+        found = [f for f in hygiene.run(project) if f.rule == "assert-in-package"]
+        assert len(found) == 1
+        assert found[0].path == "badpkg/mod.py"
+
+    def test_wallclock_in_clocked_dirs_only(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/state/cache.py": """\
+                import time
+
+                def now():
+                    return time.time()
+            """,
+            # tracing-style modules outside the reconcile world may read wall
+            "badpkg/tracing/span.py": """\
+                import time
+
+                def wall():
+                    return time.time()
+            """,
+        })
+        found = [f for f in hygiene.run(project) if f.rule == "wallclock"]
+        assert len(found) == 1
+        assert found[0].path == "badpkg/state/cache.py"
+
+    def test_clean_module_silent(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/ok.py": """\
+                import sys
+
+                def f(x=None):
+                    if x is None:
+                        x = []
+                    return (sys.argv, x)
+            """,
+        })
+        assert hygiene.run(project) == []
+
+    def test_current_tree_clean(self, repo_project):
+        """The repo itself stays hygiene-clean (the make-verify contract)."""
+        found = hygiene.run(repo_project)
+        assert found == [], "\n".join(f.render() for f in found)
+
+
+# -- trace safety -------------------------------------------------------------
+
+_TRACE_BAD = """\
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def helper(x):
+        return x.item()
+
+    @jax.jit
+    def kernel(x):
+        total = jnp.sum(x)
+        if jnp.any(x > 0):
+            pass
+        print("tracing")
+        y = np.asarray(total)
+        return float(total) + helper(x) + y
+"""
+
+_TRACE_GOOD = """\
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def host_decode(out):
+        # host-side decode: syncs are fine, this is not jit-reachable
+        return float(np.asarray(out).sum())
+
+    @jax.jit
+    def kernel(x, v=3):
+        vocab = jnp.asarray(np.arange(4))  # static host data: constant-folds
+        return jnp.where(x > 0, x, 0.0) + vocab[v]
+"""
+
+
+class TestTraceSafety:
+    def test_bad_kernel_fires_every_rule(self, tmp_path):
+        project = make_project(tmp_path, {"badpkg/ops.py": _TRACE_BAD})
+        found = trace_safety.run(project)
+        assert {"host-sync", "trace-branch", "host-effect"} <= rules_of(found)
+        # reachability: helper's .item() is found through the call edge
+        helper_hits = [f for f in found if f.symbol == "helper"]
+        assert helper_hits and helper_hits[0].rule == "host-sync"
+        # exact anchoring: float(total) on the tainted sum
+        casts = [f for f in found if "float()" in f.detail]
+        assert casts and casts[0].path == "badpkg/ops.py"
+
+    def test_good_kernel_silent(self, tmp_path):
+        project = make_project(tmp_path, {"badpkg/ops.py": _TRACE_GOOD})
+        assert trace_safety.run(project) == []
+
+    def test_try_in_trace(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/ops.py": """\
+                import jax
+                import jax.numpy as jnp
+
+                @jax.jit
+                def kernel(x):
+                    try:
+                        return jnp.sum(x)
+                    except ValueError:
+                        return x
+            """,
+        })
+        assert "try-in-trace" in rules_of(trace_safety.run(project))
+
+    def test_lambda_and_scan_step_reachable(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/ops.py": """\
+                import jax
+                import jax.numpy as jnp
+
+                def step(carry, x):
+                    jnp.asarray(x).tolist()
+                    return carry, x
+
+                def core(xs):
+                    return jax.lax.scan(step, 0, xs)
+
+                solve = jax.jit(lambda xs: core(xs))
+            """,
+        })
+        found = trace_safety.run(project)
+        assert any(f.rule == "host-sync" and f.symbol == "step" for f in found)
+
+    def test_current_tree_clean(self, repo_project):
+        found = trace_safety.run(repo_project)
+        assert found == [], "\n".join(f.render() for f in found)
+
+
+# -- retrace budget (static) --------------------------------------------------
+
+_CC_FIXTURE = """\
+    import threading
+
+    _lock = threading.Lock()
+    _memo = {}
+
+    def solve_callable(cls, n_slots, key_has_bounds, n_passes=1):
+        key = (n_slots, tuple(key_has_bounds), n_passes, _leaf_sig(cls))
+        return _memo.get(key)
+
+    def _leaf_sig(tree):
+        return ()
+"""
+
+
+class TestRetraceBudgetStatic:
+    def test_missing_static_flagged(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/utils/compilecache.py": _CC_FIXTURE,
+            "badpkg/ops/solve.py": """\
+                import functools
+
+                import jax
+
+                def solve_core(cls, n_slots, key_has_bounds, n_passes=1):
+                    return cls
+
+                _solve_jit = functools.partial(
+                    jax.jit, static_argnames=("n_slots", "key_has_bounds")
+                )(solve_core)
+            """,
+        })
+        found = retrace_budget.run(project)
+        missing = [f for f in found if f.rule == "static-args"]
+        assert len(missing) == 1 and "'n_passes'" in missing[0].detail
+
+    def test_consistent_site_silent(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/utils/compilecache.py": _CC_FIXTURE,
+            "badpkg/ops/solve.py": """\
+                import functools
+
+                import jax
+
+                def solve_core(cls, n_slots, key_has_bounds, n_passes=1):
+                    return cls
+
+                _solve_jit = functools.partial(
+                    jax.jit,
+                    static_argnames=("n_slots", "key_has_bounds", "n_passes"),
+                )(solve_core)
+            """,
+        })
+        assert retrace_budget.run(project) == []
+
+    def test_cache_key_drift_flagged(self, tmp_path):
+        # n_passes is a solve_callable param but NOT in its key tuple:
+        # declaring it static at a solve_core site must flag the drift
+        project = make_project(tmp_path, {
+            "badpkg/utils/compilecache.py": """\
+                _memo = {}
+
+                def solve_callable(cls, n_slots, key_has_bounds, n_passes=1):
+                    key = (n_slots, tuple(key_has_bounds), _leaf_sig(cls))
+                    return _memo.get(key)
+
+                def _leaf_sig(tree):
+                    return ()
+            """,
+            "badpkg/ops/solve.py": """\
+                import functools
+
+                import jax
+
+                def solve_core(cls, n_slots, key_has_bounds, n_passes=1):
+                    return cls
+
+                _solve_jit = functools.partial(
+                    jax.jit,
+                    static_argnames=("n_slots", "key_has_bounds", "n_passes"),
+                )(solve_core)
+            """,
+        })
+        found = retrace_budget.run(project)
+        drift = [f for f in found if f.rule == "cache-key-drift"]
+        assert drift and "'n_passes'" in drift[0].detail
+
+    def test_unknown_and_unhashable_static(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/ops/solve.py": """\
+                import jax
+
+                def core(x, cfg=None):
+                    return x
+
+                wrapped = jax.jit(core, static_argnames=("cfg", "typo"))
+
+                def caller(x):
+                    return wrapped(x, cfg={"a": 1})
+            """,
+        })
+        found = retrace_budget.run(project)
+        assert "unknown-static" in rules_of(found)
+        unhashable = [f for f in found if f.rule == "unhashable-static"]
+        assert unhashable and "'cfg'" in unhashable[0].detail
+
+    def test_uncached_jit_flagged_and_lru_exempt(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/ops/solve.py": """\
+                import functools
+
+                import jax
+
+                def hot(x):
+                    return jax.jit(lambda v: v + 1)(x)
+
+                @functools.lru_cache(maxsize=8)
+                def builder(n):
+                    return jax.jit(lambda v: v + n)
+            """,
+        })
+        found = [f for f in retrace_budget.run(project)
+                 if f.rule == "uncached-jit"]
+        assert len(found) == 1 and found[0].symbol == "hot"
+
+    def test_non_literal_static_flagged(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/ops/solve.py": """\
+                import jax
+
+                NAMES = ("n",)
+
+                def core(x, n=1):
+                    return x
+
+                wrapped = jax.jit(core, static_argnames=NAMES)
+            """,
+        })
+        assert "non-literal-static" in rules_of(retrace_budget.run(project))
+
+    def test_current_tree_only_baselined_findings(self, repo_project,
+                                                  repo_baseline):
+        kept, _ = apply_baseline(retrace_budget.run(repo_project), repo_baseline)
+        assert kept == [], "\n".join(f.render() for f in kept)
+
+
+# -- lock order ---------------------------------------------------------------
+
+_ABBA = """\
+    import threading
+
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def forward():
+        with lock_a:
+            with lock_b:
+                return 1
+
+    def backward():
+        with lock_b:
+            with lock_a:
+                return 2
+"""
+
+
+class TestLockOrder:
+    def test_abba_inversion(self, tmp_path):
+        project = make_project(tmp_path, {"badpkg/locks.py": _ABBA})
+        found = lock_order.run(project)
+        inversions = [f for f in found if f.rule == "lock-order"]
+        assert inversions, found
+        assert "lock_a" in inversions[0].detail and "lock_b" in inversions[0].detail
+
+    def test_abba_through_call_chain(self, tmp_path):
+        # the synthetic deadlock graph: f holds A and calls g (which takes
+        # B); h holds B and calls k (which takes A) — the inversion is only
+        # visible interprocedurally
+        project = make_project(tmp_path, {
+            "badpkg/locks.py": """\
+                import threading
+
+                lock_a = threading.Lock()
+                lock_b = threading.Lock()
+
+                def takes_b():
+                    with lock_b:
+                        return 1
+
+                def takes_a():
+                    with lock_a:
+                        return 2
+
+                def f():
+                    with lock_a:
+                        return takes_b()
+
+                def h():
+                    with lock_b:
+                        return takes_a()
+            """,
+        })
+        found = lock_order.run(project)
+        assert any(f.rule == "lock-order" for f in found), found
+
+    def test_consistent_order_silent(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/locks.py": """\
+                import threading
+
+                lock_a = threading.Lock()
+                lock_b = threading.Lock()
+
+                def one():
+                    with lock_a:
+                        with lock_b:
+                            return 1
+
+                def two():
+                    with lock_a:
+                        with lock_b:
+                            return 2
+            """,
+        })
+        assert [f for f in lock_order.run(project) if f.rule == "lock-order"] == []
+
+    def test_blocking_under_lock(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/mod.py": """\
+                import subprocess
+                import threading
+                import time
+
+                _lock = threading.Lock()
+
+                def build():
+                    with _lock:
+                        subprocess.run(["make"])
+
+                def nap_free():
+                    time.sleep(0.1)  # no lock held: fine
+                    with _lock:
+                        return 1
+            """,
+        })
+        found = [f for f in lock_order.run(project)
+                 if f.rule == "blocking-under-lock"]
+        assert len(found) == 1 and found[0].symbol == "build"
+
+    def test_blocking_through_callee(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/mod.py": """\
+                import threading
+                import time
+
+                _lock = threading.Lock()
+
+                def slow():
+                    time.sleep(1.0)
+
+                def holder():
+                    with _lock:
+                        slow()
+            """,
+        })
+        found = [f for f in lock_order.run(project)
+                 if f.rule == "blocking-under-lock"]
+        assert found and found[0].symbol == "holder"
+
+    def test_blocking_method_through_callee(self, tmp_path):
+        # factoring a .result()/.wait() into a helper must not defeat the gate
+        project = make_project(tmp_path, {
+            "badpkg/mod.py": """\
+                import threading
+
+                _lock = threading.Lock()
+
+                def helper(fut):
+                    return fut.result()
+
+                def holder(fut):
+                    with _lock:
+                        return helper(fut)
+            """,
+        })
+        found = [f for f in lock_order.run(project)
+                 if f.rule == "blocking-under-lock"]
+        assert found and found[0].symbol == "holder", found
+
+    def test_thread_join_under_lock_flagged(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/mod.py": """\
+                import threading
+
+                _lock = threading.Lock()
+
+                def stop(worker, parts):
+                    with _lock:
+                        label = ", ".join(parts)  # str.join: not a stall
+                        worker.join(timeout=5)
+                        return label
+            """,
+        })
+        found = [f for f in lock_order.run(project)
+                 if f.rule == "blocking-under-lock"]
+        assert len(found) == 1 and ".join()" in found[0].detail, found
+
+    def test_defining_sleeping_closure_not_blocking(self, tmp_path):
+        # DEFINING a closure that sleeps is not sleeping: registering delayed
+        # callbacks under a lock must stay clean
+        project = make_project(tmp_path, {
+            "badpkg/mod.py": """\
+                import threading
+                import time
+
+                _lock = threading.Lock()
+
+                def makes_closure():
+                    def callback():
+                        time.sleep(5.0)
+                    return callback
+
+                def holder():
+                    with _lock:
+                        return makes_closure()
+            """,
+        })
+        found = [f for f in lock_order.run(project)
+                 if f.rule == "blocking-under-lock"]
+        assert found == [], found
+
+    def test_self_deadlock_plain_lock_only(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/mod.py": """\
+                import threading
+
+                class Plain:
+                    def __init__(self):
+                        self._mu = threading.Lock()
+
+                    def outer(self):
+                        with self._mu:
+                            return self.inner()
+
+                    def inner(self):
+                        with self._mu:
+                            return 1
+
+                class Reentrant:
+                    def __init__(self):
+                        self._mu = threading.RLock()
+
+                    def outer(self):
+                        with self._mu:
+                            return self.inner()
+
+                    def inner(self):
+                        with self._mu:
+                            return 1
+            """,
+        })
+        found = [f for f in lock_order.run(project) if f.rule == "self-deadlock"]
+        assert len(found) == 1 and "Plain" in found[0].symbol
+
+    def test_lock_no_with(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/mod.py": """\
+                import threading
+
+                _lock = threading.Lock()
+
+                def f():
+                    _lock.acquire()
+                    try:
+                        return 1
+                    finally:
+                        _lock.release()
+            """,
+        })
+        found = [f for f in lock_order.run(project) if f.rule == "lock-no-with"]
+        assert len(found) == 2  # the acquire and the release
+
+    def test_current_tree_clean(self, repo_project, repo_baseline):
+        kept, _ = apply_baseline(lock_order.run(repo_project), repo_baseline)
+        assert kept == [], "\n".join(f.render() for f in kept)
+
+
+# -- instrumented -------------------------------------------------------------
+
+
+class TestInstrumented:
+    def test_uninstrumented_controller_fires(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/controllers/thing.py": """\
+                class ThingController:
+                    name = "thing"
+
+                    def reconcile(self, obj):
+                        return None
+            """,
+        })
+        found = instrumented.run(project)
+        assert rules_of(found) == {"uninstrumented-reconcile"}
+
+    def test_span_and_traced_accepted(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/controllers/thing.py": """\
+                from badpkg import tracing
+
+                class WithSpan:
+                    name = "a"
+
+                    def reconcile(self, obj):
+                        with tracing.span("a.reconcile"):
+                            return None
+
+                class WithDecorator:
+                    name = "b"
+
+                    @tracing.traced("b.reconcile")
+                    def reconcile(self, obj):
+                        return None
+            """,
+        })
+        assert instrumented.run(project) == []
+
+
+# -- the driver (acceptance demonstration) ------------------------------------
+
+_SEEDED_HOST_SYNC = """\
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kernel(x):
+        return float(jnp.sum(x))
+"""
+
+_SEEDED_STATIC_MISMATCH = """\
+    import functools
+
+    import jax
+
+    def solve_core(cls, n_slots, key_has_bounds, n_passes=1):
+        return cls
+
+    _solve_jit = functools.partial(
+        jax.jit, static_argnames=("n_slots",)
+    )(solve_core)
+"""
+
+
+def run_driver(root: Path, *extra: str):
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "kcanalyze.py"),
+         "--root", str(root), "--package", "badpkg", *extra],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+class TestDriver:
+    def test_seeded_tree_fails_with_all_three(self, tmp_path):
+        """One host-sync + one static-arg mismatch + one lock inversion in a
+        temp tree: the driver (hence `make verify`) exits nonzero and names
+        each — introducing any of the three bug classes breaks the build."""
+        make_project(tmp_path, {
+            "badpkg/ops/kernel.py": _SEEDED_HOST_SYNC,
+            "badpkg/ops/solve.py": _SEEDED_STATIC_MISMATCH,
+            "badpkg/utils/compilecache.py": _CC_FIXTURE,
+            "badpkg/locks.py": _ABBA,
+        })
+        proc = run_driver(tmp_path)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "trace-safety/host-sync" in proc.stdout
+        assert "retrace-budget/static-args" in proc.stdout
+        assert "lock-order/lock-order" in proc.stdout
+        assert "FAIL" in proc.stdout
+
+    def test_clean_tree_passes_with_timing(self, tmp_path):
+        make_project(tmp_path, {
+            "badpkg/ok.py": "def f(x):\n    return x\n",
+        })
+        proc = run_driver(tmp_path)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "OK" in proc.stdout
+        # the satellite contract: a timing line for the suite
+        assert "in " in proc.stdout and "s" in proc.stdout
+        assert any("pass trace-safety" in ln for ln in proc.stdout.splitlines())
+
+    def test_baseline_suppresses_documented_finding(self, tmp_path):
+        make_project(tmp_path, {
+            "badpkg/locks.py": _ABBA,
+            "badpkg/analysis/baseline.toml": """\
+                [[suppress]]
+                pass = "lock-order"
+                rule = "lock-order"
+                file = "badpkg/locks.py"
+                reason = "fixture: inversion is intentional in this test tree"
+            """,
+        })
+        proc = run_driver(tmp_path)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "1 suppressed" in proc.stdout
+
+    def test_baseline_without_reason_hard_fails(self, tmp_path):
+        make_project(tmp_path, {
+            "badpkg/ok.py": "def f(x):\n    return x\n",
+            "badpkg/analysis/baseline.toml": (
+                '[[suppress]]\nrule = "tabs"\n'
+            ),
+        })
+        proc = run_driver(tmp_path)
+        assert proc.returncode == 1
+        assert "bad baseline" in proc.stderr
+
+    def test_syntax_error_is_a_finding(self, tmp_path):
+        make_project(tmp_path, {
+            "badpkg/broken.py": "def f(:\n",
+        })
+        proc = run_driver(tmp_path)
+        assert proc.returncode == 1
+        assert "syntax-error" in proc.stdout
+
+    @pytest.mark.slow
+    def test_repo_tree_passes(self):
+        """`python tools/kcanalyze.py` exits 0 on the final tree (the same
+        invocation `make verify` gates on; slow tier because it re-parses
+        the whole repo in a subprocess — the in-process current-tree tests
+        above cover each pass inside the tier-1 budget)."""
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "kcanalyze.py")],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "OK" in proc.stdout
+
+
+# -- retrace budget (runtime manifest) ----------------------------------------
+
+
+class TestRetraceManifest:
+    def test_manifest_shape(self):
+        manifest = json.loads(MANIFEST_PATH.read_text())
+        assert int(manifest["default_budget"]) > 0
+        assert int(manifest["bench_cold_compiles"]) > 0
+        assert isinstance(manifest.get("tests", {}), dict)
+        for nodeid, budget in manifest.get("tests", {}).items():
+            assert nodeid.startswith("tests/"), nodeid
+            assert int(budget) > 0
+
+    def test_fixture_fails_over_budget(self, monkeypatch):
+        """Drive the conftest fixture by hand: a test that 'compiles' more
+        than its budget must fail with the retrace message."""
+        import conftest
+
+        monkeypatch.setitem(conftest._MANIFEST, "tests", {})
+        monkeypatch.setitem(conftest._MANIFEST, "default_budget", 2)
+        monkeypatch.delenv("KC_RETRACE_BUDGET", raising=False)
+        monkeypatch.delenv("KC_RETRACE_RECORD", raising=False)
+
+        class _Node:
+            nodeid = "tests/test_fake.py::test_over"
+
+        class _Request:
+            node = _Node()
+
+        gen = conftest._retrace_budget.__wrapped__(_Request())
+        next(gen)
+        conftest._compile_count["n"] += 3  # over the budget of 2
+        with pytest.raises(pytest.fail.Exception, match="retrace budget"):
+            next(gen)
+
+    def test_fixture_passes_within_budget(self, monkeypatch):
+        import conftest
+
+        monkeypatch.setitem(conftest._MANIFEST, "tests", {})
+        monkeypatch.setitem(conftest._MANIFEST, "default_budget", 10)
+        monkeypatch.delenv("KC_RETRACE_RECORD", raising=False)
+
+        class _Node:
+            nodeid = "tests/test_fake.py::test_ok"
+
+        class _Request:
+            node = _Node()
+
+        gen = conftest._retrace_budget.__wrapped__(_Request())
+        next(gen)
+        conftest._compile_count["n"] += 1
+        with pytest.raises(StopIteration):
+            next(gen)
+
+    @pytest.mark.slow
+    def test_manifest_matches_reality_on_representative_tests(self, tmp_path):
+        """Run two representative tier-1 tests in a fresh process with
+        recording on: the observed compile counts must fit the manifest
+        (and the budgeted run itself must pass)."""
+        record = tmp_path / "counts.jsonl"
+        targets = [
+            "tests/test_masks.py::test_intersects_parity",
+            "tests/test_masks.py::test_add_then_check_parity",
+        ]
+        env = dict(os.environ)
+        env.update(JAX_PLATFORMS="cpu", KC_RETRACE_RECORD=str(record))
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+             *targets],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=str(REPO),
+        )
+        assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+        manifest = json.loads(MANIFEST_PATH.read_text())
+        default = int(manifest["default_budget"])
+        per_test = manifest.get("tests", {})
+        rows = [json.loads(ln) for ln in record.read_text().splitlines()]
+        assert rows, "recording produced no rows"
+        for row in rows:
+            budget = int(per_test.get(row["test"], default))
+            assert row["compiles"] <= budget, (
+                f"{row['test']}: {row['compiles']} compiles > budget {budget}"
+            )

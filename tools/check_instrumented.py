@@ -1,90 +1,40 @@
 #!/usr/bin/env python
-"""Static gate: every controller ``reconcile`` entry point opens a span.
+"""Thin CLI over the framework's instrumented pass: every controller
+``reconcile`` opens a tracing span (see
+karpenter_core_tpu/analysis/passes/instrumented.py for the rule; `make
+verify` runs it through tools/kcanalyze.py baseline-aware).
 
-Scans ``karpenter_core_tpu/controllers/*.py`` for controller classes — a
-class carrying a string ``name`` attribute (the operator registration
-contract) — and asserts each one's ``reconcile`` method is instrumented:
-either decorated with ``@tracing.traced(...)``/``@traced(...)`` or containing
-a ``with tracing.span(...)``/``with span(...)`` block.  New controllers
-therefore cannot ship invisible to /debug/traces and the stage histograms.
-
-Run from `make verify`.  Exit 1 with one line per uninstrumented reconcile.
+Usage: python tools/check_instrumented.py [path]
+Exit 1 with one line per uninstrumented reconcile.
 """
 
 from __future__ import annotations
 
 import ast
+import os
 import sys
 from pathlib import Path
 
-CONTROLLERS_DIR = Path("karpenter_core_tpu/controllers")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from karpenter_core_tpu.analysis.core import SourceModule  # noqa: E402
+from karpenter_core_tpu.analysis.passes import instrumented  # noqa: E402
+
+CONTROLLERS_DIR = Path(REPO) / "karpenter_core_tpu" / "controllers"
 
 
-def _is_span_call(call: ast.expr) -> bool:
-    """True for span(...) / tracing.span(...) / *.span(...) call nodes."""
-    if not isinstance(call, ast.Call):
-        return False
-    func = call.func
-    if isinstance(func, ast.Name):
-        return func.id == "span"
-    if isinstance(func, ast.Attribute):
-        return func.attr == "span"
-    return False
-
-
-def _is_traced_decorator(node: ast.expr) -> bool:
-    """True for @traced(...) / @tracing.traced(...)."""
-    if isinstance(node, ast.Call):
-        node = node.func
-    if isinstance(node, ast.Name):
-        return node.id == "traced"
-    if isinstance(node, ast.Attribute):
-        return node.attr == "traced"
-    return False
-
-
-def _opens_span(fn: ast.FunctionDef) -> bool:
-    if any(_is_traced_decorator(d) for d in fn.decorator_list):
-        return True
-    for node in ast.walk(fn):
-        if isinstance(node, ast.With):
-            if any(_is_span_call(item.context_expr) for item in node.items):
-                return True
-    return False
-
-
-def _controller_classes(tree: ast.Module):
-    """(class, name_value) for classes with a literal string ``name`` attr."""
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ClassDef):
-            continue
-        for stmt in node.body:
-            if (
-                isinstance(stmt, ast.Assign)
-                and any(
-                    isinstance(t, ast.Name) and t.id == "name" for t in stmt.targets
-                )
-                and isinstance(stmt.value, ast.Constant)
-                and isinstance(stmt.value.value, str)
-            ):
-                yield node, stmt.value.value
-                break
-
-
-def check_file(path: Path) -> list:
-    findings = []
-    tree = ast.parse(path.read_text(), filename=str(path))
-    for cls, controller_name in _controller_classes(tree):
-        for stmt in cls.body:
-            if isinstance(stmt, ast.FunctionDef) and stmt.name == "reconcile":
-                if not _opens_span(stmt):
-                    findings.append(
-                        f"{path}:{stmt.lineno}: controller {controller_name!r} "
-                        f"({cls.name}.reconcile) opens no tracing span — "
-                        "decorate with @tracing.traced(...) or wrap the body "
-                        "in `with tracing.span(...)`"
-                    )
-    return findings
+def _load(path: Path) -> SourceModule:
+    source = path.read_text()
+    try:
+        rel = path.relative_to(REPO).as_posix()
+    except ValueError:
+        rel = path.as_posix()
+    return SourceModule(
+        name="", path=path, relpath=rel,
+        source=source, tree=ast.parse(source, filename=str(path)),
+        lines=source.splitlines(),
+    )
 
 
 def main(argv) -> int:
@@ -93,11 +43,10 @@ def main(argv) -> int:
     findings = []
     checked = 0
     for path in files:
-        file_findings = check_file(path)
-        findings.extend(file_findings)
+        findings.extend(instrumented.check_module(_load(path)))
         checked += 1
-    for finding in findings:
-        print(finding)
+    for f in findings:
+        print(f"{f.path}:{f.line}: {f.detail}")
     if findings:
         print(f"\n{len(findings)} uninstrumented reconcile(s)", file=sys.stderr)
         return 1

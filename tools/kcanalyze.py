@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""kcanalyze: the repo's static-analysis gate (run from `make verify`).
+
+Drives every pass in karpenter_core_tpu/analysis/passes/ over the tree,
+applies the checked-in baseline (karpenter_core_tpu/analysis/baseline.toml),
+prints one line per surviving finding as ``file:line: pass/rule: detail``,
+and a per-pass + total timing summary (the presubmit budget for the whole
+suite is < 30 s; in practice it runs in well under 5 s).
+
+Exit status: 1 when any unsuppressed finding (or a malformed baseline, or a
+file that fails to parse) survives; 0 otherwise.  Unused baseline entries
+are reported as warnings, not failures — prune them when the underlying
+code moves.
+
+Usage:
+    python tools/kcanalyze.py                  # whole repo, all passes
+    python tools/kcanalyze.py --pass lock-order --pass trace-safety
+    python tools/kcanalyze.py --root /tmp/tree --package badpkg
+    python tools/kcanalyze.py --baseline none  # ignore suppressions
+    python tools/kcanalyze.py --list           # show available passes
+
+See docs/ANALYSIS.md for the pass catalog and baseline policy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from karpenter_core_tpu.analysis.core import (  # noqa: E402
+    Baseline,
+    BaselineError,
+    Project,
+    apply_baseline,
+)
+from karpenter_core_tpu.analysis.passes import ALL_PASSES  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(
+    REPO, "karpenter_core_tpu", "analysis", "baseline.toml"
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=REPO,
+                    help="tree to analyze (default: this repo)")
+    ap.add_argument("--package", default="karpenter_core_tpu",
+                    help="package directory under --root")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline TOML path; 'none' disables suppressions "
+                         "(default: <root>/<package>/analysis/baseline.toml "
+                         "when present)")
+    ap.add_argument("--pass", dest="passes", action="append", default=None,
+                    metavar="NAME", help="run only the named pass(es)")
+    ap.add_argument("--list", action="store_true", help="list passes and exit")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print suppressed findings with their reasons")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for p in ALL_PASSES:
+            doc = (p.__doc__ or "").strip().splitlines()
+            print(f"{p.NAME}: {doc[0] if doc else ''}")
+        return 0
+
+    selected = ALL_PASSES
+    if args.passes:
+        by_name = {p.NAME: p for p in ALL_PASSES}
+        unknown = [n for n in args.passes if n not in by_name]
+        if unknown:
+            print(f"kcanalyze: unknown pass(es): {', '.join(unknown)} "
+                  f"(have: {', '.join(sorted(by_name))})", file=sys.stderr)
+            return 2
+        selected = [by_name[n] for n in args.passes]
+
+    # baseline resolution: explicit path > tree default > empty
+    if args.baseline == "none":
+        baseline = Baseline.empty()
+    else:
+        path = args.baseline or os.path.join(
+            args.root, args.package, "analysis", "baseline.toml"
+        )
+        if os.path.exists(path):
+            try:
+                baseline = Baseline.load(Path(path))
+            except BaselineError as e:
+                print(f"kcanalyze: bad baseline: {e}", file=sys.stderr)
+                return 1
+        elif args.baseline:
+            print(f"kcanalyze: baseline {path} not found", file=sys.stderr)
+            return 1
+        else:
+            baseline = Baseline.empty()
+
+    t0 = time.perf_counter()
+    project = Project(Path(args.root), package=args.package)
+    load_s = time.perf_counter() - t0
+
+    all_kept = list(project.errors)  # parse failures are findings
+    n_suppressed = 0
+    timings = []
+    print(f"kcanalyze: loaded {len(project.all_modules)} file(s) "
+          f"in {load_s:.2f}s")
+    for p in selected:
+        t1 = time.perf_counter()
+        found = p.run(project)
+        kept, suppressed = apply_baseline(found, baseline)
+        timings.append((p.NAME, time.perf_counter() - t1, len(kept),
+                        len(suppressed)))
+        all_kept.extend(kept)
+        n_suppressed += len(suppressed)
+        if args.verbose:
+            for f, reason in suppressed:
+                print(f"suppressed: {f.render()}  # {reason}")
+
+    for f in sorted(all_kept, key=lambda f: (f.path, f.line, f.pass_name, f.rule)):
+        print(f.render())
+
+    selected_names = {p.NAME for p in selected}
+    for entry in baseline.unused():
+        # under --pass only entries scoped to a selected pass are judged:
+        # a retrace-budget suppression is not "unused" because this run
+        # only executed lock-order
+        if entry.get("pass") is not None and entry["pass"] not in selected_names:
+            continue
+        print(
+            "kcanalyze: WARNING unused baseline entry at "
+            f"{baseline.path}:{entry.get('_line', 0)} "
+            f"(pass={entry.get('pass')!r} rule={entry.get('rule')!r} "
+            f"file={entry.get('file')!r}) — prune it",
+            file=sys.stderr,
+        )
+
+    total_s = time.perf_counter() - t0
+    for name, secs, n_found, n_supp in timings:
+        extra = f", {n_supp} suppressed" if n_supp else ""
+        print(f"kcanalyze: pass {name}: {n_found} finding(s){extra} "
+              f"in {secs:.2f}s")
+    verdict = "FAIL" if all_kept else "OK"
+    print(
+        f"kcanalyze: {verdict} — {len(selected)} pass(es), "
+        f"{len(all_kept)} finding(s), {n_suppressed} suppressed, "
+        f"{len(project.all_modules)} file(s) in {total_s:.2f}s"
+    )
+    return 1 if all_kept else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

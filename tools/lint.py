@@ -1,155 +1,103 @@
 #!/usr/bin/env python
-"""Zero-dependency lint gate for `make verify` (the role golangci-lint plays
-in the reference presubmit, /root/reference/Makefile:16-24; no third-party
-linter is vendorable in this environment, so the checks are implemented on
-the stdlib ast).
+"""Thin CLI over the framework's hygiene pass (kept for muscle memory and
+for linting individual files; `make verify` runs the full driver,
+tools/kcanalyze.py, which includes this pass baseline-aware).
 
-Checks:
-  unused-import       imported name never referenced (module `__init__.py`
-                      re-export files and names in __all__ are exempt)
-  bare-except         `except:` with no exception class
-  mutable-default     list/dict/set literals as parameter defaults
-  f-string-no-field   f-string without any substitution
-  tabs / trailing-ws  formatting gate
-  long-line           > 120 characters (comments/strings included)
+The bespoke ast walker that used to live here moved to
+karpenter_core_tpu/analysis/passes/hygiene.py unchanged in behavior —
+unused-import, bare-except, mutable-default, f-string-no-field, tabs,
+trailing-ws, long-line — plus two rules the framework added:
+assert-in-package and wallclock (see docs/ANALYSIS.md).
 
-Exit code 1 on any finding; print file:line: rule: detail.
+Usage: python tools/lint.py [path ...]   # default: the repo's lint roots
+Output: file:line: rule: detail; exit 1 on any finding.
 """
 
 from __future__ import annotations
 
-import ast
+import os
 import sys
 from pathlib import Path
 
-MAX_LINE = 120
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import ast  # noqa: E402
+
+from karpenter_core_tpu.analysis.core import (  # noqa: E402
+    Finding,
+    Project,
+    SourceModule,
+)
+from karpenter_core_tpu.analysis.passes import hygiene  # noqa: E402
 
 
-class _Walker(ast.NodeVisitor):
-    def __init__(self) -> None:
-        self.imports: dict[str, tuple[int, str]] = {}  # name -> (line, module)
-        self.used: set[str] = set()
-        self.findings: list[tuple[int, str, str]] = []
-        self.dunder_all: set[str] = set()
+class _PackageRef:
+    """The one Project attribute hygiene.check_module consults — lets the
+    explicit-paths mode lint files without parsing the whole repo."""
 
-    def visit_Import(self, node: ast.Import) -> None:
-        for alias in node.names:
-            name = alias.asname or alias.name.split(".")[0]
-            self.imports[name] = (node.lineno, alias.name)
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        if node.module == "__future__":
-            return
-        for alias in node.names:
-            if alias.name == "*":
-                continue
-            name = alias.asname or alias.name
-            self.imports[name] = (node.lineno, f"{node.module}.{alias.name}")
-
-    def visit_Name(self, node: ast.Name) -> None:
-        if isinstance(node.ctx, ast.Load):
-            self.used.add(node.id)
-        self.generic_visit(node)
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        self.generic_visit(node)
-
-    def visit_Assign(self, node: ast.Assign) -> None:
-        for target in node.targets:
-            if isinstance(target, ast.Name) and target.id == "__all__":
-                for element in ast.walk(node.value):
-                    if isinstance(element, ast.Constant) and isinstance(element.value, str):
-                        self.dunder_all.add(element.value)
-        self.generic_visit(node)
-
-    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
-        if node.type is None:
-            self.findings.append((node.lineno, "bare-except", "use `except Exception:`"))
-        self.generic_visit(node)
-
-    def _check_defaults(self, node) -> None:
-        for default in list(node.args.defaults) + [
-            d for d in node.args.kw_defaults if d is not None
-        ]:
-            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
-                self.findings.append(
-                    (default.lineno, "mutable-default", "use None + in-body init")
-                )
-
-    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        self._check_defaults(node)
-        self.generic_visit(node)
-
-    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
-        self._check_defaults(node)
-        self.generic_visit(node)
-
-    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
-        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
-            self.findings.append((node.lineno, "f-string-no-field", "drop the f prefix"))
-        # visit interpolated expressions — including those inside dynamic
-        # format specs — but never a spec's JoinedStr itself (a field-less
-        # inner JoinedStr would false-positive the no-field check)
-        def visit_fields(joined: ast.JoinedStr) -> None:
-            for value in joined.values:
-                if isinstance(value, ast.FormattedValue):
-                    self.visit(value.value)
-                    if isinstance(value.format_spec, ast.JoinedStr):
-                        visit_fields(value.format_spec)
-
-        visit_fields(node)
+    package = "karpenter_core_tpu"
 
 
-def lint_file(path: Path) -> list[str]:
-    source = path.read_text()
-    out: list[str] = []
-    for i, line in enumerate(source.splitlines(), 1):
-        if "\t" in line:
-            out.append(f"{path}:{i}: tabs: use spaces")
-        if line != line.rstrip():
-            out.append(f"{path}:{i}: trailing-ws: trailing whitespace")
-        if len(line) > MAX_LINE:
-            out.append(f"{path}:{i}: long-line: {len(line)} > {MAX_LINE}")
+def _load_module(path: Path, errors: list) -> "SourceModule | None":
+    """Parse one file; package files get their dotted name so the
+    package-scoped rules (assert-in-package, wallclock) still apply."""
+    try:
+        source = path.read_text()
+    except OSError as e:
+        errors.append(Finding(str(path), 0, "read-error", str(e), "hygiene"))
+        return None
+    try:
+        rel = path.relative_to(REPO).as_posix()
+    except ValueError:
+        rel = path.as_posix()
+    name = ""
+    if rel.startswith(_PackageRef.package + "/"):
+        parts = list(Path(rel).with_suffix("").parts)
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        name = ".".join(parts)
     try:
         tree = ast.parse(source, filename=str(path))
     except SyntaxError as e:
-        return out + [f"{path}:{e.lineno}: syntax-error: {e.msg}"]
-    walker = _Walker()
-    walker.visit(tree)
-    # string-annotation references ("Optional[Clock]") count as uses.
-    # Identifier-boundary matching over ALL string constants is a known
-    # over-approximation: prose like "the os module" in a docstring also
-    # exempts `os` — accepted to keep forward-reference annotations working
-    # without tracking annotation positions
-    import re as _re
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            for name in walker.imports:
-                if _re.search(rf"\b{_re.escape(name)}\b", node.value):
-                    walker.used.add(name)
-    is_reexport = path.name == "__init__.py"
-    if not is_reexport:
-        for name, (lineno, module) in sorted(walker.imports.items()):
-            if name not in walker.used and name not in walker.dunder_all:
-                out.append(f"{path}:{lineno}: unused-import: {module} as {name}")
-    for lineno, rule, detail in walker.findings:
-        out.append(f"{path}:{lineno}: {rule}: {detail}")
-    return out
+        errors.append(Finding(
+            rel, e.lineno or 0, "syntax-error",
+            e.msg or "invalid syntax", "hygiene",
+        ))
+        return None
+    return SourceModule(
+        name=name, path=path, relpath=rel,
+        source=source, tree=tree, lines=source.splitlines(),
+    )
 
 
-def main(argv: list[str]) -> int:
-    roots = [Path(p) for p in argv] or [
-        Path("karpenter_core_tpu"), Path("tests"), Path("tools"),
-        Path("bench.py"), Path("__graft_entry__.py"),
-    ]
-    findings: list[str] = []
-    for root in roots:
-        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
-        for path in files:
-            findings.extend(lint_file(path))
-    for finding in findings:
-        print(finding)
+def main(argv: list) -> int:
+    if not argv:
+        # whole-repo mode: the Project load is the point
+        project = Project(Path(REPO))
+        findings = project.errors + hygiene.run(project)
+    else:
+        # explicit-paths mode: parse only what was named
+        findings = []
+        modules = []
+        for arg in argv:
+            p = Path(arg)
+            resolved = (p if p.is_absolute() else Path(REPO) / p).resolve()
+            if resolved.is_dir():
+                files = sorted(resolved.rglob("*.py"))
+            elif resolved.is_file():
+                files = [resolved]
+            else:
+                print(f"lint: no such file or directory: {arg}", file=sys.stderr)
+                return 2
+            for f in files:
+                module = _load_module(f, findings)
+                if module is not None:
+                    modules.append(module)
+        for module in modules:
+            findings.extend(hygiene.check_module(module, _PackageRef()))
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        print(f"{f.path}:{f.line}: {f.rule}: {f.detail}")
     if findings:
         print(f"\n{len(findings)} finding(s)", file=sys.stderr)
         return 1
