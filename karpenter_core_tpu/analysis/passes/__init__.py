@@ -6,6 +6,7 @@ write the module, append it here, and document it in docs/ANALYSIS.md.
 """
 
 from karpenter_core_tpu.analysis.passes import (
+    chaos_hygiene,
     hygiene,
     instrumented,
     lock_order,
@@ -13,6 +14,9 @@ from karpenter_core_tpu.analysis.passes import (
     trace_safety,
 )
 
-ALL_PASSES = [trace_safety, retrace_budget, lock_order, hygiene, instrumented]
+ALL_PASSES = [
+    trace_safety, retrace_budget, lock_order, hygiene, instrumented,
+    chaos_hygiene,
+]
 
 __all__ = ["ALL_PASSES"]

@@ -1,0 +1,111 @@
+"""chaos-hygiene: determinism and registration gates for the chaos plane.
+
+Two properties make chaos failures replayable from their printed
+``(scenario, seed)`` pair, and this pass holds both statically:
+
+  point-duplicate     every ``chaos.point("name")`` registration name is
+                      unique across the package — a second registration of
+                      the same name raises at import time, but only on the
+                      import path that happens to load both modules, so the
+                      gate catches it before any runtime does
+  point-nonliteral    ``chaos.point(...)`` must be called with a string
+                      literal: the registry (docs/CHAOS.md's point catalog)
+                      is audited statically, and a computed name defeats
+                      both this pass and the catalog
+  nondeterminism      production package modules may not import ``random``
+                      or ``secrets``: every stochastic decision must flow
+                      through the chaos plane (``chaos/``, the one exempt
+                      subtree) or utils/retry's seedable DeterministicRNG,
+                      else a fault schedule replayed from its seed diverges
+                      on the first unseeded draw
+
+tests/, tools/, and top-level scripts are exempt from ``nondeterminism``
+(they are not shipped package code); nothing is exempt from the point rules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from karpenter_core_tpu.analysis.core import (
+    Finding,
+    Project,
+    import_map,
+    resolve_call_root,
+)
+
+NAME = "chaos-hygiene"
+
+_FORBIDDEN_MODULES = {"random", "secrets"}
+# resolved dotted roots that register a chaos point
+_POINT_CALLS = {
+    "karpenter_core_tpu.chaos.point",
+    "karpenter_core_tpu.chaos.plane.point",
+}
+
+
+def _is_chaos_module(module, project: Project) -> bool:
+    parts = module.name.split(".")
+    return len(parts) > 1 and parts[1] == "chaos"
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    registrations: Dict[str, List[Tuple[str, int]]] = {}
+
+    for module in project.package_modules:
+        imports = import_map(module.tree)
+        chaos_exempt = _is_chaos_module(module, project)
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)) and not chaos_exempt:
+                if isinstance(node, ast.Import):
+                    roots = [alias.name.split(".")[0] for alias in node.names]
+                else:
+                    roots = [(node.module or "").split(".")[0]]
+                for root in roots:
+                    if root in _FORBIDDEN_MODULES:
+                        findings.append(Finding(
+                            module.relpath, node.lineno, "nondeterminism",
+                            f"production module imports {root!r}; stochastic "
+                            "decisions must flow through chaos/ or "
+                            "utils/retry.DeterministicRNG so fault schedules "
+                            "replay from their seed",
+                            NAME,
+                        ))
+            if isinstance(node, ast.Call):
+                root = resolve_call_root(node.func, imports)
+                if root not in _POINT_CALLS:
+                    continue
+                if (
+                    len(node.args) != 1
+                    or not isinstance(node.args[0], ast.Constant)
+                    or not isinstance(node.args[0].value, str)
+                ):
+                    findings.append(Finding(
+                        module.relpath, node.lineno, "point-nonliteral",
+                        "chaos.point() must take a single string literal — "
+                        "the point catalog is audited statically",
+                        NAME,
+                    ))
+                    continue
+                point_name = node.args[0].value
+                registrations.setdefault(point_name, []).append(
+                    (module.relpath, node.lineno)
+                )
+
+    for point_name, sites in sorted(registrations.items()):
+        if len(sites) > 1:
+            rendered = ", ".join(f"{p}:{line}" for p, line in sites)
+            for path, line in sites:
+                findings.append(Finding(
+                    path, line, "point-duplicate",
+                    f"chaos point {point_name!r} registered {len(sites)} "
+                    f"times ({rendered}); register once and import the "
+                    "Point object everywhere else",
+                    NAME,
+                ))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,41 @@
+"""chaos/: the deterministic fault-injection plane.
+
+``chaos.point(name)`` registers a named injection point (exactly once);
+``chaos.arm(Scenario(...))`` turns the process's points live.  Unarmed, every
+point is a zero-cost no-op — production binaries never pay for the plane.
+See docs/CHAOS.md for the point catalog, scenario format, and the seed-replay
+workflow.
+"""
+
+from karpenter_core_tpu.chaos.plane import (
+    CHAOS_FAULTS_INJECTED,
+    FAULT_KINDS,
+    Fault,
+    InjectedFault,
+    Point,
+    arm,
+    armed,
+    armed_scenario,
+    current_skew_s,
+    disarm,
+    point,
+    registered_points,
+)
+from karpenter_core_tpu.chaos.scenario import PointSpec, Scenario
+
+__all__ = [
+    "CHAOS_FAULTS_INJECTED",
+    "FAULT_KINDS",
+    "Fault",
+    "InjectedFault",
+    "Point",
+    "PointSpec",
+    "Scenario",
+    "arm",
+    "armed",
+    "armed_scenario",
+    "current_skew_s",
+    "disarm",
+    "point",
+    "registered_points",
+]

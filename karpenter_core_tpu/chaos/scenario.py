@@ -1,0 +1,241 @@
+"""Scenario: a declarative, seeded fault schedule for the injection plane.
+
+A scenario names, for each injection point, *when* to fault (per-hit
+probability, an explicit hit schedule, or the first N hits), *what* fault to
+inject (kind, code, message, delay), and when to stop (``stop_after``).  The
+schedule is a pure function of ``(seed, point, hit_index)`` — the same seed
+replays the same faults in the same order, which is what makes every chaos
+failure reproducible from its printed ``(scenario, seed)`` pair.
+
+Specs come from dicts or a TOML subset (this container's Python predates
+``tomllib``, so a mini-parser covers the forms docs/CHAOS.md documents):
+
+    [scenario]
+    name = "apiserver-flake"
+    seed = 1234
+
+    [points."kubeapi.put"]
+    prob = 0.3
+    kind = "error"
+    code = 500
+    stop_after = 5
+
+    [points."cloud.create"]
+    first_n = 2
+    kind = "error"
+    message = "insufficient capacity"
+
+This module is the one place in the package allowed to import ``random``
+(the kcanalyze chaos-hygiene determinism gate): ``random.Random`` seeded
+with a derived string is a stable, platform-independent uniform source.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from karpenter_core_tpu.chaos import plane
+
+
+@dataclass
+class PointSpec:
+    """When and how one injection point faults."""
+
+    prob: float = 0.0  # per-hit fault probability (seed-derived)
+    schedule: Optional[List[int]] = None  # explicit 0-based hit indices
+    first_n: int = 0  # fault the first N hits
+    kind: str = plane.KIND_ERROR
+    code: int = 0
+    message: str = ""
+    delay_s: float = 0.0
+    stop_after: int = 0  # 0 = unbounded
+    data: dict = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.kind not in plane.FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (have {plane.FAULT_KINDS})"
+            )
+        if self.schedule is not None:
+            self.schedule = sorted(int(i) for i in self.schedule)
+
+
+class Scenario:
+    """An armable, seeded fault plan over named injection points."""
+
+    def __init__(self, name: str, seed: int, points: Dict[str, PointSpec]) -> None:
+        self.name = name
+        self.seed = int(seed)
+        self.points = dict(points)
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}
+        self._fired: Dict[str, int] = {}
+        self._skew_counted = False
+
+    def __repr__(self) -> str:
+        return f"Scenario(name={self.name!r}, seed={self.seed})"
+
+    # -- construction ----------------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, spec: dict) -> "Scenario":
+        name = spec.get("name", "unnamed")
+        seed = int(spec.get("seed", 0))
+        points = {}
+        for point_name, raw in (spec.get("points") or {}).items():
+            points[point_name] = PointSpec(**raw)
+        return cls(name, seed, points)
+
+    @classmethod
+    def from_toml(cls, text: str) -> "Scenario":
+        return cls.from_dict(_parse_mini_toml(text))
+
+    # -- the deterministic schedule --------------------------------------------
+
+    def _uniform(self, point_name: str, index: int) -> float:
+        # string-seeded Random is derived through SHA-512: stable across
+        # processes and platforms, independent per (seed, point, index)
+        return random.Random(f"{self.seed}:{point_name}:{index}").random()
+
+    def would_fault(self, point_name: str, index: int) -> bool:
+        """Pure schedule query (no counters): does hit ``index`` fault?"""
+        spec = self.points.get(point_name)
+        if spec is None:
+            return False
+        if spec.schedule is not None:
+            return index in spec.schedule
+        if spec.first_n:
+            return index < spec.first_n
+        if spec.prob > 0.0:
+            return self._uniform(point_name, index) < spec.prob
+        return False
+
+    def fault_schedule(self, point_name: str, n_hits: int) -> List[int]:
+        """The hit indices among the first ``n_hits`` that fault — the
+        replayable schedule tests assert on."""
+        out = [i for i in range(n_hits) if self.would_fault(point_name, i)]
+        spec = self.points.get(point_name)
+        if spec is not None and spec.stop_after:
+            out = out[: spec.stop_after]
+        return out
+
+    def decide(self, point_name: str, kinds=None) -> Optional[plane.Fault]:
+        """Called by Point.hit while this scenario is armed: consume one hit
+        index and return the fault for it, if any.  ``kinds`` is the set the
+        call site (plus the plane itself, for latency) can interpret: a spec
+        kind outside it is discarded without firing — the hit index still
+        advances (schedule determinism is a pure function of the index), but
+        neither ``fired_counts`` nor the injected-fault metrics move, so the
+        audit never reports an injection nothing acted on."""
+        spec = self.points.get(point_name)
+        if spec is None:
+            return None
+        with self._lock:
+            index = self._hits.get(point_name, 0)
+            self._hits[point_name] = index + 1
+            if kinds is not None and spec.kind not in kinds:
+                return None
+            if spec.stop_after and self._fired.get(point_name, 0) >= spec.stop_after:
+                return None
+            if not self.would_fault(point_name, index):
+                return None
+            self._fired[point_name] = self._fired.get(point_name, 0) + 1
+        return plane.Fault(
+            point=point_name,
+            index=index,
+            kind=spec.kind,
+            code=spec.code,
+            message=spec.message or f"injected {spec.kind}",
+            delay_s=spec.delay_s,
+            data=dict(spec.data),
+        )
+
+    def clock_skew_s(self) -> float:
+        """Standing clock offset (the ``clock.skew`` point's delay_s)."""
+        spec = self.points.get("clock.skew")
+        if spec is None or spec.kind != plane.KIND_SKEW:
+            return 0.0
+        with self._lock:
+            if not self._skew_counted:
+                self._skew_counted = True
+                plane.CHAOS_FAULTS_INJECTED.labels("clock.skew", spec.kind).inc()
+        return spec.delay_s
+
+    # -- bookkeeping -----------------------------------------------------------
+
+    def reset_counters(self) -> None:
+        with self._lock:
+            self._hits = {}
+            self._fired = {}
+            self._skew_counted = False
+
+    def hit_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._hits)
+
+    def fired_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._fired)
+
+
+# -- mini-TOML ----------------------------------------------------------------
+
+
+def _coerce(value: str):
+    value = value.strip()
+    if value.startswith("[") and value.endswith("]"):
+        inner = value[1:-1].strip()
+        return [_coerce(v) for v in inner.split(",")] if inner else []
+    if value.startswith('"') and value.endswith('"'):
+        return value[1:-1]
+    if value in ("true", "false"):
+        return value == "true"
+    try:
+        return int(value)
+    except ValueError:
+        pass
+    try:
+        return float(value)
+    except ValueError:
+        return value
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing ``#`` comment, but only outside double quotes — a
+    fault message like ``"quota #429 exceeded"`` must survive intact."""
+    in_string = False
+    for i, ch in enumerate(line):
+        if ch == '"':
+            in_string = not in_string
+        elif ch == "#" and not in_string:
+            return line[:i]
+    return line
+
+
+def _parse_mini_toml(text: str) -> dict:
+    """[scenario] / [points."name"] tables with scalar and list values —
+    exactly the subset docs/CHAOS.md documents (this Python has no tomllib)."""
+    out: dict = {"points": {}}
+    target: Optional[dict] = None
+    for raw_line in text.splitlines():
+        line = _strip_comment(raw_line).strip()
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            header = line[1:-1].strip()
+            if header == "scenario":
+                target = out
+            elif header.startswith("points."):
+                point_name = header[len("points."):].strip().strip('"')
+                target = out["points"].setdefault(point_name, {})
+            else:
+                raise ValueError(f"unknown scenario table [{header}]")
+            continue
+        if "=" not in line or target is None:
+            raise ValueError(f"unparseable scenario line {raw_line!r}")
+        key, _, value = line.partition("=")
+        target[key.strip()] = _coerce(value)
+    return out

@@ -1,9 +1,19 @@
 from karpenter_core_tpu.cloudprovider.types import (
     CloudProvider,
     InstanceType,
+    InsufficientCapacityError,
     MachineNotFoundError,
     Offering,
     Offerings,
+    TransientCloudError,
 )
 
-__all__ = ["CloudProvider", "InstanceType", "MachineNotFoundError", "Offering", "Offerings"]
+__all__ = [
+    "CloudProvider",
+    "InstanceType",
+    "InsufficientCapacityError",
+    "MachineNotFoundError",
+    "Offering",
+    "Offerings",
+    "TransientCloudError",
+]

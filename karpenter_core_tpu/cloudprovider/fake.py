@@ -25,15 +25,26 @@ from karpenter_core_tpu.apis.objects import (
     ObjectMeta,
 )
 from karpenter_core_tpu.apis.v1alpha5 import Machine, Provisioner
+from karpenter_core_tpu.chaos import plane as chaos
 from karpenter_core_tpu.cloudprovider.types import (
     CloudProvider,
     InstanceType,
+    InsufficientCapacityError,
     MachineNotFoundError,
     Offering,
     Offerings,
+    TransientCloudError,
 )
 from karpenter_core_tpu.scheduling import Requirement, Requirements
 from karpenter_core_tpu.utils import resources as resources_util
+
+# cloud.create faults: kind "error" with data.mode "insufficient-capacity"
+# (optionally data.instance_types=[...] to target types) raises ICE, any
+# other error raises TransientCloudError; kind "partial" makes the create
+# succeed but the node never register (stillborn).  cloud.delete faults:
+# code 404 raises MachineNotFoundError, otherwise TransientCloudError.
+CLOUD_CREATE = chaos.point("cloud.create")
+CLOUD_DELETE = chaos.point("cloud.delete")
 
 LABEL_INSTANCE_SIZE = "size"
 EXOTIC_INSTANCE_LABEL_KEY = "special"
@@ -179,6 +190,17 @@ class FakeCloudProvider(CloudProvider):
         self.allowed_create_calls = 1 << 62
         self.drifted = False
         self.next_create_error: Optional[Exception] = None
+        # first-class failure modes (settable directly by tests, and driven
+        # by the chaos plane's cloud.create faults):
+        #   capacity_errors:  instance-type name -> remaining ICE creates
+        #   transient_create_failures: next N creates raise TransientCloudError
+        #   stillborn_creates: next N creates succeed but the node never
+        #                      registers (provider ids land in stillborn_ids;
+        #                      the harness's kubelet emulation skips them)
+        self.capacity_errors: dict = {}
+        self.transient_create_failures = 0
+        self.stillborn_creates = 0
+        self.stillborn_ids: set = set()
         self._mu = threading.Lock()
         self._created: dict = {}
 
@@ -188,6 +210,53 @@ class FakeCloudProvider(CloudProvider):
             self.delete_calls = []
             self.allowed_create_calls = 1 << 62
             self.next_create_error = None
+            self.capacity_errors = {}
+            self.transient_create_failures = 0
+            self.stillborn_creates = 0
+            self.stillborn_ids = set()
+
+    def created_machines(self) -> List[Machine]:
+        """Machines alive at the provider — the chaos matrix's leak check
+        surface (every entry must map to a live node object or be deleted)."""
+        with self._mu:
+            return list(self._created.values())
+
+    def _check_create_faults(self, instance_type: InstanceType) -> bool:
+        """Apply the first-class failure modes and any armed chaos fault for
+        this create; returns True when the create should be stillborn."""
+        stillborn = False
+        with self._mu:
+            remaining = self.capacity_errors.get(instance_type.name, 0)
+            if remaining > 0:
+                self.capacity_errors[instance_type.name] = remaining - 1
+                raise InsufficientCapacityError(instance_type.name)
+            if self.transient_create_failures > 0:
+                self.transient_create_failures -= 1
+                raise TransientCloudError("injected transient cloud API error")
+            if self.stillborn_creates > 0:
+                self.stillborn_creates -= 1
+                stillborn = True
+        # chaos fires AFTER the first-class knobs: a knob that already failed
+        # this create would otherwise discard an injected (counted, traced)
+        # fault, misattributing the failure in the audit
+        fault = CLOUD_CREATE.hit(
+            kinds=(chaos.KIND_ERROR, chaos.KIND_TIMEOUT, chaos.KIND_PARTIAL),
+            instance_type=instance_type.name,
+        )
+        if fault is not None:
+            if fault.kind == chaos.KIND_PARTIAL:
+                stillborn = True
+            elif fault.kind in (chaos.KIND_ERROR, chaos.KIND_TIMEOUT):
+                mode = fault.data.get("mode", "transient")
+                if mode == "insufficient-capacity":
+                    targets = fault.data.get("instance_types")
+                    if not targets or instance_type.name in targets:
+                        raise InsufficientCapacityError(
+                            instance_type.name, fault.message
+                        )
+                else:
+                    raise TransientCloudError(fault.describe())
+        return stillborn
 
     def create(self, machine: Machine) -> Machine:
         with self._mu:
@@ -214,6 +283,7 @@ class FakeCloudProvider(CloudProvider):
 
         candidates.sort(key=cheapest_price)
         instance_type = candidates[0]
+        stillborn = self._check_create_faults(instance_type)
         labels = {}
         for key in instance_type.requirements.keys():
             requirement = instance_type.requirements.get(key)
@@ -242,6 +312,8 @@ class FakeCloudProvider(CloudProvider):
         )
         with self._mu:
             self._created[machine.status.provider_id] = resolved
+            if stillborn:
+                self.stillborn_ids.add(machine.status.provider_id)
         return resolved
 
     def to_node(self, machine: Machine) -> Node:
@@ -256,11 +328,20 @@ class FakeCloudProvider(CloudProvider):
         )
 
     def delete(self, machine: Machine) -> None:
+        fault = CLOUD_DELETE.hit(
+            kinds=(chaos.KIND_ERROR, chaos.KIND_TIMEOUT),
+            provider_id=machine.status.provider_id,
+        )
+        if fault is not None and fault.kind in (chaos.KIND_ERROR, chaos.KIND_TIMEOUT):
+            if fault.code == 404:
+                raise MachineNotFoundError(machine.status.provider_id)
+            raise TransientCloudError(fault.describe())
         with self._mu:
             self.delete_calls.append(machine)
             if machine.status.provider_id not in self._created:
                 raise MachineNotFoundError(machine.status.provider_id)
             del self._created[machine.status.provider_id]
+            self.stillborn_ids.discard(machine.status.provider_id)
 
     def get_instance_types(self, provisioner: Optional[Provisioner]) -> List[InstanceType]:
         if self.instance_types_list is not None:

@@ -24,6 +24,24 @@ class MachineNotFoundError(Exception):
     (types.go:148)."""
 
 
+class InsufficientCapacityError(Exception):
+    """Raised by CloudProvider.create when the offering has no capacity for
+    the selected instance type (the real clouds' ICE).  Deterministic for the
+    caller: retrying the same instance type won't help until capacity
+    returns, so launch retries should redraw from the remaining options."""
+
+    def __init__(self, instance_type: str, message: str = "") -> None:
+        super().__init__(
+            message or f"insufficient capacity for instance type {instance_type!r}"
+        )
+        self.instance_type = instance_type
+
+
+class TransientCloudError(Exception):
+    """Raised by CloudProvider.create/delete for retryable API faults
+    (throttling, 5xx): the same call may succeed moments later."""
+
+
 @dataclass(frozen=True)
 class Offering:
     """A (capacity type, zone) purchase option for an instance type

@@ -31,6 +31,7 @@ from karpenter_core_tpu.solver.scheduler import SchedulerOptions
 from karpenter_core_tpu.state.cluster import Cluster, StateNode
 from karpenter_core_tpu.utils import node as node_util
 from karpenter_core_tpu.utils import pod as pod_util
+from karpenter_core_tpu.utils import retry
 from karpenter_core_tpu.utils.clock import Clock
 
 log = logging.getLogger(__name__)
@@ -40,6 +41,13 @@ CONSOLIDATION_TTL = 15.0  # consolidation.go:64
 WAIT_RETRY_ATTEMPTS = 60  # controller.go:71-76 (~9.5 min)
 WAIT_RETRY_DELAY = 2.0
 WAIT_RETRY_MAX_DELAY = 10.0
+
+DEGRADED_PAUSES = REGISTRY.counter(
+    "karpenter_degraded_pauses_total",
+    "Deprovisioning reconciles skipped because the solver-backend circuit "
+    "breaker was open (disruption is optional work; a degraded control "
+    "plane must not act on stale simulations).",
+)
 
 EVALUATION_DURATION = REGISTRY.histogram(
     "karpenter_deprovisioning_evaluation_duration_seconds",
@@ -722,10 +730,10 @@ class MultiNodeConsolidation(_ConsolidationBase):
     # compiling in-process (set alongside use_tpu_kernel by the controller)
     solver_endpoint = ""
     _solver_client = None
-    # consecutive unexpected sweep failures before the device path disables
-    # for the process (mirrors provisioning.TPU_KERNEL_MAX_FAILURES)
-    _tpu_failures = 0
-    _TPU_MAX_FAILURES = 2
+    # the solver-backend circuit breaker, SHARED with the provisioning
+    # controller (set by DeprovisioningController) — one backend, one
+    # verdict; None (standalone construction) means no gating
+    solver_breaker: Optional[retry.CircuitBreaker] = None
 
     def compute_command(self, candidates: List[CandidateNode]) -> Command:
         if not self.should_attempt():
@@ -755,10 +763,16 @@ class MultiNodeConsolidation(_ConsolidationBase):
 
         if len(candidates) < 2:
             return Command(Action.DO_NOTHING)
+        if self.solver_breaker is not None and not self.solver_breaker.allow():
+            # breaker open: don't touch the dead backend — host binary search
+            return None
         try:
             if self.solver_endpoint:
                 cmd = self._remote_search(candidates)
                 if cmd is None:
+                    # no backend verdict: free a half-open trial slot
+                    if self.solver_breaker is not None:
+                        self.solver_breaker.release_trial()
                     return None  # service judged the shape kernel-unsupported
             else:
                 search = TPUConsolidationSearch(
@@ -772,19 +786,23 @@ class MultiNodeConsolidation(_ConsolidationBase):
                 )
         except KernelUnsupported as e:
             log.debug("TPU consolidation unsupported for cluster shape, %s", e)
+            if self.solver_breaker is not None:
+                self.solver_breaker.release_trial()  # shape verdict, not backend
             return None
         except Exception as e:  # backend init/relay faults: host binary search
-            self._tpu_failures += 1
+            if self.solver_breaker is not None:
+                self.solver_breaker.record_failure()
+                state = self.solver_breaker.state
+            else:
+                state = "unbrokered"
             log.warning(
                 "TPU consolidation sweep failed (%s: %s); falling back to the "
-                "host binary search (%d/%d consecutive failures)",
-                type(e).__name__, e, self._tpu_failures, self._TPU_MAX_FAILURES,
+                "host binary search (breaker %s)",
+                type(e).__name__, e, state,
             )
-            if self._tpu_failures >= self._TPU_MAX_FAILURES:
-                log.warning("disabling the device consolidation sweep for this process")
-                self.use_tpu_kernel = False
             return None
-        self._tpu_failures = 0
+        if self.solver_breaker is not None:
+            self.solver_breaker.record_success()
         return cmd
 
     def _remote_search(self, candidates: List[CandidateNode]) -> Optional[Command]:
@@ -991,11 +1009,34 @@ class DeprovisioningController:
         self.multi_node_consolidation.solver_endpoint = getattr(
             provisioning, "solver_endpoint", ""
         )
+        # one backend, one breaker: the sweep shares the provisioning
+        # controller's solver-backend verdict.  A stub/embedded provisioning
+        # object without a breaker gets a local one — otherwise a dead
+        # backend would be re-probed (full timeout + warning) on every sweep
+        # for the life of the process, the safeguard the old
+        # disable-after-2-failures flag used to provide.
+        breaker = getattr(provisioning, "solver_breaker", None)
+        if breaker is None:
+            from karpenter_core_tpu.controllers.provisioning import (
+                SOLVER_BREAKER_RESET_S,
+                TPU_KERNEL_MAX_FAILURES,
+            )
+
+            breaker = retry.CircuitBreaker(
+                clock,
+                failure_threshold=TPU_KERNEL_MAX_FAILURES,
+                reset_timeout_s=SOLVER_BREAKER_RESET_S,
+                name="sweep-solver-backend",
+            )
+        self.multi_node_consolidation.solver_breaker = breaker
         self.single_node_consolidation = SingleNodeConsolidation(*base_args)
         # test hook: invoked after replacements launch so suites can initialize
         # the nodes that the readiness wait polls for
         self.on_replacements_launched: Optional[Callable[[List[str]], None]] = None
         self._wait_attempts = WAIT_RETRY_ATTEMPTS
+        # reconcile requeue backoff (the reference's rate-limited workqueue):
+        # 1, 2, 4, 8, then the polling period — pinned by tests/test_retry.py
+        self._retry_backoff = retry.Backoff(1.0, POLLING_PERIOD)
 
     def reconcile(self) -> Tuple[Result, float]:
         """(result, requeue_after_seconds) — controller.go:107-128.  RETRY and
@@ -1007,25 +1048,29 @@ class DeprovisioningController:
             return result, requeue
 
     def _reconcile(self) -> Tuple[Result, float]:
+        degraded = getattr(self.provisioning, "degraded", None)
+        if degraded is not None and degraded():
+            # the solver breaker is open: deprovisioning is OPTIONAL work —
+            # disrupting nodes against a control plane already in a failure
+            # mode risks acting on a stale simulation, so pause entirely and
+            # let provisioning's degraded path keep the cluster converging
+            DEGRADED_PAUSES.labels().inc()
+            tracing.add_event("deprovisioning.paused", degraded=True)
+            log.info("deprovisioning paused: solver-backend breaker open")
+            return Result.NOTHING_TO_DO, POLLING_PERIOD
         current_state = self.cluster.cluster_consolidation_state()
         result, err = self.process_cluster()
         if result == Result.FAILED:
             log.error("processing cluster, %s", err)
-            return result, self._next_backoff()
+            return result, self._retry_backoff.next()
         if result == Result.RETRY:
-            return result, self._next_backoff()
-        self._retry_backoff = 0.0
+            return result, self._retry_backoff.next()
+        self._retry_backoff.reset()
         if result == Result.NOTHING_TO_DO:
             self.empty_node_consolidation.record_last_state(current_state)
             self.single_node_consolidation.record_last_state(current_state)
             self.multi_node_consolidation.record_last_state(current_state)
         return result, POLLING_PERIOD
-
-    _retry_backoff = 0.0
-
-    def _next_backoff(self) -> float:
-        self._retry_backoff = min(max(self._retry_backoff * 2, 1.0), POLLING_PERIOD)
-        return self._retry_backoff
 
     def process_cluster(self) -> Tuple[Result, Optional[str]]:
         for deprovisioner in (
@@ -1114,24 +1159,22 @@ class DeprovisioningController:
         return None
 
     def _wait_for_initialized(self, node_name: str) -> bool:
-        delay = WAIT_RETRY_DELAY
+        backoff = retry.Backoff(WAIT_RETRY_DELAY, WAIT_RETRY_MAX_DELAY)
         for attempt in range(self._wait_attempts):
             node = self.kube_client.get_node(node_name)
             if node is not None and labels_api.LABEL_NODE_INITIALIZED in node.metadata.labels:
                 return True
             if node is not None and self.recorder is not None:
                 self.recorder.publish(evt.waiting_on_readiness(node_name))
-            self.clock.sleep(delay)
-            delay = min(delay * 2, WAIT_RETRY_MAX_DELAY)
+            self.clock.sleep(backoff.next())
         return False
 
     def wait_for_deletion(self, node: Node) -> None:
-        delay = WAIT_RETRY_DELAY
+        backoff = retry.Backoff(WAIT_RETRY_DELAY, WAIT_RETRY_MAX_DELAY)
         for attempt in range(self._wait_attempts):
             if self.kube_client.get_node(node.name) is None:
                 return
-            self.clock.sleep(delay)
-            delay = min(delay * 2, WAIT_RETRY_MAX_DELAY)
+            self.clock.sleep(backoff.next())
         log.error("waiting on node deletion for %s", node.name)
 
     def _set_unschedulable(self, unschedulable: bool, *names: str) -> Optional[str]:

@@ -41,6 +41,7 @@ from karpenter_core_tpu.solver.builder import NoProvisionersError, build_schedul
 from karpenter_core_tpu.solver.scheduler import SchedulerOptions, SchedulingResults
 from karpenter_core_tpu.state.cluster import Cluster
 from karpenter_core_tpu.utils import pod as pod_util
+from karpenter_core_tpu.utils import retry
 from karpenter_core_tpu.utils.clock import Clock
 
 log = logging.getLogger(__name__)
@@ -58,11 +59,39 @@ TPU_KERNEL_FALLBACK = REGISTRY.counter(
     "Batches that fell back from the TPU kernel to the host scheduler.",
     ("reason",),
 )
+DEGRADED_SOLVES = REGISTRY.counter(
+    "karpenter_degraded_solves_total",
+    "Solves served by the bounded host path while the solver-backend "
+    "circuit breaker was open.",
+    ("controller",),
+)
 
 # consecutive unexpected kernel failures (backend init/relay faults, not
-# KernelUnsupported routing) before the controller stops trying the device
-# path for the rest of the process lifetime
+# KernelUnsupported routing) before the solver-backend circuit breaker opens
+# and batches route through the degraded host path until the breaker's
+# half-open trial re-proves the backend
 TPU_KERNEL_MAX_FAILURES = 2
+# seconds the solver breaker stays open before half-opening one trial batch
+SOLVER_BREAKER_RESET_S = 30.0
+# degraded-mode bound: the host path is O(pods x nodes), so while the breaker
+# is open only this many pending pods solve per batch (the rest stay pending
+# and re-trigger); KC_DEGRADED_MAX_PODS overrides
+DEGRADED_MAX_PODS = 512
+
+
+def _node_write_rejected(e: Exception) -> bool:
+    """True when a failed node write provably never reached the store: a
+    chaos fault injected before the write, the client-error surface both
+    backends map those onto, or the apiserver itself answering 4xx.
+    Connection-level deaths (socket timeout reading the response) return
+    False — the write may have committed server-side."""
+    from karpenter_core_tpu import chaos
+    from karpenter_core_tpu.operator.kubeclient import ConflictError, NotFoundError
+
+    if isinstance(e, (chaos.InjectedFault, NotFoundError, ConflictError)):
+        return True
+    status = getattr(e, "status", None)  # kubeapi.client.ApiServerError
+    return isinstance(status, int) and 400 <= status < 500
 
 
 class Batcher:
@@ -222,15 +251,45 @@ class ProvisioningController:
             solver_endpoint if solver_endpoint is not None
             else os.environ.get("KC_SOLVER_ADDRESS", "")
         )
+        try:
+            self.degraded_max_pods = int(
+                os.environ.get("KC_DEGRADED_MAX_PODS", DEGRADED_MAX_PODS)
+            )
+        except ValueError:
+            self.degraded_max_pods = DEGRADED_MAX_PODS
+        if self.degraded_max_pods < 1:
+            # a non-positive bound would make every degraded batch solve an
+            # empty subset and re-trigger forever — a no-progress livelock
+            self.degraded_max_pods = DEGRADED_MAX_PODS
         self._solver_client = None
-        self._tpu_failures = 0
-        self._requeue_failures = 0
+        # the solver-backend breaker: counts unexpected kernel/relay faults
+        # (not KernelUnsupported routing); open = degraded mode (bounded host
+        # solves here, deprovisioning paused), half-open = one trial batch
+        # re-proves the device path.  Shared with the deprovisioning
+        # controller's consolidation sweep — same backend, one verdict.
+        self.solver_breaker = retry.CircuitBreaker(
+            self.clock,
+            failure_threshold=TPU_KERNEL_MAX_FAILURES,
+            reset_timeout_s=SOLVER_BREAKER_RESET_S,
+            name="solver-backend",
+        )
+        self._requeue_backoff = retry.Backoff(0.5, 60.0, max_exponent=7)
         self._warmup_started = False
         self._warmup_lock = threading.Lock()
         self._warmup_thread: Optional[threading.Thread] = None
         from karpenter_core_tpu.utils.pretty import ChangeMonitor
 
         self._change_monitor = ChangeMonitor(ttl_seconds=3600.0)
+
+    @property
+    def _tpu_failures(self) -> int:
+        """Consecutive solver-backend failures (the breaker's counter)."""
+        return self.solver_breaker.failure_count
+
+    def degraded(self) -> bool:
+        """True while the solver-backend breaker is open: provisioning runs
+        bounded host solves and deprovisioning pauses."""
+        return self.use_tpu_kernel and self.solver_breaker.state == retry.OPEN
 
     def trigger(self) -> None:
         self.batcher.trigger()
@@ -331,14 +390,13 @@ class ProvisioningController:
             # backoff on consecutive failures — a deterministic error (e.g.
             # exhausted cloud quota) must not become a 1 Hz hot loop of
             # cloud calls (controller-runtime's rate-limited requeue queue).
-            self._requeue_failures += 1
-            delay = min(0.5 * 2 ** min(self._requeue_failures - 1, 7), 60.0)
+            delay = self._requeue_backoff.next()
             log.warning("provisioning reconcile: %s (retry in %.1fs)", err, delay)
             timer = threading.Timer(delay, self.batcher.trigger)
             timer.daemon = True
             timer.start()
         else:
-            self._requeue_failures = 0
+            self._requeue_backoff.reset()
         return err
 
     def _reconcile_batch(self) -> Optional[str]:
@@ -426,49 +484,101 @@ class ProvisioningController:
                 if err is not None:
                     return None, err
             if self.use_tpu_kernel and len(pods) >= self.tpu_kernel_min_pods:
+                if not self.solver_breaker.allow():
+                    # breaker open: degraded mode.  Don't stall on (or even
+                    # touch) the dead backend — serve a bounded host solve
+                    # now; the breaker's half-open trial re-proves the device
+                    # path and promotes batches back automatically.
+                    TPU_KERNEL_FALLBACK.labels("degraded").inc()
+                    return self._schedule_degraded(pods, state_nodes), None
+                was_half_open = self.solver_breaker.state == retry.HALF_OPEN
                 try:
                     results = self._schedule_tpu(pods, state_nodes)
                 except NoProvisionersError:
+                    # precondition error, not a backend verdict: free the
+                    # half-open trial slot so a later batch can still probe
+                    self.solver_breaker.release_trial()
                     raise
                 except Exception as e:  # backend init/relay faults, not routing
-                    self._tpu_failures += 1
+                    self.solver_breaker.record_failure()
                     TPU_KERNEL_FALLBACK.labels("backend-error").inc()
                     log.warning(
                         "TPU kernel solve failed (%s: %s); falling back to the "
-                        "host scheduler (%d/%d consecutive failures)",
-                        type(e).__name__, e, self._tpu_failures,
-                        TPU_KERNEL_MAX_FAILURES,
+                        "host scheduler (%d/%d consecutive failures, breaker %s)",
+                        type(e).__name__, e, self.solver_breaker.failure_count,
+                        TPU_KERNEL_MAX_FAILURES, self.solver_breaker.state,
                     )
-                    if self._tpu_failures >= TPU_KERNEL_MAX_FAILURES:
-                        log.warning(
-                            "disabling the TPU kernel path for this process "
-                            "after %d consecutive failures", self._tpu_failures,
-                        )
-                        self.use_tpu_kernel = False
                     results = None
                 else:
-                    self._tpu_failures = 0
-                    if results is None:
+                    if results is not None:
+                        self.solver_breaker.record_success()
+                        if was_half_open:
+                            log.info(
+                                "solver backend recovered: breaker closed, "
+                                "device path restored"
+                            )
+                    else:
                         # shape routing (unsupported/entangled/under-min): the
-                        # batch runs on the host path by design, not by fault
+                        # batch runs on the host path by design, not by fault —
+                        # and it says NOTHING about the backend, so a half-open
+                        # trial must not close the breaker on it (the next
+                        # eligible batch probes instead); in the closed state
+                        # it keeps resetting the failure streak, as before
+                        if was_half_open:
+                            self.solver_breaker.release_trial()
+                        else:
+                            self.solver_breaker.record_success()
                         TPU_KERNEL_FALLBACK.labels("unsupported").inc()
                 if results is not None:
                     return results, None
-            scheduler = build_scheduler(
-                self.kube_client,
-                self.cloud_provider,
-                self.cluster,
-                pods,
-                state_nodes,
-                daemonset_pods=self.get_daemonset_pods(),
-                recorder=self.recorder,
-                opts=SchedulerOptions(),
-            )
-            return scheduler.solve(pods), None
+            return self._host_solve(pods, state_nodes), None
         except NoProvisionersError as e:
             return None, str(e)
         finally:
             done()
+
+    def _host_solve(self, pods: List[Pod], state_nodes) -> SchedulingResults:
+        """The exact host-oracle solve — the normal fallback path and the
+        degraded path build it identically so they cannot diverge."""
+        scheduler = build_scheduler(
+            self.kube_client,
+            self.cloud_provider,
+            self.cluster,
+            pods,
+            state_nodes,
+            daemonset_pods=self.get_daemonset_pods(),
+            recorder=self.recorder,
+            opts=SchedulerOptions(),
+        )
+        return scheduler.solve(pods)
+
+    def _schedule_degraded(self, pods: List[Pod], state_nodes) -> SchedulingResults:
+        """Bounded host-path greedy solve while the solver breaker is open.
+
+        The host oracle (solver/scheduler.py) is exact but O(pods x nodes);
+        degraded mode trades batch size for latency — at most
+        ``degraded_max_pods`` pods solve per batch, the remainder stays
+        pending and re-triggers shortly, so the cluster keeps converging
+        (slowly, correctly) instead of stalling behind a dead backend.
+        Everything this path emits carries ``degraded=true``."""
+        subset = pods[: self.degraded_max_pods]
+        deferred = len(pods) - len(subset)
+        DEGRADED_SOLVES.labels("provisioning").inc()
+        with tracing.span(
+            "schedule.degraded", degraded=True, pods=len(subset), deferred=deferred
+        ):
+            log.warning(
+                "degraded solve: solver breaker open, host-solving %d/%d "
+                "pending pods", len(subset), len(pods),
+            )
+            results = self._host_solve(subset, state_nodes)
+        if deferred:
+            # the deferred tail generates no new pod events, so wake the
+            # batcher ourselves once this batch's launches land
+            timer = threading.Timer(1.0, self.batcher.trigger)
+            timer.daemon = True
+            timer.start()
+        return results
 
     def _schedule_tpu(self, pods: List[Pod], state_nodes) -> Optional[SchedulingResults]:
         """Route the batch through the TPU kernel; None falls back to the host
@@ -980,6 +1090,7 @@ class ProvisioningController:
             # requeue retry once the cache catches up
             existing = self.kube_client.get_node(node.name)
             if existing is None or existing.spec.provider_id != node.spec.provider_id:
+                self._abandon_machine(created)
                 return None, (
                     f"node name {node.name} already taken by "
                     f"{existing.spec.provider_id if existing else 'an unsynced object'}; "
@@ -987,6 +1098,36 @@ class ProvisioningController:
                 )
             log.debug("node already registered")
         except Exception as e:  # noqa: BLE001 - surfaced to the caller
+            # compensate ONLY when the write provably did not land: the cache
+            # read alone cannot distinguish "server doesn't own the node"
+            # from "watch cache is behind" (the 409 branch above documents
+            # exactly that lag), so deleting the machine on a cache miss
+            # after an ambiguous transport death could strand a committed
+            # node object on a dead instance — the phantom this guard
+            # exists to prevent.  Provably-failed = not visibly ours AND the
+            # error says the server never applied the write (a pre-write
+            # injected fault, or the server itself answered 4xx).  Anything
+            # connection-level is ambiguous: keep the machine — the watch
+            # either delivers the node or the machine surfaces as a leak in
+            # the audit, both recoverable; a phantom is not.
+            try:
+                existing = self.kube_client.get_node(node.name)
+            except Exception:  # noqa: BLE001 - read failure: stay ambiguous
+                existing = None
+            visibly_ours = (
+                existing is not None
+                and existing.spec.provider_id == node.spec.provider_id
+            )
+            if not visibly_ours:
+                if _node_write_rejected(e):
+                    self._abandon_machine(created)
+                else:
+                    log.warning(
+                        "node %s create outcome ambiguous (%s: %s); keeping "
+                        "machine %s pending the watch",
+                        node.name, type(e).__name__, e,
+                        created.status.provider_id,
+                    )
             return None, f"creating node {node.name}, {e}"
         err = self.cluster.update_node(node)
         if err is not None:
@@ -996,3 +1137,18 @@ class ProvisioningController:
             for pod in machine_node.pods:
                 self.recorder.publish(evt.nominate_pod(pod, node))
         return node.name, None
+
+    def _abandon_machine(self, created) -> None:
+        """Compensate a node pre-create that provably never landed by
+        deleting the just-launched cloud instance — otherwise a kubeapi
+        fault landing between cloud.create and the node POST strands the
+        machine forever (no node object ever points at it, so no termination
+        path will).  Best-effort: a failed delete is retried by nothing, but
+        the chaos matrix's leak invariant is what surfaced the gap."""
+        try:
+            self.cloud_provider.delete(created)
+        except Exception as e:  # noqa: BLE001 - compensation must not mask the launch error
+            log.warning(
+                "abandoning machine %s after failed node create: %s",
+                created.status.provider_id, e,
+            )

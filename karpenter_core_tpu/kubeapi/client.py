@@ -59,10 +59,12 @@ from karpenter_core_tpu.kubeapi.reflector import Reflector
 from karpenter_core_tpu.kubeapi.resources import spec_for
 from karpenter_core_tpu.metrics import REGISTRY
 from karpenter_core_tpu.operator.kubeclient import (
+    KUBEAPI_PUT,
     ConflictError,
     NotFoundError,
     RateLimiter,
     WatchFunc,
+    raise_injected_kubeapi_fault,
 )
 
 log = logging.getLogger(__name__)
@@ -99,6 +101,15 @@ class _Transport:
         self.timeout_s = timeout_s
 
     def request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        if method != "GET":
+            # same chaos point, fault mapping, AND kind filter as the
+            # in-memory backend, so one scenario replays against either
+            fault = KUBEAPI_PUT.hit(
+                kinds=("error", "timeout"),
+                backend="apiserver", verb=method, path=path,
+            )
+            if fault is not None and fault.kind in ("error", "timeout"):
+                raise_injected_kubeapi_fault(fault)
         conn = HTTPConnection(self.host, self.port, timeout=self.timeout_s)
         try:
             payload = json.dumps(body).encode() if body is not None else None
@@ -150,9 +161,11 @@ class ApiServerClient:
         watch_timeout_s: float = 60.0,
         backoff_base_s: float = 0.2,
         backoff_cap_s: float = 30.0,
+        rng=None,
     ) -> None:
         import time as _time
 
+        self._clock = clock
         self._now = clock.now if clock is not None else _time.time
         self._sleep = clock.sleep if clock is not None else _time.sleep
         self._limiter = RateLimiter(qps, burst, now=self._now, sleep=self._sleep)
@@ -160,6 +173,9 @@ class ApiServerClient:
         self._watch_timeout_s = watch_timeout_s
         self._backoff_base_s = backoff_base_s
         self._backoff_cap_s = backoff_cap_s
+        # seedable watch-recovery jitter source, shared across this client's
+        # reflectors (tests/chaos scenarios pass retry.DeterministicRNG(seed))
+        self._rng = rng
         self._reflectors: Dict[type, Reflector] = {}
         self._lock = threading.Lock()
         self._closed = False
@@ -188,6 +204,8 @@ class ApiServerClient:
                 backoff_base_s=self._backoff_base_s,
                 backoff_cap_s=self._backoff_cap_s,
                 watch_timeout_s=self._watch_timeout_s,
+                rng=self._rng,
+                clock=self._clock,
             )
             self._reflectors[kind] = refl
         refl.start()

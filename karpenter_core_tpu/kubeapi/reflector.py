@@ -26,14 +26,19 @@ from __future__ import annotations
 
 import json
 import logging
-import random
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from karpenter_core_tpu.chaos import plane as chaos
 from karpenter_core_tpu.kubeapi.resources import ResourceSpec
 from karpenter_core_tpu.metrics import REGISTRY
+from karpenter_core_tpu.utils import retry
 
 log = logging.getLogger(__name__)
+
+# watch.stream: faults watch establishment (error/timeout/410) and event
+# delivery (duplicate) — the reflector's whole recovery ladder under one name
+WATCH_STREAM = chaos.point("watch.stream")
 
 WATCH_RESTARTS = REGISTRY.counter(
     "karpenter_kubeapi_watch_restarts_total",
@@ -58,12 +63,36 @@ class Reflector:
         backoff_base_s: float = 0.2,
         backoff_cap_s: float = 30.0,
         watch_timeout_s: float = 60.0,
+        rng: Optional[retry.DeterministicRNG] = None,
+        clock=None,
     ) -> None:
         self.spec = spec
         self.transport = transport
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
         self.watch_timeout_s = watch_timeout_s
+        # watch-recovery backoff used to call module-level random.random()
+        # with an unseeded global RNG, making recovery timing unreplayable;
+        # the injected DeterministicRNG (seedable by tests/chaos scenarios)
+        # keeps the same min(base*2^n, cap) * [0.5, 1.5) shape
+        self._backoff = retry.Backoff(
+            backoff_base_s, backoff_cap_s,
+            max_exponent=16, jitter=retry.JITTER_HALF, rng=rng,
+        )
+        # restart budget: the backoff resets on every successful LIST, so a
+        # server that accepts the connect and instantly drops the stream
+        # would otherwise hot-loop at base_s forever; once the budget drains,
+        # every further restart in the window waits the full cap.  The clock
+        # is injected (like the rng) so the window is steppable by FakeClock
+        # suites and unperturbed by chaos clock.skew scenarios
+        if clock is None:
+            from karpenter_core_tpu.utils.clock import Clock
+
+            clock = Clock()
+        self._restart_budget = retry.RetryBudget(
+            clock, budget=10, window_s=60.0,
+            name=f"watch-{spec.kind_name}",
+        )
 
         self.lock = threading.RLock()
         # serializes callback DISPATCH (not store access): a watch()
@@ -149,33 +178,43 @@ class Reflector:
     # -- the loop --------------------------------------------------------------
 
     def _run(self) -> None:
-        failures = 0
         while not self._stop.is_set():
             try:
                 self._list_and_sync()
                 self._synced.set()
-                failures = 0
+                self._backoff.reset()
                 self._watch()
             except _Gone:
                 WATCH_RESTARTS.labels(self.spec.kind_name, "gone").inc()
                 log.info("watch %s: history compacted (410), relisting",
                          self.spec.plural)
                 self._resume_rv = 0  # force a fresh LIST next round
-                continue  # no backoff: a relist is the designed recovery
+                # a lone 410 relists immediately (the designed recovery), but
+                # each iteration's successful LIST resets the backoff, so a
+                # server stuck answering 410 would spin full relists at line
+                # rate — the restart budget floors that storm at the cap
+                if not self._restart_budget.allow():
+                    self._stop.wait(self.backoff_cap_s)
+                continue
             except Exception as e:  # noqa: BLE001 - stream drops are routine
                 if self._stop.is_set():
                     return
-                failures += 1
                 WATCH_RESTARTS.labels(self.spec.kind_name, "drop").inc()
-                delay = min(
-                    self.backoff_base_s * (2 ** min(failures - 1, 16)),
-                    self.backoff_cap_s,
-                ) * (0.5 + random.random())  # full jitter
+                delay = self._next_restart_delay()
                 log.warning(
                     "watch %s dropped (%s: %s); retry %d in %.2fs",
-                    self.spec.plural, type(e).__name__, e, failures, delay,
+                    self.spec.plural, type(e).__name__, e,
+                    self._backoff.failures, delay,
                 )
                 self._stop.wait(delay)
+
+    def _next_restart_delay(self) -> float:
+        """Jittered exponential backoff, floored at the cap once the rolling
+        restart budget is spent — the per-kind storm backstop."""
+        delay = self._backoff.next()
+        if not self._restart_budget.allow():
+            return max(delay, self.backoff_cap_s)
+        return delay
 
     def _list_and_sync(self) -> None:
         """LIST and reconcile the store against it: the initial sync and every
@@ -209,6 +248,17 @@ class Reflector:
         self._resume_rv = max(self._resume_rv, list_rv)
 
     def _watch(self) -> None:
+        duplicate_events = False
+        fault = WATCH_STREAM.hit(
+            kinds=(chaos.KIND_ERROR, chaos.KIND_TIMEOUT, chaos.KIND_DUPLICATE),
+            kind_name=self.spec.kind_name, rv=self._resume_rv,
+        )
+        if fault is not None:
+            if fault.code == 410:
+                raise _Gone()
+            if fault.kind in (chaos.KIND_ERROR, chaos.KIND_TIMEOUT):
+                raise IOError(fault.describe())
+            duplicate_events = fault.kind == chaos.KIND_DUPLICATE
         path = (
             f"{self.spec.base_path()}?watch=true&resourceVersion={self._resume_rv}"
             f"&allowWatchBookmarks=true"
@@ -242,6 +292,11 @@ class Reflector:
                         raise _Gone()
                     raise IOError(f"watch error event: {wire}")
                 self.apply_event(etype, self.spec.from_dict(wire), rv)
+                if duplicate_events:
+                    # duplicate delivery: the per-key rv guard must drop the
+                    # replay — exactly the at-least-once semantics a real
+                    # watch resume exhibits
+                    self.apply_event(etype, self.spec.from_dict(wire), rv)
                 self._resume_rv = max(self._resume_rv, rv)
         finally:
             self._current_response = None

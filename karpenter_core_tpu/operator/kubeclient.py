@@ -27,8 +27,15 @@ from karpenter_core_tpu.apis.objects import (
     deep_copy,
 )
 from karpenter_core_tpu.apis.v1alpha5 import Machine, Provisioner
+from karpenter_core_tpu.chaos import plane as _chaos
 
 WatchFunc = Callable[[str, object], None]  # (event_type, object); ADDED|MODIFIED|DELETED
+
+# the kubeapi.put injection point covers every client-side mutation (create/
+# update/apply/delete) on BOTH kube backends: the in-memory client fires it in
+# _throttle(), the apiserver transport (kubeapi/client.py) imports this Point
+# and fires it per mutating HTTP request — one name, one registration.
+KUBEAPI_PUT = _chaos.point("kubeapi.put")
 
 
 class ConflictError(Exception):
@@ -37,6 +44,18 @@ class ConflictError(Exception):
 
 class NotFoundError(Exception):
     pass
+
+
+def raise_injected_kubeapi_fault(fault: "_chaos.Fault") -> None:
+    """Map an injected kubeapi fault onto the client error surface callers
+    already handle: 404 → NotFoundError, 409 → ConflictError, anything else
+    (incl. timeout kinds) → InjectedFault.  Shared by both backends so a
+    chaos scenario behaves identically against either."""
+    if fault.code == 404:
+        raise NotFoundError(fault.describe())
+    if fault.code == 409:
+        raise ConflictError(fault.describe())
+    raise _chaos.InjectedFault(fault)
 
 
 class RateLimiter:
@@ -122,6 +141,11 @@ class KubeClient:
 
     def _throttle(self) -> None:
         self._limiter.take()
+        fault = KUBEAPI_PUT.hit(
+            kinds=(_chaos.KIND_ERROR, _chaos.KIND_TIMEOUT), backend="memory"
+        )
+        if fault is not None and fault.kind in (_chaos.KIND_ERROR, _chaos.KIND_TIMEOUT):
+            raise_injected_kubeapi_fault(fault)
 
     def create(self, obj) -> object:
         self._throttle()

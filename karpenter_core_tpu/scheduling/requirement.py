@@ -11,7 +11,6 @@ mask slot there is this class's complement bit.
 
 from __future__ import annotations
 
-import random
 from typing import FrozenSet, Iterable, Optional
 
 from karpenter_core_tpu.apis import labels as labels_api
@@ -109,9 +108,27 @@ class Requirement:
         if op == OP_IN:
             return next(iter(self.values))
         if op in (OP_NOT_IN, OP_EXISTS):
+            # the smallest in-range value the complement set allows — the
+            # reference draws randomly here, but an unseeded draw makes label
+            # rendering unreplayable (chaos determinism gate) and can even
+            # land on an excluded value; deterministic-and-allowed is
+            # strictly better for both callers and tests
             lo = 0 if self.greater_than is None else self.greater_than + 1
             hi = (1 << 63) - 1 if self.less_than is None else self.less_than
-            return str(random.randrange(lo, hi))
+            if lo >= hi:
+                # empty integer domain (e.g. Gt 4 + Lt 5): surface the
+                # contradiction loudly, as the reference's randrange(lo, hi)
+                # did, instead of rendering a label the requirement excludes
+                raise ValueError(
+                    f"requirement {self.key} has no allowed value in [{lo}, {hi})"
+                )
+            # valid values are [lo, hi): stop at hi-1 so a fully-excluded
+            # range returns an in-range (if excluded) value, as the
+            # reference's randrange(lo, hi) did, never one past less_than
+            candidate = lo
+            while str(candidate) in self.values and candidate + 1 < hi:
+                candidate += 1
+            return str(candidate)
         return ""
 
     def insert(self, *items: str) -> None:

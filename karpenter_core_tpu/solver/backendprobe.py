@@ -33,9 +33,16 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from karpenter_core_tpu import tracing
+from karpenter_core_tpu.chaos import plane as chaos
 from karpenter_core_tpu.metrics import REGISTRY
 
 log = logging.getLogger(__name__)
+
+# solver.dispatch: faults device-backend work — probes here (error/timeout
+# kinds fail the attempt without spawning the child) and kernel dispatch in
+# solver/tpu.py (which imports this Point; error kinds surface as the
+# backend RuntimeError the provisioning breaker counts)
+SOLVER_DISPATCH = chaos.point("solver.dispatch")
 
 PROBE_SNIPPET = (
     "import jax, jax.numpy as jnp;"
@@ -163,6 +170,30 @@ def probe_once(timeout_s: Optional[float] = None, attempt: int = 0) -> ProbeResu
             platform=None, outcome="cached", error=record["error"],
             duration_s=0.0, attempt=attempt, cached=True,
         )
+    fault = SOLVER_DISPATCH.hit(
+        kinds=(chaos.KIND_ERROR, chaos.KIND_TIMEOUT), op="probe", attempt=attempt
+    )
+    if fault is not None and fault.kind in (chaos.KIND_ERROR, chaos.KIND_TIMEOUT):
+        outcome = "timeout" if fault.kind == chaos.KIND_TIMEOUT else "error"
+        PROBE_TOTAL.labels(outcome).inc()
+        PROBE_DURATION.labels(outcome).observe(0.0)
+        record = {
+            "event": "backend_probe",
+            "attempt": attempt,
+            "outcome": outcome,
+            "platform": None,
+            "duration_s": 0.0,
+            "error": fault.describe(),
+        }
+        log.info("%s", json.dumps(record))
+        tracing.add_event("backend.probe", **record)
+        result = ProbeResult(
+            platform=None, outcome=outcome, error=fault.describe(),
+            duration_s=0.0, attempt=attempt,
+        )
+        with _fail_lock:
+            _fail_cache = (time.monotonic(), result)
+        return result
     t0 = time.perf_counter()
     platform, outcome, error = None, "error", ""
     try:

@@ -746,7 +746,16 @@ class TPUSolver:
                 ex_state, ex_static = self.encode_existing(
                     snapshot, state_nodes, bound_pods
                 )
+        from karpenter_core_tpu.solver.backendprobe import SOLVER_DISPATCH
         from karpenter_core_tpu.utils import compilecache
+
+        fault = SOLVER_DISPATCH.hit(
+            kinds=("error", "timeout"), op="solve", classes=len(snapshot.classes)
+        )
+        if fault is not None and fault.kind in ("error", "timeout"):
+            # surface exactly like a dead relay: a RuntimeError from the
+            # first device op, which the provisioning breaker counts
+            raise RuntimeError(fault.describe())
 
         if n_slots <= 0:
             n_slots = solve_ops.estimate_slots(snapshot)  # snap_slots applied inside

@@ -60,6 +60,8 @@ class FakeApiServer:
         self._stores: Dict[tuple, _Store] = {}
         self._fail_next = 0
         self._fail_code = 500
+        self._fail_next_watch = 0
+        self._fail_watch_code = 500
         self._drop_epoch = 0  # bumped by drop_watch_connections()
         self._active_watches = 0
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -135,6 +137,14 @@ class FakeApiServer:
     def fail_next(self, n: int, code: int = 500) -> None:
         with self.lock:
             self._fail_next, self._fail_code = n, code
+
+    def fail_next_watch(self, n: int, code: int = 500) -> None:
+        """Fail the next ``n`` watch ESTABLISHMENTS (the HTTP request itself
+        returns ``code`` before any stream starts).  Distinct from
+        ``drop_watch_connections()``, which only kills streams already
+        established — this is the reflector's initial-connect backoff path."""
+        with self.lock:
+            self._fail_next_watch, self._fail_watch_code = n, code
 
     # -- store helpers (also the test-side seeding/assertion surface) ----------
 
@@ -324,6 +334,15 @@ class _Handler(BaseHTTPRequestHandler):
     def _watch(self, spec, query) -> None:
         fake = self.fake
         since_rv = int(query.get("resourceVersion", ["0"])[0] or 0)
+        with fake.lock:
+            if fake._fail_next_watch > 0:
+                fake._fail_next_watch -= 1
+                code = fake._fail_watch_code
+                fail_establishment = True
+            else:
+                fail_establishment = False
+        if fail_establishment:
+            return self._error(code, "injected watch establishment failure")
         with fake.lock:
             if since_rv and since_rv + 1 < fake._compacted_below:
                 # history below the floor is gone: the resume point is stale

@@ -50,7 +50,11 @@ class Environment:
     def make_node_ready(self, node: Node) -> None:
         """Emulate kubelet registration: Ready condition + real capacity, then
         run the lifecycle chain so the node initializes (the role of
-        ExpectMakeNodesReady in the reference suites)."""
+        ExpectMakeNodesReady in the reference suites).  Stillborn machines
+        (the fake provider's create-succeeds-but-never-registers failure
+        mode) have no kubelet to emulate, so they are skipped."""
+        if node.spec.provider_id in self.provider.stillborn_ids:
+            return
         ready = next((c for c in node.status.conditions if c.type == "Ready"), None)
         if ready is None:
             node.status.conditions.append(NodeCondition(type="Ready", status="True"))

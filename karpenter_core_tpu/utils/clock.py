@@ -10,10 +10,18 @@ from __future__ import annotations
 import threading
 import time as _time
 
+from karpenter_core_tpu.chaos import plane as _chaos
+
+# the clock.skew injection point: a standing offset applied to every now()
+# while a scenario with a "clock.skew" spec is armed (zero-cost otherwise —
+# chaos.current_skew_s is one global load + is-None check).  Registered here
+# so the chaos-hygiene exactly-once gate owns the name.
+CLOCK_SKEW = _chaos.point("clock.skew")
+
 
 class Clock:
     def now(self) -> float:
-        return _time.time()
+        return _time.time() + _chaos.current_skew_s()
 
     def sleep(self, seconds: float) -> None:
         _time.sleep(seconds)
@@ -26,7 +34,7 @@ class FakeClock(Clock):
 
     def now(self) -> float:
         with self._lock:
-            return self._now
+            return self._now + _chaos.current_skew_s()
 
     def sleep(self, seconds: float) -> None:
         self.step(seconds)

@@ -73,6 +73,16 @@ def budget_for(nodeid: str) -> int:
 
 
 @pytest.fixture(autouse=True)
+def _chaos_disarm():
+    """A chaos scenario armed by a failing test must never leak into the
+    next test — the plane is process-global."""
+    yield
+    from karpenter_core_tpu import chaos
+
+    chaos.disarm()
+
+
+@pytest.fixture(autouse=True)
 def _retrace_budget(request):
     if os.environ.get("KC_RETRACE_BUDGET", "1") == "0":
         yield

@@ -922,3 +922,68 @@ class TestRetraceManifest:
             assert row["compiles"] <= budget, (
                 f"{row['test']}: {row['compiles']} compiles > budget {budget}"
             )
+
+
+class TestChaosHygienePass:
+    """chaos-hygiene: point registration uniqueness + the determinism gate."""
+
+    def _run(self, tmp_path, files):
+        from karpenter_core_tpu.analysis.passes import chaos_hygiene
+
+        return chaos_hygiene.run(make_project(tmp_path, files))
+
+    def test_duplicate_registration_fires_at_both_sites(self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/a.py": textwrap.dedent("""
+                from karpenter_core_tpu import chaos
+                P = chaos.point("cloud.create")
+            """),
+            "badpkg/b.py": textwrap.dedent("""
+                from karpenter_core_tpu import chaos
+                Q = chaos.point("cloud.create")
+            """),
+        })
+        dups = [f for f in found if f.rule == "point-duplicate"]
+        assert len(dups) == 2
+        assert {f.path for f in dups} == {"badpkg/a.py", "badpkg/b.py"}
+
+    def test_nonliteral_point_name_fires(self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/a.py": textwrap.dedent("""
+                from karpenter_core_tpu import chaos
+                NAME = "computed"
+                P = chaos.point(NAME)
+            """),
+        })
+        assert rules_of(found) == {"point-nonliteral"}
+
+    def test_random_import_in_production_module_fires(self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/logic.py": "import random\nx = 1\n",
+            "badpkg/sec.py": "import secrets\ny = 2\n",
+        })
+        rules = [(f.path, f.rule) for f in found]
+        assert ("badpkg/logic.py", "nondeterminism") in rules
+        assert ("badpkg/sec.py", "nondeterminism") in rules
+
+    def test_chaos_subtree_is_exempt(self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/chaos/__init__.py": "",
+            "badpkg/chaos/scenario.py": "import random\nx = 1\n",
+        })
+        assert found == []
+
+    def test_unique_registrations_and_rng_are_clean(self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/a.py": textwrap.dedent("""
+                from karpenter_core_tpu import chaos
+                P = chaos.point("kubeapi.put")
+                Q = chaos.point("cloud.create")
+            """),
+        })
+        assert found == []
+
+    def test_current_tree_clean(self, repo_project):
+        from karpenter_core_tpu.analysis.passes import chaos_hygiene
+
+        assert chaos_hygiene.run(repo_project) == []

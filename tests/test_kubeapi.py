@@ -276,6 +276,46 @@ class TestWatchPlane:
         # the backoff ladder — start() still syncs within its deadline
         assert client.list(Provisioner) == []
 
+    def test_watch_establishment_failure_is_retried(self, client, server):
+        # fail_next only covers plain requests; fail_next_watch fails the
+        # watch HTTP request itself, exercising the reflector's
+        # initial-connect backoff path (LIST succeeds, WATCH 500s, retry)
+        from karpenter_core_tpu.kubeapi.reflector import WATCH_RESTARTS
+
+        before = WATCH_RESTARTS.labels("Pod", "drop").value
+        server.fail_next_watch(2)
+        refl = client.reflector(Pod)  # start() returns once LIST synced
+        # both failed establishments count as drops, then the stream recovers
+        assert wait_for(
+            lambda: WATCH_RESTARTS.labels("Pod", "drop").value >= before + 2
+        )
+        assert server.wait_for_watches(1)
+        other = ApiServerClient(server.url, FakeClock(), backoff_base_s=0.05)
+        other.create(make_pod(name="after-establishment-failures"))
+        assert wait_for(
+            lambda: client.get_pod("default", "after-establishment-failures")
+            is not None
+        )
+        other.close()
+
+    def test_watch_recovery_backoff_is_seed_replayable(self, server):
+        # the reflector's watch-recovery jitter routes through the injected
+        # DeterministicRNG: same seed, same backoff schedule (the bug this
+        # fixes: module-level unseeded random made recovery timing
+        # untestable)
+        from karpenter_core_tpu.utils import retry
+
+        delays = []
+        for _ in range(2):
+            c = ApiServerClient(
+                server.url, FakeClock(), backoff_base_s=0.05,
+                backoff_cap_s=0.5, rng=retry.DeterministicRNG(1234),
+            )
+            refl = c.reflector(Pod)
+            delays.append([refl._backoff.next() for _ in range(6)])
+            c.close()
+        assert delays[0] == delays[1]
+
     def test_watch_restart_metric_counts_drops(self, client, server):
         from karpenter_core_tpu.kubeapi.reflector import WATCH_RESTARTS
 

@@ -225,4 +225,7 @@ class TestRemoteSolveRouting:
             pods = make_pods(3, requests={"cpu": "100m"})
             result = expect_provisioned(env, *pods)
             assert all(result[p.uid] is not None for p in pods)  # host fallback
-        assert env.provisioning.use_tpu_kernel is False
+        from karpenter_core_tpu.utils import retry
+
+        assert env.provisioning.solver_breaker.state == retry.OPEN
+        assert env.provisioning.degraded() is True

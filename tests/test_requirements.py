@@ -153,6 +153,25 @@ class TestOperators:
         for _ in range(16):
             assert r.has(r.any())
 
+    def test_any_skips_excluded_values_in_range(self):
+        r = Requirement._raw("k", True, frozenset({"3"}), greater_than=2, less_than=5)
+        assert r.any() == "4" and r.has(r.any())
+
+    def test_any_never_exceeds_exclusive_less_than(self):
+        # fully-excluded range [3, 4): no allowed value exists, but the
+        # result must stay in range (the reference's randrange semantics),
+        # never one past less_than
+        r = Requirement._raw("k", True, frozenset({"3"}), greater_than=2, less_than=4)
+        assert r.any() == "3"
+
+    def test_any_raises_on_empty_integer_domain(self):
+        # Gt 4 + Lt 5 allows no integer at all: surface the contradiction
+        # loudly (the reference's randrange(5, 5) raised), never render a
+        # label equal to the exclusive bound
+        r = Requirement._raw("k", True, frozenset(), greater_than=4, less_than=5)
+        with pytest.raises(ValueError):
+            r.any()
+
 
 class TestNormalization:
     def test_normalized_labels(self):

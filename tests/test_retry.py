@@ -1,0 +1,302 @@
+"""utils/retry: the single backoff / retry-budget / circuit-breaker
+implementation.
+
+Covers jitter bounds, budget exhaustion under FakeClock, the breaker's
+open → half-open → closed ladder, and — because this module REPLACED three
+hand-rolled copies — equivalence tests pinning the deprovisioning requeue,
+deprovisioning wait-retry, provisioning requeue, and reflector watch-recovery
+sequences to their pre-refactor values.
+"""
+
+import pytest
+
+from karpenter_core_tpu.metrics import REGISTRY
+from karpenter_core_tpu.utils import retry
+from karpenter_core_tpu.utils.clock import FakeClock
+
+
+class TestDeterministicRNG:
+    def test_same_seed_same_sequence(self):
+        a = retry.DeterministicRNG(1234)
+        b = retry.DeterministicRNG(1234)
+        assert [a.random() for _ in range(32)] == [b.random() for _ in range(32)]
+
+    def test_different_seeds_differ(self):
+        a = retry.DeterministicRNG(1)
+        b = retry.DeterministicRNG(2)
+        assert [a.random() for _ in range(8)] != [b.random() for _ in range(8)]
+
+    def test_uniform_bounds(self):
+        rng = retry.DeterministicRNG(99)
+        for _ in range(1000):
+            u = rng.random()
+            assert 0.0 <= u < 1.0
+
+
+class TestBackoff:
+    def test_deterministic_doubling(self):
+        b = retry.Backoff(1.0, 10.0)
+        assert [b.next() for _ in range(6)] == [1.0, 2.0, 4.0, 8.0, 10.0, 10.0]
+
+    def test_reset(self):
+        b = retry.Backoff(1.0, 10.0)
+        b.next(), b.next(), b.next()
+        b.reset()
+        assert b.next() == 1.0
+
+    def test_max_exponent_caps_growth(self):
+        b = retry.Backoff(0.5, 1e9, max_exponent=3)
+        assert [b.next() for _ in range(6)] == [0.5, 1.0, 2.0, 4.0, 4.0, 4.0]
+
+    def test_half_jitter_bounds(self):
+        b = retry.Backoff(
+            1.0, 64.0, jitter=retry.JITTER_HALF, rng=retry.DeterministicRNG(7)
+        )
+        for attempt in range(1, 12):
+            base = b.for_attempt(attempt)
+            delay = b.next()
+            assert 0.5 * base <= delay < 1.5 * base
+
+    def test_full_jitter_bounds(self):
+        b = retry.Backoff(
+            1.0, 64.0, jitter=retry.JITTER_FULL, rng=retry.DeterministicRNG(7)
+        )
+        for attempt in range(1, 12):
+            base = b.for_attempt(attempt)
+            delay = b.next()
+            assert 0.0 < delay <= base
+
+    def test_jittered_sequence_replays_from_seed(self):
+        mk = lambda: retry.Backoff(
+            0.2, 30.0, jitter=retry.JITTER_HALF, rng=retry.DeterministicRNG(42)
+        )
+        a, b = mk(), mk()
+        assert [a.next() for _ in range(10)] == [b.next() for _ in range(10)]
+
+    def test_unknown_jitter_mode_rejected(self):
+        with pytest.raises(ValueError):
+            retry.Backoff(1.0, 2.0, jitter="bogus")
+
+
+class TestPreRefactorEquivalence:
+    """The three deleted hand-rolled implementations, pinned."""
+
+    def test_deprovisioning_requeue_sequence(self):
+        # controllers/deprovisioning.py _next_backoff was
+        #   backoff = min(max(prev * 2, 1.0), POLLING_PERIOD)
+        from karpenter_core_tpu.controllers.deprovisioning import POLLING_PERIOD
+
+        old, prev = [], 0.0
+        for _ in range(6):
+            prev = min(max(prev * 2, 1.0), POLLING_PERIOD)
+            old.append(prev)
+        b = retry.Backoff(1.0, POLLING_PERIOD)
+        assert [b.next() for _ in range(6)] == old == [1, 2, 4, 8, 10, 10]
+
+    def test_deprovisioning_wait_retry_sequence(self):
+        # _wait_for_initialized/wait_for_deletion: delay = 2.0 doubling to 10.0
+        from karpenter_core_tpu.controllers.deprovisioning import (
+            WAIT_RETRY_DELAY,
+            WAIT_RETRY_MAX_DELAY,
+        )
+
+        old, delay = [], WAIT_RETRY_DELAY
+        for _ in range(6):
+            old.append(delay)
+            delay = min(delay * 2, WAIT_RETRY_MAX_DELAY)
+        b = retry.Backoff(WAIT_RETRY_DELAY, WAIT_RETRY_MAX_DELAY)
+        assert [b.next() for _ in range(6)] == old == [2, 4, 8, 10, 10, 10]
+
+    def test_provisioning_requeue_sequence(self):
+        # controllers/provisioning.py: min(0.5 * 2 ** min(n - 1, 7), 60.0)
+        old = [min(0.5 * 2 ** min(n - 1, 7), 60.0) for n in range(1, 10)]
+        b = retry.Backoff(0.5, 60.0, max_exponent=7)
+        assert [b.next() for _ in range(9)] == old
+        assert old[-2:] == [60.0, 60.0]
+
+    def test_reflector_watch_recovery_shape(self):
+        # kubeapi/reflector.py: min(base * 2^min(f-1, 16), cap) * (0.5 + u)
+        rng_old = retry.DeterministicRNG(5)
+        rng_new = retry.DeterministicRNG(5)
+        b = retry.Backoff(
+            0.2, 30.0, max_exponent=16, jitter=retry.JITTER_HALF, rng=rng_new
+        )
+        for failures in range(1, 12):
+            old = min(0.2 * (2 ** min(failures - 1, 16)), 30.0) * (
+                0.5 + rng_old.random()
+            )
+            assert b.next() == pytest.approx(old)
+
+    def test_controllers_actually_use_the_shared_impl(self):
+        from karpenter_core_tpu.kubeapi.reflector import Reflector
+        from karpenter_core_tpu.testing.harness import make_environment
+
+        env = make_environment()
+        assert isinstance(env.provisioning._requeue_backoff, retry.Backoff)
+        assert isinstance(env.deprovisioning._retry_backoff, retry.Backoff)
+        assert isinstance(env.provisioning.solver_breaker, retry.CircuitBreaker)
+        # the sweep shares the provisioning breaker: one backend, one verdict
+        assert (
+            env.deprovisioning.multi_node_consolidation.solver_breaker
+            is env.provisioning.solver_breaker
+        )
+        refl = Reflector.__init__.__code__
+        assert "rng" in refl.co_varnames  # injectable watch-recovery RNG
+
+    def test_reflector_restart_budget_clamps_a_restart_storm(self):
+        # the reflector's backoff resets on every successful LIST, so a
+        # connect-then-instant-drop server would hot-loop at base_s; once the
+        # rolling budget drains, every restart waits the full cap
+        from karpenter_core_tpu.kubeapi.reflector import Reflector
+        from karpenter_core_tpu.kubeapi.resources import spec_for
+        from karpenter_core_tpu.apis.objects import Pod
+
+        refl = Reflector(
+            spec_for(Pod), transport=None,
+            backoff_base_s=0.01, backoff_cap_s=5.0,
+            rng=retry.DeterministicRNG(1),
+        )
+        clock = FakeClock()
+        refl._restart_budget = retry.RetryBudget(
+            clock, budget=3, window_s=60.0, name="storm-test"
+        )
+        delays = []
+        for _ in range(6):
+            refl._backoff.reset()  # what a successful LIST does
+            delays.append(refl._next_restart_delay())
+        assert all(d < 1.0 for d in delays[:3])  # within budget: jittered base
+        assert all(d >= 5.0 for d in delays[3:])  # budget spent: full cap
+
+
+class TestRetryBudget:
+    def test_budget_exhaustion(self):
+        clock = FakeClock()
+        budget = retry.RetryBudget(clock, budget=3, window_s=60.0, name="t1")
+        assert [budget.allow() for _ in range(5)] == [True, True, True, False, False]
+
+    def test_budget_refills_over_the_window(self):
+        clock = FakeClock()
+        budget = retry.RetryBudget(clock, budget=2, window_s=10.0, name="t2")
+        assert budget.allow() and budget.allow()
+        assert not budget.allow()
+        clock.step(5.0)  # half the window refills one token
+        assert budget.allow()
+        assert not budget.allow()
+        clock.step(100.0)  # refill caps at the budget
+        assert budget.remaining() == pytest.approx(2.0)
+
+    def test_exhaustion_is_counted(self):
+        clock = FakeClock()
+        budget = retry.RetryBudget(clock, budget=1, window_s=60.0, name="t3")
+        budget.allow()
+        before = retry.RETRY_BUDGET_EXHAUSTED.labels("t3").value
+        budget.allow()
+        assert retry.RETRY_BUDGET_EXHAUSTED.labels("t3").value == before + 1
+
+
+class TestCircuitBreaker:
+    def make(self, clock, **kw):
+        kw.setdefault("failure_threshold", 2)
+        kw.setdefault("reset_timeout_s", 30.0)
+        kw.setdefault("name", "test-breaker")
+        return retry.CircuitBreaker(clock, **kw)
+
+    def test_closed_allows_and_failures_below_threshold_stay_closed(self):
+        clock = FakeClock()
+        breaker = self.make(clock)
+        assert breaker.allow()
+        breaker.record_failure()
+        assert breaker.state == retry.CLOSED
+        assert breaker.allow()
+
+    def test_opens_at_threshold_and_blocks(self):
+        clock = FakeClock()
+        breaker = self.make(clock)
+        breaker.record_failure()
+        breaker.record_failure()
+        assert breaker.state == retry.OPEN
+        assert not breaker.allow()
+
+    def test_success_resets_the_failure_count(self):
+        clock = FakeClock()
+        breaker = self.make(clock)
+        breaker.record_failure()
+        breaker.record_success()
+        breaker.record_failure()
+        assert breaker.state == retry.CLOSED  # never reached 2 consecutive
+
+    def test_half_open_after_reset_timeout_single_trial(self):
+        clock = FakeClock()
+        breaker = self.make(clock)
+        breaker.record_failure(), breaker.record_failure()
+        clock.step(29.0)
+        assert not breaker.allow()  # still open
+        clock.step(2.0)
+        assert breaker.state == retry.HALF_OPEN
+        assert breaker.allow()  # the one trial
+        assert not breaker.allow()  # no second trial in the window
+
+    def test_half_open_trial_success_closes(self):
+        clock = FakeClock()
+        breaker = self.make(clock)
+        breaker.record_failure(), breaker.record_failure()
+        clock.step(31.0)
+        assert breaker.allow()
+        breaker.record_success()
+        assert breaker.state == retry.CLOSED
+        assert breaker.allow()
+
+    def test_half_open_trial_failure_reopens(self):
+        clock = FakeClock()
+        breaker = self.make(clock)
+        breaker.record_failure(), breaker.record_failure()
+        clock.step(31.0)
+        assert breaker.allow()
+        breaker.record_failure()  # one failure in half-open reopens immediately
+        assert breaker.state == retry.OPEN
+        assert not breaker.allow()
+        clock.step(31.0)  # and the reopen restarted the reset window
+        assert breaker.state == retry.HALF_OPEN
+
+    def test_state_visible_on_metrics(self):
+        clock = FakeClock()
+        breaker = self.make(clock, name="metrics-breaker")
+        assert retry.BREAKER_STATE.labels("metrics-breaker").value == 0.0
+        breaker.record_failure(), breaker.record_failure()
+        assert retry.BREAKER_STATE.labels("metrics-breaker").value == 2.0
+        clock.step(31.0)
+        breaker.state  # reading transitions open -> half-open
+        assert retry.BREAKER_STATE.labels("metrics-breaker").value == 1.0
+        rendered = REGISTRY.render()
+        assert 'karpenter_circuit_breaker_state{breaker="metrics-breaker"} 1.0' in rendered
+        assert "karpenter_circuit_breaker_transitions_total" in rendered
+
+    def test_release_trial_frees_the_half_open_slot(self):
+        # a trial that ends with NO backend verdict (shape routing,
+        # precondition error) must not wedge the breaker half-open forever
+        clock = FakeClock()
+        breaker = self.make(clock)
+        breaker.record_failure(), breaker.record_failure()
+        clock.step(31.0)
+        assert breaker.allow()
+        breaker.release_trial()  # no-verdict exit
+        assert breaker.state == retry.HALF_OPEN
+        assert breaker.allow()  # the slot is free again
+        breaker.record_success()
+        assert breaker.state == retry.CLOSED
+
+    def test_state_change_hook(self):
+        clock = FakeClock()
+        seen = []
+        breaker = self.make(
+            clock, name="hooked", on_state_change=lambda a, b: seen.append((a, b))
+        )
+        breaker.record_failure(), breaker.record_failure()
+        clock.step(31.0)
+        breaker.allow()
+        breaker.record_success()
+        assert seen == [
+            (retry.CLOSED, retry.OPEN),
+            (retry.OPEN, retry.HALF_OPEN),
+            (retry.HALF_OPEN, retry.CLOSED),
+        ]

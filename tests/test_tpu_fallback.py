@@ -3,8 +3,10 @@
 The Operator facade defaults the device kernel ON (matching the binary's
 KC_TPU_KERNEL default, cmd/operator.py) — VERDICT r2 weak #7.  When the
 backend faults at solve time (relay down, init failure), batches must land on
-the host scheduler with no pods lost, and repeated faults must self-disable
-the device path for the process (circuit-breaker, not per-batch retry storms).
+the host scheduler with no pods lost, and repeated faults open the shared
+solver-backend circuit breaker (utils/retry.CircuitBreaker): batches run
+degraded on the host path without touching the backend until the breaker's
+half-open trial re-proves the device path.
 """
 
 import pytest
@@ -14,6 +16,7 @@ from karpenter_core_tpu.controllers import provisioning as prov_mod
 from karpenter_core_tpu.operator.operator import Operator
 from karpenter_core_tpu.testing import make_pods, make_provisioner
 from karpenter_core_tpu.testing.harness import expect_provisioned, make_environment
+from karpenter_core_tpu.utils import retry
 
 
 class TestTPUDefaultOn:
@@ -59,7 +62,7 @@ class TestGracefulFallback:
         assert all(result[p.uid] is not None for p in pods)
         assert _ExplodingSolver.calls == 1
 
-    def test_repeated_backend_failures_disable_kernel(self, env, monkeypatch):
+    def test_repeated_backend_failures_open_the_breaker(self, env, monkeypatch):
         import karpenter_core_tpu.solver.tpu as tpu_mod
 
         _ExplodingSolver.calls = 0
@@ -68,9 +71,60 @@ class TestGracefulFallback:
             pods = make_pods(3, requests={"cpu": "100m"})
             result = expect_provisioned(env, *pods)
             assert all(result[p.uid] is not None for p in pods)
-        # circuit broke after MAX_FAILURES; later batches never touch the solver
+        # breaker opened after MAX_FAILURES; while open (FakeClock frozen),
+        # later batches run degraded and never touch the solver
         assert _ExplodingSolver.calls == prov_mod.TPU_KERNEL_MAX_FAILURES
-        assert env.provisioning.use_tpu_kernel is False
+        assert env.provisioning.solver_breaker.state == retry.OPEN
+        assert env.provisioning.degraded() is True
+        # the device path stays CONFIGURED — recovery is the breaker's job
+        assert env.provisioning.use_tpu_kernel is True
+
+    def test_breaker_half_open_trial_restores_the_kernel_path(self, env, monkeypatch):
+        import karpenter_core_tpu.solver.tpu as tpu_mod
+
+        _ExplodingSolver.calls = 0
+        monkeypatch.setattr(tpu_mod, "TPUSolver", _ExplodingSolver)
+        for _ in range(prov_mod.TPU_KERNEL_MAX_FAILURES):
+            expect_provisioned(env, *make_pods(3, requests={"cpu": "100m"}))
+        assert env.provisioning.solver_breaker.state == retry.OPEN
+
+        # past the reset timeout the breaker half-opens; a healthy trial
+        # batch (stubbed solve) closes it and restores the device path
+        env.clock.step(prov_mod.SOLVER_BREAKER_RESET_S + 1)
+        assert env.provisioning.solver_breaker.state == retry.HALF_OPEN
+
+        from karpenter_core_tpu.solver.scheduler import SchedulingResults
+
+        monkeypatch.setattr(
+            env.provisioning, "_schedule_tpu",
+            lambda pods, state_nodes: SchedulingResults(),
+        )
+        pods = make_pods(3, requests={"cpu": "100m"})
+        expect_provisioned(env, *pods)
+        assert env.provisioning.solver_breaker.state == retry.CLOSED
+        assert env.provisioning.degraded() is False
+
+    def test_half_open_unsupported_routing_does_not_close_the_breaker(self, env, monkeypatch):
+        import karpenter_core_tpu.solver.tpu as tpu_mod
+
+        _ExplodingSolver.calls = 0
+        monkeypatch.setattr(tpu_mod, "TPUSolver", _ExplodingSolver)
+        for _ in range(prov_mod.TPU_KERNEL_MAX_FAILURES):
+            expect_provisioned(env, *make_pods(3, requests={"cpu": "100m"}))
+        env.clock.step(prov_mod.SOLVER_BREAKER_RESET_S + 1)
+        assert env.provisioning.solver_breaker.state == retry.HALF_OPEN
+
+        # the trial batch shape-routes to the host (None): that is a shape
+        # verdict, not backend evidence — the breaker must stay half-open
+        # with the trial slot freed, not flap closed
+        monkeypatch.setattr(
+            env.provisioning, "_schedule_tpu", lambda pods, state_nodes: None
+        )
+        pods = make_pods(3, requests={"cpu": "100m"})
+        result = expect_provisioned(env, *pods)
+        assert all(result[p.uid] is not None for p in pods)  # host solved it
+        assert env.provisioning.solver_breaker.state == retry.HALF_OPEN
+        assert env.provisioning.solver_breaker.allow()  # next batch can probe
 
     @pytest.mark.compile  # the restored real solver compiles -- slow tier
     def test_success_resets_failure_counter(self, env, monkeypatch):
