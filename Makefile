@@ -4,13 +4,16 @@ PYTEST ?= python -m pytest
 
 presubmit: verify test kernel-smoke perf-gate  ## everything a PR needs to pass
 
-verify: chaos  ## static checks + the chaos gate: bytecode-compile, kcanalyze (all analysis passes, baseline-aware), build the native library
+verify: chaos soak  ## static checks + the chaos and soak gates: bytecode-compile, kcanalyze (all analysis passes, baseline-aware), build the native library
 	python -m compileall -q karpenter_core_tpu tests bench.py __graft_entry__.py
 	python tools/kcanalyze.py
 	$(MAKE) -C native
 
 chaos:  ## tier-1 chaos subset with a fixed seed: seeded fault scenarios must converge leak-free (docs/CHAOS.md)
 	KC_CHAOS_SEED=1729 $(PYTEST) tests/test_chaos_matrix.py tests/test_retry.py -q -m "not slow"
+
+soak:  ## tier-1 soak smoke with a fixed seed: one deterministic trace-driven scenario must meet its SLO spec and replay byte-identically (docs/SOAK.md)
+	KC_SOAK_SEED=1729 $(PYTEST) tests/test_soak.py -q -m "not slow"
 
 test:  ## fast behavioral tier (virtual 8-device CPU mesh, ~2 min)
 	$(PYTEST) tests/ -x -q -m "not compile and not slow"
@@ -33,4 +36,4 @@ bench:  ## headline benchmark on the available accelerator
 graft-check:  ## driver contract: compile check + multi-chip dry run
 	python __graft_entry__.py
 
-.PHONY: presubmit verify chaos test test-all kernel-smoke perf perf-gate bench graft-check
+.PHONY: presubmit verify chaos soak test test-all kernel-smoke perf perf-gate bench graft-check

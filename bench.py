@@ -477,6 +477,21 @@ def main() -> None:
         results.new_nodes[0].instance_type_names  # noqa: B018 - forces the fetch
     materialize_s = time.perf_counter() - t0
 
+    # solve vs decode split: solve_decode_s above is deliberately fused (no
+    # sync between solve and decode saves a relay round trip on the headline
+    # path), which also fused the r05 finding — decode was 98% of wall time
+    # and invisible.  ONE extra pass with an explicit device sync between the
+    # stages attributes device compute to solve_s and transfer + host
+    # expansion to decode_s; tools/perfgate.py gates each independently so
+    # the pipelining work has a stable baseline.
+    t0 = time.perf_counter()
+    out = solve_ops.solve(snapshot)
+    solve_ops.sync_outputs(out)
+    t1 = time.perf_counter()
+    solver.decode(snapshot, out)
+    t2 = time.perf_counter()
+    solve_s, decode_s = t1 - t0, t2 - t1
+
     # per-stage trace: ONE extra solve with tracing on (span close syncs the
     # device, so stage attribution is exact) — run OUTSIDE the timed loop so
     # the sync points can't perturb the headline number.  The trace rides the
@@ -512,6 +527,8 @@ def main() -> None:
         "encode_s": round(encode_s, 4),
         "dispatch_s": round(dispatch_s, 4),
         "solve_decode_s": round(solve_decode_s, 4),
+        "solve_s": round(solve_s, 4),
+        "decode_s": round(decode_s, 4),
         "materialize_s": round(materialize_s, 4),
         "trace": trace_detail,
         "platform": _BACKEND["platform"],
@@ -559,6 +576,22 @@ def main() -> None:
                 detail[key] = fn()
             except Exception as e:  # noqa: BLE001 - scale lines never kill the headline
                 detail[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    # CPU fallback is a headline fact, not a detail footnote: rounds r02-r05
+    # silently benched a dead relay's CPU fallback and nobody noticed until
+    # the numbers were compared.  One loud banner at the top of the report.
+    if _BACKEND["fell_back"]:
+        failures = "; ".join(_BACKEND["probe_failures"][:3]) or "(none recorded)"
+        print(
+            "=" * 72
+            + "\nbench: WARNING backend_fell_back_to_cpu=true — every"
+            " accelerator probe\nbench: failed; this number was measured ON"
+            " CPU, not the accelerator.\n"
+            f"bench: probe failures: {failures}\n"
+            "bench: per-attempt records (incl. probe-side stderr_tail) ride"
+            " detail.backend_probes\n" + "=" * 72,
+            file=sys.stderr,
+        )
 
     line = {
         "metric": f"solve_{n_pods // 1000}k_pods_{n_its}_types_wall_clock",

@@ -22,9 +22,11 @@ New with the framework:
                       harness) and tests/ are exempt
   wallclock           ``time.time()`` / ``datetime.now()`` /
                       ``datetime.utcnow()`` in the reconcile world
-                      (controllers/, state/, operator/, solver/, kubeapi/):
-                      TTL logic must go through utils/clock.Clock so suites
-                      can advance time deterministically
+                      (controllers/, state/, operator/, solver/, kubeapi/,
+                      soak/): TTL logic and soak timelines must go through
+                      utils/clock.Clock so suites advance time
+                      deterministically (and soak verdicts replay from
+                      their seed)
 """
 
 from __future__ import annotations
@@ -45,7 +47,9 @@ NAME = "hygiene"
 MAX_LINE = 120
 
 # package subtrees where wall-clock reads must route through utils/clock.py
-_CLOCKED_DIRS = ("controllers", "state", "operator", "solver", "kubeapi")
+# (soak/ is in: its probes and traces live on the FakeClock timeline, and a
+# stray wall read would silently break verdict seed-replay)
+_CLOCKED_DIRS = ("controllers", "state", "operator", "solver", "kubeapi", "soak")
 _WALLCLOCK_CALLS = {
     "time.time", "datetime.now", "datetime.utcnow",
     "datetime.datetime.now", "datetime.datetime.utcnow",

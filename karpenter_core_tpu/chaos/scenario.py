@@ -62,6 +62,21 @@ class PointSpec:
         if self.schedule is not None:
             self.schedule = sorted(int(i) for i in self.schedule)
 
+    def to_dict(self) -> dict:
+        """The spec back in ``from_dict`` form (defaults omitted) — embedded
+        in soak verdict reports so a run carries its exact fault plan."""
+        out: dict = {}
+        for key in ("prob", "schedule", "first_n", "code", "message",
+                    "delay_s", "stop_after"):
+            value = getattr(self, key)
+            if value:
+                out[key] = value
+        if self.kind != plane.KIND_ERROR or not out:
+            out["kind"] = self.kind
+        if self.data:
+            out["data"] = dict(self.data)
+        return out
+
 
 class Scenario:
     """An armable, seeded fault plan over named injection points."""
@@ -92,6 +107,17 @@ class Scenario:
     @classmethod
     def from_toml(cls, text: str) -> "Scenario":
         return cls.from_dict(_parse_mini_toml(text))
+
+    def to_dict(self) -> dict:
+        """Round-trips through ``from_dict`` — the replay-workflow spec a
+        soak verdict report embeds."""
+        return {
+            "name": self.name,
+            "seed": self.seed,
+            "points": {
+                point: spec.to_dict() for point, spec in sorted(self.points.items())
+            },
+        }
 
     # -- the deterministic schedule --------------------------------------------
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
@@ -274,6 +275,7 @@ class ProvisioningController:
             name="solver-backend",
         )
         self._requeue_backoff = retry.Backoff(0.5, 60.0, max_exponent=7)
+        self.last_reconcile_s: Optional[float] = None
         self._warmup_started = False
         self._warmup_lock = threading.Lock()
         self._warmup_thread: Optional[threading.Thread] = None
@@ -381,8 +383,12 @@ class ProvisioningController:
         # masquerade as reconcile latency in the stage histogram
         if wait_for_batch and not self.batcher.wait():
             return None
+        t0 = time.perf_counter()
         with tracing.span("provisioning.reconcile"):
             err = self._reconcile_batch()
+        # wall seconds of the last batch, solve included — the soak runner's
+        # per-reconcile solve-latency probe reads this (soak/slo.py)
+        self.last_reconcile_s = time.perf_counter() - t0
         if err is not None:
             # requeue-on-error (controller-runtime semantics): the batcher
             # only wakes on pod events, so a failed launch would otherwise

@@ -310,6 +310,23 @@ SOLVE_STAGE_DURATION = Histogram(
 )
 REGISTRY.register(SOLVE_STAGE_DURATION)
 
+# Soak-subsystem SLO surface (soak/slo.py samples these every simulated
+# tick): the live value of each time-series probe and a counter of SLO-rule
+# violations, so a long-running soak is watchable on /metrics while the
+# structured verdict report is still being accumulated (docs/SOAK.md).
+SOAK_SLO_PROBE = Gauge(
+    NAMESPACE + "_soak_slo_probe",
+    "Latest sampled value of a soak SLO probe, by probe and scenario.",
+    ("probe", "scenario"),
+)
+REGISTRY.register(SOAK_SLO_PROBE)
+SOAK_SLO_VIOLATIONS = Counter(
+    NAMESPACE + "_soak_slo_violations_total",
+    "Soak SLO rules that failed evaluation, by probe and scenario.",
+    ("probe", "scenario"),
+)
+REGISTRY.register(SOAK_SLO_VIOLATIONS)
+
 
 def measure(observer, clock=None):
     """Closure timer (constants.go:60-66): ``done = measure(hist.labels(...))``

@@ -1,0 +1,45 @@
+"""soak/: the trace-driven soak subsystem.
+
+Seeded workload-trace generators (``soak/generators.py``) replay realistic
+churn — diurnal waves, deploy storms, batch floods, mass evictions, mixed
+multi-provisioner fleets — against the full controller stack on a FakeClock
+timeline, while an SLO engine (``soak/slo.py``) samples time-series probes
+every simulated tick and renders a structured, seed-replayable verdict
+report.  ``run_scenario`` (``soak/runner.py``) is the entry;
+``scenarios.CATALOG`` holds the built-ins; ``tools/soak.py`` is the CLI and
+``make soak`` the CI gate.  See docs/SOAK.md.
+"""
+
+from karpenter_core_tpu.soak.generators import GENERATORS, generate
+from karpenter_core_tpu.soak.runner import SoakRunner, SoakScenario, run_scenario
+from karpenter_core_tpu.soak.slo import (
+    Observation,
+    PROBES,
+    SLOEngine,
+    SLORule,
+    SLOSpec,
+    canonical_verdict,
+    percentile,
+    replay_digest,
+)
+from karpenter_core_tpu.soak.trace import TraceEvent, WorkloadTrace, merge, sort_events
+
+__all__ = [
+    "GENERATORS",
+    "Observation",
+    "PROBES",
+    "SLOEngine",
+    "SLORule",
+    "SLOSpec",
+    "SoakRunner",
+    "SoakScenario",
+    "TraceEvent",
+    "WorkloadTrace",
+    "canonical_verdict",
+    "generate",
+    "merge",
+    "percentile",
+    "replay_digest",
+    "run_scenario",
+    "sort_events",
+]

@@ -1,0 +1,311 @@
+"""The soak scenario runner: trace + optional chaos + SLO spec, end to end.
+
+A ``SoakScenario`` composes a workload trace (by generator name + params), an
+optional chaos fault plan (the chaos/ plane's point specs), and a declarative
+SLO spec, then ``run_scenario`` drives the full operator controller stack —
+provisioning, node lifecycle, termination, deprovisioning — through the
+existing suite harness on a FakeClock timeline:
+
+  every tick:  apply due trace events (pod create / delete / resize, chaos
+               faults retried next tick exactly as a real client would) →
+               one scheduling round (provisioning + binder/kubelet
+               emulation) → optional lifecycle + deprovisioning pass →
+               sample every SLO probe → advance the clock
+
+Each tick runs inside a ``soak.tick`` tracing span and every probe sample
+lands on ``/metrics`` (``karpenter_soak_slo_probe``), so a soak run is
+observable with the same tools as production.  The result is the SLO
+engine's structured verdict report; the ``verdict`` section is replayable —
+the same ``(scenario, seed)`` produces the identical report
+(``slo.replay_digest``).
+
+The ``apiserver`` backend runs the same scenario against a real watch/list
+protocol (testing/fakeapiserver.py), which is what makes watch-drop chaos
+(``watch.stream``) reachable.  See docs/SOAK.md.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu import chaos, tracing
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.soak import generators
+from karpenter_core_tpu.soak.slo import Observation, SLOEngine, SLOSpec
+from karpenter_core_tpu.soak.trace import (
+    ACTION_CREATE,
+    ACTION_DELETE,
+    TraceEvent,
+    WorkloadTrace,
+)
+from karpenter_core_tpu.testing import factories, harness
+from karpenter_core_tpu.utils import pod as pod_util
+from karpenter_core_tpu.utils import resources as resources_util
+from karpenter_core_tpu.utils import retry
+from karpenter_core_tpu.utils.clock import FakeClock
+
+BACKEND_MEMORY = "memory"
+BACKEND_APISERVER = "apiserver"
+
+# one definition of "safe to retry next tick", shared with the scheduling step
+_RETRYABLE = harness.SCHEDULING_RETRYABLE
+
+
+@dataclass
+class SoakScenario:
+    """One soak run: workload + faults + SLOs + pacing."""
+
+    name: str
+    seed: int
+    generator: str
+    params: dict = field(default_factory=dict)
+    slo: dict = field(default_factory=dict)
+    tick_s: float = 5.0
+    settle_ticks: int = 40  # grace ticks past the trace horizon
+    max_ticks: int = 0  # 0 = derived from the trace + settle_ticks
+    backend: str = BACKEND_MEMORY
+    chaos_points: Dict[str, dict] = field(default_factory=dict)
+    provisioners: Tuple[str, ...] = ("default",)
+    consolidation: bool = False
+    ttl_seconds_after_empty: Optional[int] = None
+
+    def with_seed(self, seed: int) -> "SoakScenario":
+        return replace(self, seed=int(seed))
+
+    def with_slo(self, slo: dict) -> "SoakScenario":
+        return replace(self, slo=slo)
+
+    def build_trace(self) -> WorkloadTrace:
+        return generators.generate(self.generator, self.seed, self.params)
+
+    def slo_spec(self) -> SLOSpec:
+        return SLOSpec.from_dict(self.slo)
+
+    def chaos_scenario(self) -> Optional[chaos.Scenario]:
+        if not self.chaos_points:
+            return None
+        return chaos.Scenario.from_dict({
+            "name": f"{self.name}-chaos",
+            "seed": self.seed,
+            "points": self.chaos_points,
+        })
+
+
+class SoakRunner:
+    """Replays one scenario; ``run()`` returns the verdict report."""
+
+    def __init__(self, scenario: SoakScenario) -> None:
+        self.scenario = scenario
+        self._first_empty: Dict[str, float] = {}
+
+    # -- event application -----------------------------------------------------
+
+    def _apply_event(self, env, event: TraceEvent,
+                     retries: List[TraceEvent]) -> str:
+        """Apply one trace event; returns "ok" / "skip".  Raises a retryable
+        error (injected fault, CAS conflict) for the tick loop to requeue."""
+        if event.action == ACTION_CREATE:
+            env.kube.create(factories.make_pod(
+                name=event.pod,
+                requests=dict(event.requests) or None,
+                labels=dict(event.labels) or None,
+                node_selector=dict(event.node_selector) or None,
+                owner_kind=event.owner_kind,
+                creation_timestamp=env.clock.now(),
+            ))
+            return "ok"
+        pod = env.kube.get_pod("default", event.pod)
+        if event.action == ACTION_DELETE:
+            if pod is None:
+                # a chaos fault may still be holding the create in the retry
+                # queue: cancel the pair instead of deleting a ghost
+                for queued in list(retries):
+                    if queued.pod == event.pod and queued.action == ACTION_CREATE:
+                        retries.remove(queued)
+                return "skip"
+            env.kube.delete(pod, force=True)
+            return "ok"
+        # resize: in-place vertical scaling of a (possibly bound) pod
+        if pod is None:
+            if any(q.pod == event.pod and q.action == ACTION_CREATE
+                   for q in retries):
+                retries.append(event)  # create still pending: try again later
+            return "skip"
+        pod.spec.containers[0].resources.requests = (
+            resources_util.parse_resource_list(dict(event.requests))
+        )
+        env.kube.apply(pod)
+        return "ok"
+
+    # -- probes ----------------------------------------------------------------
+
+    def _observe(self, env, now: float) -> Observation:
+        pods = env.kube.list_pods()  # one LIST feeds both views below
+        pending = harness.pending_pods(env, pods=pods)
+        ages = sorted(
+            round(max(now - p.metadata.creation_timestamp, 0.0), 6)
+            for p in pending
+        )
+        nodes = env.kube.list_nodes()
+        live = {n.name for n in nodes}
+        bound: Dict[str, int] = {}
+        for p in pods:
+            if (
+                p.spec.node_name
+                and not pod_util.is_terminal(p)
+                and not pod_util.is_owned_by_daemon_set(p)
+                and not pod_util.is_owned_by_node(p)
+            ):
+                bound[p.spec.node_name] = bound.get(p.spec.node_name, 0) + 1
+        for gone in [name for name in self._first_empty if name not in live]:
+            del self._first_empty[gone]
+        empty_ages = []
+        for node in nodes:
+            if node.metadata.labels.get(labels_api.LABEL_NODE_INITIALIZED) != "true":
+                continue
+            if bound.get(node.name):
+                self._first_empty.pop(node.name, None)
+                continue
+            first = self._first_empty.setdefault(node.name, now)
+            empty_ages.append(round(now - first, 6))
+        return Observation(
+            pending_ages_s=ages,
+            machine_leaks=len(harness.machine_leaks(env)),
+            degraded=env.provisioning.degraded(),
+            empty_node_ages_s=sorted(empty_ages),
+            nodes=len(nodes),
+            solve_latency_s=env.provisioning.last_reconcile_s or 0.0,
+        )
+
+    # -- the run ---------------------------------------------------------------
+
+    def run(self) -> dict:
+        scenario = self.scenario
+        trace = scenario.build_trace()
+        spec = scenario.slo_spec()
+        clock = FakeClock()
+        engine = SLOEngine(scenario.name, scenario.seed, scenario.tick_s)
+        chaos_scenario = scenario.chaos_scenario()
+        drain = (
+            scenario.consolidation
+            or scenario.ttl_seconds_after_empty is not None
+        )
+        t_wall = time.perf_counter()
+
+        server = None
+        kube_factory = None
+        if scenario.backend == BACKEND_APISERVER:
+            from karpenter_core_tpu.kubeapi.client import ApiServerClient
+            from karpenter_core_tpu.testing.fakeapiserver import FakeApiServer
+
+            server = FakeApiServer(bookmark_interval_s=0.2).start()
+            url = server.url
+            kube_factory = lambda c: ApiServerClient(  # noqa: E731
+                url, c, backoff_base_s=0.05, backoff_cap_s=0.5,
+                rng=retry.DeterministicRNG(scenario.seed),
+            )
+        elif scenario.backend != BACKEND_MEMORY:
+            raise ValueError(f"unknown soak backend {scenario.backend!r}")
+
+        env = None
+        try:
+            if chaos_scenario is not None:
+                # armed BEFORE construction so startup watch establishment is
+                # inside the fault window (the watch.stream point)
+                chaos.arm(chaos_scenario, clock)
+            env = harness.make_environment(kube_factory=kube_factory, clock=clock)
+            for prov_name in scenario.provisioners:
+                env.kube.create(factories.make_provisioner(
+                    name=prov_name,
+                    consolidation_enabled=True if scenario.consolidation else None,
+                    ttl_seconds_after_empty=scenario.ttl_seconds_after_empty,
+                ))
+            total_ticks = scenario.max_ticks or (
+                math.ceil(trace.duration_s / scenario.tick_s)
+                + scenario.settle_ticks
+            )
+            applied = {"create": 0, "delete": 0, "resize": 0, "retried": 0}
+            retries: List[TraceEvent] = []
+            qi = 0
+            converged_at: Optional[int] = None
+            ticks_run = 0
+            for tick in range(total_ticks):
+                t = tick * scenario.tick_s
+                ticks_run = tick + 1
+                with tracing.span("soak.tick", scenario=scenario.name,
+                                  tick=tick, t_s=t):
+                    due, retries = retries, []
+                    while qi < len(trace.events) and trace.events[qi].at_s <= t:
+                        due.append(trace.events[qi])
+                        qi += 1
+                    for event in due:
+                        try:
+                            if self._apply_event(env, event, retries) == "ok":
+                                applied[event.action] += 1
+                        except _RETRYABLE:
+                            retries.append(event)
+                            applied["retried"] += 1
+                    try:
+                        harness.step_scheduling_round(env)
+                    except _RETRYABLE:
+                        pass  # next tick retries the whole round
+                    if drain:
+                        try:
+                            env.node_lifecycle.reconcile_all()
+                        except _RETRYABLE:
+                            pass  # next tick retries the lifecycle pass
+                        try:
+                            env.deprovisioning.reconcile()
+                        except _RETRYABLE:
+                            pass
+                    obs = self._observe(env, clock.now())
+                    engine.observe(tick, t, obs)
+                    if (
+                        qi >= len(trace.events)
+                        and not retries
+                        and not obs.pending_ages_s
+                        and obs.machine_leaks == 0
+                        and (not drain or not obs.empty_node_ages_s)
+                    ):
+                        converged_at = tick
+                        break
+                clock.step(scenario.tick_s)
+
+            extra = {
+                "generator": scenario.generator,
+                "backend": scenario.backend,
+                "trace_digest": trace.digest(),
+                "trace_events": trace.counts(),
+                "events_applied": applied,
+                "converged_at_tick": converged_at,
+                "converged": converged_at is not None,
+                "ticks_run": ticks_run,
+            }
+            diagnostics = {
+                "wall_s": round(time.perf_counter() - t_wall, 3),
+            }
+            if chaos_scenario is not None:
+                diagnostics["chaos"] = {
+                    "spec": chaos_scenario.to_dict(),
+                    "hits": chaos_scenario.hit_counts(),
+                    "fired": chaos_scenario.fired_counts(),
+                }
+            return engine.report(spec, extra=extra, diagnostics=diagnostics)
+        finally:
+            if chaos_scenario is not None:
+                chaos.disarm()
+            if server is not None:
+                if env is not None:
+                    try:
+                        env.kube.close()
+                    except Exception:  # noqa: BLE001 - teardown best-effort
+                        pass
+                server.stop()
+
+
+def run_scenario(scenario: SoakScenario) -> dict:
+    """Run one scenario and return its verdict report."""
+    return SoakRunner(scenario).run()

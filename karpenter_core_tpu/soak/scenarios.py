@@ -1,0 +1,145 @@
+"""The built-in soak scenario catalog.
+
+Each entry is a builder ``(seed) -> SoakScenario`` so every invocation gets a
+fresh trace; ``build(name, seed=None)`` is the lookup tools/soak.py and
+tests/test_soak.py use.  ``deploy-storm-smoke`` is the deterministic tier-1
+gate (``make soak``); the rest form the ``slow``-marked matrix.
+
+SLO limits here are deliberately generous convergence bounds (the gate is
+"the system keeps up under churn", not a latency benchmark); a scenario that
+needs tighter bounds overrides them via ``SoakScenario.with_slo``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from karpenter_core_tpu.soak.runner import (
+    BACKEND_APISERVER,
+    SoakScenario,
+)
+
+# the default convergence SLO: everything schedulable schedules promptly, no
+# stranded machines, the solver path never degrades, terminal state is clean
+_CONVERGENCE_RULES = [
+    {"probe": "pending_age_p99_s", "agg": "max", "limit": 120.0},
+    {"probe": "machine_leaks", "agg": "max", "limit": 0.0},
+    {"probe": "degraded", "agg": "time_above", "above": 0.0, "limit": 0.0},
+    {"probe": "pending_pods", "agg": "final", "limit": 0.0},
+    {"probe": "solve_latency_s", "agg": "max", "limit": 30.0},  # advisory
+]
+
+
+def deploy_storm_smoke(seed: int = 1729) -> SoakScenario:
+    """The tier-1 smoke: a rolling deploy storm against the real watch/list
+    apiserver backend while the chaos plane kills the first watch
+    establishments (410) — the ISSUE-6 acceptance scenario.  Bounded p99
+    pending age, zero machine leaks, bounded degraded time, clean terminal
+    state; the verdict must replay byte-identically from (scenario, seed)."""
+    return SoakScenario(
+        name="deploy-storm-smoke",
+        seed=seed,
+        generator="deploy-storm",
+        params={
+            "waves": 2, "replicas": 8, "wave_interval_s": 60.0,
+            "start_s": 5.0, "teardown_lag_s": 10.0,
+        },
+        slo={"rules": _CONVERGENCE_RULES},
+        tick_s=5.0,
+        settle_ticks=12,
+        backend=BACKEND_APISERVER,
+        chaos_points={"watch.stream": {"first_n": 2, "code": 410}},
+    )
+
+
+def diurnal_consolidation(seed: int = 11) -> SoakScenario:
+    """A compressed diurnal wave with consolidation on: the fleet must grow
+    with the peak and shrink after it — consolidation lag is the probe that
+    catches a fleet that only ever grows."""
+    return SoakScenario(
+        name="diurnal-consolidation",
+        seed=seed,
+        generator="diurnal",
+        params={
+            "duration_s": 1200.0, "period_s": 600.0,
+            "base_rate_per_s": 0.01, "peak_rate_per_s": 0.08,
+            "mean_lifetime_s": 300.0,
+        },
+        slo={"rules": _CONVERGENCE_RULES + [
+            {"probe": "consolidation_lag_s", "agg": "max", "limit": 900.0},
+        ]},
+        tick_s=30.0,
+        settle_ticks=40,
+        consolidation=True,
+        ttl_seconds_after_empty=60,
+    )
+
+
+def batch_flood_flaky_api(seed: int = 23) -> SoakScenario:
+    """A batch flood while the kube API flakes (injected 500s): retries must
+    absorb the faults without stranding machines or hot-looping."""
+    return SoakScenario(
+        name="batch-flood-flaky-api",
+        seed=seed,
+        generator="batch-flood",
+        params={"jobs": 4, "pods_per_job": 25, "mean_runtime_s": 300.0},
+        slo={"rules": _CONVERGENCE_RULES},
+        tick_s=15.0,
+        settle_ticks=40,
+        chaos_points={
+            "kubeapi.put": {"prob": 0.05, "code": 500, "stop_after": 6},
+        },
+    )
+
+
+def mass_eviction_capacity(seed: int = 37) -> SoakScenario:
+    """Mass eviction plus transient cloud-create failures on the reschedule
+    wave — the correlated-failure shape (AZ drain during a capacity crunch)."""
+    return SoakScenario(
+        name="mass-eviction-capacity",
+        seed=seed,
+        generator="mass-eviction",
+        params={"standing": 40, "evict_fraction": 0.5, "evict_at_s": 300.0},
+        slo={"rules": _CONVERGENCE_RULES},
+        tick_s=15.0,
+        settle_ticks=40,
+        chaos_points={
+            "cloud.create": {"first_n": 2, "kind": "error"},
+        },
+    )
+
+
+def mixed_fleet_steady(seed: int = 41) -> SoakScenario:
+    """Three provisioners under three different churn patterns at once —
+    the multi-tenant shape where one noisy fleet must not starve another."""
+    return SoakScenario(
+        name="mixed-fleet-steady",
+        seed=seed,
+        generator="mixed-fleet",
+        params={"provisioners": ("fleet-a", "fleet-b", "fleet-c"),
+                "scale": 0.4},
+        slo={"rules": _CONVERGENCE_RULES},
+        tick_s=15.0,
+        settle_ticks=40,
+        provisioners=("fleet-a", "fleet-b", "fleet-c"),
+    )
+
+
+CATALOG: Dict[str, Callable[[int], SoakScenario]] = {
+    "deploy-storm-smoke": deploy_storm_smoke,
+    "diurnal-consolidation": diurnal_consolidation,
+    "batch-flood-flaky-api": batch_flood_flaky_api,
+    "mass-eviction-capacity": mass_eviction_capacity,
+    "mixed-fleet-steady": mixed_fleet_steady,
+}
+
+# the deterministic scenario `make soak` gates on (mirrors `make chaos`)
+TIER1_SMOKE = "deploy-storm-smoke"
+
+
+def build(name: str, seed: Optional[int] = None) -> SoakScenario:
+    """Catalog lookup; ``seed`` overrides the scenario default."""
+    if name not in CATALOG:
+        raise ValueError(f"unknown soak scenario {name!r} (have {sorted(CATALOG)})")
+    scenario = CATALOG[name]() if seed is None else CATALOG[name](int(seed))
+    return scenario

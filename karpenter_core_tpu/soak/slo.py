@@ -1,0 +1,266 @@
+"""The SLO engine: time-series probes sampled each simulated tick, declarative
+per-scenario SLO specs, and structured verdict reports.
+
+Probes are *derived views* over what the runner observes each tick (pending
+pod ages, machine leaks, degraded state, empty-node ages, last solve
+latency).  Two classes:
+
+  deterministic probes   computed purely from cluster state on the FakeClock
+                         timeline — identical across replays of the same
+                         ``(scenario, seed)``; these drive the verdict
+  wall-clock probes      measured in real seconds (per-reconcile solve
+                         latency) — reported and SLO-checked *advisorily*
+                         under ``diagnostics`` so the verdict stays
+                         replay-identical
+
+The verdict report is JSON with two top-level sections: ``verdict`` (the
+seed-replayable part — same ``(scenario, seed)`` ⇒ byte-identical canonical
+JSON, fingerprinted by ``replay_digest``) and ``diagnostics`` (wall-clock
+timings, chaos fired counts, anything thread-timing-dependent).  A failed
+rule names the violated probe and the tick window where the probe was out of
+bounds.  Probe samples are exported live as
+``karpenter_soak_slo_probe{probe,scenario}`` gauges and SLO violations as
+``karpenter_soak_slo_violations_total{probe,scenario}`` (metrics/registry.py)
+so a long soak is watchable on ``/metrics`` while it runs.
+
+See docs/SOAK.md for the spec schema and docs/OBSERVABILITY.md for the
+metrics surface.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from karpenter_core_tpu.metrics.registry import (
+    SOAK_SLO_PROBE,
+    SOAK_SLO_VIOLATIONS,
+)
+
+# probe catalog: name -> deterministic? (wall-clock probes are advisory)
+PROBES: Dict[str, bool] = {
+    "pending_pods": True,
+    "pending_age_p99_s": True,
+    "machine_leaks": True,
+    "degraded": True,
+    "consolidation_lag_s": True,
+    "nodes": True,
+    "solve_latency_s": False,
+}
+
+AGG_MAX = "max"
+AGG_MEAN = "mean"
+AGG_FINAL = "final"
+AGG_TIME_ABOVE = "time_above"
+AGGS = (AGG_MAX, AGG_MEAN, AGG_FINAL, AGG_TIME_ABOVE)
+
+
+def percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile (deterministic, no interpolation); 0.0 on an
+    empty series."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(math.ceil(q * len(ordered)) - 1, 0)
+    return ordered[min(rank, len(ordered) - 1)]
+
+
+@dataclass
+class Observation:
+    """What the runner saw at one tick (raw inputs the probes derive from)."""
+
+    pending_ages_s: List[float] = field(default_factory=list)
+    machine_leaks: int = 0
+    degraded: bool = False
+    empty_node_ages_s: List[float] = field(default_factory=list)
+    nodes: int = 0
+    solve_latency_s: float = 0.0  # wall seconds (advisory)
+
+    def probe_values(self) -> Dict[str, float]:
+        return {
+            "pending_pods": float(len(self.pending_ages_s)),
+            "pending_age_p99_s": percentile(self.pending_ages_s, 0.99),
+            "machine_leaks": float(self.machine_leaks),
+            "degraded": 1.0 if self.degraded else 0.0,
+            "consolidation_lag_s": max(self.empty_node_ages_s, default=0.0),
+            "nodes": float(self.nodes),
+            "solve_latency_s": self.solve_latency_s,
+        }
+
+
+@dataclass
+class SLORule:
+    """One bound on one probe's time series."""
+
+    probe: str
+    limit: float
+    agg: str = AGG_MAX
+    above: float = 0.0  # the threshold integrated by time_above
+
+    def __post_init__(self) -> None:
+        if self.probe not in PROBES:
+            raise ValueError(f"unknown SLO probe {self.probe!r} (have {sorted(PROBES)})")
+        if self.agg not in AGGS:
+            raise ValueError(f"unknown SLO aggregation {self.agg!r} (have {AGGS})")
+
+    def to_dict(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "probe": self.probe, "agg": self.agg, "limit": self.limit,
+        }
+        if self.agg == AGG_TIME_ABOVE:
+            out["above"] = self.above
+        return out
+
+
+@dataclass
+class SLOSpec:
+    """A scenario's declarative SLO: a list of rules, all of which must hold."""
+
+    rules: List[SLORule] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, spec: dict) -> "SLOSpec":
+        rules = [
+            rule if isinstance(rule, SLORule) else SLORule(**rule)
+            for rule in (spec.get("rules") or [])
+        ]
+        return cls(rules=rules)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rules": [rule.to_dict() for rule in self.rules]}
+
+
+@dataclass
+class _Series:
+    ticks: List[int] = field(default_factory=list)
+    times: List[float] = field(default_factory=list)
+    values: List[float] = field(default_factory=list)
+
+
+class SLOEngine:
+    """Collects per-tick probe samples and evaluates an SLOSpec into a
+    verdict report."""
+
+    def __init__(self, scenario: str, seed: int, tick_s: float) -> None:
+        self.scenario = scenario
+        self.seed = seed
+        self.tick_s = tick_s
+        self.series: Dict[str, _Series] = {name: _Series() for name in PROBES}
+
+    def observe(self, tick: int, t_s: float, obs: Observation) -> None:
+        """Record one tick's samples and export them as live gauges."""
+        for name, value in obs.probe_values().items():
+            series = self.series[name]
+            series.ticks.append(tick)
+            series.times.append(round(t_s, 6))
+            series.values.append(round(value, 6))
+            SOAK_SLO_PROBE.labels(name, self.scenario).set(value)
+
+    # -- evaluation ------------------------------------------------------------
+
+    def _aggregate(self, rule: SLORule, series: _Series) -> float:
+        values = series.values
+        if not values:
+            return 0.0
+        if rule.agg == AGG_MAX:
+            return max(values)
+        if rule.agg == AGG_MEAN:
+            return round(sum(values) / len(values), 6)
+        if rule.agg == AGG_FINAL:
+            return values[-1]
+        # time_above: seconds the probe spent above rule.above (one tick of
+        # credit per out-of-bounds sample)
+        return round(float(sum(self.tick_s for v in values if v > rule.above)), 6)
+
+    def _violation_window(self, rule: SLORule, series: _Series) -> Optional[dict]:
+        """The tick window where the probe itself was out of bounds — what a
+        failing verdict points the operator at."""
+        threshold = rule.above if rule.agg == AGG_TIME_ABOVE else rule.limit
+        bad = [i for i, v in enumerate(series.values) if v > threshold]
+        if not bad and series.ticks:
+            # mean/final can fail without any single sample exceeding the
+            # limit; point at the end of the series
+            bad = [len(series.values) - 1]
+        if not bad:
+            return None
+        return {
+            "first_tick": series.ticks[bad[0]],
+            "last_tick": series.ticks[bad[-1]],
+            "first_t_s": series.times[bad[0]],
+            "last_t_s": series.times[bad[-1]],
+            "samples_out_of_bounds": len(bad),
+        }
+
+    def evaluate(self, spec: SLOSpec) -> List[dict]:
+        results = []
+        for rule in spec.rules:
+            series = self.series[rule.probe]
+            observed = round(self._aggregate(rule, series), 6)
+            passed = observed <= rule.limit
+            result = dict(rule.to_dict())
+            result.update({
+                "observed": observed,
+                "passed": passed,
+                "wallclock": not PROBES[rule.probe],
+            })
+            if not passed:
+                result["violation"] = self._violation_window(rule, series)
+                SOAK_SLO_VIOLATIONS.labels(rule.probe, self.scenario).inc()
+            results.append(result)
+        return results
+
+    def summaries(self, deterministic: bool) -> Dict[str, dict]:
+        out = {}
+        for name, is_det in sorted(PROBES.items()):
+            if is_det != deterministic:
+                continue
+            values = self.series[name].values
+            if not values:
+                continue
+            out[name] = {
+                "min": round(min(values), 6),
+                "max": round(max(values), 6),
+                "mean": round(sum(values) / len(values), 6),
+                "final": values[-1],
+            }
+        return out
+
+    def report(self, spec: SLOSpec, extra: Optional[dict] = None,
+               diagnostics: Optional[dict] = None) -> dict:
+        """The structured verdict report.  ``verdict`` is the seed-replayable
+        section (compare/digest it); ``diagnostics`` carries wall-clock and
+        thread-timing-dependent detail."""
+        results = self.evaluate(spec)
+        deterministic = [r for r in results if not r["wallclock"]]
+        advisory = [r for r in results if r["wallclock"]]
+        ticks = max((len(s.ticks) for s in self.series.values()), default=0)
+        verdict = {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "tick_s": self.tick_s,
+            "ticks": ticks,
+            "passed": all(r["passed"] for r in deterministic),
+            "slo": deterministic,
+            "probes": self.summaries(deterministic=True),
+        }
+        verdict.update(extra or {})
+        diag = {
+            "timings": self.summaries(deterministic=False),
+            "advisory_slo": advisory,
+        }
+        diag.update(diagnostics or {})
+        return {"verdict": verdict, "diagnostics": diag}
+
+
+def canonical_verdict(report: dict) -> str:
+    """Canonical JSON of the replay-stable section."""
+    return json.dumps(report["verdict"], sort_keys=True)
+
+
+def replay_digest(report: dict) -> str:
+    """sha256 fingerprint of the verdict — two runs of the same ``(scenario,
+    seed)`` must produce the same digest (tests/test_soak.py pins it)."""
+    return hashlib.sha256(canonical_verdict(report).encode()).hexdigest()

@@ -1,0 +1,184 @@
+"""Workload traces: replayable pod-event streams on a FakeClock timeline.
+
+A trace is the unit the soak subsystem replays: an ordered list of
+``TraceEvent``s (pod create / delete / resize) with offsets from trace start.
+Traces are produced by the seeded generators in ``soak/generators.py`` and
+consumed by ``soak/runner.py``, which applies each event against the kube
+backend at its FakeClock time and runs the controller stack between ticks.
+
+Determinism contract (tested in tests/test_soak.py):
+
+  - same ``(generator, seed)`` ⇒ byte-identical ``to_jsonl()`` output (and
+    therefore identical ``digest()``);
+  - event timestamps are non-decreasing, so replay order is the list order;
+  - a pod name is created before it is deleted or resized, and created at
+    most once.
+
+Events carry only value-typed fields (name, offsets, sorted key/value
+tuples) so serialization is canonical without a custom encoder.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+ACTION_CREATE = "create"
+ACTION_DELETE = "delete"
+ACTION_RESIZE = "resize"
+ACTIONS = (ACTION_CREATE, ACTION_DELETE, ACTION_RESIZE)
+
+# replay order for events sharing a timestamp: deletes free capacity before
+# creates claim it, resizes land last (they act on already-present pods)
+_ACTION_ORDER = {ACTION_DELETE: 0, ACTION_CREATE: 1, ACTION_RESIZE: 2}
+
+
+@dataclass(frozen=True)
+class TraceEvent:
+    """One pod lifecycle event at ``at_s`` seconds after trace start."""
+
+    at_s: float
+    action: str
+    pod: str
+    # sorted (key, value) tuples — canonical and hashable; values are the
+    # resource-quantity / label strings the kube factories accept
+    requests: Tuple[Tuple[str, str], ...] = ()
+    labels: Tuple[Tuple[str, str], ...] = ()
+    node_selector: Tuple[Tuple[str, str], ...] = ()
+    owner_kind: str = ""
+
+    def __post_init__(self) -> None:
+        if self.action not in ACTIONS:
+            raise ValueError(f"unknown trace action {self.action!r} (have {ACTIONS})")
+        if self.at_s < 0:
+            raise ValueError(f"negative event offset {self.at_s} for pod {self.pod!r}")
+
+    def to_dict(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "at_s": round(self.at_s, 6),
+            "action": self.action,
+            "pod": self.pod,
+        }
+        if self.requests:
+            out["requests"] = dict(self.requests)
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        if self.node_selector:
+            out["node_selector"] = dict(self.node_selector)
+        if self.owner_kind:
+            out["owner_kind"] = self.owner_kind
+        return out
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, object]) -> "TraceEvent":
+        def pairs(key: str) -> Tuple[Tuple[str, str], ...]:
+            return tuple(sorted((str(k), str(v)) for k, v in (data.get(key) or {}).items()))
+
+        return cls(
+            at_s=float(data["at_s"]),
+            action=str(data["action"]),
+            pod=str(data["pod"]),
+            requests=pairs("requests"),
+            labels=pairs("labels"),
+            node_selector=pairs("node_selector"),
+            owner_kind=str(data.get("owner_kind", "")),
+        )
+
+
+def pairs_of(mapping: Optional[Dict[str, object]]) -> Tuple[Tuple[str, str], ...]:
+    """Canonical sorted-tuple form of a dict for TraceEvent fields."""
+    return tuple(sorted((str(k), str(v)) for k, v in (mapping or {}).items()))
+
+
+@dataclass
+class WorkloadTrace:
+    """A named, seeded, validated event stream."""
+
+    name: str
+    seed: int
+    events: List[TraceEvent] = field(default_factory=list)
+    duration_s: float = 0.0  # horizon; >= last event offset
+
+    def __post_init__(self) -> None:
+        if self.events:
+            self.duration_s = max(self.duration_s, self.events[-1].at_s)
+
+    def validate(self) -> None:
+        """Raise ValueError on the first violated trace invariant."""
+        created: set = set()
+        last_at = 0.0
+        for i, event in enumerate(self.events):
+            # compare at serialization precision: sort_events orders by the
+            # rounded offset, so sub-microsecond raw inversions inside one
+            # bucket are legal (and invisible in the canonical stream)
+            at = round(event.at_s, 6)
+            if at < last_at:
+                raise ValueError(
+                    f"{self.name}: event {i} at {at}s precedes "
+                    f"event {i - 1} at {last_at}s (timestamps must be monotone)"
+                )
+            last_at = at
+            if event.action == ACTION_CREATE:
+                if event.pod in created:
+                    raise ValueError(f"{self.name}: pod {event.pod!r} created twice")
+                created.add(event.pod)
+            elif event.pod not in created:
+                raise ValueError(
+                    f"{self.name}: {event.action} of never-created pod {event.pod!r}"
+                )
+
+    # -- canonical serialization (the determinism surface) ---------------------
+
+    def to_jsonl(self) -> str:
+        """Byte-stable serialization: one sorted-keys JSON object per line,
+        header line first.  Same (generator, seed) ⇒ identical string."""
+        lines = [json.dumps(
+            {"trace": self.name, "seed": self.seed,
+             "events": len(self.events), "duration_s": round(self.duration_s, 6)},
+            sort_keys=True,
+        )]
+        lines.extend(json.dumps(e.to_dict(), sort_keys=True) for e in self.events)
+        return "\n".join(lines) + "\n"
+
+    @classmethod
+    def from_jsonl(cls, text: str) -> "WorkloadTrace":
+        lines = [line for line in text.splitlines() if line.strip()]
+        if not lines:
+            raise ValueError("empty trace stream")
+        header = json.loads(lines[0])
+        return cls(
+            name=str(header["trace"]),
+            seed=int(header["seed"]),
+            events=[TraceEvent.from_dict(json.loads(line)) for line in lines[1:]],
+            duration_s=float(header.get("duration_s", 0.0)),
+        )
+
+    def digest(self) -> str:
+        """sha256 of the canonical stream — the replay-identity fingerprint
+        stamped into verdict reports."""
+        return hashlib.sha256(self.to_jsonl().encode()).hexdigest()
+
+    def counts(self) -> Dict[str, int]:
+        out = {action: 0 for action in ACTIONS}
+        for event in self.events:
+            out[event.action] += 1
+        return out
+
+
+def sort_events(events: Iterable[TraceEvent]) -> List[TraceEvent]:
+    """Deterministic replay order: time, then delete<create<resize, then pod
+    name — no dependence on generation order."""
+    return sorted(
+        events,
+        key=lambda e: (round(e.at_s, 6), _ACTION_ORDER[e.action], e.pod),
+    )
+
+
+def merge(name: str, seed: int, traces: Iterable[WorkloadTrace]) -> WorkloadTrace:
+    """Compose traces (e.g. per-provisioner sub-workloads) into one stream."""
+    traces = list(traces)
+    events = sort_events(e for t in traces for e in t.events)
+    duration = max((t.duration_s for t in traces), default=0.0)
+    return WorkloadTrace(name=name, seed=seed, events=events, duration_s=duration)

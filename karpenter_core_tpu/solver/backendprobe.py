@@ -25,12 +25,13 @@ from __future__ import annotations
 import json
 import logging
 import os
+import socket
 import subprocess
 import sys
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from karpenter_core_tpu import tracing
 from karpenter_core_tpu.chaos import plane as chaos
@@ -55,6 +56,97 @@ PROBE_SNIPPET = (
 PROBE_BUCKETS = [0.5, 1, 2.5, 5, 10, 20, 30, 45, 60, 90, 120]
 
 DEFAULT_PROBE_TIMEOUT_S = 60.0
+DEFAULT_LIVENESS_TIMEOUT_S = 2.0
+_STDERR_TAIL_CHARS = 2000
+
+
+def _tail(text) -> str:
+    """Last ``_STDERR_TAIL_CHARS`` of a child's stderr (bytes or str)."""
+    if not text:
+        return ""
+    if isinstance(text, bytes):
+        text = text.decode("utf-8", "replace")
+    return text.strip()[-_STDERR_TAIL_CHARS:]
+
+
+def _liveness_timeout_s() -> float:
+    """``KC_PROBE_LIVENESS_TIMEOUT_S`` (seconds, default 2; 0 disables)."""
+    try:
+        return float(
+            os.environ.get("KC_PROBE_LIVENESS_TIMEOUT_S", DEFAULT_LIVENESS_TIMEOUT_S)
+        )
+    except ValueError:
+        return DEFAULT_LIVENESS_TIMEOUT_S
+
+
+def _parse_endpoint(entry: str) -> Optional[Tuple[str, Optional[int]]]:
+    """``(host, port-or-None)`` for one relay-pool entry, or ``None`` when the
+    format can't be trusted.  Handles ``host``, ``host:port``, ``[v6]``, and
+    ``[v6]:port``; a bare IPv6 address (multiple colons, no brackets) is
+    ambiguous — the trailing group may be a port or a hextet — so it is kept
+    whole as a host with no port rather than split at the wrong colon."""
+    if entry.startswith("["):
+        host, sep, rest = entry[1:].partition("]")
+        if not sep or not host:
+            return None
+        if not rest:
+            return host, None
+        if not rest.startswith(":"):
+            return None
+        try:
+            return host, int(rest[1:])
+        except ValueError:
+            return None
+    if entry.count(":") > 1:
+        return entry, None
+    host, _, port_s = entry.rpartition(":")
+    if not host:
+        return port_s, None
+    try:
+        return host, int(port_s)
+    except ValueError:
+        return None
+
+
+def liveness_check() -> Optional[str]:
+    """Cheap pre-probe relay liveness: TCP-reach the axon relay endpoints
+    before paying a potentially-60 s hanging device probe.
+
+    A dead relay fails by HANGING the full probe timeout; a 2 s socket
+    connect detects the common down states (refused, no route, dead DNS) at
+    ~1/30th the cost.  Best-effort and conservative: returns an error string
+    only when EVERY parsed endpoint is definitively unreachable — any
+    reachable endpoint, unparseable entry, or port-less entry that resolves
+    means "proceed to the real probe".  No relay env at all (local CPU/TPU
+    backend) skips the check entirely."""
+    timeout = _liveness_timeout_s()
+    if timeout <= 0:
+        return None
+    raw = os.environ.get("PALLAS_AXON_POOL_IPS", "").strip()
+    if not raw:
+        return None
+    failures: List[str] = []
+    entries = [e.strip() for e in raw.split(",") if e.strip()]
+    for entry in entries:
+        parsed = _parse_endpoint(entry)
+        if parsed is None:
+            return None  # unparseable format: don't guess, run the probe
+        host, port = parsed
+        if port is not None:
+            try:
+                with socket.create_connection((host, port), timeout=timeout):
+                    return None  # one live endpoint is enough
+            except OSError as e:
+                failures.append(f"{entry}: {e}")
+        else:
+            try:
+                socket.getaddrinfo(host, None)
+                return None  # resolvable, no port to connect: proceed
+            except socket.gaierror as e:
+                failures.append(f"{entry}: DNS {e}")
+    if entries and len(failures) == len(entries):
+        return "all relay endpoints unreachable: " + "; ".join(failures)
+    return None
 
 
 def probe_timeout_s() -> float:
@@ -89,6 +181,11 @@ class ProbeResult:
     duration_s: float
     attempt: int = 0
     cached: bool = False  # served from the failure TTL cache (no subprocess)
+    # probe-side diagnosis: the child's stderr tail (import errors, backend
+    # tracebacks, relay noise) — BENCH_r02..r05 had NOTHING to debug a hang
+    # with except the wall clock, so the failure record now carries the
+    # evidence (truncated; rides the structured log + bench JSON)
+    stderr_tail: str = ""
 
 
 # -- failure TTL cache --------------------------------------------------------
@@ -195,27 +292,40 @@ def probe_once(timeout_s: Optional[float] = None, attempt: int = 0) -> ProbeResu
             _fail_cache = (time.monotonic(), result)
         return result
     t0 = time.perf_counter()
-    platform, outcome, error = None, "error", ""
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", PROBE_SNIPPET],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        outcome, error = "timeout", f"probe hung past {timeout_s:.0f}s (killed)"
-    except Exception as e:  # noqa: BLE001 - spawn failures must not surface
-        error = f"probe spawn failed: {e}"
+    platform, outcome, error, stderr_tail = None, "error", "", ""
+    liveness_error = liveness_check()
+    if liveness_error is not None:
+        # the relay is provably down: fail in seconds instead of hanging the
+        # full probe timeout (the failure still lands in the TTL cache, so
+        # the ladder short-circuits exactly as it would after a real hang)
+        error = f"liveness: {liveness_error}"
     else:
-        if proc.returncode == 0:
-            for line in proc.stdout.splitlines():
-                if line.startswith("PLATFORM="):
-                    platform, outcome = line.split("=", 1)[1].strip(), "ok"
-                    break
-            else:
-                error = "probe exited 0 but printed no platform"
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", PROBE_SNIPPET],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired as e:
+            outcome, error = "timeout", f"probe hung past {timeout_s:.0f}s (killed)"
+            stderr_tail = _tail(e.stderr)
+        except Exception as e:  # noqa: BLE001 - spawn failures must not surface
+            error = f"probe spawn failed: {e}"
         else:
-            tail = (proc.stderr or proc.stdout).strip().splitlines()
-            error = tail[-1][:300] if tail else f"probe rc={proc.returncode}"
+            if proc.returncode == 0:
+                for line in proc.stdout.splitlines():
+                    if line.startswith("PLATFORM="):
+                        platform, outcome = line.split("=", 1)[1].strip(), "ok"
+                        break
+                else:
+                    error = "probe exited 0 but printed no platform"
+                    stderr_tail = _tail(proc.stderr)
+            else:
+                tail = (proc.stderr or proc.stdout).strip().splitlines()
+                error = tail[-1][:300] if tail else f"probe rc={proc.returncode}"
+                # the full child traceback, not just its last line — the
+                # structured record is the only place a probe-side crash is
+                # ever diagnosable from
+                stderr_tail = _tail(proc.stderr or proc.stdout)
     duration_s = time.perf_counter() - t0
 
     PROBE_TOTAL.labels(outcome).inc()
@@ -228,11 +338,13 @@ def probe_once(timeout_s: Optional[float] = None, attempt: int = 0) -> ProbeResu
         "duration_s": round(duration_s, 3),
         "error": error,
     }
+    if stderr_tail:
+        record["stderr_tail"] = stderr_tail
     log.info("%s", json.dumps(record))
     tracing.add_event("backend.probe", **record)
     result = ProbeResult(
         platform=platform, outcome=outcome, error=error,
-        duration_s=duration_s, attempt=attempt,
+        duration_s=duration_s, attempt=attempt, stderr_tail=stderr_tail,
     )
     with _fail_lock:
         _fail_cache = None if outcome == "ok" else (time.monotonic(), result)
@@ -268,14 +380,15 @@ def acquire_backend(
     while attempt < max_attempts:
         attempt += 1
         result = probe_once(probe_timeout_s, attempt=attempt)
-        state.probes.append(
-            {
-                "attempt": attempt,
-                "outcome": result.outcome,
-                "duration_s": round(result.duration_s, 3),
-                "error": result.error,
-            }
-        )
+        probe_record = {
+            "attempt": attempt,
+            "outcome": result.outcome,
+            "duration_s": round(result.duration_s, 3),
+            "error": result.error,
+        }
+        if result.stderr_tail:
+            probe_record["stderr_tail"] = result.stderr_tail
+        state.probes.append(probe_record)
         if result.platform is not None:
             state.platform = result.platform
             state.attempts = attempt

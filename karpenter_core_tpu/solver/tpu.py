@@ -822,14 +822,20 @@ class TPUSolver:
             state.open_,
             state.n_next,
         )
-        for arr in small:
-            try:
-                arr.copy_to_host_async()
-            except AttributeError:
-                pass
-        (assign, assign_ex, failed, suspect, ex_zone, pod_count, tmpl_id, open_,
-         n_next) = jax.device_get(small)
-        planes.prefetch()  # big planes ride the link while the host expands pods
+        # the fetch is its own child span so the decode stage splits into
+        # device→host transfer vs host expansion — the boundary the decode
+        # pipelining work needs independently visible (docs/KERNEL_PERF.md).
+        # NB: without an upstream sync (ops/solve.sync_outputs) this span
+        # also absorbs any still-running device compute.
+        with tracing.span("decode.fetch", arrays=len(small)):
+            for arr in small:
+                try:
+                    arr.copy_to_host_async()
+                except AttributeError:
+                    pass
+            (assign, assign_ex, failed, suspect, ex_zone, pod_count, tmpl_id,
+             open_, n_next) = jax.device_get(small)
+            planes.prefetch()  # big planes ride the link while the host expands
 
         results = TPUSolveResults(n_slots_used=int(n_next))
         nodes: Dict[int, TPUNodeDecision] = {}

@@ -12,6 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
+from karpenter_core_tpu import chaos
 from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import Node, NodeCondition, Pod
 from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
@@ -20,11 +21,21 @@ from karpenter_core_tpu.controllers.node import NodeController
 from karpenter_core_tpu.controllers.provisioning import ProvisioningController
 from karpenter_core_tpu.controllers.termination import TerminationController
 from karpenter_core_tpu.events import Recorder
-from karpenter_core_tpu.operator.kubeclient import KubeClient
+from karpenter_core_tpu.operator.kubeclient import (
+    ConflictError,
+    KubeClient,
+    NotFoundError,
+)
 from karpenter_core_tpu.operator.settings import Settings
 from karpenter_core_tpu.state.cluster import Cluster
 from karpenter_core_tpu.state.informer import start_informers
 from karpenter_core_tpu.utils.clock import FakeClock
+
+# What one scheduling/controller step may raise and still be safely retried
+# next round: injected chaos faults, read-after-delete races, and apiserver
+# CAS conflicts.  Shared by step_scheduling_round and the soak tick loop so
+# the two paths can never disagree on retryability.
+SCHEDULING_RETRYABLE = (chaos.InjectedFault, NotFoundError, ConflictError)
 
 
 @dataclass
@@ -77,12 +88,16 @@ class Environment:
 
 
 def make_environment(
-    instance_types=None, settings: Optional[Settings] = None, kube_factory=None
+    instance_types=None, settings: Optional[Settings] = None, kube_factory=None,
+    clock: Optional[FakeClock] = None,
 ) -> Environment:
     """``kube_factory(clock)`` swaps the kube backend (default: in-memory
     KubeClient) — the apiserver-parity suites pass an ApiServerClient factory
-    bound to a fake apiserver and re-run the same scenarios byte-identically."""
-    clock = FakeClock()
+    bound to a fake apiserver and re-run the same scenarios byte-identically.
+    ``clock`` lets a caller that must exist before the environment (e.g. the
+    soak runner arming a chaos scenario over construction-time watch
+    establishment) share its FakeClock."""
+    clock = clock or FakeClock()
     kube = kube_factory(clock) if kube_factory is not None else KubeClient(clock)
     provider = FakeCloudProvider(instance_types)
     settings = settings or Settings()
@@ -131,6 +146,58 @@ def make_environment(
 
     kube.watch(Node, on_node_event, replay=False)
     return env
+
+
+def pending_pods(env: Environment, pods: Optional[list] = None) -> list:
+    """Unbound, non-terminating pods — the convergence target the chaos and
+    soak harnesses share.  Pass ``pods`` to filter an already-fetched list
+    instead of issuing another LIST."""
+    if pods is None:
+        pods = env.kube.list_pods()
+    return [
+        p for p in pods
+        if not p.spec.node_name and p.metadata.deletion_timestamp is None
+    ]
+
+
+def machine_leaks(env: Environment) -> list:
+    """Provider machines with no live node object — stranded cloud instances
+    nothing will ever delete (provider ids, empty when healthy)."""
+    node_ids = {n.spec.provider_id for n in env.kube.list_nodes()}
+    return [
+        m.status.provider_id
+        for m in env.provider.created_machines()
+        if m.status.provider_id not in node_ids
+    ]
+
+
+def step_scheduling_round(env: Environment, reconcile: bool = True) -> Optional[str]:
+    """One provisioning pass plus the kube-scheduler/kubelet emulation: bind
+    nominated pods, make registered nodes ready.  Chaos-fault tolerant — an
+    injected kubeapi fault landing on the emulation's own writes is simply
+    retried next round, exactly as the real binder/kubelet would.  Returns
+    the provisioning error, if any.  The shared inner step of the chaos
+    convergence loops (tests/test_chaos_matrix.py) and the soak runner's
+    tick (soak/runner.py)."""
+    env.recorder.reset()
+    err = env.provisioning.reconcile(wait_for_batch=False) if reconcile else None
+    for uid, node_name in nominations(env.recorder).items():
+        pod = next(
+            (p for p in env.kube.list_pods()
+             if p.uid == uid and not p.spec.node_name),
+            None,
+        )
+        if pod is not None and env.kube.get_node(node_name) is not None:
+            try:
+                env.bind(pod, node_name)
+            except SCHEDULING_RETRYABLE:
+                pass  # rebind next round
+    for node in env.kube.list_nodes():
+        try:
+            env.make_node_ready(node)
+        except SCHEDULING_RETRYABLE:
+            pass  # kubelet re-registers next round
+    return err
 
 
 def nominations(recorder) -> Dict[str, str]:

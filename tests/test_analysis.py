@@ -189,6 +189,22 @@ class TestHygiene:
         assert len(found) == 1
         assert found[0].path == "badpkg/state/cache.py"
 
+    def test_wallclock_covers_soak(self, tmp_path):
+        """soak/ is clock-disciplined: probes and traces live on the
+        FakeClock timeline, and a stray wall read would silently break
+        verdict seed-replay (ISSUE 6 soak_hygiene satellite)."""
+        project = make_project(tmp_path, {
+            "badpkg/soak/probe.py": """\
+                import time
+
+                def sample():
+                    return time.time()
+            """,
+        })
+        found = [f for f in hygiene.run(project) if f.rule == "wallclock"]
+        assert len(found) == 1
+        assert found[0].path == "badpkg/soak/probe.py"
+
     def test_clean_module_silent(self, tmp_path):
         project = make_project(tmp_path, {
             "badpkg/ok.py": """\

@@ -21,13 +21,14 @@ from karpenter_core_tpu.controllers.deprovisioning import (
     DEGRADED_PAUSES,
     Result,
 )
-from karpenter_core_tpu.operator.kubeclient import NotFoundError
 from karpenter_core_tpu.testing import harness
 from karpenter_core_tpu.testing.factories import make_pod, make_pods, make_provisioner
 from karpenter_core_tpu.testing.harness import (
     expect_provisioned,
+    machine_leaks,
     make_environment,
-    nominations,
+    pending_pods,
+    step_scheduling_round,
 )
 from karpenter_core_tpu.utils import retry
 
@@ -35,22 +36,10 @@ from karpenter_core_tpu.utils import retry
 # -- terminal invariants -------------------------------------------------------
 
 
-def pending_pods(env):
-    return [
-        p for p in env.kube.list_pods()
-        if not p.spec.node_name and p.metadata.deletion_timestamp is None
-    ]
-
-
 def assert_no_machine_leaks(env):
     """Every machine alive at the provider must be a live node object —
     anything else is a stranded cloud instance nothing will ever delete."""
-    node_ids = {n.spec.provider_id for n in env.kube.list_nodes()}
-    leaked = [
-        m.status.provider_id
-        for m in env.provider.created_machines()
-        if m.status.provider_id not in node_ids
-    ]
+    leaked = machine_leaks(env)
     assert not leaked, f"leaked machines (no node object): {leaked}"
 
 
@@ -60,24 +49,7 @@ def drive_until_converged(env, max_rounds=20):
     kubeapi faults landing on the emulation's own writes are retried next
     round, exactly as the real binder/kubelet would."""
     for round_no in range(1, max_rounds + 1):
-        env.recorder.reset()
-        env.provisioning.reconcile(wait_for_batch=False)
-        for uid, node_name in nominations(env.recorder).items():
-            pod = next(
-                (p for p in env.kube.list_pods()
-                 if p.uid == uid and not p.spec.node_name),
-                None,
-            )
-            if pod is not None and env.kube.get_node(node_name) is not None:
-                try:
-                    env.bind(pod, node_name)
-                except (chaos.InjectedFault, NotFoundError):
-                    pass  # rebind next round
-        for node in env.kube.list_nodes():
-            try:
-                env.make_node_ready(node)
-            except (chaos.InjectedFault, NotFoundError):
-                pass  # kubelet re-registers next round
+        step_scheduling_round(env)
         if not pending_pods(env):
             return round_no
     raise AssertionError(

@@ -56,3 +56,26 @@ def test_ungated_stage_never_flags():
     rows = pg.compare_stages(cur, prev, tol=0.25)
     (row,) = rows
     assert row[0] == "encode_s" and not row[3]
+
+
+def test_decode_regression_flags_independently_of_solve():
+    """The de-fused halves gate separately: decode was 98% of r05's wall time
+    and invisible inside solve_decode_s (ISSUE 6 satellite) — a decode-only
+    regression must flag even when solve and the fused number look flat."""
+    pg = _load_perfgate()
+    assert "solve_s" in pg.GATED_STAGES and "decode_s" in pg.GATED_STAGES
+    prev = {"solve_decode_s": 1.61, "solve_s": 0.90, "decode_s": 0.70}
+    cur = {"solve_decode_s": 1.70, "solve_s": 0.60, "decode_s": 1.10}
+    rows = pg.compare_stages(cur, prev, tol=0.25)
+    by_key = {row[0]: row for row in rows}
+    assert by_key["decode_s"][3], "57% decode regression must flag"
+    assert not by_key["solve_s"][3], "solve improved"
+    assert not by_key["solve_decode_s"][3], "fused number inside tolerance"
+
+
+def test_records_predating_the_split_are_skipped():
+    pg = _load_perfgate()
+    prev = {"solve_decode_s": 1.0}  # an old BENCH_r*.json without the split
+    cur = {"solve_decode_s": 1.0, "solve_s": 0.5, "decode_s": 0.5}
+    rows = pg.compare_stages(cur, prev, tol=0.25)
+    assert [row[0] for row in rows] == ["solve_decode_s"]

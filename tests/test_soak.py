@@ -1,0 +1,296 @@
+"""The soak subsystem: trace-generator determinism, the SLO engine, and the
+tier-1 smoke scenario (deploy storm + watch-drop chaos) with seed-replayable
+verdicts.
+
+Tier-1 (`make soak`, a `make verify` prerequisite): the deterministic smoke
+must meet its SLO spec, re-running the same ``(scenario, seed)`` must yield a
+byte-identical verdict report, and a deliberately tightened spec must fail
+naming the violated probe and the tick window.  The full catalog matrix is
+``slow``-marked.
+"""
+
+import json
+import os
+
+import pytest
+
+from karpenter_core_tpu import soak
+from karpenter_core_tpu.metrics import REGISTRY
+from karpenter_core_tpu.soak import generators, scenarios, slo
+from karpenter_core_tpu.soak.runner import SoakScenario
+from karpenter_core_tpu.soak.trace import (
+    ACTION_CREATE,
+    ACTION_DELETE,
+    TraceEvent,
+    WorkloadTrace,
+)
+
+SEED = int(os.environ.get("KC_SOAK_SEED", "1729"))  # `make soak` pins it
+
+
+# -- trace model ---------------------------------------------------------------
+
+
+class TestTraceModel:
+    def test_jsonl_round_trip(self):
+        trace = generators.generate("deploy-storm", 5)
+        back = WorkloadTrace.from_jsonl(trace.to_jsonl())
+        assert back.to_jsonl() == trace.to_jsonl()
+        assert back.digest() == trace.digest()
+
+    def test_validate_rejects_delete_before_create(self):
+        trace = WorkloadTrace("bad", 0, [TraceEvent(1.0, ACTION_DELETE, "ghost")])
+        with pytest.raises(ValueError, match="never-created"):
+            trace.validate()
+
+    def test_validate_rejects_double_create(self):
+        trace = WorkloadTrace("bad", 0, [
+            TraceEvent(1.0, ACTION_CREATE, "p"),
+            TraceEvent(2.0, ACTION_CREATE, "p"),
+        ])
+        with pytest.raises(ValueError, match="created twice"):
+            trace.validate()
+
+    def test_validate_rejects_non_monotone_timestamps(self):
+        trace = WorkloadTrace("bad", 0, [
+            TraceEvent(5.0, ACTION_CREATE, "a"),
+            TraceEvent(1.0, ACTION_CREATE, "b"),
+        ])
+        with pytest.raises(ValueError, match="monotone"):
+            trace.validate()
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError, match="unknown trace action"):
+            TraceEvent(0.0, "explode", "p")
+
+    def test_merge_keeps_order_and_horizon(self):
+        a = WorkloadTrace("a", 1, [TraceEvent(3.0, ACTION_CREATE, "a-0")], 10.0)
+        b = WorkloadTrace("b", 1, [TraceEvent(1.0, ACTION_CREATE, "b-0")], 4.0)
+        merged = soak.merge("m", 1, [a, b])
+        assert [e.pod for e in merged.events] == ["b-0", "a-0"]
+        assert merged.duration_s == 10.0
+        merged.validate()
+
+
+class TestGeneratorDeterminism:
+    """Same seed ⇒ byte-identical stream; distinct seeds ⇒ distinct streams;
+    timestamps monotone — for EVERY registered generator."""
+
+    @pytest.mark.parametrize("kind", sorted(generators.GENERATORS))
+    def test_same_seed_byte_identical(self, kind):
+        a = generators.generate(kind, SEED)
+        b = generators.generate(kind, SEED)
+        assert a.to_jsonl() == b.to_jsonl()
+        assert a.digest() == b.digest()
+
+    @pytest.mark.parametrize("kind", sorted(generators.GENERATORS))
+    def test_distinct_seeds_distinct_streams(self, kind):
+        a = generators.generate(kind, SEED)
+        b = generators.generate(kind, SEED + 1)
+        assert a.to_jsonl() != b.to_jsonl()
+
+    @pytest.mark.parametrize("kind", sorted(generators.GENERATORS))
+    def test_timestamps_monotone_and_valid(self, kind):
+        trace = generators.generate(kind, SEED)  # generate() validates
+        offsets = [e.at_s for e in trace.events]
+        assert offsets == sorted(offsets)
+        assert trace.events, "generator produced an empty stream"
+        assert all(e.at_s >= 0 for e in trace.events)
+
+    def test_unknown_generator_rejected(self):
+        with pytest.raises(ValueError, match="unknown generator"):
+            generators.generate("nope", 1)
+
+
+# -- SLO engine ----------------------------------------------------------------
+
+
+class TestSLOEngine:
+    def test_percentile_nearest_rank(self):
+        assert slo.percentile([], 0.99) == 0.0
+        assert slo.percentile([5.0], 0.99) == 5.0
+        values = [float(i) for i in range(1, 101)]
+        assert slo.percentile(values, 0.99) == 99.0
+        assert slo.percentile(values, 0.5) == 50.0
+
+    def test_unknown_probe_and_agg_rejected(self):
+        with pytest.raises(ValueError, match="unknown SLO probe"):
+            slo.SLORule(probe="nope", limit=1.0)
+        with pytest.raises(ValueError, match="unknown SLO aggregation"):
+            slo.SLORule(probe="pending_pods", limit=1.0, agg="median")
+
+    def test_hand_written_trace_known_p99_pending_age(self):
+        """Generator-vs-SLO-engine on a hand-written 10-event trace with a
+        hand-computable answer: 10 pods created at t=0..45 (5 s apart), NO
+        provisioner exists, so nothing ever schedules and every pod ages
+        linearly.  At the final tick (t=95) the pending ages are
+        95, 90, ..., 50 — p99 (nearest-rank over 10 samples) is the max: 95."""
+        trace = WorkloadTrace("hand", 0, [
+            TraceEvent(5.0 * i, ACTION_CREATE, f"hand-{i:02d}",
+                       requests=(("cpu", "100m"),))
+            for i in range(10)
+        ], duration_s=45.0)
+        trace.validate()
+        scenario = SoakScenario(
+            name="hand-p99", seed=0, generator="deploy-storm",
+            tick_s=5.0, settle_ticks=10, max_ticks=20,
+            provisioners=(),  # no provisioner: pods stay pending forever
+            slo={"rules": [{"probe": "pending_age_p99_s", "agg": "final",
+                            "limit": 94.0}]},
+        )
+        runner = soak.SoakRunner(scenario)
+        runner.scenario.build_trace = lambda: trace  # inject the hand trace
+        report = runner.run()
+        verdict = report["verdict"]
+        assert verdict["ticks"] == 20  # never converges: full budget
+        final = verdict["probes"]["pending_age_p99_s"]["final"]
+        assert final == 95.0
+        assert verdict["probes"]["pending_pods"]["final"] == 10.0
+        (rule,) = verdict["slo"]
+        assert rule["passed"] is False and rule["observed"] == 95.0
+
+    def test_time_above_integrates_tick_seconds(self):
+        engine = slo.SLOEngine("t", 1, tick_s=2.0)
+        for tick, degraded in enumerate([0, 1, 1, 0, 1]):
+            engine.observe(tick, tick * 2.0, slo.Observation(degraded=bool(degraded)))
+        spec = slo.SLOSpec.from_dict({"rules": [
+            {"probe": "degraded", "agg": "time_above", "above": 0.0, "limit": 4.0},
+        ]})
+        (result,) = engine.evaluate(spec)
+        assert result["observed"] == 6.0 and result["passed"] is False
+        assert result["violation"]["first_tick"] == 1
+        assert result["violation"]["last_tick"] == 4
+        assert result["violation"]["samples_out_of_bounds"] == 3
+
+
+# -- scenario builders ---------------------------------------------------------
+
+
+class TestScenarios:
+    def test_catalog_builds_and_seeds_override(self):
+        for name in scenarios.CATALOG:
+            built = scenarios.build(name, seed=7)
+            assert built.seed == 7
+            built.build_trace()  # validates
+            assert built.slo_spec().rules
+        with pytest.raises(ValueError, match="unknown soak scenario"):
+            scenarios.build("nope")
+
+    def test_chaos_spec_round_trips(self):
+        scenario = scenarios.build("deploy-storm-smoke", seed=3)
+        armed = scenario.chaos_scenario()
+        from karpenter_core_tpu import chaos
+
+        back = chaos.Scenario.from_dict(armed.to_dict())
+        assert back.fault_schedule("watch.stream", 10) == \
+            armed.fault_schedule("watch.stream", 10)
+
+
+# -- the tier-1 smoke (the ISSUE 6 acceptance walk) ----------------------------
+
+
+class TestDeployStormSmoke:
+    def _run(self, slo_override=None):
+        scenario = scenarios.build(scenarios.TIER1_SMOKE, seed=SEED)
+        if slo_override is not None:
+            scenario = scenario.with_slo(slo_override)
+        return soak.run_scenario(scenario)
+
+    def test_smoke_meets_slo_and_replays_identically(self):
+        """Deploy storm + watch-drop chaos on the apiserver backend: the SLO
+        spec holds (bounded p99 pending age, 0 machine leaks, bounded
+        degraded time, clean terminal state), the watch faults actually
+        fired, and the verdict replays byte-identically."""
+        a = self._run()
+        assert a["verdict"]["passed"] is True, json.dumps(a["verdict"], indent=2)
+        assert a["verdict"]["converged"] is True
+        by_probe = {r["probe"]: r for r in a["verdict"]["slo"]}
+        assert by_probe["machine_leaks"]["observed"] == 0.0
+        assert by_probe["degraded"]["observed"] == 0.0
+        assert by_probe["pending_pods"]["observed"] == 0.0
+        # the chaos plane really injected the watch drops
+        assert a["diagnostics"]["chaos"]["fired"].get("watch.stream") == 2
+        # scheduling actually happened against the apiserver backend
+        assert a["verdict"]["probes"]["nodes"]["final"] >= 1.0
+
+        b = self._run()
+        assert slo.canonical_verdict(a) == slo.canonical_verdict(b)
+        assert slo.replay_digest(a) == slo.replay_digest(b)
+
+    def test_tightened_slo_fails_with_probe_and_tick_window(self):
+        """The same scenario under an impossible bound: the verdict must fail
+        and name the violated probe plus the tick window where it was out of
+        bounds."""
+        report = self._run(slo_override={"rules": [
+            {"probe": "nodes", "agg": "max", "limit": 0.0},
+        ]})
+        verdict = report["verdict"]
+        assert verdict["passed"] is False
+        (rule,) = verdict["slo"]
+        assert rule["probe"] == "nodes" and rule["passed"] is False
+        window = rule["violation"]
+        assert window["first_tick"] <= window["last_tick"]
+        assert window["last_t_s"] >= window["first_t_s"]
+        assert window["samples_out_of_bounds"] >= 1
+
+    def test_probe_gauges_visible_on_metrics(self):
+        self._run()
+        rendered = REGISTRY.render()
+        assert "karpenter_soak_slo_probe" in rendered
+        assert 'scenario="deploy-storm-smoke"' in rendered
+
+
+# -- the full matrix (slow) ----------------------------------------------------
+
+
+class TestSoakMatrix:
+    @pytest.mark.slow
+    @pytest.mark.parametrize("name", sorted(scenarios.CATALOG))
+    def test_catalog_scenario_meets_slo(self, name):
+        report = soak.run_scenario(scenarios.build(name))
+        assert report["verdict"]["passed"] is True, json.dumps(
+            report["verdict"], indent=2
+        )
+        assert report["verdict"]["converged"] is True
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", [3, 5, 8])
+    def test_randomized_seeds_converge(self, seed):
+        for name in ("batch-flood-flaky-api", "mass-eviction-capacity"):
+            report = soak.run_scenario(scenarios.build(name, seed=seed))
+            assert report["verdict"]["passed"] is True, json.dumps(
+                report["verdict"], indent=2
+            )
+
+
+# -- CLI -----------------------------------------------------------------------
+
+
+class TestSoakCLI:
+    def _main(self):
+        import importlib.util
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        spec = importlib.util.spec_from_file_location(
+            "soak_cli_under_test", os.path.join(repo, "tools", "soak.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.main
+
+    def test_list(self, capsys):
+        assert self._main()(["--list"]) == 0
+        out = capsys.readouterr().out
+        assert "deploy-storm-smoke" in out and "generators:" in out
+
+    def test_trace_dump_is_canonical(self, capsys):
+        assert self._main()(["--trace", "deploy-storm", "--seed", "3"]) == 0
+        out = capsys.readouterr().out
+        assert out == generators.generate("deploy-storm", 3).to_jsonl()
+
+    def test_smoke_run_exits_zero(self, capsys):
+        assert self._main()([scenarios.TIER1_SMOKE, "--seed", str(SEED)]) == 0
+        assert "soak: PASS deploy-storm-smoke" in capsys.readouterr().out

@@ -327,6 +327,14 @@ class TestHistogramExposition:
 
 
 class TestBackendProbe:
+    @pytest.fixture(autouse=True)
+    def _no_liveness(self, monkeypatch):
+        """The pre-probe relay liveness check is exercised explicitly below;
+        everywhere else it must not intercept the monkeypatched subprocess
+        (an ambient PALLAS_AXON_POOL_IPS pointing at a dead relay would
+        otherwise change these tests' outcomes)."""
+        monkeypatch.setenv("KC_PROBE_LIVENESS_TIMEOUT_S", "0")
+
     def test_timeout_is_recorded_not_raised(self, monkeypatch, traced):
         from karpenter_core_tpu.solver import backendprobe
 
@@ -463,6 +471,122 @@ class TestBackendProbe:
         assert [p["outcome"] for p in state.probes] == ["timeout", "cached"]
         backendprobe.reset_fail_cache()
 
+    def test_probe_failure_carries_child_stderr_tail(self, monkeypatch):
+        """A crashing probe child's traceback must land in the structured
+        failure record — BENCH_r02..r05 had nothing to debug a failed
+        bring-up with except the wall clock (ISSUE 6 satellite)."""
+        from karpenter_core_tpu.solver import backendprobe
+
+        trace_text = (
+            "Traceback (most recent call last):\n"
+            '  File "<string>", line 1, in <module>\n'
+            "RuntimeError: axon relay handshake refused\n"
+        )
+
+        class CrashProc:
+            returncode = 1
+            stdout = ""
+            stderr = trace_text
+
+        monkeypatch.setattr(
+            backendprobe.subprocess, "run", lambda *a, **k: CrashProc()
+        )
+        backendprobe.reset_fail_cache()
+        result = backendprobe.probe_once(1.0)
+        assert result.outcome == "error"
+        assert "axon relay handshake refused" in result.error  # last line
+        assert "Traceback" in result.stderr_tail  # the full evidence
+        # ...and it rides acquire_backend's per-attempt record (bench JSON)
+        backendprobe.reset_fail_cache()
+        state = backendprobe.acquire_backend(max_attempts=1, sleep=lambda s: None)
+        assert "Traceback" in state.probes[0]["stderr_tail"]
+        backendprobe.reset_fail_cache()
+
+    def test_timeout_carries_partial_stderr(self, monkeypatch):
+        from karpenter_core_tpu.solver import backendprobe
+
+        def hang(*args, **kwargs):
+            raise subprocess.TimeoutExpired(
+                cmd="probe", timeout=kwargs["timeout"],
+                stderr="relay: connecting to 10.0.0.9...",
+            )
+
+        monkeypatch.setattr(backendprobe.subprocess, "run", hang)
+        backendprobe.reset_fail_cache()
+        result = backendprobe.probe_once(1.0)
+        assert result.outcome == "timeout"
+        assert "connecting to 10.0.0.9" in result.stderr_tail
+        backendprobe.reset_fail_cache()
+
+    def test_liveness_check_fails_fast_on_dead_relay(self, monkeypatch):
+        """A provably-unreachable relay fails the probe in seconds (no
+        subprocess spawned) instead of hanging the full probe timeout."""
+        from karpenter_core_tpu.solver import backendprobe
+
+        def must_not_spawn(*args, **kwargs):
+            raise AssertionError("liveness failure must skip the spawn")
+
+        monkeypatch.setattr(backendprobe.subprocess, "run", must_not_spawn)
+        # 127.0.0.1:9 (discard) is reliably refused without a listener
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1:9")
+        monkeypatch.setenv("KC_PROBE_LIVENESS_TIMEOUT_S", "0.5")
+        backendprobe.reset_fail_cache()
+        result = backendprobe.probe_once(60.0)
+        assert result.outcome == "error" and result.platform is None
+        assert result.error.startswith("liveness:")
+        assert result.duration_s < 30.0
+        # the fast failure is cached like any other: the ladder short-circuits
+        assert backendprobe.probe_once(60.0).cached
+        backendprobe.reset_fail_cache()
+
+    def test_liveness_check_is_conservative(self, monkeypatch):
+        """No relay env ⇒ no check; unparseable entries ⇒ proceed; a live
+        endpoint ⇒ proceed.  Only definitive unreachability fails fast."""
+        import socket as socket_mod
+
+        from karpenter_core_tpu.solver import backendprobe
+
+        monkeypatch.setenv("KC_PROBE_LIVENESS_TIMEOUT_S", "0.5")
+        monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+        assert backendprobe.liveness_check() is None
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "relay:not-a-port")
+        assert backendprobe.liveness_check() is None
+        # a genuinely listening endpoint passes
+        listener = socket_mod.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        try:
+            port = listener.getsockname()[1]
+            monkeypatch.setenv("PALLAS_AXON_POOL_IPS", f"127.0.0.1:{port}")
+            assert backendprobe.liveness_check() is None
+        finally:
+            listener.close()
+        # disabled via env
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1:9")
+        monkeypatch.setenv("KC_PROBE_LIVENESS_TIMEOUT_S", "0")
+        assert backendprobe.liveness_check() is None
+
+    def test_liveness_endpoint_parsing_handles_ipv6(self, monkeypatch):
+        """IPv6 relay entries must not be split at the wrong colon: bracketed
+        [v6]:port connects to the address inside the brackets, a bare v6
+        address (ambiguous trailing group) is treated as a port-less host —
+        numeric, so it resolves and the real probe runs — never as a bogus
+        host/port pair that would falsely fail a live relay."""
+        from karpenter_core_tpu.solver import backendprobe
+
+        assert backendprobe._parse_endpoint("[fdaa::2]:8471") == ("fdaa::2", 8471)
+        assert backendprobe._parse_endpoint("[fdaa::2]") == ("fdaa::2", None)
+        assert backendprobe._parse_endpoint("fe80::1:8471") == ("fe80::1:8471", None)
+        assert backendprobe._parse_endpoint("10.0.0.9:8471") == ("10.0.0.9", 8471)
+        assert backendprobe._parse_endpoint("relay-host") == ("relay-host", None)
+        assert backendprobe._parse_endpoint("[fdaa::2") is None  # unterminated
+        assert backendprobe._parse_endpoint("[fdaa::2]x") is None
+        assert backendprobe._parse_endpoint("relay:not-a-port") is None
+        # end to end: a bare numeric v6 entry resolves ⇒ check passes through
+        monkeypatch.setenv("KC_PROBE_LIVENESS_TIMEOUT_S", "0.5")
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "::1:8471")
+        assert backendprobe.liveness_check() is None
+
 
 @pytest.mark.compile
 class TestSolvePipelineSpans:
@@ -485,8 +609,11 @@ class TestSolvePipelineSpans:
             results.new_nodes[0].instance_type_names  # noqa: B018 - materialize
         trace = tracing.TRACE_STORE.last(1)[0]
         names = {s["name"] for s in trace.spans}
-        assert {"ingest", "encode", "dispatch", "solve", "decode", "materialize"} <= names
+        assert {"ingest", "encode", "dispatch", "solve", "decode",
+                "decode.fetch", "materialize"} <= names
         stages = trace.stage_durations()
         assert all(stages[n] >= 0 for n in ("ingest", "encode", "solve", "decode"))
+        # the fetch child never exceeds its decode parent (the split is real)
+        assert stages["decode.fetch"] <= stages["decode"] + 1e-6
         # every span belongs to the one trace rooted at test.solve
         assert {s["traceId"] for s in trace.spans} == {trace.trace_id}

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Soak CLI: run catalog scenarios and print their verdict reports.
+
+Usage:
+  python tools/soak.py --list                      # catalog + generators
+  python tools/soak.py deploy-storm-smoke          # run one scenario
+  python tools/soak.py --all --seed 7              # whole catalog, one seed
+  python tools/soak.py smoke --json out.json       # full report to a file
+  python tools/soak.py --trace deploy-storm --seed 3   # dump a raw trace
+
+Prints one compact verdict line per scenario (the full report with --json or
+--verbose); exits 1 if any deterministic SLO rule failed.  Replay a failure
+by re-running with the same scenario name and --seed — the verdict section
+is byte-identical (docs/SOAK.md, "seed-replay workflow").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from karpenter_core_tpu.soak import generators, replay_digest, run_scenario  # noqa: E402
+from karpenter_core_tpu.soak import scenarios as catalog  # noqa: E402
+
+
+def _verdict_line(report: dict) -> str:
+    verdict = report["verdict"]
+    failed = [r for r in verdict["slo"] if not r["passed"]]
+    status = "PASS" if verdict["passed"] else "FAIL"
+    line = (
+        f"soak: {status} {verdict['scenario']} seed={verdict['seed']} "
+        f"ticks={verdict['ticks']} converged={verdict['converged']} "
+        f"digest={replay_digest(report)[:12]}"
+    )
+    for rule in failed:
+        window = rule.get("violation") or {}
+        line += (
+            f"\n  FAIL {rule['probe']}/{rule['agg']}: observed "
+            f"{rule['observed']} > limit {rule['limit']} "
+            f"(ticks {window.get('first_tick')}..{window.get('last_tick')})"
+        )
+    return line
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("scenarios", nargs="*", help="catalog scenario names")
+    ap.add_argument("--list", action="store_true", help="list catalog + generators")
+    ap.add_argument("--all", action="store_true", help="run the whole catalog")
+    ap.add_argument("--seed", type=int, default=None, help="override the seed")
+    ap.add_argument("--json", default=None,
+                    help="write full reports (JSON list) to this path")
+    ap.add_argument("--verbose", action="store_true",
+                    help="print full reports instead of one-line verdicts")
+    ap.add_argument("--trace", default=None, metavar="GENERATOR",
+                    help="dump a generator's raw event stream (JSONL) and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        print("scenarios:")
+        for name in sorted(catalog.CATALOG):
+            builder = catalog.CATALOG[name]
+            doc = (builder.__doc__ or "").strip().splitlines()[0]
+            print(f"  {name:28s} {doc}")
+        print("generators:", ", ".join(sorted(generators.GENERATORS)))
+        return 0
+
+    if args.trace:
+        trace = generators.generate(args.trace, args.seed or 0)
+        sys.stdout.write(trace.to_jsonl())
+        return 0
+
+    names = list(args.scenarios)
+    if args.all:
+        names = sorted(catalog.CATALOG)
+    if not names:
+        names = [catalog.TIER1_SMOKE]
+
+    reports = []
+    ok = True
+    for name in names:
+        report = run_scenario(catalog.build(name, seed=args.seed))
+        reports.append(report)
+        ok = ok and report["verdict"]["passed"]
+        if args.verbose:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        print(_verdict_line(report))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(reports, f, indent=2, sort_keys=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
