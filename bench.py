@@ -357,6 +357,133 @@ def consolidation_sweep_line(n_nodes: int = 1000, pods_per_node: int = 3) -> dic
     }
 
 
+def churn_line(solver, ingest, churn_fraction: float = 0.02, ticks: int = 5) -> dict:
+    """Steady-state churn benchmark (ISSUE 7 acceptance): the resident pod
+    population stays fixed while ``churn_fraction`` of each class is replaced
+    per tick, and each tick is solved BOTH ways —
+
+      full re-solve   what every reconcile paid before this PR: encode the
+                      whole snapshot from scratch, solve every class, decode
+      delta repair    the incremental session: no encode, evictions returned
+                      to the warm carry, ONE repair executable over the delta
+
+    Reported: per-tick wall medians (``warm_solve_s`` / ``full_resolve_s``),
+    the speedup, the session's full/delta decision counts, and whether the
+    delta lineage's final assignments are identical (canonical per-node class
+    loads) to the from-scratch solve — the parity the repair claims.
+    Deterministic: evictions take each class's oldest members, replacements
+    deep-copy the class representative (same shape, fresh identity)."""
+    import copy
+    import statistics
+
+    from karpenter_core_tpu.apis.objects import new_uid
+    from karpenter_core_tpu.models import store as store_mod
+    from karpenter_core_tpu.ops import solve as solve_ops
+    from karpenter_core_tpu.solver.incremental import (
+        FallbackPolicy,
+        IncrementalSolveSession,
+        node_signature_of,
+    )
+
+    session = IncrementalSolveSession(
+        solver,
+        FallbackPolicy(enabled=True, audit_interval=0, max_delta_fraction=0.5),
+    )
+    t0 = time.perf_counter()
+    session.solve(ingest)
+    seed_s = time.perf_counter() - t0
+
+    warm_ticks, full_ticks = [], []
+    delta_compile_s = None
+    identical = True
+    reps = {}  # class signature -> representative pod (shapes to re-mint)
+    # churn concentrates in a rotating subset of classes per tick — the
+    # rollout/deployment shape (one workload's pods are replaced while the
+    # rest of the fleet idles), which is what makes the dirty REGION small
+    # even when the churned pod count is not.  KC_BENCH_CHURN_CLASSES widens
+    # it (1.0 = every class churns every tick).
+    class_fraction = float(os.environ.get("KC_BENCH_CHURN_CLASSES", "0.25"))
+    for tick in range(ticks):
+        members = ingest.class_members()
+        sigs = sorted(members, key=lambda s: repr(s))
+        window = max(int(len(sigs) * class_fraction), 1)
+        start = (tick * window) % max(len(sigs), 1)
+        dirty = [sigs[(start + i) % len(sigs)] for i in range(window)]
+        target = max(int(len(ingest) * churn_fraction), 1)
+        pool = sum(len(members[s]) for s in dirty)
+        replacements = []
+        for sig in dirty:
+            uids = members[sig]
+            take = min(max(round(target * len(uids) / max(pool, 1)), 1), len(uids))
+            rep = reps.setdefault(sig, copy.deepcopy(ingest.get(uids[0])))
+            for uid in uids[:take]:
+                ingest.remove(uid)
+            for _ in range(take):
+                pod = copy.deepcopy(rep)
+                pod.metadata.name = f"churn-{tick}-{len(replacements)}"
+                pod.metadata.uid = new_uid()
+                pod.spec.node_name = ""
+                replacements.append(pod)
+        for pod in replacements:
+            ingest.add(pod)
+
+        import jax
+
+        # the old path: full re-solve of the whole snapshot
+        t0 = time.perf_counter()
+        snapshot = solver.encode(ingest)
+        out_full = solve_ops.solve(snapshot)
+        results_full = solver.decode(snapshot, out_full)
+        full_ticks.append(time.perf_counter() - t0)
+
+        # fetch the full solve's planes (and thereby drain its device queue)
+        # BEFORE the delta timer starts — otherwise the repair's first sync
+        # absorbs the full solve's still-in-flight compute and the warm number
+        # reads slower than it is
+        assign_f, assign_ex_f = jax.device_get(
+            (out_full.assign, out_full.assign_existing)
+        )
+        # label loads by stable class identity, not row index: a fully-churned
+        # class re-enters the fresh encode at a different row among
+        # equal-request classes, which must not read as divergence
+        keys_f = [store_mod.class_key(c) for c in snapshot.classes]
+        full_sig = node_signature_of(assign_f, keys_f) + node_signature_of(
+            assign_ex_f, keys_f
+        )
+
+        # the delta path
+        t0 = time.perf_counter()
+        session.solve(ingest)
+        elapsed = time.perf_counter() - t0
+        if tick == 0:
+            # first repair pays the delta executable's cold compile; report
+            # it separately so the steady-state number is honest
+            delta_compile_s = elapsed
+        else:
+            warm_ticks.append(elapsed)
+
+        identical = identical and (full_sig == session.node_signature())
+
+    agg = session.aggregates()
+    warm_s = statistics.median(warm_ticks) if warm_ticks else float("inf")
+    full_s = statistics.median(full_ticks)
+    return {
+        "pods": len(ingest),
+        "churn_fraction": churn_fraction,
+        "ticks": ticks,
+        "seed_full_solve_s": round(seed_s, 4),
+        "delta_compile_s": round(delta_compile_s, 4) if delta_compile_s else None,
+        "warm_solve_s": round(warm_s, 4),
+        "full_resolve_s": round(full_s, 4),
+        "speedup": round(full_s / warm_s, 2) if warm_s > 0 else 0.0,
+        "modes": dict(session.mode_counts),
+        "identical_assignments": identical,
+        "scheduled": agg["scheduled"],
+        "failed": agg["failed"],
+        "nodes": agg["nodes"],
+    }
+
+
 def _traced_solve(solver, pods) -> dict:
     """One fully-traced ingest → encode → dispatch → solve → decode →
     materialize pass; returns {"trace_id", "stages"} for the bench line."""
@@ -499,6 +626,24 @@ def main() -> None:
     # JSON loadable in chrome://tracing / Perfetto.
     trace_detail = _traced_solve(solver, pods)
 
+    # steady-state churn: the incremental warm-start repair vs the full
+    # re-solve, on the SAME resident population (docs/INCREMENTAL.md); the
+    # two per-tick stage medians gate independently in tools/perfgate.py.
+    # KC_BENCH_CHURN=0 skips; fraction/ticks via KC_BENCH_CHURN_*.
+    churn = None
+    if os.environ.get("KC_BENCH_CHURN", "1") != "0":
+        try:
+            churn = churn_line(
+                solver, ingest,
+                churn_fraction=float(os.environ.get("KC_BENCH_CHURN_FRACTION", "0.02")),
+                ticks=int(os.environ.get("KC_BENCH_CHURN_TICKS", "5")),
+            )
+        except Exception as e:  # noqa: BLE001 - churn line never kills the headline
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            churn = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # restart cold: a fresh process with the persistent caches this process
     # just populated — the cost every operator restart actually pays.  The
     # child inherits os.environ, so a CPU fallback pins it too.
@@ -531,6 +676,7 @@ def main() -> None:
         "decode_s": round(decode_s, 4),
         "materialize_s": round(materialize_s, 4),
         "trace": trace_detail,
+        "churn": churn,
         "platform": _BACKEND["platform"],
         "backend_attempts": _BACKEND["attempts"],
         "backend_fell_back_to_cpu": _BACKEND["fell_back"],
@@ -540,6 +686,14 @@ def main() -> None:
         # ~15% apart across the driver's and the builder's hosts in round 4)
         "machine": compilecache._machine_tag(),
     }
+    if churn and "error" not in churn:
+        # stage-level mirrors so tools/perfgate.py gates the warm path
+        # independently of the cold numbers (a warm-path regression must not
+        # hide inside a flat headline)
+        detail["churn_warm_solve_s"] = churn["warm_solve_s"]
+        detail["churn_full_solve_s"] = churn["full_resolve_s"]
+        detail["churn_speedup"] = churn["speedup"]
+
     if _BACKEND["probe_failures"]:
         detail["backend_probe_failures"] = _BACKEND["probe_failures"]
     if _BACKEND["probes"]:

@@ -263,6 +263,10 @@ class ProvisioningController:
             # empty subset and re-trigger forever — a no-progress livelock
             self.degraded_max_pods = DEGRADED_MAX_PODS
         self._solver_client = None
+        # incremental warm-start solve lineage (solver.incremental): survives
+        # across reconciles; its fallback policy decides full vs delta per
+        # batch and KC_SOLVER_INCREMENTAL=0 disables it entirely
+        self._incremental_session = None
         # the solver-backend breaker: counts unexpected kernel/relay faults
         # (not KernelUnsupported routing); open = degraded mode (bounded host
         # solves here, deprovisioning paused), half-open = one trial batch
@@ -546,6 +550,9 @@ class ProvisioningController:
     def _host_solve(self, pods: List[Pod], state_nodes) -> SchedulingResults:
         """The exact host-oracle solve — the normal fallback path and the
         degraded path build it identically so they cannot diverge."""
+        from karpenter_core_tpu.solver.incremental import SOLVE_MODE
+
+        SOLVE_MODE.labels("host").inc()
         scheduler = build_scheduler(
             self.kube_client,
             self.cloud_provider,
@@ -567,9 +574,12 @@ class ProvisioningController:
         pending and re-triggers shortly, so the cluster keeps converging
         (slowly, correctly) instead of stalling behind a dead backend.
         Everything this path emits carries ``degraded=true``."""
+        from karpenter_core_tpu.solver.incremental import SOLVE_MODE
+
         subset = pods[: self.degraded_max_pods]
         deferred = len(pods) - len(subset)
         DEGRADED_SOLVES.labels("provisioning").inc()
+        SOLVE_MODE.labels("degraded").inc()
         with tracing.span(
             "schedule.degraded", degraded=True, pods=len(subset), deferred=deferred
         ):
@@ -630,11 +640,9 @@ class ProvisioningController:
             tpu_results, new_launchables = remote
         else:
             try:
-                # classes were already built by the split — skip re-classification
-                snapshot = solver.encode_classes(
-                    tpu_classes, state_nodes=state_nodes, bound_pods=bound_pods
+                tpu_results = self._solve_in_process(
+                    solver, tpu_classes, state_nodes, bound_pods
                 )
-                tpu_results = solver.solve_encoded(snapshot, state_nodes, bound_pods)
             except KernelUnsupported as e:
                 # batch-level shapes (deep affinity chains, cross-class PVC
                 # sharing) surface here rather than per class
@@ -664,6 +672,11 @@ class ProvisioningController:
         # kernel's placements seeded into the topology counts, so no batch
         # shape schedules fewer pods than the host would (VERDICT r2 #2)
         residual_pods = list(tpu_results.spread_residual_pods)
+        if (residual_pods or host_pods) and self._incremental_session is not None:
+            # the host remainder places pods the warm carry cannot see — the
+            # lineage is no longer the whole truth, so the next batch must
+            # re-anchor with a full solve
+            self._incremental_session.reset()
         if residual_pods:
             log.info(
                 "re-routing %d spread-residual pods to the host oracle",
@@ -684,6 +697,39 @@ class ProvisioningController:
             results.failed_pods.extend(host_results.failed_pods)
             results.errors.update(host_results.errors)
         return results
+
+    def _solve_in_process(self, solver, tpu_classes, state_nodes, bound_pods):
+        """One in-process kernel solve, routed through the incremental
+        warm-start session (solver.incremental) unless KC_SOLVER_INCREMENTAL=0
+        keeps the old full-solve-every-batch path.  The session's fallback
+        policy picks full vs delta per batch; the decision rides the
+        ``solve.mode`` span attribute and ``karpenter_solve_mode_total``."""
+        from karpenter_core_tpu.solver.incremental import (
+            SOLVE_MODE,
+            FallbackPolicy,
+            IncrementalSolveSession,
+            incremental_enabled,
+        )
+
+        if not incremental_enabled():
+            snapshot = solver.encode_classes(
+                tpu_classes, state_nodes=state_nodes, bound_pods=bound_pods
+            )
+            SOLVE_MODE.labels("full").inc()
+            sp = tracing.current()
+            if sp is not None:
+                sp.set(**{"solve.mode": "full", "solve.mode.reason": "disabled"})
+            return solver.solve_encoded(snapshot, state_nodes, bound_pods)
+        session = self._incremental_session
+        if session is None:
+            # materialized=True: this session's decisions become real nodes,
+            # so repairs additionally require that the previous solve opened
+            # no new slots (FallbackPolicy docstring)
+            session = self._incremental_session = IncrementalSolveSession(
+                policy=FallbackPolicy.from_env(materialized=True)
+            )
+        session.rebind(solver)
+        return session.solve(tpu_classes, state_nodes, bound_pods)
 
     def _solve_remote(self, solver, tpu_classes, tpu_pods, state_nodes,
                       daemonset_pods, provisioners, bound_pods):

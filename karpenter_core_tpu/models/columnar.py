@@ -103,6 +103,31 @@ class PodIngest:
     def __init__(self) -> None:
         self._slots: Dict[tuple, _ClassSlot] = {}
         self._by_uid: Dict[str, tuple] = {}
+        # monotonic mutation counter: every effective add/remove bumps it, so
+        # the versioned snapshot store (models.store) can stamp each encode
+        # with the exact ingest state it saw and cheap-compare "anything
+        # changed?" without walking the pod set
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        """Monotonic count of effective mutations (adds + removes)."""
+        return self._version
+
+    def class_members(self) -> Dict[tuple, tuple]:
+        """signature -> (uid, ...) per live class, in insertion order — the
+        equivalence-class bookkeeping the snapshot store's diff rides (no
+        signature re-derivation, no per-pod hashing on the solve path)."""
+        return {
+            sig: tuple(slot.pods) for sig, slot in self._slots.items() if slot.pods
+        }
+
+    def get(self, uid: str):
+        """The live Pod for ``uid`` (None when not tracked)."""
+        sig = self._by_uid.get(uid)
+        if sig is None:
+            return None
+        return self._slots[sig].pods.get(uid)
 
     def __len__(self) -> int:
         return len(self._by_uid)
@@ -128,6 +153,7 @@ class PodIngest:
             self._slots[sig] = slot
         slot.pods[pod.uid] = pod
         self._by_uid[pod.uid] = sig
+        self._version += 1
 
     def add_all(self, pods: List[Pod]) -> None:
         from karpenter_core_tpu import tracing
@@ -147,6 +173,7 @@ class PodIngest:
             # evict emptied shapes: label churn (e.g. pod-template-hash) mints
             # fresh signatures forever, so retired slots must not accumulate
             del self._slots[sig]
+        self._version += 1
         return True
 
     def pods(self) -> List[Pod]:

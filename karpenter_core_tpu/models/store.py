@@ -1,0 +1,382 @@
+"""Versioned, diffable snapshot store — the substrate of the incremental
+warm-start solve path (docs/INCREMENTAL.md).
+
+Every reconcile used to re-encode and re-solve the full cluster snapshot from
+scratch; at steady-state churn rates only a handful of pods/nodes change
+between ticks, so the right amortization is to version each encode and solve
+only the diff.  Three pieces:
+
+  - ``VersionedSnapshot``: one ``encode_snapshot`` output stamped with a
+    monotonic version, per-plane content digests (sha256 over the encoded
+    tensor bytes — process-independent), and the per-class membership rows
+    the diff operates on.  The rows ride ``models.columnar.PodIngest``'s
+    existing equivalence-class bookkeeping (``class_members``) — the diff
+    never re-derives a pod signature.
+  - ``SnapshotDelta``: the structured difference between two versions — new
+    and evicted pod rows per class, new/removed classes, which supply-side
+    planes changed, the unchanged class-index extents, and the delta
+    fraction the fallback policy thresholds on.  ``apply`` replays a delta
+    onto the older version's membership summary; ``diff`` then ``apply``
+    reproducing the newer summary is the store's core invariant
+    (tests/test_incremental.py).
+  - ``SnapshotStore``: holds the current version, mints the next
+    (``commit``), and diffs (``diff_snapshots``).
+
+Supply-side change detection (``supply_digest``/``catalog_digest``) hashes
+the solve INPUTS — state nodes, bound pods, catalog, provisioner templates —
+not the encoded planes, because the whole point of a delta reconcile is to
+skip the encode when nothing supply-side moved.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_core_tpu.models.snapshot import EncodedSnapshot, _class_signature
+
+# plane groups digested independently, so a delta can name WHICH side moved
+# (a catalog refresh invalidates different reuse than a pod-row change)
+PLANE_FIELDS: Dict[str, Tuple[str, ...]] = {
+    "catalog": (
+        "it_mask", "it_defined", "it_negative", "it_gt", "it_lt",
+        "it_alloc", "it_avail", "it_price", "it_capacity",
+    ),
+    "templates": (
+        "tmpl_mask", "tmpl_defined", "tmpl_negative", "tmpl_gt", "tmpl_lt",
+        "tmpl_zone", "tmpl_ct", "tmpl_it", "tmpl_daemon", "tmpl_limits",
+    ),
+    "vocab": ("valid", "is_custom", "vocab_ints"),
+    "classes": (
+        "cls_mask", "cls_defined", "cls_negative", "cls_gt", "cls_lt",
+        "cls_zone", "cls_ct", "cls_it", "cls_requests", "cls_count",
+        "cls_relax_next", "cls_anti_soft", "cls_root", "cls_tol", "cls_ports",
+    ),
+    "groups": ("grp_skew", "grp_is_zone", "grp_is_anti", "grp_member", "cls_groups"),
+}
+
+
+def _digest_arrays(arrays) -> str:
+    """sha256 over dtype + shape + raw bytes of each array, in order.  Pure
+    content — no id()s, no hash() — so two processes encoding the same input
+    produce the same digest (PYTHONHASHSEED-independent)."""
+    h = hashlib.sha256()
+    for a in arrays:
+        if a is None:
+            h.update(b"<none>")
+            continue
+        arr = np.ascontiguousarray(np.asarray(a))
+        h.update(str(arr.dtype).encode())
+        h.update(repr(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def snapshot_digests(snapshot: EncodedSnapshot) -> Dict[str, str]:
+    """Per-plane content digests of one encoded snapshot, plus an ``axes``
+    digest covering the name spaces the planes index into."""
+    out = {
+        name: _digest_arrays(getattr(snapshot, f, None) for f in fields)
+        for name, fields in PLANE_FIELDS.items()
+    }
+    h = hashlib.sha256()
+    for axis in (
+        snapshot.resources, snapshot.zones, snapshot.capacity_types,
+        snapshot.it_names, [repr(p) for p in (snapshot.ports or [])],
+    ):
+        h.update(("\x1f".join(axis) + "\x1e").encode())
+    h.update(repr(tuple(snapshot.features or ())).encode())
+    h.update(str(snapshot.scan_passes).encode())
+    out["axes"] = h.hexdigest()
+    return out
+
+
+def class_key(cls) -> tuple:
+    """Version-stable identity of one class row: the equivalence-class
+    signature of its representative pod (ladder variants carry the relaxed
+    representative, so each rung keys distinctly)."""
+    return _class_signature(cls.pods[0])
+
+
+@dataclass(frozen=True)
+class ClassRow:
+    """One class's membership at one version (roots carry the pod uids;
+    ladder variants place rolled-over counts and own no pods)."""
+
+    key: tuple
+    count: int
+    uids: Tuple[str, ...] = ()
+
+
+@dataclass
+class VersionedSnapshot:
+    """One encode output + the version metadata the diff operates on."""
+
+    version: int
+    snapshot: EncodedSnapshot
+    digests: Dict[str, str]
+    rows: Tuple[ClassRow, ...]
+    supply: str = ""  # supply_digest at encode time ("" = not tracked)
+
+    def index_of(self) -> Dict[tuple, int]:
+        return {row.key: i for i, row in enumerate(self.rows)}
+
+    def summary(self) -> Dict[tuple, Tuple[str, ...]]:
+        """class key -> member uids (the diff/apply state space)."""
+        return {row.key: row.uids for row in self.rows}
+
+
+@dataclass
+class SnapshotDelta:
+    """Structured difference between two snapshot versions."""
+
+    from_version: int
+    to_version: int
+    # pod-row changes, keyed by class identity
+    added: Dict[tuple, Tuple[str, ...]] = field(default_factory=dict)
+    evicted: Dict[tuple, Tuple[str, ...]] = field(default_factory=dict)
+    new_classes: Tuple[tuple, ...] = ()
+    removed_classes: Tuple[tuple, ...] = ()
+    # supply-side planes whose digests differ (catalog/templates/vocab/
+    # groups/axes — plus "supply" when the node-side input digest moved)
+    changed_planes: Tuple[str, ...] = ()
+    # class-index extents (start, end) of the NEWER version whose rows are
+    # untouched — the regions a masked repair never has to look at
+    unchanged_extents: Tuple[Tuple[int, int], ...] = ()
+    touched_classes: Tuple[int, ...] = ()
+    # requirement-mask words touched by class changes: word count of the
+    # packed cls_mask rows of touched classes (0 when only counts moved)
+    touched_mask_words: int = 0
+    pods_before: int = 0
+    pods_after: int = 0
+
+    @property
+    def added_count(self) -> int:
+        return sum(len(u) for u in self.added.values())
+
+    @property
+    def evicted_count(self) -> int:
+        return sum(len(u) for u in self.evicted.values())
+
+    @property
+    def delta_fraction(self) -> float:
+        """(added + evicted) over the larger population — the fallback
+        policy's primary threshold."""
+        base = max(self.pods_before, self.pods_after, 1)
+        return (self.added_count + self.evicted_count) / base
+
+    @property
+    def node_side_changed(self) -> bool:
+        return bool(self.changed_planes)
+
+    @property
+    def class_shape_changed(self) -> bool:
+        """True when the class AXIS itself moved (new/removed classes) —
+        tensor reuse is impossible and the repair must re-encode."""
+        return bool(self.new_classes or self.removed_classes)
+
+    def apply(self, prev_summary: Dict[tuple, Tuple[str, ...]]) -> Dict[tuple, Tuple[str, ...]]:
+        """Replay this delta onto the older version's membership summary.
+        ``diff_snapshots(prev, cur)`` then ``apply(prev.summary())`` must
+        reproduce ``cur.summary()`` exactly (diff ∘ apply == identity)."""
+        out = {key: list(uids) for key, uids in prev_summary.items()}
+        for key in self.removed_classes:
+            out.pop(key, None)
+        for key in self.new_classes:
+            out.setdefault(key, [])
+        for key, uids in self.evicted.items():
+            if key in out:
+                gone = set(uids)
+                out[key] = [u for u in out[key] if u not in gone]
+        for key, uids in self.added.items():
+            out.setdefault(key, []).extend(uids)
+        return {
+            key: tuple(uids) for key, uids in out.items()
+            if uids or key in self.new_classes or key not in self.evicted
+        }
+
+
+def diff_members(
+    prev_members: Dict[tuple, Tuple[str, ...]],
+    cur_members: Dict[tuple, Tuple[str, ...]],
+    from_version: int = 0,
+    to_version: int = 0,
+    supply_changed: Tuple[str, ...] = (),
+) -> SnapshotDelta:
+    """A SnapshotDelta from two membership maps alone — the NO-ENCODE diff a
+    delta reconcile uses (class key -> member uids, straight off
+    PodIngest.class_members or a prebuilt class list).  Plane-level fields
+    (extents, mask words) stay empty: nothing was encoded to measure them;
+    ``supply_changed`` carries the input-digest verdict instead."""
+    added: Dict[tuple, Tuple[str, ...]] = {}
+    evicted: Dict[tuple, Tuple[str, ...]] = {}
+    new_classes = tuple(k for k in cur_members if k not in prev_members)
+    removed_classes = tuple(k for k in prev_members if k not in cur_members)
+    for key, uids in cur_members.items():
+        before = set(prev_members.get(key, ()))
+        now = set(uids)
+        plus = tuple(u for u in uids if u not in before)
+        minus = tuple(u for u in prev_members.get(key, ()) if u not in now)
+        if plus:
+            added[key] = plus
+        if minus:
+            evicted[key] = minus
+    for key in removed_classes:
+        if prev_members[key]:
+            evicted[key] = prev_members[key]
+    return SnapshotDelta(
+        from_version=from_version,
+        to_version=to_version or from_version + 1,
+        added=added,
+        evicted=evicted,
+        new_classes=new_classes,
+        removed_classes=removed_classes,
+        changed_planes=tuple(supply_changed),
+        pods_before=sum(len(u) for u in prev_members.values()),
+        pods_after=sum(len(u) for u in cur_members.values()),
+    )
+
+
+def rows_from_snapshot(snapshot: EncodedSnapshot) -> Tuple[ClassRow, ...]:
+    """Membership rows in class order.  Root classes carry their pod uids;
+    ladder variants own no pods (counts roll into them in-kernel)."""
+    rows: List[ClassRow] = []
+    for cls in snapshot.classes:
+        uids = () if cls.is_ladder_variant else tuple(p.uid for p in cls.pods)
+        rows.append(ClassRow(key=class_key(cls), count=len(uids), uids=uids))
+    return tuple(rows)
+
+
+def diff_snapshots(prev: VersionedSnapshot, cur: VersionedSnapshot) -> SnapshotDelta:
+    """The structured delta between two committed versions: the membership
+    arithmetic delegated to ``diff_members`` (one implementation of the
+    added/evicted/new/removed edge cases), plus the plane-level fields only
+    committed versions can measure (digest verdicts, extents, mask words)."""
+    delta = diff_members(
+        prev.summary(), cur.summary(),
+        from_version=prev.version, to_version=cur.version,
+    )
+
+    changed = tuple(
+        name
+        for name in ("catalog", "templates", "vocab", "groups", "axes")
+        if prev.digests.get(name) != cur.digests.get(name)
+    )
+    if prev.supply != cur.supply:
+        changed = changed + ("supply",)
+
+    new_set = set(delta.new_classes)
+    touched = tuple(
+        i for i, row in enumerate(cur.rows)
+        if row.key in delta.added or row.key in new_set or row.key in delta.evicted
+    )
+    touched_set = set(touched)
+    extents: List[Tuple[int, int]] = []
+    start: Optional[int] = None
+    for i in range(len(cur.rows) + 1):
+        clean = i < len(cur.rows) and i not in touched_set
+        if clean and start is None:
+            start = i
+        elif not clean and start is not None:
+            extents.append((start, i))
+            start = None
+    mask_words = 0
+    cls_mask = getattr(cur.snapshot, "cls_mask", None)
+    if cls_mask is not None and len(touched):
+        row_words = int(np.prod(cls_mask.shape[1:])) if cls_mask.ndim > 1 else 0
+        # bool planes pack 32 semantic slots per uint32 word in-kernel
+        mask_words = len(touched) * -(-row_words // 32)
+    delta.changed_planes = changed
+    delta.unchanged_extents = tuple(extents)
+    delta.touched_classes = touched
+    delta.touched_mask_words = mask_words
+    return delta
+
+
+class SnapshotStore:
+    """Holds the current snapshot version and mints successors.
+
+    The store is deliberately small: versioning + diffing only.  The warm
+    carry, padded tensors, and placement bookkeeping live in
+    ``solver.incremental`` — they are solve-path state, not snapshot state.
+    """
+
+    def __init__(self) -> None:
+        self._version = 0
+        self.current: Optional[VersionedSnapshot] = None
+
+    def commit(self, snapshot: EncodedSnapshot, supply: str = "") -> VersionedSnapshot:
+        """Stamp one encode output as the next version and make it current."""
+        self._version += 1
+        versioned = VersionedSnapshot(
+            version=self._version,
+            snapshot=snapshot,
+            digests=snapshot_digests(snapshot),
+            rows=rows_from_snapshot(snapshot),
+            supply=supply,
+        )
+        self.current = versioned
+        return versioned
+
+    def diff(self, cur: VersionedSnapshot) -> Optional[SnapshotDelta]:
+        """Delta from the current version to ``cur`` (None when no current)."""
+        if self.current is None or cur is self.current:
+            return None
+        return diff_snapshots(self.current, cur)
+
+
+def supply_digest(state_nodes, bound_pods) -> str:
+    """Content digest of the solve's supply side INPUTS: state nodes (labels,
+    available capacity, taints, volume limits/usage) and the bound pods whose
+    membership seeds topology counts.  Computed without encoding anything —
+    the delta path's whole point is skipping the encode when this is stable.
+    O(nodes + bound pods) python, small constants."""
+    h = hashlib.sha256()
+    for sn in state_nodes or []:
+        node = sn.node
+        h.update(node.name.encode())
+        h.update(repr(sorted(node.metadata.labels.items())).encode())
+        h.update(repr(sorted(sn.available().items())).encode())
+        h.update(repr(sorted(
+            (t.key, t.value, t.effect) for t in sn.taints()
+        )).encode())
+        h.update(b"1" if sn.initialized() else b"0")
+        h.update(repr(sorted(sn.volume_limits().items())).encode())
+        h.update(repr(sorted(
+            (d, tuple(sorted(ids))) for d, ids in sn.volume_usage().volumes.items()
+        )).encode())
+        h.update(b"\x1e")
+    for pod in bound_pods or []:
+        h.update((pod.uid or "").encode())
+        h.update((pod.spec.node_name or "").encode())
+        h.update((pod.namespace or "").encode())
+        h.update(repr(sorted(pod.metadata.labels.items())).encode())
+        h.update(b"\x1e")
+    return h.hexdigest()
+
+
+def catalog_digest(provisioners, instance_types) -> str:
+    """Content digest of the provisioner/catalog inputs (the template plane's
+    upstream).  Provisioner specs are covered via resourceVersion/generation
+    plus the weight order; the catalog via names, capacity, and offerings."""
+    h = hashlib.sha256()
+    for p in provisioners or []:
+        h.update(p.name.encode())
+        h.update(str(p.metadata.resource_version or "").encode())
+        h.update(str(getattr(p.metadata, "generation", "") or "").encode())
+        h.update(str(getattr(p.spec, "weight", 0) or 0).encode())
+        h.update(b"\x1e")
+    for name in sorted(instance_types or {}):
+        h.update(name.encode())
+        for it in instance_types[name]:
+            h.update(it.name.encode())
+            h.update(repr(sorted(it.capacity.items())).encode())
+            h.update(repr(sorted(
+                (o.zone, o.capacity_type, o.available, o.price)
+                for o in it.offerings
+            )).encode())
+        h.update(b"\x1e")
+    return h.hexdigest()

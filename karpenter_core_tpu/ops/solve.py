@@ -193,6 +193,58 @@ class SolveOutputs(NamedTuple):
     # class (round bound hit with headroom left, or quota unrealized in-phase);
     # failed pods of flagged classes re-route to the host oracle (VERDICT r2 #2)
     spread_suspect: jnp.ndarray = None
+    # the rest of the final scan carry, returned so a later repair solve can
+    # resume from it (WarmCarry): shared topology counts and the remaining
+    # provisioner-limit budget.  Stays device-resident until consumed.
+    topo: "TopoCounts" = None
+    remaining: jnp.ndarray = None  # f32[T, R]
+
+
+class WarmCarry(NamedTuple):
+    """The previous solve's final scan carry, carried as the initial state of
+    a warm-start repair solve (docs/INCREMENTAL.md).
+
+    ``state``/``ex_state`` hold every placement the previous solve committed
+    (used capacity, merged requirement masks, zone/ct commitments, ports,
+    volume counters); ``topo`` the shared topology-group counts; ``remaining``
+    the provisioner-limit budget.  A repair solve re-enters ``solve_core``
+    with this carry and a class-count vector holding only the DELTA pods —
+    every phase then fills leftover capacity exactly as the full solve's later
+    classes would, so the constraint semantics are identical by construction.
+    Evictions are applied to the carry first (``repair_free``): capacity and
+    counts are returned, but merged requirement masks / zone commitments /
+    port claims are NOT un-merged — that one-way pessimism is the optimality
+    drift the fallback policy's periodic full-solve audit bounds."""
+
+    state: NodeState
+    ex_state: ExistingState
+    topo: TopoCounts
+    remaining: jnp.ndarray  # f32[T, R]
+
+
+class RepairPlan(NamedTuple):
+    """The dirty-region plan of a warm-start repair solve.
+
+    ``pref_new`` / ``pref_ex`` are the per-class freed-hole planes: how many
+    pods of class c were evicted from each new-node slot / existing node since
+    the carry was taken.  Every placement fill prefers refilling these holes
+    (capped at the freed count — ``_fill_with_pref``) before the normal
+    emptiest-first / index order, which is what makes steady-state churn
+    repairs land on EXACTLY the slots the departures vacated and keeps the
+    lineage's assignments identical to a from-scratch solve.  All-zeros is a
+    valid no-preference plan (pure additions).
+
+    The ``base_*`` planes ([G1, Z] i32) carry the topology-count
+    contributions of new-node slots OUTSIDE a bounded repair window
+    (``gather_repair_window``): the zone derivations in ``_class_step`` add
+    them as constants so a windowed repair sees the same zone counts a
+    full-width solve would.  All-zeros when the repair runs unwindowed."""
+
+    pref_new: jnp.ndarray  # i32[C, N]
+    pref_ex: jnp.ndarray  # i32[C, E]
+    base_fwd_sing: jnp.ndarray  # i32[G1, Z] committed-zone forward counts
+    base_fwd_full: jnp.ndarray  # i32[G1, Z] pessimistic (anti) forward counts
+    base_inv_full: jnp.ndarray  # i32[G1, Z] inverse-ownership counts
 
 
 def _water_fill(count0: jnp.ndarray, allowed: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
@@ -348,6 +400,28 @@ def _fill_by_priority(
     before = jnp.cumsum(cap_sorted) - cap_sorted
     assigned_sorted = jnp.clip(quota - before, 0, cap_sorted)
     return jnp.zeros_like(cap).at[order].set(assigned_sorted)
+
+
+def _fill_with_pref(quota, cap, priority, pref):
+    """Warm-repair hole refill (docs/INCREMENTAL.md): slots a departed pod of
+    THIS class freed since the carry was taken (``pref[n]`` > 0) absorb the
+    quota first — each capped at its freed count, so a slot with slack beyond
+    its holes cannot siphon a neighbor's refill — then the normal priority
+    order sees the remainder.  With steady-state churn (replacements shaped
+    like the departures) the holes absorb the whole quota and the repair's
+    final placements are IDENTICAL to a from-scratch solve; without holes
+    (``pref`` None or zero) this is exactly ``_fill_by_priority``."""
+    if pref is None:
+        return _fill_by_priority(quota, cap, priority)
+    i32max = jnp.iinfo(jnp.int32).max
+    idx = jnp.arange(cap.shape[0], dtype=jnp.int32)
+    hole_cap = jnp.minimum(cap, pref)
+    a0 = _fill_by_priority(quota, hole_cap, jnp.where(hole_cap > 0, idx, i32max))
+    cap_rest = cap - a0
+    a1 = _fill_by_priority(
+        quota - jnp.sum(a0), cap_rest, jnp.where(cap_rest > 0, priority, i32max)
+    )
+    return a0 + a1
 
 
 class Statics(NamedTuple):
@@ -526,13 +600,16 @@ def _phase_existing(
     extra_elig: Optional[jnp.ndarray] = None,
     single_node: bool = False,
     ft: SnapshotFeatures = ALL_FEATURES,
+    pref: Optional[jnp.ndarray] = None,
 ) -> Tuple[ExistingState, jnp.ndarray, jnp.ndarray]:
     """Place up to ``quota`` pods of the class onto existing nodes, in index
     order (the reference iterates existing nodes first, in order, and takes the
     first that accepts — scheduler.go:176-180).  ``prep`` carries the step-wide
     intake/merge tensors; ``extra_elig`` restricts to a node subset (affinity
     targets / inverse anti-affinity blocks); ``single_node`` pins the whole
-    quota to the first eligible node (hostname self-affinity bootstrap)."""
+    quota to the first eligible node (hostname self-affinity bootstrap);
+    ``pref`` (warm repair only) the class's freed-hole counts per node
+    (``_fill_with_pref``)."""
     n_ex = ex.used.shape[0]
     merged = prep.merged
     # zone eligibility reads the LIVE state, not the prep snapshot: an
@@ -548,7 +625,7 @@ def _phase_existing(
         cap = jnp.where(jnp.arange(n_ex) == first, cap, 0)
 
     priority = jnp.where(cap > 0, jnp.arange(n_ex, dtype=jnp.int32), jnp.iinfo(jnp.int32).max)
-    assigned = _fill_by_priority(quota, cap, priority)
+    assigned = _fill_with_pref(quota, cap, priority, pref)
     placed = jnp.sum(assigned)
 
     took = assigned > 0
@@ -588,13 +665,16 @@ def _phase(
     extra_elig: Optional[jnp.ndarray] = None,
     max_new_nodes: Optional[int] = None,
     ft: SnapshotFeatures = ALL_FEATURES,
+    pref: Optional[jnp.ndarray] = None,
 ) -> Tuple[NodeState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Place up to ``quota`` pods of the class on nodes whose zone mask meets
     ``zone_restrict`` — first onto open nodes, then fresh nodes from the first
     viable template.  Returns (state, assigned[N], placed).  ``host_cap_vec``
     is the per-slot class cap from hostname groups, ``fresh_host_cap`` the cap
     for newly opened nodes; ``max_new_nodes`` caps node openings (hostname
-    self-affinity bootstraps exactly one, target-fill phases open none)."""
+    self-affinity bootstraps exactly one, target-fill phases open none);
+    ``pref`` (warm repair only) the class's freed-hole counts per slot
+    (``_fill_with_pref``)."""
     n_slots = state.used.shape[0]
     n_tmpl = statics.tmpl_it.shape[0]
 
@@ -638,7 +718,7 @@ def _phase(
     # slot count both stay far below 2^15 so the packed key fits int32
     priority = state.pod_count * n_slots + jnp.arange(n_slots, dtype=jnp.int32)
     priority = jnp.where(cap_n > 0, priority, jnp.iinfo(jnp.int32).max)
-    assigned = _fill_by_priority(quota, cap_n, priority)
+    assigned = _fill_with_pref(quota, cap_n, priority, pref)
     placed_existing = jnp.sum(assigned)
 
     # -- commit to existing nodes --------------------------------------------
@@ -791,12 +871,22 @@ def _class_step(
     cls_with_index,
     features: SnapshotFeatures = ALL_FEATURES,
     fuse_zones: bool = True,
+    pref: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    topo_base: Optional[Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]] = None,
 ):
     """One scan step: schedule every pod of one class — existing nodes first,
     then new nodes, per phase.  Topology lives in shared group counts (the
     reference's hash-deduped TopologyGroups): forward counts gate spread skew /
     affinity targets / anti owners; inverse counts gate the pods anti owners
     repel.
+
+    ``pref`` (warm repair only) is the class's ``(freed_new[N], freed_ex[E])``
+    hole counts: every fill prefers refilling the slots this class's departed
+    pods vacated (``_fill_with_pref``) before the normal priority order.
+    ``topo_base`` (windowed warm repair only) is the
+    ``(fwd_sing, fwd_full, inv_full)`` [G1, Z] zone-count contribution of
+    new-node slots outside the repair window (RepairPlan docstring), added as
+    constants into the zone derivations below.
 
     ``features`` (static) prunes whole phase families the snapshot provably
     cannot exercise — they are never traced, not just runtime-skipped.
@@ -808,6 +898,8 @@ def _class_step(
     ft = features
     state, ex, topo, remaining = carry
     cls, cls_index = cls_with_index
+    pref_new = pref[0] if pref is not None else None
+    pref_ex = pref[1] if pref is not None else None
     m = cls.count
     n_ex = ex.pod_count.shape[0]
     n_new_slots = state.pod_count.shape[0]
@@ -848,10 +940,14 @@ def _class_step(
         zone_fwd_sing = jnp.einsum("ge,ez->gz", topo.fwd_ex, ex_sing_zone) + jnp.einsum(
             "gn,nz->gz", topo.fwd_new, new_sing_zone
         )  # [G1, Z]
+        if topo_base is not None:
+            zone_fwd_sing = zone_fwd_sing + topo_base[0]
         if ft.zone_anti:
             zone_fwd_full = jnp.einsum("ge,ez->gz", topo.fwd_ex, ex_zone_i) + jnp.einsum(
                 "gn,nz->gz", topo.fwd_new, new_zone_i
             )
+            if topo_base is not None:
+                zone_fwd_full = zone_fwd_full + topo_base[1]
             zone_fwd = jnp.where(
                 statics.grp_is_anti[:, None], zone_fwd_full, zone_fwd_sing
             )
@@ -864,6 +960,8 @@ def _class_step(
         zone_inv_full = jnp.einsum("ge,ez->gz", topo.inv_ex, ex_zone_i) + jnp.einsum(
             "gn,nz->gz", topo.inv_new, new_zone_i
         )
+        if topo_base is not None:
+            zone_inv_full = zone_inv_full + topo_base[2]
         mem_anti_zone = member_row & statics.grp_is_anti & statics.grp_is_zone
         blocked_z = jnp.any(mem_anti_zone[:, None] & (zone_inv_full > 0), axis=0)  # [Z]
         allowed_zone = cls.zone & ~blocked_z
@@ -948,6 +1046,7 @@ def _class_step(
             ex_o, a_ex, placed_ex = _phase_existing(
                 ex_i, ex_prep, cls, quota, restrict,
                 extra_elig=extra_ex, single_node=single_node, ft=ft,
+                pref=pref_ex,
             )
             q_new = quota - placed_ex
             if single_node:
@@ -955,7 +1054,7 @@ def _class_step(
             state_o, a_new, placed_new, rem_o = _phase(
                 state_i, cls, statics, q_new, restrict,
                 host_cap_new, fresh_host_cap, rem_i, extra_elig=extra_new,
-                max_new_nodes=max_new_nodes, ft=ft,
+                max_new_nodes=max_new_nodes, ft=ft, pref=pref_new,
             )
             return state_o, ex_o, a_new, a_ex, placed_ex + placed_new, rem_o
 
@@ -1075,7 +1174,7 @@ def _class_step(
                 # existing nodes first, in index order (scheduler.go:176-180)
                 cap_e = jnp.where(~taken_ex & zh_ex, ex_cap, 0)
                 pri_e = jnp.where(cap_e > 0, jnp.arange(n_ex, dtype=jnp.int32), i32max)
-                a_ex = _fill_by_priority(q, cap_e, pri_e)
+                a_ex = _fill_with_pref(q, cap_e, pri_e, pref_ex)
                 placed_ex = jnp.sum(a_ex)
                 took_e = a_ex > 0
                 taken_ex = taken_ex | took_e
@@ -1085,7 +1184,7 @@ def _class_step(
                 q2 = q - placed_ex
                 cap_n = jnp.where(~taken_new, cap_open, 0)
                 pri_n = jnp.where(cap_n > 0, priority, i32max)
-                a_op = _fill_by_priority(q2, cap_n, pri_n)
+                a_op = _fill_with_pref(q2, cap_n, pri_n, pref_new)
                 placed_op = jnp.sum(a_op)
                 took_n = a_op > 0
                 taken_new = taken_new | took_n
@@ -1515,6 +1614,8 @@ def solve_core(
     features: "Optional[SnapshotFeatures]" = None,
     fuse_zones: bool = True,
     packed_masks: bool = True,
+    warm_carry: "Optional[WarmCarry]" = None,
+    repair_plan: "Optional[RepairPlan]" = None,
 ):
     """Unjitted kernel core — jit/vmap/shard_map-composable (the parallel layer
     vmaps this over snapshot replicas and consolidation subsets;
@@ -1535,7 +1636,20 @@ def solve_core(
     ``packed_masks`` (static) stores requirement masks as uint32 words and
     runs the mask algebra as bitwise AND + popcount (ops/masks.py) instead of
     bf16 einsums.  Both default on; the alternates are kept for parity
-    fuzzing."""
+    fuzzing.
+
+    ``warm_carry`` (traced pytree, shapes fixed) switches the call into a
+    warm-start REPAIR solve: the scan resumes from a previous solve's final
+    carry instead of empty slots, and ``class_tensors.count`` holds only the
+    delta pods to place (docs/INCREMENTAL.md).  The carry's plane shapes must
+    match this call's buckets — solver.incremental guarantees that by reusing
+    the previous padded tensors verbatim.  ``existing_static`` is still
+    required when the carry has real existing nodes (its tol/vol rows are
+    per-class); with a warm carry the topology/budget seeding is skipped —
+    both already live in the carry.  ``repair_plan`` (warm path only) carries
+    the per-class freed-hole planes every fill prefers to refill first plus
+    the out-of-window topology bases of a bounded repair (RepairPlan
+    docstring)."""
     if features is None:
         ft = ALL_FEATURES
         if emit_zonal_anti is not None:
@@ -1564,69 +1678,117 @@ def solve_core(
     n_ct = statics.tmpl_ct.shape[-1]
     n_classes = class_tensors.count.shape[0]
 
-    if packed_masks:
-        kmask0 = jnp.broadcast_to(
-            jnp.asarray(mask_ops.full_words(width)),
-            (n_slots, n_keys, mask_ops.words_for(width)),
-        )
-    else:
-        kmask0 = jnp.ones((n_slots, n_keys, width), dtype=bool)
-    state = NodeState(
-        used=jnp.zeros((n_slots, n_res), dtype=jnp.float32),
-        kmask=kmask0,
-        kdef=jnp.zeros((n_slots, n_keys), dtype=bool),
-        kneg=jnp.zeros((n_slots, n_keys), dtype=bool),
-        kgt=jnp.full((n_slots, n_keys), -jnp.inf, dtype=jnp.float32),
-        klt=jnp.full((n_slots, n_keys), jnp.inf, dtype=jnp.float32),
-        zone=jnp.ones((n_slots, n_zones), dtype=bool),
-        ct=jnp.ones((n_slots, n_ct), dtype=bool),
-        viable=jnp.ones((n_slots, n_it), dtype=bool),
-        ports=jnp.zeros((n_slots, class_tensors.ports.shape[-1] if n_classes else 1), dtype=bool),
-        pod_count=jnp.zeros(n_slots, dtype=jnp.int32),
-        tmpl_id=jnp.zeros(n_slots, dtype=jnp.int32),
-        open_=jnp.zeros(n_slots, dtype=bool),
-        n_next=jnp.int32(0),
-    )
     g1 = statics.grp_skew.shape[0]
     n_ports = class_tensors.ports.shape[-1] if n_classes else 1
-    if existing_state is None:
-        existing_state = empty_existing_state(n_res, n_keys, width, n_zones, n_ct, n_ports)
-        existing_static = empty_existing_static(n_res, n_classes, g1)
-    if packed_masks and existing_state.kmask.dtype != jnp.uint32:
-        existing_state = existing_state._replace(
-            kmask=mask_ops.pack_mask(existing_state.kmask)
+    if warm_carry is not None:
+        # warm-start repair: resume from the previous solve's final carry.
+        # The carry's planes already went through this function once — masks
+        # are packed, topology counts and the limit budget are live — so all
+        # of the seeding below is skipped (it would double-count).
+        wc = WarmCarry(*warm_carry)
+        state = NodeState(*wc.state)
+        existing_state = ExistingState(*wc.ex_state)
+        n_slots = state.pod_count.shape[0]
+        if existing_static is None:
+            existing_static = empty_existing_static(n_res, n_classes, g1)
+        topo = TopoCounts(*wc.topo)
+        remaining0 = wc.remaining
+    else:
+        if packed_masks:
+            kmask0 = jnp.broadcast_to(
+                jnp.asarray(mask_ops.full_words(width)),
+                (n_slots, n_keys, mask_ops.words_for(width)),
+            )
+        else:
+            kmask0 = jnp.ones((n_slots, n_keys, width), dtype=bool)
+        state = NodeState(
+            used=jnp.zeros((n_slots, n_res), dtype=jnp.float32),
+            kmask=kmask0,
+            kdef=jnp.zeros((n_slots, n_keys), dtype=bool),
+            kneg=jnp.zeros((n_slots, n_keys), dtype=bool),
+            kgt=jnp.full((n_slots, n_keys), -jnp.inf, dtype=jnp.float32),
+            klt=jnp.full((n_slots, n_keys), jnp.inf, dtype=jnp.float32),
+            zone=jnp.ones((n_slots, n_zones), dtype=bool),
+            ct=jnp.ones((n_slots, n_ct), dtype=bool),
+            viable=jnp.ones((n_slots, n_it), dtype=bool),
+            ports=jnp.zeros((n_slots, n_ports), dtype=bool),
+            pod_count=jnp.zeros(n_slots, dtype=jnp.int32),
+            tmpl_id=jnp.zeros(n_slots, dtype=jnp.int32),
+            open_=jnp.zeros(n_slots, dtype=bool),
+            n_next=jnp.int32(0),
         )
+        if existing_state is None:
+            existing_state = empty_existing_state(n_res, n_keys, width, n_zones, n_ct, n_ports)
+            existing_static = empty_existing_static(n_res, n_classes, g1)
+        if packed_masks and existing_state.kmask.dtype != jnp.uint32:
+            existing_state = existing_state._replace(
+                kmask=mask_ops.pack_mask(existing_state.kmask)
+            )
 
-    # seed topology counts from pre-existing pods (topology.go:231-276
-    # countDomains): forward from selector-matching pods, inverse from
-    # anti-term owners — closed nodes (consolidation subsets) drop out at
-    # derivation time (the zone projection multiplies by the open mask)
-    open_i = existing_state.open_.astype(jnp.int32)
-    member_open = existing_static.grp_node_member * open_i[None, :]
-    owner_open = existing_static.grp_node_owner * open_i[None, :]
-    topo = TopoCounts(
-        fwd_ex=member_open,
-        inv_ex=owner_open,
-        fwd_new=jnp.zeros((g1, n_slots), dtype=jnp.int32),
-        inv_new=jnp.zeros((g1, n_slots), dtype=jnp.int32),
-    )
+        # seed topology counts from pre-existing pods (topology.go:231-276
+        # countDomains): forward from selector-matching pods, inverse from
+        # anti-term owners — closed nodes (consolidation subsets) drop out at
+        # derivation time (the zone projection multiplies by the open mask)
+        open_i = existing_state.open_.astype(jnp.int32)
+        member_open = existing_static.grp_node_member * open_i[None, :]
+        owner_open = existing_static.grp_node_owner * open_i[None, :]
+        topo = TopoCounts(
+            fwd_ex=member_open,
+            inv_ex=owner_open,
+            fwd_new=jnp.zeros((g1, n_slots), dtype=jnp.int32),
+            inv_new=jnp.zeros((g1, n_slots), dtype=jnp.int32),
+        )
 
     def step(carry, cls_with_index):
-        return _class_step(
-            statics, existing_static, n_zones, carry, cls_with_index,
-            features=ft, fuse_zones=fuse_zones,
-        )
+        # the whole class step is masked behind count > 0: a zero-count class
+        # contributes nothing (phases place 0, record adds 0), so skipping it
+        # is a pure no-op that saves the step's dense prep on device.  This is
+        # what makes the warm-start REPAIR scan cost proportional to the dirty
+        # region: clean classes carry count 0 and fall through, while the
+        # iteration shape (C steps) stays fixed so the executable is reused
+        # across reconciles.  Full solves benefit too — padded bucket rows and
+        # ladder-variant rows idle at 0 until a pass rolls counts into them.
+        if repair_plan is not None:
+            cls, cls_index, pref_new_row, pref_ex_row = cls_with_index
+            pref = (pref_new_row, pref_ex_row)
+            base = (
+                repair_plan.base_fwd_sing,
+                repair_plan.base_fwd_full,
+                repair_plan.base_inv_full,
+            )
+        else:
+            cls, cls_index = cls_with_index
+            pref = None
+            base = None
+
+        def do(carry_in):
+            return _class_step(
+                statics, existing_static, n_zones, carry_in, (cls, cls_index),
+                features=ft, fuse_zones=fuse_zones, pref=pref, topo_base=base,
+            )
+
+        def skip(carry_in):
+            state_i, ex_i, _, _ = carry_in
+            return carry_in, (
+                jnp.zeros_like(state_i.pod_count),
+                jnp.zeros_like(ex_i.pod_count),
+                jnp.int32(0),
+                jnp.array(False),
+            )
+
+        return jax.lax.cond(cls.count > 0, do, skip, carry)
 
     cls_indices = jnp.arange(n_classes, dtype=jnp.int32)
-    # charge open owned nodes' capacity against their provisioner's budget
-    n_tmpl = statics.tmpl_zone.shape[0]
-    tmpl_onehot = (
-        existing_static.node_tmpl[:, None] == jnp.arange(n_tmpl)[None, :]
-    ) & (existing_static.node_owned & existing_state.open_)[:, None]  # [E, T]
-    used_budget = jnp.einsum(
-        "et,er->tr", tmpl_onehot.astype(jnp.float32), existing_static.node_capacity
-    )
-    remaining0 = statics.tmpl_limits0 - used_budget
+    if warm_carry is None:
+        # charge open owned nodes' capacity against their provisioner's budget
+        n_tmpl = statics.tmpl_zone.shape[0]
+        tmpl_onehot = (
+            existing_static.node_tmpl[:, None] == jnp.arange(n_tmpl)[None, :]
+        ) & (existing_static.node_owned & existing_state.open_)[:, None]  # [E, T]
+        used_budget = jnp.einsum(
+            "et,er->tr", tmpl_onehot.astype(jnp.float32), existing_static.node_capacity
+        )
+        remaining0 = statics.tmpl_limits0 - used_budget
     carry = (state, existing_state, topo, remaining0)
     assign = jnp.zeros((n_classes, n_slots), dtype=jnp.int32)
     n_ex = existing_state.pod_count.shape[0]
@@ -1636,9 +1798,13 @@ def solve_core(
     suspect = jnp.zeros(n_classes, dtype=bool)
     for p in range(max(n_passes, 1)):
         cls_pass = class_tensors._replace(count=count_left)
-        carry, (a, a_ex, failed, suspect_p) = jax.lax.scan(
-            step, carry, (cls_pass, cls_indices)
-        )
+        xs = (cls_pass, cls_indices)
+        if repair_plan is not None:
+            xs = xs + (
+                repair_plan.pref_new.astype(jnp.int32),
+                repair_plan.pref_ex.astype(jnp.int32),
+            )
+        carry, (a, a_ex, failed, suspect_p) = jax.lax.scan(step, carry, xs)
         assign = assign + a
         assign_ex = assign_ex + a_ex
         suspect = suspect | suspect_p
@@ -1670,7 +1836,7 @@ def solve_core(
             )
             ex_c = ex_c._replace(vol_used=existing_state.vol_used + shared + per_pod)
             carry = (state_c, ex_c, topo_c, rem_c)
-    final_state, final_ex, _, _ = carry
+    final_state, final_ex, final_topo, final_remaining = carry
     return SolveOutputs(
         assign=assign,
         assign_existing=assign_ex,
@@ -1678,6 +1844,8 @@ def solve_core(
         state=final_state,
         ex_state=final_ex,
         spread_suspect=suspect,
+        topo=final_topo,
+        remaining=final_remaining,
     )
 
 
@@ -1726,6 +1894,162 @@ _solve_jit = functools.partial(
         "features", "fuse_zones", "packed_masks",
     ),
 )(solve_core)
+
+
+def warm_carry_of(outputs: SolveOutputs) -> Optional[WarmCarry]:
+    """Package a solve's final carry for a later repair solve.  All leaves are
+    (lazy) device arrays — holding a WarmCarry costs no transfer; None when
+    the outputs predate the carry fields (hand-built in tests)."""
+    if outputs.topo is None or outputs.remaining is None:
+        return None
+    return WarmCarry(
+        state=outputs.state,
+        ex_state=outputs.ex_state,
+        topo=outputs.topo,
+        remaining=outputs.remaining,
+    )
+
+
+@jax.jit
+def repair_free(
+    warm_carry: WarmCarry,
+    free_new: jnp.ndarray,
+    free_ex: jnp.ndarray,
+    cls_requests: jnp.ndarray,
+    member: jnp.ndarray,
+    own_inv: jnp.ndarray,
+) -> WarmCarry:
+    """Return evicted pods' capacity and topology counts to a warm carry.
+
+    ``free_new`` i32[C, N] / ``free_ex`` i32[C, E] count the pods of class c
+    evicted from each slot since the carry was produced; ``cls_requests``
+    f32[C, R] is the per-pod request vector, ``member`` / ``own_inv``
+    i32[C, G1] the class's topology-group membership and inverse-ownership
+    rows (solver.incremental builds them host-side from the snapshot).
+
+    Deliberately one-way: used capacity, pod counts, and group counts are
+    returned, but merged requirement masks, zone/ct commitments, port claims,
+    and volume counters are NOT reverted — a freed slot keeps every
+    requirement its departed residents stamped on it.  That pessimism can
+    only under-place (never corrupt), and it is exactly the accumulated
+    optimality drift the fallback policy's periodic full-solve audit resets
+    (docs/INCREMENTAL.md)."""
+    wc = WarmCarry(*warm_carry)
+    state = NodeState(*wc.state)
+    ex = ExistingState(*wc.ex_state)
+    topo = TopoCounts(*wc.topo)
+    f_new = free_new.astype(jnp.float32)
+    f_ex = free_ex.astype(jnp.float32)
+    state = state._replace(
+        used=state.used - jnp.einsum("cn,cr->nr", f_new, cls_requests),
+        pod_count=jnp.maximum(state.pod_count - jnp.sum(free_new, axis=0), 0),
+    )
+    ex = ex._replace(
+        used=ex.used - jnp.einsum("ce,cr->er", f_ex, cls_requests),
+        pod_count=jnp.maximum(ex.pod_count - jnp.sum(free_ex, axis=0), 0),
+    )
+    topo = TopoCounts(
+        fwd_ex=jnp.maximum(topo.fwd_ex - jnp.einsum("cg,ce->ge", member, free_ex), 0),
+        inv_ex=jnp.maximum(topo.inv_ex - jnp.einsum("cg,ce->ge", own_inv, free_ex), 0),
+        fwd_new=jnp.maximum(topo.fwd_new - jnp.einsum("cg,cn->gn", member, free_new), 0),
+        inv_new=jnp.maximum(topo.inv_new - jnp.einsum("cg,cn->gn", own_inv, free_new), 0),
+    )
+    return WarmCarry(state=state, ex_state=ex, topo=topo, remaining=wc.remaining)
+
+
+@jax.jit
+def gather_repair_window(warm_carry: WarmCarry, idx: jnp.ndarray, n_open_w):
+    """Gather the repair's dirty slot window out of a full-width carry.
+
+    ``idx`` i32[S] names the global new-node slots the bounded repair may
+    touch — the freed-hole slots (in ascending order), any open filler, then
+    the fresh tail starting at the carry's ``n_next`` — and ``n_open_w`` is
+    how many of them are open.  Returns the windowed WarmCarry (per-slot
+    NodeState planes and the new-side topology columns gathered; existing
+    planes and the limit budget pass through whole) plus the
+    ``(fwd_sing, fwd_full, inv_full)`` [G1, Z] zone-count contribution of
+    every EXCLUDED open slot, which the windowed solve adds back as constants
+    (RepairPlan).  The per-class-step cost of the repair then scales with the
+    window, not the fleet (docs/INCREMENTAL.md)."""
+    wc = WarmCarry(*warm_carry)
+    state = NodeState(*wc.state)
+    topo = TopoCounts(*wc.topo)
+    n_slots = state.pod_count.shape[0]
+    excl_open = jnp.ones(n_slots, dtype=bool).at[idx].set(False) & state.open_
+    zone_i = state.zone.astype(jnp.int32) * excl_open.astype(jnp.int32)[:, None]
+    sing = jnp.where(jnp.sum(zone_i, axis=-1, keepdims=True) == 1, zone_i, 0)
+    base = (
+        jnp.einsum("gn,nz->gz", topo.fwd_new, sing),
+        jnp.einsum("gn,nz->gz", topo.fwd_new, zone_i),
+        jnp.einsum("gn,nz->gz", topo.inv_new, zone_i),
+    )
+    w_state = NodeState(
+        used=state.used[idx],
+        kmask=state.kmask[idx],
+        kdef=state.kdef[idx],
+        kneg=state.kneg[idx],
+        kgt=state.kgt[idx],
+        klt=state.klt[idx],
+        zone=state.zone[idx],
+        ct=state.ct[idx],
+        viable=state.viable[idx],
+        ports=state.ports[idx],
+        pod_count=state.pod_count[idx],
+        tmpl_id=state.tmpl_id[idx],
+        open_=state.open_[idx],
+        n_next=jnp.asarray(n_open_w, dtype=jnp.int32),
+    )
+    w_topo = TopoCounts(
+        fwd_ex=topo.fwd_ex,
+        inv_ex=topo.inv_ex,
+        fwd_new=topo.fwd_new[:, idx],
+        inv_new=topo.inv_new[:, idx],
+    )
+    return (
+        WarmCarry(state=w_state, ex_state=wc.ex_state, topo=w_topo,
+                  remaining=wc.remaining),
+        base,
+    )
+
+
+@jax.jit
+def scatter_repair_window(
+    warm_carry: WarmCarry, window_carry: WarmCarry, idx: jnp.ndarray, n_open_w
+) -> WarmCarry:
+    """Write a windowed repair's final carry back over the full-width carry:
+    per-slot planes scatter to their global slots, the existing-node state
+    and limit budget are replaced whole (the repair is their only writer),
+    and ``n_next`` advances by however many fresh slots the repair opened."""
+    wc = WarmCarry(*warm_carry)
+    ww = WarmCarry(*window_carry)
+    gs = NodeState(*wc.state)
+    ws = NodeState(*ww.state)
+    gt = TopoCounts(*wc.topo)
+    wt = TopoCounts(*ww.topo)
+    state = NodeState(
+        used=gs.used.at[idx].set(ws.used),
+        kmask=gs.kmask.at[idx].set(ws.kmask),
+        kdef=gs.kdef.at[idx].set(ws.kdef),
+        kneg=gs.kneg.at[idx].set(ws.kneg),
+        kgt=gs.kgt.at[idx].set(ws.kgt),
+        klt=gs.klt.at[idx].set(ws.klt),
+        zone=gs.zone.at[idx].set(ws.zone),
+        ct=gs.ct.at[idx].set(ws.ct),
+        viable=gs.viable.at[idx].set(ws.viable),
+        ports=gs.ports.at[idx].set(ws.ports),
+        pod_count=gs.pod_count.at[idx].set(ws.pod_count),
+        tmpl_id=gs.tmpl_id.at[idx].set(ws.tmpl_id),
+        open_=gs.open_.at[idx].set(ws.open_),
+        n_next=gs.n_next + (ws.n_next - jnp.asarray(n_open_w, dtype=jnp.int32)),
+    )
+    topo = TopoCounts(
+        fwd_ex=wt.fwd_ex,
+        inv_ex=wt.inv_ex,
+        fwd_new=gt.fwd_new.at[:, idx].set(wt.fwd_new),
+        inv_new=gt.inv_new.at[:, idx].set(wt.inv_new),
+    )
+    return WarmCarry(state=state, ex_state=ww.ex_state, topo=topo,
+                     remaining=ww.remaining)
 
 
 @jax.jit

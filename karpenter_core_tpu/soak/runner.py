@@ -71,6 +71,11 @@ class SoakScenario:
     provisioners: Tuple[str, ...] = ("default",)
     consolidation: bool = False
     ttl_seconds_after_empty: Optional[int] = None
+    # route provisioning solves through the TPU kernel path (batches of at
+    # least ``tpu_kernel_min_pods`` pending pods) — the churn-steady scenario
+    # measures the full-re-solve vs incremental amortization there
+    use_tpu_kernel: bool = False
+    tpu_kernel_min_pods: int = 256
 
     def with_seed(self, seed: int) -> "SoakScenario":
         return replace(self, seed=int(seed))
@@ -217,6 +222,9 @@ class SoakRunner:
                 # inside the fault window (the watch.stream point)
                 chaos.arm(chaos_scenario, clock)
             env = harness.make_environment(kube_factory=kube_factory, clock=clock)
+            if scenario.use_tpu_kernel:
+                env.provisioning.use_tpu_kernel = True
+                env.provisioning.tpu_kernel_min_pods = scenario.tpu_kernel_min_pods
             for prov_name in scenario.provisioners:
                 env.kube.create(factories.make_provisioner(
                     name=prov_name,

@@ -125,8 +125,38 @@ def mixed_fleet_steady(seed: int = 41) -> SoakScenario:
     )
 
 
+def churn_steady(seed: int = 53) -> SoakScenario:
+    """Steady-state churn on a 10k-pod fleet through the TPU kernel solve
+    path — the measured case the ROADMAP's incremental-solver item asks for:
+    under sustained churn the full-re-solve-per-reconcile amortization
+    visibly misses a per-reconcile solve-latency SLO (advisory wall-clock
+    rule), while the incremental delta path holds it.  Slow matrix only (10k
+    pod objects, kernel compiles); the small tier-1 smoke lives in
+    tests/test_incremental.py.  KC_SOLVER_INCREMENTAL=0 reproduces the
+    full-path miss on demand (docs/INCREMENTAL.md)."""
+    return SoakScenario(
+        name="churn-steady",
+        seed=seed,
+        generator="diurnal",
+        # base == peak: a flat Poisson arrival stream with exponential
+        # lifetimes — standing population ≈ rate × lifetime ≈ 9.6k pods
+        params={
+            "duration_s": 600.0, "period_s": 600.0,
+            "base_rate_per_s": 16.0, "peak_rate_per_s": 16.0,
+            "mean_lifetime_s": 600.0,
+        },
+        slo={"rules": _CONVERGENCE_RULES + [
+            {"probe": "solve_latency_s", "agg": "mean", "limit": 1.0},
+        ]},
+        tick_s=30.0,
+        settle_ticks=30,
+        use_tpu_kernel=True,
+    )
+
+
 CATALOG: Dict[str, Callable[[int], SoakScenario]] = {
     "deploy-storm-smoke": deploy_storm_smoke,
+    "churn-steady": churn_steady,
     "diurnal-consolidation": diurnal_consolidation,
     "batch-flood-flaky-api": batch_flood_flaky_api,
     "mass-eviction-capacity": mass_eviction_capacity,

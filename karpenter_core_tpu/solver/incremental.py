@@ -1,0 +1,717 @@
+"""Incremental warm-start solving over the versioned snapshot store.
+
+The full kernel solve re-encodes and re-solves the whole cluster snapshot
+every reconcile.  At steady-state churn rates only a handful of pods change
+between ticks, so this module amortizes: a ``IncrementalSolveSession`` keeps
+the previous solve's padded tensors (solver.tpu.SolvePrep), its final scan
+carry (ops.solve.WarmCarry, device-resident), and host-side placement
+bookkeeping; each reconcile a ``FallbackPolicy`` decides **full** vs
+**delta**:
+
+  full    encode → commit to the SnapshotStore → solve from scratch → adopt
+          the carry.  Chosen on the first solve, on any supply-side change
+          (nodes / bound pods / catalog / templates), on a class-shape change
+          (new/removed equivalence classes — the tensor axes moved), when the
+          delta fraction exceeds ``max_delta_fraction``, and periodically as
+          the optimality **audit** (``audit_interval``) that measures and
+          resets accumulated repair drift.
+  delta   no encode at all: evicted pods' capacity/topology counts are
+          returned to the carry (``ops.solve.repair_free``), then ONE repair
+          executable runs over the previous padded tensors with a class-count
+          vector holding only the new (plus previously-failed) pods, resumed
+          from the carry.  Same class step, same phases, same constraint
+          semantics — the repair is literally the full solve's scan continued.
+
+Decisions surface as the ``solve.mode`` span attribute and the
+``karpenter_solve_mode_total{mode}`` counter so the amortization is
+observable.  ``KC_SOLVER_INCREMENTAL=0`` disables the session entirely — the
+degenerate case is exactly the old full-solve-every-reconcile path.
+See docs/INCREMENTAL.md.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import os
+from dataclasses import dataclass, field, replace as dc_replace
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_core_tpu import tracing
+from karpenter_core_tpu.metrics import REGISTRY
+from karpenter_core_tpu.models import store as store_mod
+from karpenter_core_tpu.models.store import (
+    SnapshotStore,
+    VersionedSnapshot,
+    class_key,
+    diff_members,
+)
+from karpenter_core_tpu.ops import solve as solve_ops
+
+log = logging.getLogger(__name__)
+
+SOLVE_MODE = REGISTRY.counter(
+    "karpenter_solve_mode_total",
+    "Kernel solve dispatches by mode: full re-solve vs incremental delta "
+    "repair (docs/INCREMENTAL.md).",
+    ("mode",),
+)
+
+MODE_FULL = "full"
+MODE_DELTA = "delta"
+
+
+def incremental_enabled() -> bool:
+    """Process-wide kill switch: KC_SOLVER_INCREMENTAL=0 keeps the old
+    full-solve-every-reconcile path as the degenerate case."""
+    return os.environ.get("KC_SOLVER_INCREMENTAL", "1") != "0"
+
+
+@dataclass
+class FallbackPolicy:
+    """Per-reconcile full-vs-delta decision (module docstring)."""
+
+    enabled: bool = True
+    # delta fraction (added+evicted over population) above which a repair
+    # stops being the right amortization — the phases run per dirty class
+    # anyway, so past this a full solve is both faster and drift-free
+    max_delta_fraction: float = 0.25
+    # delta reconciles between full-solve audits (0 = never audit); the audit
+    # both measures repair drift (objective = opened-node count) and resets it
+    audit_interval: int = 16
+    # materialized sessions (the provisioning controller, whose previous
+    # decisions become real nodes) may only repair when the previous solve
+    # opened no new slots — an opened slot was launched and must re-enter as
+    # a real existing node (supply change ⇒ full) rather than be re-decided
+    materialized: bool = False
+
+    @classmethod
+    def from_env(cls, materialized: bool = False) -> "FallbackPolicy":
+        def _f(name: str, default: float) -> float:
+            try:
+                return float(os.environ.get(name, default))
+            except ValueError:
+                return default
+
+        return cls(
+            enabled=incremental_enabled(),
+            max_delta_fraction=_f("KC_DELTA_MAX_FRACTION", 0.25),
+            audit_interval=int(_f("KC_DELTA_AUDIT_INTERVAL", 16)),
+            materialized=materialized,
+        )
+
+    def decide(self, delta, delta_ticks: int, prev_slots_used: int,
+               known_classes=None) -> Tuple[str, str]:
+        """(mode, reason).  ``delta`` is a models.store.SnapshotDelta (or None
+        on the first solve); ``delta_ticks`` counts repairs since the last
+        full solve; ``prev_slots_used`` the slots the previous solve opened;
+        ``known_classes`` the class keys the previous padded tensors can
+        express — a "new" class returning to a known (emptied) row repairs
+        fine, while a genuinely unseen key means the class axis moved and the
+        snapshot must re-encode.  Removed classes never force a full solve:
+        an emptied row idles as a zero-count scan step."""
+        if not self.enabled:
+            return MODE_FULL, "disabled"
+        if delta is None:
+            return MODE_FULL, "first"
+        if delta.node_side_changed:
+            return MODE_FULL, "supply-changed:" + ",".join(delta.changed_planes)
+        unknown = tuple(
+            k for k in delta.new_classes
+            if known_classes is None or k not in known_classes
+        )
+        if unknown:
+            return MODE_FULL, "class-shape"
+        if self.materialized and prev_slots_used > 0:
+            return MODE_FULL, "materialized-slots"
+        if self.audit_interval and delta_ticks >= self.audit_interval:
+            return MODE_FULL, "audit"
+        if delta.delta_fraction > self.max_delta_fraction:
+            return MODE_FULL, f"delta-fraction:{delta.delta_fraction:.3f}"
+        return MODE_DELTA, "delta"
+
+
+@dataclass
+class _WarmState:
+    """Everything one delta reconcile needs, carried from the last full solve
+    and updated by every repair."""
+
+    versioned: VersionedSnapshot
+    prep: object  # solver.tpu.SolvePrep (padded tensors; reused verbatim)
+    carry: object  # ops.solve.WarmCarry (device)
+    assign: np.ndarray  # i32[C_pad, N] cumulative new-slot placements
+    assign_ex: np.ndarray  # i32[C_pad, E_pad] cumulative existing placements
+    n_next: int  # slots the scan has opened so far
+    members: Dict[tuple, Tuple[str, ...]]  # class key -> live member uids
+    class_index: Dict[tuple, int]  # class key -> class row
+    pod_loc: Dict[str, Tuple[int, str, int]]  # uid -> (row, "new"|"ex", idx)
+    row_key: Dict[int, tuple]  # class row -> class key (pod_loc's inverse leg)
+    failed_pods: Dict[str, Tuple[int, object]]  # uid -> (row, Pod), unplaced
+    member_rows: np.ndarray  # i32[C_pad, G1] topology membership per class
+    own_inv_rows: np.ndarray  # i32[C_pad, G1] inverse-ownership per class
+    supply: str
+    state_nodes: list = field(default_factory=list)
+    delta_ticks: int = 0
+    initial_slots_used: int = 0  # slots open at full-solve time
+    # lineage-placed pods that have since BOUND: physically on their node now,
+    # still counted by the carry, excluded from the membership and supply
+    # views (IncrementalSolveSession._absorb_bound)
+    materialized: set = field(default_factory=set)
+
+
+class IncrementalSolveSession:
+    """One warm-start solve lineage: full solves adopt state, delta solves
+    repair it.  Bind a fresh TPUSolver each reconcile via ``rebind`` (the
+    controller rebuilds its solver per batch); the session survives as long
+    as the fallback policy keeps judging deltas safe."""
+
+    def __init__(self, solver=None, policy: Optional[FallbackPolicy] = None) -> None:
+        self.solver = solver
+        self.policy = policy or FallbackPolicy.from_env()
+        self.store = SnapshotStore()
+        self._warm: Optional[_WarmState] = None
+        self.last_mode: Optional[str] = None
+        self.last_reason: Optional[str] = None
+        self.last_audit_drift_nodes: Optional[int] = None
+        self.mode_counts: Dict[str, int] = {MODE_FULL: 0, MODE_DELTA: 0}
+
+    def rebind(self, solver) -> None:
+        self.solver = solver
+
+    def reset(self) -> None:
+        """Drop the warm lineage (next solve is full)."""
+        self._warm = None
+
+    # -- membership extraction -------------------------------------------------
+
+    @staticmethod
+    def _members_of(pods_or_classes):
+        """(class key -> uids, uid -> Pod getter, classes-or-None) from a
+        PodIngest or a prebuilt PodClass list — riding the ingest's
+        bookkeeping, no signature re-derivation per pod and no per-pod
+        materialization (the getter resolves only the delta's uids)."""
+        from karpenter_core_tpu.models.columnar import PodIngest
+
+        if isinstance(pods_or_classes, PodIngest):
+            return pods_or_classes.class_members(), pods_or_classes.get, None
+        classes = list(pods_or_classes)
+        members = {}
+        by_uid = {}
+        for cls in classes:
+            if getattr(cls, "is_ladder_variant", False):
+                continue
+            key = class_key(cls)
+            members[key] = tuple(p.uid for p in cls.pods)
+            for p in cls.pods:
+                by_uid[p.uid] = p
+        return members, by_uid.get, classes
+
+    # -- the solve entry -------------------------------------------------------
+
+    def solve(
+        self,
+        pods_or_classes,
+        state_nodes: Optional[list] = None,
+        bound_pods: Optional[list] = None,
+    ):
+        """TPUSolveResults for the current population.  Full reconciles see
+        the whole picture (every node decision); delta reconciles return only
+        this tick's placements (new pods onto new/existing capacity), which
+        is exactly what the controller needs to act on.  Raises
+        models.snapshot.KernelUnsupported exactly like TPUSolver.solve."""
+        from karpenter_core_tpu.solver.backendprobe import SOLVER_DISPATCH
+
+        members, by_uid, classes = self._members_of(pods_or_classes)
+        if self._warm is not None:
+            self._absorb_bound({p.uid for p in (bound_pods or [])})
+        catalog = store_mod.catalog_digest(
+            self.solver.provisioners, self.solver.instance_types
+        )
+        # the comparison digest excludes bound pods this lineage placed itself
+        # (their binding is the lineage's own work materializing, not a supply
+        # change); the ANCHOR a full solve stores is unfiltered, because a
+        # fresh encode sees — and accounts — every bound pod
+        known = self._warm.materialized if self._warm is not None else ()
+        supply = store_mod.supply_digest(
+            state_nodes,
+            [p for p in (bound_pods or []) if p.uid not in known]
+            if known else bound_pods,
+        ) + catalog
+        supply_anchor = supply if not known else (
+            store_mod.supply_digest(state_nodes, bound_pods) + catalog
+        )
+
+        delta = None
+        if self._warm is not None:
+            delta = diff_members(
+                self._warm.members, members,
+                from_version=self._warm.versioned.version,
+                supply_changed=() if supply == self._warm.supply else ("supply",),
+            )
+        mode, reason = self.policy.decide(
+            delta,
+            self._warm.delta_ticks if self._warm is not None else 0,
+            self._warm.n_next - self._warm.initial_slots_used
+            if self._warm is not None else 0,
+            known_classes=self._warm.class_index
+            if self._warm is not None else None,
+        )
+
+        fault = SOLVER_DISPATCH.hit(
+            kinds=("error", "timeout"), op="solve", classes=len(members)
+        )
+        if fault is not None and fault.kind in ("error", "timeout"):
+            raise RuntimeError(fault.describe())
+
+        with tracing.span("solve.incremental") as sp:
+            if mode == MODE_DELTA:
+                results = self._delta_solve(delta, by_uid, state_nodes)
+                if results is None:  # repair ran out of room: escalate
+                    mode, reason = MODE_FULL, "slots-exhausted"
+            if mode == MODE_FULL:
+                results = self._full_solve(
+                    pods_or_classes if classes is None else classes,
+                    members, state_nodes, bound_pods, supply_anchor, reason,
+                )
+            sp.set(**{"solve.mode": mode, "solve.mode.reason": reason})
+        SOLVE_MODE.labels(mode).inc()
+        self.last_mode, self.last_reason = mode, reason
+        self.mode_counts[mode] = self.mode_counts.get(mode, 0) + 1
+        return results
+
+    def _absorb_bound(self, bound_uids) -> None:
+        """Lineage-placed pods that have since BOUND leave the pending
+        population as the lineage's own work materializing, not as evictions:
+        their capacity stays committed in the carry (they now physically
+        occupy the node the repair placed them on), they leave the membership
+        view so the diff never frees them, and the supply comparison excludes
+        them so their binding doesn't read as a supply change.  Genuinely
+        foreign bound pods still flip the supply digest ⇒ full solve."""
+        w = self._warm
+        moved = [uid for uid in w.pod_loc if uid in bound_uids]
+        if not moved:
+            return
+        trimmed: Dict[tuple, List[str]] = {}
+        for uid in moved:
+            row, _kind, _idx = w.pod_loc.pop(uid)
+            key = w.row_key.get(row)
+            if key is not None:
+                trimmed.setdefault(key, []).append(uid)
+            w.materialized.add(uid)
+        for key, uids in trimmed.items():
+            gone = set(uids)
+            left = tuple(u for u in w.members.get(key, ()) if u not in gone)
+            if left:
+                w.members[key] = left
+            else:
+                w.members.pop(key, None)
+
+    # -- full path -------------------------------------------------------------
+
+    def _full_solve(self, pods_or_classes, members, state_nodes, bound_pods,
+                    supply, reason):
+        import jax
+
+        solver = self.solver
+        prev_nodes = self.node_count() if self._warm is not None else None
+        try:
+            if isinstance(pods_or_classes, list):
+                snapshot = solver.encode_classes(
+                    pods_or_classes, state_nodes=state_nodes, bound_pods=bound_pods
+                )
+            else:
+                snapshot = solver.encode(pods_or_classes, state_nodes, bound_pods)
+            versioned = self.store.commit(snapshot, supply=supply)
+            prep = solver.prepare_encoded(snapshot, state_nodes, bound_pods)
+            outputs = solver.run_prepared(prep)
+            n_next_h, failed_h = jax.device_get(
+                (outputs.state.n_next, outputs.failed)
+            )
+            slots = outputs.assign.shape[1]
+            if int(np.sum(failed_h)) > 0 and int(n_next_h) >= slots:
+                outputs = solver.run_prepared(prep, n_slots=slots * 2)
+            results = solver.decode(snapshot, outputs, state_nodes or [])
+        except Exception:
+            self._warm = None  # a half-built lineage must not seed repairs
+            raise
+        self._adopt(versioned, prep, outputs, results, members, supply,
+                    state_nodes, prev_nodes, reason)
+        return results
+
+    def _adopt(self, versioned, prep, outputs, results, members, supply,
+               state_nodes, prev_nodes, reason):
+        import jax
+
+        carry = solve_ops.warm_carry_of(outputs)
+        assign, assign_ex, n_next = jax.device_get(
+            (outputs.assign, outputs.assign_existing, outputs.state.n_next)
+        )
+        assign = np.asarray(assign, dtype=np.int32).copy()
+        assign_ex = np.asarray(assign_ex, dtype=np.int32).copy()
+        snapshot = versioned.snapshot
+        pod_loc, unplaced = _locate_pods(snapshot, assign, assign_ex)
+        all_pods = {
+            p.uid: p for cls in snapshot.classes for p in cls.pods
+        }
+        failed_pods = {uid: (row, all_pods[uid]) for uid, row in unplaced}
+        member_rows, own_inv_rows = _topology_rows(prep)
+        index = versioned.index_of()
+        row_key = {i: row.key for i, row in enumerate(versioned.rows)}
+        self.last_audit_drift_nodes = None
+        if prev_nodes is not None and reason.startswith("audit"):
+            fresh = int(np.sum(np.sum(assign, axis=0) > 0))
+            self.last_audit_drift_nodes = prev_nodes - fresh
+            if self.last_audit_drift_nodes:
+                log.info(
+                    "incremental solve audit: repair lineage carried %+d "
+                    "node(s) of drift vs the fresh full solve",
+                    self.last_audit_drift_nodes,
+                )
+        self._warm = _WarmState(
+            versioned=versioned,
+            prep=prep,
+            carry=carry,
+            assign=assign,
+            assign_ex=assign_ex,
+            n_next=int(n_next),
+            members=dict(members),
+            class_index=index,
+            pod_loc=pod_loc,
+            row_key=row_key,
+            failed_pods=failed_pods,
+            member_rows=member_rows,
+            own_inv_rows=own_inv_rows,
+            supply=supply,
+            state_nodes=list(state_nodes or []),
+            initial_slots_used=0,
+        )
+        if carry is None:
+            self._warm = None  # outputs predate the carry fields
+
+    # -- delta path ------------------------------------------------------------
+
+    def _delta_solve(self, delta, by_uid, state_nodes):
+        import jax
+
+        w = self._warm
+        c_pad = np.asarray(w.prep.cls.count).shape[0]
+        n_slots = w.assign.shape[1]
+        e_pad = w.assign_ex.shape[1]
+
+        # evictions: return departed pods' capacity and counts to the carry
+        free_new = np.zeros((c_pad, n_slots), dtype=np.int32)
+        free_ex = np.zeros((c_pad, e_pad), dtype=np.int32)
+        evicted_locs: List[Tuple[str, Tuple[int, str, int]]] = []
+        for key, uids in delta.evicted.items():
+            for uid in uids:
+                loc = w.pod_loc.get(uid)
+                if loc is None:
+                    continue  # was failed/unplaced: nothing to free
+                row, kind, idx = loc
+                (free_new if kind == "new" else free_ex)[row, idx] += 1
+                evicted_locs.append((uid, loc))
+        carry = w.carry
+        if evicted_locs:
+            carry = solve_ops.repair_free(
+                carry, free_new, free_ex,
+                np.asarray(w.prep.cls.requests, dtype=np.float32),
+                w.member_rows, w.own_inv_rows,
+            )
+
+        # additions (+ retry of previously-failed pods): a count vector with
+        # only the delta, scanned over the SAME padded tensors
+        evicted_set = {u for us in delta.evicted.values() for u in us}
+        pods_by_root: Dict[int, List[object]] = {}
+        for key, uids in delta.added.items():
+            row = w.class_index.get(key)
+            if row is None:
+                return None  # unseen class key: tensors can't express it
+            pods_by_root.setdefault(row, []).extend(by_uid(uid) for uid in uids)
+        # still-pending failures retry every repair tick under their own class
+        # row — their capacity was never committed to the carry, so a retry is
+        # a plain re-placement (the host queue's re-push equivalent).  Iterates
+        # the (tiny) failure set, not the whole membership.
+        for uid, (row, pod) in w.failed_pods.items():
+            if uid not in evicted_set:
+                pods_by_root.setdefault(row, []).append(pod)
+        counts = np.zeros(c_pad, dtype=np.int32)
+        for row, pods in pods_by_root.items():
+            counts[row] = len(pods)
+
+        # bounded repair window (docs/INCREMENTAL.md): gather the dirty slots
+        # — freed holes plus a fresh tail — into a fixed power-of-two window
+        # so the repair's per-class-step cost scales with the dirty region,
+        # not the fleet.  The freed-hole planes double as the placement
+        # preference: fills refill the exact slots departures vacated before
+        # falling back to the normal order, so steady-state churn keeps the
+        # lineage's assignments identical to a from-scratch solve.
+        g1 = w.member_rows.shape[1]
+        n_zones = np.asarray(w.prep.statics_arrays.tmpl_zone).shape[1]
+        hole_slots = sorted({loc[2] for _, loc in evicted_locs if loc[1] == "new"})
+        window = _window_indices(hole_slots, w.n_next, n_slots)
+        if window is not None:
+            idx, n_open_w = window
+            win_carry, base = solve_ops.gather_repair_window(
+                carry, idx, np.int32(n_open_w)
+            )
+            plan = solve_ops.RepairPlan(
+                pref_new=free_new[:, idx],
+                pref_ex=free_ex,
+                base_fwd_sing=base[0],
+                base_fwd_full=base[1],
+                base_inv_full=base[2],
+            )
+            outputs = self.solver.run_prepared(
+                w.prep, count=counts, warm_carry=win_carry, repair_plan=plan,
+                n_slots=len(idx),
+            )
+        else:
+            zeros_gz = np.zeros((g1, n_zones), dtype=np.int32)
+            plan = solve_ops.RepairPlan(
+                pref_new=free_new, pref_ex=free_ex,
+                base_fwd_sing=zeros_gz, base_fwd_full=zeros_gz,
+                base_inv_full=zeros_gz,
+            )
+            outputs = self.solver.run_prepared(
+                w.prep, count=counts, warm_carry=carry, repair_plan=plan
+            )
+        assign_d, assign_ex_d, failed_d, n_next_h = jax.device_get(
+            (outputs.assign, outputs.assign_existing, outputs.failed,
+             outputs.state.n_next)
+        )
+        slots_seen = len(idx) if window is not None else n_slots
+        if int(np.sum(failed_d)) > 0 and int(n_next_h) >= slots_seen:
+            return None  # out of slots/window: the caller escalates to full
+
+        # decode through the standard path over a delta VIEW of the snapshot
+        # (same planes, classes carry only this tick's pods), then drop node
+        # decisions the repair placed nothing on — previously-decided nodes
+        # must not be re-launched.  Windowed outputs decode directly: only
+        # window slots can carry this tick's placements, and the smaller
+        # planes make the decode cheaper too.
+        delta_view = _delta_view(w.versioned.snapshot, pods_by_root)
+        results = self.solver.decode(delta_view, outputs, w.state_nodes)
+        results.new_nodes = [d for d in results.new_nodes if d.pods]
+
+        # adopt: bookkeeping moves only after the device work succeeded
+        assign_d = np.asarray(assign_d, dtype=np.int32)
+        assign_ex_d = np.asarray(assign_ex_d, dtype=np.int32)
+        loc_d, unplaced = _locate_pods(delta_view, assign_d, assign_ex_d)
+        if window is not None:
+            # scatter the windowed repair back to the full-width lineage:
+            # assignment columns, pod locations, and the device carry
+            new_carry = solve_ops.scatter_repair_window(
+                carry, solve_ops.warm_carry_of(outputs), idx, np.int32(n_open_w)
+            )
+            assign_g = np.zeros((c_pad, n_slots), dtype=np.int32)
+            assign_g[:, idx] = assign_d
+            assign_d = assign_g
+            loc_d = {
+                uid: (row, kind, int(idx[i]) if kind == "new" else i)
+                for uid, (row, kind, i) in loc_d.items()
+            }
+            n_next_h = w.n_next + (int(n_next_h) - n_open_w)
+        else:
+            new_carry = solve_ops.warm_carry_of(outputs)
+        for uid, loc in evicted_locs:
+            row, kind, slot = loc
+            (w.assign if kind == "new" else w.assign_ex)[row, slot] -= 1
+            del w.pod_loc[uid]
+        w.assign += assign_d
+        w.assign_ex += assign_ex_d
+        w.pod_loc.update(loc_d)
+        # every non-evicted failure was retried this tick, so the repair's
+        # unplaced tail IS the new failure set
+        delta_pods = {
+            p.uid: p for pods in pods_by_root.values() for p in pods
+        }
+        w.failed_pods = {
+            uid: (row, delta_pods[uid]) for uid, row in unplaced
+        }
+        w.carry = new_carry
+        w.n_next = int(n_next_h)
+        # membership: previous minus evicted plus added
+        members = {k: list(v) for k, v in w.members.items()}
+        for key, uids in delta.evicted.items():
+            gone = set(uids)
+            if key in members:
+                members[key] = [u for u in members[key] if u not in gone]
+        for key, uids in delta.added.items():
+            members.setdefault(key, []).extend(uids)
+        w.members = {k: tuple(v) for k, v in members.items() if v}
+        w.delta_ticks += 1
+        return results
+
+    # -- aggregate views (bench / parity tests) --------------------------------
+
+    def node_count(self) -> int:
+        w = self._warm
+        if w is None:
+            return 0
+        return int(np.sum(np.sum(w.assign, axis=0) > 0))
+
+    def aggregates(self) -> Dict[str, int]:
+        """The session lineage's current placement totals."""
+        w = self._warm
+        if w is None:
+            return {"scheduled": 0, "failed": 0, "nodes": 0}
+        return {
+            "scheduled": int(w.assign.sum() + w.assign_ex.sum()),
+            "failed": len(w.failed_pods),
+            "nodes": self.node_count(),
+        }
+
+    def node_signature(self):
+        """Canonical multiset of per-node class loads, labeled by stable
+        class identity — the assignment-identity view the churn bench
+        compares against a from-scratch full solve (order- and
+        row-index-independent)."""
+        w = self._warm
+        if w is None:
+            return ()
+        keys = [w.row_key.get(i, i) for i in range(w.assign.shape[0])]
+        return node_signature_of(w.assign, keys) + node_signature_of(
+            w.assign_ex, keys
+        )
+
+
+_WINDOW_MIN = 256
+_WINDOW_FRESH = 64
+
+
+def _window_indices(hole_slots, n_next: int, n_slots: int):
+    """The bounded repair window's global slot indices: every freed-hole slot
+    (ascending — all open, they held placed pods), open filler below
+    ``n_next`` if the power-of-two bucket needs it, then the fresh tail.
+    Returns (idx i32[S], open_count) or None when windowing is off
+    (KC_DELTA_WINDOW=0), the bucket would not shrink the solve, or the
+    geometry doesn't fit — callers then run the repair at full width, which
+    is always correct.  S rides a power-of-two ladder (min
+    max(KC_DELTA_WINDOW, holes + fresh headroom)) so steady churn reuses ONE
+    windowed executable per bucket."""
+    env = os.environ.get("KC_DELTA_WINDOW", "")
+    if env == "0":
+        return None
+    try:
+        min_s = max(int(env), 1) if env else min(_WINDOW_MIN, n_slots // 4)
+    except ValueError:
+        min_s = _WINDOW_MIN
+    # fresh headroom scales down with tiny fleets so small solves window too
+    fresh_headroom = min(_WINDOW_FRESH, max(8, n_slots // 16))
+    want = max(min_s, len(hole_slots) + fresh_headroom)
+    s = 1
+    while s < want:
+        s <<= 1
+    if s >= n_slots:
+        return None
+    fresh = list(range(n_next, min(n_next + (s - len(hole_slots)), n_slots)))
+    filler_needed = s - len(hole_slots) - len(fresh)
+    open_w = list(hole_slots)
+    if filler_needed > 0:
+        holes = set(hole_slots)
+        filler = []
+        slot = n_next - 1
+        while slot >= 0 and len(filler) < filler_needed:
+            if slot not in holes:
+                filler.append(slot)
+            slot -= 1
+        if len(filler) < filler_needed:
+            return None
+        open_w = sorted(open_w + filler)
+    idx = np.asarray(open_w + fresh, dtype=np.int32)
+    return idx, len(open_w)
+
+
+def node_signature_of(assign: np.ndarray, keys=None):
+    """Sorted tuple of per-node (class, count) loads, empty slots dropped —
+    two solves with identical placements (up to slot naming) produce equal
+    signatures.  ``keys`` maps class row -> a stable class identity; without
+    it the raw row index labels the load, which only compares correctly
+    between solves that share ONE encode's class order (a fully-churned
+    class re-enters a fresh encode at a different row)."""
+    sig = []
+    arr = np.asarray(assign)
+    # class keys are nested tuples that may hold unorderable members
+    # (frozensets), so canonicalize by repr — identical values repr equal
+    for col in range(arr.shape[1]):
+        loads = tuple(sorted(
+            (
+                ((keys[int(c)] if keys is not None else int(c)), int(arr[c, col]))
+                for c in np.nonzero(arr[:, col])[0]
+            ),
+            key=repr,
+        ))
+        if loads:
+            sig.append(loads)
+    return tuple(sorted(sig, key=repr))
+
+
+def _locate_pods(snapshot, assign, assign_ex):
+    """uid -> (class row, "new"|"ex", index) plus the unplaced tail as
+    (uid, root row) pairs, in the exact cursor order TPUSolver.decode
+    consumes pods (ladder rows share their root's cursor)."""
+    n_classes = len(snapshot.classes)
+    if snapshot.cls_root is not None:
+        root_of = [int(r) for r in snapshot.cls_root]
+    else:
+        root_of = list(range(n_classes))
+    cursors = [0] * n_classes
+    loc: Dict[str, Tuple[int, str, int]] = {}
+    unplaced: List[str] = []
+    for c in range(n_classes):
+        r = root_of[c]
+        pods = snapshot.classes[r].pods
+        cursor = cursors[r]
+        ex_idx = np.nonzero(assign_ex[c] > 0)[0]
+        for e, take in zip(ex_idx.tolist(), assign_ex[c][ex_idx].tolist()):
+            for pod in pods[cursor:cursor + take]:
+                loc[pod.uid] = (c, "ex", int(e))
+            cursor += take
+        node_idx = np.nonzero(assign[c] > 0)[0]
+        for n, take in zip(node_idx.tolist(), assign[c][node_idx].tolist()):
+            for pod in pods[cursor:cursor + take]:
+                loc[pod.uid] = (c, "new", int(n))
+            cursor += take
+        cursors[r] = cursor
+    for c in range(n_classes):
+        if root_of[c] != c:
+            continue
+        unplaced.extend((p.uid, c) for p in snapshot.classes[c].pods[cursors[c]:])
+    return loc, unplaced
+
+
+def _topology_rows(prep) -> Tuple[np.ndarray, np.ndarray]:
+    """(member, own_inv) i32[C_pad, G1] rows for ops.solve.repair_free: which
+    group counts each class's placements incremented — membership from the
+    padded grp_member plane, inverse ownership from the owned anti slots
+    (preferred terms register no inverse counts, matching the record step)."""
+    member = np.asarray(prep.statics_arrays.grp_member).astype(np.int32)
+    c_pad, g1 = member.shape
+    own_inv = np.zeros((c_pad, g1), dtype=np.int32)
+    groups = np.asarray(prep.cls.groups)
+    anti_soft = np.asarray(prep.cls.anti_soft)
+    g_dummy = g1 - 1
+    for c in range(c_pad):
+        g_zan, g_han = int(groups[c, 4]), int(groups[c, 5])
+        if g_zan < g_dummy and not bool(anti_soft[c, 0]):
+            own_inv[c, g_zan] += 1
+        if g_han < g_dummy and not bool(anti_soft[c, 1]):
+            own_inv[c, g_han] += 1
+    return member, own_inv
+
+
+def _delta_view(snapshot, pods_by_root: Dict[int, List[object]]):
+    """A shallow snapshot view whose root classes carry only this tick's
+    pods (delta additions + retried failures) — what decode's cursor walk
+    consumes; every tensor plane is shared with the original."""
+    view = copy.copy(snapshot)
+    classes = []
+    for c, cls in enumerate(snapshot.classes):
+        if cls.is_ladder_variant:
+            classes.append(cls)
+            continue
+        classes.append(dc_replace(cls, pods=list(pods_by_root.get(c, ()))))
+    view.classes = classes
+    return view

@@ -10,8 +10,9 @@ with the bin-pack running as a batch tensor program on the TPU.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, NamedTuple, Optional
 
 import jax
 import numpy as np
@@ -135,6 +136,24 @@ class TPUNodeDecision:
             for r, name in enumerate(self._snapshot.resources)
             if row[r] > 0
         }
+
+
+class SolvePrep(NamedTuple):
+    """One snapshot's kernel inputs, prepared (and bucket-padded) once.
+
+    The seam the incremental session (solver.incremental) needs: a delta
+    reconcile reuses a previous reconcile's SolvePrep verbatim — same padded
+    tensors, same executable shape — and only swaps the class-count vector,
+    so the jit cache stays warm across the whole churn regime."""
+
+    cls: object  # ops.solve.ClassTensors (padded host/device pytree)
+    statics_arrays: object  # ops.solve.StaticArrays
+    key_has_bounds: tuple
+    ex_state: object  # Optional[ops.solve.ExistingState]
+    ex_static: object  # Optional[ops.solve.ExistingStatic]
+    n_slots: int
+    n_passes: int
+    features: object  # ops.solve.SnapshotFeatures
 
 
 @dataclass
@@ -733,6 +752,80 @@ class TPUSolver:
             logging.getLogger(__name__).debug("kernel warmup failed: %s", e)
             return False
 
+    def prepare_encoded(
+        self,
+        snapshot: EncodedSnapshot,
+        state_nodes: Optional[list] = None,
+        bound_pods: Optional[List[Pod]] = None,
+        n_slots: int = 0,
+    ) -> SolvePrep:
+        """Kernel inputs for one encoded snapshot, existing-node planes
+        included, bucket-padded (unless KC_TPU_SHAPE_BUCKETS=0) and ready for
+        ``run_prepared``.  Splitting prepare from run is what lets the
+        incremental session hold a prep across reconciles and re-run it with
+        a delta count vector + warm carry (docs/INCREMENTAL.md)."""
+        ex_state = ex_static = None
+        if state_nodes:
+            with tracing.span("encode.existing", state_nodes=len(state_nodes)):
+                ex_state, ex_static = self.encode_existing(
+                    snapshot, state_nodes, bound_pods
+                )
+        if n_slots <= 0:
+            n_slots = solve_ops.estimate_slots(snapshot)  # snap_slots applied inside
+        features = solve_ops.features_with_existing(snapshot, ex_static)
+        cls, statics_arrays, key_has_bounds = solve_ops.prepare_host(snapshot)
+        if os.environ.get("KC_TPU_SHAPE_BUCKETS", "1") != "0":
+            cls, statics_arrays, key_has_bounds, ex_state, ex_static = (
+                solve_ops.pad_planes(
+                    cls, statics_arrays, key_has_bounds, ex_state, ex_static
+                )
+            )
+        return SolvePrep(
+            cls=cls, statics_arrays=statics_arrays, key_has_bounds=key_has_bounds,
+            ex_state=ex_state, ex_static=ex_static, n_slots=n_slots,
+            n_passes=snapshot.scan_passes, features=features,
+        )
+
+    def run_prepared(
+        self,
+        prep: SolvePrep,
+        count=None,
+        warm_carry=None,
+        repair_plan=None,
+        n_slots: int = 0,
+    ) -> solve_ops.SolveOutputs:
+        """Run the kernel on a SolvePrep.  ``count`` overrides the class-count
+        vector (the repair solve passes only the delta pods; shape must match
+        the padded class axis); ``warm_carry`` resumes from a previous solve's
+        final carry (ops.solve.WarmCarry); ``repair_plan`` carries the freed-
+        hole planes the repair's fills refill first plus the out-of-window
+        topology bases of a bounded repair (ops.solve.RepairPlan).
+        Returns raw SolveOutputs — decode is the caller's step."""
+        from karpenter_core_tpu.utils import compilecache
+
+        cls = prep.cls
+        if count is not None:
+            cls = cls._replace(count=np.asarray(count, dtype=np.int32))
+        ex_static = prep.ex_static
+        if warm_carry is not None and ex_static is None:
+            # the warm variant always takes the ex-static planes (its tol/vol
+            # rows are per-class); synthesize the empty ones the full solve
+            # built internally so the repair sees identical semantics
+            n_res = np.asarray(prep.cls.requests).shape[-1]
+            n_classes = np.asarray(cls.count).shape[0]
+            g1 = np.asarray(prep.statics_arrays.grp_skew).shape[0]
+            ex_static = solve_ops.empty_existing_static(n_res, n_classes, g1)
+        return compilecache.run_solve(
+            cls, prep.statics_arrays, n_slots or prep.n_slots, prep.key_has_bounds,
+            None if warm_carry is not None else prep.ex_state,
+            ex_static,
+            n_passes=prep.n_passes,
+            features=prep.features,
+            warm_carry=warm_carry,
+            repair_plan=repair_plan,
+            pre_padded=True,
+        )
+
     def solve_encoded(
         self,
         snapshot: EncodedSnapshot,
@@ -740,14 +833,7 @@ class TPUSolver:
         bound_pods: Optional[List[Pod]] = None,
         n_slots: int = 0,
     ) -> TPUSolveResults:
-        ex_state = ex_static = None
-        if state_nodes:
-            with tracing.span("encode.existing", state_nodes=len(state_nodes)):
-                ex_state, ex_static = self.encode_existing(
-                    snapshot, state_nodes, bound_pods
-                )
         from karpenter_core_tpu.solver.backendprobe import SOLVER_DISPATCH
-        from karpenter_core_tpu.utils import compilecache
 
         fault = SOLVER_DISPATCH.hit(
             kinds=("error", "timeout"), op="solve", classes=len(snapshot.classes)
@@ -757,17 +843,8 @@ class TPUSolver:
             # first device op, which the provisioning breaker counts
             raise RuntimeError(fault.describe())
 
-        if n_slots <= 0:
-            n_slots = solve_ops.estimate_slots(snapshot)  # snap_slots applied inside
-
-        features = solve_ops.features_with_existing(snapshot, ex_static)
-
-        cls, statics_arrays, key_has_bounds = solve_ops.prepare_host(snapshot)
-        outputs = compilecache.run_solve(
-            cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
-            n_passes=snapshot.scan_passes,
-            features=features,
-        )
+        prep = self.prepare_encoded(snapshot, state_nodes, bound_pods, n_slots)
+        outputs = self.run_prepared(prep)
         # slot exhaustion: retry once with double capacity.  One batched fetch
         # (the relay costs ~67 ms per round trip); both arrays are cached on
         # the jax array objects, so decode's batched fetch doesn't re-ship them.
@@ -775,11 +852,7 @@ class TPUSolver:
         n_used = int(n_next_h)
         slots = outputs.assign.shape[1]
         if int(np.sum(failed_h)) > 0 and n_used >= slots:
-            outputs = compilecache.run_solve(
-                cls, statics_arrays, slots * 2, key_has_bounds, ex_state, ex_static,
-                n_passes=snapshot.scan_passes,
-                features=features,
-            )
+            outputs = self.run_prepared(prep, n_slots=slots * 2)
         return self.decode(snapshot, outputs, state_nodes or [])
 
     def decode(
@@ -803,6 +876,11 @@ class TPUSolver:
         outputs: solve_ops.SolveOutputs,
         state_nodes: Optional[list] = None,
     ) -> TPUSolveResults:
+        # NOTE: solver.incremental._locate_pods mirrors this walk's pod
+        # consumption order (root-shared cursors, existing before new, index
+        # order within each) to label pod -> slot for the repair path; a
+        # change to the order here must be mirrored there (the tier-1 parity
+        # fuzz in tests/test_incremental.py catches drift loudly).
         state = outputs.state
         # start every device→host copy up front so transfers overlap the
         # host-side expansion work below; planes stay lazy until consumed.

@@ -203,6 +203,8 @@ def enable() -> None:
                 solve_ops.ExistingStatic,
                 solve_ops.SolveOutputs,
                 solve_ops.TopoCounts,
+                solve_ops.WarmCarry,
+                solve_ops.RepairPlan,
                 mask_ops.ReqTensor,
             ):
                 try:
@@ -261,20 +263,31 @@ def solve_callable(
     features=None,
     fuse_zones: bool = True,
     packed_masks: bool = True,
+    warm_carry=None,
+    repair_plan=None,
 ):
     """An AOT-compiled solve callable served through the export cache, or None
     when export-caching is unavailable (callers fall back to the plain jit).
 
     The returned callable takes (cls, statics_arrays[, ex_state, ex_static])
-    matching how it was built; it is memoized in-process so warm calls reuse
-    the already-compiled executable.  Inputs may be host (numpy) or device
-    pytrees — only shapes/dtypes matter, so callers can overlap the device
-    upload with this compile (the relay makes both seconds-long)."""
+    — or (cls, statics_arrays, ex_static, warm_carry) for the warm-start
+    repair variant — matching how it was built; it is memoized in-process so
+    warm calls reuse the already-compiled executable.  Inputs may be host
+    (numpy) or device pytrees — only shapes/dtypes matter, so callers can
+    overlap the device upload with this compile (the relay makes both
+    seconds-long).  A warm carry keys its own ``delta`` executable variant
+    (``has_warm`` + the carry's leaf signature): the repair program resumes
+    the scan from the carry instead of empty slots, and because
+    solver.incremental reuses the previous padded tensors verbatim the repair
+    shape is FIXED across reconciles — one delta executable stays warm for
+    the whole churn regime (docs/INCREMENTAL.md)."""
     import jax
 
     try:
         enable()
         has_ex = ex_state is not None
+        has_warm = warm_carry is not None
+        has_repair = repair_plan is not None
         features = snap_features(features)
         key = (
             _kernel_src_hash(),
@@ -286,10 +299,13 @@ def solve_callable(
             fuse_zones,
             packed_masks,
             has_ex,
+            has_warm,
             _leaf_sig(cls),
             _leaf_sig(statics_arrays),
             _leaf_sig(ex_state) if has_ex else None,
-            _leaf_sig(ex_static) if has_ex else None,
+            _leaf_sig(ex_static) if (has_ex or has_warm) else None,
+            _leaf_sig(warm_carry) if has_warm else None,
+            _leaf_sig(repair_plan) if has_repair else None,
         )
         # in-flight dedup: the warmup thread and the first real batch race to
         # build the same key; the loser waits on the winner's build instead of
@@ -309,7 +325,8 @@ def solve_callable(
         try:
             return _build_and_memo(key, cls, statics_arrays, n_slots,
                                    key_has_bounds, ex_state, ex_static, n_passes,
-                                   features, fuse_zones, packed_masks)
+                                   features, fuse_zones, packed_masks, warm_carry,
+                                   repair_plan)
         finally:
             with _lock:
                 _in_flight.pop(key, None)
@@ -321,7 +338,8 @@ def solve_callable(
 
 def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
                     ex_state, ex_static, n_passes, features=None,
-                    fuse_zones=True, packed_masks=True):
+                    fuse_zones=True, packed_masks=True, warm_carry=None,
+                    repair_plan=None):
     """Build one executable for ``key``: export-cache load (or trace+export),
     then AOT compile, then memoize.  Callers hold the key's in-flight slot."""
     import jax
@@ -329,12 +347,17 @@ def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
     from karpenter_core_tpu.ops import solve as solve_ops
 
     has_ex = ex_state is not None
+    has_warm = warm_carry is not None
     digest = hashlib.sha256(repr(key).encode()).hexdigest()[:24]
     path = os.path.join(cache_dir(), f"solve-{digest}.stablehlo")
+    if has_warm:
+        struct_args = (cls, statics_arrays, ex_static, warm_carry, repair_plan)
+    elif has_ex:
+        struct_args = (cls, statics_arrays, ex_state, ex_static)
+    else:
+        struct_args = (cls, statics_arrays)
     structs = jax.tree_util.tree_map(
-        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-        (cls, statics_arrays, ex_state, ex_static) if has_ex
-        else (cls, statics_arrays),
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), struct_args
     )
     fn = None
     if os.path.exists(path):
@@ -346,7 +369,17 @@ def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
             log.warning("export cache load failed (%s), re-exporting", e)
             fn = None
     if fn is None:
-        if has_ex:
+        if has_warm:
+            # the delta variant: ex_state rides inside the carry; ex_static is
+            # passed separately because its tol/vol rows are per-class
+            base = jax.jit(
+                lambda c, s, exst, w, rp: solve_ops.solve_core(
+                    c, s, n_slots, key_has_bounds, None, exst, n_passes=n_passes,
+                    features=features, fuse_zones=fuse_zones,
+                    packed_masks=packed_masks, warm_carry=w, repair_plan=rp,
+                )
+            )
+        elif has_ex:
             base = jax.jit(
                 lambda c, s, exs, exst: solve_ops.solve_core(
                     c, s, n_slots, key_has_bounds, exs, exst, n_passes=n_passes,
@@ -396,6 +429,9 @@ def run_solve(
     ex_static=None,
     n_passes: int = 1,
     features=None,
+    warm_carry=None,
+    repair_plan=None,
+    pre_padded: bool = False,
 ):
     """Solve through the export cache, falling back to the plain jit.
 
@@ -404,7 +440,13 @@ def run_solve(
     the (cache-served) compile, since both are seconds-long over the relay and
     independent.  ``features`` is the snapshot's SnapshotFeatures phase plan
     (None = all-on); it may be silently widened to a previously-built
-    superset executable (snap_features)."""
+    superset executable (snap_features).
+
+    ``warm_carry`` selects the warm-start repair variant (solve_callable
+    docstring); ``pre_padded`` skips the bucket padding for callers that
+    already hold padded planes — mandatory with a warm carry, whose device
+    arrays must not round-trip through numpy padding (pad_planes would force
+    a device→host sync on them)."""
     from concurrent.futures import ThreadPoolExecutor
 
     import jax
@@ -418,8 +460,13 @@ def run_solve(
     # the separate "solve" span blocks on the outputs (tracing only) so device
     # compute is attributed to the solve, not to whichever span first touches
     # the result — the JAX-aware boundary docs/OBSERVABILITY.md describes.
-    with tracing.span("dispatch", n_slots=n_slots, n_passes=n_passes):
-        if os.environ.get("KC_TPU_SHAPE_BUCKETS", "1") != "0":
+    with tracing.span("dispatch", n_slots=n_slots, n_passes=n_passes,
+                      warm=warm_carry is not None):
+        if (
+            not pre_padded
+            and warm_carry is None
+            and os.environ.get("KC_TPU_SHAPE_BUCKETS", "1") != "0"
+        ):
             cls, statics_arrays, key_has_bounds, ex_state, ex_static = solve_ops.pad_planes(
                 cls, statics_arrays, key_has_bounds, ex_state, ex_static
             )
@@ -429,19 +476,21 @@ def run_solve(
             )
             fn = solve_callable(
                 cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
-                n_passes, features, fuse_zones, packed_masks,
+                n_passes, features, fuse_zones, packed_masks, warm_carry,
+                repair_plan,
             )
             cls, statics_arrays, ex_state, ex_static = upload.result()
         if fn is None:
             out = solve_ops._solve_jit(
                 cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
                 n_passes=n_passes, features=features, fuse_zones=fuse_zones,
-                packed_masks=packed_masks,
+                packed_masks=packed_masks, warm_carry=warm_carry,
+                repair_plan=repair_plan,
             )
-        elif ex_state is not None:
-            out = fn(cls, statics_arrays, ex_state, ex_static)
+        elif warm_carry is not None:
+            out = fn(cls, statics_arrays, ex_static, warm_carry, repair_plan)
         else:
-            out = fn(cls, statics_arrays)
+            out = fn(cls, statics_arrays, ex_state, ex_static) if ex_state is not None else fn(cls, statics_arrays)
     if tracing.enabled():
         with tracing.span("solve", sync=out):
             pass

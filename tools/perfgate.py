@@ -86,13 +86,19 @@ def last_record(platform: str):
 # decode_s are the de-fused halves of solve_decode_s (bench.py's one
 # explicitly-synced pass): decode was 98% of r05 wall time and invisible
 # inside the fused number, so each half gates independently ahead of the
-# decode pipelining work.  Records older than the split simply lack the
-# keys and are skipped per-stage.
+# decode pipelining work.  churn_warm_solve_s / churn_full_solve_s are the
+# steady-state churn bench's per-tick medians (bench.py churn_line): the
+# warm-start delta repair and the full re-solve gate INDEPENDENTLY, so a
+# warm-path regression can't hide inside healthy cold numbers (and vice
+# versa).  Records older than a split simply lack the keys and are skipped
+# per-stage.
 STAGE_KEYS = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "encode_s",
-              "dispatch_s", "materialize_s", "cold_s")
+              "dispatch_s", "materialize_s", "cold_s",
+              "churn_warm_solve_s", "churn_full_solve_s")
 # stages that matter enough to flag; the others are printed but only the
 # load-bearing ones gate (sub-10ms stages WARN on scheduler-noise otherwise)
-GATED_STAGES = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "cold_s")
+GATED_STAGES = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "cold_s",
+                "churn_warm_solve_s", "churn_full_solve_s")
 
 
 def compare_stages(detail: dict, prev_detail: dict, tol: float):
@@ -141,6 +147,31 @@ def warn_compile_budget(detail: dict) -> None:
               f"budget {expected}")
 
 
+def report_churn(detail: dict) -> None:
+    """Surface the incremental-solve churn line: the full/delta decision
+    counts, the measured speedup, and assignment parity.  Advisory — the
+    enforced side is the two churn stage durations in GATED_STAGES."""
+    churn = detail.get("churn")
+    if not churn:
+        return
+    if "error" in churn:
+        print(f"perfgate: churn bench errored: {churn['error']}")
+        return
+    print(
+        "perfgate: churn warm_solve {w:.4f}s vs full_resolve {f:.4f}s — "
+        "speedup {s:.2f}x, modes {m}, identical_assignments={i}".format(
+            w=churn["warm_solve_s"], f=churn["full_resolve_s"],
+            s=churn.get("speedup", 0.0), m=churn.get("modes"),
+            i=churn.get("identical_assignments"),
+        )
+    )
+    if churn.get("speedup", 0.0) < 2.0:
+        print(
+            "perfgate: WARNING churn speedup below the 2x ISSUE-7 acceptance "
+            "floor — the warm-start delta path is not paying for itself"
+        )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=0.05,
@@ -162,6 +193,7 @@ def main() -> int:
     platform = detail.get("platform")
     pods_per_sec = detail.get("pods_per_sec")
     warn_compile_budget(detail)
+    report_churn(detail)
     if pods_per_sec is None:
         print(json.dumps(rec))
         print("perfgate: FAIL (bench produced no pods_per_sec)")

@@ -59,6 +59,10 @@ def main(argv=None) -> int:
                     help="print full reports instead of one-line verdicts")
     ap.add_argument("--trace", default=None, metavar="GENERATOR",
                     help="dump a generator's raw event stream (JSONL) and exit")
+    ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                    help="serve /metrics for the duration of the run so "
+                         "karpenter_soak_slo_probe and karpenter_solve_mode_total "
+                         "are watchable live (0 = ephemeral port)")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -81,15 +85,30 @@ def main(argv=None) -> int:
     if not names:
         names = [catalog.TIER1_SMOKE]
 
+    http = None
+    if args.metrics_port is not None:
+        # live observation: karpenter_soak_slo_probe updates every simulated
+        # tick and karpenter_solve_mode_total counts the full/delta/host
+        # decisions as the run makes them (docs/INCREMENTAL.md); the soak
+        # process IS the operator here, so it serves the operator's endpoint
+        from karpenter_core_tpu.operator.httpserver import OperatorHTTP
+
+        http = OperatorHTTP(metrics_port=args.metrics_port, health_port=0).start()
+        print(f"soak: serving /metrics on :{http.metrics_port}", flush=True)
+
     reports = []
     ok = True
-    for name in names:
-        report = run_scenario(catalog.build(name, seed=args.seed))
-        reports.append(report)
-        ok = ok and report["verdict"]["passed"]
-        if args.verbose:
-            print(json.dumps(report, indent=2, sort_keys=True))
-        print(_verdict_line(report))
+    try:
+        for name in names:
+            report = run_scenario(catalog.build(name, seed=args.seed))
+            reports.append(report)
+            ok = ok and report["verdict"]["passed"]
+            if args.verbose:
+                print(json.dumps(report, indent=2, sort_keys=True))
+            print(_verdict_line(report))
+    finally:
+        if http is not None:
+            http.stop()
     if args.json:
         with open(args.json, "w") as f:
             json.dump(reports, f, indent=2, sort_keys=True)
