@@ -484,6 +484,106 @@ def churn_line(solver, ingest, churn_fraction: float = 0.02, ticks: int = 5) -> 
     }
 
 
+def policy_line(n_pods: int = 2000, n_its: int = 24) -> dict:
+    """Policy-objective benchmark (ISSUE 9 acceptance): the SAME feasibility
+    solve decoded twice on a mixed spot/on-demand demo fleet with a skewed
+    price sheet —
+
+      first-fit    policy off: the launch hands the provider the full
+                   viable set and lands on the FIRST compatible available
+                   offering of the cheapest type (today's behavior),
+                   emulated host-side per decision
+      objective    policy on: ops.objective argmin-selects the cheapest
+                   feasible (type, zone, capacity-type) cell per node and
+                   pins the launch to it
+
+    Feasibility is identical by construction (one solve, two decodes);
+    reported are the two fleet costs, their delta (> 0 on this fleet: the
+    cheap offerings hide in zones/capacity-types first-fit never reaches),
+    and ``objective_s`` — the warm wall cost of the scoring stage itself,
+    gated per-round by tools/perfgate.py."""
+    from karpenter_core_tpu.cloudprovider import fake as fake_cp
+    from karpenter_core_tpu.models.columnar import PodIngest
+    from karpenter_core_tpu.ops import objective as objective_ops
+    from karpenter_core_tpu.policy import PolicyConfig
+    from karpenter_core_tpu.policy import planes as policy_planes
+    from karpenter_core_tpu.solver.tpu import TPUSolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(n_its))
+    # the spot market moved: zone-2 spot is cheap, but the provider's
+    # first-compatible walk lands on zone-1 (listed first) at full price
+    for it in provider.get_instance_types(None):
+        provider.set_price(it.name, it.offerings[0].price * 0.6,
+                           capacity_type="spot", zone="test-zone-2")
+    provisioners = [make_provisioner(name="default")]
+    config = PolicyConfig(enabled=True)
+    solver = TPUSolver(provider, provisioners, policy=config)
+    sizes = [{"cpu": "500m", "memory": "512Mi"}, {"cpu": 1, "memory": "2Gi"},
+             {"cpu": "250m", "memory": "256Mi"}]
+    ingest = PodIngest()
+    ingest.add_all([make_pod(requests=sizes[i % len(sizes)]) for i in range(n_pods)])
+
+    snapshot = solver.encode(ingest)
+    prep = solver.prepare_encoded(snapshot)
+    outputs = solver.run_prepared(prep)
+    results_on = solver.decode(snapshot, outputs)
+
+    # warm cost of the objective stage alone (first call pays its compile)
+    planes = policy_planes.planes_of(snapshot)
+    objective_s = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        objective_ops.select_for_state(
+            outputs.state, planes, config, snapshot.capacity_types
+        )
+        objective_s = min(objective_s, time.perf_counter() - t0)
+
+    # the first-fit decode of the SAME outputs: policy off, then emulate the
+    # provider's landing per decision (cheapest type by its cheapest
+    # in-requirements offering, then the first compatible available offering)
+    solver.policy = None
+    results_off = solver.decode(snapshot, outputs)
+    it_by_name = {it.name: it for it in provider.get_instance_types(None)}
+
+    def landed_price(decision) -> float:
+        zones, cts = set(decision.zones), set(decision.capacity_types)
+
+        def cheapest(it) -> float:
+            prices = [
+                o.price for o in it.offerings.available()
+                if o.zone in zones and o.capacity_type in cts
+            ]
+            return min(prices) if prices else float("inf")
+
+        options = sorted(
+            (it_by_name[name] for name in decision.instance_type_names
+             if name in it_by_name),
+            key=cheapest,
+        )
+        for it in options:
+            for off in it.offerings.available():
+                if off.zone in zones and off.capacity_type in cts:
+                    return off.price
+        return 0.0
+
+    firstfit_cost = sum(landed_price(d) for d in results_off.new_nodes)
+    policy_cost = results_on.fleet_cost or 0.0
+    pods_on = sorted(p.uid for d in results_on.new_nodes for p in d.pods)
+    pods_off = sorted(p.uid for d in results_off.new_nodes for p in d.pods)
+    return {
+        "pods": n_pods,
+        "instance_types": n_its,
+        "nodes": len(results_on.new_nodes),
+        "objective_s": round(objective_s, 4),
+        "fleet_cost_firstfit": round(firstfit_cost, 4),
+        "fleet_cost_policy": round(policy_cost, 4),
+        "fleet_cost_delta": round(firstfit_cost - policy_cost, 4),
+        # one solve, two decodes: placements must match exactly
+        "identical_placements": pods_on == pods_off,
+    }
+
+
 def _traced_solve(solver, pods) -> dict:
     """One fully-traced ingest → encode → dispatch → solve → decode →
     materialize pass; returns {"trace_id", "stages"} for the bench line."""
@@ -644,6 +744,19 @@ def main() -> None:
             traceback.print_exc(file=sys.stderr)
             churn = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # policy objective: the cheapest-fleet-vs-first-fit delta and the warm
+    # cost of the scoring stage on a skewed-price demo fleet
+    # (docs/POLICY.md); KC_BENCH_POLICY=0 skips.
+    policy = None
+    if os.environ.get("KC_BENCH_POLICY", "1") != "0":
+        try:
+            policy = policy_line()
+        except Exception as e:  # noqa: BLE001 - policy line never kills the headline
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            policy = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # restart cold: a fresh process with the persistent caches this process
     # just populated — the cost every operator restart actually pays.  The
     # child inherits os.environ, so a CPU fallback pins it too.
@@ -693,6 +806,12 @@ def main() -> None:
         detail["churn_warm_solve_s"] = churn["warm_solve_s"]
         detail["churn_full_solve_s"] = churn["full_resolve_s"]
         detail["churn_speedup"] = churn["speedup"]
+    detail["policy"] = policy
+    if policy and "error" not in policy:
+        # stage mirror for the perfgate objective_s gate + the acceptance
+        # fleet-cost delta (must stay > 0 on the demo fleet)
+        detail["objective_s"] = policy["objective_s"]
+        detail["policy_fleet_cost_delta"] = policy["fleet_cost_delta"]
 
     if _BACKEND["probe_failures"]:
         detail["backend_probe_failures"] = _BACKEND["probe_failures"]

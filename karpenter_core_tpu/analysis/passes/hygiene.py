@@ -23,8 +23,8 @@ New with the framework:
   wallclock           ``time.time()`` / ``datetime.now()`` /
                       ``datetime.utcnow()`` in the reconcile world
                       (controllers/, state/, operator/, solver/, kubeapi/,
-                      soak/): TTL logic and soak timelines must go through
-                      utils/clock.Clock so suites advance time
+                      soak/, policy/): TTL logic and soak timelines must go
+                      through utils/clock.Clock so suites advance time
                       deterministically (and soak verdicts replay from
                       their seed)
 """
@@ -48,8 +48,12 @@ MAX_LINE = 120
 
 # package subtrees where wall-clock reads must route through utils/clock.py
 # (soak/ is in: its probes and traces live on the FakeClock timeline, and a
-# stray wall read would silently break verdict seed-replay)
-_CLOCKED_DIRS = ("controllers", "state", "operator", "solver", "kubeapi", "soak")
+# stray wall read would silently break verdict seed-replay; policy/ is in:
+# objective decisions and counter-proposals run inside reconciles and soak
+# ticks, so a wall read there breaks the same replay guarantees)
+_CLOCKED_DIRS = (
+    "controllers", "state", "operator", "solver", "kubeapi", "soak", "policy",
+)
 _WALLCLOCK_CALLS = {
     "time.time", "datetime.now", "datetime.utcnow",
     "datetime.datetime.now", "datetime.datetime.utcnow",

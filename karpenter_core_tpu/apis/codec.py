@@ -404,6 +404,7 @@ def provisioner_to_dict(p: Provisioner) -> Dict[str, Any]:
             "consolidation": (
                 {"enabled": p.spec.consolidation.enabled} if p.spec.consolidation else None
             ),
+            "policy": dict(p.spec.policy) if p.spec.policy else None,
         },
     }
 
@@ -435,6 +436,7 @@ def provisioner_from_dict(d: Dict[str, Any]) -> Provisioner:
                 if spec_d.get("consolidation")
                 else None
             ),
+            policy=dict(spec_d["policy"]) if spec_d.get("policy") else None,
         ),
     )
 

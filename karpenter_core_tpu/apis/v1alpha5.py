@@ -80,6 +80,11 @@ class ProvisionerSpec:
     # Scheduling priority across provisioners (higher wins; provisioner.go:132)
     weight: Optional[int] = None
     limits: Optional[Limits] = None
+    # Policy-objective block (docs/POLICY.md): wire-cased knobs consumed by
+    # policy.PolicyConfig.merged — enabled / costWeight / throughputWeight /
+    # riskAversion / spotPreference / counterProposals / maxResizeFraction /
+    # throughput.  None = objective off, today's behavior exactly.
+    policy: Optional[Dict[str, Any]] = None
 
 
 @dataclass

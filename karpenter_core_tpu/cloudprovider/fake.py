@@ -258,6 +258,92 @@ class FakeCloudProvider(CloudProvider):
                     raise TransientCloudError(fault.describe())
         return stillborn
 
+    # -- offering realism knobs (policy subsystem, docs/POLICY.md) -------------
+
+    def _pinned_catalog(self) -> List[InstanceType]:
+        """The catalog as a mutable, pinned list.  ``get_instance_types``
+        builds the default catalog FRESH per call when no list was supplied,
+        so dynamic-offering knobs must first pin one instance of it."""
+        if self.instance_types_list is None:
+            self.instance_types_list = default_instance_types()
+        return self.instance_types_list
+
+    def _update_offerings(self, instance_type, capacity_type, zone, **changes) -> int:
+        """Replace matching offerings (frozen dataclasses) with updated
+        copies; returns how many offerings changed.  ``capacity_type`` /
+        ``zone`` of None match everything."""
+        import dataclasses
+
+        updated = 0
+        with self._mu:
+            for it in self._pinned_catalog():
+                if it.name != instance_type:
+                    continue
+                fresh = []
+                for off in it.offerings:
+                    if (capacity_type is None or off.capacity_type == capacity_type) and (
+                        zone is None or off.zone == zone
+                    ):
+                        off = dataclasses.replace(off, **changes)
+                        updated += 1
+                    fresh.append(off)
+                it.offerings = Offerings(fresh)
+        return updated
+
+    def set_price(
+        self,
+        instance_type: str,
+        price: float,
+        capacity_type: Optional[str] = None,
+        zone: Optional[str] = None,
+    ) -> int:
+        """Dynamic per-offering price update (the spot market moving).  The
+        policy input digest covers prices, so a set_price between reconciles
+        invalidates the incremental warm-start lineage exactly like any other
+        supply change (tests/test_policy.py pins the escalation)."""
+        return self._update_offerings(
+            instance_type, capacity_type, zone, price=float(price)
+        )
+
+    def set_interruption_rate(
+        self,
+        instance_type: str,
+        rate: float,
+        capacity_type: Optional[str] = "spot",
+        zone: Optional[str] = None,
+    ) -> int:
+        """Stamp an interruption-risk prior on matching offerings (spot by
+        default).  Feeds the policy risk planes (policy.planes) and the
+        ``interrupt_spot`` sampler below."""
+        return self._update_offerings(
+            instance_type, capacity_type, zone,
+            interruption_rate=min(max(float(rate), 0.0), 1.0),
+        )
+
+    def interrupt_spot(self, rng, creates: int = 1) -> List[str]:
+        """Sample one round of spot interruptions from the per-offering
+        ``interruption_rate`` priors: each spot offering with a positive rate
+        is reclaimed with that probability (``rng`` is a seeded
+        utils.retry.DeterministicRNG so soak runs replay), and every
+        interrupted instance type feeds the first-class ``capacity_errors``
+        failure path — its next ``creates`` launches raise
+        InsufficientCapacityError, exactly the chaos plane's capacity-fault
+        shape.  Returns the interrupted type names."""
+        interrupted: List[str] = []
+        with self._mu:
+            for it in self._pinned_catalog():
+                for off in it.offerings:
+                    rate = float(getattr(off, "interruption_rate", 0.0) or 0.0)
+                    if off.capacity_type != "spot" or rate <= 0.0:
+                        continue
+                    if rng.random() < rate:
+                        self.capacity_errors[it.name] = (
+                            self.capacity_errors.get(it.name, 0) + creates
+                        )
+                        interrupted.append(it.name)
+                        break  # one ICE grant per type per round
+        return interrupted
+
     def create(self, machine: Machine) -> Machine:
         with self._mu:
             self.create_calls.append(machine)

@@ -45,12 +45,18 @@ class TransientCloudError(Exception):
 @dataclass(frozen=True)
 class Offering:
     """A (capacity type, zone) purchase option for an instance type
-    (types.go:106)."""
+    (types.go:106).
+
+    ``interruption_rate`` is the cloud's reclaim-probability signal for the
+    offering (spot interruption frequency, [0, 1]); it seeds the policy
+    subsystem's risk priors (policy.planes) and defaults to 0 so offerings
+    built before the policy layer behave exactly as before."""
 
     capacity_type: str
     zone: str
     price: float
     available: bool = True
+    interruption_rate: float = 0.0
 
 
 class Offerings(List[Offering]):

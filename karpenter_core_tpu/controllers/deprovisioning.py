@@ -775,8 +775,13 @@ class MultiNodeConsolidation(_ConsolidationBase):
                         self.solver_breaker.release_trial()
                     return None  # service judged the shape kernel-unsupported
             else:
+                provisioners = self.kube_client.list_provisioners()
                 search = TPUConsolidationSearch(
-                    self.cloud_provider, self.kube_client.list_provisioners()
+                    self.cloud_provider, provisioners,
+                    # policy objective: lanes score by fleet-cost delta
+                    # instead of node count when enabled (docs/POLICY.md);
+                    # resolved per sweep like provisioning resolves per batch
+                    policy=self._policy_config(provisioners),
                 )
                 cmd = search.compute_command(
                     candidates,
@@ -804,6 +809,17 @@ class MultiNodeConsolidation(_ConsolidationBase):
         if self.solver_breaker is not None:
             self.solver_breaker.record_success()
         return cmd
+
+    def _policy_config(self, provisioners):
+        """The policy-objective config for this sweep: the provisioning
+        controller's resolver when it exposes one (one fleet, one objective),
+        else env defaults (standalone / stub embeddings)."""
+        resolver = getattr(self.provisioning, "policy_config", None)
+        if resolver is not None:
+            return resolver(provisioners)
+        from karpenter_core_tpu.policy import PolicyConfig
+
+        return PolicyConfig.resolve(provisioners)
 
     def _remote_search(self, candidates: List[CandidateNode]) -> Optional[Command]:
         """Ship the sweep to the solver service (/Consolidate).  Returns None

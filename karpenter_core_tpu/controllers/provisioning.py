@@ -66,6 +66,12 @@ DEGRADED_SOLVES = REGISTRY.counter(
     "circuit breaker was open.",
     ("controller",),
 )
+POLICY_COUNTERPROPOSALS = REGISTRY.counter(
+    "karpenter_policy_counterproposals_total",
+    "ShapeHint counter-proposals emitted for pods a bounded resize would "
+    "make schedulable on a strictly cheaper fleet (docs/POLICY.md).",
+    ("kind",),
+)
 
 # consecutive unexpected kernel failures (backend init/relay faults, not
 # KernelUnsupported routing) before the solver-backend circuit breaker opens
@@ -436,6 +442,7 @@ class ProvisioningController:
         results, err = self.schedule(pods, state_nodes)
         if err is not None:
             return err
+        self._emit_counterproposals(results.failed_pods)
         if not results.new_nodes:
             return None
 
@@ -626,6 +633,10 @@ class ProvisioningController:
             self.cloud_provider, provisioners,
             daemonset_pods=daemonset_pods,
             kube_client=self.kube_client,
+            # the policy objective stage (docs/POLICY.md): scores feasible
+            # offerings after the solve and pins each node's launch to the
+            # argmin cell; disabled config = pre-policy pipeline exactly
+            policy=self.policy_config(provisioners),
         )
         bound_pods = self.kube_client.list_pods()
         if self.solver_endpoint:
@@ -1052,6 +1063,56 @@ class ProvisioningController:
                     domains[labels_api.LABEL_TOPOLOGY_ZONE] = zones.values_list()[0]
             for pod in launchable.pods:
                 seed(pod, requirements, domains)
+
+    def policy_config(self, provisioners=None):
+        """The policy-objective config this reconcile runs under: env
+        defaults overlaid by the highest-weight provisioner's ``spec.policy``
+        block; KC_POLICY=0 kills the stage everywhere (policy.config)."""
+        from karpenter_core_tpu.policy import PolicyConfig
+
+        if provisioners is None:
+            provisioners = self.kube_client.list_provisioners()
+        return PolicyConfig.resolve(provisioners)
+
+    def _emit_counterproposals(self, failed_pods: List[Pod]) -> None:
+        """ShapeHint counter-proposals for unschedulable pods (docs/POLICY.md):
+        when a bounded resize would fit a strictly cheaper fleet, say so —
+        one event per distinct pod shape (not per pod: a 50k-replica batch
+        failing identically is ONE proposal), plus
+        ``karpenter_policy_counterproposals_total``."""
+        if not failed_pods:
+            return
+        from karpenter_core_tpu.policy import propose_resize
+        from karpenter_core_tpu.utils import resources as resources_util
+
+        # one provisioner LIST serves both the config resolve and the catalog
+        provisioners = self.kube_client.list_provisioners()
+        policy = self.policy_config(provisioners)
+        if not (policy.enabled and policy.counter_proposals):
+            return
+        catalog, seen_types = [], set()
+        for provisioner in provisioners:
+            for it in self.cloud_provider.get_instance_types(provisioner):
+                if it.name not in seen_types:
+                    seen_types.add(it.name)
+                    catalog.append(it)
+        proposed: dict = {}
+        for pod in failed_pods:
+            requests = resources_util.ceiling(pod)
+            shape = tuple(sorted(requests.items()))
+            if shape in proposed:
+                continue
+            proposed[shape] = None
+            hint = propose_resize(requests, catalog, policy)
+            if hint is None:
+                continue
+            POLICY_COUNTERPROPOSALS.labels("resize").inc()
+            log.info(
+                "counter-proposal for pod %s/%s: %s",
+                pod.namespace, pod.name, hint.message(),
+            )
+            if self.recorder is not None:
+                self.recorder.publish(evt.shape_hint(pod, hint.message()))
 
     def get_daemonset_pods(self) -> List[Pod]:
         """Representative daemonset pods for overhead calculation.  The

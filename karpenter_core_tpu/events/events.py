@@ -88,6 +88,20 @@ def waiting_on_readiness(node_repr: str) -> Event:
     )
 
 
+def shape_hint(pod: Pod, message: str) -> Event:
+    """Policy counter-proposal (docs/POLICY.md): the pod is unschedulable
+    (or schedulable only expensively) as specified, but a bounded resize
+    would fit a strictly cheaper fleet.  Advisory — the workload owner
+    decides; nothing mutates the pod."""
+    return Event(
+        involved_object=pod,
+        type="Normal",
+        reason="ShapeHint",
+        message=message,
+        dedupe_values=[pod.namespace, pod.name, message],
+    )
+
+
 def unconsolidatable(node: Node, reason: str) -> Event:
     return Event(
         involved_object=node,

@@ -327,6 +327,18 @@ SOAK_SLO_VIOLATIONS = Counter(
 )
 REGISTRY.register(SOAK_SLO_VIOLATIONS)
 
+# Policy-objective surface (docs/POLICY.md): the latest solve's selected
+# fleet cost, raw offering prices ({view="price"}) and risk-weighted
+# expectation ({view="expected"}), set by TPUSolver decode when the
+# objective stage runs.
+POLICY_FLEET_COST = Gauge(
+    NAMESPACE + "_policy_fleet_cost",
+    "Fleet cost of the latest policy-objective selection, by view "
+    "(price = raw offering prices, expected = risk-weighted).",
+    ("view",),
+)
+REGISTRY.register(POLICY_FLEET_COST)
+
 
 def measure(observer, clock=None):
     """Closure timer (constants.go:60-66): ``done = measure(hist.labels(...))``

@@ -209,6 +209,15 @@ class EncodedSnapshot:
     # per_pod = each pod its own disjoint claims (count-dependent per node)
     class_volumes: list = None
 
+    # policy-objective planes (policy.planes.attach_planes, filled by
+    # TPUSolver post-encode): the offering price sheet, interruption-risk
+    # priors, and per-type throughput weights on this snapshot's I/Z/CT axes.
+    # Digested as the ``policy`` plane group in models.store so a price-sheet
+    # change escalates the incremental path exactly like a supply change.
+    pol_price: np.ndarray = None  # f32[I, Z, CT]
+    pol_risk: np.ndarray = None  # f32[I, Z, CT]
+    pol_throughput: np.ndarray = None  # f32[I]
+
 
 def _class_signature(pod: Pod) -> tuple:
     """Equivalence key computed from the raw spec — cheap enough to run per pod
@@ -782,6 +791,15 @@ def encode_snapshot(
         tuple(resources),
         tuple(zones),
         tuple(capacity_types),
+        # offering content is part of the key: prices/availability can move
+        # between encodes on one live solver (dynamic spot pricing —
+        # FakeCloudProvider.set_price), and the cached it_price/it_avail
+        # planes must not outlive the sheet they encoded
+        tuple(
+            (o.zone, o.capacity_type, o.available, o.price)
+            for it in all_its
+            for o in it.offerings
+        ),
     )
     if cache is not None and cache.get("key") == cache_key:
         (

@@ -56,6 +56,10 @@ PLANE_FIELDS: Dict[str, Tuple[str, ...]] = {
         "cls_relax_next", "cls_anti_soft", "cls_root", "cls_tol", "cls_ports",
     ),
     "groups": ("grp_skew", "grp_is_zone", "grp_is_anti", "grp_member", "cls_groups"),
+    # the policy-objective planes (policy.planes): the price sheet versions
+    # independently of the feasibility catalog so a spot-market move (or a
+    # risk/throughput prior change) is its own named escalation reason
+    "policy": ("pol_price", "pol_risk", "pol_throughput"),
 }
 
 
@@ -262,7 +266,7 @@ def diff_snapshots(prev: VersionedSnapshot, cur: VersionedSnapshot) -> SnapshotD
 
     changed = tuple(
         name
-        for name in ("catalog", "templates", "vocab", "groups", "axes")
+        for name in ("catalog", "templates", "vocab", "groups", "axes", "policy")
         if prev.digests.get(name) != cur.digests.get(name)
     )
     if prev.supply != cur.supply:

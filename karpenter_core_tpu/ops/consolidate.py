@@ -37,6 +37,10 @@ class SweepOutputs(NamedTuple):
     new_ct: jnp.ndarray  # bool[S, M, CT]
     new_used: jnp.ndarray  # f32[S, M, R]
     new_tmpl: jnp.ndarray  # i32[S, M]
+    # fleet cost of the lane's replacement nodes: sum over opened slots of
+    # the cheapest surviving offering price (ops.solve.node_prices) — the
+    # in-kernel half of policy-aware cost-delta consolidation (docs/POLICY.md)
+    new_cost: jnp.ndarray  # f32[S]
 
 
 def sweep(
@@ -48,6 +52,7 @@ def sweep(
     candidate_rank: jnp.ndarray,  # i32[E]: position in disruption order, big=not candidate
     ex_cls_count: jnp.ndarray,  # i32[C, E]: candidate pods per class per node
     prefix_sizes: jnp.ndarray,  # i32[S]
+    it_price: jnp.ndarray,  # f32[I, Z, CT] offering price sheet
     n_slots: int = 16,
     n_passes: int = 1,
     features=None,
@@ -75,6 +80,8 @@ def sweep(
         uninit = jnp.any(
             (out.assign_existing > 0) & ~ex_static.init[None, :]
         )
+        prices = solve_ops.node_prices(out.state, it_price)
+        cost = jnp.sum(jnp.where(jnp.isfinite(prices), prices, 0.0))
         return (
             n_new,
             failed,
@@ -84,6 +91,7 @@ def sweep(
             out.state.ct,
             out.state.used,
             out.state.tmpl_id,
+            cost,
         )
 
     results = jax.vmap(one_prefix)(prefix_sizes)
@@ -105,14 +113,17 @@ def _sharded_sweep_fn(mesh, key_has_bounds, n_slots: int, n_passes: int = 1,
 
     lane_sharded = NamedSharding(mesh, P("replica"))
 
-    def core(sizes_arg, cls_arg, statics_arg, ex_state_arg, ex_static_arg, rank_arg, counts_arg):
+    def core(sizes_arg, cls_arg, statics_arg, ex_state_arg, ex_static_arg,
+             rank_arg, counts_arg, price_arg):
         return sweep(
             cls_arg, statics_arg, key_has_bounds, ex_state_arg, ex_static_arg,
-            rank_arg, counts_arg, sizes_arg, n_slots=n_slots, n_passes=n_passes,
-            features=features,
+            rank_arg, counts_arg, sizes_arg, price_arg, n_slots=n_slots,
+            n_passes=n_passes, features=features,
         )
 
-    return jax.jit(core, in_shardings=(lane_sharded, None, None, None, None, None, None))
+    return jax.jit(
+        core, in_shardings=(lane_sharded,) + (None,) * 7
+    )
 
 
 def run_sweep(
@@ -130,6 +141,7 @@ def run_sweep(
     cross-device traffic is the gather of per-lane results."""
     cls, statics_arrays, key_has_bounds = solve_ops.prepare(snapshot)
     sizes = jnp.asarray(prefix_sizes)
+    it_price = jnp.asarray(snapshot.it_price)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -147,6 +159,7 @@ def run_sweep(
             out = fn(
                 sizes, cls, statics_arrays, ex_state, ex_static,
                 jnp.asarray(candidate_rank), jnp.asarray(ex_cls_count),
+                it_price,
             )
         if pad:
             out = SweepOutputs(*(np.asarray(plane)[: len(prefix_sizes)] for plane in out))
@@ -160,6 +173,7 @@ def run_sweep(
         jnp.asarray(candidate_rank),
         jnp.asarray(ex_cls_count),
         sizes,
+        it_price,
         n_slots=n_slots,
         n_passes=snapshot.scan_passes,
         features=compilecache.snap_features(

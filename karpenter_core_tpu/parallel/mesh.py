@@ -279,6 +279,91 @@ def _monte_carlo_fn(mesh, key_has_bounds, n_slots: int, n_passes: int,
     )
 
 
+def perturb_offering_availability(
+    snapshot: EncodedSnapshot, risk, n_replicas: int, seed: int = 0
+) -> jnp.ndarray:
+    """bool[REP, I, Z, CT]: per-replica offering availability with every
+    offering cell interrupted with ITS OWN prior probability — the policy
+    risk planes (policy.planes) instead of ``perturb_spot_availability``'s
+    one uniform spot rate.  Offerings with zero risk never drop, so a
+    risk-free catalog reproduces the unperturbed solve in every replica."""
+    key = jax.random.PRNGKey(seed)
+    avail = jnp.asarray(snapshot.it_avail)  # [I, Z, CT]
+    risk_arr = jnp.asarray(risk, dtype=jnp.float32)
+    interrupted = (
+        jax.random.uniform(key, (n_replicas,) + avail.shape) < risk_arr[None]
+    )
+    return avail[None] & ~interrupted
+
+
+def policy_monte_carlo(
+    snapshot: EncodedSnapshot,
+    n_replicas: int,
+    mesh: Optional[Mesh] = None,
+    seed: int = 0,
+    n_slots: int = 0,
+) -> dict:
+    """Risk-weighted policy variants over the Monte-Carlo replica machinery:
+    sample one interruption OUTCOME per replica from the snapshot's
+    per-offering risk priors (``pol_risk``), solve every outcome in parallel
+    across the mesh, and pick the replica assignment minimizing risk-adjusted
+    cost — fleet price plus an unschedulable-pod penalty that dominates any
+    price difference, so a cheap fleet that strands pods under interruption
+    never wins (docs/POLICY.md "Risk-weighted variants").
+
+    Returns per-replica ``cost``/``failed``/``nodes`` arrays plus
+    ``expected_cost`` (the mean risk-adjusted cost — the number a
+    risk-averse objective reports for this fleet) and ``best_replica``."""
+    if mesh is None:
+        mesh = default_mesh()
+    if n_slots <= 0:
+        n_slots = solve_ops.estimate_slots(snapshot)
+    risk = getattr(snapshot, "pol_risk", None)
+    if risk is None:
+        risk = np.zeros_like(np.asarray(snapshot.it_price))
+    price = getattr(snapshot, "pol_price", None)
+    if price is None:
+        price = snapshot.it_price
+
+    cls, statics_arrays, key_has_bounds = solve_ops.prepare(snapshot)
+    avail_r = perturb_offering_availability(snapshot, risk, n_replicas, seed)
+    it_price = jnp.asarray(price)
+    avail_idx = solve_ops.Statics._fields.index("it_avail")
+
+    fn = _monte_carlo_fn(
+        mesh, key_has_bounds, n_slots, snapshot.scan_passes, avail_idx,
+        compilecache.snap_features(solve_ops.snapshot_features(snapshot)),
+    )
+    with mesh:
+        scheduled, failed, nodes, cost = jax.device_get(
+            fn(avail_r, cls, statics_arrays, it_price)
+        )
+    cost = np.asarray(cost, dtype=np.float64)
+    failed = np.asarray(failed, dtype=np.int64)
+    # the penalty per unplaced pod dominates any achievable fleet price —
+    # every open slot costs at most the max offering price, so max_price ×
+    # n_slots bounds any replica's fleet cost and feasibility strictly
+    # outranks price in the risk-adjusted ordering
+    finite = np.asarray(price)[np.isfinite(price)]
+    penalty = float(finite.max() if finite.size else 1.0) * max(n_slots, 1)
+    adjusted = cost + failed * (penalty + 1.0)
+    best = int(np.argmin(adjusted)) if len(adjusted) else 0
+    return {
+        "replicas": n_replicas,
+        "scheduled": np.asarray(scheduled),
+        "failed": failed,
+        "nodes": np.asarray(nodes),
+        "cost": cost,
+        "adjusted_cost": adjusted,
+        "expected_cost": float(np.mean(adjusted)) if len(adjusted) else 0.0,
+        "cost_mean": float(np.mean(cost)) if len(cost) else 0.0,
+        "cost_max": float(np.max(cost)) if len(cost) else 0.0,
+        "best_replica": best,
+        "best_cost": float(cost[best]) if len(cost) else 0.0,
+        "feasible_replicas": int(np.sum(failed == 0)),
+    }
+
+
 @functools.lru_cache(maxsize=16)
 def _crossed_grid_fn(mesh, key_has_bounds, n_slots: int, n_passes: int, avail_idx: int,
                      features=None):

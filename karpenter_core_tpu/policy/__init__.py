@@ -1,0 +1,41 @@
+"""Policy-objective subsystem: cost-, heterogeneity-, and interruption-aware
+batched solves (docs/POLICY.md).
+
+The kernel answers feasibility ("which node fits"); this package answers the
+economic question on top of it ("which *fleet* is cheapest/fastest/safest"):
+
+  - ``config``: the ``PolicyConfig`` knob surface (weights, risk aversion,
+    enable flags) resolved from env + the Provisioner's ``spec.policy`` block;
+    default == today's behavior exactly, ``KC_POLICY=0`` is the kill switch.
+  - ``planes``: the dense objective planes (price / interruption-risk /
+    throughput over the instance-type × zone × capacity-type axes) attached
+    to every encoded snapshot and digested as the ``policy`` plane group in
+    ``models.store`` so a price-sheet change invalidates the incremental
+    warm-start lineage like any other supply change.
+  - ``counterproposal``: the ShapeHint engine — when a pod is unschedulable
+    (or schedulable only expensively) and a bounded resize would fit a
+    strictly cheaper fleet, propose the shape instead of only rejecting it.
+
+The batched scoring/argmin kernel itself lives in ``ops.objective`` (it is
+device code, next to the solve kernel it runs after).
+"""
+
+from karpenter_core_tpu.policy.config import PolicyConfig, policy_enabled
+from karpenter_core_tpu.policy.counterproposal import ShapeHint, propose_resize
+from karpenter_core_tpu.policy.planes import (
+    ObjectivePlanes,
+    attach_planes,
+    build_planes,
+    policy_input_digest,
+)
+
+__all__ = [
+    "PolicyConfig",
+    "policy_enabled",
+    "ObjectivePlanes",
+    "attach_planes",
+    "build_planes",
+    "policy_input_digest",
+    "ShapeHint",
+    "propose_resize",
+]

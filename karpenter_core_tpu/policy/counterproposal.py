@@ -1,0 +1,118 @@
+"""Counter-proposals: the solver proposing pod shapes instead of only
+accepting or rejecting them ("Toward Co-adapting ML Job Shape and Cluster
+Topology", PAPERS.md).
+
+When a pod is unschedulable as specified — or schedulable only onto an
+expensive shape — but a BOUNDED resize (shrink every over-subscribed resource
+by at most ``PolicyConfig.max_resize_fraction``) would fit a strictly cheaper
+fleet, the provisioning controller emits a ``ShapeHint`` event on the pod
+(events.shape_hint) and bumps ``karpenter_policy_counterproposals_total``.
+The hint is advisory: nothing mutates the pod — the workload owner (or an
+admission webhook acting for them) decides whether the trade is acceptable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from karpenter_core_tpu.utils import resources as resources_util
+
+
+@dataclass(frozen=True)
+class ShapeHint:
+    """One concrete counter-proposal for an unschedulable (or expensively
+    schedulable) pod shape."""
+
+    suggested_requests: Dict[str, float]
+    instance_type: str
+    price: float  # cheapest available offering of the fitting type
+    shrink_fraction: float  # largest per-resource shrink the proposal needs
+    # price of the cheapest shape that fits the pod AS SPECIFIED
+    # (inf = unschedulable without the resize)
+    current_price: float
+
+    def message(self) -> str:
+        shaped = ", ".join(
+            f"{name}={resources_util.format_quantity(value)}"
+            for name, value in sorted(self.suggested_requests.items())
+        )
+        if self.current_price == float("inf"):
+            verdict = "pod is unschedulable as specified"
+        else:
+            verdict = (
+                f"cheapest fit as specified costs {self.current_price:.4f}"
+            )
+        return (
+            f"{verdict}; shrinking requests by "
+            f"{self.shrink_fraction:.0%} (to {shaped}) fits "
+            f"{self.instance_type} at {self.price:.4f}"
+        )
+
+
+def _cheapest_offering_price(it) -> float:
+    cheapest = it.offerings.available().cheapest()
+    return cheapest.price if cheapest is not None else float("inf")
+
+
+def propose_resize(
+    requests: resources_util.ResourceList,
+    instance_types: List,
+    config,
+) -> Optional[ShapeHint]:
+    """A ShapeHint when a bounded shrink of ``requests`` fits a strictly
+    cheaper fleet, else None.
+
+    For each catalog type the needed shrink is the largest per-resource
+    overshoot fraction ``(request - allocatable) / request`` over the
+    requested resources; a type needing more than ``max_resize_fraction`` is
+    out of bounds.  Among in-bounds types the cheapest available offering
+    wins; the proposal stands only when that price strictly beats the
+    cheapest fit of the unmodified shape (inf when nothing fits — the
+    "unschedulable but a resize would fit" case the ISSUE names)."""
+    if not requests or config is None or not config.counter_proposals:
+        return None
+    current_price = float("inf")
+    best: Optional[ShapeHint] = None
+    for it in instance_types:
+        alloc = it.allocatable()
+        shrink = 0.0
+        fits_now = True
+        suggested: Dict[str, float] = {}
+        for name, req in requests.items():
+            if req <= 0:
+                continue
+            have = alloc.get(name, 0.0)
+            if have < req:
+                fits_now = False
+                shrink = max(shrink, (req - have) / req)
+                suggested[name] = have
+            else:
+                suggested[name] = req
+        price = _cheapest_offering_price(it)
+        if price == float("inf"):
+            continue
+        if fits_now:
+            current_price = min(current_price, price)
+            continue
+        if shrink > config.max_resize_fraction or any(
+            v <= 0 for v in suggested.values()
+        ):
+            continue
+        if best is None or price < best.price:
+            best = ShapeHint(
+                suggested_requests=suggested,
+                instance_type=it.name,
+                price=price,
+                shrink_fraction=shrink,
+                current_price=float("inf"),  # filled below
+            )
+    if best is None or best.price >= current_price:
+        return None
+    return ShapeHint(
+        suggested_requests=best.suggested_requests,
+        instance_type=best.instance_type,
+        price=best.price,
+        shrink_fraction=best.shrink_fraction,
+        current_price=current_price,
+    )

@@ -147,6 +147,29 @@ class SoakRunner:
 
     # -- probes ----------------------------------------------------------------
 
+    @staticmethod
+    def _fleet_cost(nodes, provider) -> float:
+        """Summed current-offering price of the live fleet: each node's
+        (instance type, capacity type, zone) labels looked up against the
+        provider's price sheet (the ``fleet_cost_per_tick`` probe).  Nodes
+        with unknown types/offerings contribute 0 — the probe measures the
+        priced fleet, it does not fail the tick."""
+        by_name = {it.name: it for it in provider.get_instance_types(None)}
+        total = 0.0
+        for node in nodes:
+            it = by_name.get(
+                node.metadata.labels.get(labels_api.LABEL_INSTANCE_TYPE_STABLE, "")
+            )
+            if it is None:
+                continue
+            offering = it.offerings.get(
+                node.metadata.labels.get(labels_api.LABEL_CAPACITY_TYPE, ""),
+                node.metadata.labels.get(labels_api.LABEL_TOPOLOGY_ZONE, ""),
+            )
+            if offering is not None:
+                total += offering.price
+        return total
+
     def _observe(self, env, now: float) -> Observation:
         pods = env.kube.list_pods()  # one LIST feeds both views below
         pending = harness.pending_pods(env, pods=pods)
@@ -182,6 +205,7 @@ class SoakRunner:
             degraded=env.provisioning.degraded(),
             empty_node_ages_s=sorted(empty_ages),
             nodes=len(nodes),
+            fleet_cost=self._fleet_cost(nodes, env.provider),
             solve_latency_s=env.provisioning.last_reconcile_s or 0.0,
         )
 

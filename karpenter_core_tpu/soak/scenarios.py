@@ -109,6 +109,42 @@ def mass_eviction_capacity(seed: int = 37) -> SoakScenario:
     )
 
 
+def spot_churn(seed: int = 67) -> SoakScenario:
+    """Spot-market churn: a standing fleet under mass eviction + reschedule
+    while the chaos plane injects insufficient-capacity faults on
+    ``cloud.create`` — the spot-interruption shape (capacity vanishes under
+    the reschedule wave, launches redraw onto surviving offerings).  The SLO
+    adds the policy subsystem's economic probe: MEAN fleet cost per tick
+    stays bounded (a churned fleet that lands on expensive offerings, or
+    only ever grows, blows the bound while node counts still look healthy)
+    on top of the p99 pending-age convergence rule.  Small enough that the
+    tier-1 smoke (tests/test_policy.py) runs it directly; the slow matrix
+    replays it with the rest of the catalog."""
+    return SoakScenario(
+        name="spot-churn",
+        seed=seed,
+        generator="mass-eviction",
+        params={"standing": 24, "evict_fraction": 0.5, "evict_at_s": 180.0},
+        slo={"rules": _CONVERGENCE_RULES + [
+            # generous economic bound: the standing fleet prices well under
+            # this; a leak/only-grow regression or a pathologically expensive
+            # re-landing blows it (docs/POLICY.md "Soak surface")
+            {"probe": "fleet_cost_per_tick", "agg": "mean", "limit": 10.0},
+            {"probe": "fleet_cost_per_tick", "agg": "max", "limit": 30.0},
+        ]},
+        tick_s=15.0,
+        settle_ticks=40,
+        chaos_points={
+            # spot interruptions: capacity errors on the reschedule wave's
+            # creates, bounded so convergence is reachable
+            "cloud.create": {
+                "prob": 0.25, "kind": "error", "stop_after": 4,
+                "data": {"mode": "insufficient-capacity"},
+            },
+        },
+    )
+
+
 def mixed_fleet_steady(seed: int = 41) -> SoakScenario:
     """Three provisioners under three different churn patterns at once —
     the multi-tenant shape where one noisy fleet must not starve another."""
@@ -161,6 +197,7 @@ CATALOG: Dict[str, Callable[[int], SoakScenario]] = {
     "batch-flood-flaky-api": batch_flood_flaky_api,
     "mass-eviction-capacity": mass_eviction_capacity,
     "mixed-fleet-steady": mixed_fleet_steady,
+    "spot-churn": spot_churn,
 }
 
 # the deterministic scenario `make soak` gates on (mirrors `make chaos`)

@@ -48,6 +48,13 @@ PROBES: Dict[str, bool] = {
     "degraded": True,
     "consolidation_lag_s": True,
     "nodes": True,
+    # summed current-offering price of the live fleet, sampled per tick —
+    # the policy subsystem's economic convergence surface (docs/POLICY.md):
+    # a fleet that only grows, or that lands on expensive offerings under
+    # spot churn, blows a mean bound here while node counts look healthy.
+    # Deterministic: derived from node labels × the catalog price sheet on
+    # the FakeClock timeline.
+    "fleet_cost_per_tick": True,
     "solve_latency_s": False,
 }
 
@@ -77,6 +84,7 @@ class Observation:
     degraded: bool = False
     empty_node_ages_s: List[float] = field(default_factory=list)
     nodes: int = 0
+    fleet_cost: float = 0.0  # summed current-offering price of live nodes
     solve_latency_s: float = 0.0  # wall seconds (advisory)
 
     def probe_values(self) -> Dict[str, float]:
@@ -87,6 +95,7 @@ class Observation:
             "degraded": 1.0 if self.degraded else 0.0,
             "consolidation_lag_s": max(self.empty_node_ages_s, default=0.0),
             "nodes": float(self.nodes),
+            "fleet_cost_per_tick": round(self.fleet_cost, 6),
             "solve_latency_s": self.solve_latency_s,
         }
 

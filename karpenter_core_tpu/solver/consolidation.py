@@ -33,7 +33,7 @@ from karpenter_core_tpu.solver.tpu import TPUSolver
 MAX_LANES = 64
 
 
-def search_largest_prefix(n, evaluate):
+def search_largest_prefix(n, evaluate, refine: bool = True):
     """Largest valid consolidation prefix via batched lane sweeps.
 
     ``evaluate(sizes) -> (best_command_or_None, best_k)`` runs one device
@@ -43,13 +43,18 @@ def search_largest_prefix(n, evaluate):
     bracket, shrinking it ~MAX_LANES× each time — the boundary pins exactly
     in ceil(log64(n)) passes (2 up to 4096 candidates, 3 to 256k) vs the
     reference's ~log2(n) sequential full simulations
-    (multinodeconsolidation.go:86-113)."""
+    (multinodeconsolidation.go:86-113).
+
+    ``refine=False`` stops after the coarse pass — cost-delta scoring
+    (policy objective) picks its optimum WITHIN a pass, and the bracket
+    refinement's larger-k-wins assumption would let a worse-saving larger
+    prefix displace it."""
     if n <= MAX_LANES:
         sizes = np.arange(1, n + 1, dtype=np.int32)
     else:
         sizes = np.unique(np.round(np.linspace(1, n, MAX_LANES)).astype(np.int32))
     best, best_k = evaluate(sizes)
-    if n <= MAX_LANES or best is None:
+    if n <= MAX_LANES or best is None or not refine:
         return best
 
     lo = best_k
@@ -92,8 +97,14 @@ class TPUReplacement:
 
 
 class TPUConsolidationSearch:
-    def __init__(self, cloud_provider, provisioners) -> None:
-        self.solver = TPUSolver(cloud_provider, provisioners)
+    def __init__(self, cloud_provider, provisioners, policy=None) -> None:
+        # policy (policy.PolicyConfig): with the objective enabled, lanes are
+        # scored by FLEET COST DELTA (old subset price minus replacement
+        # cost) instead of node count — the cheapest fleet wins even when a
+        # smaller prefix removes fewer nodes (docs/POLICY.md).  None/disabled
+        # keeps the reference behavior: the largest valid prefix wins.
+        self.policy = policy
+        self.solver = TPUSolver(cloud_provider, provisioners, policy=policy)
         self.it_by_name = {
             it.name: it
             for p in self.solver.provisioners
@@ -155,13 +166,36 @@ class TPUConsolidationSearch:
             lambda sizes: self._evaluate_sweep(
                 snapshot, ex_state, ex_static, rank, ex_cls_count, sizes, candidates
             ),
+            refine=not (
+                self.policy is not None and getattr(self.policy, "enabled", False)
+            ),
         )
         return best if best is not None else Command(Action.DO_NOTHING)
+
+    def _candidate_price_cumsum(self, candidates) -> np.ndarray:
+        """Cumulative current-offering price of the first-k candidates
+        (nan-poisoned past any candidate whose offering is unknown, which
+        drops those lanes out of cost scoring without failing the sweep)."""
+        prices = np.full(len(candidates), np.nan, dtype=np.float64)
+        for i, c in enumerate(candidates):
+            offering = c.instance_type.offerings.get(c.capacity_type, c.zone)
+            if offering is not None:
+                prices[i] = offering.price
+        return np.cumsum(prices)
 
     def _evaluate_sweep(
         self, snapshot, ex_state, ex_static, rank, ex_cls_count, sizes, candidates
     ):
-        """(best command, its prefix size) across the given lane sizes."""
+        """(best command, its prefix size) across the given lane sizes.
+
+        Default scoring is the reference's: the LARGEST valid prefix wins
+        (most nodes removed).  With the policy objective enabled, lanes are
+        scored by fleet-cost saving — old subset price minus the lane's
+        replacement cost (the kernel's ``new_cost``) — and the largest
+        saving wins, node count breaking ties; fewest-nodes and
+        cheapest-fleet genuinely disagree when a large prefix forces a
+        pricey replacement while a smaller one deletes outright
+        (tests/test_policy.py pins both directions)."""
         out = consolidate_ops.run_sweep(
             snapshot, ex_state, ex_static, rank, ex_cls_count, sizes
         )
@@ -173,27 +207,45 @@ class TPUConsolidationSearch:
         ct = np.asarray(out.new_ct)
         used = np.asarray(out.new_used)
         tmpl_id = np.asarray(out.new_tmpl)
+        new_cost = np.asarray(out.new_cost)
+        cost_scoring = self.policy is not None and getattr(
+            self.policy, "enabled", False
+        )
+        old_cum = self._candidate_price_cumsum(candidates) if cost_scoring else None
 
         best: Optional[Command] = None
         best_k = 0
+        best_saving = -np.inf
         for lane, k in enumerate(sizes.tolist()):
             if failed[lane] > 0 or uninit[lane]:
                 continue
             subset = candidates[:k]
             if int(n_new[lane]) == 0:
-                best = Command(Action.DELETE, [c.node for c in subset])
-                best_k = k
+                cmd = Command(Action.DELETE, [c.node for c in subset])
+                lane_cost = 0.0
+            elif int(n_new[lane]) == 1:
+                replacement = self._decode_replacement(
+                    snapshot, viable[lane, 0], zone[lane, 0], ct[lane, 0],
+                    used[lane, 0], int(tmpl_id[lane, 0]), subset,
+                )
+                if replacement is None:
+                    continue
+                cmd = Command(
+                    Action.REPLACE, [c.node for c in subset], [replacement]
+                )
+                lane_cost = float(new_cost[lane])
+            else:
                 continue
-            if int(n_new[lane]) != 1:
-                continue
-            replacement = self._decode_replacement(
-                snapshot, viable[lane, 0], zone[lane, 0], ct[lane, 0],
-                used[lane, 0], int(tmpl_id[lane, 0]), subset,
-            )
-            if replacement is None:
-                continue
-            best = Command(Action.REPLACE, [c.node for c in subset], [replacement])
-            best_k = k
+            if cost_scoring:
+                saving = float(old_cum[k - 1]) - lane_cost if k >= 1 else 0.0
+                if np.isnan(saving):
+                    saving = -np.inf  # unpriceable subset: never preferred
+                if saving > best_saving or (
+                    saving == best_saving and k > best_k
+                ):
+                    best, best_k, best_saving = cmd, k, saving
+            else:
+                best, best_k = cmd, k
         return best, best_k
 
     def _decode_replacement(

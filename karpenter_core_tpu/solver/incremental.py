@@ -226,8 +226,20 @@ class IncrementalSolveSession:
         members, by_uid, classes = self._members_of(pods_or_classes)
         if self._warm is not None:
             self._absorb_bound({p.uid for p in (bound_pods or [])})
+        from karpenter_core_tpu.policy import planes as policy_planes
+
         catalog = store_mod.catalog_digest(
             self.solver.provisioners, self.solver.instance_types
+        ) + policy_planes.policy_input_digest(
+            # the policy side of the supply: offering prices + interruption
+            # priors + objective knobs + the provider's pending-ICE set.  A
+            # set_price between reconciles (the spot market moving), a weight
+            # change, or a type starting to fail creates flips this digest
+            # and the fallback policy escalates to a full solve — a repair
+            # would otherwise keep optimizing against a stale price/risk
+            # sheet (docs/INCREMENTAL.md "Policy-digest escalation")
+            self.solver.instance_types, getattr(self.solver, "policy", None),
+            provider=getattr(self.solver, "cloud_provider", None),
         )
         # the comparison digest excludes bound pods this lineage placed itself
         # (their binding is the lineage's own work materializing, not a supply

@@ -102,13 +102,22 @@ class TPUNodeDecision:
     and the request vector materialize lazily — at 50k-pod scale eager
     materialization of ~7k nodes × ~1k type names dominates decode time, and
     the underlying planes only cross the device link when first consumed
-    (launch path), off the solve critical path."""
+    (launch path), off the solve critical path.
 
-    __slots__ = ("provisioner_name", "pods", "_snapshot", "_planes", "_slot")
+    ``selected`` carries the policy objective's argmin offering for this node
+    (ops.objective, stamped by TPUSolver decode when the policy stage is
+    enabled): the launch then lands on exactly that (instance type, zone,
+    capacity type) cell — zone/ct pinned, the selected type ordered first —
+    instead of whichever offering the provider's first-compatible walk
+    happens to hit.  None (the default) keeps today's behavior exactly."""
+
+    __slots__ = ("provisioner_name", "pods", "selected", "_snapshot",
+                 "_planes", "_slot")
 
     def __init__(self, provisioner_name, snapshot, planes, slot):
         self.provisioner_name = provisioner_name
         self.pods: List[Pod] = []
+        self.selected: Optional[dict] = None
         self._snapshot = snapshot
         self._planes = planes
         self._slot = slot
@@ -116,15 +125,24 @@ class TPUNodeDecision:
     @property
     def instance_type_names(self) -> List[str]:
         row = self._planes.viable[self._slot]
-        return [self._snapshot.it_names[i] for i in np.nonzero(row)[0]]
+        names = [self._snapshot.it_names[i] for i in np.nonzero(row)[0]]
+        if self.selected is not None:
+            chosen = self.selected["instance_type"]
+            if chosen in names:
+                names = [chosen] + [n for n in names if n != chosen]
+        return names
 
     @property
     def zones(self) -> List[str]:
+        if self.selected is not None:
+            return [self.selected["zone"]]
         row = self._planes.zone[self._slot]
         return [self._snapshot.zones[z] for z in np.nonzero(row)[0]]
 
     @property
     def capacity_types(self) -> List[str]:
+        if self.selected is not None:
+            return [self.selected["capacity_type"]]
         row = self._planes.ct[self._slot]
         return [self._snapshot.capacity_types[c] for c in np.nonzero(row)[0]]
 
@@ -173,6 +191,11 @@ class TPUSolveResults:
     # onto zone-less nodes so both engines see one consistent commitment
     existing_committed_zones: Dict[str, str] = field(default_factory=dict)
     n_slots_used: int = 0
+    # policy objective results (ops.objective, set when the policy stage ran):
+    # the summed selected-offering price over this solve's open slots, raw and
+    # risk-weighted.  None when policy is disabled — the planes never ran.
+    fleet_cost: Optional[float] = None
+    fleet_expected_cost: Optional[float] = None
 
 
 @dataclass
@@ -202,11 +225,18 @@ class TPUSolver:
         provisioners: List[Provisioner],
         daemonset_pods: Optional[List[Pod]] = None,
         kube_client=None,
+        policy=None,
     ) -> None:
         # kube_client resolves PVC -> CSI driver for volume attach-limit
         # planes (volumeusage.go:65-90); None matches the host oracle's
         # behavior of treating unresolvable volumes as unconstrained
         self.kube_client = kube_client
+        # the policy-objective config (policy.PolicyConfig): None/disabled =
+        # feasibility-only decode, exactly the pre-policy pipeline.  The
+        # provider handle stays on the solver so the risk planes can read its
+        # live capacity-error state at encode time (policy.planes).
+        self.policy = policy
+        self.cloud_provider = cloud_provider
         self.provisioners = order_by_weight(
             [p for p in provisioners if p.metadata.deletion_timestamp is None]
         )
@@ -334,6 +364,15 @@ class TPUSolver:
         )
         snapshot.class_volumes = self._resolve_class_volumes(
             snapshot.classes, state_nodes
+        )
+        # objective planes ride every encode (price sheet / risk priors /
+        # throughput weights) so the ``policy`` digest group versions the
+        # economics even while the objective stage itself is disabled
+        from karpenter_core_tpu.policy import planes as policy_planes
+
+        policy_planes.attach_planes(
+            snapshot, self._it_by_name, config=self.policy,
+            provider=self.cloud_provider,
         )
         return snapshot
 
@@ -863,12 +902,51 @@ class TPUSolver:
     ) -> TPUSolveResults:
         with tracing.span("decode") as sp:
             results = self._decode_impl(snapshot, outputs, state_nodes)
+            self._apply_policy_selection(snapshot, outputs, results)
             sp.set(
                 new_nodes=len(results.new_nodes),
                 failed=len(results.failed_pods),
                 residual=len(results.spread_residual_pods),
             )
             return results
+
+    def _apply_policy_selection(self, snapshot, outputs, results) -> None:
+        """The policy-objective stage folded into decode: one batched argmin
+        over every open slot's feasible (instance type, zone, capacity type)
+        cells (ops.objective), stamped onto the node decisions so the launch
+        lands on the selected offering.  A no-op (zero device work) unless
+        the solver's PolicyConfig enables the objective."""
+        config = self.policy
+        if config is None or not getattr(config, "enabled", False):
+            return
+        from karpenter_core_tpu.policy import planes as policy_planes
+
+        planes = policy_planes.planes_of(snapshot)
+        if planes is None:
+            return
+        from karpenter_core_tpu.ops import objective as objective_ops
+
+        with tracing.span("decode.objective", nodes=len(results.new_nodes)):
+            selection = objective_ops.select_for_state(
+                outputs.state, planes, config, snapshot.capacity_types
+            )
+        for decision in results.new_nodes:
+            n = decision._slot
+            if not bool(selection.active[n]):
+                continue
+            decision.selected = {
+                "instance_type": snapshot.it_names[int(selection.sel_it[n])],
+                "zone": snapshot.zones[int(selection.sel_zone[n])],
+                "capacity_type": snapshot.capacity_types[int(selection.sel_ct[n])],
+                "price": float(selection.price[n]),
+                "expected": float(selection.expected[n]),
+            }
+        results.fleet_cost = float(selection.fleet_cost)
+        results.fleet_expected_cost = float(selection.fleet_expected)
+        from karpenter_core_tpu.metrics.registry import POLICY_FLEET_COST
+
+        POLICY_FLEET_COST.labels("price").set(results.fleet_cost)
+        POLICY_FLEET_COST.labels("expected").set(results.fleet_expected_cost)
 
     def _decode_impl(
         self,

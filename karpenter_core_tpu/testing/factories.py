@@ -238,6 +238,7 @@ def make_provisioner(
     ttl_seconds_after_empty: Optional[int] = None,
     ttl_seconds_until_expired: Optional[int] = None,
     consolidation_enabled: Optional[bool] = None,
+    policy: Optional[Dict] = None,
 ) -> Provisioner:
     return Provisioner(
         metadata=ObjectMeta(name=name, namespace=""),
@@ -255,5 +256,6 @@ def make_provisioner(
                 if consolidation_enabled is not None
                 else None
             ),
+            policy=dict(policy) if policy else None,
         ),
     )

@@ -203,7 +203,8 @@ class TestSnapshotStore:
             outs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
         assert outs[0] == outs[1]
         assert set(outs[0]) == {
-            "catalog", "templates", "vocab", "classes", "groups", "axes"
+            "catalog", "templates", "vocab", "classes", "groups", "axes",
+            "policy",
         }
 
     def test_supply_digest_sensitivity(self):

@@ -94,11 +94,13 @@ def last_record(platform: str):
 # per-stage.
 STAGE_KEYS = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "encode_s",
               "dispatch_s", "materialize_s", "cold_s",
-              "churn_warm_solve_s", "churn_full_solve_s")
+              "churn_warm_solve_s", "churn_full_solve_s", "objective_s")
 # stages that matter enough to flag; the others are printed but only the
 # load-bearing ones gate (sub-10ms stages WARN on scheduler-noise otherwise)
+# objective_s gates too: the policy scoring stage rides every policy-enabled
+# decode, so a regression there is a per-reconcile cost (bench.py policy_line)
 GATED_STAGES = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "cold_s",
-                "churn_warm_solve_s", "churn_full_solve_s")
+                "churn_warm_solve_s", "churn_full_solve_s", "objective_s")
 
 
 def compare_stages(detail: dict, prev_detail: dict, tol: float):
@@ -172,6 +174,38 @@ def report_churn(detail: dict) -> None:
         )
 
 
+def report_policy(detail: dict) -> None:
+    """Surface the policy-objective line: fleet cost first-fit vs objective
+    and the scoring-stage cost.  The fleet-cost delta is the ISSUE-9
+    acceptance floor (> 0 on the demo fleet); the enforced stage gate is
+    ``objective_s`` in GATED_STAGES."""
+    policy = detail.get("policy")
+    if not policy:
+        return
+    if "error" in policy:
+        print(f"perfgate: policy bench errored: {policy['error']}")
+        return
+    print(
+        "perfgate: policy fleet cost {p:.4f} vs first-fit {f:.4f} — delta "
+        "{d:.4f}, objective_s {o:.4f}s, identical_placements={i}".format(
+            p=policy["fleet_cost_policy"], f=policy["fleet_cost_firstfit"],
+            d=policy["fleet_cost_delta"], o=policy["objective_s"],
+            i=policy.get("identical_placements"),
+        )
+    )
+    if policy.get("fleet_cost_delta", 0.0) <= 0.0:
+        print(
+            "perfgate: WARNING policy fleet-cost delta is not positive — the "
+            "objective stage stopped beating first-fit on the demo fleet "
+            "(ISSUE-9 acceptance floor)"
+        )
+    if not policy.get("identical_placements", True):
+        print(
+            "perfgate: WARNING policy decode changed pod placements — the "
+            "objective stage must select offerings, never reassign pods"
+        )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=0.05,
@@ -194,6 +228,7 @@ def main() -> int:
     pods_per_sec = detail.get("pods_per_sec")
     warn_compile_budget(detail)
     report_churn(detail)
+    report_policy(detail)
     if pods_per_sec is None:
         print(json.dumps(rec))
         print("perfgate: FAIL (bench produced no pods_per_sec)")
