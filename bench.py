@@ -584,6 +584,144 @@ def policy_line(n_pods: int = 2000, n_its: int = 24) -> dict:
     }
 
 
+def sharded_probe(n_pods: int, n_its: int, mesh_devices: int) -> None:
+    """Child of ``sharded_line``: solve ONE fleet at ONE mesh size and print
+    a JSON line.  Runs in its own process because the virtual device count
+    (XLA_FLAGS --xla_force_host_platform_device_count) is fixed at backend
+    init — the parent pins the env before spawning.  ``mesh_devices`` <= 1
+    measures the production single-device path (mesh off), the scaling
+    baseline the sharded sizes compare against."""
+    from karpenter_core_tpu.models.columnar import PodIngest
+    from karpenter_core_tpu.ops import solve as solve_ops
+    from karpenter_core_tpu.utils import compilecache
+
+    compilecache.enable()
+    solver, pods = build_inputs(n_pods, n_its, n_provisioners=5)
+    ingest = PodIngest()
+    ingest.add_all(pods)
+    snapshot = solver.encode(ingest)
+    t0 = time.perf_counter()
+    out = solve_ops.sync_outputs(solve_ops.solve(snapshot))
+    cold_s = time.perf_counter() - t0
+    solve_s = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = solve_ops.sync_outputs(solve_ops.solve(snapshot))
+        solve_s = min(solve_s, time.perf_counter() - t0)
+    results = solver.decode(snapshot, out)
+    import jax
+
+    print(json.dumps({
+        "mesh_devices": mesh_devices,
+        "visible_devices": jax.device_count(),
+        "solve_s": round(solve_s, 4),
+        "cold_s": round(cold_s, 2),
+        "scheduled": sum(len(n.pods) for n in results.new_nodes),
+        "failed": len(results.failed_pods),
+        "nodes": len(results.new_nodes),
+    }))
+
+
+def sharded_line() -> dict:
+    """The mesh scaling study (docs/KERNEL_PERF.md "Layer 5"): the SAME fleet
+    solved at mesh sizes 1/2/4/8 (KC_BENCH_SHARDED_SIZES, trimmed to what the
+    host allows), one subprocess per size so each gets its own virtual device
+    pool, reporting per-size ``solve_s`` and scaling efficiency
+    (t1 / (k * tk)).  Fleet: KC_BENCH_SHARDED_PODS (default 100k) pods ×
+    KC_BENCH_SHARDED_ITS (default 2k) instance types — the ROADMAP scale
+    point where the catalog stops fitting one device's comfortable working
+    set.  Placements are asserted identical across sizes (the sharded solve's
+    bit-parity contract), so a scaling win can never hide a behavior drift."""
+    sizes = []
+    for raw in os.environ.get("KC_BENCH_SHARDED_SIZES", "1,2,4,8").split(","):
+        try:
+            sizes.append(max(int(raw), 1))
+        except ValueError:
+            continue
+    sizes = sorted(set(sizes))
+    n_pods = int(os.environ.get("KC_BENCH_SHARDED_PODS", "100000"))
+    n_its = int(os.environ.get("KC_BENCH_SHARDED_ITS", "2000"))
+
+    force_host_pool = _BACKEND["platform"] == "cpu" or _BACKEND["fell_back"]
+    if not force_host_pool:
+        # real accelerator: the device pool is whatever the backend exposes —
+        # trim oversized sizes instead of letting KC_SOLVER_MESH_DEVICES cap
+        # them silently (a k=8 row measured on 4 devices would report a
+        # wrong-by-2x efficiency in the gated scaling line)
+        import jax
+
+        available = jax.device_count()
+        dropped = [k for k in sizes if k > available]
+        sizes = [k for k in sizes if k <= available] or [1]
+        if dropped:
+            print(
+                f"bench: sharded_line dropping mesh sizes {dropped} — the "
+                f"backend exposes {available} device(s)", file=sys.stderr,
+            )
+    max_devices = max(sizes)
+
+    env = dict(os.environ)
+    if force_host_pool:
+        # host-mesh study: pin CPU and scrub the relay exactly like
+        # run_pinned, then force the virtual device pool
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("AXON_POOL_SVC_OVERRIDE", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={max_devices}"]
+        )
+
+    per_size = []
+    signature = None
+    for k in sizes:
+        child = dict(env)
+        child["KC_SOLVER_MESH"] = "1" if k > 1 else "0"
+        child["KC_SOLVER_MESH_DEVICES"] = str(k)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), str(n_pods),
+                 str(n_its), "--sharded-probe", str(k)],
+                capture_output=True, text=True, timeout=1800, env=child,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        except Exception as e:  # noqa: BLE001 - one size failing stays a line fact
+            rec = {"mesh_devices": k, "error": f"{type(e).__name__}: {e}"[:300]}
+        per_size.append(rec)
+        if "error" not in rec:
+            sig = (rec["scheduled"], rec["failed"], rec["nodes"])
+            if signature is None:
+                signature = sig
+            elif sig != signature:
+                rec["placement_drift"] = True
+
+    ok = {r["mesh_devices"]: r for r in per_size if "error" not in r}
+    line = {
+        "n_pods": n_pods,
+        "n_instance_types": n_its,
+        "sizes": per_size,
+        "identical_placements": all(
+            not r.get("placement_drift") for r in per_size if "error" not in r
+        ),
+    }
+    if 1 in ok:
+        t1 = ok[1]["solve_s"]
+        line["solve_s_1dev"] = t1
+        for k, rec in ok.items():
+            if k > 1:
+                rec["speedup"] = round(t1 / rec["solve_s"], 2) if rec["solve_s"] else 0.0
+                rec["efficiency"] = round(t1 / (k * rec["solve_s"]), 3) if rec["solve_s"] else 0.0
+        best = min((rec["solve_s"], k) for k, rec in ok.items())
+        line["solve_s_best"] = best[0]
+        line["best_devices"] = best[1]
+        line["speedup_best"] = round(t1 / best[0], 2) if best[0] else 0.0
+    return line
+
+
 def _traced_solve(solver, pods) -> dict:
     """One fully-traced ingest → encode → dispatch → solve → decode →
     materialize pass; returns {"trace_id", "stages"} for the bench line."""
@@ -757,6 +895,20 @@ def main() -> None:
             traceback.print_exc(file=sys.stderr)
             policy = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # mesh scaling: the same fleet at mesh sizes 1/2/4/8 (one subprocess per
+    # size — the virtual device pool is fixed at backend init), reporting
+    # per-size solve_s + efficiency; tools/perfgate.py gates the 1-device and
+    # best-mesh numbers independently.  KC_BENCH_SHARDED=0 skips.
+    sharded = None
+    if os.environ.get("KC_BENCH_SHARDED", "1") != "0":
+        try:
+            sharded = sharded_line()
+        except Exception as e:  # noqa: BLE001 - sharded line never kills the headline
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            sharded = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # restart cold: a fresh process with the persistent caches this process
     # just populated — the cost every operator restart actually pays.  The
     # child inherits os.environ, so a CPU fallback pins it too.
@@ -812,6 +964,15 @@ def main() -> None:
         # fleet-cost delta (must stay > 0 on the demo fleet)
         detail["objective_s"] = policy["objective_s"]
         detail["policy_fleet_cost_delta"] = policy["fleet_cost_delta"]
+    detail["sharded"] = sharded
+    if sharded and "error" not in sharded and "solve_s_1dev" in sharded:
+        # stage mirrors so tools/perfgate.py gates the sharded path
+        # independently — a sharding regression must not hide inside the
+        # (single-device) headline number
+        detail["sharded_solve_1dev_s"] = sharded["solve_s_1dev"]
+        if "solve_s_best" in sharded:
+            detail["sharded_solve_s"] = sharded["solve_s_best"]
+            detail["sharded_speedup"] = sharded.get("speedup_best")
 
     if _BACKEND["probe_failures"]:
         detail["backend_probe_failures"] = _BACKEND["probe_failures"]
@@ -877,7 +1038,15 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--restart-probe" in sys.argv:
+    if "--sharded-probe" in sys.argv:
+        # child of sharded_line(): env (device pool + KC_SOLVER_MESH*) was
+        # pinned by the parent before this interpreter started
+        sharded_probe(
+            int(sys.argv[1]) if len(sys.argv) > 1 else 100_000,
+            int(sys.argv[2]) if len(sys.argv) > 2 else 2_000,
+            int(sys.argv[sys.argv.index("--sharded-probe") + 1]),
+        )
+    elif "--restart-probe" in sys.argv:
         # child of main(): backend already acquired (or pinned) by the parent
         restart_probe(
             int(sys.argv[1]) if len(sys.argv) > 1 else 50_000,

@@ -28,8 +28,18 @@ from karpenter_core_tpu.analysis.core import (
 )
 
 _JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_SHARD_MAP_NAMES = {
+    "jax.experimental.shard_map.shard_map",
+    "jax.shard_map",
+    "shard_map",
+}
 _PARTIAL_NAMES = {"functools.partial", "partial"}
-_UNWRAP_NAMES = {"jax.vmap", "vmap", "jax.checkpoint", "jax.remat"}
+_UNWRAP_NAMES = {
+    "jax.vmap", "vmap", "jax.checkpoint", "jax.remat",
+    # a jitted shard_map unwraps to its body for reachability: host syncs
+    # inside sharded bodies are trace hazards exactly like under plain jit
+    "jax.experimental.shard_map.shard_map", "jax.shard_map", "shard_map",
+}
 
 
 @dataclass
@@ -132,11 +142,11 @@ def _is_partial_of_jit(call: ast.Call, imports: Dict[str, str]) -> bool:
     return resolve_call_root(call.args[0], imports) in _JIT_NAMES
 
 
-def find_jit_sites(module: SourceModule) -> List[JitSite]:
-    imports = import_map(module.tree)
-    sites: List[JitSite] = []
-
-    # enclosing-function tracking for the per-call-jit check
+def _enclosing_map(tree: ast.Module) -> Dict[int, str]:
+    """node id -> qualname of the enclosing function ("" = module scope) —
+    the per-call-construction checks need to know which function a jit/
+    shard_map site lives in.  Shared by find_jit_sites and
+    find_shard_map_sites so the tracking can never drift between them."""
     enclosing_of: Dict[int, str] = {}
 
     def mark(node: ast.AST, qual: List[str]) -> None:
@@ -149,7 +159,14 @@ def find_jit_sites(module: SourceModule) -> List[JitSite]:
                 enclosing_of[id(child)] = ".".join(qual)
                 mark(child, qual)
 
-    mark(module.tree, [])
+    mark(tree, [])
+    return enclosing_of
+
+
+def find_jit_sites(module: SourceModule) -> List[JitSite]:
+    imports = import_map(module.tree)
+    sites: List[JitSite] = []
+    enclosing_of = _enclosing_map(module.tree)
 
     for node in ast.walk(module.tree):
         # decorator sites
@@ -202,5 +219,81 @@ def find_jit_sites(module: SourceModule) -> List[JitSite]:
                 enclosing=enclosing_of.get(id(node), ""),
             )
             _apply_statics(site, node.func)
+            sites.append(site)
+    return sites
+
+
+def _shard_map_kwargs(site: JitSite, call: ast.Call) -> None:
+    """Record shard_map's config expressions (mesh/in_specs/out_specs/
+    check_rep) on the site.  ``mesh`` may also arrive positionally (arg 1 of
+    the direct-call spelling)."""
+    for kw in call.keywords:
+        if kw.arg:
+            site.kwargs[kw.arg] = kw.value
+    if "mesh" not in site.kwargs and len(call.args) >= 2:
+        site.kwargs["mesh"] = call.args[1]
+
+
+def find_shard_map_sites(module: SourceModule) -> List[JitSite]:
+    """``shard_map`` call sites, same spellings as ``find_jit_sites``:
+
+        shard_map(body, mesh=..., in_specs=..., out_specs=...)
+        @functools.partial(shard_map, mesh=..., ...)
+        functools.partial(shard_map, mesh=...)(body)
+
+    Shared by trace-safety (sharded bodies seed jit reachability — a host
+    sync inside one hangs/retraces exactly like under plain jit) and
+    retrace-budget (per-call construction + un-keyed mesh statics,
+    docs/ANALYSIS.md)."""
+    imports = import_map(module.tree)
+    sites: List[JitSite] = []
+    enclosing_of = _enclosing_map(module.tree)
+
+    def _is_partial_of_shard_map(call: ast.Call) -> bool:
+        if resolve_call_root(call.func, imports) not in _PARTIAL_NAMES:
+            return False
+        return bool(call.args) and (
+            resolve_call_root(call.args[0], imports) in _SHARD_MAP_NAMES
+        )
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and (
+                    resolve_call_root(dec.func, imports) in _SHARD_MAP_NAMES
+                    or _is_partial_of_shard_map(dec)
+                ):
+                    site = JitSite(
+                        module=module, lineno=node.lineno, target=None,
+                        decorated=node, jit_call=dec,
+                        enclosing=enclosing_of.get(id(node), ""),
+                    )
+                    _shard_map_kwargs(site, dec)
+                    sites.append(site)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        root = resolve_call_root(node.func, imports)
+        if root in _SHARD_MAP_NAMES and node.args:
+            site = JitSite(
+                module=module, lineno=node.lineno,
+                target=_unwrap_target(node.args[0], imports, module.tree),
+                jit_call=node,
+                enclosing=enclosing_of.get(id(node), ""),
+            )
+            _shard_map_kwargs(site, node)
+            sites.append(site)
+        elif (
+            isinstance(node.func, ast.Call)
+            and _is_partial_of_shard_map(node.func)
+            and node.args
+        ):
+            site = JitSite(
+                module=module, lineno=node.lineno,
+                target=_unwrap_target(node.args[0], imports, module.tree),
+                jit_call=node.func,
+                enclosing=enclosing_of.get(id(node), ""),
+            )
+            _shard_map_kwargs(site, node.func)
             sites.append(site)
     return sites

@@ -25,7 +25,7 @@ is a distinct production bug:
   uncached-jit      ``jax.jit(...)`` constructed inside a function that is
                     not memoized (lru_cache): every call builds a fresh
                     wrapper with an empty jit cache, so every call retraces
-                    (the bug class ops.consolidate._sharded_sweep_fn's
+                    (the bug class ops.consolidate._lane_sweep_fn's
                     docstring describes)
 
 The runtime half of this pass lives in tests/conftest.py: a fixture counts
@@ -50,6 +50,7 @@ from karpenter_core_tpu.analysis.jitsites import (
     JitSite,
     _PARTIAL_NAMES,
     find_jit_sites,
+    find_shard_map_sites,
 )
 
 NAME = "retrace-budget"
@@ -80,6 +81,50 @@ def _param_defaults(fn: ast.AST) -> Dict[str, ast.expr]:
         if d is not None:
             out[p.arg] = d
     return out
+
+
+def _is_memoized(fn, imports: Dict[str, str]) -> bool:
+    """The function carries a memoizing decorator (lru_cache/cache) — its
+    per-call jit/shard_map constructions build once per distinct key."""
+    if fn is None or not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        droot = resolve_call_root(
+            dec.func if isinstance(dec, ast.Call) else dec, imports
+        )
+        if droot in _MEMO_DECORATORS:
+            return True
+    return False
+
+
+def _mesh_derives_from_params(mesh_expr: ast.expr, fn: ast.AST) -> bool:
+    """True when a shard_map's mesh expression references (or chases, through
+    one local single-assignment, to an expression referencing) at least one
+    parameter of the enclosing memoized builder — the mesh topology is then
+    part of the memo key by construction (``mesh = mesh_for(mesh_axes)``).
+    A mesh pulled from module scope or a closure is NOT keyed: two
+    topologies would silently share one cached executable."""
+    params = set(_params(fn))
+    if not params:
+        return False
+
+    def names_of(expr: ast.expr):
+        return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+    if names_of(mesh_expr) & params:
+        return True
+    if isinstance(mesh_expr, ast.Name):
+        hits = [
+            node.value
+            for node in ast.walk(fn)
+            if isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == mesh_expr.id
+        ]
+        if len(hits) == 1 and names_of(hits[0]) & params:
+            return True
+    return False
 
 
 def _fn_index(module: SourceModule) -> Dict[str, ast.AST]:
@@ -289,17 +334,7 @@ def run(project: Project) -> List[Finding]:
 
             # per-call jit construction
             if site.enclosing:
-                enclosing_fn = fn_index.get(site.enclosing)
-                memoized = False
-                if enclosing_fn is not None:
-                    for dec in enclosing_fn.decorator_list:
-                        droot = resolve_call_root(
-                            dec.func if isinstance(dec, ast.Call) else dec,
-                            imports,
-                        )
-                        if droot in _MEMO_DECORATORS:
-                            memoized = True
-                if not memoized:
+                if not _is_memoized(fn_index.get(site.enclosing), imports):
                     findings.append(Finding(
                         module.relpath, site.lineno, "uncached-jit",
                         "jax.jit constructed per call inside "
@@ -319,6 +354,42 @@ def run(project: Project) -> List[Finding]:
                 qual = getattr(site.decorated, "name", "")
                 if qual and not site.enclosing:
                     wrappers[f"{module.name}.{qual}"] = (statics, target_params)
+
+        # shard_map sites (the mesh dispatch layer, docs/KERNEL_PERF.md
+        # "Layer 5"): same per-call-construction hazard as jax.jit, plus the
+        # mesh-keying rule — a memoized builder whose shard_map captures a
+        # mesh that does NOT derive from the builder's parameters silently
+        # shares one executable across mesh topologies (the sharded twin of
+        # cache-key-drift)
+        for site in find_shard_map_sites(module):
+            if site.enclosing:
+                enclosing_fn = fn_index.get(site.enclosing)
+                memoized = _is_memoized(enclosing_fn, imports)
+                if not memoized:
+                    findings.append(Finding(
+                        module.relpath, site.lineno, "uncached-jit",
+                        "shard_map constructed per call inside "
+                        f"{site.enclosing!r}: each call builds a fresh "
+                        "sharded wrapper with an empty jit cache and "
+                        "retraces — memoize the builder "
+                        "(functools.lru_cache) or hoist to module scope",
+                        NAME, symbol=site.enclosing,
+                    ))
+                else:
+                    mesh_expr = site.kwargs.get("mesh")
+                    if mesh_expr is not None and not _mesh_derives_from_params(
+                        mesh_expr, enclosing_fn
+                    ):
+                        findings.append(Finding(
+                            module.relpath, site.lineno, "unkeyed-mesh-static",
+                            "shard_map mesh inside memoized builder "
+                            f"{site.enclosing!r} does not derive from the "
+                            "builder's parameters — distinct mesh topologies "
+                            "would share one cached executable; thread the "
+                            "topology through the cache key (e.g. "
+                            "mesh_for(mesh_axes))",
+                            NAME, symbol=site.enclosing,
+                        ))
 
     # unhashable literals at call sites of known jitted wrappers
     for module in project.package_modules:

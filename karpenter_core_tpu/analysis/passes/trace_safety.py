@@ -48,7 +48,10 @@ from karpenter_core_tpu.analysis.core import (
     import_map,
     resolve_call_root,
 )
-from karpenter_core_tpu.analysis.jitsites import find_jit_sites
+from karpenter_core_tpu.analysis.jitsites import (
+    find_jit_sites,
+    find_shard_map_sites,
+)
 
 NAME = "trace-safety"
 
@@ -215,10 +218,13 @@ class _FnChecker:
 
 
 def jit_entry_keys(project: Project, graph: CallGraph) -> List[str]:
-    """Function keys of every jax.jit target in the package."""
+    """Function keys of every jax.jit AND shard_map target in the package —
+    a shard_map body is traced device code exactly like a jitted function
+    (host syncs inside it hang the per-device program), so sharded bodies
+    seed the same reachability set."""
     keys: List[str] = []
     for module in project.package_modules:
-        for site in find_jit_sites(module):
+        for site in find_jit_sites(module) + find_shard_map_sites(module):
             if site.decorated is not None:
                 key = graph.key_for_node(site.decorated)
             elif site.target is not None:

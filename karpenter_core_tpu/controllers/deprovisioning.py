@@ -874,6 +874,10 @@ class MultiNodeConsolidation(_ConsolidationBase):
                 wire_candidates, pending, provisioners,
                 nodes=nodes,
                 claim_drivers=self.provisioning._claim_drivers(bound_pods + pending),
+                # same policy the in-process sweep would run under — remote
+                # lanes score by fleet-cost delta too (PR 9 leftover: the
+                # config previously never crossed the channel)
+                policy=self._policy_config(provisioners),
             )
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.FAILED_PRECONDITION:

@@ -650,6 +650,20 @@ class ProvisioningController:
                 return None  # service judged the batch kernel-unsupported
             tpu_results, new_launchables = remote
         else:
+            # sharded dispatch (docs/KERNEL_PERF.md "Layer 5"): the in-process
+            # solve routes through the shard_map mesh dispatcher whenever
+            # KC_SOLVER_MESH enables it (default: on with >1 device) — the
+            # encode pads the catalog shard-aligned and prepare_encoded
+            # captures the topology, so this controller needs no mesh
+            # plumbing of its own; surface the routing on the span for triage.
+            # (Deliberately NOT computed on the remote branch above: a CPU
+            # controller replica must never initialize a device backend.)
+            from karpenter_core_tpu.parallel import mesh as mesh_mod
+
+            mesh_axes = mesh_mod.solve_mesh_axes()
+            sp = tracing.current()
+            if sp is not None and mesh_axes is not None:
+                sp.set(**{"solve.mesh": repr(mesh_axes)})
             try:
                 tpu_results = self._solve_in_process(
                     solver, tpu_classes, state_nodes, bound_pods
@@ -807,6 +821,11 @@ class ProvisioningController:
                 daemonset_pods=daemonset_pods,
                 claim_drivers=self._claim_drivers(tpu_pods + shipped_bound),
                 members=members,
+                # the replica's resolved policy config rides the wire: the
+                # remote objective stage must select offerings exactly like
+                # an in-process solve would (it previously fell back
+                # silently to first-fit — PolicyConfig never crossed)
+                policy=solver.policy,
             )
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.FAILED_PRECONDITION:

@@ -682,13 +682,24 @@ def encode_snapshot(
     cache_host: Optional[object] = None,
     extra_host_ports: Optional[List[tuple]] = None,
     classes: Optional[List[PodClass]] = None,
+    catalog_pad_multiple: int = 1,
 ) -> EncodedSnapshot:
     """Encode a solve input.  ``templates`` must be weight-ordered (the order
     is the kernel's template preference order, scheduler.go:174-219).
     ``extra_requirement_sets`` widen the vocabulary (e.g. existing-node label
     values, which must be representable for NotIn semantics to stay exact).
     ``classes`` short-circuits classification when the caller maintains pod
-    classes incrementally (models.columnar.PodIngest)."""
+    classes incrementally (models.columnar.PodIngest).
+
+    ``catalog_pad_multiple`` emits the instance-type axis shard-aligned: the
+    I extent pads up to a multiple of the solve mesh's catalog axis
+    (parallel.mesh.catalog_pad_multiple, threaded by TPUSolver) with INERT
+    sentinel types — ``~catalog-pad-N`` names, no offerings, zero
+    allocatable/capacity, excluded from every template catalog — so the
+    shard_map dispatcher's even-split requirement is met at encode time and
+    every downstream consumer (decode, store digests, policy planes, the
+    wire) sees one consistent padded extent.  Padded columns can never be
+    viable; the solve is bit-identical to the unpadded encode's."""
     if classes is None:
         classes = classify_pods(pods)
     classes = _with_prefer_no_schedule_rungs(classes, templates)
@@ -709,6 +720,11 @@ def encode_snapshot(
                 it_index[it.name] = len(all_its)
                 all_its.append(it)
     it_names = [it.name for it in all_its]
+    # shard-aligned catalog extent (docstring): inert sentinel types fill the
+    # tail so the mesh's catalog axis divides I evenly
+    pad_multiple = max(int(catalog_pad_multiple or 1), 1)
+    n_pad_types = ((-len(it_names)) % pad_multiple) if it_names else 0
+    it_names += [f"~catalog-pad-{j}" for j in range(n_pad_types)]
 
     zones: List[str] = []
     capacity_types: List[str] = []
@@ -782,7 +798,7 @@ def encode_snapshot(
     # catalog planes only depend on the vocabulary content + catalog +
     # resource/zone/ct axes — identical across reconcile loops, so cache them
     # (cache_host carries the dict across encodes, e.g. a TPUSolver)
-    I, Z, CT, R = len(all_its), len(zones), len(capacity_types), len(resources)
+    I, Z, CT, R = len(it_names), len(zones), len(capacity_types), len(resources)
     cache = getattr(cache_host, "_catalog_cache", None) if cache_host is not None else None
     cache_key = (
         tuple(vocab.keys),
@@ -814,6 +830,30 @@ def encode_snapshot(
         snap.it_mask, snap.it_defined, snap.it_negative, snap.it_gt, snap.it_lt = (
             np.stack([p[j] for p in it_planes]) for j in range(5)
         )
+        if n_pad_types:
+            # inert ReqTensor rows for the sentinel types: nothing defined, so
+            # every compatibility check skips them (they are also excluded
+            # from availability/templates below — belt and suspenders).
+            # Fill values MATCH ops.solve.pad_catalog's row-padding convention
+            # (mask=False, defined=False, ±inf bounds) so the two padding
+            # paths can never diverge on the tail even if the kernel ever
+            # starts consulting mask where defined is False.
+            K, W = snap.it_mask.shape[1], snap.it_mask.shape[2]
+            snap.it_mask = np.concatenate(
+                [snap.it_mask, np.zeros((n_pad_types, K, W), dtype=bool)]
+            )
+            snap.it_defined = np.concatenate(
+                [snap.it_defined, np.zeros((n_pad_types, K), dtype=bool)]
+            )
+            snap.it_negative = np.concatenate(
+                [snap.it_negative, np.zeros((n_pad_types, K), dtype=bool)]
+            )
+            snap.it_gt = np.concatenate(
+                [snap.it_gt, np.full((n_pad_types, K), -np.inf, dtype=np.float32)]
+            )
+            snap.it_lt = np.concatenate(
+                [snap.it_lt, np.full((n_pad_types, K), np.inf, dtype=np.float32)]
+            )
         zone_idx2 = {z: i for i, z in enumerate(zones)}
         ct_idx2 = {c: i for i, c in enumerate(capacity_types)}
         for i, it in enumerate(all_its):

@@ -247,6 +247,32 @@ class RepairPlan(NamedTuple):
     base_inv_full: jnp.ndarray  # i32[G1, Z] inverse-ownership counts
 
 
+def _imax(x: jnp.ndarray, statics: "Statics") -> jnp.ndarray:
+    """Finish a reduction over the catalog (instance-type) axis.
+
+    The local ``jnp.max`` already ran; when the solve executes inside a
+    ``shard_map`` with the catalog sharded (parallel.mesh dispatch), every
+    device holds only its I-shard's partial maximum and this inserts the
+    cross-shard ``lax.pmax``.  Unsharded solves pass ``catalog_axis=None``
+    and this is the identity — the single-chip path is literally the same
+    code (docs/KERNEL_PERF.md "Layer 5").  max over i32/f32 is exactly
+    associative, so the sharded solve stays BIT-IDENTICAL to single-device.
+    """
+    if statics.catalog_axis is not None:
+        x = jax.lax.pmax(x, statics.catalog_axis)
+    return x
+
+
+def _isum(x: jnp.ndarray, statics: "Statics") -> jnp.ndarray:
+    """Cross-shard ``lax.psum`` over the catalog axis (see ``_imax``).  Only
+    used for integer-valued f32 counts (einsum of 0/1 products), whose
+    partial sums are exact in f32 — summation order cannot change the bits.
+    """
+    if statics.catalog_axis is not None:
+        x = jax.lax.psum(x, statics.catalog_axis)
+    return x
+
+
 def _water_fill(count0: jnp.ndarray, allowed: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
     """i32[Z] quotas: distribute m pods over allowed zones, always filling the
     lowest-count zone first — the telescoped form of the reference's per-pod
@@ -447,6 +473,9 @@ class Statics(NamedTuple):
     key_has_bounds: Tuple[bool, ...]  # python tuple -> static per-key branching
     packed: bool = False  # mask planes are uint32 words (ops/masks.py pack_mask)
     mask_v: int = 0  # semantic slot count V+1 (only meaningful when packed)
+    # mesh axis name the catalog (I) planes are sharded over inside a
+    # shard_map body (parallel.mesh); None = unsharded, no collectives traced
+    catalog_axis: "Optional[str]" = None
 
 
 class StaticArrays(NamedTuple):
@@ -692,7 +721,7 @@ def _phase(
     )  # [N, I]
     cap_ni = _capacity(state.used, cls.requests, statics)
     cap_ni = jnp.where(it_ok, cap_ni, 0)
-    cap_n = jnp.max(cap_ni, axis=-1)  # [N]
+    cap_n = _imax(jnp.max(cap_ni, axis=-1), statics)  # [N]
 
     elig = (
         state.open_
@@ -775,7 +804,7 @@ def _phase(
     )  # [T, I]
     t_cap_ti = _capacity(statics.tmpl_daemon, cls.requests, statics)
     t_cap_ti = jnp.where(t_it_ok, t_cap_ti, 0)
-    t_cap = jnp.max(t_cap_ti, axis=-1)  # [T]
+    t_cap = _imax(jnp.max(t_cap_ti, axis=-1), statics)  # [T]
     t_viable = (
         cls.tol
         & tmpl_key_ok
@@ -796,9 +825,9 @@ def _phase(
     # provisioner-limit budget: opening a node pessimistically consumes the
     # largest surviving instance type (subtractMax), so the batch of openings
     # is capped by floor(remaining / max_capacity) per limited resource
-    max_cap_star = jnp.max(
+    max_cap_star = _imax(jnp.max(
         jnp.where(t_it_ok[t_star][:, None], statics.it_capacity, 0.0), axis=0
-    )  # [R]
+    ), statics)  # [R]
     rem_star = remaining[t_star]  # [R]
     budget_per_r = jnp.where(
         jnp.isfinite(rem_star) & (max_cap_star > 0),
@@ -1129,7 +1158,10 @@ def _class_step(
                     elig & zone_has_new[:, z], jnp.minimum(cap_z, host_cap_new), 0
                 )
                 cap_z_list.append(cap_z)
-            cap_open_z = jnp.stack(cap_z_list)  # [Z, N]
+            # one cross-shard max for the whole [Z, N] block: the clamps above
+            # (host-port cap, eligibility mask, host_cap_new) all commute with
+            # pmax — replicated operands, monotone ops over nonnegative caps
+            cap_open_z = _imax(jnp.stack(cap_z_list), statics)  # [Z, N]
             viable_nzi = jnp.stack(viable_z_list, axis=1)  # [N, Z, I]
             priority = state_i.pod_count * n_new_slots + jnp.arange(
                 n_new_slots, dtype=jnp.int32
@@ -1197,7 +1229,7 @@ def _class_step(
                 )
                 t_it_ok = t_base & ovt & within
                 t_cap_ti = jnp.where(t_it_ok, t_cap_ti0, 0)
-                t_cap = jnp.max(t_cap_ti, axis=-1)
+                t_cap = _imax(jnp.max(t_cap_ti, axis=-1), statics)
                 t_viable = cls.tol & tmpl_key_ok & tz & t_ct_any & (t_cap > 0)
                 t_star = jnp.argmax(t_viable)
                 t_ok = t_viable[t_star]
@@ -1207,9 +1239,9 @@ def _class_step(
                 per_node = jnp.maximum(per_node, 1)
                 n_new = jnp.where(t_ok & (rem_pods > 0), -(-rem_pods // per_node), 0)
                 n_new = jnp.minimum(n_new, n_new_slots - n_next)
-                max_cap_star = jnp.max(
+                max_cap_star = _imax(jnp.max(
                     jnp.where(t_it_ok[t_star][:, None], statics.it_capacity, 0.0), axis=0
-                )
+                ), statics)
                 rem_star = rem[t_star]
                 budget_per_r = jnp.where(
                     jnp.isfinite(rem_star) & (max_cap_star > 0),
@@ -1362,14 +1394,16 @@ def _class_step(
     # existing node with intake left sits in) — used by spread quotas and the
     # affinity bootstrap below
     if ft.zone_spread or ft.zone_affinity:
-        tmpl_offers = jnp.einsum(
+        # the einsum's i-contraction is partial per catalog shard; psum of the
+        # integer-valued f32 partials is exact, so the >0.5 test is unmoved
+        tmpl_offers = _isum(jnp.einsum(
             "ti,izc,tz,tc->z",
             statics.tmpl_it.astype(jnp.bfloat16),
             (statics.it_avail & cls.it[:, None, None]).astype(jnp.bfloat16),
             statics.tmpl_zone.astype(jnp.bfloat16),
             (statics.tmpl_ct & cls.ct[None, :]).astype(jnp.bfloat16),
             preferred_element_type=jnp.float32,
-        ) > 0.5  # [Z]
+        ), statics) > 0.5  # [Z]
         ex_cap_spread = ex_prep.cap if ok_ex is None else jnp.where(ok_ex, ex_prep.cap, 0)
         # per-zone intake for this class: existing nodes contribute their
         # remaining intake; template zones open new nodes on demand (unbounded).
@@ -1616,10 +1650,21 @@ def solve_core(
     packed_masks: bool = True,
     warm_carry: "Optional[WarmCarry]" = None,
     repair_plan: "Optional[RepairPlan]" = None,
+    catalog_axis: "Optional[str]" = None,
 ):
     """Unjitted kernel core — jit/vmap/shard_map-composable (the parallel layer
     vmaps this over snapshot replicas and consolidation subsets;
     __graft_entry__ compile-checks it).
+
+    ``catalog_axis`` (static) names the mesh axis the catalog (instance-type)
+    planes are sharded over when this body runs inside a ``shard_map``
+    (parallel.mesh dispatch): every I-axis reduction finishes with a
+    ``pmax``/``psum`` collective over that axis (``_imax``/``_isum``), all of
+    them exact, so the sharded solve is bit-identical to the single-device
+    solve.  None (the default; the auto mesh config resolves to it on a
+    single device) traces no collectives at all, while a FORCED 1-device
+    mesh keeps them as singleton no-ops — the degenerate case is the same
+    code either way.
 
     ``n_passes`` > 1 re-scans still-failed pods seeded by earlier passes'
     topology counts — the kernel's equivalent of the host queue re-pushing
@@ -1669,7 +1714,8 @@ def solve_core(
             mask=mask_ops.pack_mask(class_tensors.mask)
         )
     statics = Statics(
-        *sa, key_has_bounds=key_has_bounds, packed=packed_masks, mask_v=width
+        *sa, key_has_bounds=key_has_bounds, packed=packed_masks, mask_v=width,
+        catalog_axis=catalog_axis,
     )
     n_zones = statics.tmpl_zone.shape[-1]
     n_res = statics.it_alloc.shape[-1]
@@ -2072,9 +2118,14 @@ def unpack_bool(packed: np.ndarray, m: int) -> np.ndarray:
     return bits[..., :m].astype(bool)
 
 
-def node_prices(state: NodeState, it_price: jnp.ndarray) -> jnp.ndarray:
+def node_prices(state: NodeState, it_price: jnp.ndarray,
+                catalog_axis: "Optional[str]" = None) -> jnp.ndarray:
     """f32[N]: min over (viable instance type, allowed zone, allowed ct) of
-    offering price; +inf when no offering, 0 for closed slots."""
+    offering price; +inf when no offering, 0 for closed slots.
+
+    ``catalog_axis``: inside a shard_map body with the catalog sharded, the
+    viable/price planes are local I-shards — the min finishes with an exact
+    cross-shard ``pmin`` (parallel.mesh lane sweep)."""
     # price[i, z, ct] -> restrict to node's viable/zone/ct masks
     allowed = (
         state.viable[:, :, None, None]
@@ -2083,6 +2134,8 @@ def node_prices(state: NodeState, it_price: jnp.ndarray) -> jnp.ndarray:
     )
     priced = jnp.where(allowed, it_price[None, :, :, :], jnp.inf)
     best = jnp.min(priced, axis=(1, 2, 3))
+    if catalog_axis is not None:
+        best = jax.lax.pmin(best, catalog_axis)
     return jnp.where(state.open_ & (state.pod_count > 0), best, 0.0)
 
 
@@ -2112,10 +2165,14 @@ def features_with_existing(snapshot, ex_static) -> SnapshotFeatures:
     return f
 
 
-def solve(snapshot: EncodedSnapshot, n_slots: int = 0) -> SolveOutputs:
+def solve(snapshot: EncodedSnapshot, n_slots: int = 0,
+          mesh_axes="auto") -> SolveOutputs:
     """Run the kernel on an encoded snapshot.  ``n_slots`` defaults to a
     rounded estimate; if slots run out (failed>0 with n_next==n_slots) the
-    caller should retry with more (solver.tpu handles this)."""
+    caller should retry with more (solver.tpu handles this).  ``mesh_axes``
+    rides through to compilecache.run_solve: ``"auto"`` (default) follows
+    KC_SOLVER_MESH onto the sharded dispatch path, ``None`` pins the
+    single-device program (parity baselines)."""
     from karpenter_core_tpu import tracing
     from karpenter_core_tpu.utils import compilecache
 
@@ -2127,6 +2184,7 @@ def solve(snapshot: EncodedSnapshot, n_slots: int = 0) -> SolveOutputs:
         host_cls, host_statics, n_slots, key_has_bounds,
         n_passes=snapshot.scan_passes,
         features=snapshot_features(snapshot),
+        mesh_axes=mesh_axes,
     )
 
 
@@ -2271,6 +2329,45 @@ def estimate_slots(snapshot: EncodedSnapshot) -> int:
 #
 # The reference has no analog (Go recompiles nothing); this is TPU operational
 # parity, same motive as utils.compilecache.
+
+
+def pad_catalog(cls, statics_arrays, multiple: int, it_price=None):
+    """Pad the instance-type (I) axis of prepared host planes to a multiple of
+    the mesh's catalog axis with INERT types: no availability, zero
+    allocatable/capacity, excluded from every template and class mask, and
+    (when a price sheet rides along) +inf price.  Padded columns can never be
+    viable, so the padded solve is bit-identical to the unpadded one on the
+    real columns — the shard_map dispatcher (parallel.mesh) requires the
+    sharded axis to divide evenly.  Production snapshots are already encoded
+    shard-aligned (models.snapshot.encode_snapshot ``catalog_pad_multiple``);
+    this is the safety net for planes prepared outside that path.
+
+    Returns (cls, statics_arrays[, it_price]) unchanged when the axis already
+    divides."""
+    sa = StaticArrays(*statics_arrays)
+    i0 = np.asarray(sa.it_alloc).shape[0]
+    i_new = -(-max(i0, 1) // max(multiple, 1)) * max(multiple, 1)
+    if i_new == i0:
+        return (cls, sa) if it_price is None else (cls, sa, it_price)
+    it = sa.it
+    it_p = mask_ops.ReqTensor(
+        mask=_pad_axis(np.asarray(it.mask), 0, i_new, False),
+        defined=_pad_axis(np.asarray(it.defined), 0, i_new, False),
+        negative=_pad_axis(np.asarray(it.negative), 0, i_new, False),
+        gt=_pad_axis(np.asarray(it.gt), 0, i_new, -np.inf),
+        lt=_pad_axis(np.asarray(it.lt), 0, i_new, np.inf),
+    )
+    sa = sa._replace(
+        it=it_p,
+        it_alloc=_pad_axis(np.asarray(sa.it_alloc), 0, i_new, 0.0),
+        it_avail=_pad_axis(np.asarray(sa.it_avail), 0, i_new, False),
+        tmpl_it=_pad_axis(np.asarray(sa.tmpl_it), 1, i_new, False),
+        it_capacity=_pad_axis(np.asarray(sa.it_capacity), 0, i_new, 0.0),
+    )
+    cls = cls._replace(it=_pad_axis(np.asarray(cls.it), 1, i_new, False))
+    if it_price is None:
+        return cls, sa
+    return cls, sa, _pad_axis(np.asarray(it_price), 0, i_new, np.inf)
 
 
 def bucket(n: int, floor: int = 8) -> int:

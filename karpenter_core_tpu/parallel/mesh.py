@@ -1,33 +1,241 @@
-"""Device-mesh parallelism for the solver.
+"""Device-mesh parallelism for the solver — the PRODUCTION dispatch layer.
 
 The reference has no collective layer (its "distributed backend" is the
 kube-apiserver watch plane, SURVEY.md §2.11/§5.8); the TPU-native design adds
-one where the problem is data-parallel:
+one wherever the problem is data-parallel:
 
+  - **Sharded production solve** (the default path on >1 device,
+    ``KC_SOLVER_MESH``): every provisioning/repair solve runs as a
+    ``shard_map`` over the device mesh with the CATALOG (instance-type) axis
+    sharded and the pod/class planes replicated.  Per class step the hot
+    planes are [N slots, I types] with per-I independence; the kernel's few
+    I-axis reductions finish with exact pmax/psum collectives
+    (ops.solve._imax/_isum), so the sharded solve is BIT-IDENTICAL to the
+    single-device solve — and a 1-device mesh is the degenerate case running
+    literally the same code.  ``partition_specs`` assigns specs to the solve
+    pytrees by regex over leaf paths (the partition-rule pattern).
+  - **Consolidation lane sweep**: the subset-prefix simulations
+    (ops.consolidate.sweep) split across the mesh's second ``lane`` axis
+    while each lane group shards the catalog — one 2D shard_map answers the
+    whole largest-valid-prefix search.
   - **Monte-Carlo what-if** (BASELINE config 5): vmap the solve kernel over
     perturbed snapshot replicas (spot-interruption scenarios), sharded across
     the mesh's ``replica`` axis; cost statistics reduce over ICI with psum.
-  - **Consolidation subset search** (BASELINE config 3): vmap the simulation
-    over candidate node subsets, sharded the same way (ops.consolidate).
 
-Multi-slice scaling note: the replica/subset axes are embarrassingly parallel,
-so cross-slice traffic is one scalar reduction per solve — lay the mesh's
-replica axis over DCN and everything else rides ICI.
+Multi-slice scaling note: the replica/lane axes are embarrassingly parallel,
+so lay them over DCN; the catalog axis's per-step collectives are tiny
+([N]-vector max/sum) and ride ICI.
+
+Flags (docs/KERNEL_PERF.md "Layer 5"):
+
+    KC_SOLVER_MESH=1|0       force the sharded path on/off; unset = auto
+                             (on when the backend exposes >1 device)
+    KC_SOLVER_MESH_DEVICES   cap the devices the solve mesh uses
+    KC_SOLVER_MESH_SHAPE     "CxL" catalog×lane split for the sweep mesh
+                             (default: lanes=2 when the count allows)
 """
 
 from __future__ import annotations
 
 import functools
+import os
+import re
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from karpenter_core_tpu.models.snapshot import EncodedSnapshot
 from karpenter_core_tpu.ops import solve as solve_ops
 from karpenter_core_tpu.utils import compilecache
+
+CATALOG_AXIS = "catalog"
+LANE_AXIS = "lane"
+
+# partition rules: leaf-path regex -> the axis index the catalog shards.
+# Applied to every solve pytree (ClassTensors/StaticArrays/NodeState/
+# WarmCarry/SolveOutputs...) — unmatched leaves replicate.
+# ``.it.<field>`` is the catalog ReqTensor ([I, K, ...]); bare ``.it`` is
+# ClassTensors.it ([C, I]); ``.viable`` covers NodeState in carries and
+# outputs alike, which is what lets the warm-start repair reuse the same
+# rule set for its carry pytrees.  (The lane sweep's per-lane outputs add a
+# leading lane axis and build their specs by hand —
+# ops.consolidate._lane_sweep_fn.)
+CATALOG_PARTITION_RULES: Tuple[Tuple[str, int], ...] = (
+    (r"\.it\.(mask|defined|negative|gt|lt)$", 0),
+    (r"\.(it_alloc|it_avail|it_capacity|it_price)$", 0),
+    (r"\.tmpl_it$", 1),
+    (r"\.it$", 1),
+    (r"\.viable$", 1),
+)
+
+
+def named_tree_map(fn, tree, path: str = ""):
+    """tree_map with dotted field paths for namedtuple pytrees (the named
+    partition-rule pattern): ``fn(path, leaf) -> leaf'``.  None subtrees pass
+    through (optional ex/warm planes)."""
+    if tree is None:
+        return None
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):
+        return type(tree)(*(
+            named_tree_map(fn, getattr(tree, f), f"{path}.{f}")
+            for f in tree._fields
+        ))
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(
+            named_tree_map(fn, v, f"{path}[{i}]") for i, v in enumerate(tree)
+        )
+    return fn(path, tree)
+
+
+def _spec_for(path: str, axis_name: str):
+    for pattern, axis in CATALOG_PARTITION_RULES:
+        if re.search(pattern, path):
+            return P(*([None] * axis), axis_name)
+    return P()
+
+
+def partition_specs(tree, axis_name: str = CATALOG_AXIS):
+    """PartitionSpec pytree for a solve pytree: catalog-indexed leaves shard
+    over ``axis_name`` (CATALOG_PARTITION_RULES), the rest replicate."""
+    return named_tree_map(lambda p, _leaf: _spec_for(p, axis_name), tree)
+
+
+def mesh_shardings(tree, mesh: Mesh, axis_name: str = CATALOG_AXIS):
+    """NamedSharding pytree mirroring ``partition_specs`` — the device_put
+    layout for uploading solve inputs onto the mesh."""
+    return named_tree_map(
+        lambda p, _leaf: NamedSharding(mesh, _spec_for(p, axis_name)), tree
+    )
+
+
+# -- production mesh configuration -------------------------------------------
+
+
+def _env_tristate(name: str) -> Optional[bool]:
+    raw = os.environ.get(name)
+    # empty string = unset = AUTO (the chart's documented "" default rides
+    # through as an env var set to ""), not forced-off
+    if raw is None or raw == "":
+        return None
+    return raw not in ("0", "false", "False")
+
+
+def _mesh_device_count() -> int:
+    """Devices the solve mesh may use.  Reads ``jax.devices()`` — callers gate
+    on the env kill switch first so KC_SOLVER_MESH=0 never initializes a
+    backend."""
+    n = len(jax.devices())
+    cap = os.environ.get("KC_SOLVER_MESH_DEVICES")
+    if cap:
+        try:
+            n = max(1, min(n, int(cap)))
+        except ValueError:
+            pass
+    return n
+
+
+def solve_mesh_axes() -> Optional[Tuple[Tuple[str, int], ...]]:
+    """The production solve mesh topology, or None for the unsharded path.
+
+    ``KC_SOLVER_MESH=0`` → None; ``=1`` → a catalog mesh over the available
+    devices (1-device degenerate mesh included — same code, singleton
+    collectives); unset → AUTO, on exactly when the backend exposes more
+    than one device.  The returned hashable descriptor — not a Mesh — is
+    what rides the compile-cache key (one warm executable per topology);
+    ``mesh_for`` reconstructs the Mesh deterministically from it."""
+    forced = _env_tristate("KC_SOLVER_MESH")
+    if forced is False:
+        return None
+    n = _mesh_device_count()
+    if forced is None and n <= 1:
+        return None
+    return ((CATALOG_AXIS, n),)
+
+
+def lane_mesh_axes() -> Optional[Tuple[Tuple[str, int], ...]]:
+    """The 2D (catalog × lane) sweep mesh topology, or None.  Enabled by the
+    same switch as the solve mesh; ``KC_SOLVER_MESH_SHAPE=CxL`` pins the
+    split, default peels a lane axis of 2 off an even device count ≥ 4 so
+    consolidation prefixes evaluate in parallel WITH catalog sharding.
+
+    A pinned shape's catalog axis must DIVIDE the solve mesh size: the
+    encode pads the catalog to multiples of the solve mesh
+    (``catalog_pad_multiple``), so any other split would fail the even-split
+    check on every snapshot and silently degrade the sweep to lanes-only —
+    reject it up front and fall back to the default split instead."""
+    axes = solve_mesh_axes()
+    if axes is None:
+        return None
+    n = axes[0][1]
+    shape = os.environ.get("KC_SOLVER_MESH_SHAPE", "")
+    if shape:
+        try:
+            c, lanes = (int(v) for v in shape.lower().split("x"))
+            if c * lanes <= n and c >= 1 and lanes >= 1 and n % c == 0:
+                return ((CATALOG_AXIS, c), (LANE_AXIS, lanes))
+        except ValueError:
+            pass
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "KC_SOLVER_MESH_SHAPE=%r rejected (needs CxL with C*L <= %d and "
+            "C dividing %d); using the default split", shape, n, n,
+        )
+    lanes = 2 if n >= 4 and n % 2 == 0 else 1
+    return ((CATALOG_AXIS, n // lanes), (LANE_AXIS, lanes))
+
+
+def catalog_pad_multiple() -> int:
+    """The multiple the encode pads the instance-type axis to so every mesh
+    topology in play divides it (models.snapshot.encode_snapshot).  The lane
+    mesh's catalog axis divides the solve mesh's, so the solve mesh size is
+    the binding constraint."""
+    axes = solve_mesh_axes()
+    return axes[0][1] if axes is not None else 1
+
+
+@functools.lru_cache(maxsize=8)
+def mesh_for(mesh_axes: Tuple[Tuple[str, int], ...]) -> Mesh:
+    """Deterministic Mesh for a topology descriptor: the first prod(sizes)
+    devices of ``jax.devices()`` reshaped to the axis sizes.  Cached so every
+    consumer of one topology shares one Mesh object (and jit caches key
+    consistently on it)."""
+    names = tuple(name for name, _ in mesh_axes)
+    sizes = tuple(size for _, size in mesh_axes)
+    total = int(np.prod(sizes))
+    devices = jax.devices()
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {mesh_axes} needs {total} devices, have {len(devices)}"
+        )
+    return Mesh(np.array(devices[:total]).reshape(sizes), names)
+
+
+def sharded_solve_callable(mesh_axes, base_with_axis, base_plain, structs):
+    """jit(shard_map(...)) over the solve pytrees for one mesh topology.
+
+    ``base_with_axis`` is the solve_core partial with
+    ``catalog_axis=CATALOG_AXIS`` (collectives traced); ``base_plain`` the
+    axis-free twin used only to eval_shape the output structure (outside the
+    mesh no axis name is bound).  ``structs`` are the positional arg pytrees
+    (ShapeDtypeStructs or arrays).  Returns the jitted callable; the caller
+    memoizes (utils.compilecache keys it by topology + leaf signatures)."""
+    mesh = mesh_for(mesh_axes)
+    in_specs = tuple(partition_specs(s) for s in structs)
+    out_specs = partition_specs(jax.eval_shape(base_plain, *structs))
+    return jax.jit(shard_map(
+        base_with_axis, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        # replicated out_specs are guaranteed by construction (every
+        # cross-shard reduction is an exact collective inside the body);
+        # check_rep's rewrite machinery cannot see through the class scan,
+        # so the static claim stands in for it — the mesh parity fuzz
+        # (tests/test_mesh_dispatch.py) pins the guarantee at runtime
+        check_rep=False,
+    ))
 
 
 def default_mesh(n_devices: Optional[int] = None, axis: str = "replica") -> Mesh:
@@ -58,15 +266,6 @@ def default_mesh_2d(
     return Mesh(np.array(devices[: r * l]).reshape(r, l), axes)
 
 
-def _pad_i_axis(arr, axis: int, target: int, value):
-    pad = target - arr.shape[axis]
-    if pad <= 0:
-        return jnp.asarray(arr)
-    widths = [(0, 0)] * arr.ndim
-    widths[axis] = (0, pad)
-    return jnp.pad(jnp.asarray(arr), widths, constant_values=value)
-
-
 def solve_catalog_sharded(
     snapshot: EncodedSnapshot,
     mesh: Optional[Mesh] = None,
@@ -74,113 +273,45 @@ def solve_catalog_sharded(
     n_slots: int = 0,
 ):
     """The PROVISIONING solve with the catalog (instance-type) axis sharded
-    across the mesh (VERDICT r4 #7 / BASELINE config 4).
+    across the mesh (VERDICT r4 #7 / BASELINE config 4) — now a thin wrapper
+    over the PRODUCTION shard_map dispatcher (utils.compilecache.run_solve
+    with ``mesh_axes``), kept as the named dryrun entry __graft_entry__ and
+    the parity suites call.
 
     Why the catalog axis: class dedup collapses the pod axis to ~a dozen
     classes regardless of pod count (models/snapshot.py docstring), and the
     class scan's carry is inherently sequential — but per class step the hot
     planes are [N slots, I instance types] with per-I independence
-    (_it_intersects, _capacity, _offering_ok) and only max/any reductions
-    over I.  Annotating the I-indexed inputs with a NamedSharding and letting
-    GSPMD propagate yields per-device [N, I/D] compute with one small
-    collective per reduction — the scaling-book recipe (mesh + annotations,
-    XLA inserts collectives), no kernel changes.
+    (_it_intersects, _capacity, _offering_ok) and only max/any/or reductions
+    over I.  The shard_map body computes per-device [N, I/D] planes and
+    finishes each reduction with one exact collective
+    (ops.solve._imax/_isum), so the result is BIT-IDENTICAL to the
+    single-device solve; bit-packed masks compose transparently (packing is
+    elementwise over the trailing slot axis, per catalog row).
 
-    The catalog pads to a device multiple with inert instance types (no
-    availability, zero allocatable, excluded from every template/class mask).
-    Returns SolveOutputs identical to the single-device solve — decode sees
-    the same planes (padded I tail is never viable).
-
-    Bit-packed masks compose transparently: the shardings below annotate the
-    HOST-layout bool planes, and solve_core packs them to uint32 words inside
-    the jitted program — an elementwise transform over the trailing slot axis,
-    so GSPMD keeps the I-axis partition for the packed catalog words and the
-    word-wide AND reductions stay collective-free (__graft_entry__'s dry run
-    asserts exact parity vs the single-device solve).
-    """
+    The catalog pads to a device multiple with inert instance types
+    (ops.solve.pad_catalog) — the padded I tail is never viable, so decode
+    sees the same placements."""
     if mesh is None:
         mesh = default_mesh(axis=axis)
     if axis not in mesh.axis_names:
         axis = mesh.axis_names[-1]
-    # pad to the SHARDING axis size, not the total device count — on a 2D
-    # mesh P(axis) only splits the catalog that many ways
+    # shard as many ways as the given mesh's sharding axis — on a 2D mesh
+    # P(axis) only splits the catalog that many ways
     axis_size = int(mesh.shape[axis])
     if n_slots <= 0:
         n_slots = solve_ops.estimate_slots(snapshot)
 
     cls, statics_arrays, key_has_bounds = solve_ops.prepare_host(snapshot)
-    i0 = statics_arrays.it_alloc.shape[0]
-    i_pad = -(-i0 // axis_size) * axis_size
-
-    it = statics_arrays.it
-    it_padded = type(it)(
-        mask=_pad_i_axis(it.mask, 0, i_pad, False),
-        defined=_pad_i_axis(it.defined, 0, i_pad, False),
-        negative=_pad_i_axis(it.negative, 0, i_pad, False),
-        gt=_pad_i_axis(it.gt, 0, i_pad, -np.inf),
-        lt=_pad_i_axis(it.lt, 0, i_pad, np.inf),
+    cls, statics_arrays = solve_ops.pad_catalog(cls, statics_arrays, axis_size)
+    out = compilecache.run_solve(
+        cls, statics_arrays, n_slots, key_has_bounds,
+        n_passes=snapshot.scan_passes,
+        features=solve_ops.snapshot_features(snapshot),
+        mesh_axes=((CATALOG_AXIS, axis_size),),
     )
-    statics_padded = statics_arrays._replace(
-        it=it_padded,
-        it_alloc=_pad_i_axis(statics_arrays.it_alloc, 0, i_pad, 0.0),
-        it_avail=_pad_i_axis(statics_arrays.it_avail, 0, i_pad, False),
-        tmpl_it=_pad_i_axis(statics_arrays.tmpl_it, 1, i_pad, False),
-        it_capacity=_pad_i_axis(statics_arrays.it_capacity, 0, i_pad, 0.0),
-    )
-    cls_padded = cls._replace(it=_pad_i_axis(cls.it, 1, i_pad, False))
-
-    shard_i = NamedSharding(mesh, P(axis))
-    shard_i_ax1 = NamedSharding(mesh, P(None, axis))
-    replicated = NamedSharding(mesh, P())
-
-    # sharding pytrees mirroring the inputs: I-indexed leaves partitioned,
-    # everything else replicated (GSPMD propagates through the scan)
-    statics_shardings = jax.tree_util.tree_map(
-        lambda _: replicated, statics_padded
-    )._replace(
-        it=type(it)(
-            mask=shard_i, defined=shard_i, negative=shard_i, gt=shard_i, lt=shard_i
-        ),
-        it_alloc=shard_i,
-        it_avail=shard_i,
-        tmpl_it=shard_i_ax1,
-        it_capacity=shard_i,
-    )
-    cls_shardings = jax.tree_util.tree_map(
-        lambda _: replicated, cls_padded
-    )._replace(it=shard_i_ax1)
-
-    with mesh:
-        cls_dev = jax.device_put(cls_padded, cls_shardings)
-        statics_dev = jax.device_put(statics_padded, statics_shardings)
-        fn = _catalog_solve_fn(
-            key_has_bounds, n_slots, snapshot.scan_passes,
-            compilecache.snap_features(solve_ops.snapshot_features(snapshot)),
-            cls_shardings, statics_shardings,
-        )
-        out = fn(cls_dev, statics_dev)
-        jax.block_until_ready(out)
+    jax.block_until_ready(out)
     return out
-
-
-@functools.lru_cache(maxsize=16)
-def _catalog_solve_fn(key_has_bounds, n_slots: int, n_passes: int, features,
-                      cls_shardings, statics_shardings):
-    """Cached jitted catalog-sharded solve — a fresh ``jax.jit`` per call
-    would defeat JAX's compile cache (keyed on callable identity) and retrace
-    every solve (same pattern as ops.consolidate._sharded_sweep_fn; the
-    sharding pytrees are NamedSharding namedtuples, hashable and
-    mesh-identifying, so they key the cache instead of the mesh itself)."""
-    return jax.jit(
-        functools.partial(
-            solve_ops.solve_core,
-            n_slots=n_slots,
-            key_has_bounds=key_has_bounds,
-            n_passes=n_passes,
-            features=features,
-        ),
-        in_shardings=(cls_shardings, statics_shardings),
-    )
 
 
 def perturb_spot_availability(
@@ -370,7 +501,7 @@ def _crossed_grid_fn(mesh, key_has_bounds, n_slots: int, n_passes: int, avail_id
     """Cached jitted crossed grid — a fresh closure per call would defeat
     JAX's compile cache (keyed on callable identity) and recompile the whole
     vmap-of-vmap solve every study (same pattern as
-    ops.consolidate._sharded_sweep_fn)."""
+    ops.consolidate._lane_sweep_fn)."""
     rep, lane = mesh.axis_names
 
     def one_cell(avail, k, cls, statics_arrays, ex_state, ex_static, rank, counts):

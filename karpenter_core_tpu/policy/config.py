@@ -133,6 +133,36 @@ class PolicyConfig:
                 return config.merged(spec)
         return config
 
+    # -- wire ------------------------------------------------------------------
+
+    def to_wire(self) -> dict:
+        """The ``spec.policy``-shaped dict the snapshot channel ships so a
+        remote solve runs under THIS controller replica's resolved config
+        (service.snapshot_channel).  Wire-cased keys, same schema as the
+        Provisioner CRD block — one vocabulary on both sides."""
+        return {
+            "enabled": bool(self.enabled),
+            "costWeight": float(self.cost_weight),
+            "throughputWeight": float(self.throughput_weight),
+            "riskAversion": float(self.risk_aversion),
+            "spotPreference": bool(self.spot_preference),
+            "counterProposals": bool(self.counter_proposals),
+            "maxResizeFraction": float(self.max_resize_fraction),
+            "throughput": {name: weight for name, weight in self.throughput},
+        }
+
+    @classmethod
+    def from_wire(cls, spec: Optional[dict]) -> Optional["PolicyConfig"]:
+        """Decode a request's ``policy`` entry (``to_wire`` output / a raw
+        spec.policy dict).  None/empty → None, the pre-policy pipeline — the
+        wire default, so old clients keep exactly the old behavior.  The
+        serving side's KC_POLICY=0 kill switch still wins (``merged``), so a
+        solver operator can disable the stage fleet-wide regardless of what
+        the controller replicas ask for."""
+        if not spec:
+            return None
+        return cls().merged(dict(spec))
+
     # -- identity --------------------------------------------------------------
 
     def throughput_of(self, name: str) -> float:

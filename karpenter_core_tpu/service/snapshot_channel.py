@@ -206,7 +206,16 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                     pods=reschedulable(node_pods.get(c["name"], [])),
                 ))
 
-            search = TPUConsolidationSearch(self.cloud_provider, provisioners)
+            from karpenter_core_tpu.policy import PolicyConfig
+
+            search = TPUConsolidationSearch(
+                self.cloud_provider, provisioners,
+                # the requesting replica's resolved policy config rides the
+                # wire (PolicyConfig.to_wire) so remote sweeps score lanes by
+                # fleet-cost delta exactly like in-process ones; absent =
+                # pre-policy behavior, serving-side KC_POLICY=0 still wins
+                policy=PolicyConfig.from_wire(req.get("policy")),
+            )
             cmd = search.compute_command(
                 candidates, pending_pods=pending,
                 state_nodes=state_nodes, bound_pods=bound,
@@ -344,9 +353,16 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 self._decode_common(req)
             )
 
+            from karpenter_core_tpu.policy import PolicyConfig
+
             solver = TPUSolver(
                 self.cloud_provider, provisioners, daemonset_pods,
                 kube_client=resolver,
+                # policy over the wire (regression: a CPU controller replica
+                # with the objective enabled previously fell back SILENTLY to
+                # first-fit selection on remote solves — the field never
+                # crossed the channel)
+                policy=PolicyConfig.from_wire(req.get("policy")),
             )
             snapshot = solver.encode_classes(
                 classes, state_nodes=state_nodes or None, bound_pods=bound
@@ -402,9 +418,12 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 self._decode_common(req)
             )
 
+            from karpenter_core_tpu.policy import PolicyConfig
+
             solver = TPUSolver(
                 self.cloud_provider, provisioners, daemonset_pods,
                 kube_client=resolver,
+                policy=PolicyConfig.from_wire(req.get("policy")),
             )
             results = solver.solve(pods, state_nodes=state_nodes or None, bound_pods=bound)
 
@@ -455,6 +474,17 @@ def serve(cloud_provider, address: str = "127.0.0.1:0", max_workers: int = 4):
     return server, port
 
 
+def _policy_wire(policy) -> Dict:
+    """Normalize a client ``policy`` argument (PolicyConfig, wire dict, or
+    None) to the request's wire entry.  {} for None keeps the msgpack schema
+    stable while decoding as "no policy" on the serving side."""
+    if policy is None:
+        return {}
+    if isinstance(policy, dict):
+        return dict(policy)
+    return policy.to_wire()
+
+
 class SnapshotSolverClient:
     """Controller-plane client for the channel."""
 
@@ -477,13 +507,16 @@ class SnapshotSolverClient:
         provisioners: List,
         nodes: Optional[List[Dict]] = None,
         claim_drivers: Optional[Dict[str, str]] = None,
+        policy=None,
         timeout: float = 120.0,
     ) -> Dict:
         """Remote multi-node consolidation sweep.
 
         ``candidates``: [{name, instanceType, capacityType, zone, provisioner,
         disruptionCost}] in disruption order, referencing ``nodes`` entries by
-        name.  Returns the raw response: {action, nodesToRemove: [name],
+        name.  ``policy`` (policy.PolicyConfig or a wire dict) makes the
+        remote sweep score lanes by fleet-cost delta like an in-process one.
+        Returns the raw response: {action, nodesToRemove: [name],
         replacements: [{provisioner, instanceTypes, zones, capacityTypes,
         requests, podRefs: [[nodeName, podIndex]]}]}."""
         request = msgpack.packb(
@@ -493,6 +526,7 @@ class SnapshotSolverClient:
                 "provisioners": [codec.provisioner_to_dict(p) for p in provisioners],
                 "nodes": nodes or [],
                 "claimDrivers": claim_drivers or {},
+                "policy": _policy_wire(policy),
             }
         )
         return msgpack.unpackb(self._consolidate(request, timeout=timeout))
@@ -520,11 +554,14 @@ class SnapshotSolverClient:
         nodes: Optional[List[Dict]] = None,
         daemonset_pods: Optional[List] = None,
         claim_drivers: Optional[Dict[str, str]] = None,
+        policy=None,
         timeout: float = 60.0,
     ) -> Dict:
         """nodes: [{"node": node_dict, "pods": [...], "volumeLimits": {...}}];
         claim_drivers: {"<ns>/<claim>": csi-driver} resolved by this plane so
-        volume attach limits bind on the solver side."""
+        volume attach limits bind on the solver side; policy: the replica's
+        resolved policy.PolicyConfig (or wire dict) so the remote objective
+        stage selects offerings exactly like an in-process solve."""
         request = msgpack.packb(
             {
                 "pods": [codec.pod_to_dict(p) for p in pods],
@@ -532,6 +569,7 @@ class SnapshotSolverClient:
                 "daemonsetPods": [codec.pod_to_dict(p) for p in daemonset_pods or []],
                 "nodes": nodes or [],
                 "claimDrivers": claim_drivers or {},
+                "policy": _policy_wire(policy),
             }
         )
         return msgpack.unpackb(self._solve(request, timeout=timeout))
@@ -544,6 +582,7 @@ class SnapshotSolverClient:
         daemonset_pods: Optional[List] = None,
         claim_drivers: Optional[Dict[str, str]] = None,
         members: Optional[List[List[int]]] = None,
+        policy=None,
         timeout: float = 60.0,
     ) -> Dict:
         """Class-columnar solve: dedup ``pods`` into shape classes locally,
@@ -554,7 +593,10 @@ class SnapshotSolverClient:
         ``members`` — precomputed class membership (lists of indices into
         ``pods``), for callers that already classified the batch (the
         provisioning controller's split does) so the O(pods) signature pass
-        doesn't run twice on the hot path."""
+        doesn't run twice on the hot path.  ``policy`` — the replica's
+        resolved policy.PolicyConfig (or wire dict); without it a remote
+        solve silently ran first-fit selection while the replica believed
+        the objective was on."""
         if members is None:
             from karpenter_core_tpu.models.snapshot import _class_signature
 
@@ -572,6 +614,7 @@ class SnapshotSolverClient:
                 "daemonsetPods": [codec.pod_to_dict(p) for p in daemonset_pods or []],
                 "nodes": nodes or [],
                 "claimDrivers": claim_drivers or {},
+                "policy": _policy_wire(policy),
             }
         )
         response = msgpack.unpackb(self._solve_classes(request, timeout=timeout))

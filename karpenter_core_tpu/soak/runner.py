@@ -27,6 +27,7 @@ protocol (testing/fakeapiserver.py), which is what makes watch-drop chaos
 from __future__ import annotations
 
 import math
+import os
 import time
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Tuple
@@ -76,6 +77,14 @@ class SoakScenario:
     # measures the full-re-solve vs incremental amortization there
     use_tpu_kernel: bool = False
     tpu_kernel_min_pods: int = 256
+    # catalog size for the environment's fake cloud (0 = harness default) —
+    # the sharded-path scenarios grow this to the scale where catalog
+    # sharding matters (docs/KERNEL_PERF.md "Layer 5")
+    n_instance_types: int = 0
+    # env overrides applied for the duration of the run (restored after) —
+    # how the sharded scenarios pin KC_SOLVER_MESH* without leaking into the
+    # rest of the process
+    env: Dict[str, str] = field(default_factory=dict)
 
     def with_seed(self, seed: int) -> "SoakScenario":
         return replace(self, seed=int(seed))
@@ -240,12 +249,26 @@ class SoakRunner:
             raise ValueError(f"unknown soak backend {scenario.backend!r}")
 
         env = None
+        # scenario env overrides (e.g. KC_SOLVER_MESH for the sharded path),
+        # restored in the finally below so runs can't leak config
+        saved_env: Dict[str, Optional[str]] = {}
+        for key, value in scenario.env.items():
+            saved_env[key] = os.environ.get(key)
+            os.environ[key] = value
         try:
             if chaos_scenario is not None:
                 # armed BEFORE construction so startup watch establishment is
                 # inside the fault window (the watch.stream point)
                 chaos.arm(chaos_scenario, clock)
-            env = harness.make_environment(kube_factory=kube_factory, clock=clock)
+            instance_types = None
+            if scenario.n_instance_types:
+                from karpenter_core_tpu.cloudprovider import fake as fake_cp
+
+                instance_types = fake_cp.instance_types(scenario.n_instance_types)
+            env = harness.make_environment(
+                instance_types=instance_types, kube_factory=kube_factory,
+                clock=clock,
+            )
             if scenario.use_tpu_kernel:
                 env.provisioning.use_tpu_kernel = True
                 env.provisioning.tpu_kernel_min_pods = scenario.tpu_kernel_min_pods
@@ -267,6 +290,7 @@ class SoakRunner:
             for tick in range(total_ticks):
                 t = tick * scenario.tick_s
                 ticks_run = tick + 1
+                t_tick = time.perf_counter()
                 with tracing.span("soak.tick", scenario=scenario.name,
                                   tick=tick, t_s=t):
                     due, retries = retries, []
@@ -294,6 +318,10 @@ class SoakRunner:
                         except _RETRYABLE:
                             pass
                     obs = self._observe(env, clock.now())
+                    # whole-tick wall cost (events + scheduling + lifecycle +
+                    # observe) — the advisory per-tick wall budget the
+                    # sharded scenarios gate on (slo.py "tick_wall_s")
+                    obs.tick_wall_s = time.perf_counter() - t_tick
                     engine.observe(tick, t, obs)
                     if (
                         qi >= len(trace.events)
@@ -327,6 +355,11 @@ class SoakRunner:
                 }
             return engine.report(spec, extra=extra, diagnostics=diagnostics)
         finally:
+            for key, value in saved_env.items():
+                if value is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = value
             if chaos_scenario is not None:
                 chaos.disarm()
             if server is not None:

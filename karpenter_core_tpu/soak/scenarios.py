@@ -190,9 +190,43 @@ def churn_steady(seed: int = 53) -> SoakScenario:
     )
 
 
+def churn_steady_sharded(seed: int = 59) -> SoakScenario:
+    """The churn-steady regime grown to the SHARDED-path scale: a ~100k-pod
+    standing fleet over a 2k-type catalog, provisioning solves routed through
+    the shard_map mesh dispatcher (KC_SOLVER_MESH=1 over whatever devices the
+    host exposes — docs/KERNEL_PERF.md "Layer 5"), under sustained churn.
+    On top of the convergence rules it budgets WALL TIME per tick
+    (``tick_wall_s``, advisory like every wall-clock probe): the sharded
+    path must keep the whole reconcile loop inside the budget at a fleet the
+    single-device path cannot hold comfortably.  Slow matrix only (compile +
+    ~100k pod objects); the tier-1 smoke is a scaled-down clone in
+    tests/test_mesh_dispatch.py."""
+    return SoakScenario(
+        name="churn-steady-sharded",
+        seed=seed,
+        generator="diurnal",
+        # flat Poisson: standing population ≈ rate × lifetime ≈ 100k pods
+        params={
+            "duration_s": 600.0, "period_s": 600.0,
+            "base_rate_per_s": 167.0, "peak_rate_per_s": 167.0,
+            "mean_lifetime_s": 600.0,
+        },
+        slo={"rules": _CONVERGENCE_RULES + [
+            {"probe": "solve_latency_s", "agg": "mean", "limit": 2.0},
+            {"probe": "tick_wall_s", "agg": "mean", "limit": 60.0},
+        ]},
+        tick_s=30.0,
+        settle_ticks=30,
+        use_tpu_kernel=True,
+        n_instance_types=2000,
+        env={"KC_SOLVER_MESH": "1"},
+    )
+
+
 CATALOG: Dict[str, Callable[[int], SoakScenario]] = {
     "deploy-storm-smoke": deploy_storm_smoke,
     "churn-steady": churn_steady,
+    "churn-steady-sharded": churn_steady_sharded,
     "diurnal-consolidation": diurnal_consolidation,
     "batch-flood-flaky-api": batch_flood_flaky_api,
     "mass-eviction-capacity": mass_eviction_capacity,

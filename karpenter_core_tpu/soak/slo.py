@@ -56,6 +56,12 @@ PROBES: Dict[str, bool] = {
     # the FakeClock timeline.
     "fleet_cost_per_tick": True,
     "solve_latency_s": False,
+    # whole-tick wall time (events + scheduling + lifecycle + observe) in
+    # real seconds — the per-tick wall budget the sharded-path soak gates on
+    # (a mesh fleet that keeps up on solve_latency but drowns in decode or
+    # bookkeeping blows this while every deterministic probe looks clean).
+    # Wall-clock ⇒ advisory: excluded from the replayable verdict digest.
+    "tick_wall_s": False,
 }
 
 AGG_MAX = "max"
@@ -86,6 +92,7 @@ class Observation:
     nodes: int = 0
     fleet_cost: float = 0.0  # summed current-offering price of live nodes
     solve_latency_s: float = 0.0  # wall seconds (advisory)
+    tick_wall_s: float = 0.0  # whole-tick wall seconds (advisory)
 
     def probe_values(self) -> Dict[str, float]:
         return {
@@ -97,6 +104,7 @@ class Observation:
             "nodes": float(self.nodes),
             "fleet_cost_per_tick": round(self.fleet_cost, 6),
             "solve_latency_s": self.solve_latency_s,
+            "tick_wall_s": self.tick_wall_s,
         }
 
 

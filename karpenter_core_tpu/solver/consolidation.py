@@ -196,9 +196,27 @@ class TPUConsolidationSearch:
         cheapest-fleet genuinely disagree when a large prefix forces a
         pricey replacement while a smaller one deletes outright
         (tests/test_policy.py pins both directions)."""
-        out = consolidate_ops.run_sweep(
-            snapshot, ex_state, ex_static, rank, ex_cls_count, sizes
-        )
+        # the sweep auto-routes onto the 2D (catalog × lane) mesh when
+        # KC_SOLVER_MESH enables it (parallel.mesh.lane_mesh_axes): prefix
+        # lanes split across the lane axis, the catalog shards within each
+        # lane group.  Assignments/viability/zone planes are bit-identical
+        # to the unsharded sweep (mesh parity suite); the f32 per-lane
+        # new_cost SUMS agree only to reduction-order ulp (XLA reassociates
+        # across programs), so a razor-thin cost-delta tie can in principle
+        # resolve differently with the mesh on vs off — same caveat as any
+        # recompile (docs/KERNEL_PERF.md "Layer 5")
+        from karpenter_core_tpu import tracing
+        from karpenter_core_tpu.parallel import mesh as mesh_mod
+
+        mesh_axes = mesh_mod.lane_mesh_axes()
+        with tracing.span(
+            "consolidate.sweep", lanes=len(sizes),
+            mesh=repr(mesh_axes) if mesh_axes else None,
+        ):
+            out = consolidate_ops.run_sweep(
+                snapshot, ex_state, ex_static, rank, ex_cls_count, sizes,
+                mesh_axes=mesh_axes,
+            )
         n_new = np.asarray(out.n_new)
         failed = np.asarray(out.failed)
         uninit = np.asarray(out.used_uninitialized)

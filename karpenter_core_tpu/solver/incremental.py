@@ -103,7 +103,7 @@ class FallbackPolicy:
         )
 
     def decide(self, delta, delta_ticks: int, prev_slots_used: int,
-               known_classes=None) -> Tuple[str, str]:
+               known_classes=None, mesh_changed: bool = False) -> Tuple[str, str]:
         """(mode, reason).  ``delta`` is a models.store.SnapshotDelta (or None
         on the first solve); ``delta_ticks`` counts repairs since the last
         full solve; ``prev_slots_used`` the slots the previous solve opened;
@@ -111,11 +111,18 @@ class FallbackPolicy:
         express — a "new" class returning to a known (emptied) row repairs
         fine, while a genuinely unseen key means the class axis moved and the
         snapshot must re-encode.  Removed classes never force a full solve:
-        an emptied row idles as a zero-count scan step."""
+        an emptied row idles as a zero-count scan step.  ``mesh_changed``:
+        the live solve-mesh topology (parallel.mesh.solve_mesh_axes) no
+        longer matches the one the warm prep was built for — the carry's
+        planes are sharded for the OLD layout and the catalog pad multiple
+        moved with it, so the lineage re-anchors with a full solve on the
+        new topology."""
         if not self.enabled:
             return MODE_FULL, "disabled"
         if delta is None:
             return MODE_FULL, "first"
+        if mesh_changed:
+            return MODE_FULL, "mesh-changed"
         if delta.node_side_changed:
             return MODE_FULL, "supply-changed:" + ",".join(delta.changed_planes)
         unknown = tuple(
@@ -262,6 +269,15 @@ class IncrementalSolveSession:
                 from_version=self._warm.versioned.version,
                 supply_changed=() if supply == self._warm.supply else ("supply",),
             )
+        # mesh-topology watch: the warm carry is sharded for (and its repair
+        # executable keyed on) the topology captured at prepare time — a
+        # KC_SOLVER_MESH flip or a device-count change escalates to full
+        from karpenter_core_tpu.parallel import mesh as mesh_mod
+
+        mesh_changed = self._warm is not None and (
+            getattr(self._warm.prep, "mesh_axes", None)
+            != mesh_mod.solve_mesh_axes()
+        )
         mode, reason = self.policy.decide(
             delta,
             self._warm.delta_ticks if self._warm is not None else 0,
@@ -269,6 +285,7 @@ class IncrementalSolveSession:
             if self._warm is not None else 0,
             known_classes=self._warm.class_index
             if self._warm is not None else None,
+            mesh_changed=mesh_changed,
         )
 
         fault = SOLVER_DISPATCH.hit(

@@ -172,6 +172,12 @@ class SolvePrep(NamedTuple):
     n_slots: int
     n_passes: int
     features: object  # ops.solve.SnapshotFeatures
+    # mesh topology the prep was built for (parallel.mesh.solve_mesh_axes at
+    # prepare time; None = unsharded).  Captured HERE so a lineage of repairs
+    # keeps dispatching onto the topology its carry is sharded over — the
+    # incremental session escalates to a full solve when the live topology
+    # moves (solver.incremental "mesh-changed")
+    mesh_axes: object = None
 
 
 @dataclass
@@ -354,6 +360,12 @@ class TPUSolver:
         from karpenter_core_tpu.models.snapshot import pod_port_keys
 
         extra_ports = [key for pod in bound_pods or [] for key in pod_port_keys(pod)]
+        # shard-aligned catalog extent: when the sharded solve path is on
+        # (parallel.mesh, KC_SOLVER_MESH), the encode pads the instance-type
+        # axis to the mesh's catalog-axis multiple so the shard_map dispatch
+        # splits it evenly — one consistent padded extent everywhere
+        from karpenter_core_tpu.parallel import mesh as mesh_mod
+
         snapshot = encode_snapshot(
             pods, self.provisioners, self.templates, self.instance_types,
             extra_requirement_sets=extra,
@@ -361,6 +373,7 @@ class TPUSolver:
             cache_host=self,
             extra_host_ports=extra_ports,
             classes=classes,
+            catalog_pad_multiple=mesh_mod.catalog_pad_multiple(),
         )
         snapshot.class_volumes = self._resolve_class_volumes(
             snapshot.classes, state_nodes
@@ -819,10 +832,16 @@ class TPUSolver:
                     cls, statics_arrays, key_has_bounds, ex_state, ex_static
                 )
             )
+        from karpenter_core_tpu.parallel import mesh as mesh_mod
+        from karpenter_core_tpu.utils import compilecache
+
         return SolvePrep(
             cls=cls, statics_arrays=statics_arrays, key_has_bounds=key_has_bounds,
             ex_state=ex_state, ex_static=ex_static, n_slots=n_slots,
             n_passes=snapshot.scan_passes, features=features,
+            mesh_axes=compilecache.resolve_mesh_axes(
+                mesh_mod.solve_mesh_axes(), solve_ops.StaticArrays(*statics_arrays)
+            ),
         )
 
     def run_prepared(
@@ -863,6 +882,10 @@ class TPUSolver:
             warm_carry=warm_carry,
             repair_plan=repair_plan,
             pre_padded=True,
+            # the prep's captured topology, NOT "auto": a warm carry's plane
+            # layout must keep matching the executable it resumes into even
+            # if the live mesh config moves mid-lineage
+            mesh_axes=getattr(prep, "mesh_axes", None),
         )
 
     def solve_encoded(

@@ -265,9 +265,20 @@ def solve_callable(
     packed_masks: bool = True,
     warm_carry=None,
     repair_plan=None,
+    mesh_axes=None,
 ):
     """An AOT-compiled solve callable served through the export cache, or None
     when export-caching is unavailable (callers fall back to the plain jit).
+
+    ``mesh_axes`` (hashable topology descriptor, e.g. ``(("catalog", 8),)``
+    from parallel.mesh.solve_mesh_axes) selects the SHARDED variant: the
+    same solve built as a ``shard_map`` over that mesh with the catalog axis
+    partitioned (docs/KERNEL_PERF.md "Layer 5").  The topology is part of the
+    cache key — one warm executable per mesh shape — and the degenerate
+    1-device topology is its own key too, so flipping KC_SOLVER_MESH never
+    silently reuses an executable built for another layout.  Mesh variants
+    skip the exported-StableHLO disk cache (a shard_map program embeds its
+    mesh; the XLA persistent cache still covers the compile).
 
     The returned callable takes (cls, statics_arrays[, ex_state, ex_static])
     — or (cls, statics_arrays, ex_static, warm_carry) for the warm-start
@@ -300,6 +311,7 @@ def solve_callable(
             packed_masks,
             has_ex,
             has_warm,
+            mesh_axes,
             _leaf_sig(cls),
             _leaf_sig(statics_arrays),
             _leaf_sig(ex_state) if has_ex else None,
@@ -326,7 +338,7 @@ def solve_callable(
             return _build_and_memo(key, cls, statics_arrays, n_slots,
                                    key_has_bounds, ex_state, ex_static, n_passes,
                                    features, fuse_zones, packed_masks, warm_carry,
-                                   repair_plan)
+                                   repair_plan, mesh_axes)
         finally:
             with _lock:
                 _in_flight.pop(key, None)
@@ -336,15 +348,46 @@ def solve_callable(
         return None
 
 
+def _base_solve_fn(has_warm, has_ex, n_slots, key_has_bounds, n_passes,
+                   features, fuse_zones, packed_masks, catalog_axis=None):
+    """The positional-signature solve body for one variant: (cls, statics[,
+    ...]) matching how callers invoke the memoized executable.
+    ``catalog_axis`` threads the mesh axis name into solve_core's exact
+    cross-shard collectives (the shard_map build passes it; every other
+    build leaves it None — same code, no collectives traced)."""
+    from karpenter_core_tpu.ops import solve as solve_ops
+
+    if has_warm:
+        # the delta variant: ex_state rides inside the carry; ex_static is
+        # passed separately because its tol/vol rows are per-class
+        return lambda c, s, exst, w, rp: solve_ops.solve_core(
+            c, s, n_slots, key_has_bounds, None, exst, n_passes=n_passes,
+            features=features, fuse_zones=fuse_zones,
+            packed_masks=packed_masks, warm_carry=w, repair_plan=rp,
+            catalog_axis=catalog_axis,
+        )
+    if has_ex:
+        return lambda c, s, exs, exst: solve_ops.solve_core(
+            c, s, n_slots, key_has_bounds, exs, exst, n_passes=n_passes,
+            features=features, fuse_zones=fuse_zones,
+            packed_masks=packed_masks, catalog_axis=catalog_axis,
+        )
+    return lambda c, s: solve_ops.solve_core(
+        c, s, n_slots, key_has_bounds, n_passes=n_passes,
+        features=features, fuse_zones=fuse_zones, packed_masks=packed_masks,
+        catalog_axis=catalog_axis,
+    )
+
+
 def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
                     ex_state, ex_static, n_passes, features=None,
                     fuse_zones=True, packed_masks=True, warm_carry=None,
-                    repair_plan=None):
+                    repair_plan=None, mesh_axes=None):
     """Build one executable for ``key``: export-cache load (or trace+export),
-    then AOT compile, then memoize.  Callers hold the key's in-flight slot."""
+    then AOT compile, then memoize.  Callers hold the key's in-flight slot.
+    Mesh variants (``mesh_axes``) build jit(shard_map(...)) instead and skip
+    the export cache — the memo (and XLA's persistent cache) keep them warm."""
     import jax
-
-    from karpenter_core_tpu.ops import solve as solve_ops
 
     has_ex = ex_state is not None
     has_warm = warm_carry is not None
@@ -359,6 +402,24 @@ def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
     structs = jax.tree_util.tree_map(
         lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), struct_args
     )
+    if mesh_axes is not None:
+        from karpenter_core_tpu.parallel import mesh as mesh_mod
+
+        base_axis = _base_solve_fn(
+            has_warm, has_ex, n_slots, key_has_bounds, n_passes, features,
+            fuse_zones, packed_masks, catalog_axis=mesh_axes[0][0],
+        )
+        base_plain = _base_solve_fn(
+            has_warm, has_ex, n_slots, key_has_bounds, n_passes, features,
+            fuse_zones, packed_masks,
+        )
+        fn = mesh_mod.sharded_solve_callable(
+            mesh_axes, base_axis, base_plain, structs
+        )
+        with _lock:
+            _memo[key] = fn
+            _stats["builds"] += 1
+        return fn
     fn = None
     if os.path.exists(path):
         try:
@@ -369,32 +430,10 @@ def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
             log.warning("export cache load failed (%s), re-exporting", e)
             fn = None
     if fn is None:
-        if has_warm:
-            # the delta variant: ex_state rides inside the carry; ex_static is
-            # passed separately because its tol/vol rows are per-class
-            base = jax.jit(
-                lambda c, s, exst, w, rp: solve_ops.solve_core(
-                    c, s, n_slots, key_has_bounds, None, exst, n_passes=n_passes,
-                    features=features, fuse_zones=fuse_zones,
-                    packed_masks=packed_masks, warm_carry=w, repair_plan=rp,
-                )
-            )
-        elif has_ex:
-            base = jax.jit(
-                lambda c, s, exs, exst: solve_ops.solve_core(
-                    c, s, n_slots, key_has_bounds, exs, exst, n_passes=n_passes,
-                    features=features, fuse_zones=fuse_zones,
-                    packed_masks=packed_masks,
-                )
-            )
-        else:
-            base = jax.jit(
-                lambda c, s: solve_ops.solve_core(
-                    c, s, n_slots, key_has_bounds, n_passes=n_passes,
-                    features=features, fuse_zones=fuse_zones,
-                    packed_masks=packed_masks,
-                )
-            )
+        base = jax.jit(_base_solve_fn(
+            has_warm, has_ex, n_slots, key_has_bounds, n_passes, features,
+            fuse_zones, packed_masks,
+        ))
         exported = jax.export.export(base)(*structs)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
@@ -420,6 +459,30 @@ def kernel_flags():
     )
 
 
+def resolve_mesh_axes(mesh_axes, statics_arrays):
+    """Resolve run_solve's ``mesh_axes`` argument to a concrete topology or
+    None.  ``"auto"`` consults parallel.mesh.solve_mesh_axes (KC_SOLVER_MESH
+    env + device count); any topology whose catalog axis does not divide the
+    instance-type extent falls back to the unsharded path — production
+    snapshots are encoded shard-aligned (models.snapshot), the guard covers
+    planes prepared outside that path."""
+    if mesh_axes == "auto":
+        from karpenter_core_tpu.parallel import mesh as mesh_mod
+
+        mesh_axes = mesh_mod.solve_mesh_axes()
+    if mesh_axes is None:
+        return None
+    n_it = int(statics_arrays.it_alloc.shape[0])
+    axis_size = int(mesh_axes[0][1])
+    if axis_size < 1 or n_it % axis_size != 0:
+        log.debug(
+            "mesh dispatch skipped: catalog extent %d not a multiple of the "
+            "mesh axis %r", n_it, mesh_axes,
+        )
+        return None
+    return tuple(mesh_axes)
+
+
 def run_solve(
     cls,
     statics_arrays,
@@ -432,6 +495,7 @@ def run_solve(
     warm_carry=None,
     repair_plan=None,
     pre_padded: bool = False,
+    mesh_axes="auto",
 ):
     """Solve through the export cache, falling back to the plain jit.
 
@@ -446,7 +510,14 @@ def run_solve(
     docstring); ``pre_padded`` skips the bucket padding for callers that
     already hold padded planes — mandatory with a warm carry, whose device
     arrays must not round-trip through numpy padding (pad_planes would force
-    a device→host sync on them)."""
+    a device→host sync on them).
+
+    ``mesh_axes`` routes the solve through the sharded shard_map dispatcher
+    (parallel.mesh): a topology descriptor, None for the unsharded path, or
+    ``"auto"`` (the default — KC_SOLVER_MESH env / device count decide, so
+    every production entry point inherits the sharded path without threading
+    anything).  The sharded solve is bit-identical to the unsharded one
+    (docs/KERNEL_PERF.md "Layer 5")."""
     from concurrent.futures import ThreadPoolExecutor
 
     import jax
@@ -456,12 +527,14 @@ def run_solve(
 
     fuse_zones, packed_masks = kernel_flags()
     features = snap_features(features)
+    mesh_axes = resolve_mesh_axes(mesh_axes, statics_arrays)
     # "dispatch" covers pad + upload + executable lookup + async kernel launch;
     # the separate "solve" span blocks on the outputs (tracing only) so device
     # compute is attributed to the solve, not to whichever span first touches
     # the result — the JAX-aware boundary docs/OBSERVABILITY.md describes.
     with tracing.span("dispatch", n_slots=n_slots, n_passes=n_passes,
-                      warm=warm_carry is not None):
+                      warm=warm_carry is not None,
+                      mesh=repr(mesh_axes) if mesh_axes else None):
         if (
             not pre_padded
             and warm_carry is None
@@ -470,14 +543,23 @@ def run_solve(
             cls, statics_arrays, key_has_bounds, ex_state, ex_static = solve_ops.pad_planes(
                 cls, statics_arrays, key_has_bounds, ex_state, ex_static
             )
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            upload = pool.submit(
-                jax.device_put, (cls, statics_arrays, ex_state, ex_static)
+
+        def _upload(tree):
+            if mesh_axes is None:
+                return jax.device_put(tree)
+            from karpenter_core_tpu.parallel import mesh as mesh_mod
+
+            return jax.device_put(
+                tree,
+                mesh_mod.mesh_shardings(tree, mesh_mod.mesh_for(mesh_axes)),
             )
+
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            upload = pool.submit(_upload, (cls, statics_arrays, ex_state, ex_static))
             fn = solve_callable(
                 cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
                 n_passes, features, fuse_zones, packed_masks, warm_carry,
-                repair_plan,
+                repair_plan, mesh_axes,
             )
             cls, statics_arrays, ex_state, ex_static = upload.result()
         if fn is None:

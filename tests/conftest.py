@@ -28,6 +28,15 @@ import os
 # thread would race the meters
 os.environ.setdefault("KC_TPU_WARMUP", "0")
 
+# the sharded solve path would AUTO-enable on this 8-device virtual mesh
+# (parallel.mesh.solve_mesh_axes: on when >1 device) and flip every kernel
+# test onto mesh executables, perturbing the metered compile counts and the
+# pinned single-device behaviors.  Pin it off by default — exactly like the
+# warmup pin above — and let the dedicated mesh suites
+# (tests/test_mesh_dispatch.py, tests/test_catalog_sharded.py) opt in per
+# test via monkeypatch.  Production keeps the >1-device auto-default.
+os.environ.setdefault("KC_SOLVER_MESH", "0")
+
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()

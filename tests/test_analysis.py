@@ -315,6 +315,50 @@ class TestTraceSafety:
         found = trace_safety.run(repo_project)
         assert found == [], "\n".join(f.render() for f in found)
 
+    def test_shard_map_body_reachable(self, tmp_path):
+        """A host sync inside a shard_map body is a static-gate failure even
+        when the body never appears at a jax.jit site — sharded bodies seed
+        the same reachability as jitted ones (ISSUE 10)."""
+        project = make_project(tmp_path, {
+            "badpkg/ops.py": """\
+                import jax
+                import jax.numpy as jnp
+                from jax.experimental.shard_map import shard_map
+
+                def body(x):
+                    v = jnp.sum(x)
+                    return float(v)  # host sync inside the sharded body
+
+                def dispatch(mesh, specs, x):
+                    return shard_map(
+                        body, mesh=mesh, in_specs=specs, out_specs=specs
+                    )(x)
+            """,
+        })
+        found = trace_safety.run(project)
+        assert "host-sync" in rules_of(found)
+        assert any(f.symbol == "body" or "body" in f.detail for f in found)
+
+    def test_shard_map_decorator_spelling_reachable(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/ops.py": """\
+                import functools
+
+                import jax
+                import jax.numpy as jnp
+                from jax.experimental.shard_map import shard_map
+
+                MESH = None
+
+                @functools.partial(shard_map, mesh=MESH, in_specs=(), out_specs=())
+                def body(x):
+                    if jnp.sum(x) > 0:  # trace-branch inside sharded body
+                        return x
+                    return x + 1
+            """,
+        })
+        assert "trace-branch" in rules_of(trace_safety.run(project))
+
 
 # -- retrace budget (static) --------------------------------------------------
 
@@ -457,6 +501,91 @@ class TestRetraceBudgetStatic:
             """,
         })
         assert "non-literal-static" in rules_of(retrace_budget.run(project))
+
+    def test_uncached_shard_map_flagged_and_lru_exempt(self, tmp_path):
+        """shard_map constructed per call retraces exactly like per-call
+        jax.jit; a memoized builder whose mesh derives from its parameters
+        is the sanctioned shape (parallel.mesh pattern)."""
+        project = make_project(tmp_path, {
+            "badpkg/ops/solve.py": """\
+                import functools
+
+                import jax
+                from jax.experimental.shard_map import shard_map
+
+                def body(x):
+                    return x
+
+                def hot(mesh, specs, x):
+                    return shard_map(
+                        body, mesh=mesh, in_specs=specs, out_specs=specs
+                    )(x)
+
+                @functools.lru_cache(maxsize=8)
+                def builder(mesh_axes, specs):
+                    mesh = mesh_for(mesh_axes)
+                    return jax.jit(shard_map(
+                        body, mesh=mesh, in_specs=specs, out_specs=specs
+                    ))
+
+                def mesh_for(axes):
+                    return axes
+            """,
+        })
+        found = [f for f in retrace_budget.run(project)
+                 if f.rule == "uncached-jit" and "shard_map" in f.detail]
+        assert len(found) == 1 and found[0].symbol == "hot"
+
+    def test_unkeyed_mesh_static_flagged(self, tmp_path):
+        """A memoized builder whose shard_map captures a module-global mesh
+        shares ONE cached executable across topologies — the sharded twin of
+        cache-key-drift."""
+        project = make_project(tmp_path, {
+            "badpkg/ops/solve.py": """\
+                import functools
+
+                import jax
+                from jax.experimental.shard_map import shard_map
+
+                MESH = object()
+
+                def body(x):
+                    return x
+
+                @functools.lru_cache(maxsize=8)
+                def builder(specs):
+                    return jax.jit(shard_map(
+                        body, mesh=MESH, in_specs=specs, out_specs=specs
+                    ))
+            """,
+        })
+        found = [f for f in retrace_budget.run(project)
+                 if f.rule == "unkeyed-mesh-static"]
+        assert len(found) == 1 and found[0].symbol == "builder"
+
+    def test_mesh_derived_from_params_silent(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/ops/solve.py": """\
+                import functools
+
+                import jax
+                from jax.experimental.shard_map import shard_map
+
+                def body(x):
+                    return x
+
+                def mesh_for(axes):
+                    return axes
+
+                @functools.lru_cache(maxsize=8)
+                def builder(mesh_axes, specs):
+                    mesh = mesh_for(mesh_axes)
+                    return jax.jit(shard_map(
+                        body, mesh=mesh, in_specs=specs, out_specs=specs
+                    ))
+            """,
+        })
+        assert "unkeyed-mesh-static" not in rules_of(retrace_budget.run(project))
 
     def test_current_tree_only_baselined_findings(self, repo_project,
                                                   repo_baseline):

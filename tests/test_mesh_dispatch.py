@@ -1,0 +1,430 @@
+"""The sharded production solve path (ISSUE 10, docs/KERNEL_PERF.md Layer 5).
+
+parallel/mesh.py is the dispatch layer: production solves run as a
+``shard_map`` over the device mesh with the catalog axis sharded, behind
+``KC_SOLVER_MESH`` (auto-on with >1 device; tests/conftest.py pins it off
+suite-wide so these suites opt in per test).  The contract under test is
+BIT-IDENTITY: the sharded solve — provisioning, warm-start repair, and the
+consolidation lane sweep, with and without the policy objective — must equal
+the single-device solve exactly, with the 1-device mesh as the degenerate
+case.  All tests run in-process on the conftest's forced 8-device virtual
+CPU mesh (XLA_FLAGS --xla_force_host_platform_device_count=8), tier-1.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.apis import labels as labels_api
+from karpenter_core_tpu.apis.objects import (
+    LabelSelector,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.ops import consolidate as consolidate_ops
+from karpenter_core_tpu.ops import solve as solve_ops
+from karpenter_core_tpu.parallel import mesh as mesh_ops
+from karpenter_core_tpu.solver.tpu import TPUSolver
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+from karpenter_core_tpu.utils import compilecache
+
+pytestmark = pytest.mark.compile  # mesh executables compile per topology
+
+
+N_ITS = 24  # divides every mesh size in play (1/2/4/8) without padding
+
+
+def build_fleet(n_pods=96, n_its=N_ITS, seed=0, policy=None, provider=None):
+    """A mixed fleet covering the phase families the dispatcher must keep
+    bit-identical: plain sizes, zonal spread, hostname spread, zone
+    self-affinity.  ``seed`` skews the mix so the parity fuzz sees distinct
+    shapes per round."""
+    rng = np.random.RandomState(seed)
+    if provider is None:
+        provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(n_its))
+    solver = TPUSolver(
+        provider,
+        [make_provisioner(name="a", weight=2), make_provisioner(name="b")],
+        policy=policy,
+    )
+    sizes = [{"cpu": "500m"}, {"cpu": 1, "memory": "2Gi"}, {"cpu": "250m"}]
+    pods = []
+    for i in range(n_pods // 2):
+        pods.append(make_pod(requests=sizes[int(rng.randint(len(sizes)))]))
+    for _ in range(n_pods // 4):
+        pods.append(make_pod(
+            labels={"app": f"zs-{seed}"}, requests={"cpu": "250m"},
+            topology_spread=[TopologySpreadConstraint(
+                max_skew=1, topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                label_selector=LabelSelector(match_labels={"app": f"zs-{seed}"}),
+            )],
+        ))
+    for _ in range(n_pods // 8):
+        pods.append(make_pod(
+            labels={"app": f"hs-{seed}"}, requests={"cpu": "250m"},
+            topology_spread=[TopologySpreadConstraint(
+                max_skew=1, topology_key=labels_api.LABEL_HOSTNAME,
+                label_selector=LabelSelector(match_labels={"app": f"hs-{seed}"}),
+            )],
+        ))
+    for _ in range(n_pods - len(pods)):
+        pods.append(make_pod(
+            labels={"aff": f"g-{seed}"}, requests={"cpu": "250m"},
+            pod_affinity=[PodAffinityTerm(
+                topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                label_selector=LabelSelector(match_labels={"aff": f"g-{seed}"}),
+            )],
+        ))
+    return solver, pods
+
+
+def assert_outputs_identical(a: solve_ops.SolveOutputs, b: solve_ops.SolveOutputs):
+    """Bit-identity over every output plane the decode consumes."""
+    for name, left, right in (
+        ("assign", a.assign, b.assign),
+        ("assign_existing", a.assign_existing, b.assign_existing),
+        ("failed", a.failed, b.failed),
+        ("spread_suspect", a.spread_suspect, b.spread_suspect),
+        ("viable", a.state.viable, b.state.viable),
+        ("zone", a.state.zone, b.state.zone),
+        ("ct", a.state.ct, b.state.ct),
+        ("used", a.state.used, b.state.used),
+        ("pod_count", a.state.pod_count, b.state.pod_count),
+        ("tmpl_id", a.state.tmpl_id, b.state.tmpl_id),
+        ("open_", a.state.open_, b.state.open_),
+        ("ex_zone", a.ex_state.zone, b.ex_state.zone),
+        ("ex_used", a.ex_state.used, b.ex_state.used),
+        ("remaining", a.remaining, b.remaining),
+    ):
+        assert np.array_equal(np.asarray(left), np.asarray(right)), (
+            f"sharded solve diverged from single-device on plane {name!r}"
+        )
+    assert int(a.state.n_next) == int(b.state.n_next)
+
+
+def solve_both(solver, pods, monkeypatch, devices, state_nodes=None):
+    """(plain outputs, sharded outputs) on ONE shard-aligned snapshot: the
+    encode runs with the mesh on (padded extents), the plain solve then runs
+    the same prep with the mesh off — identical inputs, two dispatchers."""
+    monkeypatch.setenv("KC_SOLVER_MESH", "1")
+    monkeypatch.setenv("KC_SOLVER_MESH_DEVICES", str(devices))
+    snapshot = solver.encode(pods, state_nodes)
+    prep = solver.prepare_encoded(snapshot, state_nodes)
+    assert prep.mesh_axes == ((mesh_ops.CATALOG_AXIS, devices),)
+    sharded = solver.run_prepared(prep)
+    plain = solver.run_prepared(prep._replace(mesh_axes=None))
+    return plain, sharded, snapshot, prep
+
+
+class TestDegenerateMesh:
+    def test_1device_mesh_bit_identical(self, monkeypatch):
+        """The degenerate 1-device mesh runs literally the same kernel code
+        (singleton collectives) and must reproduce the unsharded solve
+        bit-for-bit."""
+        solver, pods = build_fleet()
+        plain, sharded, _, _ = solve_both(solver, pods, monkeypatch, devices=1)
+        assert_outputs_identical(plain, sharded)
+
+    def test_1device_executable_reused(self, monkeypatch):
+        """Same topology, second solve: memo hit, zero new builds — the
+        cache keys on the mesh topology so repeats stay warm."""
+        solver, pods = build_fleet()
+        monkeypatch.setenv("KC_SOLVER_MESH", "1")
+        monkeypatch.setenv("KC_SOLVER_MESH_DEVICES", "1")
+        snapshot = solver.encode(pods)
+        prep = solver.prepare_encoded(snapshot)
+        solver.run_prepared(prep)
+        before = compilecache.stats()["builds"]
+        solver.run_prepared(prep)
+        assert compilecache.stats()["builds"] == before
+
+    def test_auto_off_on_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("KC_SOLVER_MESH", "0")
+        assert mesh_ops.solve_mesh_axes() is None
+        assert mesh_ops.catalog_pad_multiple() == 1
+
+    def test_auto_on_with_virtual_devices(self, monkeypatch):
+        monkeypatch.delenv("KC_SOLVER_MESH", raising=False)
+        axes = mesh_ops.solve_mesh_axes()
+        assert axes is not None and axes[0][0] == mesh_ops.CATALOG_AXIS
+        assert axes[0][1] == 8  # the conftest's forced virtual pool
+
+
+class TestMeshParityFuzz:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_provisioning_parity(self, monkeypatch, seed):
+        """Fuzz-pinned (PR 3 style): sharded assignments bit-identical to
+        single-device on identical snapshots across distinct fleet mixes."""
+        solver, pods = build_fleet(seed=seed)
+        plain, sharded, _, _ = solve_both(solver, pods, monkeypatch, devices=8)
+        assert_outputs_identical(plain, sharded)
+
+    def test_parity_on_2device_mesh(self, monkeypatch):
+        """The forced multi-host-style 2-device CPU mesh (two devices of the
+        conftest's virtual pool)."""
+        solver, pods = build_fleet(seed=3)
+        plain, sharded, _, _ = solve_both(solver, pods, monkeypatch, devices=2)
+        assert_outputs_identical(plain, sharded)
+
+    @pytest.mark.slow
+    def test_decode_results_identical(self, monkeypatch):
+        """End-to-end: the decoded node decisions (pods, instance types,
+        zones) agree — sentinel catalog padding never leaks into decode.
+        Slow tier: the raw-plane parity above plus the encode suite's
+        sentinel checks cover the tier-1 budget's share of this."""
+        solver, pods = build_fleet(seed=4)
+        plain, sharded, snapshot, _ = solve_both(
+            solver, pods, monkeypatch, devices=8
+        )
+        res_plain = solver.decode(snapshot, plain)
+        res_sharded = solver.decode(snapshot, sharded)
+        assert len(res_plain.new_nodes) == len(res_sharded.new_nodes)
+        for a, b in zip(res_plain.new_nodes, res_sharded.new_nodes):
+            assert [p.uid for p in a.pods] == [p.uid for p in b.pods]
+            assert a.instance_type_names == b.instance_type_names
+            assert a.zones == b.zones
+            for name in a.instance_type_names:
+                assert not name.startswith("~catalog-pad-")
+        assert len(res_plain.failed_pods) == len(res_sharded.failed_pods)
+
+    def test_policy_objective_parity(self, monkeypatch):
+        """With the policy objective enabled and skewed prices, the argmin
+        must reduce identically across catalog shards: same selected
+        offering per node, same fleet cost."""
+        from karpenter_core_tpu.policy import PolicyConfig
+
+        provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(N_ITS))
+        its = provider.get_instance_types(None)
+        provider.set_price(its[1].name, 0.01)
+        provider.set_price(its[5].name, 93.0)
+        solver, pods = build_fleet(
+            seed=5, policy=PolicyConfig(enabled=True), provider=provider
+        )
+        plain, sharded, snapshot, _ = solve_both(
+            solver, pods, monkeypatch, devices=8
+        )
+        assert_outputs_identical(plain, sharded)
+        res_plain = solver.decode(snapshot, plain)
+        res_sharded = solver.decode(snapshot, sharded)
+        assert res_plain.fleet_cost == res_sharded.fleet_cost
+        sel_plain = [d.selected for d in res_plain.new_nodes]
+        sel_sharded = [d.selected for d in res_sharded.new_nodes]
+        assert sel_plain == sel_sharded
+        assert any(s is not None for s in sel_sharded)
+
+
+class TestShardAlignedEncode:
+    def test_encode_pads_catalog_to_mesh_multiple(self, monkeypatch):
+        monkeypatch.setenv("KC_SOLVER_MESH", "1")
+        monkeypatch.setenv("KC_SOLVER_MESH_DEVICES", "8")
+        provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(30))
+        solver = TPUSolver(provider, [make_provisioner(name="a")])
+        snapshot = solver.encode([make_pod(requests={"cpu": "250m"})])
+        assert len(snapshot.it_names) == 32  # 30 -> next multiple of 8
+        assert snapshot.it_names[30].startswith("~catalog-pad-")
+        assert snapshot.it_alloc.shape[0] == 32
+        assert not snapshot.it_avail[30:].any()
+        assert np.isinf(snapshot.it_price[30:]).all()
+        assert not snapshot.tmpl_it[:, 30:].any()
+        # policy planes ride the padded extent too, inert on the tail
+        assert snapshot.pol_price.shape[0] == 32
+        assert np.isinf(snapshot.pol_price[30:]).all()
+
+    @pytest.mark.slow
+    def test_padding_inert_vs_unpadded_encode(self, monkeypatch):
+        """The padded encode's solve equals the unpadded encode's solve on
+        the real catalog columns — padding changes layout, never results.
+        Slow tier: tier-1's share of this invariant rides
+        tests/test_catalog_sharded.py::test_catalog_not_divisible_by_devices
+        (pad-tail inertness on the dispatcher path) plus the sentinel
+        checks above — this is the two-encode cross-check."""
+        provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(30))
+
+        monkeypatch.setenv("KC_SOLVER_MESH", "0")
+        solver0 = TPUSolver(provider, [make_provisioner(name="a")])
+        pods = [make_pod(requests={"cpu": "500m"}) for _ in range(16)]
+        snap0 = solver0.encode(pods)
+        out0 = solver0.run_prepared(solver0.prepare_encoded(snap0))
+
+        monkeypatch.setenv("KC_SOLVER_MESH", "1")
+        monkeypatch.setenv("KC_SOLVER_MESH_DEVICES", "8")
+        solver1 = TPUSolver(provider, [make_provisioner(name="a")])
+        snap1 = solver1.encode(pods)
+        prep1 = solver1.prepare_encoded(snap1)
+        out1 = solver1.run_prepared(prep1._replace(mesh_axes=None))
+
+        assert np.array_equal(np.asarray(out0.assign), np.asarray(out1.assign))
+        assert np.array_equal(np.asarray(out0.failed), np.asarray(out1.failed))
+        i0 = 30
+        assert np.array_equal(
+            np.asarray(out0.state.viable)[:, :i0],
+            np.asarray(out1.state.viable)[:, :i0],
+        )
+        assert not np.asarray(out1.state.viable)[
+            np.asarray(out1.state.pod_count) > 0
+        ][:, i0:].any()
+
+
+class TestConsolidationLanes:
+    def _sweep_inputs(self, solver, snapshot):
+        n_classes = len(snapshot.classes)
+        ex_state = solve_ops.empty_existing_state(
+            len(snapshot.resources), snapshot.vocab.n_keys,
+            snapshot.vocab.width, len(snapshot.zones),
+            len(snapshot.capacity_types),
+        )
+        ex_static = solve_ops.empty_existing_static(
+            len(snapshot.resources), n_classes, len(snapshot.groups) + 1
+        )
+        rank = np.full(1, 1 << 30, dtype=np.int32)
+        counts = np.zeros((n_classes, 1), dtype=np.int32)
+        return ex_state, ex_static, rank, counts
+
+    def test_lane_sweep_parity(self, monkeypatch):
+        """The 2D (catalog × lane) sweep equals the unsharded sweep on every
+        output plane, including the pmin-reduced per-lane fleet cost."""
+        monkeypatch.setenv("KC_SOLVER_MESH", "1")
+        monkeypatch.setenv("KC_SOLVER_MESH_DEVICES", "4")
+        solver, pods = build_fleet(seed=6, n_pods=24)
+        snapshot = solver.encode(pods)
+        ex_state, ex_static, rank, counts = self._sweep_inputs(solver, snapshot)
+        sizes = np.arange(1, 4, dtype=np.int32)  # 3 lanes, pads to 4
+        plain = consolidate_ops.run_sweep(
+            snapshot, ex_state, ex_static, rank, counts, sizes, mesh_axes=None
+        )
+        sharded = consolidate_ops.run_sweep(
+            snapshot, ex_state, ex_static, rank, counts, sizes,
+            mesh_axes=(("catalog", 2), ("lane", 2)),
+        )
+        for name in consolidate_ops.SweepOutputs._fields:
+            left = np.asarray(getattr(plain, name))
+            right = np.asarray(getattr(sharded, name))
+            if name == "new_cost":
+                # the per-lane fleet cost is a float32 SUM over node slots:
+                # XLA reassociates reductions differently per compiled
+                # program, so two *different programs* (plain vmap vs padded
+                # lane shard_map) legitimately differ in the last ulp — not
+                # a sharding artifact (the summands, node_prices, are pinned
+                # bit-identical via new_viable/new_zone/new_ct above)
+                assert np.allclose(left, right, rtol=1e-6, atol=0.0), (
+                    "lane sweep new_cost diverged beyond reduction-order ulp"
+                )
+            else:
+                assert np.array_equal(left, right), (
+                    f"lane sweep diverged on {name!r}"
+                )
+
+    def test_lane_mesh_axes_default_split(self, monkeypatch):
+        monkeypatch.setenv("KC_SOLVER_MESH", "1")
+        monkeypatch.delenv("KC_SOLVER_MESH_DEVICES", raising=False)
+        monkeypatch.delenv("KC_SOLVER_MESH_SHAPE", raising=False)
+        axes = mesh_ops.lane_mesh_axes()
+        assert axes == ((mesh_ops.CATALOG_AXIS, 4), (mesh_ops.LANE_AXIS, 2))
+        monkeypatch.setenv("KC_SOLVER_MESH_SHAPE", "2x4")
+        axes = mesh_ops.lane_mesh_axes()
+        assert axes == ((mesh_ops.CATALOG_AXIS, 2), (mesh_ops.LANE_AXIS, 4))
+
+
+class TestWarmStartOnMesh:
+    @pytest.mark.slow
+    def test_repair_lineage_parity_on_mesh(self, monkeypatch):
+        """The incremental session's warm-start repairs run through the same
+        mesh dispatcher (carry planes sharded per the partition rules) and
+        keep the lineage identical to from-scratch solves."""
+        from karpenter_core_tpu.models.columnar import PodIngest
+        from karpenter_core_tpu.solver.incremental import (
+            FallbackPolicy,
+            IncrementalSolveSession,
+        )
+
+        monkeypatch.setenv("KC_SOLVER_MESH", "1")
+        monkeypatch.setenv("KC_SOLVER_MESH_DEVICES", "2")
+        solver, pods = build_fleet(seed=7, n_pods=48)
+        ingest = PodIngest()
+        ingest.add_all(pods)
+        session = IncrementalSolveSession(
+            solver, FallbackPolicy(audit_interval=0)
+        )
+        session.solve(ingest)
+        assert session.last_mode == "full"
+        # churn a few pods: the repair must ride the mesh path (prep captured
+        # the topology) and stay assignment-identical to a fresh full solve
+        removed = [p.uid for p in pods[:4]]
+        for uid in removed:
+            ingest.remove(uid)
+        for i in range(4):
+            ingest.add(make_pod(requests={"cpu": "500m"}))
+        session.solve(ingest)
+        assert session.last_mode == "delta"
+        lineage_sig = session.node_signature()
+
+        fresh = IncrementalSolveSession(solver, FallbackPolicy())
+        fresh.solve(ingest)
+        assert fresh.node_signature() == lineage_sig
+
+    def test_mesh_change_escalates_full(self, monkeypatch):
+        from karpenter_core_tpu.models.columnar import PodIngest
+        from karpenter_core_tpu.solver.incremental import IncrementalSolveSession
+
+        monkeypatch.setenv("KC_SOLVER_MESH", "0")
+        solver, pods = build_fleet(seed=8, n_pods=32)
+        ingest = PodIngest()
+        ingest.add_all(pods)
+        session = IncrementalSolveSession(solver)
+        session.solve(ingest)
+        assert session.last_mode == "full"
+        # steady tick stays delta while the topology holds...
+        ingest.add(make_pod(requests={"cpu": "500m"}))
+        session.solve(ingest)
+        assert session.last_mode == "delta"
+        # ...then the mesh config moves: the lineage must re-anchor
+        monkeypatch.setenv("KC_SOLVER_MESH", "1")
+        monkeypatch.setenv("KC_SOLVER_MESH_DEVICES", "2")
+        ingest.add(make_pod(requests={"cpu": "500m"}))
+        session.solve(ingest)
+        assert session.last_mode == "full"
+        assert session.last_reason == "mesh-changed"
+
+
+class TestShardedSoakSmoke:
+    def test_churn_sharded_smoke(self, monkeypatch):
+        """Scaled-down churn-steady-sharded: the mesh path exercised under
+        sustained churn through the full controller stack, with the
+        tick_wall_s probe sampled (tier-1 smoke of the slow-matrix
+        scenario)."""
+        from dataclasses import replace
+
+        from karpenter_core_tpu.soak import scenarios as soak_scenarios
+        from karpenter_core_tpu.soak.runner import run_scenario
+
+        scenario = replace(
+            soak_scenarios.churn_steady_sharded(seed=59),
+            params={
+                "duration_s": 60.0, "period_s": 60.0,
+                "base_rate_per_s": 1.0, "peak_rate_per_s": 1.0,
+                "mean_lifetime_s": 60.0,
+            },
+            slo={"rules": [
+                {"probe": "pending_pods", "agg": "final", "limit": 0.0},
+                {"probe": "machine_leaks", "agg": "max", "limit": 0.0},
+                {"probe": "tick_wall_s", "agg": "mean", "limit": 120.0},
+            ]},
+            tick_s=10.0,
+            settle_ticks=8,
+            n_instance_types=16,
+            tpu_kernel_min_pods=1,
+            env={"KC_SOLVER_MESH": "1", "KC_SOLVER_MESH_DEVICES": "2"},
+        )
+        report = run_scenario(scenario)
+        assert report["verdict"]["passed"] is True, report["verdict"]
+        # tick_wall_s is wall-clock: advisory, riding diagnostics not the
+        # replayable verdict
+        assert "tick_wall_s" in report["diagnostics"]["timings"]
+        assert any(
+            r["probe"] == "tick_wall_s"
+            for r in report["diagnostics"]["advisory_slo"]
+        )
+        # the scenario env must not leak into the process
+        import os
+
+        assert os.environ.get("KC_SOLVER_MESH") == "0"

@@ -95,6 +95,56 @@ class TestSnapshotChannel:
             assert node["provisioner"] == "default"
             assert node["instanceTypes"]
 
+    def test_policy_config_threads_through_remote_solve(self):
+        """PR 9 leftover regression: a CPU controller replica with the
+        policy objective enabled previously fell back SILENTLY to first-fit
+        selection on remote solves — PolicyConfig never crossed the wire.
+        With the ``policy`` request field, the serving side's objective
+        stage must pin the launch to the argmin offering (cheapest first,
+        zone pinned) exactly like an in-process policy solve."""
+        from karpenter_core_tpu.policy import PolicyConfig
+        from karpenter_core_tpu.service.snapshot_channel import (
+            SnapshotSolverClient,
+            serve,
+        )
+
+        provider = FakeCloudProvider()
+        its = provider.get_instance_types(None)
+        # make a non-first, always-viable catalog entry the unambiguous
+        # argmin (arm-instance-type fits any 900m batch; the objective only
+        # selects among a node's FEASIBLE cells)
+        cheapest = "arm-instance-type"
+        for it in its:
+            provider.set_price(it.name, 9.0)
+        provider.set_price(cheapest, 0.01)
+
+        server, port = serve(provider)
+        client = SnapshotSolverClient(f"127.0.0.1:{port}")
+        try:
+            pods = make_pods(4, requests={"cpu": "900m"})
+            with_policy = client.solve_classes(
+                pods, [make_provisioner()],
+                policy=PolicyConfig(enabled=True),
+            )
+            without = client.solve_classes(pods, [make_provisioner()])
+        finally:
+            client.close()
+            server.stop(0)
+
+        assert with_policy["newNodes"] and without["newNodes"]
+        for node in with_policy["newNodes"]:
+            # objective selection: argmin type ordered first, zone pinned
+            assert node["instanceTypes"][0] == cheapest
+            assert len(node["zones"]) == 1
+        # the policy-less request keeps the pre-policy behavior: viability
+        # order, nothing pinned (the silent-fallback shape this regression
+        # test exists to distinguish)
+        assert any(
+            node["instanceTypes"][0] != cheapest
+            or len(node["zones"]) > 1
+            for node in without["newNodes"]
+        )
+
     def test_solve_with_existing_nodes(self, channel):
         node = make_node(
             labels={

@@ -94,13 +94,19 @@ def last_record(platform: str):
 # per-stage.
 STAGE_KEYS = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "encode_s",
               "dispatch_s", "materialize_s", "cold_s",
-              "churn_warm_solve_s", "churn_full_solve_s", "objective_s")
+              "churn_warm_solve_s", "churn_full_solve_s", "objective_s",
+              "sharded_solve_s", "sharded_solve_1dev_s")
 # stages that matter enough to flag; the others are printed but only the
 # load-bearing ones gate (sub-10ms stages WARN on scheduler-noise otherwise)
 # objective_s gates too: the policy scoring stage rides every policy-enabled
-# decode, so a regression there is a per-reconcile cost (bench.py policy_line)
+# decode, so a regression there is a per-reconcile cost (bench.py policy_line).
+# The two sharded stages gate INDEPENDENTLY: the best-mesh solve and its
+# 1-device baseline come from bench.py's sharded_line — a sharding
+# regression cannot hide inside a flat single-device headline, and a
+# baseline regression cannot masquerade as a scaling win.
 GATED_STAGES = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "cold_s",
-                "churn_warm_solve_s", "churn_full_solve_s", "objective_s")
+                "churn_warm_solve_s", "churn_full_solve_s", "objective_s",
+                "sharded_solve_s", "sharded_solve_1dev_s")
 
 
 def compare_stages(detail: dict, prev_detail: dict, tol: float):
@@ -206,6 +212,44 @@ def report_policy(detail: dict) -> None:
         )
 
 
+def report_sharded(detail: dict) -> None:
+    """Surface the mesh scaling line: per-size solve_s, speedup, efficiency,
+    and the bit-parity fact.  The ISSUE-10 acceptance floor is a 1.5x
+    best-mesh speedup over 1-device at the 100k-pod / 2k-type fleet (or a
+    documented host-fabric cap); the enforced side is the two sharded stage
+    durations in GATED_STAGES."""
+    sharded = detail.get("sharded")
+    if not sharded:
+        return
+    if "error" in sharded:
+        print(f"perfgate: sharded bench errored: {sharded['error']}")
+        return
+    for rec in sharded.get("sizes", ()):
+        if "error" in rec:
+            print(f"perfgate: sharded mesh={rec.get('mesh_devices')} "
+                  f"errored: {rec['error']}")
+            continue
+        extra = ""
+        if "speedup" in rec:
+            extra = (f" speedup {rec['speedup']:.2f}x "
+                     f"efficiency {rec['efficiency']:.3f}")
+        print(f"perfgate: sharded mesh={rec['mesh_devices']} "
+              f"solve_s {rec['solve_s']:.4f}s{extra}")
+    if not sharded.get("identical_placements", True):
+        print(
+            "perfgate: WARNING sharded solve changed placements across mesh "
+            "sizes — the shard_map dispatch must stay bit-identical to the "
+            "single-device solve"
+        )
+    speedup = sharded.get("speedup_best")
+    if speedup is not None and speedup < 1.5:
+        print(
+            "perfgate: WARNING sharded best-mesh speedup "
+            f"{speedup:.2f}x below the 1.5x ISSUE-10 acceptance floor — "
+            "the host fabric (or a regression) is capping catalog sharding"
+        )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=0.05,
@@ -229,6 +273,7 @@ def main() -> int:
     warn_compile_budget(detail)
     report_churn(detail)
     report_policy(detail)
+    report_sharded(detail)
     if pods_per_sec is None:
         print(json.dumps(rec))
         print("perfgate: FAIL (bench produced no pods_per_sec)")
