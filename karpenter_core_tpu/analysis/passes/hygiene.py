@@ -27,6 +27,17 @@ New with the framework:
                       through utils/clock.Clock so suites advance time
                       deterministically (and soak verdicts replay from
                       their seed)
+  per-pod-loop        Python ``for`` loops (and comprehensions) iterating a
+                      pod collection inside the encode hot path
+                      (models/columnar.py, models/snapshot.py): the
+                      delta-native ingest (docs/KERNEL_PERF.md "Layer 6")
+                      columnarized the per-pod work into interned fast keys
+                      and numpy batch ops, and a new O(pods)-body loop would
+                      silently regress the million-pod tick budget.  The
+                      deliberate residual loops (the bulk-add driver whose
+                      body is O(1) dict work, the cold classify_pods batch
+                      path) carry baseline entries with reasons — the rule
+                      exists so NEW ones can't land unexplained.
 """
 
 from __future__ import annotations
@@ -58,6 +69,67 @@ _WALLCLOCK_CALLS = {
     "time.time", "datetime.now", "datetime.utcnow",
     "datetime.datetime.now", "datetime.datetime.utcnow",
 }
+
+# encode-hot-path modules the per-pod-loop rule watches (package-relative
+# dotted suffixes) and the identifier names that mark an iterable as a pod
+# collection when they appear anywhere inside a loop's iterated expression
+_PER_POD_LOOP_MODULES = ("models.columnar", "models.snapshot")
+_POD_COLLECTION_NAMES = {
+    "pods", "all_pods", "bound_pods", "tpu_pods", "host_pods", "pending_pods",
+}
+
+
+def _iter_mentions_pods(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in _POD_COLLECTION_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _POD_COLLECTION_NAMES:
+            return True
+    return False
+
+
+class _PodLoopWalker(ast.NodeVisitor):
+    """Collect (line, symbol) of loops/comprehensions over pod collections,
+    tracking the enclosing function/class qualname so baseline entries can
+    match on ``symbol`` instead of a rot-prone line number."""
+
+    def __init__(self) -> None:
+        self.stack: List[str] = []
+        self.hits: List[tuple] = []
+
+    def _symbol(self) -> str:
+        return ".".join(self.stack)
+
+    def _scoped(self, node, name: str) -> None:
+        self.stack.append(name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._scoped(node, node.name)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._scoped(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._scoped(node, node.name)
+
+    def visit_For(self, node: ast.For) -> None:
+        if _iter_mentions_pods(node.iter):
+            self.hits.append((node.lineno, self._symbol()))
+        self.generic_visit(node)
+
+    def _check_comp(self, node) -> None:
+        for gen in node.generators:
+            if _iter_mentions_pods(gen.iter):
+                self.hits.append((node.lineno, self._symbol()))
+                break
+        self.generic_visit(node)
+
+    visit_ListComp = _check_comp
+    visit_SetComp = _check_comp
+    visit_DictComp = _check_comp
+    visit_GeneratorExp = _check_comp
 
 
 class _Walker(ast.NodeVisitor):
@@ -180,6 +252,21 @@ def check_module(module: SourceModule, project: Project) -> List[Finding]:
                     "assert in shipped package code disappears under "
                     "`python -O`; raise an exception instead",
                 )
+
+    # -- per-pod-loop ----------------------------------------------------------
+    if module.in_package and any(
+        module.name.endswith(f".{suffix}") for suffix in _PER_POD_LOOP_MODULES
+    ):
+        pod_walker = _PodLoopWalker()
+        pod_walker.visit(module.tree)
+        for lineno, symbol in pod_walker.hits:
+            out.append(Finding(
+                module.relpath, lineno, "per-pod-loop",
+                "Python loop over a pod collection in the encode hot path — "
+                "columnarize it (interned fast keys / numpy batch ops) or "
+                "baseline it with a reason (docs/KERNEL_PERF.md Layer 6)",
+                NAME, symbol=symbol,
+            ))
 
     # -- wallclock -------------------------------------------------------------
     parts = module.name.split(".")

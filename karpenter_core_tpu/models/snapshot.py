@@ -26,7 +26,10 @@ from karpenter_core_tpu.apis import labels as labels_api
 from karpenter_core_tpu.apis.objects import SCHEDULE_ANYWAY, Pod
 from karpenter_core_tpu.apis.v1alpha5 import Provisioner
 from karpenter_core_tpu.cloudprovider import InstanceType
-from karpenter_core_tpu.models.vocab import Vocabulary, encode_value_set
+from karpenter_core_tpu.models.vocab import (
+    Vocabulary,
+    encode_value_sets,
+)
 from karpenter_core_tpu.scheduling import Requirements, Taints
 from karpenter_core_tpu.solver.machinetemplate import MachineTemplate
 from karpenter_core_tpu.utils import resources as resources_util
@@ -98,6 +101,12 @@ class PodClass:
     # intentionally doesn't track inverse anti preferences (topology.go:203-206)
     zone_anti_soft: bool = False
     host_anti_soft: bool = False
+    # the already-derived _class_signature of this class's shape, when the
+    # producer holds it (PodIngest slots, the controller's interner) — lets
+    # the encode's class-plane reuse key skip re-deriving O(C) signatures
+    # per tick.  MUST equal _class_signature(pods[0]) when set; None makes
+    # the key fall back to the derivation.
+    interned_sig: Optional[tuple] = None
 
     @property
     def count(self) -> int:
@@ -217,6 +226,13 @@ class EncodedSnapshot:
     pol_price: np.ndarray = None  # f32[I, Z, CT]
     pol_risk: np.ndarray = None  # f32[I, Z, CT]
     pol_throughput: np.ndarray = None  # f32[I]
+
+    # delta-consuming encode provenance: True when every class-shape-derived
+    # plane above was shared BY REFERENCE from the previous same-shape encode
+    # (cache_host._class_plane_cache) and only the count vector was rebuilt.
+    # The store's commit and the solver's warm-prep reuse both key on that
+    # array identity (docs/KERNEL_PERF.md "Layer 6").
+    encode_reused: bool = False
 
 
 def _class_signature(pod: Pod) -> tuple:
@@ -790,9 +806,10 @@ def encode_snapshot(
         scan_passes=scan_passes,
         has_required_zonal_anti=has_required_zonal_anti,
     )
-    snap.valid = vocab.valid_mask()
-    snap.is_custom = vocab.is_custom()
-    snap.vocab_ints = vocab.ints_table()
+    vocab_content = (
+        tuple(vocab.keys),
+        tuple((k, tuple(v)) for k, v in sorted(vocab.values.items())),
+    )
 
     # -- instance types -------------------------------------------------------
     # catalog planes only depend on the vocabulary content + catalog +
@@ -800,9 +817,7 @@ def encode_snapshot(
     # (cache_host carries the dict across encodes, e.g. a TPUSolver)
     I, Z, CT, R = len(it_names), len(zones), len(capacity_types), len(resources)
     cache = getattr(cache_host, "_catalog_cache", None) if cache_host is not None else None
-    cache_key = (
-        tuple(vocab.keys),
-        tuple((k, tuple(v)) for k, v in sorted(vocab.values.items())),
+    cache_key = vocab_content + (
         tuple(it_names),
         tuple(resources),
         tuple(zones),
@@ -810,7 +825,10 @@ def encode_snapshot(
         # offering content is part of the key: prices/availability can move
         # between encodes on one live solver (dynamic spot pricing —
         # FakeCloudProvider.set_price), and the cached it_price/it_avail
-        # planes must not outlive the sheet they encoded
+        # planes must not outlive the sheet they encoded.  Capacity content
+        # is NOT keyed — it_alloc has always assumed catalog capacity is
+        # immutable on a live solver, and it_capacity (cached here too now)
+        # rides the same assumption.
         tuple(
             (o.zone, o.capacity_type, o.available, o.price)
             for it in all_its
@@ -820,12 +838,9 @@ def encode_snapshot(
     if cache is not None and cache.get("key") == cache_key:
         (
             snap.it_mask, snap.it_defined, snap.it_negative, snap.it_gt, snap.it_lt,
-            snap.it_alloc, snap.it_avail, snap.it_price,
+            snap.it_alloc, snap.it_avail, snap.it_price, snap.it_capacity,
         ) = cache["planes"]
     else:
-        snap.it_alloc = np.zeros((I, R), dtype=np.float32)
-        snap.it_avail = np.zeros((I, Z, CT), dtype=bool)
-        snap.it_price = np.full((I, Z, CT), np.inf, dtype=np.float32)
         it_planes = [vocab.encode_requirements(it.requirements) for it in all_its]
         snap.it_mask, snap.it_defined, snap.it_negative, snap.it_gt, snap.it_lt = (
             np.stack([p[j] for p in it_planes]) for j in range(5)
@@ -854,26 +869,253 @@ def encode_snapshot(
             snap.it_lt = np.concatenate(
                 [snap.it_lt, np.full((n_pad_types, K), np.inf, dtype=np.float32)]
             )
+        # one vectorized scatter per plane instead of a Python store per
+        # (type, resource) / (type, offering) cell — at 2k-type catalogs the
+        # cell loops were the cold encode's floor
+        snap.it_alloc = np.zeros((I, R), dtype=np.float32)
+        snap.it_capacity = np.zeros((I, R), dtype=np.float32)
+        snap.it_avail = np.zeros((I, Z, CT), dtype=bool)
+        snap.it_price = np.full((I, Z, CT), np.inf, dtype=np.float32)
+        res_index = {name: r for r, name in enumerate(resources)}
         zone_idx2 = {z: i for i, z in enumerate(zones)}
         ct_idx2 = {c: i for i, c in enumerate(capacity_types)}
+        a_cells: List[tuple] = []  # (i, r, value) for it_alloc
+        c_cells: List[tuple] = []  # (i, r, value) for it_capacity
+        o_cells: List[tuple] = []  # (i, z, ct, price) for available offerings
         for i, it in enumerate(all_its):
-            alloc = it.allocatable()
-            for r, name in enumerate(resources):
-                snap.it_alloc[i, r] = alloc.get(name, 0.0)
+            for name, quantity in it.allocatable().items():
+                r = res_index.get(name)
+                if r is not None:
+                    a_cells.append((i, r, quantity))
+            for name, quantity in it.capacity.items():
+                r = res_index.get(name)
+                if r is not None:
+                    c_cells.append((i, r, quantity))
             for off in it.offerings:
                 if off.available:
-                    snap.it_avail[i, zone_idx2[off.zone], ct_idx2[off.capacity_type]] = True
-                    snap.it_price[i, zone_idx2[off.zone], ct_idx2[off.capacity_type]] = off.price
+                    o_cells.append((
+                        i, zone_idx2[off.zone], ct_idx2[off.capacity_type],
+                        off.price,
+                    ))
+        if a_cells:
+            rows, cols, vals = zip(*a_cells)
+            snap.it_alloc[list(rows), list(cols)] = np.asarray(vals, dtype=np.float32)
+        if c_cells:
+            rows, cols, vals = zip(*c_cells)
+            snap.it_capacity[list(rows), list(cols)] = np.asarray(vals, dtype=np.float32)
+        if o_cells:
+            rows, zcols, ccols, prices = zip(*o_cells)
+            snap.it_avail[list(rows), list(zcols), list(ccols)] = True
+            snap.it_price[list(rows), list(zcols), list(ccols)] = np.asarray(
+                prices, dtype=np.float32
+            )
         if cache_host is not None:
             cache_host._catalog_cache = {
                 "key": cache_key,
                 "planes": (
                     snap.it_mask, snap.it_defined, snap.it_negative, snap.it_gt,
                     snap.it_lt, snap.it_alloc, snap.it_avail, snap.it_price,
+                    snap.it_capacity,
                 ),
             }
-    zone_idx = {z: i for i, z in enumerate(zones)}
-    ct_idx = {c: i for i, c in enumerate(capacity_types)}
+
+    # -- class/template/group/port planes: the delta-consuming seam ----------
+    # Everything below this point is a pure function of the class SHAPES
+    # (signatures), the templates, the vocabulary, the axes, and the extra
+    # groups/ports — NOT of the per-class pod counts.  A churn tick that only
+    # moves members between existing shapes therefore reuses the previous
+    # encode's plane arrays by reference (bit-identical by construction; the
+    # arrays are treated as immutable everywhere downstream), and the store's
+    # commit skips re-digesting the untouched plane groups by the same
+    # identity (models.store.snapshot_digests).  The fresh cls_count vector
+    # is the only thing a steady-state re-encode actually computes.
+    reuse_key = None
+    prev_snap: Optional[EncodedSnapshot] = None
+    if cache_host is not None:
+        reuse_key = _class_plane_key(
+            vocab_content, snap, classes, templates, provisioners,
+            instance_types, extra_requirement_sets, extra_anti_groups,
+            extra_host_ports,
+        )
+        cached_cls = getattr(cache_host, "_class_plane_cache", None)
+        if cached_cls is not None and cached_cls.get("key") == reuse_key:
+            prev_snap = cached_cls["snap"]
+    if prev_snap is not None:
+        _share_class_planes(snap, prev_snap, classes)
+        snap.encode_reused = True
+        return snap
+
+    snap.valid = vocab.valid_mask()
+    snap.is_custom = vocab.is_custom()
+    snap.vocab_ints = vocab.ints_table()
+    _populate_class_planes(
+        snap, classes, templates, provisioners, instance_types,
+        extra_anti_groups, extra_host_ports,
+    )
+
+    # -- static phase plan ----------------------------------------------------
+    # which constraint families any class can exercise; a False flag lets the
+    # kernel skip tracing the family's phases entirely (ops/solve._class_step).
+    # Deferred import: ops.solve imports this module at load time.
+    from karpenter_core_tpu.ops.solve import SnapshotFeatures
+
+    def owns(attr: str) -> bool:
+        return any(getattr(c, attr) is not None for c in classes)
+
+    extra_groups = [spec for spec, _ in (extra_anti_groups or [])]
+    snap.features = SnapshotFeatures(
+        zone_spread=owns("zone_spread"),
+        host_spread=owns("host_spread"),
+        zone_affinity=owns("zone_affinity"),
+        host_affinity=owns("host_affinity"),
+        zone_anti=owns("zone_anti"),
+        required_zone_anti=has_required_zonal_anti,
+        host_anti=owns("host_anti"),
+        # inverse planes: groups whose owners register inverse counts —
+        # required class-owned anti terms or already-bound pods' terms
+        inv_zone_anti=has_required_zonal_anti
+        or any(g.is_zone for g in extra_groups),
+        inv_host_anti=any(
+            c.host_anti is not None and not c.host_anti_soft for c in classes
+        )
+        or any(not g.is_zone for g in extra_groups),
+        host_ports=bool(snap.cls_ports.any()),
+        volume_limits=False,  # refined by TPUSolver.solve_encoded
+    ).canonical()
+
+    if cache_host is not None:
+        cache_host._class_plane_cache = {"key": reuse_key, "snap": snap}
+    return snap
+
+
+# plane fields shared by reference on a class-plane reuse hit — everything
+# class-shape-derived; cls_count (the only count-derived plane) is rebuilt
+# fresh every encode and re-shared only when its values are unchanged
+_SHAPE_PLANE_FIELDS = (
+    "valid", "is_custom", "vocab_ints",
+    "tmpl_mask", "tmpl_defined", "tmpl_negative", "tmpl_gt", "tmpl_lt",
+    "tmpl_zone", "tmpl_ct", "tmpl_it", "tmpl_daemon", "tmpl_limits",
+    "cls_mask", "cls_defined", "cls_negative", "cls_gt", "cls_lt",
+    "cls_zone", "cls_ct", "cls_it", "cls_requests", "cls_relax_next",
+    "cls_anti_soft", "cls_root", "cls_tol", "cls_ports",
+    "grp_skew", "grp_is_zone", "grp_is_anti", "grp_member", "cls_groups",
+)
+
+
+def _requirements_content(reqs) -> tuple:
+    """Order-independent content key of one Requirements set."""
+    entries = []
+    for key in reqs.keys():
+        r = reqs.get(key)
+        entries.append((
+            key, r.complement, tuple(sorted(r.values)),
+            r.greater_than, r.less_than,
+        ))
+    return tuple(sorted(entries))
+
+
+def _class_plane_key(
+    vocab_content, snap, classes, templates, provisioners, instance_types,
+    extra_requirement_sets, extra_anti_groups, extra_host_ports,
+) -> tuple:
+    """Reuse key of the class-shape-derived plane block.  Covers every input
+    those planes read: the finalized class-signature sequence (counts
+    excluded — they are the delta), vocabulary content, the axis name
+    spaces, template content (requirements, taints, daemon overhead
+    requests), provisioner limits, per-template catalog membership, and the
+    extra group/port/requirement inputs."""
+    return (
+        vocab_content,
+        tuple(snap.resources), tuple(snap.zones), tuple(snap.capacity_types),
+        tuple(snap.it_names),
+        # the finalized ROOT-signature sequence, interned when the producer
+        # carried it (PodIngest / SignatureInterner) so steady-state ticks
+        # derive zero signatures here.  Ladder variants are implied: the
+        # chain (relax rungs, prefer-no-schedule rungs) is a deterministic
+        # function of the root's spec — which the signature captures — and
+        # of the templates, which this key covers below.
+        tuple(
+            c.interned_sig
+            if c.interned_sig is not None
+            else _class_signature(c.pods[0])
+            for c in classes
+            if not c.is_ladder_variant
+        ),
+        tuple(
+            (
+                t.provisioner_name,
+                _requirements_content(t.requirements),
+                tuple(sorted(
+                    (tt.key, tt.value, tt.effect, getattr(tt, "operator", ""))
+                    for tt in t.taints
+                )),
+                tuple(sorted((t.requests or {}).items())),
+            )
+            for t in templates
+        ),
+        tuple(
+            (
+                p.name,
+                tuple(sorted(p.spec.limits.resources.items()))
+                if p.spec.limits is not None
+                else None,
+            )
+            for p in provisioners
+        ),
+        tuple(
+            (
+                t.provisioner_name,
+                tuple(
+                    it.name
+                    for it in instance_types.get(t.provisioner_name, ())
+                ),
+            )
+            for t in templates
+        ),
+        tuple(
+            _requirements_content(r) for r in (extra_requirement_sets or ())
+        ),
+        tuple(
+            (spec, _selector_sig(sel) if sel is not None else None)
+            for spec, sel in (extra_anti_groups or ())
+        ),
+        tuple(extra_host_ports or ()),
+    )
+
+
+def _share_class_planes(snap: EncodedSnapshot, prev: EncodedSnapshot, classes) -> None:
+    """Populate ``snap`` from a previous same-shape encode: every
+    shape-derived plane by reference (identity — the store digest reuse and
+    the solver's warm-prep reuse both key on it), the count vector fresh
+    (shared back only when values are unchanged, so an idle tick stays
+    fully identity-stable)."""
+    for f in _SHAPE_PLANE_FIELDS:
+        setattr(snap, f, getattr(prev, f))
+    snap.ports = prev.ports
+    snap.groups = prev.groups
+    snap.group_selectors = prev.group_selectors
+    snap.features = prev.features
+    counts = np.array(
+        [0 if c.is_ladder_variant else c.count for c in classes],
+        dtype=np.int32,
+    )
+    if prev.cls_count is not None and np.array_equal(counts, prev.cls_count):
+        snap.cls_count = prev.cls_count
+    else:
+        snap.cls_count = counts
+
+
+def _populate_class_planes(
+    snap: EncodedSnapshot, classes, templates, provisioners, instance_types,
+    extra_anti_groups, extra_host_ports,
+) -> None:
+    """Build the class/template/group/port planes (the shape-derived block
+    ``_share_class_planes`` reuses on delta ticks) as batch operations over
+    interned name spaces — no per-universe-value Python on the hot path."""
+    vocab = snap.vocab
+    zones, capacity_types, it_names = snap.zones, snap.capacity_types, snap.it_names
+    resources = snap.resources
+    I, Z, CT, R = len(it_names), len(zones), len(capacity_types), len(resources)
 
     # -- templates ------------------------------------------------------------
     T = len(templates)
@@ -881,9 +1123,34 @@ def encode_snapshot(
     snap.tmpl_mask, snap.tmpl_defined, snap.tmpl_negative, snap.tmpl_gt, snap.tmpl_lt = (
         np.stack([p[j] for p in tmpl_planes]) for j in range(5)
     )
-    snap.tmpl_zone = np.zeros((T, Z), dtype=bool)
-    snap.tmpl_ct = np.zeros((T, CT), dtype=bool)
+
+    def req_of(reqs, label):
+        return reqs.get(label) if reqs.has(label) else None
+
+    snap.tmpl_zone = encode_value_sets(
+        [req_of(t.requirements, labels_api.LABEL_TOPOLOGY_ZONE) for t in templates],
+        zones,
+    )
+    snap.tmpl_ct = encode_value_sets(
+        [req_of(t.requirements, labels_api.LABEL_CAPACITY_TYPE) for t in templates],
+        capacity_types,
+    )
+    # catalog membership by interned name index, then AND the instance-type
+    # name requirement row — same cells as the old per-name Python walk
+    it_name_index = {name: i for i, name in enumerate(it_names)}
     snap.tmpl_it = np.zeros((T, I), dtype=bool)
+    for t, tmpl in enumerate(templates):
+        members = [
+            it_name_index[it.name]
+            for it in instance_types.get(tmpl.provisioner_name, [])
+            if it.name in it_name_index
+        ]
+        if members:
+            snap.tmpl_it[t, members] = True
+    snap.tmpl_it &= encode_value_sets(
+        [req_of(t.requirements, labels_api.LABEL_INSTANCE_TYPE_STABLE) for t in templates],
+        it_names,
+    )
     snap.tmpl_daemon = np.zeros((T, R), dtype=np.float32)
     # raw provisioner limits (scheduler.go:69-75); in-solve usage is the
     # capacity of the solve's own state nodes, subtracted in-kernel per
@@ -891,39 +1158,12 @@ def encode_snapshot(
     # consolidation subsets release their nodes' budget per lane
     snap.tmpl_limits = np.full((T, R), np.inf, dtype=np.float32)
     prov_by_name = {p.name: p for p in provisioners}
-    snap.it_capacity = np.zeros((I, R), dtype=np.float32)
-    for i, it in enumerate(all_its):
-        for r, name in enumerate(resources):
-            snap.it_capacity[i, r] = it.capacity.get(name, 0.0)
     for t, tmpl in enumerate(templates):
         prov = prov_by_name.get(tmpl.provisioner_name)
         if prov is not None and prov.spec.limits is not None:
             for r, name in enumerate(resources):
                 if name in prov.spec.limits.resources:
                     snap.tmpl_limits[t, r] = prov.spec.limits.resources[name]
-    for t, tmpl in enumerate(templates):
-        reqs = tmpl.requirements
-        snap.tmpl_zone[t] = encode_value_set(
-            reqs.get(labels_api.LABEL_TOPOLOGY_ZONE) if reqs.has(labels_api.LABEL_TOPOLOGY_ZONE) else None,
-            zones,
-        )
-        snap.tmpl_ct[t] = encode_value_set(
-            reqs.get(labels_api.LABEL_CAPACITY_TYPE) if reqs.has(labels_api.LABEL_CAPACITY_TYPE) else None,
-            capacity_types,
-        )
-        name_req = (
-            reqs.get(labels_api.LABEL_INSTANCE_TYPE_STABLE)
-            if reqs.has(labels_api.LABEL_INSTANCE_TYPE_STABLE)
-            else None
-        )
-        catalog = {it.name for it in instance_types.get(tmpl.provisioner_name, [])}
-        snap.tmpl_it[t] = np.array(
-            [
-                name in catalog and (name_req is None or name_req.has(name))
-                for name in it_names
-            ],
-            dtype=bool,
-        )
         for r, name in enumerate(resources):
             snap.tmpl_daemon[t, r] = tmpl.requests.get(name, 0.0) if tmpl.requests else 0.0
 
@@ -941,9 +1181,18 @@ def encode_snapshot(
         snap.cls_mask, snap.cls_defined, snap.cls_negative, snap.cls_gt, snap.cls_lt = (
             np.stack([p[j] for p in cls_planes]) for j in range(5)
         )
-    snap.cls_zone = np.zeros((C, Z), dtype=bool)
-    snap.cls_ct = np.zeros((C, CT), dtype=bool)
-    snap.cls_it = np.zeros((C, I), dtype=bool)
+    snap.cls_zone = encode_value_sets(
+        [req_of(c.requirements, labels_api.LABEL_TOPOLOGY_ZONE) for c in classes],
+        zones,
+    ) if C else np.zeros((0, Z), dtype=bool)
+    snap.cls_ct = encode_value_sets(
+        [req_of(c.requirements, labels_api.LABEL_CAPACITY_TYPE) for c in classes],
+        capacity_types,
+    ) if C else np.zeros((0, CT), dtype=bool)
+    snap.cls_it = encode_value_sets(
+        [req_of(c.requirements, labels_api.LABEL_INSTANCE_TYPE_STABLE) for c in classes],
+        it_names,
+    ) if C else np.zeros((0, I), dtype=bool)
     snap.cls_requests = np.zeros((C, R), dtype=np.float32)
     snap.cls_count = np.zeros(C, dtype=np.int32)
     snap.cls_relax_next = np.full(C, -1, dtype=np.int32)
@@ -998,21 +1247,6 @@ def encode_snapshot(
             if spec is not None:
                 snap.cls_groups[c, slot] = group_index[spec]
     for c, cls in enumerate(classes):
-        reqs = cls.requirements
-        snap.cls_zone[c] = encode_value_set(
-            reqs.get(labels_api.LABEL_TOPOLOGY_ZONE) if reqs.has(labels_api.LABEL_TOPOLOGY_ZONE) else None,
-            zones,
-        )
-        snap.cls_ct[c] = encode_value_set(
-            reqs.get(labels_api.LABEL_CAPACITY_TYPE) if reqs.has(labels_api.LABEL_CAPACITY_TYPE) else None,
-            capacity_types,
-        )
-        snap.cls_it[c] = encode_value_set(
-            reqs.get(labels_api.LABEL_INSTANCE_TYPE_STABLE)
-            if reqs.has(labels_api.LABEL_INSTANCE_TYPE_STABLE)
-            else None,
-            it_names,
-        )
         requests = dict(cls.requests)
         requests[resources_util.PODS] = 1.0
         for r, name in enumerate(resources):
@@ -1037,38 +1271,6 @@ def encode_snapshot(
     for c, cls in enumerate(classes):
         for key in pod_port_keys(cls.pods[0]):
             snap.cls_ports[c, port_idx[key]] = True
-
-    # -- static phase plan ----------------------------------------------------
-    # which constraint families any class can exercise; a False flag lets the
-    # kernel skip tracing the family's phases entirely (ops/solve._class_step).
-    # Deferred import: ops.solve imports this module at load time.
-    from karpenter_core_tpu.ops.solve import SnapshotFeatures
-
-    def owns(attr: str) -> bool:
-        return any(getattr(c, attr) is not None for c in classes)
-
-    extra_groups = [spec for spec, _ in (extra_anti_groups or [])]
-    snap.features = SnapshotFeatures(
-        zone_spread=owns("zone_spread"),
-        host_spread=owns("host_spread"),
-        zone_affinity=owns("zone_affinity"),
-        host_affinity=owns("host_affinity"),
-        zone_anti=owns("zone_anti"),
-        required_zone_anti=has_required_zonal_anti,
-        host_anti=owns("host_anti"),
-        # inverse planes: groups whose owners register inverse counts —
-        # required class-owned anti terms or already-bound pods' terms
-        inv_zone_anti=has_required_zonal_anti
-        or any(g.is_zone for g in extra_groups),
-        inv_host_anti=any(
-            c.host_anti is not None and not c.host_anti_soft for c in classes
-        )
-        or any(not g.is_zone for g in extra_groups),
-        host_ports=bool(snap.cls_ports.any()),
-        volume_limits=False,  # refined by TPUSolver.solve_encoded
-    ).canonical()
-
-    return snap
 
 
 def pod_port_keys(pod: Pod) -> List[tuple]:

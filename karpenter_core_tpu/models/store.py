@@ -79,13 +79,36 @@ def _digest_arrays(arrays) -> str:
     return h.hexdigest()
 
 
-def snapshot_digests(snapshot: EncodedSnapshot) -> Dict[str, str]:
+def snapshot_digests(
+    snapshot: EncodedSnapshot,
+    prev_snapshot: Optional[EncodedSnapshot] = None,
+    prev_digests: Optional[Dict[str, str]] = None,
+) -> Dict[str, str]:
     """Per-plane content digests of one encoded snapshot, plus an ``axes``
-    digest covering the name spaces the planes index into."""
-    out = {
-        name: _digest_arrays(getattr(snapshot, f, None) for f in fields)
-        for name, fields in PLANE_FIELDS.items()
-    }
+    digest covering the name spaces the planes index into.
+
+    ``prev_snapshot``/``prev_digests`` enable the delta-consuming commit: a
+    plane group whose every array is the SAME OBJECT as in the previously
+    digested snapshot reuses the previous digest instead of re-hashing the
+    bytes.  The delta-native encode (models.snapshot ``encode_reused``)
+    shares unchanged planes by reference exactly so this identity test
+    fires; content digests stay content digests — identity is only ever a
+    proof that the content cannot have changed (planes are immutable
+    post-encode)."""
+    out = {}
+    for name, fields in PLANE_FIELDS.items():
+        prev = prev_digests.get(name) if prev_digests else None
+        if (
+            prev is not None
+            and prev_snapshot is not None
+            and all(
+                getattr(snapshot, f, None) is getattr(prev_snapshot, f, None)
+                for f in fields
+            )
+        ):
+            out[name] = prev
+            continue
+        out[name] = _digest_arrays(getattr(snapshot, f, None) for f in fields)
     h = hashlib.sha256()
     for axis in (
         snapshot.resources, snapshot.zones, snapshot.capacity_types,
@@ -101,7 +124,13 @@ def snapshot_digests(snapshot: EncodedSnapshot) -> Dict[str, str]:
 def class_key(cls) -> tuple:
     """Version-stable identity of one class row: the equivalence-class
     signature of its representative pod (ladder variants carry the relaxed
-    representative, so each rung keys distinctly)."""
+    representative, so each rung keys distinctly).  Producers that already
+    hold the signature stamp it on the class (``PodClass.interned_sig``,
+    contract: equals the derivation exactly) so commits skip the O(C)
+    re-derivation."""
+    sig = getattr(cls, "interned_sig", None)
+    if sig is not None:
+        return sig
     return _class_signature(cls.pods[0])
 
 
@@ -313,12 +342,24 @@ class SnapshotStore:
         self.current: Optional[VersionedSnapshot] = None
 
     def commit(self, snapshot: EncodedSnapshot, supply: str = "") -> VersionedSnapshot:
-        """Stamp one encode output as the next version and make it current."""
+        """Stamp one encode output as the next version and make it current.
+
+        Consumes the delta-native encode's reuse: plane groups the encode
+        shared by reference from the previous committed snapshot keep their
+        digests without re-hashing a byte — on a steady-state churn tick only
+        the ``classes`` group (whose cls_count moved) and the recomputed
+        ``policy`` planes touch the hasher, so the commit cost scales with
+        what changed, not with the fleet."""
         self._version += 1
+        prev = self.current
         versioned = VersionedSnapshot(
             version=self._version,
             snapshot=snapshot,
-            digests=snapshot_digests(snapshot),
+            digests=snapshot_digests(
+                snapshot,
+                prev_snapshot=prev.snapshot if prev is not None else None,
+                prev_digests=prev.digests if prev is not None else None,
+            ),
             rows=rows_from_snapshot(snapshot),
             supply=supply,
         )

@@ -208,3 +208,38 @@ def encode_value_set(requirement: Optional[Requirement], universe: List[str]) ->
     if requirement is None:
         return np.ones(len(universe), dtype=bool)
     return np.array([requirement.has(v) for v in universe], dtype=bool)
+
+
+def encode_value_sets(
+    requirements: List[Optional[Requirement]], universe: List[str]
+) -> np.ndarray:
+    """bool[N, len(universe)]: ``encode_value_set`` batched over a requirement
+    list through ONE interned universe index.  Plain In requirements (no
+    complement, no numeric bounds — the overwhelmingly common case) fill by
+    value-index lookup in O(|values|) instead of a ``has`` call per universe
+    value, which matters when the universe is the instance-type catalog
+    (thousands of names per class row).  Bit-identical to the scalar path
+    (tests/test_encode_delta.py fuzzes the equivalence)."""
+    n_universe = len(universe)
+    out = np.ones((len(requirements), n_universe), dtype=bool)
+    index: Optional[Dict[str, int]] = None
+    for i, req in enumerate(requirements):
+        if req is None:
+            continue
+        if (
+            not req.complement
+            and req.greater_than is None
+            and req.less_than is None
+        ):
+            if index is None:
+                index = {v: j for j, v in enumerate(universe)}
+            row = np.zeros(n_universe, dtype=bool)
+            for v in req.values:
+                j = index.get(v)
+                if j is not None:
+                    row[j] = True
+            out[i] = row
+        else:
+            # complement/bounded operators keep the exact scalar semantics
+            out[i] = encode_value_set(req, universe)
+    return out

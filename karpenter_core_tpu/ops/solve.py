@@ -26,6 +26,7 @@ data-dependent Python control flow.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -2412,10 +2413,17 @@ def _pad_req(t: mask_ops.ReqTensor, k_new: int, v_new: int) -> mask_ops.ReqTenso
     )
 
 
-def pad_planes(cls, statics_arrays, key_has_bounds, ex_state=None, ex_static=None):
+def pad_planes(cls, statics_arrays, key_has_bounds, ex_state=None, ex_static=None,
+               device_finish=False):
     """Bucket-pad kernel inputs (host numpy pytrees from prepare_host /
     TPUSolver.encode_existing).  Returns (cls, statics_arrays, key_has_bounds,
-    ex_state, ex_static) with stable shapes across nearby problem sizes."""
+    ex_state, ex_static) with stable shapes across nearby problem sizes.
+
+    ``device_finish`` assembles the class-axis planes ON DEVICE under a small
+    memoized jit (``finish_class_planes_device``): the host ships the compact
+    class rows and the broadcast/scatter into the padded bucket happens
+    device-side — bit-identical fills, smaller host→device transfer, no host
+    np.pad over the class block (docs/KERNEL_PERF.md "Layer 6")."""
     sa = StaticArrays(*statics_arrays)
 
     c_old = cls.count.shape[0]
@@ -2430,31 +2438,37 @@ def pad_planes(cls, statics_arrays, key_has_bounds, ex_state=None, ex_static=Non
     g1_new = bucket(g1_old - 1, floor=4) + 1
     p_new = bucket(p_old, floor=4)
 
-    groups = np.asarray(cls.groups)
-    groups = np.where(groups >= g1_old - 1, g1_new - 1, groups)
-    cls_t = _pad_req(
-        mask_ops.ReqTensor(cls.mask, cls.defined, cls.negative, cls.gt, cls.lt),
-        k_new, v_new,
-    )
-    cls = ClassTensors(
-        mask=_pad_axis(cls_t.mask, 0, c_new, True),
-        defined=_pad_axis(cls_t.defined, 0, c_new, False),
-        negative=_pad_axis(cls_t.negative, 0, c_new, False),
-        gt=_pad_axis(cls_t.gt, 0, c_new, -np.inf),
-        lt=_pad_axis(cls_t.lt, 0, c_new, np.inf),
-        zone=_pad_axis(np.asarray(cls.zone), 0, c_new, True),
-        ct=_pad_axis(np.asarray(cls.ct), 0, c_new, True),
-        it=_pad_axis(np.asarray(cls.it), 0, c_new, True),
-        requests=_pad_axis(np.asarray(cls.requests), 0, c_new, 0),
-        count=_pad_axis(np.asarray(cls.count), 0, c_new, 0),
-        tol=_pad_axis(np.asarray(cls.tol), 0, c_new, False),
-        ports=_pad_axis(_pad_axis(np.asarray(cls.ports), -1, p_new, False), 0, c_new, False),
-        groups=_pad_axis(groups, 0, c_new, g1_new - 1),
-        relax_next=_pad_axis(np.asarray(cls.relax_next), 0, c_new, -1),
-        anti_soft=_pad_axis(np.asarray(cls.anti_soft), 0, c_new, False),
-        # padded rows never place (count 0), so any root value is inert
-        root=_pad_axis(np.asarray(cls.root), 0, c_new, 0),
-    )
+    if device_finish:
+        cls = finish_class_planes_device(
+            cls, c_new=c_new, k_new=k_new, v_new=v_new,
+            g1_old=g1_old, g1_new=g1_new, p_new=p_new,
+        )
+    else:
+        groups = np.asarray(cls.groups)
+        groups = np.where(groups >= g1_old - 1, g1_new - 1, groups)
+        cls_t = _pad_req(
+            mask_ops.ReqTensor(cls.mask, cls.defined, cls.negative, cls.gt, cls.lt),
+            k_new, v_new,
+        )
+        cls = ClassTensors(
+            mask=_pad_axis(cls_t.mask, 0, c_new, True),
+            defined=_pad_axis(cls_t.defined, 0, c_new, False),
+            negative=_pad_axis(cls_t.negative, 0, c_new, False),
+            gt=_pad_axis(cls_t.gt, 0, c_new, -np.inf),
+            lt=_pad_axis(cls_t.lt, 0, c_new, np.inf),
+            zone=_pad_axis(np.asarray(cls.zone), 0, c_new, True),
+            ct=_pad_axis(np.asarray(cls.ct), 0, c_new, True),
+            it=_pad_axis(np.asarray(cls.it), 0, c_new, True),
+            requests=_pad_axis(np.asarray(cls.requests), 0, c_new, 0),
+            count=_pad_axis(np.asarray(cls.count), 0, c_new, 0),
+            tol=_pad_axis(np.asarray(cls.tol), 0, c_new, False),
+            ports=_pad_axis(_pad_axis(np.asarray(cls.ports), -1, p_new, False), 0, c_new, False),
+            groups=_pad_axis(groups, 0, c_new, g1_new - 1),
+            relax_next=_pad_axis(np.asarray(cls.relax_next), 0, c_new, -1),
+            anti_soft=_pad_axis(np.asarray(cls.anti_soft), 0, c_new, False),
+            # padded rows never place (count 0), so any root value is inert
+            root=_pad_axis(np.asarray(cls.root), 0, c_new, 0),
+        )
 
     statics_arrays = sa._replace(
         it=_pad_req(sa.it, k_new, v_new),
@@ -2527,3 +2541,77 @@ def pad_planes(cls, statics_arrays, key_has_bounds, ex_state=None, ex_static=Non
             ),
         )
     return cls, statics_arrays, key_has_bounds, ex_state, ex_static
+
+
+# -- device-side plane finishing (docs/KERNEL_PERF.md "Layer 6") --------------
+#
+# The encode's class planes are compact (C rows); the executable wants the
+# bucket-padded layout.  With KC_ENCODE_DEVICE_FINISH=1 the pad/scatter runs
+# ON DEVICE under a small memoized jit: the host→device transfer carries the
+# exact class rows and the padded planes never exist host-side.  Fill values
+# mirror pad_planes' host branch cell for cell, so the two finishing paths
+# are bit-identical (tests/test_encode_delta.py pins it).
+
+
+def encode_device_finish_enabled() -> bool:
+    """KC_ENCODE_DEVICE_FINISH=1 opts the prepare path into device-side
+    class-plane finishing (default off: on CPU backends the device IS the
+    host, so the jit adds dispatch cost for no transfer win)."""
+    return os.environ.get("KC_ENCODE_DEVICE_FINISH", "0") == "1"
+
+
+def _jpad(a, axis, target, value):
+    cur = a.shape[axis]
+    if cur >= target:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, target - cur)
+    return jnp.pad(a, widths, constant_values=value)
+
+
+def _jwiden_mask(mask, v_new):
+    v = mask.shape[-1] - 1
+    if v >= v_new:
+        return mask
+    block = jnp.zeros(mask.shape[:-1] + (v_new - v,), dtype=mask.dtype)
+    return jnp.concatenate([mask[..., :v], block, mask[..., v:]], axis=-1)
+
+
+@functools.lru_cache(maxsize=64)
+def _cls_finish_fn(c_new: int, k_new: int, v_new: int, g1_old: int,
+                   g1_new: int, p_new: int):
+    """One jitted finisher per (bucket-target, group-extent) combination —
+    steady-state encodes reuse a single compiled program per shape bucket."""
+
+    def finish(cls):
+        mask = _jwiden_mask(cls.mask, v_new)
+        mask = _jpad(mask, -2, k_new, True)
+        groups = jnp.where(cls.groups >= g1_old - 1, g1_new - 1, cls.groups)
+        return ClassTensors(
+            mask=_jpad(mask, 0, c_new, True),
+            defined=_jpad(_jpad(cls.defined, -1, k_new, False), 0, c_new, False),
+            negative=_jpad(_jpad(cls.negative, -1, k_new, False), 0, c_new, False),
+            gt=_jpad(_jpad(cls.gt, -1, k_new, -jnp.inf), 0, c_new, -jnp.inf),
+            lt=_jpad(_jpad(cls.lt, -1, k_new, jnp.inf), 0, c_new, jnp.inf),
+            zone=_jpad(cls.zone, 0, c_new, True),
+            ct=_jpad(cls.ct, 0, c_new, True),
+            it=_jpad(cls.it, 0, c_new, True),
+            requests=_jpad(cls.requests, 0, c_new, 0),
+            count=_jpad(cls.count, 0, c_new, 0),
+            tol=_jpad(cls.tol, 0, c_new, False),
+            ports=_jpad(_jpad(cls.ports, -1, p_new, False), 0, c_new, False),
+            groups=_jpad(groups, 0, c_new, g1_new - 1),
+            relax_next=_jpad(cls.relax_next, 0, c_new, -1),
+            anti_soft=_jpad(cls.anti_soft, 0, c_new, False),
+            # padded rows never place (count 0), so any root value is inert
+            root=_jpad(cls.root, 0, c_new, 0),
+        )
+
+    return jax.jit(finish)
+
+
+def finish_class_planes_device(cls, c_new: int, k_new: int, v_new: int,
+                               g1_old: int, g1_new: int, p_new: int):
+    """Padded ClassTensors assembled on device from the compact host rows —
+    the device-finishing twin of pad_planes' host class branch."""
+    return _cls_finish_fn(c_new, k_new, v_new, g1_old, g1_new, p_new)(cls)

@@ -216,6 +216,9 @@ class SoakRunner:
             nodes=len(nodes),
             fleet_cost=self._fleet_cost(nodes, env.provider),
             solve_latency_s=env.provisioning.last_reconcile_s or 0.0,
+            # per-batch host ingest/classification wall: the advisory probe
+            # that keeps the delta-native encode path honest under soak
+            ingest_s=getattr(env.provisioning, "last_ingest_s", 0.0) or 0.0,
         )
 
     # -- the run ---------------------------------------------------------------

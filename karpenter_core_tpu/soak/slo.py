@@ -62,6 +62,11 @@ PROBES: Dict[str, bool] = {
     # bookkeeping blows this while every deterministic probe looks clean).
     # Wall-clock ⇒ advisory: excluded from the replayable verdict digest.
     "tick_wall_s": False,
+    # host-side ingest/classification wall seconds of the last provisioning
+    # batch (ProvisioningController.last_ingest_s): the per-tick encode-path
+    # budget the delta-native ingest keeps flat while the fleet grows
+    # (docs/KERNEL_PERF.md "Layer 6").  Wall-clock ⇒ advisory.
+    "ingest_s": False,
 }
 
 AGG_MAX = "max"
@@ -93,6 +98,7 @@ class Observation:
     fleet_cost: float = 0.0  # summed current-offering price of live nodes
     solve_latency_s: float = 0.0  # wall seconds (advisory)
     tick_wall_s: float = 0.0  # whole-tick wall seconds (advisory)
+    ingest_s: float = 0.0  # last batch's host ingest/classify wall (advisory)
 
     def probe_values(self) -> Dict[str, float]:
         return {
@@ -105,6 +111,7 @@ class Observation:
             "fleet_cost_per_tick": round(self.fleet_cost, 6),
             "solve_latency_s": self.solve_latency_s,
             "tick_wall_s": self.tick_wall_s,
+            "ingest_s": self.ingest_s,
         }
 
 

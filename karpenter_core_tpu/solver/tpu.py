@@ -307,10 +307,14 @@ class TPUSolver:
             "encode",
             classes=len(classes) if classes is not None else None,
             state_nodes=len(state_nodes or ()),
-        ):
-            return self._encode_with_classes_impl(
+        ) as sp:
+            snapshot = self._encode_with_classes_impl(
                 pods, classes, state_nodes, bound_pods
             )
+            # delta-consuming encode provenance: True when the class planes
+            # were shared by reference from the previous same-shape encode
+            sp.set(**{"encode.reused": snapshot.encode_reused})
+            return snapshot
 
     def _encode_with_classes_impl(
         self,
@@ -804,6 +808,22 @@ class TPUSolver:
             logging.getLogger(__name__).debug("kernel warmup failed: %s", e)
             return False
 
+    # snapshot fields whose identity anchors the warm-prep reuse: everything
+    # prepare_host reads EXCEPT cls_count (the per-tick delta).  The
+    # delta-native encode shares these by reference across same-shape ticks,
+    # so a repeat prepare ships only the fresh count vector.
+    _PREP_ANCHOR_FIELDS = (
+        "cls_mask", "cls_defined", "cls_negative", "cls_gt", "cls_lt",
+        "cls_zone", "cls_ct", "cls_it", "cls_requests", "cls_tol", "cls_ports",
+        "cls_groups", "cls_relax_next", "cls_anti_soft", "cls_root",
+        "it_mask", "it_defined", "it_negative", "it_gt", "it_lt",
+        "it_alloc", "it_avail", "it_capacity",
+        "tmpl_mask", "tmpl_defined", "tmpl_negative", "tmpl_gt", "tmpl_lt",
+        "tmpl_zone", "tmpl_ct", "tmpl_it", "tmpl_daemon", "tmpl_limits",
+        "valid", "is_custom", "vocab_ints",
+        "grp_skew", "grp_is_zone", "grp_is_anti", "grp_member",
+    )
+
     def prepare_encoded(
         self,
         snapshot: EncodedSnapshot,
@@ -815,7 +835,18 @@ class TPUSolver:
         included, bucket-padded (unless KC_TPU_SHAPE_BUCKETS=0) and ready for
         ``run_prepared``.  Splitting prepare from run is what lets the
         incremental session hold a prep across reconciles and re-run it with
-        a delta count vector + warm carry (docs/INCREMENTAL.md)."""
+        a delta count vector + warm carry (docs/INCREMENTAL.md).
+
+        Two delta-native fast paths (docs/KERNEL_PERF.md "Layer 6"): when the
+        snapshot's shape planes are IDENTICAL (by reference — the delta
+        encode's contract) to the last prepared ones and no existing-node
+        planes are needed, the previous prep is reused with only a fresh
+        padded count vector — the compact delta is all that moves.  And with
+        KC_ENCODE_DEVICE_FINISH=1 the class-plane bucket padding is assembled
+        on device under a small jit instead of host np.pad."""
+        from karpenter_core_tpu.parallel import mesh as mesh_mod
+        from karpenter_core_tpu.utils import compilecache
+
         ex_state = ex_static = None
         if state_nodes:
             with tracing.span("encode.existing", state_nodes=len(state_nodes)):
@@ -825,17 +856,42 @@ class TPUSolver:
         if n_slots <= 0:
             n_slots = solve_ops.estimate_slots(snapshot)  # snap_slots applied inside
         features = solve_ops.features_with_existing(snapshot, ex_static)
+        pad = os.environ.get("KC_TPU_SHAPE_BUCKETS", "1") != "0"
+        anchors = None
+        if ex_state is None and pad:
+            anchors = tuple(
+                getattr(snapshot, f, None) for f in self._PREP_ANCHOR_FIELDS
+            )
+            cached = getattr(self, "_prep_cache", None)
+            if cached is not None and all(
+                a is b for a, b in zip(cached["anchors"], anchors)
+            ):
+                prev: SolvePrep = cached["prep"]
+                c_pad = np.asarray(prev.cls.count).shape[0]
+                count = solve_ops._pad_axis(
+                    np.asarray(snapshot.cls_count, dtype=np.int32), 0, c_pad, 0
+                )
+                return SolvePrep(
+                    cls=prev.cls._replace(count=count),
+                    statics_arrays=prev.statics_arrays,
+                    key_has_bounds=prev.key_has_bounds,
+                    ex_state=None, ex_static=None,
+                    n_slots=n_slots, n_passes=snapshot.scan_passes,
+                    features=features,
+                    mesh_axes=compilecache.resolve_mesh_axes(
+                        mesh_mod.solve_mesh_axes(),
+                        solve_ops.StaticArrays(*prev.statics_arrays),
+                    ),
+                )
         cls, statics_arrays, key_has_bounds = solve_ops.prepare_host(snapshot)
-        if os.environ.get("KC_TPU_SHAPE_BUCKETS", "1") != "0":
+        if pad:
             cls, statics_arrays, key_has_bounds, ex_state, ex_static = (
                 solve_ops.pad_planes(
-                    cls, statics_arrays, key_has_bounds, ex_state, ex_static
+                    cls, statics_arrays, key_has_bounds, ex_state, ex_static,
+                    device_finish=solve_ops.encode_device_finish_enabled(),
                 )
             )
-        from karpenter_core_tpu.parallel import mesh as mesh_mod
-        from karpenter_core_tpu.utils import compilecache
-
-        return SolvePrep(
+        prep = SolvePrep(
             cls=cls, statics_arrays=statics_arrays, key_has_bounds=key_has_bounds,
             ex_state=ex_state, ex_static=ex_static, n_slots=n_slots,
             n_passes=snapshot.scan_passes, features=features,
@@ -843,6 +899,9 @@ class TPUSolver:
                 mesh_mod.solve_mesh_axes(), solve_ops.StaticArrays(*statics_arrays)
             ),
         )
+        if anchors is not None:
+            self._prep_cache = {"anchors": anchors, "prep": prep}
+        return prep
 
     def run_prepared(
         self,

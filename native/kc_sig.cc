@@ -1,0 +1,523 @@
+// kc_sig: CPython extension twin of models/columnar._fast_sig_key_py.
+//
+// The ingest hot loop's only per-pod work is building an EXACT fast key over
+// the pod spec (docs/KERNEL_PERF.md "Layer 6"); at million-pod fleets the
+// Python attribute walk is the host-side wall, so this module rebuilds the
+// same key with C-API attribute reads.  Contract (pinned by the parity fuzz
+// in tests/test_encode_delta.py):
+//
+//   fast_sig_key(pod) -> tuple  EXACTLY the tuple the Python twin builds —
+//                               the two implementations' keys live in one
+//                               dict and must compare/hash equal
+//                     -> None   shape not fast-key-able (multi/init
+//                               containers, limits, host ports, PVC claims):
+//                               the caller derives the full signature
+//                     -> NotImplemented
+//                               shape is fast-key-able but outside this
+//                               module's coverage (node affinity, multi-term
+//                               or preferred pod affinity): the caller runs
+//                               the Python twin
+//
+// Any structural surprise (missing attribute, non-dict where a dict is
+// expected) degrades to None — never a wrong key.  Values (strings, ints)
+// pass through untouched, so key equality semantics are Python's own.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+namespace {
+
+// interned attribute names (module-lifetime references)
+PyObject *S_spec, *S_metadata, *S_containers, *S_init_containers, *S_resources,
+    *S_limits, *S_ports, *S_host_port, *S_volumes, *S_persistent_volume_claim,
+    *S_namespace, *S_labels, *S_node_selector, *S_requests, *S_affinity,
+    *S_topology_spread_constraints, *S_tolerations, *S_key, *S_operator,
+    *S_value, *S_effect, *S_topology_key, *S_max_skew, *S_when_unsatisfiable,
+    *S_label_selector, *S_match_labels, *S_match_expressions, *S_values,
+    *S_node_affinity, *S_pod_affinity, *S_pod_anti_affinity, *S_required,
+    *S_preferred, *S_namespaces, *S_namespace_selector, *S_aff1, *S_empty_str;
+PyObject *EMPTY_TUPLE;
+
+// attribute read; NULL (error cleared) means "punt"
+PyObject *attr(PyObject *o, PyObject *name) {
+  PyObject *v = PyObject_GetAttr(o, name);
+  if (v == nullptr) PyErr_Clear();
+  return v;
+}
+
+// tuple(d.items()) for an exact dict; NULL = punt.  Insertion order is
+// preserved (PyDict_Next), matching Python's items() iteration.
+PyObject *items_tuple(PyObject *d) {
+  if (d == Py_None) return nullptr;
+  if (!PyDict_CheckExact(d)) return nullptr;
+  Py_ssize_t n = PyDict_Size(d);
+  PyObject *out = PyTuple_New(n);
+  if (out == nullptr) { PyErr_Clear(); return nullptr; }
+  Py_ssize_t pos = 0, i = 0;
+  PyObject *k, *v;
+  while (PyDict_Next(d, &pos, &k, &v)) {
+    PyObject *pair = PyTuple_Pack(2, k, v);
+    if (pair == nullptr) { PyErr_Clear(); Py_DECREF(out); return nullptr; }
+    PyTuple_SET_ITEM(out, i++, pair);
+  }
+  return out;
+}
+
+// attribute that must be an exact list; NULL = punt
+PyObject *list_attr(PyObject *o, PyObject *name) {
+  PyObject *v = attr(o, name);
+  if (v == nullptr) return nullptr;
+  if (!PyList_CheckExact(v)) { Py_DECREF(v); return nullptr; }
+  return v;  // new reference
+}
+
+// the _fast_selector_key twin: (match_labels items, match_expressions tuple)
+// or Py_None for a None selector; NULL = punt
+PyObject *selector_key(PyObject *sel) {
+  if (sel == Py_None) Py_RETURN_NONE;
+  PyObject *ml = attr(sel, S_match_labels);
+  if (ml == nullptr) return nullptr;
+  PyObject *ml_t = items_tuple(ml);
+  Py_DECREF(ml);
+  if (ml_t == nullptr) return nullptr;
+  PyObject *me = list_attr(sel, S_match_expressions);
+  if (me == nullptr) { Py_DECREF(ml_t); return nullptr; }
+  Py_ssize_t n = PyList_GET_SIZE(me);
+  PyObject *me_t = PyTuple_New(n);
+  if (me_t == nullptr) { PyErr_Clear(); Py_DECREF(ml_t); Py_DECREF(me); return nullptr; }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(me, i);
+    PyObject *k = attr(e, S_key);
+    PyObject *op = attr(e, S_operator);
+    PyObject *vals = attr(e, S_values);
+    PyObject *vals_t = (vals == nullptr) ? nullptr : PySequence_Tuple(vals);
+    if (vals_t == nullptr) PyErr_Clear();
+    Py_XDECREF(vals);
+    if (k == nullptr || op == nullptr || vals_t == nullptr) {
+      Py_XDECREF(k); Py_XDECREF(op); Py_XDECREF(vals_t);
+      Py_DECREF(ml_t); Py_DECREF(me); Py_DECREF(me_t);
+      return nullptr;
+    }
+    PyObject *entry = PyTuple_New(3);
+    if (entry == nullptr) {
+      PyErr_Clear();
+      Py_DECREF(k); Py_DECREF(op); Py_DECREF(vals_t);
+      Py_DECREF(ml_t); Py_DECREF(me); Py_DECREF(me_t);
+      return nullptr;
+    }
+    PyTuple_SET_ITEM(entry, 0, k);
+    PyTuple_SET_ITEM(entry, 1, op);
+    PyTuple_SET_ITEM(entry, 2, vals_t);
+    PyTuple_SET_ITEM(me_t, i, entry);
+  }
+  Py_DECREF(me);
+  PyObject *out = PyTuple_New(2);
+  if (out == nullptr) { PyErr_Clear(); Py_DECREF(ml_t); Py_DECREF(me_t); return nullptr; }
+  PyTuple_SET_ITEM(out, 0, ml_t);
+  PyTuple_SET_ITEM(out, 1, me_t);
+  return out;
+}
+
+enum Verdict { OK, PUNT_FULL, PUNT_PY };
+
+// core: build the key into *out (new ref) or report a punt
+Verdict build_key(PyObject *pod, PyObject **out) {
+  *out = nullptr;
+  PyObject *spec = attr(pod, S_spec);
+  if (spec == nullptr) return PUNT_FULL;
+  Verdict verdict = PUNT_FULL;
+  PyObject *containers = nullptr, *c0 = nullptr, *resources = nullptr;
+  PyObject *metadata = nullptr, *base_ns = nullptr, *labels_t = nullptr;
+  PyObject *nodesel_t = nullptr, *requests_t = nullptr;
+  PyObject *affinity = nullptr, *spreads = nullptr, *tolerations = nullptr;
+  PyObject *tol_key = nullptr, *spread_key = nullptr, *aff_key = nullptr;
+  PyObject *tmp = nullptr;
+
+  containers = list_attr(spec, S_containers);
+  if (containers == nullptr || PyList_GET_SIZE(containers) != 1) goto done;
+  tmp = attr(spec, S_init_containers);
+  if (tmp == nullptr) goto done;
+  {
+    int truth = PyObject_IsTrue(tmp);
+    Py_CLEAR(tmp);
+    if (truth != 0) goto done;  // init containers (or error) -> full signature
+  }
+  c0 = PyList_GET_ITEM(containers, 0);  // borrowed
+  resources = attr(c0, S_resources);
+  if (resources == nullptr) goto done;
+  tmp = attr(resources, S_limits);
+  if (tmp == nullptr) goto done;
+  {
+    int truth = PyObject_IsTrue(tmp);
+    Py_CLEAR(tmp);
+    if (truth != 0) goto done;
+  }
+  tmp = attr(c0, S_ports);
+  if (tmp == nullptr) goto done;
+  if (PyList_CheckExact(tmp)) {
+    for (Py_ssize_t i = 0; i < PyList_GET_SIZE(tmp); i++) {
+      PyObject *hp = attr(PyList_GET_ITEM(tmp, i), S_host_port);
+      if (hp == nullptr) { Py_CLEAR(tmp); goto done; }
+      int truth = PyObject_IsTrue(hp);
+      Py_DECREF(hp);
+      if (truth != 0) { Py_CLEAR(tmp); goto done; }
+    }
+    Py_CLEAR(tmp);
+  } else {
+    int truth = PyObject_IsTrue(tmp);
+    Py_CLEAR(tmp);
+    if (truth != 0) goto done;  // non-list truthy ports: punt
+  }
+  tmp = attr(spec, S_volumes);
+  if (tmp == nullptr) goto done;
+  if (PyList_CheckExact(tmp)) {
+    for (Py_ssize_t i = 0; i < PyList_GET_SIZE(tmp); i++) {
+      PyObject *pvc = attr(PyList_GET_ITEM(tmp, i), S_persistent_volume_claim);
+      if (pvc == nullptr) { Py_CLEAR(tmp); goto done; }
+      int is_none = (pvc == Py_None);
+      Py_DECREF(pvc);
+      if (!is_none) { Py_CLEAR(tmp); goto done; }
+    }
+    Py_CLEAR(tmp);
+  } else {
+    int truth = PyObject_IsTrue(tmp);
+    Py_CLEAR(tmp);
+    if (truth != 0) goto done;
+  }
+
+  // -- base ------------------------------------------------------------------
+  metadata = attr(pod, S_metadata);
+  if (metadata == nullptr) goto done;
+  tmp = attr(metadata, S_namespace);
+  if (tmp == nullptr) goto done;
+  {
+    int truth = PyObject_IsTrue(tmp);
+    if (truth < 0) { Py_CLEAR(tmp); goto done; }
+    if (truth) {
+      base_ns = tmp;  // steal
+      tmp = nullptr;
+    } else {
+      Py_CLEAR(tmp);
+      base_ns = S_empty_str;
+      Py_INCREF(base_ns);
+    }
+  }
+  tmp = attr(metadata, S_labels);
+  if (tmp == nullptr) goto done;
+  labels_t = items_tuple(tmp);
+  Py_CLEAR(tmp);
+  if (labels_t == nullptr) goto done;
+  tmp = attr(spec, S_node_selector);
+  if (tmp == nullptr) goto done;
+  nodesel_t = items_tuple(tmp);
+  Py_CLEAR(tmp);
+  if (nodesel_t == nullptr) goto done;
+  tmp = attr(resources, S_requests);
+  if (tmp == nullptr) goto done;
+  requests_t = items_tuple(tmp);
+  Py_CLEAR(tmp);
+  if (requests_t == nullptr) goto done;
+
+  affinity = attr(spec, S_affinity);
+  if (affinity == nullptr) goto done;
+  spreads = list_attr(spec, S_topology_spread_constraints);
+  if (spreads == nullptr) goto done;
+  tolerations = list_attr(spec, S_tolerations);
+  if (tolerations == nullptr) goto done;
+
+  if (affinity == Py_None && PyList_GET_SIZE(spreads) == 0 &&
+      PyList_GET_SIZE(tolerations) == 0) {
+    *out = PyTuple_New(4);
+    if (*out == nullptr) { PyErr_Clear(); goto done; }
+    PyTuple_SET_ITEM(*out, 0, base_ns);
+    PyTuple_SET_ITEM(*out, 1, labels_t);
+    PyTuple_SET_ITEM(*out, 2, nodesel_t);
+    PyTuple_SET_ITEM(*out, 3, requests_t);
+    base_ns = labels_t = nodesel_t = requests_t = nullptr;  // stolen
+    verdict = OK;
+    goto done;
+  }
+
+  // -- tolerations -----------------------------------------------------------
+  {
+    Py_ssize_t n = PyList_GET_SIZE(tolerations);
+    tol_key = PyTuple_New(n);
+    if (tol_key == nullptr) { PyErr_Clear(); goto done; }
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *t = PyList_GET_ITEM(tolerations, i);
+      PyObject *k = attr(t, S_key), *op = attr(t, S_operator);
+      PyObject *v = attr(t, S_value), *eff = attr(t, S_effect);
+      if (k == nullptr || op == nullptr || v == nullptr || eff == nullptr) {
+        Py_XDECREF(k); Py_XDECREF(op); Py_XDECREF(v); Py_XDECREF(eff);
+        goto done;
+      }
+      PyObject *entry = PyTuple_New(4);
+      if (entry == nullptr) {
+        PyErr_Clear();
+        Py_DECREF(k); Py_DECREF(op); Py_DECREF(v); Py_DECREF(eff);
+        goto done;
+      }
+      PyTuple_SET_ITEM(entry, 0, k);
+      PyTuple_SET_ITEM(entry, 1, op);
+      PyTuple_SET_ITEM(entry, 2, v);
+      PyTuple_SET_ITEM(entry, 3, eff);
+      PyTuple_SET_ITEM(tol_key, i, entry);
+    }
+  }
+
+  // -- spreads ---------------------------------------------------------------
+  {
+    Py_ssize_t n = PyList_GET_SIZE(spreads);
+    if (n == 1) {
+      // flat 4-tuple, mirroring the Python twin's one-constraint branch
+      PyObject *c = PyList_GET_ITEM(spreads, 0);
+      PyObject *tk = attr(c, S_topology_key), *sk = attr(c, S_max_skew);
+      PyObject *wu = attr(c, S_when_unsatisfiable);
+      PyObject *sel = attr(c, S_label_selector);
+      PyObject *sel_k = (sel == nullptr) ? nullptr : selector_key(sel);
+      Py_XDECREF(sel);
+      if (tk == nullptr || sk == nullptr || wu == nullptr || sel_k == nullptr) {
+        Py_XDECREF(tk); Py_XDECREF(sk); Py_XDECREF(wu); Py_XDECREF(sel_k);
+        goto done;
+      }
+      spread_key = PyTuple_New(4);
+      if (spread_key == nullptr) {
+        PyErr_Clear();
+        Py_DECREF(tk); Py_DECREF(sk); Py_DECREF(wu); Py_DECREF(sel_k);
+        goto done;
+      }
+      PyTuple_SET_ITEM(spread_key, 0, tk);
+      PyTuple_SET_ITEM(spread_key, 1, sk);
+      PyTuple_SET_ITEM(spread_key, 2, wu);
+      PyTuple_SET_ITEM(spread_key, 3, sel_k);
+    } else if (n == 0) {
+      spread_key = EMPTY_TUPLE;
+      Py_INCREF(spread_key);
+    } else {
+      spread_key = PyTuple_New(n);
+      if (spread_key == nullptr) { PyErr_Clear(); goto done; }
+      for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *c = PyList_GET_ITEM(spreads, i);
+        PyObject *tk = attr(c, S_topology_key), *sk = attr(c, S_max_skew);
+        PyObject *wu = attr(c, S_when_unsatisfiable);
+        PyObject *sel = attr(c, S_label_selector);
+        PyObject *sel_k = (sel == nullptr) ? nullptr : selector_key(sel);
+        Py_XDECREF(sel);
+        if (tk == nullptr || sk == nullptr || wu == nullptr || sel_k == nullptr) {
+          Py_XDECREF(tk); Py_XDECREF(sk); Py_XDECREF(wu); Py_XDECREF(sel_k);
+          goto done;
+        }
+        PyObject *entry = PyTuple_New(4);
+        if (entry == nullptr) {
+          PyErr_Clear();
+          Py_DECREF(tk); Py_DECREF(sk); Py_DECREF(wu); Py_DECREF(sel_k);
+          goto done;
+        }
+        PyTuple_SET_ITEM(entry, 0, tk);
+        PyTuple_SET_ITEM(entry, 1, sk);
+        PyTuple_SET_ITEM(entry, 2, wu);
+        PyTuple_SET_ITEM(entry, 3, sel_k);
+        PyTuple_SET_ITEM(spread_key, i, entry);
+      }
+    }
+  }
+
+  // -- affinity --------------------------------------------------------------
+  if (affinity == Py_None) {
+    aff_key = Py_None;
+    Py_INCREF(aff_key);
+  } else {
+    PyObject *na = attr(affinity, S_node_affinity);
+    PyObject *pa = attr(affinity, S_pod_affinity);
+    PyObject *anti = attr(affinity, S_pod_anti_affinity);
+    if (na == nullptr || pa == nullptr || anti == nullptr) {
+      Py_XDECREF(na); Py_XDECREF(pa); Py_XDECREF(anti);
+      goto done;
+    }
+    bool flat = false;
+    PyObject *required = nullptr, *preferred = nullptr;
+    if (pa != Py_None && na == Py_None && anti == Py_None) {
+      required = list_attr(pa, S_required);
+      preferred = list_attr(pa, S_preferred);
+      flat = required != nullptr && preferred != nullptr &&
+             PyList_GET_SIZE(preferred) == 0 && PyList_GET_SIZE(required) == 1;
+    }
+    Py_DECREF(na); Py_DECREF(pa); Py_DECREF(anti);
+    if (!flat) {
+      Py_XDECREF(required); Py_XDECREF(preferred);
+      // shape outside this module's coverage: the Python twin handles it
+      verdict = PUNT_PY;
+      goto done;
+    }
+    PyObject *term = PyList_GET_ITEM(required, 0);  // borrowed
+    PyObject *tk = attr(term, S_topology_key);
+    PyObject *sel = attr(term, S_label_selector);
+    PyObject *sel_k = (sel == nullptr) ? nullptr : selector_key(sel);
+    Py_XDECREF(sel);
+    PyObject *ns = attr(term, S_namespaces);
+    PyObject *ns_sel = attr(term, S_namespace_selector);
+    Py_DECREF(required); Py_DECREF(preferred);
+    if (tk == nullptr || sel_k == nullptr || ns == nullptr || ns_sel == nullptr) {
+      Py_XDECREF(tk); Py_XDECREF(sel_k); Py_XDECREF(ns); Py_XDECREF(ns_sel);
+      goto done;
+    }
+    PyObject *ns_t;
+    int ns_truth = PyObject_IsTrue(ns);
+    if (ns_truth < 0) {
+      Py_DECREF(tk); Py_DECREF(sel_k); Py_DECREF(ns); Py_DECREF(ns_sel);
+      PyErr_Clear();
+      goto done;
+    }
+    if (ns_truth) {
+      ns_t = PySequence_Tuple(ns);
+      if (ns_t == nullptr) {
+        PyErr_Clear();
+        Py_DECREF(tk); Py_DECREF(sel_k); Py_DECREF(ns); Py_DECREF(ns_sel);
+        goto done;
+      }
+    } else {
+      ns_t = EMPTY_TUPLE;
+      Py_INCREF(ns_t);
+    }
+    Py_DECREF(ns);
+    PyObject *ns_sel_k;
+    if (ns_sel == Py_None) {
+      ns_sel_k = Py_None;
+      Py_INCREF(ns_sel_k);
+    } else {
+      ns_sel_k = selector_key(ns_sel);
+    }
+    Py_DECREF(ns_sel);
+    if (ns_sel_k == nullptr) {
+      Py_DECREF(tk); Py_DECREF(sel_k); Py_DECREF(ns_t);
+      goto done;
+    }
+    aff_key = PyTuple_New(5);
+    if (aff_key == nullptr) {
+      PyErr_Clear();
+      Py_DECREF(tk); Py_DECREF(sel_k); Py_DECREF(ns_t); Py_DECREF(ns_sel_k);
+      goto done;
+    }
+    Py_INCREF(S_aff1);
+    PyTuple_SET_ITEM(aff_key, 0, S_aff1);
+    PyTuple_SET_ITEM(aff_key, 1, tk);
+    PyTuple_SET_ITEM(aff_key, 2, sel_k);
+    PyTuple_SET_ITEM(aff_key, 3, ns_t);
+    PyTuple_SET_ITEM(aff_key, 4, ns_sel_k);
+  }
+
+  *out = PyTuple_New(7);
+  if (*out == nullptr) { PyErr_Clear(); goto done; }
+  PyTuple_SET_ITEM(*out, 0, base_ns);
+  PyTuple_SET_ITEM(*out, 1, labels_t);
+  PyTuple_SET_ITEM(*out, 2, nodesel_t);
+  PyTuple_SET_ITEM(*out, 3, requests_t);
+  PyTuple_SET_ITEM(*out, 4, tol_key);
+  PyTuple_SET_ITEM(*out, 5, spread_key);
+  PyTuple_SET_ITEM(*out, 6, aff_key);
+  base_ns = labels_t = nodesel_t = requests_t = nullptr;  // stolen
+  tol_key = spread_key = aff_key = nullptr;
+  verdict = OK;
+
+done:
+  // the degrade contract: every punt path returns a clean None/NotImplemented
+  // — some guards (PyObject_IsTrue) may have left an exception set, and a
+  // non-NULL return with a live error flag is a C-API violation that would
+  // surface as a SystemError instead of the full-signature fallback
+  if (PyErr_Occurred()) PyErr_Clear();
+  Py_XDECREF(spec);
+  Py_XDECREF(containers);
+  Py_XDECREF(resources);
+  Py_XDECREF(metadata);
+  Py_XDECREF(base_ns);
+  Py_XDECREF(labels_t);
+  Py_XDECREF(nodesel_t);
+  Py_XDECREF(requests_t);
+  Py_XDECREF(affinity);
+  Py_XDECREF(spreads);
+  Py_XDECREF(tolerations);
+  Py_XDECREF(tol_key);
+  Py_XDECREF(spread_key);
+  Py_XDECREF(aff_key);
+  Py_XDECREF(tmp);
+  return verdict;
+}
+
+PyObject *fast_sig_key(PyObject *, PyObject *pod) {
+  PyObject *out = nullptr;
+  switch (build_key(pod, &out)) {
+    case OK:
+      return out;
+    case PUNT_PY:
+      Py_RETURN_NOTIMPLEMENTED;
+    case PUNT_FULL:
+    default:
+      Py_RETURN_NONE;
+  }
+}
+
+PyMethodDef methods[] = {
+    {"fast_sig_key", fast_sig_key, METH_O,
+     "Exact fast signature key of one pod (None = derive the full "
+     "signature; NotImplemented = use the Python twin)."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "kc_sig",
+    "C twin of models/columnar._fast_sig_key_py (see that docstring for the "
+    "exactness contract).",
+    -1, methods, nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_kc_sig(void) {
+  PyObject *m = PyModule_Create(&moduledef);
+  if (m == nullptr) return nullptr;
+#define INTERN(var, s)                                \
+  var = PyUnicode_InternFromString(s);                \
+  if (var == nullptr) return nullptr;
+  INTERN(S_spec, "spec")
+  INTERN(S_metadata, "metadata")
+  INTERN(S_containers, "containers")
+  INTERN(S_init_containers, "init_containers")
+  INTERN(S_resources, "resources")
+  INTERN(S_limits, "limits")
+  INTERN(S_ports, "ports")
+  INTERN(S_host_port, "host_port")
+  INTERN(S_volumes, "volumes")
+  INTERN(S_persistent_volume_claim, "persistent_volume_claim")
+  INTERN(S_namespace, "namespace")
+  INTERN(S_labels, "labels")
+  INTERN(S_node_selector, "node_selector")
+  INTERN(S_requests, "requests")
+  INTERN(S_affinity, "affinity")
+  INTERN(S_topology_spread_constraints, "topology_spread_constraints")
+  INTERN(S_tolerations, "tolerations")
+  INTERN(S_key, "key")
+  INTERN(S_operator, "operator")
+  INTERN(S_value, "value")
+  INTERN(S_effect, "effect")
+  INTERN(S_topology_key, "topology_key")
+  INTERN(S_max_skew, "max_skew")
+  INTERN(S_when_unsatisfiable, "when_unsatisfiable")
+  INTERN(S_label_selector, "label_selector")
+  INTERN(S_match_labels, "match_labels")
+  INTERN(S_match_expressions, "match_expressions")
+  INTERN(S_values, "values")
+  INTERN(S_node_affinity, "node_affinity")
+  INTERN(S_pod_affinity, "pod_affinity")
+  INTERN(S_pod_anti_affinity, "pod_anti_affinity")
+  INTERN(S_required, "required")
+  INTERN(S_preferred, "preferred")
+  INTERN(S_namespaces, "namespaces")
+  INTERN(S_namespace_selector, "namespace_selector")
+  INTERN(S_aff1, "aff1")
+#undef INTERN
+  S_empty_str = PyUnicode_InternFromString("");
+  if (S_empty_str == nullptr) return nullptr;
+  EMPTY_TUPLE = PyTuple_New(0);
+  if (EMPTY_TUPLE == nullptr) return nullptr;
+  return m;
+}

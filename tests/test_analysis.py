@@ -219,9 +219,69 @@ class TestHygiene:
         assert hygiene.run(project) == []
 
     def test_current_tree_clean(self, repo_project):
-        """The repo itself stays hygiene-clean (the make-verify contract)."""
+        """The repo itself stays hygiene-clean after the checked-in baseline
+        (the make-verify contract) — the only raw findings allowed are the
+        documented per-pod-loop suppressions."""
+        from karpenter_core_tpu.analysis.core import Baseline, apply_baseline
+
+        baseline = Baseline.load(
+            Path(__file__).resolve().parents[1]
+            / "karpenter_core_tpu" / "analysis" / "baseline.toml"
+        )
         found = hygiene.run(repo_project)
-        assert found == [], "\n".join(f.render() for f in found)
+        assert {f.rule for f in found} <= {"per-pod-loop"}, "\n".join(
+            f.render() for f in found
+        )
+        kept, _suppressed = apply_baseline(found, baseline)
+        assert kept == [], "\n".join(f.render() for f in kept)
+
+    def test_per_pod_loop_flags_encode_hot_path_only(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/models/columnar.py": """\
+                def ingest(pods):
+                    out = []
+                    for pod in pods:
+                        out.append(pod)
+                    return out
+            """,
+            # pod loops OUTSIDE the encode hot path are not this rule's
+            # business (the controllers legitimately iterate batches)
+            "badpkg/controllers/thing.py": """\
+                def count(pods):
+                    return len([p for p in pods])
+            """,
+        })
+        found = [f for f in hygiene.run(project) if f.rule == "per-pod-loop"]
+        assert len(found) == 1
+        assert found[0].path == "badpkg/models/columnar.py"
+        assert found[0].symbol == "ingest"
+
+    def test_per_pod_loop_comprehensions_and_attributes(self, tmp_path):
+        """Comprehensions count, and so do pod-collection ATTRIBUTES
+        (slot.pods.values()) — the loop shape doesn't matter, the O(pods)
+        body does; the symbol carries the method qualname so baseline
+        entries survive line churn."""
+        project = make_project(tmp_path, {
+            "badpkg/models/snapshot.py": """\
+                class Encoder:
+                    def walk(self, slot):
+                        return [p.uid for p in slot.pods.values()]
+            """,
+        })
+        found = [f for f in hygiene.run(project) if f.rule == "per-pod-loop"]
+        assert len(found) == 1
+        assert found[0].symbol == "Encoder.walk"
+
+    def test_per_pod_loop_clean_class_loops_silent(self, tmp_path):
+        """Loops over CLASSES (the O(distinct shapes) solve-path unit) never
+        trip the rule — only pod collections do."""
+        project = make_project(tmp_path, {
+            "badpkg/models/snapshot.py": """\
+                def encode(classes):
+                    return [c.requirements for c in classes]
+            """,
+        })
+        assert [f for f in hygiene.run(project) if f.rule == "per-pod-loop"] == []
 
 
 # -- trace safety -------------------------------------------------------------

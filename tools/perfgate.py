@@ -92,9 +92,11 @@ def last_record(platform: str):
 # warm-path regression can't hide inside healthy cold numbers (and vice
 # versa).  Records older than a split simply lack the keys and are skipped
 # per-stage.
-STAGE_KEYS = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "encode_s",
+STAGE_KEYS = ("solve_decode_s", "solve_s", "decode_s", "ingest_s",
+              "classify_s", "planes_s", "upload_s", "encode_s",
               "dispatch_s", "materialize_s", "cold_s",
-              "churn_warm_solve_s", "churn_full_solve_s", "objective_s",
+              "churn_warm_solve_s", "churn_full_solve_s",
+              "churn_delta_ingest_s", "objective_s",
               "sharded_solve_s", "sharded_solve_1dev_s")
 # stages that matter enough to flag; the others are printed but only the
 # load-bearing ones gate (sub-10ms stages WARN on scheduler-noise otherwise)
@@ -105,6 +107,13 @@ STAGE_KEYS = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "encode_s",
 # regression cannot hide inside a flat single-device headline, and a
 # baseline regression cannot masquerade as a scaling win.
 GATED_STAGES = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "cold_s",
+                # the ingest sub-stages (ISSUE 11) gate INDEPENDENTLY: a
+                # classify regression cannot hide inside a flat ingest
+                # number, a plane-construction regression cannot hide behind
+                # a fast classify, and the per-tick delta ingest cannot
+                # silently go O(fleet).  Records older than the split lack
+                # the keys and are skipped per-stage, as usual.
+                "classify_s", "planes_s", "upload_s", "churn_delta_ingest_s",
                 "churn_warm_solve_s", "churn_full_solve_s", "objective_s",
                 "sharded_solve_s", "sharded_solve_1dev_s")
 
@@ -173,6 +182,25 @@ def report_churn(detail: dict) -> None:
             i=churn.get("identical_assignments"),
         )
     )
+    if churn.get("delta_ingest_s") is not None:
+        frac = churn.get("delta_ingest_fraction_of_full")
+        print(
+            "perfgate: churn delta ingest {d:.5f}s for {n} churned pods "
+            "(full re-ingest {f:.4f}s, fraction {r})".format(
+                d=churn["delta_ingest_s"],
+                n=churn.get("churned_pods_per_tick"),
+                f=churn.get("full_ingest_s") or 0.0,
+                r=frac,
+            )
+        )
+        # O(churned) acceptance: at 2% churn the delta tick must cost a
+        # small fraction of the O(fleet) re-ingest (ISSUE 11)
+        if frac is not None and frac > 0.5:
+            print(
+                "perfgate: WARNING churn delta ingest cost is approaching "
+                "the O(fleet) re-ingest — the membership-delta path is not "
+                "paying for itself"
+            )
     if churn.get("speedup", 0.0) < 2.0:
         print(
             "perfgate: WARNING churn speedup below the 2x ISSUE-7 acceptance "
