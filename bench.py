@@ -660,6 +660,79 @@ def sharded_probe(n_pods: int, n_its: int, mesh_devices: int) -> None:
     }))
 
 
+def tenant_line(n_tenants: int = 8, pods_per_tenant: int = 256) -> dict:
+    """Multi-tenant coalescing benchmark (ISSUE 12, docs/SERVICE.md): N
+    synthetic tenants whose snapshots share one shape bucket (the production
+    regime — many clusters, few distinct pod shapes), solved two ways:
+
+      serial    N solo dispatches of the same warm executable, one per
+                tenant — what N uncoalesced requests cost the device
+      batched   ONE vmapped dispatch over the tenant-stacked planes
+                (service.tenant.BatchCoalescer._run_batched)
+
+    Reports both throughputs, the speedup, and the serial path's p99
+    per-solve latency; tools/perfgate.py prints an advisory report and warns
+    when batching stops paying (speedup <= 1).  Env: KC_BENCH_TENANTS,
+    KC_BENCH_TENANT_PODS; KC_BENCH_TENANT=0 skips the line."""
+    from karpenter_core_tpu.cloudprovider import fake as fake_cp
+    from karpenter_core_tpu.models.columnar import PodIngest
+    from karpenter_core_tpu.ops import solve as solve_ops
+    from karpenter_core_tpu.service.tenant import BatchCoalescer, bucket_key
+    from karpenter_core_tpu.soak.slo import percentile
+    from karpenter_core_tpu.solver.tpu import TPUSolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    provider = fake_cp.FakeCloudProvider()
+    provisioners = [make_provisioner()]
+    sizes = [{"cpu": "500m"}, {"cpu": "250m"}, {"cpu": 1, "memory": "1Gi"}]
+    preps = []
+    solvers = []
+    for t in range(n_tenants):
+        solver = TPUSolver(provider, provisioners)
+        ingest = PodIngest()
+        ingest.add_all([
+            make_pod(requests=sizes[(t + i) % len(sizes)])
+            for i in range(pods_per_tenant)
+        ])
+        snapshot = solver.encode(ingest)
+        preps.append(solver.prepare_encoded(snapshot))
+        solvers.append(solver)
+    buckets = {bucket_key(p) for p in preps}
+    # warm both executables: compiles stay outside the timed region
+    solve_ops.sync_outputs(solvers[0].run_prepared(preps[0]))
+    BatchCoalescer._run_batched(preps)
+
+    serial_s = float("inf")
+    lat: list = []
+    for _ in range(3):
+        lats = []
+        t0 = time.perf_counter()
+        for solver, prep in zip(solvers, preps):
+            t1 = time.perf_counter()
+            solve_ops.sync_outputs(solver.run_prepared(prep))
+            lats.append(time.perf_counter() - t1)
+        total = time.perf_counter() - t0
+        if total < serial_s:
+            serial_s, lat = total, lats
+    batched_s = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        BatchCoalescer._run_batched(preps)  # device_gets internally: synced
+        batched_s = min(batched_s, time.perf_counter() - t0)
+    p99 = percentile(lat, 0.99)  # the soak SLO engine's nearest-rank
+    return {
+        "tenants": n_tenants,
+        "pods_per_tenant": pods_per_tenant,
+        "shape_buckets": len(buckets),
+        "serial_s": round(serial_s, 4),
+        "batched_s": round(batched_s, 4),
+        "speedup": round(serial_s / batched_s, 2) if batched_s > 0 else None,
+        "serial_solves_per_s": round(n_tenants / serial_s, 2),
+        "batched_solves_per_s": round(n_tenants / batched_s, 2),
+        "p99_serial_solve_s": round(p99, 4),
+    }
+
+
 def sharded_line() -> dict:
     """The mesh scaling study (docs/KERNEL_PERF.md "Layer 5"): the SAME fleet
     solved at mesh sizes 1/2/4/8 (KC_BENCH_SHARDED_SIZES, trimmed to what the
@@ -968,6 +1041,21 @@ def main() -> None:
             traceback.print_exc(file=sys.stderr)
             sharded = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # multi-tenant coalescing: batched (vmapped tenant axis) vs serial solves
+    # over N same-bucket tenants (docs/SERVICE.md); KC_BENCH_TENANT=0 skips.
+    tenant = None
+    if os.environ.get("KC_BENCH_TENANT", "1") != "0":
+        try:
+            tenant = tenant_line(
+                n_tenants=int(os.environ.get("KC_BENCH_TENANTS", "8")),
+                pods_per_tenant=int(os.environ.get("KC_BENCH_TENANT_PODS", "256")),
+            )
+        except Exception as e:  # noqa: BLE001 - tenant line never kills the headline
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            tenant = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # restart cold: a fresh process with the persistent caches this process
     # just populated — the cost every operator restart actually pays.  The
     # child inherits os.environ, so a CPU fallback pins it too.
@@ -1028,6 +1116,14 @@ def main() -> None:
         # fleet-cost delta (must stay > 0 on the demo fleet)
         detail["objective_s"] = policy["objective_s"]
         detail["policy_fleet_cost_delta"] = policy["fleet_cost_delta"]
+    detail["tenant"] = tenant
+    if tenant and "error" not in tenant:
+        # mirrors for the perfgate advisory report (batched must keep beating
+        # serial — coalescing that stops paying is a regression even when
+        # the single-tenant headline stays flat)
+        detail["tenant_batched_solve_s"] = tenant["batched_s"]
+        detail["tenant_serial_solve_s"] = tenant["serial_s"]
+        detail["tenant_speedup"] = tenant["speedup"]
     detail["sharded"] = sharded
     if sharded and "error" not in sharded and "solve_s_1dev" in sharded:
         # stage mirrors so tools/perfgate.py gates the sharded path

@@ -61,9 +61,13 @@ MAX_LINE = 120
 # (soak/ is in: its probes and traces live on the FakeClock timeline, and a
 # stray wall read would silently break verdict seed-replay; policy/ is in:
 # objective decisions and counter-proposals run inside reconciles and soak
-# ticks, so a wall read there breaks the same replay guarantees)
+# ticks, so a wall read there breaks the same replay guarantees; service/ is
+# in: the tenant plane's TTL/lease/breaker/bucket policy must step on
+# FakeClock for the multi-tenant suites — latency MEASUREMENT uses
+# time.perf_counter, which stays allowed)
 _CLOCKED_DIRS = (
     "controllers", "state", "operator", "solver", "kubeapi", "soak", "policy",
+    "service",
 )
 _WALLCLOCK_CALLS = {
     "time.time", "datetime.now", "datetime.utcnow",

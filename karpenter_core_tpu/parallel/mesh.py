@@ -54,6 +54,7 @@ from karpenter_core_tpu.utils import compilecache
 
 CATALOG_AXIS = "catalog"
 LANE_AXIS = "lane"
+TENANT_AXIS = "tenant"
 
 # partition rules: leaf-path regex -> the axis index the catalog shards.
 # Applied to every solve pytree (ClassTensors/StaticArrays/NodeState/
@@ -70,6 +71,16 @@ CATALOG_PARTITION_RULES: Tuple[Tuple[str, int], ...] = (
     (r"\.tmpl_it$", 1),
     (r"\.it$", 1),
     (r"\.viable$", 1),
+)
+
+# tenant-batch partition rules (the multi-tenant coalesced solve,
+# service/tenant.py): the coalescer stacks EVERY solve pytree leaf with a
+# leading tenant axis, so one catch-all rule shards axis 0 of every leaf —
+# each device holds T/D whole tenants and no collectives cross them (tenant
+# solves are independent by construction).  Same rule-by-regex machinery as
+# the catalog rules above, just a different axis and rule set.
+TENANT_PARTITION_RULES: Tuple[Tuple[str, int], ...] = (
+    (r".", 0),
 )
 
 
@@ -91,8 +102,8 @@ def named_tree_map(fn, tree, path: str = ""):
     return fn(path, tree)
 
 
-def _spec_for(path: str, axis_name: str):
-    for pattern, axis in CATALOG_PARTITION_RULES:
+def _spec_for(path: str, axis_name: str, rules=CATALOG_PARTITION_RULES):
+    for pattern, axis in rules:
         if re.search(pattern, path):
             return P(*([None] * axis), axis_name)
     return P()
@@ -187,6 +198,61 @@ def lane_mesh_axes() -> Optional[Tuple[Tuple[str, int], ...]]:
         )
     lanes = 2 if n >= 4 and n % 2 == 0 else 1
     return ((CATALOG_AXIS, n // lanes), (LANE_AXIS, lanes))
+
+
+def tenant_partition_specs(tree):
+    """PartitionSpec pytree for a tenant-stacked solve pytree: every leaf
+    shards its leading (tenant) axis (TENANT_PARTITION_RULES)."""
+    return named_tree_map(
+        lambda p, _leaf: _spec_for(p, TENANT_AXIS, TENANT_PARTITION_RULES), tree
+    )
+
+
+def tenant_mesh_shardings(tree, mesh: Mesh):
+    """NamedSharding pytree for a tenant-stacked solve pytree — the
+    device_put layout for a coalesced batch's inputs."""
+    return named_tree_map(
+        lambda p, _leaf: NamedSharding(
+            mesh, _spec_for(p, TENANT_AXIS, TENANT_PARTITION_RULES)
+        ),
+        tree,
+    )
+
+
+def tenant_mesh_axes(n_tenants: int) -> Optional[Tuple[Tuple[str, int], ...]]:
+    """Mesh topology for one coalesced tenant batch, or None for the
+    vmap-only (single-device) path.  Gated by the same KC_SOLVER_MESH switch
+    as the solve mesh; the device count must divide the batch size so every
+    shard holds whole tenants — otherwise the batch runs unsharded (always
+    correct, the batched executable is the same vmap body)."""
+    forced = _env_tristate("KC_SOLVER_MESH")
+    if forced is False:
+        return None
+    n = _mesh_device_count()
+    if forced is None and n <= 1:
+        return None
+    if n < 1 or n_tenants % n != 0:
+        return None
+    return ((TENANT_AXIS, n),)
+
+
+def tenant_solve_callable(mesh_axes, base_plain, structs):
+    """jit(shard_map(vmap(solve))) for one coalesced tenant batch: the batch
+    splits over the mesh's tenant axis, each device vmaps its local tenants
+    through the plain (collective-free) solve body.  ``structs`` are the
+    tenant-STACKED positional arg pytrees (ShapeDtypeStructs or arrays);
+    the caller memoizes (utils.compilecache.batched_solve_callable)."""
+    mesh = mesh_for(mesh_axes)
+    vmapped = jax.vmap(base_plain)
+    in_specs = tuple(tenant_partition_specs(s) for s in structs)
+    out_specs = tenant_partition_specs(jax.eval_shape(vmapped, *structs))
+    return jax.jit(shard_map(
+        vmapped, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        # every output leaf is sharded over the tenant axis (no replicated
+        # outputs to verify) and tenants never exchange data; the coalesced
+        # parity tests (tests/test_tenant_service.py) pin bit-identity
+        check_rep=False,
+    ))
 
 
 def catalog_pad_multiple() -> int:

@@ -18,10 +18,29 @@ pending pods into shape classes (models.columnar.PodIngest) and ships ONE
 representative pod + count per class — O(distinct shapes) on the wire instead
 of O(pods) — and gets back per-node class counts it expands locally.  At 50k
 pods / ~13 shapes that is a ~4000× smaller request than /Solve.
+
+The MULTI-TENANT layer (service/tenant.py, docs/SERVICE.md): a SolveClasses
+request carrying a ``tenant`` envelope routes through admission control
+(token-bucket rate limits + a bounded global queue, RESOURCE_EXHAUSTED sheds
+with a retry-after hint), a per-tenant server-side incremental-solve session
+(LRU + TTL, ``session-lost`` re-anchor after restarts), per-tenant circuit
+breakers, and the batch coalescer that stacks compatible-shape-bucket
+tenants into ONE vmapped device solve.  Requests without the envelope keep
+the original stateless contract exactly.
+
+``service.rpc`` is the chaos point on this channel — the one major I/O
+boundary the other six points don't cover.  It fires on both sides: the
+client wrapper (error/timeout raised before the call leaves) and the server
+handlers (error → UNAVAILABLE, timeout → DEADLINE_EXCEEDED, partial →
+the solve runs but the response is dropped, latency through the armed
+clock) — so chaos suites can flap the whole service and watch the
+controller's solver breaker + degraded mode absorb it.
 """
 
 from __future__ import annotations
 
+import copy
+import hashlib
 import json
 import logging
 import os
@@ -32,14 +51,33 @@ from typing import Dict, List, Optional
 import grpc
 import msgpack
 
+from karpenter_core_tpu import chaos, tracing
 from karpenter_core_tpu.apis import codec
 from karpenter_core_tpu.models.snapshot import KernelUnsupported
+from karpenter_core_tpu.service import tenant as tenant_mod
 from karpenter_core_tpu.solver.tpu import TPUSolver
 from karpenter_core_tpu.state.cluster import StateNode
 
 log = logging.getLogger(__name__)
 
 SERVICE = "karpenter.v1.SnapshotSolver"
+
+# the gRPC channel's injection point (docs/CHAOS.md): one Point, both
+# transports — like kubeapi.put covers both kube backends
+SERVICE_RPC = chaos.point("service.rpc")
+
+
+class _AbortRequest(Exception):
+    """Internal: carry a (code, details) abort decision out of helper depth
+    to the handler boundary.  ``context.abort`` raises a BARE Exception, so
+    calling it under a ``try/except Exception`` would get the abort re-caught
+    and re-labeled INTERNAL — helpers raise this instead and the outermost
+    handler translates it exactly once."""
+
+    def __init__(self, code, details: str) -> None:
+        super().__init__(details)
+        self.code = code
+        self.details = details
 
 
 class _WireVolumeResolver:
@@ -92,8 +130,16 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
     server-assigned resourceVersion; wall-clock staleness is judged by the
     electors, not here."""
 
-    def __init__(self, cloud_provider) -> None:
+    def __init__(self, cloud_provider, clock=None, tenant_config=None) -> None:
         self.cloud_provider = cloud_provider
+        # the multi-tenant plane: admission + sessions + breakers + coalescer
+        # (service/tenant.py).  ``clock`` drives every timing policy so
+        # FakeClock suites can step TTLs and breaker windows.
+        self.tenants = tenant_mod.TenantPlane(clock=clock, config=tenant_config)
+        # server-side per-RPC deadline: an abandoned/slow client cannot pin a
+        # worker past this (0 disables); checked at the solve stage
+        # boundaries, the coarsest-grained units of handler work
+        self.deadline_s = tenant_mod._env_f("KC_SERVICE_DEADLINE_S", 120.0)
         self._leases: Dict[tuple, Dict] = {}
         self._lease_lock = threading.Lock()
         # best-effort durability: a solver restart that wiped the lease map
@@ -154,6 +200,39 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
     def _health(self, request: bytes, context) -> bytes:
         return msgpack.packb({"status": "ok"})
 
+    def _rpc_chaos(self, context, method: str):
+        """Server-transport leg of the ``service.rpc`` chaos point.  error →
+        UNAVAILABLE now, timeout → DEADLINE_EXCEEDED now, latency applied by
+        the plane (armed clock); a ``partial`` fault is RETURNED so the
+        handler can do its full work and then drop the response — the
+        wasted-work shape real partial failures have."""
+        fault = SERVICE_RPC.hit(
+            kinds=("error", "timeout", "partial"), side="server", method=method
+        )
+        if fault is None:
+            return None
+        if fault.kind == "partial":
+            return fault
+        if fault.kind == "timeout":
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, fault.describe())
+        context.abort(grpc.StatusCode.UNAVAILABLE, fault.describe())
+
+    def _deadline_guard(self, context, t0: float) -> None:
+        """Server-side per-RPC deadline (KC_SERVICE_DEADLINE_S): checked at
+        the solve-stage boundaries so an abandoned or glacial client cannot
+        pin a worker forever; also drops work for clients that already
+        disconnected.  Raises _AbortRequest (translated at the handler
+        boundary)."""
+        if context is None:
+            return
+        if not context.is_active():
+            raise _AbortRequest(grpc.StatusCode.CANCELLED, "client disconnected")
+        if self.deadline_s and tenant_mod.monotonic() - t0 > self.deadline_s:
+            raise _AbortRequest(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"server-side deadline {self.deadline_s:.1f}s exceeded",
+            )
+
     def _consolidate(self, request: bytes, context) -> bytes:
         """Multi-node consolidation sweep on the device: every prefix of the
         disruption-sorted candidate list simulated in parallel
@@ -164,6 +243,7 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
         from karpenter_core_tpu.controllers.deprovisioning import CandidateNode
         from karpenter_core_tpu.solver.consolidation import TPUConsolidationSearch
 
+        partial = self._rpc_chaos(context, "Consolidate")
         try:
             req = msgpack.unpackb(request)
             provisioners, daemonset_pods, state_nodes, bound, resolver, node_pods = (
@@ -255,12 +335,15 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                     for r in cmd.replacement_nodes
                 ],
             }
-            return msgpack.packb(response)
+            payload = msgpack.packb(response)
         except KernelUnsupported as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, f"kernel unsupported: {e}")
         except Exception as e:  # noqa: BLE001 - surface as INTERNAL
             log.exception("consolidate request failed")
             context.abort(grpc.StatusCode.INTERNAL, str(e))
+        if partial is not None:
+            context.abort(grpc.StatusCode.UNAVAILABLE, partial.describe())
+        return payload
 
     def _lease_get(self, request: bytes, context) -> bytes:
         req = msgpack.unpackb(request)
@@ -336,11 +419,64 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             state_nodes.append(state_node)
         return provisioners, daemonset_pods, state_nodes, bound, resolver, node_pods
 
+    @staticmethod
+    def _classes_payload(results, class_counts) -> Dict:
+        """The SolveClasses response body for one TPUSolveResults;
+        ``class_counts(pods) -> [(class_index, count)]`` supplies the
+        caller's pod→request-class mapping (identity-based on the stateless
+        path, uid-based on the tenant path)."""
+        return {
+            "newNodes": [
+                {
+                    "provisioner": n.provisioner_name,
+                    "instanceTypes": n.instance_type_names,
+                    "zones": n.zones,
+                    "capacityTypes": n.capacity_types,
+                    "requests": n.requests,
+                    "classCounts": class_counts(n.pods),
+                }
+                for n in results.new_nodes
+            ],
+            "existingAssignments": {
+                name: class_counts(placed)
+                for name, placed in results.existing_assignments.items()
+            },
+            "failedClassCounts": class_counts(results.failed_pods),
+            # spread residuals: classes the kernel may have under-placed
+            # vs the host oracle — the controller plane re-routes them
+            # through its host scheduler with seeded topology counts
+            # (provisioning._solve_host_remainder), so the wire path keeps
+            # the same no-shape-schedules-fewer guarantee as in-process
+            "residualClassCounts": class_counts(results.spread_residual_pods),
+            # zone commitments the solve stamped onto zone-less existing
+            # nodes: the re-route must see the same pins
+            "existingCommittedZones": dict(results.existing_committed_zones),
+        }
+
     def _solve_classes(self, request: bytes, context) -> bytes:
+        t0 = tenant_mod.monotonic()
+        partial = self._rpc_chaos(context, "SolveClasses")
+        try:
+            req = msgpack.unpackb(request)
+        except Exception as e:  # noqa: BLE001 - not even msgpack
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"malformed request: {e}")
+        try:
+            if isinstance(req, dict) and req.get("tenant"):
+                response = self._solve_classes_tenant(req, context, len(request), t0)
+            else:
+                response = self._solve_classes_stateless(req, context, t0)
+        except _AbortRequest as a:
+            context.abort(a.code, a.details)
+        if partial is not None:
+            context.abort(grpc.StatusCode.UNAVAILABLE, partial.describe())
+        return response
+
+    def _solve_classes_stateless(self, req, context, t0: float) -> bytes:
+        """The original stateless contract: every request is one snapshot
+        solve, no admission, no session."""
         from karpenter_core_tpu.models.snapshot import build_pod_ladder
 
         try:
-            req = msgpack.unpackb(request)
             entries = req.get("podClasses", [])
             reps = [codec.pod_from_dict(e["pod"]) for e in entries]
             classes = []
@@ -364,10 +500,12 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 # crossed the channel)
                 policy=PolicyConfig.from_wire(req.get("policy")),
             )
+            self._deadline_guard(context, t0)
             snapshot = solver.encode_classes(
                 classes, state_nodes=state_nodes or None, bound_pods=bound
             )
             results = solver.solve_encoded(snapshot, state_nodes or None, bound)
+            self._deadline_guard(context, t0)
 
             def class_counts(pods) -> list:
                 counts: Dict[int, int] = {}
@@ -376,41 +514,207 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                     counts[i] = counts.get(i, 0) + 1
                 return sorted(counts.items())
 
-            response = {
-                "newNodes": [
-                    {
-                        "provisioner": n.provisioner_name,
-                        "instanceTypes": n.instance_type_names,
-                        "zones": n.zones,
-                        "capacityTypes": n.capacity_types,
-                        "requests": n.requests,
-                        "classCounts": class_counts(n.pods),
-                    }
-                    for n in results.new_nodes
-                ],
-                "existingAssignments": {
-                    name: class_counts(placed)
-                    for name, placed in results.existing_assignments.items()
-                },
-                "failedClassCounts": class_counts(results.failed_pods),
-                # spread residuals: classes the kernel may have under-placed
-                # vs the host oracle — the controller plane re-routes them
-                # through its host scheduler with seeded topology counts
-                # (provisioning._solve_host_remainder), so the wire path keeps
-                # the same no-shape-schedules-fewer guarantee as in-process
-                "residualClassCounts": class_counts(results.spread_residual_pods),
-                # zone commitments the solve stamped onto zone-less existing
-                # nodes: the re-route must see the same pins
-                "existingCommittedZones": dict(results.existing_committed_zones),
-            }
-            return msgpack.packb(response)
+            return msgpack.packb(self._classes_payload(results, class_counts))
         except KernelUnsupported as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, f"kernel unsupported: {e}")
+        except _AbortRequest:
+            raise
         except Exception as e:  # noqa: BLE001 - surface as INTERNAL
             log.exception("solve-classes request failed")
             context.abort(grpc.StatusCode.INTERNAL, str(e))
 
+    # -- the tenant path (docs/SERVICE.md) ------------------------------------
+
+    @staticmethod
+    def _materialize_class(rep, count: int, uid_base: str) -> List:
+        """``count`` bookkeeping copies of the class representative with
+        distinct, REQUEST-STABLE uids (``<class-digest>#<j>``).  Pods within
+        an equivalence class are fungible, so synthetic member identities
+        capture count deltas exactly — the per-tenant incremental session
+        diffs successive requests' memberships without per-pod uids ever
+        crossing the wire (the O(classes) win stays)."""
+        pods = []
+        for j in range(count):
+            pod = copy.copy(rep)
+            pod.metadata = copy.copy(rep.metadata)
+            pod.metadata.uid = f"{uid_base}#{j}"
+            pods.append(pod)
+        return pods
+
+    def _decode_tenant_classes(self, req):
+        """(classes, uid_base -> request class index, decode_common tail)."""
+        from karpenter_core_tpu.models.snapshot import build_pod_ladder
+        from karpenter_core_tpu.models.store import class_key
+
+        entries = req.get("podClasses", [])
+        classes = []
+        uid_class: Dict[str, int] = {}
+        for i, entry in enumerate(entries):
+            rep = codec.pod_from_dict(entry["pod"])
+            cls = build_pod_ladder(rep)
+            cls.pods = [rep]  # class_key derives from the representative
+            # class identity digest: stable across this process's lifetime,
+            # which is all the lineage needs (a restart re-anchors anyway)
+            uid_base = hashlib.sha256(
+                repr(class_key(cls)).encode()
+            ).hexdigest()[:16]
+            if uid_base in uid_class:
+                raise ValueError(f"duplicate pod class at index {i}")
+            uid_class[uid_base] = i
+            cls.pods = self._materialize_class(rep, int(entry["count"]), uid_base)
+            classes.append(cls)
+        provisioners, daemonset_pods, state_nodes, bound, resolver, _ = (
+            self._decode_common(req)
+        )
+        return classes, uid_class, provisioners, daemonset_pods, state_nodes, bound, resolver
+
+    def _solve_classes_tenant(self, req, context, nbytes: int, t0: float) -> bytes:
+        from karpenter_core_tpu.policy import PolicyConfig
+
+        envelope = req.get("tenant") or {}
+        tid = str(envelope.get("id") or "")
+        if not tid:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "tenant.id required")
+        plane = self.tenants
+        decision = plane.admit(tid)
+        if not decision.admitted:
+            if decision.reason == "isolated":
+                context.abort(
+                    grpc.StatusCode.UNAVAILABLE,
+                    "tenant-isolated "
+                    f"{tenant_mod.RETRY_AFTER_PREFIX}{decision.retry_after_s:.3f}",
+                )
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, decision.detail())
+        # a half-open breaker trial latched by THIS admit must be freed on
+        # every exit that reaches no verdict (kernel-unsupported, deadline)
+        # or the tenant would wedge half-open forever — record_* calls are
+        # the verdicts, everything else releases in the finally.  Only the
+        # trial this request was granted (decision.trial) is ever released:
+        # a concurrent request's latch is not ours to free.
+        entry = decision.entry
+        verdict = False
+        try:
+            if nbytes > plane.config.max_request_bytes:
+                verdict = True
+                plane.record_bad_request(entry, "oversized")
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"tenant-ejected reason=oversized bytes={nbytes} "
+                    f"limit={plane.config.max_request_bytes}",
+                )
+            try:
+                (classes, uid_class, provisioners, daemonset_pods, state_nodes,
+                 bound, resolver) = self._decode_tenant_classes(req)
+            except Exception as e:  # noqa: BLE001 - tenant-attributable
+                verdict = True
+                plane.record_bad_request(entry, "malformed")
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"tenant-ejected reason=malformed: {e}",
+                )
+            solver = TPUSolver(
+                self.cloud_provider, provisioners, daemonset_pods,
+                kube_client=resolver,
+                policy=PolicyConfig.from_wire(req.get("policy")),
+            )
+            claimed = int(envelope.get("sessionVersion") or 0)
+            supply_digest = envelope.get("supplyDigest")
+            self._deadline_guard(context, t0)
+            with entry.lock:
+                have = entry.session.lineage_version()
+                if claimed != have:
+                    # the client's lineage and ours diverged: a restarted /
+                    # evicted server (claimed > 0, have == 0 — the
+                    # ``session-lost`` re-anchor), a restarted client
+                    # (claimed == 0, have > 0), or plain version skew.  The
+                    # answer is always the same: drop the lineage, full
+                    # solve, never a stale delta.
+                    entry.session.reset()
+                    entry.session.force_full(
+                        "session-lost" if claimed else "client-reanchor"
+                    )
+                elif (
+                    have
+                    and supply_digest is not None
+                    and entry.supply_digest is not None
+                    and supply_digest != entry.supply_digest
+                ):
+                    # versions agree but the client's view of its supply
+                    # moved in a way our decode may not capture: trust the
+                    # digest, re-anchor
+                    entry.session.force_full("supply-digest")
+                entry.session.rebind(solver)
+                # last_batched is written by the coalescer hook, which only
+                # full solves reach — reset so a delta (solo by design)
+                # doesn't echo a stale batch size
+                entry.last_batched = 1
+                t_solve = tenant_mod.monotonic()
+                try:
+                    with tracing.span("solve.tenant", tenant=tid,
+                                      classes=len(classes)):
+                        results = entry.session.solve(
+                            classes, state_nodes or None, bound
+                        )
+                except KernelUnsupported as e:
+                    # a host-routable batch shape, not abuse: no breaker
+                    # verdict (the finally frees any half-open trial)
+                    tenant_mod.TENANT_EJECTED.labels(tid, "unsupported").inc()
+                    context.abort(
+                        grpc.StatusCode.FAILED_PRECONDITION,
+                        f"kernel unsupported: {e}",
+                    )
+                except Exception as e:  # noqa: BLE001 - eject, batch survives
+                    verdict = True
+                    plane.record_fault(entry)
+                    log.warning("tenant %s solve ejected: %s", tid, e)
+                    return msgpack.packb({
+                        "error": {"kind": "ejected", "reason": str(e)},
+                        "tenant": {
+                            "id": tid,
+                            "sessionVersion": entry.session.lineage_version(),
+                        },
+                    })
+                solve_s = tenant_mod.monotonic() - t_solve
+                entry.supply_digest = supply_digest
+                mode, reason = entry.session.last_mode, entry.session.last_reason
+                version = entry.session.lineage_version()
+                batched = entry.last_batched
+            self._deadline_guard(context, t0)
+
+            t_decode = tenant_mod.monotonic()
+
+            def class_counts(pods) -> list:
+                counts: Dict[int, int] = {}
+                for p in pods:
+                    i = uid_class[p.uid.rsplit("#", 1)[0]]
+                    counts[i] = counts.get(i, 0) + 1
+                return sorted(counts.items())
+
+            response = self._classes_payload(results, class_counts)
+            response["tenant"] = {
+                "id": tid,
+                "solveMode": mode,
+                "reason": reason,
+                "sessionVersion": version,
+                "batched": batched,
+            }
+            verdict = True
+            plane.record_ok(entry)
+            plane.observe_latencies(
+                tid,
+                queue_s=t_solve - t0,
+                solve_s=solve_s,
+                decode_s=tenant_mod.monotonic() - t_decode,
+            )
+            return msgpack.packb(response)
+        finally:
+            if not verdict and decision.trial:
+                entry.breaker.release_trial()
+            plane.release(tid)
+
     def _solve(self, request: bytes, context) -> bytes:
+        t0 = tenant_mod.monotonic()
+        partial = self._rpc_chaos(context, "Solve")
         try:
             req = msgpack.unpackb(request)
             pods = [codec.pod_from_dict(p) for p in req.get("pods", [])]
@@ -425,7 +729,9 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 kube_client=resolver,
                 policy=PolicyConfig.from_wire(req.get("policy")),
             )
+            self._deadline_guard(context, t0)
             results = solver.solve(pods, state_nodes=state_nodes or None, bound_pods=bound)
+            self._deadline_guard(context, t0)
 
             pod_index = {p.uid: i for i, p in enumerate(pods)}
             response = {
@@ -453,24 +759,78 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 ],
                 "existingCommittedZones": dict(results.existing_committed_zones),
             }
-            return msgpack.packb(response)
+            payload = msgpack.packb(response)
         except KernelUnsupported as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, f"kernel unsupported: {e}")
+        except _AbortRequest as a:
+            context.abort(a.code, a.details)
         except Exception as e:  # noqa: BLE001 - surface as INTERNAL
             log.exception("solve request failed")
             context.abort(grpc.StatusCode.INTERNAL, str(e))
+        if partial is not None:
+            context.abort(grpc.StatusCode.UNAVAILABLE, partial.describe())
+        return payload
 
 
-def serve(cloud_provider, address: str = "127.0.0.1:0", max_workers: int = 4):
-    """Start the sidecar; returns (server, bound_port)."""
+def service_capacity(max_workers: Optional[int] = None) -> tuple:
+    """(workers, max_concurrent_rpcs) for ``serve``: KC_SERVICE_WORKERS sizes
+    the solver pool (no more hardcoded 4), KC_SERVICE_QUEUE bounds how many
+    additional RPCs may WAIT behind the busy workers — anything past that is
+    rejected by the transport with RESOURCE_EXHAUSTED instead of piling up
+    unboundedly (the admission controller's token buckets shed per-tenant
+    load far earlier; this is the transport backstop)."""
+    workers = (
+        max_workers if max_workers is not None
+        else max(tenant_mod._env_i("KC_SERVICE_WORKERS", 4), 1)
+    )
+    queue = max(tenant_mod._env_i("KC_SERVICE_QUEUE", 32), 0)
+    return workers, workers + queue
+
+
+def serve(
+    cloud_provider,
+    address: str = "127.0.0.1:0",
+    max_workers: Optional[int] = None,
+    clock=None,
+    tenant_config=None,
+    metrics_port: Optional[int] = None,
+):
+    """Start the sidecar; returns (server, bound_port).
+
+    ``max_workers`` None reads KC_SERVICE_WORKERS (default 4); the request
+    queue is bounded (KC_SERVICE_QUEUE) and per-RPC work is deadlined
+    (KC_SERVICE_DEADLINE_S).  ``clock``/``tenant_config`` thread into the
+    multi-tenant plane (service/tenant.py).  ``metrics_port`` (0 = ephemeral)
+    additionally serves the process /metrics — the per-tenant latency
+    histograms and shed/eject/evict counters — over HTTP; the started
+    OperatorHTTP rides ``server.kc_http``."""
     from karpenter_core_tpu.utils import compilecache
 
     compilecache.enable()  # sidecar restarts reuse compiled solve kernels
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((SnapshotSolverService(cloud_provider),))
+    workers, max_rpcs = service_capacity(max_workers)
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=workers),
+        maximum_concurrent_rpcs=max_rpcs,
+    )
+    service = SnapshotSolverService(
+        cloud_provider, clock=clock, tenant_config=tenant_config
+    )
+    server.add_generic_rpc_handlers((service,))
     port = server.add_insecure_port(address)
     server.start()
-    log.info("snapshot solver listening on port %d", port)
+    # the service (and its tenant plane) stays reachable for operators/tests
+    server.kc_service = service
+    server.kc_http = None
+    if metrics_port is not None:
+        from karpenter_core_tpu.operator.httpserver import OperatorHTTP
+
+        server.kc_http = OperatorHTTP(
+            metrics_port=metrics_port, health_port=0
+        ).start()
+    log.info(
+        "snapshot solver listening on port %d (%d workers, %d max rpcs)",
+        port, workers, max_rpcs,
+    )
     return server, port
 
 
@@ -497,6 +857,19 @@ class SnapshotSolverClient:
         self._lease_get = self.channel.unary_unary(f"/{SERVICE}/LeaseGet")
         self._lease_apply = self.channel.unary_unary(f"/{SERVICE}/LeaseApply")
 
+    @staticmethod
+    def _client_chaos(method: str) -> None:
+        """Client-transport leg of the ``service.rpc`` point: error/timeout
+        faults surface as a raised InjectedFault BEFORE the call leaves —
+        exactly what a dead/black-holed channel looks like to the caller
+        (the provisioning solver breaker counts it); latency rides the armed
+        clock inside the plane."""
+        fault = SERVICE_RPC.hit(
+            kinds=("error", "timeout"), side="client", method=method
+        )
+        if fault is not None:
+            raise chaos.InjectedFault(fault)
+
     def health(self) -> Dict:
         return msgpack.unpackb(self._health(msgpack.packb({})))
 
@@ -519,6 +892,7 @@ class SnapshotSolverClient:
         Returns the raw response: {action, nodesToRemove: [name],
         replacements: [{provisioner, instanceTypes, zones, capacityTypes,
         requests, podRefs: [[nodeName, podIndex]]}]}."""
+        self._client_chaos("Consolidate")
         request = msgpack.packb(
             {
                 "candidates": candidates,
@@ -562,6 +936,7 @@ class SnapshotSolverClient:
         volume attach limits bind on the solver side; policy: the replica's
         resolved policy.PolicyConfig (or wire dict) so the remote objective
         stage selects offerings exactly like an in-process solve."""
+        self._client_chaos("Solve")
         request = msgpack.packb(
             {
                 "pods": [codec.pod_to_dict(p) for p in pods],
@@ -597,6 +972,7 @@ class SnapshotSolverClient:
         resolved policy.PolicyConfig (or wire dict); without it a remote
         solve silently ran first-fit selection while the replica believed
         the objective was on."""
+        self._client_chaos("SolveClasses")
         if members is None:
             from karpenter_core_tpu.models.snapshot import _class_signature
 
@@ -647,6 +1023,45 @@ class SnapshotSolverClient:
             "residualPodIndices": take(response.get("residualClassCounts", [])),
             "existingCommittedZones": response.get("existingCommittedZones", {}),
         }
+
+    def solve_tenant_classes(
+        self,
+        pod_classes: List[tuple],
+        provisioners: List,
+        tenant: Dict,
+        nodes: Optional[List[Dict]] = None,
+        daemonset_pods: Optional[List] = None,
+        claim_drivers: Optional[Dict[str, str]] = None,
+        policy=None,
+        timeout: float = 60.0,
+    ) -> Dict:
+        """The multi-tenant protocol (docs/SERVICE.md): ship
+        ``pod_classes`` ([(representative Pod, count)]) with a ``tenant``
+        envelope ({id, sessionVersion, supplyDigest}) and get the RAW
+        class-count response back, plus its ``tenant`` echo ({id, solveMode,
+        reason, sessionVersion, batched}).  Delta responses carry only the
+        delta's placements, so no client-side pod expansion happens here —
+        the caller owns the count→pod mapping.  A response carrying
+        ``error`` is this tenant's structured ejection (its co-batched
+        tenants were answered normally); sheds/isolation surface as
+        RESOURCE_EXHAUSTED / UNAVAILABLE RpcErrors whose details carry a
+        ``retry-after-s=`` hint (service.tenant.parse_retry_after)."""
+        self._client_chaos("SolveClasses")
+        request = msgpack.packb(
+            {
+                "podClasses": [
+                    {"pod": codec.pod_to_dict(pod), "count": int(count)}
+                    for pod, count in pod_classes
+                ],
+                "provisioners": [codec.provisioner_to_dict(p) for p in provisioners],
+                "daemonsetPods": [codec.pod_to_dict(p) for p in daemonset_pods or []],
+                "nodes": nodes or [],
+                "claimDrivers": claim_drivers or {},
+                "policy": _policy_wire(policy),
+                "tenant": dict(tenant),
+            }
+        )
+        return msgpack.unpackb(self._solve_classes(request, timeout=timeout))
 
     def close(self) -> None:
         self.channel.close()

@@ -1,0 +1,574 @@
+"""The multi-tenant solver plane: admission, coalescing, sessions, isolation.
+
+"Millions of users" is tens of thousands of clusters sharing one solver
+fleet (ROADMAP; Tesserae is the scale frame).  This module is the robustness
+layer that makes the sharing safe — one tenant's poison snapshot, slow
+client, or burst must not take down the other N−1:
+
+  admission     Every tenant request passes an ``AdmissionController``:
+                a per-tenant token bucket (``utils/retry.RetryBudget``) plus
+                a bounded global in-flight cap.  Past either bound the
+                request is SHED — an explicit RESOURCE_EXHAUSTED response
+                carrying a retry-after hint (the bucket's exact refill time,
+                escalated by a per-tenant ``Backoff`` while the tenant keeps
+                hammering) — instead of queueing without bound behind the
+                worker pool.
+
+  coalescing    Compatible requests batch into ONE device solve: tenants
+                whose prepared planes share a shape bucket (the compile
+                cache's padding makes this the common case) stack on a
+                leading tenant axis and run a vmapped executable
+                (``utils/compilecache.batched_solve_callable``; a mesh
+                tenant axis when KC_SOLVER_MESH is on —
+                ``parallel/mesh.TENANT_PARTITION_RULES``).  Batch membership
+                is fault-contained: a tenant whose snapshot fails validation
+                never reaches the batch, and a batch-program fault falls
+                back to per-tenant solo runs — so every co-batched tenant's
+                outputs are bit-identical to its solo solve, always.
+
+  sessions      A per-tenant ``IncrementalSolveSession`` lineage lives
+                server-side under an LRU + TTL eviction policy: steady
+                same-supply churn repairs instead of re-solving.  Crash
+                recovery is by re-anchor, never by trust: a client claiming
+                a lineage this process doesn't hold (server restart, LRU/TTL
+                eviction) gets a FULL solve with reason ``session-lost`` —
+                no stale lineage ever answers.
+
+  isolation     A per-tenant ``CircuitBreaker``: malformed / oversized
+                snapshots and solve faults count against the tenant; past
+                the threshold the tenant is isolated (UNAVAILABLE with a
+                retry-after) until the breaker's half-open trial readmits
+                it.  Other tenants never see the breaker.
+
+Everything observable rides ``/metrics``: per-tenant queue/solve/decode
+latency histograms and shed/eject/evict counters (docs/SERVICE.md).  All
+timing policy (TTL, breaker windows, bucket refill) goes through the
+injected ``utils/clock.Clock`` so FakeClock suites step it deterministically;
+latency *measurement* uses the monotonic wall clock (diagnostics, not
+policy).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_core_tpu import tracing
+from karpenter_core_tpu.metrics import REGISTRY
+from karpenter_core_tpu.utils import retry
+from karpenter_core_tpu.utils.clock import Clock
+
+TENANT_QUEUE_LATENCY = REGISTRY.histogram(
+    "karpenter_tenant_queue_latency_seconds",
+    "Per-tenant time from RPC receipt to solve start (admission + decode).",
+    ("tenant",),
+)
+TENANT_SOLVE_LATENCY = REGISTRY.histogram(
+    "karpenter_tenant_solve_latency_seconds",
+    "Per-tenant solve time (session decide + device dispatch), coalesced or "
+    "solo.",
+    ("tenant",),
+)
+TENANT_DECODE_LATENCY = REGISTRY.histogram(
+    "karpenter_tenant_decode_latency_seconds",
+    "Per-tenant response decode/assembly time.",
+    ("tenant",),
+)
+TENANT_SHED = REGISTRY.counter(
+    "karpenter_tenant_shed_total",
+    "Requests shed by admission control, by tenant and reason "
+    "(rate / queue / isolated).",
+    ("tenant", "reason"),
+)
+TENANT_EJECTED = REGISTRY.counter(
+    "karpenter_tenant_ejected_total",
+    "Tenant requests ejected with a structured error, by tenant and reason "
+    "(malformed / oversized / solve-fault).",
+    ("tenant", "reason"),
+)
+TENANT_SESSIONS_EVICTED = REGISTRY.counter(
+    "karpenter_tenant_sessions_evicted_total",
+    "Server-side tenant sessions evicted, by reason (lru / ttl).",
+    ("reason",),
+)
+TENANT_SESSIONS_LIVE = REGISTRY.gauge(
+    "karpenter_tenant_sessions_live",
+    "Server-side tenant sessions currently resident.",
+)
+TENANT_BATCHES = REGISTRY.counter(
+    "karpenter_tenant_batches_total",
+    "Coalesced tenant solves dispatched, by batch size (1 = solo).",
+    ("size",),
+)
+
+# the shed/isolated detail string clients parse the hint out of
+RETRY_AFTER_PREFIX = "retry-after-s="
+
+
+def parse_retry_after(details: str) -> Optional[float]:
+    """The retry-after hint out of a shed/isolated response's detail string,
+    or None when absent/unparseable."""
+    for token in (details or "").replace(";", " ").split():
+        if token.startswith(RETRY_AFTER_PREFIX):
+            try:
+                return float(token[len(RETRY_AFTER_PREFIX):])
+            except ValueError:
+                return None
+    return None
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_i(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class TenantConfig:
+    """Knobs for the tenant plane; all env-overridable (docs/SERVICE.md)."""
+
+    # admission: per-tenant token bucket (sustained rate + burst) and the
+    # bounded global solve queue
+    rate_per_s: float = 10.0
+    burst: int = 20
+    max_inflight: int = 16
+    # sessions: LRU capacity + idle TTL
+    max_sessions: int = 256
+    session_ttl_s: float = 900.0
+    # isolation: per-tenant breaker
+    breaker_threshold: int = 3
+    breaker_reset_s: float = 30.0
+    # coalescing: rendezvous window + cap (window 0 disables batching)
+    batch_window_s: float = 0.01
+    max_batch: int = 8
+    # request bound: oversized snapshots count against the tenant's breaker
+    max_request_bytes: int = 32 * 1024 * 1024
+
+    @classmethod
+    def from_env(cls) -> "TenantConfig":
+        return cls(
+            rate_per_s=max(_env_f("KC_TENANT_RATE", 10.0), 0.001),
+            burst=max(_env_i("KC_TENANT_BURST", 20), 1),
+            max_inflight=max(_env_i("KC_TENANT_QUEUE", 16), 1),
+            max_sessions=max(_env_i("KC_TENANT_SESSIONS", 256), 1),
+            session_ttl_s=_env_f("KC_TENANT_SESSION_TTL_S", 900.0),
+            breaker_threshold=max(_env_i("KC_TENANT_BREAKER_THRESHOLD", 3), 1),
+            breaker_reset_s=_env_f("KC_TENANT_BREAKER_RESET_S", 30.0),
+            batch_window_s=_env_f("KC_TENANT_BATCH_WINDOW_S", 0.01),
+            max_batch=max(_env_i("KC_TENANT_BATCH_MAX", 8), 1),
+            max_request_bytes=max(
+                _env_i("KC_TENANT_MAX_BYTES", 32 * 1024 * 1024), 1024
+            ),
+        )
+
+
+@dataclass
+class AdmissionDecision:
+    admitted: bool
+    reason: str = ""  # rate / queue / isolated when not admitted
+    retry_after_s: float = 0.0
+    # the tenant's entry (so the handler never re-looks it up) and whether
+    # THIS admission latched the breaker's half-open trial — a no-verdict
+    # exit must release exactly the trial it was granted, never a
+    # concurrent request's
+    entry: Optional["TenantEntry"] = None
+    trial: bool = False
+
+    def detail(self) -> str:
+        """The grpc abort detail string (machine-parseable hint included)."""
+        return (
+            f"tenant-shed reason={self.reason} "
+            f"{RETRY_AFTER_PREFIX}{self.retry_after_s:.3f}"
+        )
+
+
+# -- batch coalescing ---------------------------------------------------------
+
+
+def bucket_key(prep) -> tuple:
+    """The shape-bucket identity of a SolvePrep: two preps with equal keys
+    run the same solve program, so their batches can stack on a tenant axis.
+    Mirrors the compile-cache key's static components (docs/SERVICE.md)."""
+    from karpenter_core_tpu.utils import compilecache
+
+    return (
+        compilecache._leaf_sig(prep.cls),
+        compilecache._leaf_sig(prep.statics_arrays),
+        compilecache._leaf_sig(prep.ex_state) if prep.ex_state is not None else None,
+        compilecache._leaf_sig(prep.ex_static) if prep.ex_static is not None else None,
+        int(prep.n_slots),
+        tuple(prep.key_has_bounds),
+        int(prep.n_passes),
+        tuple(prep.features) if prep.features is not None else None,
+    )
+
+
+class _Member:
+    __slots__ = ("prep", "solo", "done", "outputs", "error", "batch_n")
+
+    def __init__(self, prep, solo: Callable[[], object]) -> None:
+        self.prep = prep
+        self.solo = solo
+        self.done = threading.Event()
+        self.outputs = None
+        self.error: Optional[BaseException] = None
+        self.batch_n = 1
+
+
+class _Group:
+    __slots__ = ("members", "full", "closed")
+
+    def __init__(self) -> None:
+        self.members: List[_Member] = []
+        self.full = threading.Event()
+        self.closed = False
+
+
+class BatchCoalescer:
+    """Rendezvous concurrent compatible-bucket solves into one batched
+    dispatch.  ``run(prep, solo)`` blocks until this request's outputs exist
+    and returns ``(outputs, batch_size)``; ``solo`` is the caller's
+    unbatched dispatch (used for singleton groups and as the per-tenant
+    fault-containment fallback when a batch program faults)."""
+
+    def __init__(self, window_s: float = 0.01, max_batch: int = 8) -> None:
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        self._groups: Dict[tuple, _Group] = {}
+
+    def run(self, prep, solo: Callable[[], object]) -> Tuple[object, int]:
+        if self.window_s <= 0 or self.max_batch <= 1:
+            return solo(), 1
+        key = bucket_key(prep)
+        member = _Member(prep, solo)
+        with self._lock:
+            group = self._groups.get(key)
+            # a full group is as good as closed: the leader may not have
+            # woken from full.wait() yet, and appending past max_batch would
+            # dispatch an unexpected batch size (fresh compile, uncapped
+            # device cost) — late arrivals start the next group instead
+            leader = (
+                group is None or group.closed
+                or len(group.members) >= self.max_batch
+            )
+            if leader:
+                group = _Group()
+                group.members.append(member)
+                self._groups[key] = group
+            else:
+                group.members.append(member)
+                if len(group.members) >= self.max_batch:
+                    group.full.set()
+        if not leader:
+            # the leader always resolves every member in its finally block
+            member.done.wait()
+            if member.error is not None:
+                raise member.error
+            return member.outputs, member.batch_n
+        # leader: hold the window open for co-batchers, then dispatch
+        group.full.wait(self.window_s)
+        with self._lock:
+            group.closed = True
+            if self._groups.get(key) is group:
+                del self._groups[key]
+            members = list(group.members)
+        try:
+            self._execute(members)
+        finally:
+            for m in members:
+                m.done.set()
+        if member.error is not None:
+            raise member.error
+        return member.outputs, member.batch_n
+
+    def _execute(self, members: List[_Member]) -> None:
+        TENANT_BATCHES.labels(str(len(members))).inc()
+        if len(members) == 1:
+            m = members[0]
+            try:
+                m.outputs = m.solo()
+            except BaseException as e:  # noqa: BLE001 - routed to the caller
+                m.error = e
+            return
+        try:
+            outs = self._run_batched([m.prep for m in members])
+        except BaseException:  # noqa: BLE001 - batch fault: contain per tenant
+            # fault containment: the batch PROGRAM faulted (device error,
+            # chaos) — nothing tenant-attributable yet.  Re-run each member
+            # solo: tenants whose solves are healthy still get their exact
+            # answers; the faulty one surfaces its own error.
+            for m in members:
+                try:
+                    m.outputs = m.solo()
+                    m.batch_n = 1
+                except BaseException as e:  # noqa: BLE001 - per-tenant verdict
+                    m.error = e
+            return
+        for m, out in zip(members, outs):
+            m.outputs = out
+            m.batch_n = len(members)
+
+    @staticmethod
+    def _run_batched(preps) -> List[object]:
+        """One vmapped device dispatch over the stacked preps; returns
+        per-tenant output slices (bit-identical to solo solves)."""
+        import jax
+
+        from karpenter_core_tpu.parallel import mesh as mesh_mod
+        from karpenter_core_tpu.utils import compilecache
+
+        p0 = preps[0]
+        has_ex = p0.ex_state is not None
+
+        def stack(trees):
+            return jax.tree_util.tree_map(
+                lambda *ls: np.stack([np.asarray(x) for x in ls]), *trees
+            )
+
+        with tracing.span("solve.coalesced", tenants=len(preps),
+                          n_slots=p0.n_slots):
+            args = [stack([p.cls for p in preps]),
+                    stack([p.statics_arrays for p in preps])]
+            if has_ex:
+                args.append(stack([p.ex_state for p in preps]))
+                args.append(stack([p.ex_static for p in preps]))
+            mesh_axes = mesh_mod.tenant_mesh_axes(len(preps))
+            fn = compilecache.batched_solve_callable(
+                len(preps), p0.cls, p0.statics_arrays, p0.n_slots,
+                p0.key_has_bounds, p0.ex_state, p0.ex_static,
+                p0.n_passes, p0.features, mesh_axes,
+            )
+            if mesh_axes is not None:
+                mesh = mesh_mod.mesh_for(mesh_axes)
+                args = [
+                    jax.device_put(a, mesh_mod.tenant_mesh_shardings(a, mesh))
+                    for a in args
+                ]
+            # ONE batched fetch of the stacked outputs, sliced per tenant on
+            # the host: decode consumes every plane anyway, and host slicing
+            # avoids compiling a per-leaf-per-index gather op on device
+            outs = jax.device_get(fn(*args))
+            return [
+                jax.tree_util.tree_map(lambda a, i=i: a[i], outs)
+                for i in range(len(preps))
+            ]
+
+
+# -- per-tenant state ---------------------------------------------------------
+
+
+@dataclass
+class TenantEntry:
+    """Everything the plane keeps per tenant."""
+
+    tenant_id: str
+    session: object  # solver.incremental.IncrementalSolveSession
+    breaker: retry.CircuitBreaker
+    bucket: retry.RetryBudget
+    shed_backoff: retry.Backoff
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    last_seen: float = 0.0
+    supply_digest: Optional[str] = None
+    last_batched: int = 1
+
+
+class TenantPlane:
+    """Admission + sessions + breakers + the coalescer, as one unit the
+    service owns.  Thread-safe; ``clock`` drives every timing POLICY (TTL,
+    breaker reset, bucket refill) so FakeClock suites are deterministic."""
+
+    def __init__(self, clock: Optional[Clock] = None,
+                 config: Optional[TenantConfig] = None) -> None:
+        self.clock = clock or Clock()
+        self.config = config or TenantConfig.from_env()
+        self.coalescer = BatchCoalescer(
+            self.config.batch_window_s, self.config.max_batch
+        )
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, TenantEntry]" = OrderedDict()
+        self._inflight = 0
+        self._last_sweep = self.clock.now()
+
+    # -- session lifecycle -----------------------------------------------------
+
+    def _new_entry(self, tenant_id: str) -> TenantEntry:
+        from karpenter_core_tpu.solver.incremental import (
+            FallbackPolicy,
+            IncrementalSolveSession,
+        )
+
+        cfg = self.config
+        entry = TenantEntry(
+            tenant_id=tenant_id,
+            session=None,
+            breaker=retry.CircuitBreaker(
+                self.clock,
+                failure_threshold=cfg.breaker_threshold,
+                reset_timeout_s=cfg.breaker_reset_s,
+                name=f"tenant:{tenant_id}",
+            ),
+            bucket=retry.RetryBudget(
+                self.clock, budget=cfg.burst,
+                window_s=cfg.burst / cfg.rate_per_s,
+                name=f"tenant:{tenant_id}",
+            ),
+            shed_backoff=retry.Backoff(0.25, 30.0),
+            last_seen=self.clock.now(),
+        )
+        session = IncrementalSolveSession(
+            policy=FallbackPolicy.from_env(),
+            run_prepared=lambda prep, **kw: self._dispatch(entry, prep, **kw),
+        )
+        entry.session = session
+        return entry
+
+    def _dispatch(self, entry: TenantEntry, prep, **kw):
+        """The session's full-solve dispatch hook: plain full solves are
+        coalescing candidates; anything parameterized (slot-exhaustion
+        retries) dispatches solo."""
+        solver = entry.session.solver
+        if kw:
+            return solver.run_prepared(prep, **kw)
+        outputs, batched = self.coalescer.run(
+            prep, lambda: solver.run_prepared(prep)
+        )
+        entry.last_batched = batched
+        return outputs
+
+    def checkout(self, tenant_id: str) -> TenantEntry:
+        """The tenant's entry (created on first sight), LRU-touched; expired
+        and over-capacity sessions are evicted on the way."""
+        now = self.clock.now()
+        with self._lock:
+            self._sweep_locked(now)
+            entry = self._entries.get(tenant_id)
+            if entry is None:
+                entry = self._new_entry(tenant_id)
+                self._entries[tenant_id] = entry
+                while len(self._entries) > self.config.max_sessions:
+                    evicted_id, evicted = self._entries.popitem(last=False)
+                    self._drop_entry(evicted, "lru")
+            else:
+                self._entries.move_to_end(tenant_id)
+            entry.last_seen = now
+            TENANT_SESSIONS_LIVE.labels().set(float(len(self._entries)))
+            return entry
+
+    def _sweep_locked(self, now: float) -> None:
+        ttl = self.config.session_ttl_s
+        if ttl <= 0:
+            return
+        # cadence-bound: a full scan per checkout would serialize every
+        # tenant on the plane lock for O(resident sessions) work several
+        # times per RPC — expiry only needs to be caught within a fraction
+        # of the TTL, not on every access
+        if now - self._last_sweep < max(ttl / 8.0, 1.0):
+            return
+        self._last_sweep = now
+        expired = [
+            tid for tid, e in self._entries.items() if now - e.last_seen > ttl
+        ]
+        for tid in expired:
+            self._drop_entry(self._entries.pop(tid), "ttl")
+
+    @staticmethod
+    def _drop_entry(entry: TenantEntry, reason: str) -> None:
+        TENANT_SESSIONS_EVICTED.labels(reason).inc()
+        # the breaker gauge would otherwise report a dead tenant forever
+        retry.BREAKER_STATE.delete_labels(f"tenant:{entry.tenant_id}")
+
+    def sessions(self) -> List[str]:
+        with self._lock:
+            return list(self._entries)
+
+    # -- admission -------------------------------------------------------------
+
+    def admit(self, tenant_id: str) -> AdmissionDecision:
+        """Admission gate; an admitted request MUST be paired with
+        ``release()``.  Order: isolation (breaker) → global in-flight bound
+        → per-tenant rate.  The queue check runs BEFORE the token bucket so
+        global pressure caused by OTHER tenants never burns this tenant's
+        own tokens (a queue-shed retry must not escalate into a rate shed)."""
+        entry = self.checkout(tenant_id)
+        if not entry.breaker.allow():
+            hint = max(entry.breaker.reset_timeout_s, 1.0)
+            TENANT_SHED.labels(tenant_id, "isolated").inc()
+            return AdmissionDecision(False, "isolated", hint, entry=entry)
+        granted_trial = entry.breaker.state == retry.HALF_OPEN
+        with self._lock:
+            queued = self._inflight >= self.config.max_inflight
+            if not queued:
+                self._inflight += 1
+        if queued:
+            if granted_trial:
+                entry.breaker.release_trial()  # shed ≠ a backend verdict
+            TENANT_SHED.labels(tenant_id, "queue").inc()
+            hint = max(entry.shed_backoff.next(), 0.25)
+            return AdmissionDecision(False, "queue", hint, entry=entry)
+        if not entry.bucket.allow():
+            with self._lock:
+                self._inflight = max(0, self._inflight - 1)
+            if granted_trial:
+                entry.breaker.release_trial()
+            hint = max(entry.bucket.next_token_s(), 0.05)
+            # repeated sheds escalate the hint so a hammering client backs
+            # off harder each time (reset on the next successful admit)
+            hint = max(hint, entry.shed_backoff.next())
+            TENANT_SHED.labels(tenant_id, "rate").inc()
+            return AdmissionDecision(False, "rate", hint, entry=entry)
+        entry.shed_backoff.reset()
+        return AdmissionDecision(True, entry=entry, trial=granted_trial)
+
+    def release(self, tenant_id: str) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    # -- fault accounting ------------------------------------------------------
+
+    def record_bad_request(self, entry: TenantEntry, reason: str) -> None:
+        """Malformed / oversized snapshot: tenant-attributable, breaker
+        counts it toward isolation."""
+        TENANT_EJECTED.labels(entry.tenant_id, reason).inc()
+        entry.breaker.record_failure()
+
+    def record_fault(self, entry: TenantEntry) -> None:
+        """This tenant's solve faulted (ejected from its batch)."""
+        TENANT_EJECTED.labels(entry.tenant_id, "solve-fault").inc()
+        entry.breaker.record_failure()
+
+    def record_ok(self, entry: TenantEntry) -> None:
+        entry.breaker.record_success()
+
+    # -- latency observation (diagnostic wall time, not policy) ---------------
+
+    @staticmethod
+    def observe_latencies(tenant_id: str, queue_s: float, solve_s: float,
+                          decode_s: float) -> None:
+        TENANT_QUEUE_LATENCY.labels(tenant_id).observe(max(queue_s, 0.0))
+        TENANT_SOLVE_LATENCY.labels(tenant_id).observe(max(solve_s, 0.0))
+        TENANT_DECODE_LATENCY.labels(tenant_id).observe(max(decode_s, 0.0))
+
+
+def monotonic() -> float:
+    """Latency measurement clock (diagnostics only — timing POLICY goes
+    through the injected utils/clock.Clock)."""
+    return time.perf_counter()

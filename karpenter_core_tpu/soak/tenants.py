@@ -1,0 +1,391 @@
+"""Multi-tenant soak: N synthetic tenants hammer ONE solver server.
+
+The acceptance scenario for the multi-tenant solver service (ISSUE-12,
+docs/SERVICE.md): ≥ 8 concurrent synthetic tenants drive per-tenant snapshot
+streams against a single in-process gRPC server — rounds barrier-synchronized
+so the batch coalescer actually sees concurrency — with the ``service.rpc``
+and ``solver.dispatch`` chaos points armed, and (mid-stream) a server
+kill/restart.  The verdict gates on:
+
+  - **0 cross-tenant wrong answers**: every full response accounts for
+    exactly the tenant's own pod classes (each class's placed + failed +
+    residual == the count it sent, no foreign class indices, the tenant echo
+    matches); delta responses must stay inside the tenant's class space.
+  - **0 machine leaks**: the solver service never creates machines.
+  - **every session re-anchors** after the restart: the first successful
+    post-restart solve per tenant carries reason ``session-lost`` (a full
+    solve — no stale lineage ever answers).
+  - **p99 end-to-end latency** within the scenario SLO (wall-clock —
+    advisory-grade like every wall probe, but the acceptance bound).
+
+Sheds (RESOURCE_EXHAUSTED + retry-after), isolation, chaos faults, and
+ejections are EXPECTED under chaos — tenants retry through them; the
+verdict counts them as diagnostics, not failures, as long as every round
+eventually completes.  Unlike the trace-driven soak (soak/runner.py) this
+drives real threads against a real gRPC server, so the report is not
+byte-replayable; the deterministic workload (DeterministicRNG per tenant)
+still makes failures reproducible in shape.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.utils import retry
+
+# shape palette shared by every tenant: same shapes ⇒ same encode buckets ⇒
+# the coalescer gets real batching opportunities (the production regime:
+# many clusters, few distinct pod shapes)
+_SHAPES: Tuple[Dict, ...] = (
+    {"cpu": "500m"},
+    {"cpu": "250m"},
+    {"cpu": 1, "memory": "1Gi"},
+)
+
+
+@dataclass
+class TenantSoakScenario:
+    """One multi-tenant soak run."""
+
+    name: str = "multi-tenant"
+    seed: int = 1729
+    tenants: int = 8
+    rounds: int = 4
+    pods_per_tenant: int = 10
+    churn_fraction: float = 0.3
+    # server kill/restart after this round completes (None = no restart)
+    restart_after_round: Optional[int] = 1
+    p99_slo_s: float = 90.0
+    batch_window_s: float = 0.05
+    max_attempts: int = 80
+    chaos_points: Dict[str, dict] = field(default_factory=lambda: {
+        "service.rpc": {"prob": 0.2, "stop_after": 6},
+        "solver.dispatch": {"prob": 0.35, "stop_after": 2, "kind": "error"},
+    })
+
+
+class _ServerBox:
+    """The live server handle tenants dial through; the restart swaps it."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.address: Optional[str] = None
+        self.epoch = 0
+
+    def set(self, address: str) -> None:
+        with self.lock:
+            self.address = address
+            self.epoch += 1
+
+    def get(self) -> Tuple[str, int]:
+        with self.lock:
+            return self.address, self.epoch
+
+
+class _TenantDriver:
+    """One synthetic tenant: a deterministic churning workload, retry-through
+    faults, structural response verification."""
+
+    def __init__(self, index: int, scenario: TenantSoakScenario,
+                 box: _ServerBox) -> None:
+        self.tenant_id = f"tenant-{index:02d}"
+        self.scenario = scenario
+        self.box = box
+        self.rng = retry.DeterministicRNG(scenario.seed * 7919 + index)
+        # two classes per tenant, drawn from the shared palette
+        i = int(self.rng.random() * len(_SHAPES))
+        j = (i + 1 + int(self.rng.random() * (len(_SHAPES) - 1))) % len(_SHAPES)
+        half = max(scenario.pods_per_tenant // 2, 1)
+        self.counts: List[Tuple[Dict, int]] = [
+            (_SHAPES[i], half),
+            (_SHAPES[j], max(scenario.pods_per_tenant - half, 1)),
+        ]
+        self.session_version = 0
+        self.client = None
+        self.client_epoch = -1
+        self.stats = {
+            "completed": 0, "sheds": 0, "transport_errors": 0,
+            "client_faults": 0, "ejects": 0, "wrong_answers": 0,
+            "incomplete_rounds": 0,
+        }
+        self.latencies: List[float] = []
+        self.mode_counts: Dict[str, int] = {}
+        self.relost = False
+        self.errors: List[str] = []
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _connect(self):
+        from karpenter_core_tpu.service.snapshot_channel import (
+            SnapshotSolverClient,
+        )
+
+        address, epoch = self.box.get()
+        if self.client is None or epoch != self.client_epoch:
+            if self.client is not None:
+                try:
+                    self.client.close()
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+            self.client = SnapshotSolverClient(address)
+            self.client_epoch = epoch
+        return self.client
+
+    def _churn(self) -> None:
+        """Count churn: ±churn_fraction of the population, bounded ≥ 1 per
+        class so the class set (and the encode bucket) stays stable."""
+        budget = max(
+            int(self.scenario.pods_per_tenant * self.scenario.churn_fraction), 1
+        )
+        for _ in range(budget):
+            k = int(self.rng.random() * len(self.counts))
+            shape, count = self.counts[k]
+            delta = 1 if self.rng.random() < 0.5 else -1
+            self.counts[k] = (shape, max(count + delta, 1))
+
+    # -- verification ----------------------------------------------------------
+
+    def _verify(self, resp: Dict, sent: List[int]) -> None:
+        """Structural correctness: a wrong answer is any response that does
+        not account for exactly this tenant's classes (the cross-tenant
+        contamination detector)."""
+        def fail(msg: str) -> None:
+            self.stats["wrong_answers"] += 1
+            self.errors.append(f"{self.tenant_id}: {msg}")
+
+        echo = resp.get("tenant") or {}
+        if echo.get("id") != self.tenant_id:
+            fail(f"tenant echo {echo.get('id')!r}")
+            return
+        placed = [0] * len(sent)
+
+        def absorb(counts) -> bool:
+            for c, n in counts:
+                if not (0 <= c < len(sent)) or n < 0:
+                    fail(f"class index {c} count {n} out of range")
+                    return False
+                placed[c] += n
+            return True
+
+        for node in resp.get("newNodes", []):
+            if not absorb(node.get("classCounts", [])):
+                return
+        for counts in resp.get("existingAssignments", {}).values():
+            if not absorb(counts):
+                return
+        if not absorb(resp.get("failedClassCounts", [])):
+            return
+        if not absorb(resp.get("residualClassCounts", [])):
+            return
+        if echo.get("solveMode") == "full":
+            if placed != sent:
+                fail(f"full response accounts {placed} != sent {sent}")
+        else:
+            # delta responses carry only this tick's delta placements
+            if any(p > s for p, s in zip(placed, sent)):
+                fail(f"delta response overflows {placed} > sent {sent}")
+
+    # -- one round -------------------------------------------------------------
+
+    def run_round(self, expect_relost: bool) -> None:
+        import grpc
+
+        from karpenter_core_tpu.service import tenant as tenant_mod
+        from karpenter_core_tpu.testing import factories
+
+        self._churn()
+        pod_classes = [
+            (factories.make_pod(name=f"{self.tenant_id}-c{k}", requests=dict(shape)),
+             count)
+            for k, (shape, count) in enumerate(self.counts)
+        ]
+        sent = [count for _, count in self.counts]
+        provisioners = [factories.make_provisioner()]
+        t0 = time.perf_counter()
+        for attempt in range(self.scenario.max_attempts):
+            try:
+                client = self._connect()
+                resp = client.solve_tenant_classes(
+                    pod_classes, provisioners,
+                    tenant={
+                        "id": self.tenant_id,
+                        "sessionVersion": self.session_version,
+                    },
+                    timeout=30.0,
+                )
+            except chaos.InjectedFault:
+                self.stats["client_faults"] += 1
+                continue
+            except grpc.RpcError as e:
+                code = e.code()
+                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    self.stats["sheds"] += 1
+                    hint = tenant_mod.parse_retry_after(e.details() or "")
+                    time.sleep(min(hint or 0.1, 0.5))
+                    continue
+                self.stats["transport_errors"] += 1
+                # server restart / chaos abort / isolation: re-dial and retry
+                self.client_epoch = -1
+                time.sleep(0.05)
+                continue
+            if "error" in resp:
+                # structured ejection: our solve faulted, co-batched tenants
+                # were served; our session may have reset — re-anchor
+                self.stats["ejects"] += 1
+                self.session_version = int(
+                    (resp.get("tenant") or {}).get("sessionVersion") or 0
+                )
+                continue
+            self.latencies.append(time.perf_counter() - t0)
+            echo = resp["tenant"]
+            mode = f"{echo.get('solveMode')}:{echo.get('reason')}"
+            self.mode_counts[mode] = self.mode_counts.get(mode, 0) + 1
+            if expect_relost and echo.get("reason") == "session-lost":
+                self.relost = True
+            self._verify(resp, sent)
+            self.session_version = int(echo.get("sessionVersion") or 0)
+            self.stats["completed"] += 1
+            return
+        self.stats["incomplete_rounds"] += 1
+        self.errors.append(
+            f"{self.tenant_id}: round never completed in "
+            f"{self.scenario.max_attempts} attempts"
+        )
+
+
+def run_multi_tenant(scenario: Optional[TenantSoakScenario] = None,
+                     seed: Optional[int] = None) -> dict:
+    """Run the scenario; returns a soak-style report dict (verdict +
+    diagnostics)."""
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_core_tpu.service.snapshot_channel import serve
+    from karpenter_core_tpu.service.tenant import TenantConfig
+    from karpenter_core_tpu.soak.slo import percentile
+
+    scenario = scenario or TenantSoakScenario()
+    if seed is not None:
+        scenario.seed = int(seed)
+    provider = FakeCloudProvider()
+    config = TenantConfig(
+        rate_per_s=50.0, burst=100,
+        max_inflight=max(scenario.tenants * 2, 16),
+        batch_window_s=scenario.batch_window_s,
+        max_batch=scenario.tenants,
+    )
+    box = _ServerBox()
+    server, port = serve(provider, tenant_config=config)
+    box.set(f"127.0.0.1:{port}")
+
+    drivers = [
+        _TenantDriver(i, scenario, box) for i in range(scenario.tenants)
+    ]
+    chaos_scenario = None
+    if scenario.chaos_points:
+        chaos_scenario = chaos.Scenario.from_dict({
+            "name": f"{scenario.name}-chaos",
+            "seed": scenario.seed,
+            "points": dict(scenario.chaos_points),
+        })
+    t_wall = time.perf_counter()
+    restarted = False
+    try:
+        if chaos_scenario is not None:
+            chaos.arm(chaos_scenario)
+        for round_idx in range(scenario.rounds):
+            expect_relost = restarted
+            threads = [
+                threading.Thread(
+                    target=d.run_round, args=(expect_relost,), daemon=True
+                )
+                for d in drivers
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if (
+                scenario.restart_after_round is not None
+                and round_idx == scenario.restart_after_round
+            ):
+                # kill/restart mid-stream: every tenant's next solve must
+                # re-anchor (reason session-lost) — in-memory lineages die
+                # with the process, supply digests re-anchor from scratch
+                server.stop(grace=0)
+                server, port = serve(provider, tenant_config=config)
+                box.set(f"127.0.0.1:{port}")
+                restarted = True
+    finally:
+        if chaos_scenario is not None:
+            chaos.disarm()
+        for d in drivers:
+            if d.client is not None:
+                try:
+                    d.client.close()
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+        server.stop(grace=0)
+
+    latencies = [v for d in drivers for v in d.latencies]
+    wrong = sum(d.stats["wrong_answers"] for d in drivers)
+    incomplete = sum(d.stats["incomplete_rounds"] for d in drivers)
+    machine_leaks = len(provider.created_machines())
+    relost = sum(1 for d in drivers if d.relost)
+    expected_relost = scenario.tenants if restarted else 0
+    p99 = percentile(latencies, 0.99)  # the SLO engine's nearest-rank
+
+    rules = [
+        {"probe": "wrong_answers", "agg": "max", "limit": 0.0,
+         "observed": float(wrong), "passed": wrong == 0},
+        {"probe": "machine_leaks", "agg": "max", "limit": 0.0,
+         "observed": float(machine_leaks), "passed": machine_leaks == 0},
+        {"probe": "incomplete_rounds", "agg": "max", "limit": 0.0,
+         "observed": float(incomplete), "passed": incomplete == 0},
+        {"probe": "sessions_relost", "agg": "final",
+         "limit": float(expected_relost), "observed": float(relost),
+         "passed": relost == expected_relost},
+        {"probe": "e2e_latency_p99_s", "agg": "max",
+         "limit": scenario.p99_slo_s, "observed": round(p99, 3),
+         "passed": p99 <= scenario.p99_slo_s},
+    ]
+    mode_counts: Dict[str, int] = {}
+    for d in drivers:
+        for k, v in d.mode_counts.items():
+            mode_counts[k] = mode_counts.get(k, 0) + v
+    batched_rounds = sum(
+        v for k, v in mode_counts.items() if k.startswith("full")
+    )
+    report = {
+        "verdict": {
+            "scenario": scenario.name,
+            "seed": scenario.seed,
+            "passed": all(r["passed"] for r in rules),
+            "slo": rules,
+            "tenants": scenario.tenants,
+            "rounds": scenario.rounds,
+            "restarted": restarted,
+            "converged": incomplete == 0,
+            "ticks": scenario.rounds,
+        },
+        "diagnostics": {
+            "wall_s": round(time.perf_counter() - t_wall, 3),
+            "latency_p99_s": round(p99, 3),
+            "latency_max_s": round(max(latencies), 3) if latencies else 0.0,
+            "mode_counts": mode_counts,
+            "full_solves": batched_rounds,
+            "stats": {
+                k: sum(d.stats[k] for d in drivers)
+                for k in drivers[0].stats
+            } if drivers else {},
+            "errors": [e for d in drivers for e in d.errors][:20],
+        },
+    }
+    if chaos_scenario is not None:
+        report["diagnostics"]["chaos"] = {
+            "hits": chaos_scenario.hit_counts(),
+            "fired": chaos_scenario.fired_counts(),
+        }
+    return report

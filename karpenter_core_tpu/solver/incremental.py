@@ -174,7 +174,8 @@ class IncrementalSolveSession:
     controller rebuilds its solver per batch); the session survives as long
     as the fallback policy keeps judging deltas safe."""
 
-    def __init__(self, solver=None, policy: Optional[FallbackPolicy] = None) -> None:
+    def __init__(self, solver=None, policy: Optional[FallbackPolicy] = None,
+                 run_prepared=None) -> None:
         self.solver = solver
         self.policy = policy or FallbackPolicy.from_env()
         self.store = SnapshotStore()
@@ -183,6 +184,13 @@ class IncrementalSolveSession:
         self.last_reason: Optional[str] = None
         self.last_audit_drift_nodes: Optional[int] = None
         self.mode_counts: Dict[str, int] = {MODE_FULL: 0, MODE_DELTA: 0}
+        # dispatch hook for FULL solves: ``run_prepared(prep, **kw)`` replaces
+        # ``solver.run_prepared`` so a host (the multi-tenant solver service)
+        # can route the device execution through its batch coalescer — the
+        # prep/decode bookkeeping around it is unchanged, and delta repairs
+        # (whose warm carry is lineage-private) always dispatch solo
+        self._run_prepared = run_prepared
+        self._forced_reason: Optional[str] = None
 
     def rebind(self, solver) -> None:
         self.solver = solver
@@ -190,6 +198,23 @@ class IncrementalSolveSession:
     def reset(self) -> None:
         """Drop the warm lineage (next solve is full)."""
         self._warm = None
+
+    def force_full(self, reason: str) -> None:
+        """Make the NEXT solve a full re-anchor with this reason, whatever the
+        fallback policy would have decided.  The multi-tenant service uses it
+        for lineage trust failures the policy cannot see server-side: a
+        client claiming a session version this process doesn't hold
+        (``session-lost`` after a server restart or an LRU/TTL eviction), a
+        client that itself restarted, or a supply-digest mismatch."""
+        self._forced_reason = reason
+
+    def lineage_version(self) -> int:
+        """The warm lineage's snapshot-store version (0 = no lineage) — what
+        the tenant protocol echoes to clients so a restarted server is
+        detectable (docs/SERVICE.md)."""
+        if self._warm is None:
+            return 0
+        return int(self._warm.versioned.version)
 
     # -- membership extraction -------------------------------------------------
 
@@ -287,24 +312,38 @@ class IncrementalSolveSession:
             if self._warm is not None else None,
             mesh_changed=mesh_changed,
         )
+        forced = self._forced_reason
+        if forced is not None:
+            # lineage trust override (force_full): full re-anchor, one shot
+            mode, reason = MODE_FULL, forced
+            self._forced_reason = None
 
-        fault = SOLVER_DISPATCH.hit(
-            kinds=("error", "timeout"), op="solve", classes=len(members)
-        )
-        if fault is not None and fault.kind in ("error", "timeout"):
-            raise RuntimeError(fault.describe())
+        try:
+            fault = SOLVER_DISPATCH.hit(
+                kinds=("error", "timeout"), op="solve", classes=len(members)
+            )
+            if fault is not None and fault.kind in ("error", "timeout"):
+                raise RuntimeError(fault.describe())
 
-        with tracing.span("solve.incremental") as sp:
-            if mode == MODE_DELTA:
-                results = self._delta_solve(delta, by_uid, state_nodes)
-                if results is None:  # repair ran out of room: escalate
-                    mode, reason = MODE_FULL, "slots-exhausted"
-            if mode == MODE_FULL:
-                results = self._full_solve(
-                    pods_or_classes if classes is None else classes,
-                    members, state_nodes, bound_pods, supply_anchor, reason,
-                )
-            sp.set(**{"solve.mode": mode, "solve.mode.reason": reason})
+            with tracing.span("solve.incremental") as sp:
+                if mode == MODE_DELTA:
+                    results = self._delta_solve(delta, by_uid, state_nodes)
+                    if results is None:  # repair ran out of room: escalate
+                        mode, reason = MODE_FULL, "slots-exhausted"
+                if mode == MODE_FULL:
+                    results = self._full_solve(
+                        pods_or_classes if classes is None else classes,
+                        members, state_nodes, bound_pods, supply_anchor, reason,
+                    )
+                sp.set(**{"solve.mode": mode, "solve.mode.reason": reason})
+        except Exception:
+            if forced is not None:
+                # the forced re-anchor never answered (fault/ejection): it is
+                # still owed, so the RETRY carries the same reason — a
+                # post-restart session-lost must not relabel itself "first"
+                # just because chaos ate the first attempt
+                self._forced_reason = forced
+            raise
         SOLVE_MODE.labels(mode).inc()
         self.last_mode, self.last_reason = mode, reason
         self.mode_counts[mode] = self.mode_counts.get(mode, 0) + 1
@@ -354,13 +393,14 @@ class IncrementalSolveSession:
                 snapshot = solver.encode(pods_or_classes, state_nodes, bound_pods)
             versioned = self.store.commit(snapshot, supply=supply)
             prep = solver.prepare_encoded(snapshot, state_nodes, bound_pods)
-            outputs = solver.run_prepared(prep)
+            run = self._run_prepared or solver.run_prepared
+            outputs = run(prep)
             n_next_h, failed_h = jax.device_get(
                 (outputs.state.n_next, outputs.failed)
             )
             slots = outputs.assign.shape[1]
             if int(np.sum(failed_h)) > 0 and int(n_next_h) >= slots:
-                outputs = solver.run_prepared(prep, n_slots=slots * 2)
+                outputs = run(prep, n_slots=slots * 2)
             results = solver.decode(snapshot, outputs, state_nodes or [])
         except Exception:
             self._warm = None  # a half-built lineage must not seed repairs

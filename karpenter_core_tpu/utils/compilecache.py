@@ -449,6 +449,83 @@ def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
     return compiled
 
 
+def batched_solve_callable(
+    n_tenants: int,
+    cls,
+    statics_arrays,
+    n_slots: int,
+    key_has_bounds,
+    ex_state=None,
+    ex_static=None,
+    n_passes: int = 1,
+    features=None,
+    mesh_axes=None,
+):
+    """The coalesced multi-tenant executable: ``vmap`` of the plain solve body
+    over a leading tenant axis (service/tenant.py stacks N compatible-bucket
+    tenants' planes and unstacks the outputs).  Memoized in ``_memo`` like
+    every other variant, keyed on the batch size + the per-tenant bucket
+    signature, so steady coalescing reuses ONE batched executable per
+    (bucket, N).  ``cls``/``statics_arrays``/``ex_*`` are ONE tenant's
+    (unstacked) pytrees — only shapes/dtypes matter.  ``mesh_axes``
+    (parallel.mesh.tenant_mesh_axes) selects the sharded twin: the same vmap
+    body under a shard_map that splits the tenant axis across devices.
+
+    Per-element semantics are the solo program's exactly — the coalesced
+    parity suite pins every co-batched tenant's outputs bit-identical to its
+    solo solve (tests/test_tenant_service.py)."""
+    import jax
+
+    fuse_zones, packed_masks = kernel_flags()
+    features = snap_features(features)
+    has_ex = ex_state is not None
+    key = (
+        "tenant-batch",
+        int(n_tenants),
+        _kernel_src_hash(),
+        jax.default_backend(),
+        n_slots,
+        tuple(key_has_bounds),
+        n_passes,
+        tuple(features),
+        fuse_zones,
+        packed_masks,
+        has_ex,
+        mesh_axes,
+        _leaf_sig(cls),
+        _leaf_sig(statics_arrays),
+        _leaf_sig(ex_state) if has_ex else None,
+        _leaf_sig(ex_static) if has_ex else None,
+    )
+    with _lock:
+        fn = _memo.get(key)
+        if fn is not None:
+            _stats["memo_hits"] += 1
+            return fn
+    base = _base_solve_fn(
+        False, has_ex, n_slots, key_has_bounds, n_passes, features,
+        fuse_zones, packed_masks,
+    )
+    if has_ex:
+        solo_args = (cls, statics_arrays, ex_state, ex_static)
+    else:
+        solo_args = (cls, statics_arrays)
+    structs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct((int(n_tenants),) + tuple(a.shape), a.dtype),
+        solo_args,
+    )
+    if mesh_axes is not None:
+        from karpenter_core_tpu.parallel import mesh as mesh_mod
+
+        fn = mesh_mod.tenant_solve_callable(mesh_axes, base, structs)
+    else:
+        fn = jax.jit(jax.vmap(base))
+    with _lock:
+        _memo[key] = fn
+        _stats["builds"] += 1
+    return fn
+
+
 def kernel_flags():
     """(fuse_zones, packed_masks) process defaults: both on, individually
     disengageable for triage via KC_KERNEL_FUSE_ZONES=0 /

@@ -174,6 +174,19 @@ class RetryBudget:
             self._refill()
             return self._tokens
 
+    def next_token_s(self) -> float:
+        """Seconds until ``allow()`` would next succeed (0.0 = it would now).
+        The retry-after hint a shed/admission-control response carries so a
+        rejected caller backs off for exactly as long as the bucket needs,
+        instead of guessing (service/tenant.py)."""
+        with self._lock:
+            self._refill()
+            if self._tokens >= 1.0:
+                return 0.0
+            if self.refill_per_s <= 0:
+                return float("inf")
+            return (1.0 - self._tokens) / self.refill_per_s
+
 
 # breaker states (gauge values on /metrics)
 CLOSED = "closed"

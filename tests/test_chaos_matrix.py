@@ -396,6 +396,55 @@ class TestConvergenceScenarios:
         assert env.provisioning.degraded() is False
         assert_no_machine_leaks(env)
 
+    def test_flapping_solver_service_degraded_then_recovers(self, tmp_path,
+                                                            monkeypatch):
+        """The ``service.rpc`` chaos point flaps the gRPC channel (the one
+        major I/O boundary the other six points don't cover): remote solves
+        die at the transport, the controller's EXISTING solver breaker opens,
+        degraded host solves keep the cluster converging, and the half-open
+        trial restores the remote path once the flapping stops."""
+        from karpenter_core_tpu.service.snapshot_channel import serve
+        from karpenter_core_tpu.solver.scheduler import SchedulingResults
+
+        monkeypatch.setenv("KC_LEASE_STATE", str(tmp_path / "leases.json"))
+        env = seeded_env()
+        env.provisioning.use_tpu_kernel = True
+        env.provisioning.tpu_kernel_min_pods = 2
+        server, port = serve(env.provider)
+        env.provisioning.solver_endpoint = f"127.0.0.1:{port}"
+        try:
+            scenario = chaos.Scenario("service-flap", 17, {
+                "service.rpc": chaos.PointSpec(first_n=8),
+            })
+            with chaos.armed(scenario, env.clock):
+                # flapping channel: every remote attempt dies client-side,
+                # the breaker counts each one, pods still land via fallback
+                for _ in range(prov_mod.TPU_KERNEL_MAX_FAILURES):
+                    pods = make_pods(2, requests={"cpu": "100m"})
+                    result = expect_provisioned(env, *pods)
+                    assert all(result[p.uid] is not None for p in pods)
+                assert env.provisioning.solver_breaker.state == retry.OPEN
+                assert env.provisioning.degraded() is True
+                assert scenario.fired_counts().get("service.rpc", 0) >= 2
+                # degraded batch never touches the flapping channel
+                hits = scenario.hit_counts().get("service.rpc", 0)
+                pods = make_pods(2, requests={"cpu": "100m"})
+                result = expect_provisioned(env, *pods)
+                assert all(result[p.uid] is not None for p in pods)
+                assert scenario.hit_counts().get("service.rpc", 0) == hits
+            # channel heals: half-open trial re-promotes the remote path
+            env.clock.step(prov_mod.SOLVER_BREAKER_RESET_S + 1)
+            assert env.provisioning.solver_breaker.state == retry.HALF_OPEN
+            env.provisioning._schedule_tpu = (
+                lambda pods, state_nodes: SchedulingResults()
+            )
+            expect_provisioned(env, *make_pods(2, requests={"cpu": "100m"}))
+            assert env.provisioning.solver_breaker.state == retry.CLOSED
+            assert env.provisioning.degraded() is False
+            assert_no_machine_leaks(env)
+        finally:
+            server.stop(grace=0)
+
     def test_clock_skew_during_ttl_expiry(self):
         """Skewed clocks accelerate an emptiness TTL; the node deletes
         exactly once, and when the skew stops nothing re-fires."""

@@ -348,6 +348,106 @@ class TestWireSchema:
             server.stop(0)
 
 
+class TestRemoteLeaseCAS:
+    """Lease-plane compare-and-swap under CONCURRENT writers: only the happy
+    path was pinned before — two elector replicas racing the same
+    expectedVersion must yield exactly one winner and a version-conflict
+    error for the loser (the property leader election's safety rests on)."""
+
+    @pytest.fixture()
+    def lease_server(self, tmp_path, monkeypatch):
+        from karpenter_core_tpu.service.snapshot_channel import serve
+
+        monkeypatch.setenv("KC_LEASE_STATE", str(tmp_path / "leases.json"))
+        server, port = serve(FakeCloudProvider())
+        yield f"127.0.0.1:{port}"
+        server.stop(0)
+
+    @staticmethod
+    def _lease(name="leader", holder="", transitions=0):
+        from karpenter_core_tpu.apis.objects import Lease, LeaseSpec, ObjectMeta
+
+        return Lease(
+            metadata=ObjectMeta(name=name, namespace="karpenter"),
+            spec=LeaseSpec(
+                holder_identity=holder,
+                lease_duration_seconds=15,
+                acquire_time=1.0,
+                renew_time=1.0,
+                lease_transitions=transitions,
+            ),
+        )
+
+    def test_racing_updates_same_expected_version_one_winner(self, lease_server):
+        import threading
+
+        from karpenter_core_tpu.operator.kubeclient import ConflictError
+        from karpenter_core_tpu.service.snapshot_channel import RemoteLeaseStore
+
+        seed_store = RemoteLeaseStore(lease_server)
+        created = seed_store.create(self._lease(holder="seed"))
+        assert created.metadata.resource_version == 1
+
+        stores = {w: RemoteLeaseStore(lease_server) for w in ("alpha", "beta")}
+        outcomes = {}
+        barrier = threading.Barrier(2)
+
+        def race(who):
+            barrier.wait()
+            try:
+                updated = stores[who].update_with_version(
+                    self._lease(holder=who, transitions=1),
+                    expected_resource_version=1,
+                )
+                outcomes[who] = ("won", updated.metadata.resource_version)
+            except ConflictError as e:
+                outcomes[who] = ("conflict", str(e))
+
+        threads = [threading.Thread(target=race, args=(w,)) for w in stores]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        results = sorted(kind for kind, _ in outcomes.values())
+        assert results == ["conflict", "won"], outcomes
+        winner = next(w for w, (kind, _) in outcomes.items() if kind == "won")
+        assert outcomes[winner][1] == 2
+        # the stored lease is the winner's, exactly one version bump
+        final = seed_store.get(None, "leader", "karpenter")
+        assert final.spec.holder_identity == winner
+        assert final.metadata.resource_version == 2
+
+    def test_racing_creates_one_winner(self, lease_server):
+        import threading
+
+        from karpenter_core_tpu.operator.kubeclient import ConflictError
+        from karpenter_core_tpu.service.snapshot_channel import RemoteLeaseStore
+
+        stores = {w: RemoteLeaseStore(lease_server) for w in ("alpha", "beta")}
+        outcomes = {}
+        barrier = threading.Barrier(2)
+
+        def race(who):
+            barrier.wait()
+            try:
+                stores[who].create(self._lease(name="fresh", holder=who))
+                outcomes[who] = "won"
+            except ConflictError:
+                outcomes[who] = "conflict"
+
+        threads = [threading.Thread(target=race, args=(w,)) for w in stores]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(outcomes.values()) == ["conflict", "won"], outcomes
+        winner = next(w for w, kind in outcomes.items() if kind == "won")
+        final = stores["alpha"].get(None, "fresh", "karpenter")
+        assert final.metadata.resource_version == 1
+        assert final.spec.holder_identity == winner
+
+
 class TestSettingsStore:
     def test_live_update(self):
         from karpenter_core_tpu.operator.kubeclient import KubeClient

@@ -1,0 +1,619 @@
+"""Multi-tenant solver service: admission control, tenant fault isolation,
+batch coalescing, and crash-recoverable sessions (service/tenant.py,
+docs/SERVICE.md).
+
+The wire tests run small solves so the kernel executable compiles once per
+pytest process and stays memoized across tests (the shapes share one
+bucket)."""
+
+import threading
+
+import grpc
+import msgpack
+import pytest
+
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_core_tpu.service.snapshot_channel import (
+    SERVICE,
+    SnapshotSolverClient,
+    serve,
+    service_capacity,
+)
+from karpenter_core_tpu.service.tenant import (
+    BatchCoalescer,
+    TenantConfig,
+    TenantPlane,
+    parse_retry_after,
+)
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+from karpenter_core_tpu.utils import retry
+from karpenter_core_tpu.utils.clock import FakeClock
+
+
+def _loose_config(**kw) -> TenantConfig:
+    """A config that never sheds or batches unless the test asks for it."""
+    base = dict(
+        rate_per_s=1000.0, burst=1000, max_inflight=64,
+        batch_window_s=0.0, max_batch=8,
+        breaker_threshold=3, breaker_reset_s=30.0,
+    )
+    base.update(kw)
+    return TenantConfig(**base)
+
+
+def _pod_classes(n: int = 4, cpu: str = "500m"):
+    return [(make_pod(requests={"cpu": cpu}), n)]
+
+
+def _solve(client, tenant_id: str, count: int = 4, version: int = 0,
+           cpu: str = "500m", supply_digest=None):
+    tenant = {"id": tenant_id, "sessionVersion": version}
+    if supply_digest is not None:
+        tenant["supplyDigest"] = supply_digest
+    return client.solve_tenant_classes(
+        _pod_classes(count, cpu), [make_provisioner()], tenant=tenant
+    )
+
+
+@pytest.fixture()
+def channel(request):
+    """(server, client, clock) with a per-test TenantConfig via
+    ``@pytest.mark.tenant_config(...)``."""
+    marker = request.node.get_closest_marker("tenant_config")
+    config = _loose_config(**(marker.kwargs if marker else {}))
+    clock = FakeClock()
+    server, port = serve(FakeCloudProvider(), tenant_config=config, clock=clock)
+    client = SnapshotSolverClient(f"127.0.0.1:{port}")
+    yield server, client, clock
+    client.close()
+    server.stop(0)
+
+
+def _raw_solve_classes(port_or_client, payload: dict) -> bytes:
+    client = port_or_client
+    raw = client.channel.unary_unary(f"/{SERVICE}/SolveClasses")
+    return raw(msgpack.packb(payload))
+
+
+class TestAdmissionControl:
+    @pytest.mark.tenant_config(rate_per_s=0.1, burst=1)
+    def test_rate_shed_is_resource_exhausted_with_retry_after(self, channel):
+        _server, client, _clock = channel
+        ok = _solve(client, "acme")
+        assert ok["tenant"]["solveMode"] == "full"
+        with pytest.raises(grpc.RpcError) as excinfo:
+            _solve(client, "acme")
+        assert excinfo.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert "tenant-shed" in excinfo.value.details()
+        hint = parse_retry_after(excinfo.value.details())
+        assert hint is not None and hint > 0
+
+    @pytest.mark.tenant_config(rate_per_s=0.1, burst=1)
+    def test_one_tenants_burst_does_not_shed_another(self, channel):
+        _server, client, _clock = channel
+        _solve(client, "noisy")
+        with pytest.raises(grpc.RpcError):
+            _solve(client, "noisy")
+        # the quiet tenant has its own bucket
+        assert _solve(client, "quiet")["tenant"]["solveMode"] == "full"
+
+    def test_queue_bound_sheds_with_hint(self):
+        plane = TenantPlane(clock=FakeClock(), config=_loose_config(max_inflight=1))
+        assert plane.admit("a").admitted
+        decision = plane.admit("b")
+        assert not decision.admitted and decision.reason == "queue"
+        assert decision.retry_after_s > 0
+        plane.release("a")
+        assert plane.admit("b").admitted
+
+    def test_shed_hints_escalate_while_hammering(self):
+        clock = FakeClock()
+        # fast-refill bucket: its own hint is tiny, so the per-tenant shed
+        # Backoff is what the hammering client sees escalate
+        plane = TenantPlane(
+            clock=clock, config=_loose_config(rate_per_s=1000.0, burst=1)
+        )
+        assert plane.admit("a").admitted
+        plane.release("a")
+        hints = [plane.admit("a").retry_after_s for _ in range(3)]
+        assert hints[0] < hints[1] < hints[2]  # Backoff-escalated
+
+    def test_queue_shed_does_not_burn_rate_tokens(self):
+        """Global queue pressure caused by OTHER tenants must not consume
+        this tenant's tokens: a queue-shed storm followed by a drain leaves
+        the tenant admissible, never escalated into a rate shed."""
+        plane = TenantPlane(
+            clock=FakeClock(),
+            config=_loose_config(max_inflight=1, rate_per_s=0.001, burst=1),
+        )
+        assert plane.admit("hog").admitted
+        for _ in range(5):
+            decision = plane.admit("victim")
+            assert not decision.admitted and decision.reason == "queue"
+        plane.release("hog")
+        # the victim's single burst token is still there
+        assert plane.admit("victim").admitted
+
+    def test_retry_budget_next_token_hint(self):
+        clock = FakeClock()
+        bucket = retry.RetryBudget(clock, budget=2, window_s=20.0, name="t")
+        assert bucket.next_token_s() == 0.0
+        assert bucket.allow() and bucket.allow() and not bucket.allow()
+        hint = bucket.next_token_s()
+        assert 0 < hint <= 10.0
+        clock.step(hint)
+        assert bucket.allow()
+
+
+class TestTenantIsolation:
+    @pytest.mark.tenant_config(breaker_threshold=2)
+    def test_malformed_requests_isolate_the_tenant(self, channel):
+        server, client, clock = channel
+        for _ in range(2):
+            with pytest.raises(grpc.RpcError) as excinfo:
+                _raw_solve_classes(client, {
+                    "tenant": {"id": "bad"},
+                    "podClasses": [{"oops": 1}],
+                })
+            assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            assert "tenant-ejected reason=malformed" in excinfo.value.details()
+        # breaker open: even a VALID request is refused with a hint
+        with pytest.raises(grpc.RpcError) as excinfo:
+            _solve(client, "bad")
+        assert excinfo.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert "tenant-isolated" in excinfo.value.details()
+        assert parse_retry_after(excinfo.value.details()) > 0
+        # the other N-1 tenants never notice
+        assert _solve(client, "good")["tenant"]["solveMode"] == "full"
+        # half-open after the reset window: one trial readmits the tenant
+        clock.step(31.0)
+        assert _solve(client, "bad")["tenant"]["solveMode"] == "full"
+        plane = server.kc_service.tenants
+        entry = plane.checkout("bad")
+        assert entry.breaker.state == retry.CLOSED
+
+    @pytest.mark.tenant_config(max_request_bytes=2048)
+    def test_oversized_snapshot_is_ejected_and_counted(self, channel):
+        _server, client, _clock = channel
+        big = make_pod(requests={"cpu": "500m"},
+                       labels={f"pad-{i}": "x" * 40 for i in range(40)})
+        with pytest.raises(grpc.RpcError) as excinfo:
+            client.solve_tenant_classes(
+                [(big, 4)], [make_provisioner()], tenant={"id": "fat"}
+            )
+        assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "reason=oversized" in excinfo.value.details()
+
+    def test_missing_tenant_id_rejected(self, channel):
+        _server, client, _clock = channel
+        with pytest.raises(grpc.RpcError) as excinfo:
+            _raw_solve_classes(client, {"tenant": {"sessionVersion": 1},
+                                        "podClasses": []})
+        assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+class TestSessionLifecycle:
+    def test_ttl_eviction_on_fake_clock(self):
+        clock = FakeClock()
+        plane = TenantPlane(clock=clock, config=_loose_config(session_ttl_s=60.0))
+        plane.checkout("a")
+        clock.step(61.0)
+        plane.checkout("b")
+        assert plane.sessions() == ["b"]
+
+    def test_lru_eviction_caps_resident_sessions(self):
+        plane = TenantPlane(clock=FakeClock(), config=_loose_config(max_sessions=2))
+        for tid in ("a", "b", "c"):
+            plane.checkout(tid)
+        assert plane.sessions() == ["b", "c"]
+        # touching keeps a session warm
+        plane.checkout("b")
+        plane.checkout("d")
+        assert plane.sessions() == ["b", "d"]
+
+    @pytest.mark.tenant_config(max_sessions=1)
+    def test_evicted_session_reanchors_as_session_lost(self, channel):
+        _server, client, _clock = channel
+        r1 = _solve(client, "a")
+        assert r1["tenant"]["reason"] == "first"
+        _solve(client, "b")  # evicts a (capacity 1)
+        r2 = _solve(client, "a", version=r1["tenant"]["sessionVersion"])
+        assert r2["tenant"]["solveMode"] == "full"
+        assert r2["tenant"]["reason"] == "session-lost"
+
+
+class TestSessionRecovery:
+    def test_full_then_delta_then_stale_version_reanchors(self, channel):
+        _server, client, _clock = channel
+        r1 = _solve(client, "acme", count=8)
+        assert (r1["tenant"]["solveMode"], r1["tenant"]["reason"]) == ("full", "first")
+        v1 = r1["tenant"]["sessionVersion"]
+        assert v1 > 0
+        # +2 pods of 10 stays under the delta-fraction escalation bound
+        r2 = _solve(client, "acme", count=10, version=v1)
+        assert r2["tenant"]["solveMode"] == "delta"
+        # only the delta's pods come back on a delta solve
+        placed = sum(n for node in r2["newNodes"] for _c, n in node["classCounts"])
+        placed += sum(n for _c, n in r2["failedClassCounts"])
+        placed += sum(
+            n for counts in r2["existingAssignments"].values() for _c, n in counts
+        )
+        assert placed == 2
+        r3 = _solve(client, "acme", count=10, version=v1 + 999)
+        assert (r3["tenant"]["solveMode"], r3["tenant"]["reason"]) == (
+            "full", "session-lost"
+        )
+
+    def test_client_restart_reanchors(self, channel):
+        _server, client, _clock = channel
+        r1 = _solve(client, "acme")
+        assert r1["tenant"]["sessionVersion"] > 0
+        r2 = _solve(client, "acme", version=0)
+        assert (r2["tenant"]["solveMode"], r2["tenant"]["reason"]) == (
+            "full", "client-reanchor"
+        )
+
+    def test_supply_digest_mismatch_reanchors(self, channel):
+        _server, client, _clock = channel
+        r1 = _solve(client, "acme", supply_digest="sha:aaa")
+        v1 = r1["tenant"]["sessionVersion"]
+        r2 = _solve(client, "acme", version=v1, supply_digest="sha:bbb")
+        assert (r2["tenant"]["solveMode"], r2["tenant"]["reason"]) == (
+            "full", "supply-digest"
+        )
+
+    def test_server_restart_mid_stream_session_lost(self):
+        """Kill the server, bring a new one up: in-memory lineages die with
+        the process and every session re-anchors — reason session-lost, a
+        FULL solve, never a stale delta."""
+        provider = FakeCloudProvider()
+        config = _loose_config()
+        server, port = serve(provider, tenant_config=config)
+        client = SnapshotSolverClient(f"127.0.0.1:{port}")
+        try:
+            r1 = _solve(client, "acme", count=5)
+            v1 = r1["tenant"]["sessionVersion"]
+            assert v1 > 0
+        finally:
+            client.close()
+            server.stop(grace=0)
+        server, port = serve(provider, tenant_config=config)
+        client = SnapshotSolverClient(f"127.0.0.1:{port}")
+        try:
+            r2 = _solve(client, "acme", count=5, version=v1)
+            assert (r2["tenant"]["solveMode"], r2["tenant"]["reason"]) == (
+                "full", "session-lost"
+            )
+            # the full re-anchor accounts every pod, exactly once
+            placed = sum(
+                n for node in r2["newNodes"] for _c, n in node["classCounts"]
+            )
+            placed += sum(
+                n for counts in r2["existingAssignments"].values()
+                for _c, n in counts
+            )
+            assert placed == 5
+        finally:
+            client.close()
+            server.stop(grace=0)
+
+
+def _strip(resp: dict) -> dict:
+    return {k: v for k, v in resp.items() if k != "tenant"}
+
+
+class TestCoalescing:
+    @pytest.mark.tenant_config(batch_window_s=1.0)
+    def test_coalesced_batch_bit_identical_to_solo(self, channel):
+        """Two concurrent compatible-bucket tenants coalesce into ONE
+        batched (vmapped) solve whose per-tenant answers are bit-identical
+        to their solo solves."""
+        _server, client, _clock = channel
+        solo = {
+            "a": _solve(client, "solo-a", cpu="500m"),
+            "b": _solve(client, "solo-b", cpu="250m"),
+        }
+        for attempt in range(3):
+            results = {}
+            errors = []
+
+            def call(tid, cpu, attempt=attempt):
+                try:
+                    results[tid] = client.solve_tenant_classes(
+                        _pod_classes(4, cpu), [make_provisioner()],
+                        tenant={"id": f"{tid}-{attempt}"},
+                    )
+                except Exception as e:  # noqa: BLE001 - surfaced below
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=call, args=("a", "500m")),
+                threading.Thread(target=call, args=("b", "250m")),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors, errors
+            if all(r["tenant"]["batched"] == 2 for r in results.values()):
+                break
+        else:
+            pytest.fail("coalescer never batched the concurrent tenants")
+        assert _strip(results["a"]) == _strip(solo["a"])
+        assert _strip(results["b"]) == _strip(solo["b"])
+
+    @pytest.mark.tenant_config(batch_window_s=1.0)
+    def test_ejected_tenant_contained_rest_bit_identical(self, channel):
+        """The fault-containment acceptance pin: one tenant's snapshot
+        fails validation (never reaches the batch) and is answered with a
+        structured error, while its co-batched tenants' assignments stay
+        bit-identical to their solo solves."""
+        _server, client, _clock = channel
+        solo = {
+            "a": _solve(client, "solo2-a", cpu="500m"),
+            "c": _solve(client, "solo2-c", cpu="250m"),
+        }
+        results = {}
+        errors = {}
+
+        def good(tid, cpu):
+            results[tid] = client.solve_tenant_classes(
+                _pod_classes(4, cpu), [make_provisioner()],
+                tenant={"id": tid},
+            )
+
+        def bad():
+            try:
+                # no "pod" key at all: fails validation at decode, before
+                # the batch ever sees it
+                _raw_solve_classes(client, {
+                    "tenant": {"id": "poison"},
+                    "podClasses": [{"count": 3}],
+                })
+            except grpc.RpcError as e:
+                errors["poison"] = e
+
+        threads = [
+            threading.Thread(target=good, args=("batch-a", "500m")),
+            threading.Thread(target=good, args=("batch-c", "250m")),
+            threading.Thread(target=bad),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors["poison"].code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "tenant-ejected" in errors["poison"].details()
+        assert _strip(results["batch-a"]) == _strip(solo["a"])
+        assert _strip(results["batch-c"]) == _strip(solo["c"])
+
+    @pytest.mark.tenant_config(batch_window_s=1.0)
+    def test_batch_program_fault_falls_back_to_solo(self, channel, monkeypatch):
+        """A fault in the batched PROGRAM itself (not attributable to one
+        tenant) re-runs every member solo — answers still land, still
+        correct."""
+        def boom(preps):
+            raise RuntimeError("batched executable died")
+
+        monkeypatch.setattr(BatchCoalescer, "_run_batched", staticmethod(boom))
+        _server, client, _clock = channel
+        solo = _solve(client, "solo3-a", cpu="500m")
+        results = {}
+
+        def call(tid, cpu):
+            results[tid] = client.solve_tenant_classes(
+                _pod_classes(4, cpu), [make_provisioner()],
+                tenant={"id": tid},
+            )
+
+        threads = [
+            threading.Thread(target=call, args=("fb-a", "500m")),
+            threading.Thread(target=call, args=("fb-b", "250m")),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results["fb-a"]["tenant"]["batched"] == 1
+        assert _strip(results["fb-a"]) == _strip(solo)
+
+    def test_coalescer_never_exceeds_max_batch(self, monkeypatch):
+        """Late same-bucket arrivals racing a full group must start the NEXT
+        group, never swell a dispatched batch past max_batch (an oversized
+        batch would miss the (bucket, N) executable memo and compile on the
+        request path)."""
+        import numpy as np
+
+        class _FakePrep:
+            cls = (np.zeros(2, dtype=np.int32),)
+            statics_arrays = (np.ones(2, dtype=np.int32),)
+            ex_state = None
+            ex_static = None
+            n_slots = 4
+            key_has_bounds = (False,)
+            n_passes = 1
+            features = None
+
+        sizes = []
+
+        def fake_batched(preps):
+            sizes.append(len(preps))
+            return [("out", i) for i in range(len(preps))]
+
+        monkeypatch.setattr(BatchCoalescer, "_run_batched",
+                            staticmethod(fake_batched))
+        coalescer = BatchCoalescer(window_s=0.3, max_batch=2)
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(
+                    coalescer.run(_FakePrep(), lambda: ("solo", 0))
+                )
+            )
+            for _ in range(5)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 5
+        assert all(outputs is not None for outputs, _n in results)
+        assert all(size <= 2 for size in sizes), sizes
+        assert all(n <= 2 for _outputs, n in results)
+
+    def test_tenant_mesh_axis_batched_solve_bit_identical(self, monkeypatch):
+        """The sharded twin (KC_SOLVER_MESH=1): the coalesced batch splits
+        over a mesh ``tenant`` axis (parallel.mesh.TENANT_PARTITION_RULES),
+        each device vmapping its local tenants — outputs bit-identical to
+        the plain vmap path and to solo solves."""
+        import jax
+        import numpy as np
+
+        from karpenter_core_tpu.models.columnar import PodIngest
+        from karpenter_core_tpu.parallel import mesh as mesh_mod
+        from karpenter_core_tpu.solver.tpu import TPUSolver
+
+        provider = FakeCloudProvider()
+        solver = TPUSolver(provider, [make_provisioner()])
+        preps = []
+        for cpu in ("500m", "250m", "500m", "250m"):
+            ingest = PodIngest()
+            ingest.add_all([make_pod(requests={"cpu": cpu}) for _ in range(6)])
+            preps.append(solver.prepare_encoded(solver.encode(ingest)))
+        solo = [solver.run_prepared(p) for p in preps]
+
+        plain = BatchCoalescer._run_batched(preps)
+        monkeypatch.setenv("KC_SOLVER_MESH", "1")
+        monkeypatch.setenv("KC_SOLVER_MESH_DEVICES", "2")
+        assert mesh_mod.tenant_mesh_axes(len(preps)) == (("tenant", 2),)
+        meshed = BatchCoalescer._run_batched(preps)
+        # an indivisible batch declines the mesh rather than mis-sharding
+        assert mesh_mod.tenant_mesh_axes(3) is None
+
+        for i in range(len(preps)):
+            solo_leaves = jax.tree_util.tree_leaves(jax.device_get(solo[i]))
+            for variant in (plain[i], meshed[i]):
+                leaves = jax.tree_util.tree_leaves(variant)
+                assert all(
+                    np.array_equal(np.asarray(a), np.asarray(b))
+                    for a, b in zip(solo_leaves, leaves)
+                )
+
+    def test_solver_fault_is_structured_ejection(self, channel):
+        """A chaos solver.dispatch fault during one tenant's solve comes
+        back as a structured in-body error (not a batch-wide abort), and
+        the tenant re-anchors cleanly afterwards."""
+        _server, client, _clock = channel
+        scenario = chaos.Scenario("tenant-eject", 7, {
+            "solver.dispatch": chaos.PointSpec(first_n=1),
+        })
+        with chaos.armed(scenario):
+            resp = _solve(client, "faulty")
+        assert resp["error"]["kind"] == "ejected"
+        assert "chaos" in resp["error"]["reason"]
+        assert resp["tenant"]["sessionVersion"] == 0
+        # chaos exhausted: the next solve re-anchors from scratch
+        r2 = _solve(client, "faulty")
+        assert r2["tenant"]["solveMode"] == "full"
+
+
+class TestServerLoop:
+    def test_service_capacity_env(self, monkeypatch):
+        monkeypatch.setenv("KC_SERVICE_WORKERS", "7")
+        monkeypatch.setenv("KC_SERVICE_QUEUE", "3")
+        assert service_capacity() == (7, 10)
+        # explicit arg wins over the env
+        assert service_capacity(2) == (2, 5)
+        monkeypatch.setenv("KC_SERVICE_QUEUE", "bogus")
+        assert service_capacity(2) == (2, 34)  # default queue 32
+
+    def test_server_side_deadline_aborts(self, monkeypatch):
+        monkeypatch.setenv("KC_SERVICE_DEADLINE_S", "0.000001")
+        server, port = serve(FakeCloudProvider(), tenant_config=_loose_config())
+        client = SnapshotSolverClient(f"127.0.0.1:{port}")
+        try:
+            with pytest.raises(grpc.RpcError) as excinfo:
+                _solve(client, "slowpoke")
+            assert excinfo.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+            assert "server-side deadline" in excinfo.value.details()
+        finally:
+            client.close()
+            server.stop(0)
+
+    def test_stateless_requests_unaffected_by_tenant_plane(self, channel):
+        """No tenant envelope = the original contract, byte for byte."""
+        _server, client, _clock = channel
+        pods = [make_pod(requests={"cpu": "500m"}) for _ in range(4)]
+        response = client.solve_classes(pods, [make_provisioner()])
+        assert "tenant" not in response
+        placed = sum(len(n["podIndices"]) for n in response["newNodes"])
+        assert placed == 4
+
+
+class TestServiceRpcChaosPoint:
+    def test_client_side_fault_raises_injected(self, channel):
+        _server, client, _clock = channel
+        scenario = chaos.Scenario("svc-client", 3, {
+            "service.rpc": chaos.PointSpec(first_n=1),
+        })
+        with chaos.armed(scenario):
+            with pytest.raises(chaos.InjectedFault):
+                client.solve_classes(
+                    [make_pod(requests={"cpu": "500m"})], [make_provisioner()]
+                )
+        assert scenario.fired_counts().get("service.rpc") == 1
+
+    def test_server_side_fault_is_unavailable(self, channel):
+        _server, client, _clock = channel
+        # hit 0 is the client leg (passes), hit 1 the server leg (fires)
+        scenario = chaos.Scenario("svc-server", 3, {
+            "service.rpc": chaos.PointSpec(schedule=[1]),
+        })
+        with chaos.armed(scenario):
+            with pytest.raises(grpc.RpcError) as excinfo:
+                client.solve_classes(
+                    [make_pod(requests={"cpu": "500m"})], [make_provisioner()]
+                )
+            assert excinfo.value.code() == grpc.StatusCode.UNAVAILABLE
+
+    def test_server_partial_drops_response_after_solve(self, channel):
+        _server, client, _clock = channel
+        scenario = chaos.Scenario("svc-partial", 3, {
+            "service.rpc": chaos.PointSpec(schedule=[1], kind="partial"),
+        })
+        with chaos.armed(scenario):
+            with pytest.raises(grpc.RpcError) as excinfo:
+                client.solve_classes(
+                    [make_pod(requests={"cpu": "500m"})], [make_provisioner()]
+                )
+            assert excinfo.value.code() == grpc.StatusCode.UNAVAILABLE
+            assert "partial" in excinfo.value.details()
+        # the partial fault wasted a full solve — the point of the kind
+        assert scenario.fired_counts().get("service.rpc") == 1
+
+
+class TestTenantWireSchema:
+    """Golden pins for the tenant envelope (service/SCHEMA.md)."""
+
+    def test_tenant_response_envelope_fields(self, channel):
+        _server, client, _clock = channel
+        resp = _solve(client, "schema")
+        assert set(resp["tenant"]) == {
+            "id", "solveMode", "reason", "sessionVersion", "batched",
+        }
+        assert set(resp) == {
+            "newNodes", "existingAssignments", "failedClassCounts",
+            "residualClassCounts", "existingCommittedZones", "tenant",
+        }
+
+    def test_error_envelope_fields(self, channel):
+        _server, client, _clock = channel
+        scenario = chaos.Scenario("schema-eject", 5, {
+            "solver.dispatch": chaos.PointSpec(first_n=1),
+        })
+        with chaos.armed(scenario):
+            resp = _solve(client, "schema-err")
+        assert set(resp) == {"error", "tenant"}
+        assert set(resp["error"]) == {"kind", "reason"}
+        assert set(resp["tenant"]) == {"id", "sessionVersion"}

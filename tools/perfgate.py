@@ -278,6 +278,43 @@ def report_sharded(detail: dict) -> None:
         )
 
 
+def report_tenant(detail: dict) -> None:
+    """Surface the multi-tenant coalescing line (ISSUE-12, docs/SERVICE.md):
+    batched (vmapped tenant axis) vs serial solve throughput over N
+    same-bucket tenants, plus the serial path's p99.  Advisory: warns when
+    coalescing stops beating serial dispatch."""
+    tenant = detail.get("tenant")
+    if not tenant:
+        return
+    if "error" in tenant:
+        print(f"perfgate: tenant bench errored: {tenant['error']}")
+        return
+    print(
+        "perfgate: tenant x{n} batched {b:.4f}s ({bt:.1f} solves/s) vs "
+        "serial {s:.4f}s ({st:.1f} solves/s) — speedup {x:.2f}x, "
+        "p99 serial solve {p:.4f}s, buckets={k}".format(
+            n=tenant["tenants"], b=tenant["batched_s"],
+            bt=tenant["batched_solves_per_s"], s=tenant["serial_s"],
+            st=tenant["serial_solves_per_s"], x=tenant.get("speedup") or 0.0,
+            p=tenant["p99_serial_solve_s"], k=tenant.get("shape_buckets"),
+        )
+    )
+    if tenant.get("shape_buckets", 1) != 1:
+        print(
+            "perfgate: WARNING tenant bench snapshots landed in "
+            f"{tenant['shape_buckets']} shape buckets — the coalescer can "
+            "only batch within one bucket, so the speedup number is "
+            "measuring the wrong regime"
+        )
+    speedup = tenant.get("speedup")
+    if speedup is not None and speedup <= 1.0:
+        print(
+            "perfgate: WARNING tenant batched solve no faster than serial "
+            f"({speedup:.2f}x) — coalescing overhead is eating the "
+            "multi-tenant win (docs/SERVICE.md triage)"
+        )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=0.05,
@@ -302,6 +339,7 @@ def main() -> int:
     report_churn(detail)
     report_policy(detail)
     report_sharded(detail)
+    report_tenant(detail)
     if pods_per_sec is None:
         print(json.dumps(rec))
         print("perfgate: FAIL (bench produced no pods_per_sec)")

@@ -71,6 +71,11 @@ def main(argv=None) -> int:
             builder = catalog.CATALOG[name]
             doc = (builder.__doc__ or "").strip().splitlines()[0]
             print(f"  {name:28s} {doc}")
+        print(
+            f"  {'multi-tenant':28s} N synthetic tenants against one solver "
+            "server (service.rpc + solver.dispatch chaos, restart re-anchor; "
+            "docs/SERVICE.md)"
+        )
         print("generators:", ", ".join(sorted(generators.GENERATORS)))
         return 0
 
@@ -100,7 +105,14 @@ def main(argv=None) -> int:
     ok = True
     try:
         for name in names:
-            report = run_scenario(catalog.build(name, seed=args.seed))
+            if name == "multi-tenant":
+                # the service soak drives a real gRPC server with tenant
+                # threads rather than the trace-driven controller stack
+                from karpenter_core_tpu.soak.tenants import run_multi_tenant
+
+                report = run_multi_tenant(seed=args.seed)
+            else:
+                report = run_scenario(catalog.build(name, seed=args.seed))
             reports.append(report)
             ok = ok and report["verdict"]["passed"]
             if args.verbose:
