@@ -720,6 +720,51 @@ def tenant_line(n_tenants: int = 8, pods_per_tenant: int = 256) -> dict:
         BatchCoalescer._run_batched(preps)  # device_gets internally: synced
         batched_s = min(batched_s, time.perf_counter() - t0)
     p99 = percentile(lat, 0.99)  # the soak SLO engine's nearest-rank
+
+    # durable-session overhead (ISSUE-13, docs/SERVICE.md): the serial loop
+    # again, with a per-solve journal append exactly like the tenant handler
+    # issues (enqueue on the hot path, framing/fsync on the writer thread).
+    # perfgate report_recovery warns past 5% added p99.
+    import tempfile
+
+    import msgpack
+
+    from karpenter_core_tpu.apis import codec
+    from karpenter_core_tpu.service.journal import SessionJournal
+
+    req_bytes = msgpack.packb({
+        "podClasses": [{
+            "pod": codec.pod_to_dict(make_pod(requests=sizes[0])),
+            "count": pods_per_tenant,
+        }],
+        "tenant": {"id": "bench"},
+    })
+    state = {
+        "version": 1, "supply": "0" * 64, "planes": {},
+        "aggregates": {"scheduled": pods_per_tenant, "failed": 0, "nodes": 1},
+        "signature": "0" * 64, "delta_ticks": 0,
+    }
+    lat_j: list = []
+    with tempfile.TemporaryDirectory() as journal_dir:
+        journal = SessionJournal(journal_dir, checkpoint_every=0)
+        journal.start()
+        serial_journal_s = float("inf")
+        for _ in range(3):
+            lats = []
+            t0 = time.perf_counter()
+            for tseq, (solver, prep) in enumerate(zip(solvers, preps)):
+                t1 = time.perf_counter()
+                solve_ops.sync_outputs(solver.run_prepared(prep))
+                journal.append_solve(
+                    tenant=f"bench-{tseq}", kind="anchor", tseq=0, version=1,
+                    client_supply=None, state=state, request=req_bytes,
+                )
+                lats.append(time.perf_counter() - t1)
+            total = time.perf_counter() - t0
+            if total < serial_journal_s:
+                serial_journal_s, lat_j = total, lats
+        journal.close(checkpoint=False)
+    p99_j = percentile(lat_j, 0.99)
     return {
         "tenants": n_tenants,
         "pods_per_tenant": pods_per_tenant,
@@ -730,6 +775,10 @@ def tenant_line(n_tenants: int = 8, pods_per_tenant: int = 256) -> dict:
         "serial_solves_per_s": round(n_tenants / serial_s, 2),
         "batched_solves_per_s": round(n_tenants / batched_s, 2),
         "p99_serial_solve_s": round(p99, 4),
+        "p99_serial_journal_s": round(p99_j, 4),
+        "journal_overhead_fraction": (
+            round(p99_j / p99 - 1.0, 4) if p99 > 0 else None
+        ),
     }
 
 

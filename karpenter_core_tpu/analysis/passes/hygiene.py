@@ -63,8 +63,10 @@ MAX_LINE = 120
 # objective decisions and counter-proposals run inside reconciles and soak
 # ticks, so a wall read there breaks the same replay guarantees; service/ is
 # in: the tenant plane's TTL/lease/breaker/bucket policy must step on
-# FakeClock for the multi-tenant suites — latency MEASUREMENT uses
-# time.perf_counter, which stays allowed)
+# FakeClock for the multi-tenant suites, and service/journal.py's record
+# timestamps ride the injected Clock so durable-session recovery tests run
+# on FakeClock — latency MEASUREMENT uses time.perf_counter, which stays
+# allowed)
 _CLOCKED_DIRS = (
     "controllers", "state", "operator", "solver", "kubeapi", "soak", "policy",
     "service",

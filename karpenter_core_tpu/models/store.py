@@ -121,6 +121,39 @@ def snapshot_digests(
     return out
 
 
+def stable_canonical(obj):
+    """A cross-process canonical form of a (possibly nested) python value:
+    sets/frozensets are sorted, dicts are sorted item tuples, everything else
+    passes through (or falls back to repr).  The point is PYTHONHASHSEED
+    independence — class keys hold frozensets whose iteration (and repr)
+    order is hash-randomized, so a digest of a raw repr would differ between
+    two processes encoding identical state.  The durable-session journal
+    (service/journal.py) verifies restored lineages against digests the
+    crashed process wrote, so its verification digests must canonicalize
+    through here."""
+    if isinstance(obj, (frozenset, set)):
+        return ("set", tuple(sorted((stable_canonical(v) for v in obj), key=repr)))
+    if isinstance(obj, dict):
+        return (
+            "map",
+            tuple(sorted(
+                ((stable_canonical(k), stable_canonical(v)) for k, v in obj.items()),
+                key=repr,
+            )),
+        )
+    if isinstance(obj, (list, tuple)):
+        return tuple(stable_canonical(v) for v in obj)
+    if isinstance(obj, (str, int, float, bool, bytes, type(None))):
+        return obj
+    return repr(obj)
+
+
+def stable_digest(obj) -> str:
+    """sha256 over the stable canonical form — equal values digest equally in
+    any process, whatever PYTHONHASHSEED says."""
+    return hashlib.sha256(repr(stable_canonical(obj)).encode()).hexdigest()
+
+
 def class_key(cls) -> tuple:
     """Version-stable identity of one class row: the equivalence-class
     signature of its representative pod (ladder variants carry the relaxed
@@ -340,6 +373,19 @@ class SnapshotStore:
     def __init__(self) -> None:
         self._version = 0
         self.current: Optional[VersionedSnapshot] = None
+
+    def seed_version(self, version: int) -> None:
+        """Pre-position the version counter so the NEXT commit mints
+        ``version + 1``.  Journal replay (service/journal.py) uses this to
+        restore a recovered lineage at the exact version the crashed process
+        last echoed to its client — without it, a replayed anchor would mint
+        version 1 and every client claiming the true version would be forced
+        into a spurious ``session-lost`` re-anchor.  Only valid on a store
+        that has never committed (replay always starts from a fresh
+        session)."""
+        if self.current is not None:
+            raise RuntimeError("seed_version is only valid before the first commit")
+        self._version = max(int(version), 0)
 
     def commit(self, snapshot: EncodedSnapshot, supply: str = "") -> VersionedSnapshot:
         """Stamp one encode output as the next version and make it current.

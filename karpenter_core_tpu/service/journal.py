@@ -1,0 +1,622 @@
+"""Durable solver sessions: the crash-consistent session journal.
+
+PR 12's multi-tenant service survives a restart only by discarding every
+tenant lineage and re-anchoring with full solves (``session-lost``) —
+correct, but one process crash becomes a fleet-wide cold-solve storm.  This
+module is the durable-state layer that brings a crashed or drained solver
+back **warm**:
+
+  frames      An append-only write-ahead journal of per-tenant solve
+              records.  Every frame is ``u32 length | u32 crc32c | msgpack
+              payload`` after a file magic; a record carries the tenant, a
+              global ``seq``, a per-tenant ``tseq``, the solve kind
+              (``anchor`` = full, ``delta`` = repair), the lineage version,
+              the client's supply digest, a cross-process-stable
+              verification ``state`` (IncrementalSolveSession.lineage_state:
+              snapshot-store plane digests, supply+policy anchor, cumulative
+              assignment signature), and the RAW wire request bytes.
+
+  replay      Recovery does not deserialize tensors — it REPLAYS.  A
+              tenant's live chain is its last anchor plus the deltas since
+              (the fallback policy's audit interval bounds the chain); each
+              record's stored request is decoded and re-solved through a
+              fresh session, and because solves are deterministic the
+              replayed lineage is bit-identical to the one that crashed.
+              Never-trust verification: the replayed ``lineage_state`` must
+              equal the journaled one field for field, and the client's next
+              request still passes the version/supply-digest checks — any
+              mismatch, torn frame, truncated tail, or CRC failure
+              downgrades that tenant to the existing ``session-lost``
+              re-anchor.  A wrong answer is impossible; the worst case is
+              always a full solve.
+
+  checkpoints Every ``checkpoint_every`` appends the writer compacts: the
+              live chains (an anchor obsoletes everything before it; a
+              ``drop`` record removes an evicted tenant) are rewritten to
+              ``checkpoint.wal`` (tmp + fsync + atomic rename) and the
+              journal is truncated.  A crash between rename and truncate
+              leaves duplicate frames — global ``seq`` dedup makes the
+              replay see each record once — and a checkpoint "newer" than a
+              stale journal resolves the same way.
+
+  discipline  Appends are enqueued off the RPC hot path; a single writer
+              thread frames, writes, flushes, and (by default) fsyncs each
+              record.  Any I/O failure — real ENOSPC, a failed fsync, or an
+              injected ``store.io`` chaos fault — fails the journal CLOSED:
+              journaling stops (counted on
+              ``karpenter_journal_failures_total``), serving continues, and
+              the next recovery reads the valid durable prefix.  The journal
+              degrades availability of warmth, never correctness.
+
+``store.io`` is the chaos point on this boundary (docs/CHAOS.md): ``error``
+(ENOSPC via ``data.errno``, fsync failure via ``data.op="fsync"``) and
+``partial`` (a torn half-frame lands, then the journal dies — the shape a
+kill -9 mid-append leaves on disk) on append hits; ``error`` on checkpoint
+hits leaves a stale checkpoint behind while the journal keeps growing.
+
+Recovery outcomes land on ``karpenter_session_recovered_total{outcome}``:
+``warm`` (lineage restored + verified), ``reanchor`` (replay failed or
+verification mismatched — the tenant re-anchors), ``corrupt`` (the frame
+stream itself broke under that tenant's chain).
+
+All timestamps ride the injected ``utils/clock.Clock`` (the kcanalyze
+``wallclock`` rule covers ``service/``), so recovery suites run on
+FakeClock.  Triage flags: ``KC_SESSION_JOURNAL``, ``KC_JOURNAL_DIR``,
+``KC_JOURNAL_CHECKPOINT_EVERY``, ``KC_JOURNAL_FSYNC`` (docs/SERVICE.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+
+from karpenter_core_tpu import chaos, tracing
+from karpenter_core_tpu.metrics import REGISTRY
+from karpenter_core_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+# the store-I/O injection point (docs/CHAOS.md): torn write / partial frame /
+# ENOSPC / fsync error on appends, stale checkpoint on compactions
+STORE_IO = chaos.point("store.io")
+
+SESSION_RECOVERED = REGISTRY.counter(
+    "karpenter_session_recovered_total",
+    "Session recovery outcomes at restart: warm (lineage replayed and "
+    "verified), reanchor (a tenant chain was structurally broken or failed "
+    "replay/verification — that tenant re-anchors session-lost), corrupt "
+    "(a journal/checkpoint frame stream was torn or CRC-failed — counted "
+    "per damaged file).",
+    ("outcome",),
+)
+JOURNAL_RECORDS = REGISTRY.counter(
+    "karpenter_journal_records_total",
+    "Session-journal records accepted for append, by kind "
+    "(anchor / delta / drop).",
+    ("kind",),
+)
+JOURNAL_FAILURES = REGISTRY.counter(
+    "karpenter_journal_failures_total",
+    "Session-journal I/O failures by operation (append / fsync / checkpoint "
+    "/ queue); any append/fsync failure fails the journal closed.",
+    ("op",),
+)
+JOURNAL_ACTIVE = REGISTRY.gauge(
+    "karpenter_journal_active",
+    "1 while the session journal is accepting appends (0 = disabled or "
+    "failed closed).",
+)
+
+MAGIC = b"KCWJ1\n"
+_FRAME_HEAD = struct.Struct("<II")  # payload length, crc32c(payload)
+MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+KIND_ANCHOR = "anchor"
+KIND_DELTA = "delta"
+
+# frame-stream read statuses
+STATUS_OK = "ok"
+STATUS_EMPTY = "empty"
+STATUS_MISSING = "missing"
+STATUS_TORN = "torn"       # truncated mid-frame (crash tail)
+STATUS_CORRUPT = "corrupt"  # CRC / framing / decode failure
+
+
+class RecoveryMismatch(Exception):
+    """A replayed lineage disagreed with its journaled verification state —
+    the never-trust downgrade to ``session-lost``."""
+
+
+# -- crc32c (Castagnoli) ------------------------------------------------------
+# zlib.crc32 is CRC-32/ISO-HDLC; storage stacks standardize on Castagnoli for
+# its better burst-error detection, and the table-driven form below is fast
+# enough for the writer thread (frames are O(request) small).
+
+def _crc32c_table() -> Tuple[int, ...]:
+    poly = 0x82F63B78
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return tuple(table)
+
+
+_CRC_TABLE = _crc32c_table()
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+# -- frame codec --------------------------------------------------------------
+
+
+def encode_frame(record: dict) -> bytes:
+    payload = msgpack.packb(record)
+    return _FRAME_HEAD.pack(len(payload), crc32c(payload)) + payload
+
+
+def read_frames(path: str) -> Tuple[List[dict], str]:
+    """Decode every intact frame from ``path``; stops at the FIRST torn or
+    corrupt frame (WAL discipline: framing after corruption cannot be
+    trusted).  Returns (records, status)."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        return [], STATUS_MISSING
+    except OSError as e:  # unreadable volume: recover what we can't — nothing
+        log.warning("journal read failed for %s: %s", path, e)
+        return [], STATUS_CORRUPT
+    if not data:
+        return [], STATUS_EMPTY
+    if not data.startswith(MAGIC):
+        return [], STATUS_CORRUPT
+    records: List[dict] = []
+    off = len(MAGIC)
+    n = len(data)
+    while off < n:
+        if off + _FRAME_HEAD.size > n:
+            return records, STATUS_TORN
+        length, crc = _FRAME_HEAD.unpack_from(data, off)
+        if length > MAX_FRAME_BYTES:
+            return records, STATUS_CORRUPT
+        start = off + _FRAME_HEAD.size
+        end = start + length
+        if end > n:
+            return records, STATUS_TORN
+        payload = data[start:end]
+        if crc32c(payload) != crc:
+            return records, STATUS_CORRUPT
+        try:
+            rec = msgpack.unpackb(payload)
+        except Exception:  # noqa: BLE001 - a framed-but-unparseable payload
+            return records, STATUS_CORRUPT
+        if isinstance(rec, dict):
+            records.append(rec)
+        off = end
+    return records, STATUS_OK
+
+
+# -- chain assembly -----------------------------------------------------------
+
+
+class ChainMirror:
+    """Per-tenant live-chain state, driven one record at a time.  The SAME
+    implementation serves recovery (assembling chains from the frame stream)
+    and the writer (tracking what the next checkpoint must retain), so the
+    two can never disagree about which records are live."""
+
+    def __init__(self, max_chain: int = 64) -> None:
+        self.max_chain = max_chain
+        self.chains: Dict[str, List[dict]] = {}
+        self.broken: set = set()
+        self._last_seq: Dict[str, int] = {}
+
+    def apply(self, rec: dict) -> None:
+        tenant = rec.get("tenant")
+        if not isinstance(tenant, str) or not tenant:
+            return
+        seq = int(rec.get("seq", -1))
+        # duplicate suppression: a crash between checkpoint-rename and
+        # journal-truncate leaves the same frames in both files, and a stale
+        # journal older than the checkpoint replays already-compacted seqs
+        if seq <= self._last_seq.get(tenant, -1):
+            return
+        self._last_seq[tenant] = seq
+        kind = rec.get("t")
+        if kind == "drop":
+            self.chains.pop(tenant, None)
+            self.broken.discard(tenant)
+            return
+        if kind != "solve":
+            return
+        if rec.get("kind") == KIND_ANCHOR:
+            self.chains[tenant] = [rec]
+            self.broken.discard(tenant)
+            return
+        chain = self.chains.get(tenant)
+        if (
+            chain is None
+            or int(rec.get("tseq", -1)) != int(chain[-1].get("tseq", -1)) + 1
+            or int(rec.get("version", -1)) != int(chain[0].get("version", -2))
+        ):
+            # a delta with no anchor, a gap in the tenant sequence, or a
+            # version that moved without an anchor: the chain cannot be
+            # replayed faithfully — forget it (the tenant re-anchors)
+            self.chains.pop(tenant, None)
+            self.broken.add(tenant)
+            return
+        chain.append(rec)
+        if len(chain) > self.max_chain:
+            self.chains.pop(tenant, None)
+            self.broken.add(tenant)
+
+    def live_records(self) -> List[dict]:
+        """Every record a compaction must keep, in global seq order."""
+        out = [rec for chain in self.chains.values() for rec in chain]
+        out.sort(key=lambda r: int(r.get("seq", 0)))
+        return out
+
+    def max_seq(self) -> int:
+        return max(self._last_seq.values(), default=-1)
+
+
+def assemble_chains(
+    records: List[dict], max_chain: int = 64
+) -> Tuple[Dict[str, List[dict]], set]:
+    """(tenant -> live chain, broken tenants) from a decoded frame stream."""
+    mirror = ChainMirror(max_chain)
+    for rec in records:
+        mirror.apply(rec)
+    return mirror.chains, mirror.broken
+
+
+# -- the journal --------------------------------------------------------------
+
+
+class SessionJournal:
+    """Append-only, crc32c-framed, fsync-disciplined session journal with
+    periodic compacted checkpoints (module docstring).  Appends are enqueued
+    (never blocking the RPC path) and written by one background thread;
+    ``recover()`` is called before ``start()`` so the restart sequence is
+    read → replay → compact → resume appending."""
+
+    def __init__(
+        self,
+        directory: str,
+        clock: Optional[Clock] = None,
+        checkpoint_every: int = 64,
+        fsync: bool = True,
+        max_chain: int = 64,
+        queue_depth: int = 1024,
+        queue_bytes: int = 64 * 1024 * 1024,
+    ) -> None:
+        self.directory = directory
+        self.journal_path = os.path.join(directory, "journal.wal")
+        self.checkpoint_path = os.path.join(directory, "checkpoint.wal")
+        self.clock = clock or Clock()
+        self.checkpoint_every = max(int(checkpoint_every), 0)
+        self.fsync = fsync
+        self.max_chain = max_chain
+        os.makedirs(directory, exist_ok=True)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_depth)
+        # records hold raw request bytes (up to KC_TENANT_MAX_BYTES each), so
+        # the queue must be bounded by BYTES as well as count — a slow disk
+        # under large-snapshot tenants would otherwise buffer gigabytes
+        # before the count bound ever fired
+        self.queue_bytes = queue_bytes
+        self._queued_bytes = 0
+        self._seq_lock = threading.Lock()
+        self._seq = 0
+        self._mirror = ChainMirror(max_chain)
+        self._appends_since_ckpt = 0
+        self._fd = None
+        self._failed = False
+        self._closed = False
+        self._writer: Optional[threading.Thread] = None
+
+    # -- restart-time read side ------------------------------------------------
+
+    def recover(self) -> Tuple[Dict[str, List[dict]], set, Dict[str, str]]:
+        """Read checkpoint + journal tail and assemble the live per-tenant
+        chains.  Returns (chains, broken tenants, read statuses).  Also
+        seeds the writer's mirror and seq counter so post-recovery appends
+        and compactions continue the same history."""
+        ck_records, ck_status = read_frames(self.checkpoint_path)
+        j_records, j_status = read_frames(self.journal_path)
+        mirror = ChainMirror(self.max_chain)
+        for rec in ck_records + j_records:
+            mirror.apply(rec)
+        self._mirror = mirror
+        with self._seq_lock:
+            self._seq = mirror.max_seq() + 1
+        stats = {"checkpoint": ck_status, "journal": j_status,
+                 "frames": str(len(ck_records) + len(j_records))}
+        if ck_status not in (STATUS_OK, STATUS_MISSING, STATUS_EMPTY) or \
+                j_status not in (STATUS_OK, STATUS_MISSING, STATUS_EMPTY):
+            log.warning(
+                "session journal read degraded (checkpoint=%s journal=%s): "
+                "replaying the valid durable prefix only", ck_status, j_status,
+            )
+        return dict(mirror.chains), set(mirror.broken), stats
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        """Compact the recovered state and begin accepting appends."""
+        try:
+            self._compact_locked()
+        except OSError as e:
+            self._fail("checkpoint", f"startup compaction failed: {e}")
+            return
+        self._writer = threading.Thread(
+            target=self._run, name="kc-session-journal", daemon=True
+        )
+        self._writer.start()
+        JOURNAL_ACTIVE.labels().set(1.0)
+
+    def active(self) -> bool:
+        """Accepting appends.  True between construction and close/failure —
+        including BEFORE start(): recovery enqueues drop records for chains
+        that failed verification, and the writer drains them the moment it
+        comes up."""
+        return not self._failed and not self._closed
+
+    def close(self, checkpoint: bool = True, timeout_s: float = 10.0) -> None:
+        """Graceful shutdown: drain the queue, optionally write a final
+        compacted checkpoint (the drain path), release the file."""
+        if self._closed:
+            return
+        self._closed = True
+        JOURNAL_ACTIVE.labels().set(0.0)
+        if self._writer is None:
+            self._close_fd()
+            return
+        if checkpoint:
+            done = threading.Event()
+            self._put(("ckpt", done))
+        self._put(("stop", None))
+        self._writer.join(timeout=timeout_s)
+        # the writer closes the fd on ITS way out; a timed-out join means it
+        # may still be mid-write — leaking the fd briefly beats racing it
+
+    def abandon(self) -> None:
+        """SIGKILL semantics for tests/soak: stop WITHOUT draining — queued
+        (not-yet-durable) records are dropped, no final checkpoint, the file
+        is released exactly as a dead process would leave it."""
+        if self._closed:
+            return
+        self._closed = True
+        self._failed = True  # writer discards whatever it still dequeues
+        JOURNAL_ACTIVE.labels().set(0.0)
+        if self._writer is None:
+            self._close_fd()
+            return
+        self._put(("stop", None))
+        self._writer.join(timeout=2.0)
+
+    def checkpoint_now(self, timeout_s: float = 10.0) -> bool:
+        """Force a compaction (the drain path calls this via close); returns
+        False when the writer could not confirm in time."""
+        if not self.active():
+            return False
+        done = threading.Event()
+        self._put(("ckpt", done))
+        return done.wait(timeout_s)
+
+    # -- append side (RPC hot path: enqueue only) ------------------------------
+
+    def append_solve(
+        self,
+        tenant: str,
+        kind: str,
+        tseq: int,
+        version: int,
+        client_supply: Optional[str],
+        state: Dict[str, object],
+        request: bytes,
+    ) -> None:
+        """Record one completed tenant solve.  Called with the tenant entry
+        lock held — everything here is dict construction plus a non-blocking
+        enqueue; framing, I/O, and fsync happen on the writer thread."""
+        if not self.active():
+            return
+        rec = {
+            "t": "solve",
+            "tenant": tenant,
+            "kind": kind,
+            "tseq": int(tseq),
+            "version": int(version),
+            "client_supply": client_supply,
+            "state": dict(state),
+            "request": bytes(request),
+            "ts": self.clock.now(),
+        }
+        self._enqueue(rec, kind)
+
+    def append_drop(self, tenant: str) -> None:
+        """The tenant's session left the plane (LRU/TTL eviction, failed
+        recovery): recovery must not resurrect the lineage."""
+        if not self.active():
+            return
+        self._enqueue({"t": "drop", "tenant": tenant, "ts": self.clock.now()}, "drop")
+
+    def _enqueue(self, rec: dict, kind: str) -> None:
+        cost = len(rec.get("request") or b"") + 256
+        with self._seq_lock:
+            if self._queued_bytes + cost > self.queue_bytes:
+                # never block an RPC on the journal (and never buffer
+                # unbounded bytes); a dropped append only costs warmth at
+                # the next recovery (the chain breaks at the gap)
+                JOURNAL_FAILURES.labels("queue").inc()
+                return
+            rec["seq"] = self._seq
+            self._seq += 1
+            self._queued_bytes += cost
+        try:
+            self._queue.put_nowait(("rec", rec, cost))
+            JOURNAL_RECORDS.labels(kind).inc()
+        except queue.Full:
+            with self._seq_lock:
+                self._queued_bytes -= cost
+            JOURNAL_FAILURES.labels("queue").inc()
+
+    def _put(self, item) -> None:
+        try:
+            self._queue.put(item, timeout=1.0)
+        except queue.Full:
+            JOURNAL_FAILURES.labels("queue").inc()
+
+    # -- writer thread ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            op, payload = item[0], item[1]
+            if op == "stop":
+                break
+            if op == "ckpt":
+                if not self._failed:
+                    try:
+                        self._compact_locked()
+                    except (OSError, ValueError) as e:
+                        self._fail("checkpoint", str(e))
+                if payload is not None:
+                    payload.set()
+                continue
+            with self._seq_lock:
+                self._queued_bytes -= item[2]
+            if not self._failed:
+                self._write_record(payload)
+        # the writer owns the fd while it runs: only IT closes on exit, so a
+        # close()/abandon() join timeout can never yank the file out from
+        # under a write in flight
+        self._close_fd()
+
+    def _ensure_fd(self):
+        if self._fd is None:
+            self._fd = open(self.journal_path, "ab")
+            if self._fd.tell() == 0:
+                self._fd.write(MAGIC)
+                self._fd.flush()
+                if self.fsync:
+                    os.fsync(self._fd.fileno())
+        return self._fd
+
+    def _write_record(self, rec: dict) -> None:
+        frame = encode_frame(rec)
+        fault = STORE_IO.hit(
+            kinds=("error", "partial"), op="append", tenant=rec.get("tenant", "")
+        )
+        try:
+            fd = self._ensure_fd()
+            if fault is not None:
+                if fault.kind == "partial":
+                    # torn write: a prefix lands (what a kill -9 mid-append
+                    # leaves on disk), then the journal dies
+                    fd.write(frame[: max(len(frame) // 2, 1)])
+                    fd.flush()
+                    raise OSError(fault.describe())
+                if (fault.data or {}).get("op") == "fsync":
+                    # the write lands, durability doesn't
+                    fd.write(frame)
+                    fd.flush()
+                    raise OSError(fault.describe())
+                errno_ = int((fault.data or {}).get("errno", 0))
+                raise OSError(errno_, fault.describe())
+            fd.write(frame)
+            fd.flush()
+            if self.fsync:
+                os.fsync(fd.fileno())
+        except (OSError, ValueError) as e:
+            # ValueError = operation on a closed file (a teardown race):
+            # same verdict as a disk error — fail closed, keep serving
+            self._fail("append", str(e))
+            return
+        self._mirror.apply(rec)
+        self._appends_since_ckpt += 1
+        if self.checkpoint_every and self._appends_since_ckpt >= self.checkpoint_every:
+            fault = STORE_IO.hit(kinds=("error",), op="checkpoint")
+            if fault is not None:
+                # stale checkpoint: compaction skipped, the old checkpoint
+                # stays on disk and the journal keeps growing — recovery is
+                # still exact (seq dedup), only compaction is deferred
+                JOURNAL_FAILURES.labels("checkpoint").inc()
+                self._appends_since_ckpt = 0
+                return
+            try:
+                self._compact_locked()
+            except OSError as e:
+                self._fail("checkpoint", str(e))
+
+    def _compact_locked(self) -> None:
+        """Rewrite the live chains as the checkpoint (tmp + fsync + atomic
+        rename + directory fsync), then truncate the journal.  Runs on the
+        writer thread (or synchronously from start(), before the writer
+        exists)."""
+        with tracing.span("journal.checkpoint",
+                          tenants=len(self._mirror.chains)):
+            tmp = f"{self.checkpoint_path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(MAGIC)
+                for rec in self._mirror.live_records():
+                    f.write(encode_frame(rec))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.checkpoint_path)
+            self._fsync_dir()
+            # rotate the journal: everything live is in the checkpoint now
+            self._close_fd()
+            with open(self.journal_path, "wb") as f:
+                f.write(MAGIC)
+                f.flush()
+                os.fsync(f.fileno())
+            self._fsync_dir()
+            self._appends_since_ckpt = 0
+
+    def _fsync_dir(self) -> None:
+        try:
+            dfd = os.open(self.directory, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(dfd)
+        except OSError:
+            pass
+        finally:
+            os.close(dfd)
+
+    def _close_fd(self) -> None:
+        if self._fd is not None:
+            try:
+                self._fd.close()
+            except OSError:
+                pass
+            self._fd = None
+
+    def _fail(self, op: str, reason: str) -> None:
+        """Fail CLOSED: journaling stops, serving continues.  A disk that
+        can't take writes must never take the solver down with it — the cost
+        is bounded (colder recovery), the alternative is an outage."""
+        self._failed = True
+        JOURNAL_FAILURES.labels(op).inc()
+        JOURNAL_ACTIVE.labels().set(0.0)
+        tracing.add_event("journal.failed", op=op, reason=reason)
+        log.warning(
+            "session journal failed closed (%s: %s) — serving continues "
+            "without durability; sessions re-anchor at the next restart",
+            op, reason,
+        )
+        self._close_fd()

@@ -54,6 +54,7 @@ import msgpack
 from karpenter_core_tpu import chaos, tracing
 from karpenter_core_tpu.apis import codec
 from karpenter_core_tpu.models.snapshot import KernelUnsupported
+from karpenter_core_tpu.service import journal as journal_mod
 from karpenter_core_tpu.service import tenant as tenant_mod
 from karpenter_core_tpu.solver.tpu import TPUSolver
 from karpenter_core_tpu.state.cluster import StateNode
@@ -130,12 +131,39 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
     server-assigned resourceVersion; wall-clock staleness is judged by the
     electors, not here."""
 
-    def __init__(self, cloud_provider, clock=None, tenant_config=None) -> None:
+    def __init__(self, cloud_provider, clock=None, tenant_config=None,
+                 journal_dir=None) -> None:
         self.cloud_provider = cloud_provider
         # the multi-tenant plane: admission + sessions + breakers + coalescer
         # (service/tenant.py).  ``clock`` drives every timing policy so
         # FakeClock suites can step TTLs and breaker windows.
         self.tenants = tenant_mod.TenantPlane(clock=clock, config=tenant_config)
+        # durable sessions (service/journal.py, docs/SERVICE.md): when a
+        # journal directory is configured, every completed tenant solve is
+        # journaled and a restart replays the per-tenant chains back into
+        # WARM lineages before the server takes traffic.  KC_SESSION_JOURNAL
+        # enables it env-side; an explicit journal_dir argument always wins.
+        self.journal = None
+        if journal_dir is None and os.environ.get("KC_SESSION_JOURNAL", "0") == "1":
+            journal_dir = os.environ.get("KC_JOURNAL_DIR", "")
+            if not journal_dir:
+                from karpenter_core_tpu.utils import compilecache
+
+                journal_dir = os.path.join(
+                    compilecache.cache_dir(), "session-journal"
+                )
+        if journal_dir:
+            self.journal = journal_mod.SessionJournal(
+                journal_dir,
+                clock=self.tenants.clock,
+                checkpoint_every=tenant_mod._env_i(
+                    "KC_JOURNAL_CHECKPOINT_EVERY", 64
+                ),
+                fsync=os.environ.get("KC_JOURNAL_FSYNC", "1") != "0",
+            )
+            self._recover_sessions()
+            self.journal.start()
+            self.tenants.on_drop = self.journal.append_drop
         # server-side per-RPC deadline: an abandoned/slow client cannot pin a
         # worker past this (0 disables); checked at the solve stage
         # boundaries, the coarsest-grained units of handler work
@@ -176,6 +204,159 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             os.replace(tmp, self._lease_path)
         except Exception as e:  # noqa: BLE001 - durability is best-effort
             log.debug("lease state persist failed: %s", e)
+
+    # -- durable-session recovery (service/journal.py, docs/SERVICE.md) -------
+
+    def _recover_sessions(self) -> None:
+        """Replay the journal's per-tenant chains into warm server-side
+        lineages, with never-trust verification: a replayed lineage must
+        reproduce the journaled ``lineage_state`` exactly or the tenant is
+        downgraded to the existing ``session-lost`` re-anchor.  Runs before
+        the server accepts traffic."""
+        chains, broken, stats = self.journal.recover()
+        # outcome semantics: "corrupt" = the frame STREAM broke (torn tail /
+        # CRC failure), counted per damaged file; a structurally broken
+        # chain (tseq gap from a dropped append, version skew) is a
+        # "reanchor" — the disk is fine, the tenant just re-anchors
+        for status in (stats.get("checkpoint"), stats.get("journal")):
+            if status in (journal_mod.STATUS_TORN, journal_mod.STATUS_CORRUPT):
+                journal_mod.SESSION_RECOVERED.labels("corrupt").inc()
+        for _tenant in sorted(broken):
+            journal_mod.SESSION_RECOVERED.labels("reanchor").inc()
+        if not chains:
+            return
+        plane = self.tenants
+        # most-recent chains win the session cap (the LRU the crash erased)
+        ordered = sorted(
+            chains.items(), key=lambda kv: int(kv[1][-1].get("seq", 0))
+        )
+        if len(ordered) > plane.config.max_sessions:
+            ordered = ordered[-plane.config.max_sessions:]
+        warm = 0
+        plane._bypass_coalescer = True  # replay is solo: no rendezvous waits
+        try:
+            for tenant_id, chain in ordered:
+                entry = plane.restore_entry(tenant_id)
+                try:
+                    with tracing.span("session.recover", tenant=tenant_id,
+                                      records=len(chain)):
+                        for rec in chain:
+                            self._replay_record(entry, rec)
+                        state = entry.session.lineage_state()
+                        want = chain[-1].get("state") or {}
+                        if state != want:
+                            raise journal_mod.RecoveryMismatch(
+                                f"replayed lineage state diverged "
+                                f"(have version {state.get('version')}, "
+                                f"journal {want.get('version')})"
+                            )
+                except Exception as e:  # noqa: BLE001 - downgrade, never trust
+                    log.warning(
+                        "session recovery for tenant %s downgraded to "
+                        "re-anchor: %s", tenant_id, e,
+                    )
+                    plane.discard_entry(tenant_id)
+                    self.journal.append_drop(tenant_id)
+                    journal_mod.SESSION_RECOVERED.labels("reanchor").inc()
+                else:
+                    last = chain[-1]
+                    entry.supply_digest = last.get("client_supply")
+                    entry.journal_tseq = int(last.get("tseq", 0))
+                    entry.recovered = "warm"
+                    warm += 1
+                    journal_mod.SESSION_RECOVERED.labels("warm").inc()
+        finally:
+            plane._bypass_coalescer = False
+        log.info(
+            "session journal recovery: %d/%d lineage(s) warm, %d broken "
+            "chain(s) (checkpoint=%s journal=%s)",
+            warm, len(ordered), len(broken),
+            stats.get("checkpoint"), stats.get("journal"),
+        )
+
+    def _replay_record(self, entry, rec: dict) -> None:
+        """Re-run one journaled solve from its stored wire request.  Solves
+        are deterministic, so replaying the anchor + deltas reconstructs the
+        crashed process's lineage bit for bit; the store version is seeded so
+        the restored lineage answers to the exact version the client was
+        last told."""
+        from karpenter_core_tpu.policy import PolicyConfig
+        from karpenter_core_tpu.solver.incremental import MODE_FULL
+
+        req = msgpack.unpackb(rec["request"])
+        (classes, _uid_class, provisioners, daemonset_pods, state_nodes,
+         bound, resolver) = self._decode_tenant_classes(req)
+        solver = TPUSolver(
+            self.cloud_provider, provisioners, daemonset_pods,
+            kube_client=resolver,
+            policy=PolicyConfig.from_wire(req.get("policy")),
+        )
+        session = entry.session
+        session.rebind(solver)
+        if rec.get("kind") == journal_mod.KIND_ANCHOR:
+            session.reset()
+            session.store.seed_version(int(rec.get("version", 1)) - 1)
+        session.solve(classes, state_nodes or None, bound)
+        want_full = rec.get("kind") == journal_mod.KIND_ANCHOR
+        if (session.last_mode == MODE_FULL) != want_full:
+            raise journal_mod.RecoveryMismatch(
+                f"replayed {rec.get('kind')} record resolved as "
+                f"{session.last_mode}"
+            )
+
+    def _journal_solve(self, entry, tenant_id: str, mode: str,
+                       supply_digest, request: bytes) -> None:
+        """Append one completed tenant solve to the journal.  Called with the
+        entry lock held — the verification state must snapshot the lineage
+        the response was computed from; the actual I/O is enqueued."""
+        version = entry.session.lineage_version()
+        if self.journal is None or version <= 0:
+            return  # nothing warm to recover (carry-less solve)
+        if mode == "full":
+            entry.journal_tseq = 0
+            kind = journal_mod.KIND_ANCHOR
+        else:
+            entry.journal_tseq += 1
+            kind = journal_mod.KIND_DELTA
+        self.journal.append_solve(
+            tenant=tenant_id,
+            kind=kind,
+            tseq=entry.journal_tseq,
+            version=version,
+            client_supply=supply_digest,
+            state=entry.session.lineage_state(),
+            request=bytes(request),
+        )
+
+    # -- graceful drain (SIGTERM path, docs/SERVICE.md) ------------------------
+
+    def drain(self, timeout_s: Optional[float] = None,
+              retry_after_s: Optional[float] = None) -> bool:
+        """Stop admitting (sheds carry a retry-after hint), let in-flight
+        solves finish, then flush + checkpoint the journal.  Returns True
+        when the plane fully quiesced inside the timeout.  The caller stops
+        the gRPC server afterwards."""
+        if timeout_s is None:
+            timeout_s = tenant_mod._env_f("KC_SERVICE_DRAIN_S", 30.0)
+        if retry_after_s is None:
+            retry_after_s = tenant_mod._env_f("KC_DRAIN_RETRY_AFTER_S", 5.0)
+        self.tenants.start_draining(retry_after_s)
+        deadline = tenant_mod.monotonic() + max(timeout_s, 0.0)
+        import time as _time
+
+        while self.tenants.inflight() > 0 and tenant_mod.monotonic() < deadline:
+            _time.sleep(0.02)
+        drained = self.tenants.inflight() == 0
+        if self.journal is not None:
+            self.journal.close(checkpoint=True)
+        log.info("service drained (quiesced=%s)", drained)
+        return drained
+
+    def shutdown(self) -> None:
+        """Non-drain teardown (tests, soak): release the journal cleanly
+        without forcing a final checkpoint."""
+        if self.journal is not None:
+            self.journal.close(checkpoint=False)
 
     # -- grpc plumbing --------------------------------------------------------
 
@@ -462,7 +643,7 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"malformed request: {e}")
         try:
             if isinstance(req, dict) and req.get("tenant"):
-                response = self._solve_classes_tenant(req, context, len(request), t0)
+                response = self._solve_classes_tenant(req, context, request, t0)
             else:
                 response = self._solve_classes_stateless(req, context, t0)
         except _AbortRequest as a:
@@ -568,16 +749,25 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
         )
         return classes, uid_class, provisioners, daemonset_pods, state_nodes, bound, resolver
 
-    def _solve_classes_tenant(self, req, context, nbytes: int, t0: float) -> bytes:
+    def _solve_classes_tenant(self, req, context, request: bytes, t0: float) -> bytes:
         from karpenter_core_tpu.policy import PolicyConfig
 
+        nbytes = len(request)
         envelope = req.get("tenant") or {}
         tid = str(envelope.get("id") or "")
         if not tid:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "tenant.id required")
         plane = self.tenants
-        decision = plane.admit(tid)
+        decision = plane.admit(tid, weight=envelope.get("weight"))
         if not decision.admitted:
+            if decision.reason == "draining":
+                # graceful drain: the server is going away — an explicit
+                # UNAVAILABLE with the hint, so clients re-dial elsewhere
+                context.abort(
+                    grpc.StatusCode.UNAVAILABLE,
+                    "tenant-draining "
+                    f"{tenant_mod.RETRY_AFTER_PREFIX}{decision.retry_after_s:.3f}",
+                )
             if decision.reason == "isolated":
                 context.abort(
                     grpc.StatusCode.UNAVAILABLE,
@@ -641,7 +831,11 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 ):
                     # versions agree but the client's view of its supply
                     # moved in a way our decode may not capture: trust the
-                    # digest, re-anchor
+                    # digest, re-anchor.  force_full OVERWRITES any leftover
+                    # forced reason — a journal-recovered session whose
+                    # earlier owed re-anchor never ran must report
+                    # ``supply-digest`` here, not echo a stale
+                    # ``session-lost`` into the mode counter and span
                     entry.session.force_full("supply-digest")
                 entry.session.rebind(solver)
                 # last_batched is written by the coalescer hook, which only
@@ -679,6 +873,15 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 mode, reason = entry.session.last_mode, entry.session.last_reason
                 version = entry.session.lineage_version()
                 batched = entry.last_batched
+                # one-shot recovery echo: the first response after a warm
+                # journal restore tells the client its lineage survived.
+                # Captured here, CONSUMED only when the response actually
+                # returns — a deadline/disconnect abort past this point must
+                # not eat the marker (the client never saw it)
+                recovered = entry.recovered
+                # durable sessions: journal the completed solve (enqueue
+                # only; framing/fsync ride the writer thread off this path)
+                self._journal_solve(entry, tid, mode, supply_digest, request)
             self._deadline_guard(context, t0)
 
             t_decode = tenant_mod.monotonic()
@@ -698,6 +901,9 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 "sessionVersion": version,
                 "batched": batched,
             }
+            if recovered:
+                response["tenant"]["recovered"] = recovered
+                entry.recovered = None
             verdict = True
             plane.record_ok(entry)
             plane.observe_latencies(
@@ -787,6 +993,32 @@ def service_capacity(max_workers: Optional[int] = None) -> tuple:
     return workers, workers + queue
 
 
+def install_drain_handler(server, service, grace_s: float = 1.0) -> bool:
+    """SIGTERM → graceful drain (stop admitting with retry-after hints,
+    finish in-flight solves, flush + checkpoint the journal) → server stop.
+    Main-thread only (signal module restriction); returns False when the
+    handler could not be installed."""
+    import signal
+
+    if threading.current_thread() is not threading.main_thread():
+        return False
+
+    def _drain_and_stop() -> None:
+        service.drain()
+        server.stop(grace=grace_s)
+
+    def _on_term(signum, frame) -> None:
+        threading.Thread(
+            target=_drain_and_stop, name="kc-service-drain", daemon=True
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        return False
+    return True
+
+
 def serve(
     cloud_provider,
     address: str = "127.0.0.1:0",
@@ -794,6 +1026,8 @@ def serve(
     clock=None,
     tenant_config=None,
     metrics_port: Optional[int] = None,
+    journal_dir: Optional[str] = None,
+    drain_on_sigterm: bool = False,
 ):
     """Start the sidecar; returns (server, bound_port).
 
@@ -803,7 +1037,13 @@ def serve(
     multi-tenant plane (service/tenant.py).  ``metrics_port`` (0 = ephemeral)
     additionally serves the process /metrics — the per-tenant latency
     histograms and shed/eject/evict counters — over HTTP; the started
-    OperatorHTTP rides ``server.kc_http``."""
+    OperatorHTTP rides ``server.kc_http``.
+
+    ``journal_dir`` (or KC_SESSION_JOURNAL=1 + KC_JOURNAL_DIR) enables the
+    durable-session journal: recovery replay runs HERE, before the port
+    binds, so the first request a client lands already sees warm lineages.
+    ``drain_on_sigterm`` installs the graceful-drain SIGTERM handler
+    (main-thread processes only)."""
     from karpenter_core_tpu.utils import compilecache
 
     compilecache.enable()  # sidecar restarts reuse compiled solve kernels
@@ -813,7 +1053,8 @@ def serve(
         maximum_concurrent_rpcs=max_rpcs,
     )
     service = SnapshotSolverService(
-        cloud_provider, clock=clock, tenant_config=tenant_config
+        cloud_provider, clock=clock, tenant_config=tenant_config,
+        journal_dir=journal_dir,
     )
     server.add_generic_rpc_handlers((service,))
     port = server.add_insecure_port(address)
@@ -821,6 +1062,8 @@ def serve(
     # the service (and its tenant plane) stays reachable for operators/tests
     server.kc_service = service
     server.kc_http = None
+    if drain_on_sigterm:
+        install_drain_handler(server, service)
     if metrics_port is not None:
         from karpenter_core_tpu.operator.httpserver import OperatorHTTP
 

@@ -137,6 +137,28 @@ def _env_i(name: str, default: int) -> int:
         return default
 
 
+# weighted fair-share bounds: a weight outside this band is someone fat-
+# fingering an env var or a client inflating itself — clamp, don't trust
+WEIGHT_MIN = 0.01
+WEIGHT_MAX = 100.0
+
+
+def parse_weights(spec: str) -> Dict[str, float]:
+    """KC_TENANT_WEIGHTS: ``tenant-a=2.0,tenant-b=0.5`` — unparseable parts
+    are skipped (a typo must not take admission down)."""
+    out: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        key, _, value = part.partition("=")
+        try:
+            out[key.strip()] = min(max(float(value), WEIGHT_MIN), WEIGHT_MAX)
+        except ValueError:
+            continue
+    return out
+
+
 @dataclass
 class TenantConfig:
     """Knobs for the tenant plane; all env-overridable (docs/SERVICE.md)."""
@@ -146,6 +168,11 @@ class TenantConfig:
     rate_per_s: float = 10.0
     burst: int = 20
     max_inflight: int = 16
+    # weighted fair share: per-tenant multipliers on rate AND burst
+    # (KC_TENANT_WEIGHTS; the wire envelope's ``weight`` field covers tenants
+    # the operator hasn't pinned — env wins, because the serving side owns
+    # fairness policy, not the client claiming its own priority)
+    weights: Dict[str, float] = field(default_factory=dict)
     # sessions: LRU capacity + idle TTL
     max_sessions: int = 256
     session_ttl_s: float = 900.0
@@ -173,7 +200,27 @@ class TenantConfig:
             max_request_bytes=max(
                 _env_i("KC_TENANT_MAX_BYTES", 32 * 1024 * 1024), 1024
             ),
+            weights=parse_weights(os.environ.get("KC_TENANT_WEIGHTS", "")),
         )
+
+    def resolve_weight(self, tenant_id: str, wire_weight=None) -> float:
+        """The tenant's fair-share weight: operator env pin wins, then the
+        wire envelope's claim, then 1.0 — always clamped."""
+        weight = self.weights.get(tenant_id)
+        if weight is None:
+            try:
+                weight = float(wire_weight) if wire_weight is not None else 1.0
+            except (TypeError, ValueError):
+                weight = 1.0
+        return min(max(weight, WEIGHT_MIN), WEIGHT_MAX)
+
+    def bucket_shape(self, weight: float) -> Tuple[int, float]:
+        """(budget, window_s) for a weighted tenant bucket: burst scales with
+        the weight, and the window is derived from the SCALED budget so the
+        refill rate is exactly ``rate_per_s * weight`` even after the burst
+        rounds to an int — shed hints stay exact."""
+        budget = max(int(round(self.burst * weight)), 1)
+        return budget, budget / (self.rate_per_s * weight)
 
 
 @dataclass
@@ -385,6 +432,14 @@ class TenantEntry:
     last_seen: float = 0.0
     supply_digest: Optional[str] = None
     last_batched: int = 1
+    # weighted fair share: the resolved weight this entry's bucket was shaped
+    # for (a change reshapes the bucket in place)
+    weight: float = 1.0
+    # durable sessions (service/journal.py): per-tenant record sequence (0 at
+    # each anchor, +1 per delta) and the one-shot recovery echo ("warm")
+    # surfaced on the first post-recovery response
+    journal_tseq: int = 0
+    recovered: Optional[str] = None
 
 
 class TenantPlane:
@@ -403,16 +458,27 @@ class TenantPlane:
         self._entries: "OrderedDict[str, TenantEntry]" = OrderedDict()
         self._inflight = 0
         self._last_sweep = self.clock.now()
+        # graceful drain (docs/SERVICE.md): set, every admission sheds with a
+        # retry-after hint while in-flight solves finish
+        self._draining = False
+        self._drain_hint_s = 5.0
+        # session-drop hook: the durable-session journal records evictions so
+        # recovery never resurrects a dropped lineage
+        self.on_drop: Optional[Callable[[str], None]] = None
+        # recovery replay runs solves through _dispatch before the server
+        # accepts traffic — solo, no rendezvous window to wait out
+        self._bypass_coalescer = False
 
     # -- session lifecycle -----------------------------------------------------
 
-    def _new_entry(self, tenant_id: str) -> TenantEntry:
+    def _new_entry(self, tenant_id: str, weight: float = 1.0) -> TenantEntry:
         from karpenter_core_tpu.solver.incremental import (
             FallbackPolicy,
             IncrementalSolveSession,
         )
 
         cfg = self.config
+        budget, window_s = cfg.bucket_shape(weight)
         entry = TenantEntry(
             tenant_id=tenant_id,
             session=None,
@@ -423,12 +489,13 @@ class TenantPlane:
                 name=f"tenant:{tenant_id}",
             ),
             bucket=retry.RetryBudget(
-                self.clock, budget=cfg.burst,
-                window_s=cfg.burst / cfg.rate_per_s,
+                self.clock, budget=budget,
+                window_s=window_s,
                 name=f"tenant:{tenant_id}",
             ),
             shed_backoff=retry.Backoff(0.25, 30.0),
             last_seen=self.clock.now(),
+            weight=weight,
         )
         session = IncrementalSolveSession(
             policy=FallbackPolicy.from_env(),
@@ -442,7 +509,7 @@ class TenantPlane:
         coalescing candidates; anything parameterized (slot-exhaustion
         retries) dispatches solo."""
         solver = entry.session.solver
-        if kw:
+        if kw or self._bypass_coalescer:
             return solver.run_prepared(prep, **kw)
         outputs, batched = self.coalescer.run(
             prep, lambda: solver.run_prepared(prep)
@@ -450,24 +517,57 @@ class TenantPlane:
         entry.last_batched = batched
         return outputs
 
-    def checkout(self, tenant_id: str) -> TenantEntry:
+    def checkout(self, tenant_id: str, weight: Optional[float] = None) -> TenantEntry:
         """The tenant's entry (created on first sight), LRU-touched; expired
-        and over-capacity sessions are evicted on the way."""
+        and over-capacity sessions are evicted on the way.  ``weight`` is the
+        resolved fair-share weight (None = default); a change reshapes the
+        entry's bucket in place, carrying the current fill proportionally."""
         now = self.clock.now()
         with self._lock:
             self._sweep_locked(now)
             entry = self._entries.get(tenant_id)
             if entry is None:
-                entry = self._new_entry(tenant_id)
+                entry = self._new_entry(
+                    tenant_id, weight if weight is not None else 1.0
+                )
                 self._entries[tenant_id] = entry
                 while len(self._entries) > self.config.max_sessions:
                     evicted_id, evicted = self._entries.popitem(last=False)
                     self._drop_entry(evicted, "lru")
             else:
                 self._entries.move_to_end(tenant_id)
+                if weight is not None and abs(weight - entry.weight) > 1e-9:
+                    budget, window_s = self.config.bucket_shape(weight)
+                    entry.bucket.reconfigure(budget, window_s)
+                    entry.weight = weight
             entry.last_seen = now
             TENANT_SESSIONS_LIVE.labels().set(float(len(self._entries)))
             return entry
+
+    def restore_entry(self, tenant_id: str) -> TenantEntry:
+        """A fresh entry for journal recovery (service/journal.py): no
+        admission, no LRU touch beyond registration — the restored lineage is
+        attached by the caller after replay verifies."""
+        with self._lock:
+            entry = self._new_entry(
+                tenant_id, self.config.resolve_weight(tenant_id)
+            )
+            self._entries[tenant_id] = entry
+            while len(self._entries) > self.config.max_sessions:
+                _evicted_id, evicted = self._entries.popitem(last=False)
+                self._drop_entry(evicted, "lru")
+            TENANT_SESSIONS_LIVE.labels().set(float(len(self._entries)))
+            return entry
+
+    def discard_entry(self, tenant_id: str) -> None:
+        """Remove a tenant whose recovery replay failed verification — the
+        next request re-anchors ``session-lost`` exactly as if nothing had
+        been journaled."""
+        with self._lock:
+            entry = self._entries.pop(tenant_id, None)
+            if entry is not None:
+                retry.BREAKER_STATE.delete_labels(f"tenant:{tenant_id}")
+            TENANT_SESSIONS_LIVE.labels().set(float(len(self._entries)))
 
     def _sweep_locked(self, now: float) -> None:
         ttl = self.config.session_ttl_s
@@ -486,11 +586,15 @@ class TenantPlane:
         for tid in expired:
             self._drop_entry(self._entries.pop(tid), "ttl")
 
-    @staticmethod
-    def _drop_entry(entry: TenantEntry, reason: str) -> None:
+    def _drop_entry(self, entry: TenantEntry, reason: str) -> None:
         TENANT_SESSIONS_EVICTED.labels(reason).inc()
         # the breaker gauge would otherwise report a dead tenant forever
         retry.BREAKER_STATE.delete_labels(f"tenant:{entry.tenant_id}")
+        # journal the drop (enqueue-only; no lock ordering hazard: the
+        # journal never takes plane locks) so recovery cannot resurrect an
+        # evicted lineage
+        if self.on_drop is not None:
+            self.on_drop(entry.tenant_id)
 
     def sessions(self) -> List[str]:
         with self._lock:
@@ -498,13 +602,27 @@ class TenantPlane:
 
     # -- admission -------------------------------------------------------------
 
-    def admit(self, tenant_id: str) -> AdmissionDecision:
+    def start_draining(self, retry_after_s: float = 5.0) -> None:
+        """Graceful drain: every subsequent admission sheds with this
+        retry-after hint; in-flight solves finish normally."""
+        self._drain_hint_s = max(retry_after_s, 0.1)
+        self._draining = True
+
+    def admit(self, tenant_id: str, weight=None) -> AdmissionDecision:
         """Admission gate; an admitted request MUST be paired with
-        ``release()``.  Order: isolation (breaker) → global in-flight bound
-        → per-tenant rate.  The queue check runs BEFORE the token bucket so
-        global pressure caused by OTHER tenants never burns this tenant's
-        own tokens (a queue-shed retry must not escalate into a rate shed)."""
-        entry = self.checkout(tenant_id)
+        ``release()``.  Order: draining → isolation (breaker) → global
+        in-flight bound → per-tenant rate.  The queue check runs BEFORE the
+        token bucket so global pressure caused by OTHER tenants never burns
+        this tenant's own tokens (a queue-shed retry must not escalate into
+        a rate shed).  ``weight`` is the wire envelope's fair-share claim
+        (config.resolve_weight decides; an operator env pin wins)."""
+        if self._draining:
+            # no checkout: a draining server must not mint fresh sessions
+            TENANT_SHED.labels(tenant_id, "draining").inc()
+            return AdmissionDecision(False, "draining", self._drain_hint_s)
+        entry = self.checkout(
+            tenant_id, weight=self.config.resolve_weight(tenant_id, weight)
+        )
         if not entry.breaker.allow():
             hint = max(entry.breaker.reset_timeout_s, 1.0)
             TENANT_SHED.labels(tenant_id, "isolated").inc()

@@ -216,6 +216,29 @@ class IncrementalSolveSession:
             return 0
         return int(self._warm.versioned.version)
 
+    def lineage_state(self) -> Dict[str, object]:
+        """Cross-process-stable verification summary of the warm lineage —
+        what the durable-session journal (service/journal.py) writes with
+        every record and what recovery compares a REPLAYED lineage against
+        before trusting it (never-trust: any field differing downgrades the
+        tenant to the ``session-lost`` re-anchor).  Everything here is a
+        plain msgpack-able scalar/str/dict: the store's per-plane content
+        digests and supply anchor are sha256 hex (PYTHONHASHSEED-free by
+        construction), and the placement signature canonicalizes its class
+        keys through models.store.stable_digest because they hold frozensets
+        whose raw repr order is hash-randomized."""
+        w = self._warm
+        if w is None:
+            return {"version": 0}
+        return {
+            "version": int(w.versioned.version),
+            "supply": w.supply,
+            "planes": dict(w.versioned.digests),
+            "aggregates": self.aggregates(),
+            "signature": store_mod.stable_digest(self.node_signature()),
+            "delta_ticks": int(w.delta_ticks),
+        }
+
     # -- membership extraction -------------------------------------------------
 
     @staticmethod

@@ -174,6 +174,20 @@ class RetryBudget:
             self._refill()
             return self._tokens
 
+    def reconfigure(self, budget: int, window_s: float) -> None:
+        """Reshape the bucket in place — the weighted fair-share admission
+        path (service/tenant.py) rescales a tenant's rate/burst when its
+        weight changes.  The current fill carries over PROPORTIONALLY: a
+        weight bump neither grants a free full burst nor confiscates earned
+        headroom, and ``next_token_s()`` hints stay exact because they read
+        the same (budget, refill) fields."""
+        with self._lock:
+            self._refill()
+            frac = self._tokens / self.budget if self.budget > 0 else 1.0
+            self.budget = float(budget)
+            self.refill_per_s = budget / window_s if window_s > 0 else float("inf")
+            self._tokens = min(self.budget, frac * self.budget)
+
     def next_token_s(self) -> float:
         """Seconds until ``allow()`` would next succeed (0.0 = it would now).
         The retry-after hint a shed/admission-control response carries so a

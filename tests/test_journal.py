@@ -1,0 +1,615 @@
+"""Durable solver sessions (ISSUE-13, service/journal.py, docs/SERVICE.md):
+crash-consistent journal framing, chain assembly, warm restart recovery with
+never-trust verification, graceful drain, and the ``store.io`` chaos point.
+
+The recovery-matrix contract under test: kill -9 mid-append, truncated tail
+frames, CRC-corrupted frames, a checkpoint newer than the journal, and an
+empty journal each yield warm-or-reanchor — NEVER a wrong or stale answer.
+"""
+
+import os
+
+import grpc
+import numpy as np
+import pytest
+
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_core_tpu.service import journal as journal_mod
+from karpenter_core_tpu.service.journal import (
+    MAGIC,
+    SessionJournal,
+    assemble_chains,
+    crc32c,
+    encode_frame,
+    read_frames,
+)
+from karpenter_core_tpu.service.snapshot_channel import (
+    SnapshotSolverClient,
+    serve,
+)
+from karpenter_core_tpu.service.tenant import TenantConfig, parse_retry_after
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+from karpenter_core_tpu.utils.clock import FakeClock
+
+
+def _loose_config(**kw) -> TenantConfig:
+    base = dict(
+        rate_per_s=1000.0, burst=1000, max_inflight=64,
+        batch_window_s=0.0, max_batch=8,
+        breaker_threshold=3, breaker_reset_s=30.0,
+    )
+    base.update(kw)
+    return TenantConfig(**base)
+
+
+def _solve(client, tenant_id, count=4, version=0, cpu="500m", supply=None):
+    tenant = {"id": tenant_id, "sessionVersion": version}
+    if supply is not None:
+        tenant["supplyDigest"] = supply
+    return client.solve_tenant_classes(
+        [(make_pod(requests={"cpu": cpu}), count)], [make_provisioner()],
+        tenant=tenant,
+    )
+
+
+def _counter_value(counter, **labels) -> float:
+    total = 0.0
+    for _name, sample_labels, value in counter.samples():
+        if all(sample_labels.get(k) == v for k, v in labels.items()):
+            total += value
+    return total
+
+
+def _solve_rec(tenant, seq, tseq, kind="delta", version=1, request=b"req"):
+    return {
+        "t": "solve", "tenant": tenant, "seq": seq, "tseq": tseq,
+        "kind": kind, "version": version, "client_supply": None,
+        "state": {"version": version}, "request": request, "ts": 0.0,
+    }
+
+
+# -- framing ------------------------------------------------------------------
+
+
+class TestFraming:
+    def test_crc32c_known_vector(self):
+        # the RFC 3720 check value for "123456789"
+        assert crc32c(b"123456789") == 0xE3069283
+        assert crc32c(b"") == 0
+
+    def test_frame_round_trip(self, tmp_path):
+        path = tmp_path / "j.wal"
+        records = [_solve_rec("a", i, i) for i in range(5)]
+        with open(path, "wb") as f:
+            f.write(MAGIC)
+            for rec in records:
+                f.write(encode_frame(rec))
+        out, status = read_frames(str(path))
+        assert status == "ok"
+        assert out == records
+
+    def test_missing_empty_and_bad_magic(self, tmp_path):
+        assert read_frames(str(tmp_path / "nope.wal")) == ([], "missing")
+        (tmp_path / "empty.wal").write_bytes(b"")
+        assert read_frames(str(tmp_path / "empty.wal")) == ([], "empty")
+        (tmp_path / "junk.wal").write_bytes(b"not a journal")
+        assert read_frames(str(tmp_path / "junk.wal")) == ([], "corrupt")
+
+    def test_truncated_tail_yields_valid_prefix(self, tmp_path):
+        """kill -9 mid-append: every possible truncation point decodes to the
+        frames fully written before it — never an exception, never a frame
+        past the tear."""
+        path = tmp_path / "j.wal"
+        records = [_solve_rec("a", i, i, request=b"x" * (20 + i)) for i in range(4)]
+        frames = [encode_frame(r) for r in records]
+        data = MAGIC + b"".join(frames)
+        boundaries = [len(MAGIC)]
+        for frame in frames:
+            boundaries.append(boundaries[-1] + len(frame))
+        for cut in range(len(data) + 1):
+            path.write_bytes(data[:cut])
+            out, status = read_frames(str(path))
+            complete = sum(1 for b in boundaries[1:] if b <= cut)
+            assert len(out) == complete, f"cut at {cut}"
+            assert out == records[:complete]
+            if cut < len(data) and cut > len(MAGIC):
+                assert status in ("torn", "ok", "corrupt")
+
+    def test_crc_corruption_stops_the_stream(self, tmp_path):
+        path = tmp_path / "j.wal"
+        records = [_solve_rec("a", i, i) for i in range(4)]
+        frames = [encode_frame(r) for r in records]
+        data = bytearray(MAGIC + b"".join(frames))
+        # flip one payload byte inside frame 2 (skip its 8-byte head)
+        off = len(MAGIC) + len(frames[0]) + len(frames[1]) + 8 + 3
+        data[off] ^= 0xFF
+        path.write_bytes(bytes(data))
+        out, status = read_frames(str(path))
+        assert status == "corrupt"
+        assert out == records[:2]  # nothing after the bad frame is trusted
+
+
+# -- chain assembly -----------------------------------------------------------
+
+
+class TestChainAssembly:
+    def test_anchor_obsoletes_earlier_records(self):
+        records = [
+            _solve_rec("a", 0, 0, kind="anchor", version=1),
+            _solve_rec("a", 1, 1, version=1),
+            _solve_rec("a", 2, 0, kind="anchor", version=2),
+            _solve_rec("a", 3, 1, version=2),
+        ]
+        chains, broken = assemble_chains(records)
+        assert not broken
+        assert [r["seq"] for r in chains["a"]] == [2, 3]
+
+    def test_delta_without_anchor_is_broken(self):
+        chains, broken = assemble_chains([_solve_rec("a", 0, 1, version=1)])
+        assert chains == {} and broken == {"a"}
+
+    def test_tseq_gap_breaks_the_chain(self):
+        records = [
+            _solve_rec("a", 0, 0, kind="anchor"),
+            _solve_rec("a", 1, 1),
+            _solve_rec("a", 2, 3),  # tseq 2 was lost (dropped append)
+        ]
+        chains, broken = assemble_chains(records)
+        assert "a" not in chains and broken == {"a"}
+
+    def test_version_moving_without_anchor_breaks(self):
+        records = [
+            _solve_rec("a", 0, 0, kind="anchor", version=1),
+            _solve_rec("a", 1, 1, version=2),
+        ]
+        chains, broken = assemble_chains(records)
+        assert "a" not in chains and broken == {"a"}
+
+    def test_drop_removes_the_tenant(self):
+        records = [
+            _solve_rec("a", 0, 0, kind="anchor"),
+            {"t": "drop", "tenant": "a", "seq": 1},
+        ]
+        chains, broken = assemble_chains(records)
+        assert chains == {} and broken == set()
+
+    def test_checkpoint_newer_than_journal_dedups_by_seq(self):
+        """A crash between checkpoint-rename and journal-truncate leaves the
+        journal holding frames the checkpoint already compacted: seq dedup
+        must see each record once, whichever file it rides in."""
+        checkpoint = [
+            _solve_rec("a", 4, 0, kind="anchor", version=2),
+            _solve_rec("a", 5, 1, version=2),
+        ]
+        stale_journal = [
+            _solve_rec("a", 0, 0, kind="anchor", version=1),
+            _solve_rec("a", 4, 0, kind="anchor", version=2),
+            _solve_rec("a", 5, 1, version=2),
+            _solve_rec("a", 6, 2, version=2),  # genuinely new tail
+        ]
+        chains, broken = assemble_chains(checkpoint + stale_journal)
+        assert not broken
+        assert [r["seq"] for r in chains["a"]] == [4, 5, 6]
+
+    def test_tenants_are_independent(self):
+        records = [
+            _solve_rec("a", 0, 0, kind="anchor"),
+            _solve_rec("b", 1, 2),  # broken chain for b only
+            _solve_rec("a", 2, 1),
+        ]
+        chains, broken = assemble_chains(records)
+        assert set(chains) == {"a"} and broken == {"b"}
+
+    def test_max_chain_bound_breaks_runaway_chains(self):
+        records = [_solve_rec("a", 0, 0, kind="anchor")]
+        records += [_solve_rec("a", i, i) for i in range(1, 10)]
+        chains, broken = assemble_chains(records, max_chain=4)
+        assert "a" not in chains and broken == {"a"}
+
+
+# -- the journal object -------------------------------------------------------
+
+
+class TestSessionJournal:
+    def _journal(self, tmp_path, **kw) -> SessionJournal:
+        kw.setdefault("clock", FakeClock())
+        kw.setdefault("checkpoint_every", 0)
+        return SessionJournal(str(tmp_path), **kw)
+
+    def _drain(self, journal: SessionJournal) -> None:
+        assert journal.checkpoint_now(timeout_s=5.0)
+
+    def test_append_recover_round_trip(self, tmp_path):
+        journal = self._journal(tmp_path)
+        journal.start()
+        journal.append_solve("acme", "anchor", 0, 1, "sd", {"version": 1}, b"r1")
+        journal.append_solve("acme", "delta", 1, 1, "sd", {"version": 1}, b"r2")
+        journal.close(checkpoint=False)
+        fresh = self._journal(tmp_path)
+        chains, broken, stats = fresh.recover()
+        assert not broken
+        assert [r["kind"] for r in chains["acme"]] == ["anchor", "delta"]
+        assert chains["acme"][1]["request"] == b"r2"
+        # FakeClock stamped the records (wallclock discipline)
+        assert chains["acme"][0]["ts"] == pytest.approx(1_000_000.0)
+
+    def test_checkpoint_compacts_and_truncates(self, tmp_path):
+        journal = self._journal(tmp_path, checkpoint_every=3)
+        journal.start()
+        # 2 anchors + 1 delta: the 3rd append triggers compaction; tenant
+        # a's first anchor is obsolete by then
+        journal.append_solve("a", "anchor", 0, 1, None, {"version": 1}, b"old")
+        journal.append_solve("a", "anchor", 0, 2, None, {"version": 2}, b"new")
+        journal.append_solve("a", "delta", 1, 2, None, {"version": 2}, b"d")
+        journal.close(checkpoint=False)
+        ck_records, ck_status = read_frames(os.path.join(str(tmp_path), "checkpoint.wal"))
+        j_records, j_status = read_frames(os.path.join(str(tmp_path), "journal.wal"))
+        assert ck_status == "ok" and j_status in ("ok", "empty")
+        assert [r["request"] for r in ck_records] == [b"new", b"d"]
+        assert j_records == []  # rotated
+        fresh = self._journal(tmp_path)
+        chains, broken, _stats = fresh.recover()
+        assert [r["request"] for r in chains["a"]] == [b"new", b"d"]
+
+    def test_drop_survives_restart(self, tmp_path):
+        journal = self._journal(tmp_path)
+        journal.start()
+        journal.append_solve("a", "anchor", 0, 1, None, {"version": 1}, b"r")
+        journal.append_drop("a")
+        journal.close(checkpoint=True)
+        fresh = self._journal(tmp_path)
+        chains, broken, _stats = fresh.recover()
+        assert chains == {} and broken == set()
+
+    def test_pre_start_drops_are_durable(self, tmp_path):
+        """Recovery appends drop records for chains that failed verification
+        BEFORE the writer starts — they must still land once it does, or the
+        next restart would replay the same bad chain forever."""
+        journal = self._journal(tmp_path)
+        journal.start()
+        journal.append_solve("bad", "anchor", 0, 1, None, {"version": 1}, b"r")
+        journal.close(checkpoint=False)
+        fresh = self._journal(tmp_path)
+        chains, _broken, _stats = fresh.recover()
+        assert "bad" in chains
+        fresh.append_drop("bad")  # enqueued pre-start, like _recover_sessions
+        fresh.start()
+        fresh.close(checkpoint=False)
+        final = self._journal(tmp_path)
+        chains, broken, _stats = final.recover()
+        assert chains == {} and broken == set()
+
+    def test_abandon_drops_queued_records(self, tmp_path):
+        """SIGKILL semantics: whatever the writer flushed is durable, the
+        queue is not — and the surviving prefix is still a valid chain."""
+        journal = self._journal(tmp_path)
+        journal.append_solve("a", "anchor", 0, 1, None, {"version": 1}, b"r")
+        # never started: the record sits in the queue, then dies with abandon
+        journal.abandon()
+        fresh = self._journal(tmp_path)
+        chains, _broken, _stats = fresh.recover()
+        assert chains == {}
+
+    def test_store_io_partial_fault_tears_the_tail(self, tmp_path):
+        journal = self._journal(tmp_path)
+        journal.start()
+        scenario = chaos.Scenario("torn", 11, {
+            "store.io": chaos.PointSpec(schedule=[1], kind="partial"),
+        })
+        with chaos.armed(scenario):
+            journal.append_solve("a", "anchor", 0, 1, None, {"version": 1}, b"r")
+            journal.append_solve("a", "delta", 1, 1, None, {"version": 1}, b"d")
+            journal.close(checkpoint=False)
+        assert scenario.fired_counts().get("store.io") == 1
+        _records, status = read_frames(os.path.join(str(tmp_path), "journal.wal"))
+        assert status == "torn"
+        # recovery still reads the valid prefix: the anchor survives whole
+        fresh = self._journal(tmp_path)
+        chains, _broken, stats = fresh.recover()
+        assert [r["kind"] for r in chains.get("a", [])] == ["anchor"]
+        assert stats["journal"] == "torn"
+
+    def test_store_io_enospc_fails_closed(self, tmp_path):
+        journal = self._journal(tmp_path)
+        journal.start()
+        scenario = chaos.Scenario("enospc", 5, {
+            "store.io": chaos.PointSpec(first_n=1, data={"errno": 28}),
+        })
+        with chaos.armed(scenario):
+            journal.append_solve("a", "anchor", 0, 1, None, {"version": 1}, b"r")
+            journal.close(checkpoint=False)
+        assert not journal.active()
+        fresh = self._journal(tmp_path)
+        chains, _broken, _stats = fresh.recover()
+        assert chains == {}  # nothing durable, nothing wrong
+
+    def test_store_io_checkpoint_fault_leaves_stale_checkpoint(self, tmp_path):
+        """A checkpoint-time fault skips the compaction (the stale checkpoint
+        stays on disk, the journal keeps its frames) without losing a single
+        record."""
+        journal = self._journal(tmp_path, checkpoint_every=2)
+        journal.start()
+        # hits: append#0, append#1, checkpoint#2 — schedule the checkpoint
+        scenario = chaos.Scenario("stale-ckpt", 5, {
+            "store.io": chaos.PointSpec(schedule=[2]),
+        })
+        with chaos.armed(scenario):
+            journal.append_solve("a", "anchor", 0, 1, None, {"version": 1}, b"r")
+            journal.append_solve("a", "delta", 1, 1, None, {"version": 1}, b"d")
+            journal.close(checkpoint=False)
+        assert scenario.fired_counts().get("store.io") == 1
+        assert journal.active() is False or True  # journal stays usable
+        j_records, _status = read_frames(os.path.join(str(tmp_path), "journal.wal"))
+        assert len(j_records) == 2  # not compacted away
+        fresh = self._journal(tmp_path)
+        chains, broken, _stats = fresh.recover()
+        assert not broken
+        assert [r["kind"] for r in chains["a"]] == ["anchor", "delta"]
+
+    def test_corruption_fuzz_never_raises_never_invents(self, tmp_path):
+        """Fuzz the recovery matrix over seeds: random journals, random
+        single-point corruption (truncate / bit flip) — recover() never
+        raises and every assembled chain is a prefix-consistent replay set
+        (warm-or-reanchor, never garbage)."""
+        rng = np.random.default_rng(1729)
+        for trial in range(20):
+            path = tmp_path / f"fuzz-{trial}"
+            path.mkdir()
+            journal_path = path / "journal.wal"
+            tenants = [f"t{i}" for i in range(int(rng.integers(1, 4)))]
+            tseq = {t: -1 for t in tenants}
+            records = []
+            for seq in range(int(rng.integers(2, 12))):
+                tenant = tenants[int(rng.integers(0, len(tenants)))]
+                if tseq[tenant] < 0 or rng.random() < 0.3:
+                    tseq[tenant] = 0
+                    records.append(_solve_rec(tenant, seq, 0, kind="anchor"))
+                else:
+                    tseq[tenant] += 1
+                    records.append(_solve_rec(tenant, seq, tseq[tenant]))
+            data = bytearray(MAGIC + b"".join(encode_frame(r) for r in records))
+            mode = int(rng.integers(0, 3))
+            if mode == 0 and len(data) > len(MAGIC):  # truncate
+                data = data[: int(rng.integers(len(MAGIC), len(data)))]
+            elif mode == 1 and len(data) > len(MAGIC):  # bit flip
+                off = int(rng.integers(len(MAGIC), len(data)))
+                data[off] ^= 1 << int(rng.integers(0, 8))
+            journal_path.write_bytes(bytes(data))
+            journal = SessionJournal(str(path), clock=FakeClock())
+            chains, broken, _stats = journal.recover()
+            for tenant, chain in chains.items():
+                assert chain[0]["kind"] == "anchor"
+                for prev, cur in zip(chain, chain[1:]):
+                    assert cur["kind"] == "delta"
+                    assert cur["tseq"] == prev["tseq"] + 1
+                    assert cur["seq"] > prev["seq"]
+                # every surviving record is one we actually wrote
+                written = {(r["tenant"], r["seq"], r["tseq"]) for r in records}
+                for rec in chain:
+                    assert (rec["tenant"], rec["seq"], rec["tseq"]) in written
+
+
+# -- wire-level warm recovery -------------------------------------------------
+
+
+class TestWarmRestart:
+    def _serve(self, provider, journal_dir=None, **cfg_kw):
+        config = _loose_config(**cfg_kw)
+        server, port = serve(
+            provider, tenant_config=config,
+            journal_dir=str(journal_dir) if journal_dir else None,
+        )
+        return server, SnapshotSolverClient(f"127.0.0.1:{port}")
+
+    @staticmethod
+    def _stop(server, client, abandon=False):
+        client.close()
+        server.stop(grace=0)
+        svc = server.kc_service
+        if svc.journal is not None:
+            if abandon:
+                svc.journal.abandon()
+            else:
+                svc.shutdown()
+
+    def test_warm_restart_resumes_delta_bit_identical(self, tmp_path):
+        """The acceptance pin: kill the server (journal abandoned un-flushed
+        = SIGKILL), restart over the same journal dir — the session resumes
+        WARM (delta mode, recovered echo) and the post-restart response is
+        bit-identical to what an uninterrupted server answers."""
+        provider = FakeCloudProvider()
+        server, client = self._serve(provider, tmp_path / "j")
+        r1 = _solve(client, "acme", count=8)
+        assert (r1["tenant"]["solveMode"], r1["tenant"]["reason"]) == ("full", "first")
+        v1 = r1["tenant"]["sessionVersion"]
+        r2 = _solve(client, "acme", count=10, version=v1)
+        assert r2["tenant"]["solveMode"] == "delta"
+        import time
+        time.sleep(0.2)  # let the writer drain (appends are async by design)
+        self._stop(server, client, abandon=True)
+
+        # the uninterrupted reference run, fresh server, same sequence
+        server_u, client_u = self._serve(provider)
+        u1 = _solve(client_u, "acme", count=8)
+        u2 = _solve(client_u, "acme", count=10, version=u1["tenant"]["sessionVersion"])
+        u3 = _solve(client_u, "acme", count=12, version=u2["tenant"]["sessionVersion"])
+        self._stop(server_u, client_u)
+
+        server2, client2 = self._serve(provider, tmp_path / "j")
+        r3 = _solve(client2, "acme", count=12, version=v1)
+        assert r3["tenant"]["solveMode"] == "delta"
+        assert r3["tenant"]["recovered"] == "warm"
+        strip = lambda r: {k: v for k, v in r.items() if k != "tenant"}  # noqa: E731
+        assert strip(r3) == strip(u3)
+        # the recovered echo is one-shot
+        r4 = _solve(client2, "acme", count=12, version=r3["tenant"]["sessionVersion"])
+        assert "recovered" not in r4["tenant"]
+        self._stop(server2, client2)
+
+    def test_corrupt_checkpoint_downgrades_to_session_lost(self, tmp_path):
+        provider = FakeCloudProvider()
+        server, client = self._serve(provider, tmp_path / "j")
+        r1 = _solve(client, "acme", count=6)
+        v1 = r1["tenant"]["sessionVersion"]
+        server.kc_service.drain(timeout_s=5.0)  # flush + checkpoint
+        self._stop(server, client)
+        ck = tmp_path / "j" / "checkpoint.wal"
+        data = bytearray(ck.read_bytes())
+        data[-5] ^= 0xFF  # CRC-corrupt the tail frame
+        ck.write_bytes(bytes(data))
+        corrupt_before = _counter_value(
+            journal_mod.SESSION_RECOVERED, outcome="corrupt"
+        )
+        server2, client2 = self._serve(provider, tmp_path / "j")
+        # the damaged frame stream counts outcome=corrupt (per file)
+        assert _counter_value(
+            journal_mod.SESSION_RECOVERED, outcome="corrupt"
+        ) == corrupt_before + 1
+        r2 = _solve(client2, "acme", count=6, version=v1)
+        # never a wrong answer: the worst case is always the full re-anchor
+        assert (r2["tenant"]["solveMode"], r2["tenant"]["reason"]) == (
+            "full", "session-lost"
+        )
+        placed = sum(n for node in r2["newNodes"] for _c, n in node["classCounts"])
+        placed += sum(n for _c, n in r2["failedClassCounts"])
+        placed += sum(
+            n for counts in r2["existingAssignments"].values() for _c, n in counts
+        )
+        assert placed == 6
+        self._stop(server2, client2)
+
+    def test_empty_journal_dir_serves_normally(self, tmp_path):
+        provider = FakeCloudProvider()
+        server, client = self._serve(provider, tmp_path / "fresh")
+        r1 = _solve(client, "acme")
+        assert r1["tenant"]["reason"] == "first"
+        # a client claiming a version nobody journaled: session-lost
+        r2 = _solve(client, "other", version=7)
+        assert r2["tenant"]["reason"] == "session-lost"
+        self._stop(server, client)
+
+    def test_recovery_outcome_metric_counts_warm(self, tmp_path):
+        provider = FakeCloudProvider()
+        server, client = self._serve(provider, tmp_path / "j")
+        _solve(client, "acme", count=5)
+        import time
+        time.sleep(0.2)
+        self._stop(server, client, abandon=True)
+        before = _counter_value(journal_mod.SESSION_RECOVERED, outcome="warm")
+        server2, client2 = self._serve(provider, tmp_path / "j")
+        after = _counter_value(journal_mod.SESSION_RECOVERED, outcome="warm")
+        assert after == before + 1
+        self._stop(server2, client2)
+
+    def test_evicted_session_is_not_resurrected(self, tmp_path):
+        """An LRU-evicted tenant journals a drop record: recovery must not
+        bring its lineage back from the dead."""
+        provider = FakeCloudProvider()
+        config = _loose_config(max_sessions=1)
+        server, port = serve(
+            provider, tenant_config=config, journal_dir=str(tmp_path / "j")
+        )
+        client = SnapshotSolverClient(f"127.0.0.1:{port}")
+        r1 = _solve(client, "a", count=4)
+        v1 = r1["tenant"]["sessionVersion"]
+        _solve(client, "b", count=4)  # evicts a (capacity 1)
+        import time
+        time.sleep(0.2)
+        self._stop(server, client, abandon=True)
+        server2, port2 = serve(
+            provider, tenant_config=config, journal_dir=str(tmp_path / "j")
+        )
+        client2 = SnapshotSolverClient(f"127.0.0.1:{port2}")
+        r2 = _solve(client2, "a", count=4, version=v1)
+        assert r2["tenant"]["reason"] == "session-lost"
+        self._stop(server2, client2)
+
+
+class TestGracefulDrain:
+    def test_drain_sheds_with_hint_then_checkpoints(self, tmp_path):
+        provider = FakeCloudProvider()
+        server, port = serve(
+            provider, tenant_config=_loose_config(),
+            journal_dir=str(tmp_path / "j"),
+        )
+        client = SnapshotSolverClient(f"127.0.0.1:{port}")
+        r1 = _solve(client, "acme", count=8)
+        v1 = r1["tenant"]["sessionVersion"]
+        assert server.kc_service.drain(timeout_s=5.0) is True
+        with pytest.raises(grpc.RpcError) as excinfo:
+            _solve(client, "acme", count=8, version=v1)
+        assert excinfo.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert "tenant-draining" in excinfo.value.details()
+        assert parse_retry_after(excinfo.value.details()) > 0
+        client.close()
+        server.stop(grace=0)
+        # the drain checkpointed: a restart resumes WARM from the checkpoint
+        ck_records, status = read_frames(str(tmp_path / "j" / "checkpoint.wal"))
+        assert status == "ok" and len(ck_records) >= 1
+        server2, port2 = serve(
+            provider, tenant_config=_loose_config(),
+            journal_dir=str(tmp_path / "j"),
+        )
+        client2 = SnapshotSolverClient(f"127.0.0.1:{port2}")
+        r2 = _solve(client2, "acme", count=10, version=v1)
+        assert r2["tenant"]["solveMode"] == "delta"
+        assert r2["tenant"]["recovered"] == "warm"
+        client2.close()
+        server2.stop(grace=0)
+        server2.kc_service.shutdown()
+
+    def test_drain_handler_installs_on_main_thread(self, tmp_path):
+        import signal
+
+        from karpenter_core_tpu.service.snapshot_channel import (
+            install_drain_handler,
+        )
+
+        provider = FakeCloudProvider()
+        server, port = serve(provider, tenant_config=_loose_config())
+        try:
+            previous = signal.getsignal(signal.SIGTERM)
+            try:
+                assert install_drain_handler(server, server.kc_service) is True
+                assert signal.getsignal(signal.SIGTERM) is not previous
+            finally:
+                signal.signal(signal.SIGTERM, previous)
+        finally:
+            server.stop(grace=0)
+
+
+class TestStaleReasonEcho:
+    def test_recovered_session_supply_mismatch_reports_supply_digest(
+        self, tmp_path
+    ):
+        """ISSUE-13 satellite: a journal-recovered session that then hits a
+        supply-digest mismatch must report ``supply-digest`` — not echo a
+        leftover ``session-lost`` into the solve-mode counter and span."""
+        provider = FakeCloudProvider()
+        server, port = serve(
+            provider, tenant_config=_loose_config(),
+            journal_dir=str(tmp_path / "j"),
+        )
+        client = SnapshotSolverClient(f"127.0.0.1:{port}")
+        r1 = _solve(client, "acme", count=6, supply="sha:aaa")
+        v1 = r1["tenant"]["sessionVersion"]
+        import time
+        time.sleep(0.2)
+        client.close()
+        server.stop(grace=0)
+        server.kc_service.journal.abandon()
+        server2, port2 = serve(
+            provider, tenant_config=_loose_config(),
+            journal_dir=str(tmp_path / "j"),
+        )
+        client2 = SnapshotSolverClient(f"127.0.0.1:{port2}")
+        # warm recovery restored the client's journaled supply digest, so the
+        # mismatch is detectable — and must win the reason
+        r2 = _solve(client2, "acme", count=6, version=v1, supply="sha:bbb")
+        assert (r2["tenant"]["solveMode"], r2["tenant"]["reason"]) == (
+            "full", "supply-digest"
+        )
+        client2.close()
+        server2.stop(grace=0)
+        server2.kc_service.shutdown()

@@ -146,6 +146,103 @@ class TestAdmissionControl:
         assert bucket.allow()
 
 
+class TestWeightedFairShare:
+    """ISSUE-13 satellite: per-tenant weights scale the admission bucket's
+    rate AND burst; sheds keep exact retry-after hints."""
+
+    def test_env_weights_scale_burst_and_rate(self, monkeypatch):
+        monkeypatch.setenv("KC_TENANT_WEIGHTS", "heavy=4.0, light=0.5")
+        config = TenantConfig.from_env()
+        assert config.resolve_weight("heavy") == 4.0
+        assert config.resolve_weight("light") == 0.5
+        assert config.resolve_weight("unlisted") == 1.0
+        plane = TenantPlane(clock=FakeClock(), config=_loose_config(
+            rate_per_s=1.0, burst=4, weights={"heavy": 4.0, "light": 0.5},
+        ))
+        heavy = plane.checkout("heavy", weight=config.resolve_weight("heavy"))
+        light = plane.checkout("light", weight=config.resolve_weight("light"))
+        assert heavy.bucket.budget == 16.0  # burst * 4
+        assert light.bucket.budget == 2.0   # burst * 0.5
+        assert heavy.bucket.refill_per_s == pytest.approx(4.0)
+        assert light.bucket.refill_per_s == pytest.approx(0.5)
+
+    def test_weighted_admission_over_the_wire(self):
+        """A weighted tenant sustains proportionally more requests before
+        shedding, and the shed hint is the weighted bucket's exact refill
+        time."""
+        clock = FakeClock()
+        config = _loose_config(
+            rate_per_s=0.1, burst=2, weights={"heavy": 3.0},
+        )
+        server, port = serve(FakeCloudProvider(), tenant_config=config,
+                             clock=clock)
+        client = SnapshotSolverClient(f"127.0.0.1:{port}")
+        try:
+            for _ in range(6):  # burst 2 * weight 3
+                assert _solve(client, "heavy")["tenant"]["id"] == "heavy"
+            with pytest.raises(grpc.RpcError) as excinfo:
+                _solve(client, "heavy")
+            assert excinfo.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            # the unweighted tenant sheds after its plain burst of 2
+            _solve(client, "plain")
+            _solve(client, "plain")
+            with pytest.raises(grpc.RpcError) as excinfo:
+                _solve(client, "plain")
+            hint = parse_retry_after(excinfo.value.details())
+            # exact refill hint: 1 token at rate 0.1/s (possibly escalated
+            # by the shed backoff, never below the bucket's own time)
+            assert hint is not None and hint >= 0.05
+        finally:
+            client.close()
+            server.stop(0)
+
+    def test_wire_weight_claims_are_honored_but_env_wins(self):
+        plane = TenantPlane(clock=FakeClock(), config=_loose_config(
+            rate_per_s=1.0, burst=4, weights={"pinned": 2.0},
+        ))
+        # the wire claim shapes an unpinned tenant's bucket
+        decision = plane.admit("claimer", weight=5.0)
+        assert decision.admitted
+        assert decision.entry.bucket.budget == 20.0
+        plane.release("claimer")
+        # but an operator env pin beats the wire's self-promotion
+        decision = plane.admit("pinned", weight=50.0)
+        assert decision.admitted
+        assert decision.entry.bucket.budget == 8.0
+        plane.release("pinned")
+
+    def test_weight_change_reshapes_bucket_proportionally(self):
+        clock = FakeClock()
+        plane = TenantPlane(clock=clock, config=_loose_config(
+            rate_per_s=1.0, burst=4,
+        ))
+        entry = plane.checkout("a", weight=1.0)
+        for _ in range(2):
+            assert entry.bucket.allow()
+        assert entry.bucket.remaining() == pytest.approx(2.0)
+        # weight 1 -> 2: budget 4 -> 8, half-full stays half-full
+        entry = plane.checkout("a", weight=2.0)
+        assert entry.weight == 2.0
+        assert entry.bucket.budget == 8.0
+        assert entry.bucket.remaining() == pytest.approx(4.0)
+
+    def test_weight_clamps(self):
+        config = _loose_config(weights={"evil": 1e9})
+        assert config.resolve_weight("evil") == 100.0
+        assert config.resolve_weight("x", wire_weight=-5) == 0.01
+        assert config.resolve_weight("x", wire_weight="bogus") == 1.0
+
+
+class TestDrainingAdmission:
+    def test_draining_sheds_without_minting_sessions(self):
+        plane = TenantPlane(clock=FakeClock(), config=_loose_config())
+        plane.start_draining(retry_after_s=7.0)
+        decision = plane.admit("newcomer")
+        assert not decision.admitted and decision.reason == "draining"
+        assert decision.retry_after_s == 7.0
+        assert plane.sessions() == []  # no session minted while draining
+
+
 class TestTenantIsolation:
     @pytest.mark.tenant_config(breaker_threshold=2)
     def test_malformed_requests_isolate_the_tenant(self, channel):

@@ -55,6 +55,62 @@ class TestMultiTenantSoak:
         assert report["diagnostics"]["wall_s"] > 0
 
 
+class TestJournalSoak:
+    """ISSUE-13: the multi-tenant soak with the session journal armed — a
+    mid-stream SIGKILL + restart must resume ≥80% of live sessions WARM
+    (delta mode), with per-tenant responses bit-identical to an
+    uninterrupted run of the same seed, and 0 cross-tenant leakage."""
+
+    _BASE = dict(tenants=8, rounds=4, pods_per_tenant=10, chaos_points={})
+
+    def test_journal_warm_resume_bit_identical(self, tmp_path):
+        interrupted = run_multi_tenant(
+            TenantSoakScenario(
+                restart_after_round=1, journal_dir=str(tmp_path / "journal"),
+                **self._BASE,
+            ),
+            seed=_seed(),
+        )
+        uninterrupted = run_multi_tenant(
+            TenantSoakScenario(restart_after_round=None, **self._BASE),
+            seed=_seed(),
+        )
+        verdict = interrupted["verdict"]
+        rules = {r["probe"]: r for r in verdict["slo"]}
+        # 0 cross-tenant leakage / wrong answers, every round completed
+        assert rules["wrong_answers"]["observed"] == 0, \
+            interrupted["diagnostics"]["errors"]
+        assert rules["incomplete_rounds"]["observed"] == 0
+        assert rules["machine_leaks"]["observed"] == 0
+        # >= 80% of live sessions resumed WARM after the SIGKILL
+        assert verdict["restarted"] is True
+        assert rules["warm_resume_fraction"]["passed"], \
+            rules["warm_resume_fraction"]
+        # warm + re-anchored partition the fleet exactly (nothing limbo)
+        assert rules["sessions_relost"]["passed"], rules["sessions_relost"]
+        assert verdict["passed"] is True
+        # bit-identity: every warm tenant's per-round responses match the
+        # uninterrupted run digest for digest — including the post-restart
+        # delta rounds served off the replayed lineage
+        ti = interrupted["diagnostics"]["tenants"]
+        tu = uninterrupted["diagnostics"]["tenants"]
+        warm = [t for t, v in ti.items() if v["outcome"] == "warm"]
+        assert warm, "no warm resumes to compare"
+        for tenant in warm:
+            assert ti[tenant]["digests"] == tu[tenant]["digests"], tenant
+
+    def test_journal_disabled_still_relosts_everything(self):
+        """The PR-12 contract is untouched when no journal is configured:
+        every session re-anchors session-lost after a restart."""
+        report = run_multi_tenant(
+            TenantSoakScenario(restart_after_round=1, **self._BASE),
+            seed=_seed(),
+        )
+        rules = {r["probe"]: r for r in report["verdict"]["slo"]}
+        assert rules["sessions_relost"]["observed"] == self._BASE["tenants"]
+        assert "warm_resume_fraction" not in rules
+
+
 @pytest.mark.slow
 class TestMultiTenantSoakScale:
     def test_sixteen_tenants_more_rounds(self):
@@ -63,3 +119,21 @@ class TestMultiTenantSoakScale:
             seed=_seed(),
         )
         assert report["verdict"]["passed"] is True, report
+
+    def test_thirty_two_tenants_journal_sigkill(self, tmp_path):
+        """The ISSUE-13 acceptance scale: 32 tenants, SIGKILL mid-stream,
+        journal-backed restart — ≥80% warm resumes, zero wrong answers."""
+        report = run_multi_tenant(
+            TenantSoakScenario(
+                tenants=32, rounds=4, restart_after_round=1,
+                journal_dir=str(tmp_path / "journal"), chaos_points={},
+            ),
+            seed=_seed(),
+        )
+        verdict = report["verdict"]
+        rules = {r["probe"]: r for r in verdict["slo"]}
+        assert rules["wrong_answers"]["observed"] == 0, \
+            report["diagnostics"]["errors"]
+        assert rules["warm_resume_fraction"]["passed"], \
+            rules["warm_resume_fraction"]
+        assert verdict["passed"] is True, report["verdict"]

@@ -315,6 +315,35 @@ def report_tenant(detail: dict) -> None:
         )
 
 
+def report_recovery(detail: dict) -> None:
+    """Surface the durable-session journal's hot-path cost (ISSUE-13,
+    docs/SERVICE.md): the tenant bench's serial p99 with a per-solve journal
+    append vs without.  The append is an enqueue — framing and fsync ride
+    the writer thread — so the advisory warns when it adds more than 5% to
+    the tenant p99 (something is blocking the RPC path that shouldn't)."""
+    tenant = detail.get("tenant")
+    if not tenant or "journal_overhead_fraction" not in tenant:
+        return
+    overhead = tenant.get("journal_overhead_fraction")
+    if overhead is None:
+        return
+    print(
+        "perfgate: recovery journal p99 {j:.4f}s vs {p:.4f}s bare — "
+        "append overhead {o:+.1f}%".format(
+            j=tenant["p99_serial_journal_s"],
+            p=tenant["p99_serial_solve_s"],
+            o=overhead * 100.0,
+        )
+    )
+    if overhead > 0.05:
+        print(
+            "perfgate: WARNING journal append adds "
+            f"{overhead * 100.0:.1f}% to the tenant p99 (>5%) — the append "
+            "path must stay enqueue-only; check KC_JOURNAL_FSYNC discipline "
+            "and queue depth (docs/SERVICE.md durable-session triage)"
+        )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=0.05,
@@ -340,6 +369,7 @@ def main() -> int:
     report_policy(detail)
     report_sharded(detail)
     report_tenant(detail)
+    report_recovery(detail)
     if pods_per_sec is None:
         print(json.dumps(rec))
         print("perfgate: FAIL (bench produced no pods_per_sec)")

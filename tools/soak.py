@@ -76,6 +76,11 @@ def main(argv=None) -> int:
             "server (service.rpc + solver.dispatch chaos, restart re-anchor; "
             "docs/SERVICE.md)"
         )
+        print(
+            f"  {'multi-tenant-journal':28s} 32 tenants + session journal: "
+            "mid-stream SIGKILL, restart must resume >=80% of sessions WARM "
+            "(service/journal.py; docs/SERVICE.md)"
+        )
         print("generators:", ", ".join(sorted(generators.GENERATORS)))
         return 0
 
@@ -111,6 +116,23 @@ def main(argv=None) -> int:
                 from karpenter_core_tpu.soak.tenants import run_multi_tenant
 
                 report = run_multi_tenant(seed=args.seed)
+            elif name == "multi-tenant-journal":
+                import tempfile
+
+                from karpenter_core_tpu.soak.tenants import (
+                    TenantSoakScenario,
+                    run_multi_tenant,
+                )
+
+                with tempfile.TemporaryDirectory() as journal_dir:
+                    report = run_multi_tenant(
+                        TenantSoakScenario(
+                            name="multi-tenant-journal",
+                            tenants=32, rounds=4, restart_after_round=1,
+                            journal_dir=journal_dir, chaos_points={},
+                        ),
+                        seed=args.seed,
+                    )
             else:
                 report = run_scenario(catalog.build(name, seed=args.seed))
             reports.append(report)
