@@ -519,6 +519,193 @@ def churn_line(solver, ingest, churn_fraction: float = 0.02, ticks: int = 5) -> 
     }
 
 
+def pipeline_line(n_pods: int = 100_000, n_its: int = 2000,
+                  churn_fraction: float = 0.02, ticks: int = 6) -> dict:
+    """Pipelined solve loop benchmark (ISSUE 14 acceptance): the SAME
+    deterministic churn-tick sequence driven two ways over the incremental
+    session in its ANCHOR regime — FallbackPolicy(materialized=True), the
+    in-process provisioning controller's policy, where every tick's solve
+    re-anchors full because the previous solve's decisions became real
+    nodes.  That is the loop whose fetch+materialize tail the pipeline
+    exists to hide: per tick the device re-solves the whole fleet while the
+    host mints the next churn wave, decodes the previous anchor, and
+    materializes its launch-path reads.
+
+      serial     KC_PIPELINE=0: dispatch, block on the fetch, decode,
+                 materialize, only then the next tick (the pre-pipeline
+                 loop bit-for-bit, per-tick host plane re-upload included)
+      pipelined  KC_PIPELINE=1 + solve(deferred=True): tick k+1's dispatch
+                 overlaps tick k's device->host copy and host materialize;
+                 the completion barrier surfaces only the device time the
+                 host work could not cover (docs/KERNEL_PERF.md "Layer 7")
+
+    Reported: warm per-tick means (tick 0 excluded), the speedup,
+    ``overlap_efficiency`` = median hidden/(hidden+exposed) per
+    pipeline.overlap record (the hidden-fetch fraction), and whether the two
+    legs' final assignments are identical.  The donation ledger
+    (``donated`` / ``donation_reallocs``) comes from a short steady-churn
+    REPAIR segment appended to the pipelined leg — carry donation is the
+    warm path's device-memory story and the anchor loop never dispatches
+    warm."""
+    import copy
+    import statistics
+
+    from karpenter_core_tpu.apis.objects import new_uid
+    from karpenter_core_tpu.models.columnar import PodIngest
+    from karpenter_core_tpu.solver.incremental import (
+        FallbackPolicy,
+        IncrementalSolveSession,
+    )
+    from karpenter_core_tpu.utils import pipeline as pipeline_mod
+
+    solver, pods = build_inputs(n_pods, n_its, n_provisioners=5)
+
+    def churn(ingest, reps, tick: int) -> None:
+        members = ingest.class_members()
+        sigs = sorted(members, key=repr)
+        target = max(int(len(ingest) * churn_fraction), 1)
+        pool = sum(len(members[s]) for s in sigs)
+        evictions, replacements = [], []
+        for sig in sigs:
+            uids = members[sig]
+            take = min(
+                max(round(target * len(uids) / max(pool, 1)), 1), len(uids)
+            )
+            rep = reps.setdefault(sig, copy.deepcopy(ingest.get(uids[0])))
+            evictions.extend(uids[:take])
+            for _ in range(take):
+                pod = copy.deepcopy(rep)
+                pod.metadata.name = f"churn-{tick}-{len(replacements)}"
+                pod.metadata.uid = new_uid()
+                pod.spec.node_name = ""
+                replacements.append(pod)
+        for uid in evictions:
+            ingest.remove(uid)
+        for pod in replacements:
+            ingest.add(pod)
+
+    def consume(results) -> int:
+        # the launch path's reads: every decision materializes its offering
+        # lists and request vector
+        touched = 0
+        for d in results.new_nodes:
+            touched += len(d.instance_type_names[:4]) + len(d.zones)
+            touched += len(d.requests)
+        return touched
+
+    def anchor_leg(pipelined: bool) -> dict:
+        ingest = PodIngest()
+        ingest.add_all(pods)  # pods are read-only to the solve: legs share
+        session = IncrementalSolveSession(
+            solver,
+            FallbackPolicy(enabled=True, audit_interval=0,
+                           max_delta_fraction=0.5, materialized=True),
+        )
+        handle = session.solve(ingest, deferred=pipelined)
+        if pipelined:
+            consume(handle.result())
+        else:
+            consume(handle)
+        reps: dict = {}
+        tick_walls, overlaps = [], []
+        ring = pipeline_mod.SolvePipeline()  # KC_PIPELINE_DEPTH deep
+        for tick in range(ticks + 1):  # tick 0 warms; excluded from stats
+            t_tick = time.perf_counter()
+            churn(ingest, reps, tick)
+            if pipelined:
+                retired = ring.submit(
+                    lambda: session.solve(ingest, deferred=True)
+                )
+                if retired is not None:
+                    consume(retired)
+                    rec = pipeline_mod.last_overlap()
+                    total = rec["hidden_s"] + rec["exposed_s"]
+                    if total > 0:
+                        overlaps.append(rec["hidden_s"] / total)
+            else:
+                consume(session.solve(ingest))
+            if tick > 0:
+                tick_walls.append(time.perf_counter() - t_tick)
+        for results in ring.drain():
+            consume(results)
+        return {
+            "tick_s": statistics.mean(tick_walls) if tick_walls else 0.0,
+            "overlap_efficiency": (
+                round(statistics.median(overlaps), 3) if overlaps else None
+            ),
+            "signature": session.node_signature(),
+            "modes": dict(session.mode_counts),
+            "aggregates": session.aggregates(),
+        }
+
+    def repair_segment(n_ticks: int = 3) -> dict:
+        """Steady-churn repairs through the pipelined loop: the donation
+        ledger's measurement segment (and a warm-path sanity check)."""
+        ingest = PodIngest()
+        ingest.add_all(pods)
+        session = IncrementalSolveSession(
+            solver,
+            FallbackPolicy(enabled=True, audit_interval=0,
+                           max_delta_fraction=0.5),
+        )
+        session.solve(ingest, deferred=True).result()
+        reps: dict = {}
+        stats0 = pipeline_mod.stats()
+        ring = pipeline_mod.SolvePipeline()
+        for tick in range(n_ticks):
+            churn(ingest, reps, tick)
+            retired = ring.submit(
+                lambda: session.solve(ingest, deferred=True)
+            )
+            if retired is not None:
+                consume(retired)
+        for results in ring.drain():
+            consume(results)
+        stats1 = pipeline_mod.stats()
+        return {
+            "donated": stats1["donated"] - stats0["donated"],
+            "donation_reallocs": (
+                stats1["donation_reallocs"] - stats0["donation_reallocs"]
+            ),
+            "modes": dict(session.mode_counts),
+        }
+
+    saved = os.environ.get("KC_PIPELINE")
+    try:
+        os.environ["KC_PIPELINE"] = "1"
+        pipe = anchor_leg(True)
+        repairs = repair_segment()
+        os.environ["KC_PIPELINE"] = "0"
+        serial = anchor_leg(False)
+    finally:
+        if saved is None:
+            os.environ.pop("KC_PIPELINE", None)
+        else:
+            os.environ["KC_PIPELINE"] = saved
+
+    identical = serial["signature"] == pipe["signature"]
+    serial_s, pipe_s = serial["tick_s"], pipe["tick_s"]
+    return {
+        "pods": n_pods,
+        "instance_types": n_its,
+        "churn_fraction": churn_fraction,
+        "ticks": ticks,
+        "serial_tick_s": round(serial_s, 4),
+        "pipelined_tick_s": round(pipe_s, 4),
+        "speedup": round(serial_s / pipe_s, 2) if pipe_s > 0 else 0.0,
+        "overlap_efficiency": pipe["overlap_efficiency"],
+        "donated": repairs["donated"],
+        "donation_reallocs": repairs["donation_reallocs"],
+        "repair_modes": repairs["modes"],
+        "identical_assignments": identical,
+        "serial_modes": serial["modes"],
+        "pipelined_modes": pipe["modes"],
+        "scheduled": pipe["aggregates"]["scheduled"],
+        "failed": pipe["aggregates"]["failed"],
+        "nodes": pipe["aggregates"]["nodes"],
+    }
+
+
 def policy_line(n_pods: int = 2000, n_its: int = 24) -> dict:
     """Policy-objective benchmark (ISSUE 9 acceptance): the SAME feasibility
     solve decoded twice on a mixed spot/on-demand demo fleet with a skewed
@@ -1063,6 +1250,28 @@ def main() -> None:
             traceback.print_exc(file=sys.stderr)
             churn = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # pipelined loop: serial vs double-buffered deferred churn ticks at the
+    # 100k × 2k scale config (docs/KERNEL_PERF.md "Layer 7"); the warm
+    # per-tick stage gates in tools/perfgate.py and report_pipeline warns
+    # when overlap efficiency sags.  KC_BENCH_PIPELINE=0 skips;
+    # KC_BENCH_PIPELINE_{PODS,ITS,TICKS,FRACTION} size it.
+    pipeline = None
+    if os.environ.get("KC_BENCH_PIPELINE", "1") != "0":
+        try:
+            pipeline = pipeline_line(
+                n_pods=int(os.environ.get("KC_BENCH_PIPELINE_PODS", "100000")),
+                n_its=int(os.environ.get("KC_BENCH_PIPELINE_ITS", "2000")),
+                churn_fraction=float(
+                    os.environ.get("KC_BENCH_PIPELINE_FRACTION", "0.02")
+                ),
+                ticks=int(os.environ.get("KC_BENCH_PIPELINE_TICKS", "6")),
+            )
+        except Exception as e:  # noqa: BLE001 - pipeline line never kills the headline
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            pipeline = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # policy objective: the cheapest-fleet-vs-first-fit delta and the warm
     # cost of the scoring stage on a skewed-price demo fleet
     # (docs/POLICY.md); KC_BENCH_POLICY=0 skips.
@@ -1159,6 +1368,15 @@ def main() -> None:
         detail["churn_speedup"] = churn["speedup"]
         # per-tick membership-delta ingest (O(churned) acceptance, ISSUE 11)
         detail["churn_delta_ingest_s"] = churn["delta_ingest_s"]
+    detail["pipeline"] = pipeline
+    if pipeline and "error" not in pipeline:
+        # stage mirrors so tools/perfgate.py gates the pipelined warm tick
+        # independently; report_pipeline reads the efficiency + parity
+        detail["pipeline_warm_tick_s"] = pipeline["pipelined_tick_s"]
+        detail["pipeline_serial_tick_s"] = pipeline["serial_tick_s"]
+        detail["pipeline_speedup"] = pipeline["speedup"]
+        detail["pipeline_overlap_efficiency"] = pipeline["overlap_efficiency"]
+        detail["pipeline_donation_reallocs"] = pipeline["donation_reallocs"]
     detail["policy"] = policy
     if policy and "error" not in policy:
         # stage mirror for the perfgate objective_s gate + the acceptance

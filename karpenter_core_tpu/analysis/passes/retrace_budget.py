@@ -27,6 +27,21 @@ is a distinct production bug:
                     wrapper with an empty jit cache, so every call retraces
                     (the bug class ops.consolidate._lane_sweep_fn's
                     docstring describes)
+  donated-read      a buffer passed to a donating dispatch site is read
+                    again afterwards in the same function — the classic
+                    use-after-donate footgun of the pipelined solve loop
+                    (docs/KERNEL_PERF.md "Layer 7"): the executable consumed
+                    the device memory, so the read either raises
+                    "buffer deleted" or (with a live host view) silently
+                    degrades donation to a realloc.  Donating sites are
+                    (a) calls whose callee name ends in ``_donated``
+                    (ops.solve.repair_free_donated / scatter_repair_window
+                    _donated — by convention their FIRST positional
+                    argument is donated) and (b) ``run_prepared`` /
+                    ``run_solve`` calls with a ``warm_carry=`` keyword (the
+                    carry is donated whenever the pipeline is armed).
+                    Branch-aware: donation inside one arm of an if/else
+                    taints only that arm and the code after the branch.
 
 The runtime half of this pass lives in tests/conftest.py: a fixture counts
 actual XLA compilations per tier-1 test against the checked-in manifest
@@ -125,6 +140,142 @@ def _mesh_derives_from_params(mesh_expr: ast.expr, fn: ast.AST) -> bool:
         if len(hits) == 1 and names_of(hits[0]) & params:
             return True
     return False
+
+
+# donating dispatch sites for the donated-read rule: callees whose
+# ``warm_carry=`` keyword argument is donated when the pipeline is armed
+# (utils.compilecache.run_solve / solver.tpu.TPUSolver.run_prepared), plus
+# the ``*_donated`` helper convention (first positional argument donated —
+# ops/solve.py repair_free_donated / scatter_repair_window_donated)
+_DONATING_CALLEES = {"run_prepared", "run_solve"}
+
+
+def _call_donations(node: ast.Call) -> List[str]:
+    """Plain names this call donates, per the donating-site conventions."""
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else ""
+    )
+    out: List[str] = []
+    if name.endswith("_donated"):
+        if node.args and isinstance(node.args[0], ast.Name):
+            out.append(node.args[0].id)
+    elif name in _DONATING_CALLEES:
+        for kw in node.keywords:
+            if kw.arg == "warm_carry" and isinstance(kw.value, ast.Name):
+                out.append(kw.value.id)
+    return out
+
+
+def _donated_read_findings(module: SourceModule) -> List[Finding]:
+    """The donated-read rule (module docstring): an intra-procedural,
+    branch-aware walk flagging reads of a name after the dispatch that
+    donated its buffer.  Rebinding the name clears the taint (``carry =
+    repair_free_donated(carry, ...)`` is the intended idiom — the name then
+    holds the dispatch's OUTPUT, not the consumed input).  Aliased callees
+    (``fn = x_donated; fn(...)``) are not chased — the rule is a tripwire
+    for the direct spellings the solve path uses, not an escape-proof
+    dataflow analysis."""
+    findings: List[Finding] = []
+
+    def flag(name: str, read_line: int, donate_line: int, qual: str) -> None:
+        findings.append(Finding(
+            module.relpath, read_line, "donated-read",
+            f"{name!r} is read after being donated to the dispatch at line "
+            f"{donate_line} — the executable consumed its device buffer; "
+            "use the dispatch's returned value, or keep an undonated "
+            "reference taken before the call",
+            NAME, symbol=qual,
+        ))
+
+    def check_reads(node: ast.AST, donated: Dict[str, int], qual: str) -> None:
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Name)
+                and isinstance(sub.ctx, ast.Load)
+                and sub.id in donated
+            ):
+                flag(sub.id, sub.lineno, donated[sub.id], qual)
+                donated.pop(sub.id, None)  # one finding per donation
+
+    def register(node: ast.AST, donated: Dict[str, int]) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                for nm in _call_donations(sub):
+                    donated[nm] = sub.lineno
+
+    def clear_binds(targets, donated: Dict[str, int]) -> None:
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    donated.pop(sub.id, None)
+
+    def scan(stmts, donated: Dict[str, int], qual: str) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested defs get their own fresh scope below
+            if isinstance(stmt, ast.If):
+                check_reads(stmt.test, donated, qual)
+                register(stmt.test, donated)
+                body_d, else_d = dict(donated), dict(donated)
+                scan(stmt.body, body_d, qual)
+                scan(stmt.orelse, else_d, qual)
+                donated.clear()
+                donated.update(body_d)
+                donated.update(else_d)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                head = stmt.iter if hasattr(stmt, "iter") else stmt.test
+                check_reads(head, donated, qual)
+                register(head, donated)
+                if hasattr(stmt, "target"):
+                    clear_binds([stmt.target], donated)
+                body_d = dict(donated)
+                scan(stmt.body, body_d, qual)
+                scan(stmt.orelse, body_d, qual)
+                donated.update(body_d)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    check_reads(item.context_expr, donated, qual)
+                    register(item.context_expr, donated)
+                    if item.optional_vars is not None:
+                        clear_binds([item.optional_vars], donated)
+                scan(stmt.body, donated, qual)
+                continue
+            if isinstance(stmt, ast.Try):
+                scan(stmt.body, donated, qual)
+                for handler in stmt.handlers:
+                    h_d = dict(donated)
+                    scan(handler.body, h_d, qual)
+                    donated.update(h_d)
+                scan(stmt.orelse, donated, qual)
+                scan(stmt.finalbody, donated, qual)
+                continue
+            # simple statement: reads first (the donating call's own
+            # argument is not yet tainted), then new donations, then
+            # rebound targets drop their taint
+            check_reads(stmt, donated, qual)
+            register(stmt, donated)
+            if isinstance(stmt, ast.Assign):
+                clear_binds(stmt.targets, donated)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                clear_binds([stmt.target], donated)
+
+    def walk_fns(node: ast.AST, qual: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = ".".join(qual + [child.name])
+                scan(child.body, {}, q)
+                walk_fns(child, qual + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                walk_fns(child, qual + [child.name])
+            else:
+                walk_fns(child, qual)
+
+    walk_fns(module.tree, [])
+    return findings
 
 
 def _fn_index(module: SourceModule) -> Dict[str, ast.AST]:
@@ -240,6 +391,9 @@ def run(project: Project) -> List[Finding]:
     for module in project.package_modules:
         imports = import_map(module.tree)
         fn_index = _fn_index(module)
+        # use-after-donate tripwire for the pipelined loop's donating
+        # dispatch sites (docs/KERNEL_PERF.md "Layer 7")
+        findings.extend(_donated_read_findings(module))
         sites = find_jit_sites(module)
         for site in sites:
             statics = tuple(site.static_argnames or ())

@@ -290,6 +290,14 @@ class ProvisioningController:
         # the soak runner's advisory ``ingest_s`` probe reads this
         # (soak/slo.py; docs/KERNEL_PERF.md "Layer 6")
         self.last_ingest_s: float = 0.0
+        # hidden device→host fetch wall of the last kernel solve (the
+        # ``pipeline.overlap`` record, utils.pipeline): seconds of copy the
+        # loop spent doing other work instead of blocking.  The soak
+        # runner's advisory ``tick_overlap_s`` probe reads this; ≈0 on this
+        # controller's serial per-reconcile path, >0 when a pipelined loop
+        # (bench pipeline_line, deferred session ticks) drove the solve
+        # (docs/KERNEL_PERF.md "Layer 7")
+        self.last_overlap_s: float = 0.0
         # persistent signature/ladder interner: watch events become
         # membership deltas — a pod shape seen in ANY previous batch never
         # pays signature derivation or ladder construction again
@@ -765,7 +773,13 @@ class ProvisioningController:
                 policy=FallbackPolicy.from_env(materialized=True)
             )
         session.rebind(solver)
-        return session.solve(tpu_classes, state_nodes, bound_pods)
+        results = session.solve(tpu_classes, state_nodes, bound_pods)
+        # surface the solve's hidden-fetch wall for the soak runner's
+        # advisory ``tick_overlap_s`` probe (utils.pipeline, docs/SOAK.md)
+        from karpenter_core_tpu.utils import pipeline as pipeline_mod
+
+        self.last_overlap_s = pipeline_mod.last_overlap().get("hidden_s", 0.0)
+        return results
 
     def _solve_remote(self, solver, tpu_classes, tpu_pods, state_nodes,
                       daemonset_pods, provisioners, bound_pods):

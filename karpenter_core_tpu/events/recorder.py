@@ -7,6 +7,7 @@ event types may carry their own token-bucket rate limiter.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -62,33 +63,43 @@ class Recorder:
         self._seen: Dict[tuple, float] = {}
         self._limiters: Dict[str, _TokenBucket] = {}
         self.events: List[Event] = []
+        self._lock = threading.Lock()
 
     # retain at most this many events for test inspection; older are dropped
     MAX_RETAINED_EVENTS = 10_000
 
     def publish(self, event: Event) -> None:
+        # publishers are concurrent (launch_machines fans out over a thread
+        # pool): the dedupe map, limiter registry, and retained-event list
+        # mutate under one lock — the 100k sharded soak's launch storms
+        # crashed the unlocked sweep with "dictionary changed size during
+        # iteration".  The sink call stays OUTSIDE the lock (it is arbitrary
+        # user code and may publish re-entrantly).
         key = event.dedupe_key()
         now = self.clock()
-        last = self._seen.get(key)
-        if last is not None and now - last < DEDUPE_TTL_SECONDS:
-            return
-        if event.rate_limit_qps is not None:
-            limiter = self._limiters.setdefault(
-                event.reason, _TokenBucket(event.rate_limit_qps, clock=self.clock)
-            )
-            if not limiter.allow():
+        with self._lock:
+            last = self._seen.get(key)
+            if last is not None and now - last < DEDUPE_TTL_SECONDS:
                 return
-        self._seen[key] = now
-        self._expire(now)
-        self.events.append(event)
-        if len(self.events) > self.MAX_RETAINED_EVENTS:
-            del self.events[: len(self.events) - self.MAX_RETAINED_EVENTS]
+            if event.rate_limit_qps is not None:
+                limiter = self._limiters.setdefault(
+                    event.reason,
+                    _TokenBucket(event.rate_limit_qps, clock=self.clock),
+                )
+                if not limiter.allow():
+                    return
+            self._seen[key] = now
+            self._expire(now)
+            self.events.append(event)
+            if len(self.events) > self.MAX_RETAINED_EVENTS:
+                del self.events[: len(self.events) - self.MAX_RETAINED_EVENTS]
         if self.sink is not None:
             self.sink(event)
 
     def _expire(self, now: float) -> None:
         """Evict dedupe entries past the TTL (the reference uses a 120s TTL
-        cache with a janitor; we sweep opportunistically on publish)."""
+        cache with a janitor; we sweep opportunistically on publish).
+        Caller holds ``_lock``."""
         if len(self._seen) < 1024:
             return
         expired = [k for k, ts in self._seen.items() if now - ts >= DEDUPE_TTL_SECONDS]
@@ -96,5 +107,6 @@ class Recorder:
             del self._seen[k]
 
     def reset(self) -> None:
-        self.events.clear()
-        self._seen.clear()
+        with self._lock:
+            self.events.clear()
+            self._seen.clear()

@@ -1957,8 +1957,7 @@ def warm_carry_of(outputs: SolveOutputs) -> Optional[WarmCarry]:
     )
 
 
-@jax.jit
-def repair_free(
+def _repair_free_impl(
     warm_carry: WarmCarry,
     free_new: jnp.ndarray,
     free_ex: jnp.ndarray,
@@ -2002,6 +2001,15 @@ def repair_free(
         inv_new=jnp.maximum(topo.inv_new - jnp.einsum("cg,cn->gn", own_inv, free_new), 0),
     )
     return WarmCarry(state=state, ex_state=ex, topo=topo, remaining=wc.remaining)
+
+
+repair_free = jax.jit(_repair_free_impl)
+# the pipelined loop's twin (utils.pipeline.donation_enabled): the input
+# carry's device buffers are DONATED — steady-state churn frees evictions in
+# place instead of reallocating the full-width planes every tick.  The caller
+# contract matches the donated-read analysis rule (docs/ANALYSIS.md): the
+# first positional argument must never be read after this call.
+repair_free_donated = jax.jit(_repair_free_impl, donate_argnums=(0,))
 
 
 @jax.jit
@@ -2059,8 +2067,7 @@ def gather_repair_window(warm_carry: WarmCarry, idx: jnp.ndarray, n_open_w):
     )
 
 
-@jax.jit
-def scatter_repair_window(
+def _scatter_repair_window_impl(
     warm_carry: WarmCarry, window_carry: WarmCarry, idx: jnp.ndarray, n_open_w
 ) -> WarmCarry:
     """Write a windowed repair's final carry back over the full-width carry:
@@ -2097,6 +2104,17 @@ def scatter_repair_window(
     )
     return WarmCarry(state=state, ex_state=ww.ex_state, topo=topo,
                      remaining=ww.remaining)
+
+
+scatter_repair_window = jax.jit(_scatter_repair_window_impl)
+# donating twin (utils.pipeline): the FULL-WIDTH carry (first positional
+# argument) is donated — the scatter writes the window back into the same
+# device memory.  The window carry is NOT donated: its state planes are the
+# repair outputs the (possibly still pending) decode reads.  Same caller
+# contract as repair_free_donated: never read arg 0 after this call.
+scatter_repair_window_donated = jax.jit(
+    _scatter_repair_window_impl, donate_argnums=(0,)
+)
 
 
 @jax.jit

@@ -281,7 +281,8 @@ def mesh_for(mesh_axes: Tuple[Tuple[str, int], ...]) -> Mesh:
     return Mesh(np.array(devices[:total]).reshape(sizes), names)
 
 
-def sharded_solve_callable(mesh_axes, base_with_axis, base_plain, structs):
+def sharded_solve_callable(mesh_axes, base_with_axis, base_plain, structs,
+                           donate_argnums=()):
     """jit(shard_map(...)) over the solve pytrees for one mesh topology.
 
     ``base_with_axis`` is the solve_core partial with
@@ -289,7 +290,13 @@ def sharded_solve_callable(mesh_axes, base_with_axis, base_plain, structs):
     axis-free twin used only to eval_shape the output structure (outside the
     mesh no axis name is bound).  ``structs`` are the positional arg pytrees
     (ShapeDtypeStructs or arrays).  Returns the jitted callable; the caller
-    memoizes (utils.compilecache keys it by topology + leaf signatures)."""
+    memoizes (utils.compilecache keys it by topology + leaf signatures).
+
+    ``donate_argnums`` threads buffer donation through the sharded build —
+    the pipelined loop's warm-carry variant (utils.pipeline) donates the
+    carry argument so mesh-sharded churn repairs reuse the carry's sharded
+    device buffers in place; the sharding layout is unchanged (the carry's
+    partition specs cover inputs AND outputs, CATALOG_PARTITION_RULES)."""
     mesh = mesh_for(mesh_axes)
     in_specs = tuple(partition_specs(s) for s in structs)
     out_specs = partition_specs(jax.eval_shape(base_plain, *structs))
@@ -301,7 +308,7 @@ def sharded_solve_callable(mesh_axes, base_with_axis, base_plain, structs):
         # so the static claim stands in for it — the mesh parity fuzz
         # (tests/test_mesh_dispatch.py) pins the guarantee at runtime
         check_rep=False,
-    ))
+    ), donate_argnums=tuple(donate_argnums))
 
 
 def default_mesh(n_devices: Optional[int] = None, axis: str = "replica") -> Mesh:

@@ -61,6 +61,7 @@ import numpy as np
 
 from karpenter_core_tpu import tracing
 from karpenter_core_tpu.metrics import REGISTRY
+from karpenter_core_tpu.utils import pipeline as pipeline_mod
 from karpenter_core_tpu.utils import retry
 from karpenter_core_tpu.utils.clock import Clock
 
@@ -408,8 +409,13 @@ class BatchCoalescer:
                 ]
             # ONE batched fetch of the stacked outputs, sliced per tenant on
             # the host: decode consumes every plane anyway, and host slicing
-            # avoids compiling a per-leaf-per-index gather op on device
-            outs = jax.device_get(fn(*args))
+            # avoids compiling a per-leaf-per-index gather op on device.
+            # The fetch goes through the pipeline helper — async copies on
+            # every leaf first, then one device_get — so the NEXT coalesced
+            # group's dispatch (another worker thread) overlaps this group's
+            # device→host copy instead of queueing behind per-array blocking
+            # transfers (docs/KERNEL_PERF.md "Layer 7").
+            outs = pipeline_mod.fetch_tree(fn(*args))
             return [
                 jax.tree_util.tree_map(lambda a, i=i: a[i], outs)
                 for i in range(len(preps))

@@ -219,6 +219,11 @@ class SoakRunner:
             # per-batch host ingest/classification wall: the advisory probe
             # that keeps the delta-native encode path honest under soak
             ingest_s=getattr(env.provisioning, "last_ingest_s", 0.0) or 0.0,
+            # hidden device→host fetch wall of the last kernel solve (the
+            # pipelined-loop overlap record, utils.pipeline; wall-clock-only
+            # so it rides off the replay digest like tick_wall_s)
+            tick_overlap_s=getattr(env.provisioning, "last_overlap_s", 0.0)
+            or 0.0,
         )
 
     # -- the run ---------------------------------------------------------------

@@ -67,6 +67,14 @@ PROBES: Dict[str, bool] = {
     # budget the delta-native ingest keeps flat while the fleet grows
     # (docs/KERNEL_PERF.md "Layer 6").  Wall-clock ⇒ advisory.
     "ingest_s": False,
+    # hidden device→host fetch wall of the last kernel solve
+    # (ProvisioningController.last_overlap_s — the utils.pipeline
+    # ``pipeline.overlap`` record): copy seconds the pipelined loop spent
+    # doing other work instead of blocking.  ≈0 on the serial controller
+    # path; the overlap win itself is wall-clock-only, so this rides OFF
+    # the replay digest exactly like tick_wall_s (docs/KERNEL_PERF.md
+    # "Layer 7").  Wall-clock ⇒ advisory.
+    "tick_overlap_s": False,
 }
 
 AGG_MAX = "max"
@@ -99,6 +107,7 @@ class Observation:
     solve_latency_s: float = 0.0  # wall seconds (advisory)
     tick_wall_s: float = 0.0  # whole-tick wall seconds (advisory)
     ingest_s: float = 0.0  # last batch's host ingest/classify wall (advisory)
+    tick_overlap_s: float = 0.0  # hidden fetch wall of the last solve (advisory)
 
     def probe_values(self) -> Dict[str, float]:
         return {
@@ -112,6 +121,7 @@ class Observation:
             "solve_latency_s": self.solve_latency_s,
             "tick_wall_s": self.tick_wall_s,
             "ingest_s": self.ingest_s,
+            "tick_overlap_s": self.tick_overlap_s,
         }
 
 

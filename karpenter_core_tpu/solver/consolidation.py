@@ -207,6 +207,7 @@ class TPUConsolidationSearch:
         # recompile (docs/KERNEL_PERF.md "Layer 5")
         from karpenter_core_tpu import tracing
         from karpenter_core_tpu.parallel import mesh as mesh_mod
+        from karpenter_core_tpu.utils import pipeline as pipeline_mod
 
         mesh_axes = mesh_mod.lane_mesh_axes()
         with tracing.span(
@@ -217,6 +218,11 @@ class TPUConsolidationSearch:
                 snapshot, ex_state, ex_static, rank, ex_cls_count, sizes,
                 mesh_axes=mesh_axes,
             )
+            # ONE batched device→host fetch of every sweep plane (async
+            # copies started up front) instead of eight serial np.asarray
+            # transfers — the coarse sweep's fetch no longer serializes
+            # array-by-array ahead of the refine sweep's dispatch
+            out = pipeline_mod.fetch_tree(out)  # structure-preserving
         n_new = np.asarray(out.n_new)
         failed = np.asarray(out.failed)
         uninit = np.asarray(out.used_uninitialized)

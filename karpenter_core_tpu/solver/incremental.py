@@ -49,6 +49,7 @@ from karpenter_core_tpu.models.store import (
     diff_members,
 )
 from karpenter_core_tpu.ops import solve as solve_ops
+from karpenter_core_tpu.utils import pipeline as pipeline_mod
 
 log = logging.getLogger(__name__)
 
@@ -168,11 +169,79 @@ class _WarmState:
     materialized: set = field(default_factory=set)
 
 
+@dataclass
+class _PendingTick:
+    """One dispatched-but-unsettled deferred tick (the pipeline's in-flight
+    slot).  ``kind`` is "delta" (a repair: ``data`` holds the dispatch
+    record, the post-tick membership, and the captured population snapshot a
+    settle-time window/slot exhaustion re-anchors from) or "full" (an
+    anchor: ``data`` holds the committed snapshot, the prep, the device
+    outputs, and the fetch ticket whose copies are already in flight)."""
+
+    kind: str  # "delta" | "full"
+    box: "PendingResults"
+    data: dict
+
+
+class PendingResults:
+    """Deferred TPUSolveResults handle (``solve(deferred=True)``).
+
+    ``result()`` settles the session's pending tick if it still is pending
+    (the completion barrier), then materializes the decode — by the time the
+    canonical double-buffered loop calls it, the barrier already ran at the
+    next solve's entry and only host materialize is left, overlapped with
+    that solve's device compute.  Safe to call any number of times; raises
+    whatever the tick's settle raised."""
+
+    __slots__ = ("_session", "_results", "_error", "_decode", "_settled")
+
+    def __init__(self, session, results=None, error=None) -> None:
+        self._session = session
+        self._results = results
+        self._error = error
+        self._decode = None  # set at settle for delta ticks
+        self._settled = results is not None or error is not None
+
+    def _settle_with(self, results=None, error=None, decode=None) -> None:
+        self._results = results
+        self._error = error
+        self._decode = decode
+        self._settled = True
+
+    def done(self) -> bool:
+        return self._settled
+
+    def result(self):
+        if not self._settled:
+            self._session.settle()
+        if self._error is not None:
+            raise self._error
+        if self._results is None and self._decode is not None:
+            decode, self._decode = self._decode, None
+            try:
+                self._results = decode()
+            except BaseException as e:  # noqa: BLE001 - cached, then raised
+                # record the failure so every later result() re-raises it
+                # instead of silently returning None
+                self._error = e
+                raise
+        return self._results
+
+
 class IncrementalSolveSession:
     """One warm-start solve lineage: full solves adopt state, delta solves
     repair it.  Bind a fresh TPUSolver each reconcile via ``rebind`` (the
     controller rebuilds its solver per batch); the session survives as long
-    as the fallback policy keeps judging deltas safe."""
+    as the fallback policy keeps judging deltas safe.
+
+    ``solve(..., deferred=True)`` runs the tick through the double-buffered
+    pipeline (docs/KERNEL_PERF.md "Layer 7"): the repair dispatches and the
+    call returns a PendingResults immediately; the completion barrier,
+    bookkeeping, and decode settle at the NEXT solve's entry (or at
+    ``result()``), so the next tick's planning and the previous tick's host
+    materialize overlap this tick's device compute and device→host copy.
+    ``KC_PIPELINE=0`` makes deferred calls settle inline — the serial loop
+    exactly."""
 
     def __init__(self, solver=None, policy: Optional[FallbackPolicy] = None,
                  run_prepared=None) -> None:
@@ -191,12 +260,25 @@ class IncrementalSolveSession:
         # (whose warm carry is lineage-private) always dispatch solo
         self._run_prepared = run_prepared
         self._forced_reason: Optional[str] = None
+        # pipelined-loop state: the in-flight deferred tick, the two-deep
+        # ring of reusable host staging buffers its fetches land in, and the
+        # last settled-but-undecoded box (materialized before its staging
+        # slot can be rewritten)
+        self._pending: Optional[_PendingTick] = None
+        self._staging = None
+        self._undecoded: Optional[PendingResults] = None
 
     def rebind(self, solver) -> None:
         self.solver = solver
 
     def reset(self) -> None:
-        """Drop the warm lineage (next solve is full)."""
+        """Drop the warm lineage (next solve is full).  A pending deferred
+        tick settles first so its handle stays consumable."""
+        if self._pending is not None:
+            try:
+                self.settle()
+            except Exception:  # noqa: BLE001 - the handle carries the error
+                pass
         self._warm = None
 
     def force_full(self, reason: str) -> None:
@@ -212,6 +294,7 @@ class IncrementalSolveSession:
         """The warm lineage's snapshot-store version (0 = no lineage) — what
         the tenant protocol echoes to clients so a restarted server is
         detectable (docs/SERVICE.md)."""
+        self.settle()
         if self._warm is None:
             return 0
         return int(self._warm.versioned.version)
@@ -227,6 +310,7 @@ class IncrementalSolveSession:
         construction), and the placement signature canonicalizes its class
         keys through models.store.stable_digest because they hold frozensets
         whose raw repr order is hash-randomized."""
+        self.settle()
         w = self._warm
         if w is None:
             return {"version": 0}
@@ -270,14 +354,28 @@ class IncrementalSolveSession:
         pods_or_classes,
         state_nodes: Optional[list] = None,
         bound_pods: Optional[list] = None,
+        deferred: bool = False,
     ):
         """TPUSolveResults for the current population.  Full reconciles see
         the whole picture (every node decision); delta reconciles return only
         this tick's placements (new pods onto new/existing capacity), which
         is exactly what the controller needs to act on.  Raises
-        models.snapshot.KernelUnsupported exactly like TPUSolver.solve."""
+        models.snapshot.KernelUnsupported exactly like TPUSolver.solve.
+
+        ``deferred=True`` returns a PendingResults handle instead of
+        results: delta ticks dispatch and settle at the NEXT solve call (the
+        pipelined loop — class docstring); full solves settle inline and the
+        handle is immediately consumable.  With KC_PIPELINE=0 the handle is
+        always settled inline — the serial loop bit-for-bit."""
         from karpenter_core_tpu.solver.backendprobe import SOLVER_DISPATCH
 
+        # settle the in-flight deferred tick FIRST: this tick's membership
+        # diff and eviction plan read the bookkeeping that tick rewrites
+        self.settle()
+        # ``deferred`` shapes the RETURN TYPE (a handle); ``pipelined``
+        # whether the tick actually stays in flight — KC_PIPELINE=0 settles
+        # inline, so the handle is just the serial results in a box
+        pipelined = deferred and pipeline_mod.pipeline_enabled()
         members, by_uid, classes = self._members_of(pods_or_classes)
         if self._warm is not None:
             self._absorb_bound({p.uid for p in (bound_pods or [])})
@@ -349,7 +447,21 @@ class IncrementalSolveSession:
                 raise RuntimeError(fault.describe())
 
             with tracing.span("solve.incremental") as sp:
-                if mode == MODE_DELTA:
+                if mode == MODE_DELTA and pipelined:
+                    handle = self._delta_dispatch_deferred(
+                        delta, by_uid,
+                        pods_or_classes if classes is None else classes,
+                        members, state_nodes, bound_pods, supply_anchor,
+                    )
+                    if handle is not None:
+                        sp.set(**{"solve.mode": mode,
+                                  "solve.mode.reason": reason,
+                                  "solve.deferred": True})
+                        # mode accounting waits for the settle — a window
+                        # exhaustion discovered there escalates to full
+                        return handle
+                    mode, reason = MODE_FULL, "slots-exhausted"
+                elif mode == MODE_DELTA:
                     results = self._delta_solve(delta, by_uid, state_nodes)
                     if results is None:  # repair ran out of room: escalate
                         mode, reason = MODE_FULL, "slots-exhausted"
@@ -357,7 +469,14 @@ class IncrementalSolveSession:
                     results = self._full_solve(
                         pods_or_classes if classes is None else classes,
                         members, state_nodes, bound_pods, supply_anchor, reason,
+                        deferred=pipelined,
                     )
+                    if isinstance(results, PendingResults):
+                        sp.set(**{"solve.mode": mode,
+                                  "solve.mode.reason": reason,
+                                  "solve.deferred": True})
+                        # mode accounting waits for the settle
+                        return results
                 sp.set(**{"solve.mode": mode, "solve.mode.reason": reason})
         except Exception:
             if forced is not None:
@@ -370,6 +489,8 @@ class IncrementalSolveSession:
         SOLVE_MODE.labels(mode).inc()
         self.last_mode, self.last_reason = mode, reason
         self.mode_counts[mode] = self.mode_counts.get(mode, 0) + 1
+        if deferred:
+            return PendingResults(self, results=results)
         return results
 
     def _absorb_bound(self, bound_uids) -> None:
@@ -402,7 +523,7 @@ class IncrementalSolveSession:
     # -- full path -------------------------------------------------------------
 
     def _full_solve(self, pods_or_classes, members, state_nodes, bound_pods,
-                    supply, reason):
+                    supply, reason, deferred: bool = False):
         import jax
 
         solver = self.solver
@@ -418,6 +539,26 @@ class IncrementalSolveSession:
             prep = solver.prepare_encoded(snapshot, state_nodes, bound_pods)
             run = self._run_prepared or solver.run_prepared
             outputs = run(prep)
+            if deferred and pipeline_mod.pipeline_enabled():
+                # the pipelined anchor: the encode/commit/prepare above ran
+                # host-side; the device solve is in flight — settle (barrier,
+                # slot-exhaustion retry, adoption) waits for the next solve's
+                # entry and decode for the handle, both overlapping this
+                # solve's device compute
+                if self._staging is None:
+                    self._staging = pipeline_mod.HostStagingRing()
+                ticket = solver.begin_fetch(outputs, ring=self._staging)
+                box = PendingResults(self)
+                self._pending = _PendingTick(
+                    kind="full", box=box, data=dict(
+                        snapshot=snapshot, versioned=versioned, prep=prep,
+                        run=run, outputs=outputs, ticket=ticket,
+                        members=dict(members), supply=supply,
+                        state_nodes=list(state_nodes or ()),
+                        prev_nodes=prev_nodes, reason=reason, solver=solver,
+                    ),
+                )
+                return box
             n_next_h, failed_h = jax.device_get(
                 (outputs.state.n_next, outputs.failed)
             )
@@ -431,6 +572,29 @@ class IncrementalSolveSession:
         self._adopt(versioned, prep, outputs, results, members, supply,
                     state_nodes, prev_nodes, reason)
         return results
+
+    def _settle_full(self, pending: _PendingTick) -> None:
+        """Retire a deferred anchor: completion barrier, the slot-exhaustion
+        retry (synchronous, rare), adoption; decode stays deferred to the
+        handle's ``result()``."""
+        f = pending.data
+        from karpenter_core_tpu.solver.tpu import TPUSolver
+
+        fetched = f["ticket"].wait()
+        slots = f["outputs"].assign.shape[1]
+        if TPUSolver.fetch_exhausted(fetched, slots):
+            outputs = f["run"](f["prep"], n_slots=slots * 2)
+            ticket = f["solver"].begin_fetch(outputs, ring=self._staging)
+            ticket.wait()
+            f["outputs"], f["ticket"] = outputs, ticket
+        self._adopt(
+            f["versioned"], f["prep"], f["outputs"], None, f["members"],
+            f["supply"], f["state_nodes"], f["prev_nodes"], f["reason"],
+        )
+        pending.box._settle_with(decode=lambda: f["solver"].decode(
+            f["snapshot"], f["outputs"], f["state_nodes"], fetched=f["ticket"]
+        ))
+        self._undecoded = pending.box
 
     def _adopt(self, versioned, prep, outputs, results, members, supply,
                state_nodes, prev_nodes, reason):
@@ -449,6 +613,12 @@ class IncrementalSolveSession:
         }
         failed_pods = {uid: (row, all_pods[uid]) for uid, row in unplaced}
         member_rows, own_inv_rows = _topology_rows(prep)
+        if pipeline_mod.pipeline_enabled():
+            # upload the padded planes ONCE: every repair in this lineage
+            # then re-dispatches over the same device buffers — only the
+            # per-tick count vector crosses the host→device boundary again
+            # (KC_PIPELINE=0 keeps the old re-upload-per-tick path)
+            prep = self.solver.upload_prep(prep)
         index = versioned.index_of()
         row_key = {i: row.key for i, row in enumerate(versioned.rows)}
         self.last_audit_drift_nodes = None
@@ -483,12 +653,21 @@ class IncrementalSolveSession:
             self._warm = None  # outputs predate the carry fields
 
     # -- delta path ------------------------------------------------------------
+    #
+    # One delta tick is four stages — plan (host), dispatch (device, async),
+    # settle (completion barrier + bookkeeping), decode (host materialize).
+    # The serial path (_delta_solve) runs them back to back in the exact
+    # pre-pipeline order; the deferred path (_delta_dispatch_deferred) stops
+    # after dispatch and settles at the next solve's entry, so the stages of
+    # consecutive ticks overlap (docs/KERNEL_PERF.md "Layer 7").
 
-    def _delta_solve(self, delta, by_uid, state_nodes):
-        import jax
-
+    def _delta_plan(self, delta, by_uid):
+        """The host-side tick plan: eviction free planes, the delta count
+        vector, and the post-tick membership.  None when an unseen class key
+        means the padded tensors cannot express the delta (caller escalates
+        to a full solve)."""
         w = self._warm
-        c_pad = np.asarray(w.prep.cls.count).shape[0]
+        c_pad = w.prep.cls.count.shape[0]  # shape read only: may be device
         n_slots = w.assign.shape[1]
         e_pad = w.assign_ex.shape[1]
 
@@ -504,13 +683,6 @@ class IncrementalSolveSession:
                 row, kind, idx = loc
                 (free_new if kind == "new" else free_ex)[row, idx] += 1
                 evicted_locs.append((uid, loc))
-        carry = w.carry
-        if evicted_locs:
-            carry = solve_ops.repair_free(
-                carry, free_new, free_ex,
-                np.asarray(w.prep.cls.requests, dtype=np.float32),
-                w.member_rows, w.own_inv_rows,
-            )
 
         # additions (+ retry of previously-failed pods): a count vector with
         # only the delta, scanned over the SAME padded tensors
@@ -532,6 +704,42 @@ class IncrementalSolveSession:
         for row, pods in pods_by_root.items():
             counts[row] = len(pods)
 
+        # membership after this tick lands: previous minus evicted plus added
+        members = {k: list(v) for k, v in w.members.items()}
+        for key, uids in delta.evicted.items():
+            gone = set(uids)
+            if key in members:
+                members[key] = [u for u in members[key] if u not in gone]
+        for key, uids in delta.added.items():
+            members.setdefault(key, []).extend(uids)
+        members_after = {k: tuple(v) for k, v in members.items() if v}
+        return {
+            "delta": delta, "free_new": free_new, "free_ex": free_ex,
+            "evicted_locs": evicted_locs, "pods_by_root": pods_by_root,
+            "counts": counts, "members_after": members_after,
+        }
+
+    def _delta_dispatch(self, plan):
+        """Dispatch the repair onto the device (asynchronously) and start
+        its device→host fetch.  Warm dispatches donate the carry when the
+        pipeline is armed (utils.pipeline): the pre-dispatch carry is dead
+        after this call — only ``keep_carry`` (the full-width carry of a
+        WINDOWED repair, which the settle's scatter consumes) may be read
+        again, and an exception anywhere past the donating call drops the
+        lineage (the except below and its twins in _delta_solve/settle):
+        a kept ``_warm`` pointing at a donated buffer would turn one
+        transient fault into a crash loop on every later repair."""
+        w = self._warm
+        free_new, free_ex = plan["free_new"], plan["free_ex"]
+        evicted_locs, counts = plan["evicted_locs"], plan["counts"]
+        n_slots = w.assign.shape[1]
+        donate = pipeline_mod.donation_enabled() and not (
+            self.solver.policy is not None
+            and getattr(self.solver.policy, "enabled", False)
+        )
+        carry = w.carry
+        donated = False
+
         # bounded repair window (docs/INCREMENTAL.md): gather the dirty slots
         # — freed holes plus a fresh tail — into a fixed power-of-two window
         # so the repair's per-class-step cost scales with the dirty region,
@@ -540,62 +748,123 @@ class IncrementalSolveSession:
         # falling back to the normal order, so steady-state churn keeps the
         # lineage's assignments identical to a from-scratch solve.
         g1 = w.member_rows.shape[1]
-        n_zones = np.asarray(w.prep.statics_arrays.tmpl_zone).shape[1]
+        n_zones = w.prep.statics_arrays.tmpl_zone.shape[1]
         hole_slots = sorted({loc[2] for _, loc in evicted_locs if loc[1] == "new"})
         window = _window_indices(hole_slots, w.n_next, n_slots)
-        if window is not None:
-            idx, n_open_w = window
-            win_carry, base = solve_ops.gather_repair_window(
-                carry, idx, np.int32(n_open_w)
-            )
-            plan = solve_ops.RepairPlan(
-                pref_new=free_new[:, idx],
-                pref_ex=free_ex,
-                base_fwd_sing=base[0],
-                base_fwd_full=base[1],
-                base_inv_full=base[2],
-            )
-            outputs = self.solver.run_prepared(
-                w.prep, count=counts, warm_carry=win_carry, repair_plan=plan,
-                n_slots=len(idx),
-            )
-        else:
-            zeros_gz = np.zeros((g1, n_zones), dtype=np.int32)
-            plan = solve_ops.RepairPlan(
-                pref_new=free_new, pref_ex=free_ex,
-                base_fwd_sing=zeros_gz, base_fwd_full=zeros_gz,
-                base_inv_full=zeros_gz,
-            )
-            outputs = self.solver.run_prepared(
-                w.prep, count=counts, warm_carry=carry, repair_plan=plan
-            )
-        assign_d, assign_ex_d, failed_d, n_next_h = jax.device_get(
-            (outputs.assign, outputs.assign_existing, outputs.failed,
-             outputs.state.n_next)
+        try:
+            if evicted_locs:
+                free_fn = (
+                    solve_ops.repair_free_donated if donate
+                    else solve_ops.repair_free
+                )
+                donated = donate
+                carry = free_fn(
+                    carry, free_new, free_ex,
+                    _as_request_plane(w.prep.cls.requests),
+                    w.member_rows, w.own_inv_rows,
+                )
+            if window is not None:
+                idx, n_open_w = window
+                win_carry, base = solve_ops.gather_repair_window(
+                    carry, idx, np.int32(n_open_w)
+                )
+                repair_plan = solve_ops.RepairPlan(
+                    pref_new=free_new[:, idx],
+                    pref_ex=free_ex,
+                    base_fwd_sing=base[0],
+                    base_fwd_full=base[1],
+                    base_inv_full=base[2],
+                )
+                keep_carry = carry
+                outputs = self.solver.run_prepared(
+                    w.prep, count=counts, warm_carry=win_carry,
+                    repair_plan=repair_plan, n_slots=len(idx),
+                )
+                donated = donated or donate
+            else:
+                zeros_gz = np.zeros((g1, n_zones), dtype=np.int32)
+                repair_plan = solve_ops.RepairPlan(
+                    pref_new=free_new, pref_ex=free_ex,
+                    base_fwd_sing=zeros_gz, base_fwd_full=zeros_gz,
+                    base_inv_full=zeros_gz,
+                )
+                keep_carry = None
+                outputs = self.solver.run_prepared(
+                    w.prep, count=counts, warm_carry=carry,
+                    repair_plan=repair_plan,
+                )
+                donated = donated or donate
+            if self._staging is None and pipeline_mod.pipeline_enabled():
+                self._staging = pipeline_mod.HostStagingRing()
+            ticket = self.solver.begin_fetch(outputs, ring=self._staging)
+        except BaseException:
+            if donated or donate:
+                self._warm = None  # the carry was donated: lineage is gone
+            raise
+        # decode consumes a delta VIEW of the snapshot: same planes, classes
+        # carry only this tick's pods (built here, while the device works)
+        delta_view = _delta_view(w.versioned.snapshot, plan["pods_by_root"])
+        return {
+            "plan": plan, "outputs": outputs, "ticket": ticket,
+            "window": window, "keep_carry": keep_carry, "donated": donated,
+            "delta_view": delta_view, "state_nodes": w.state_nodes,
+            "solver": self.solver,
+        }
+
+    @staticmethod
+    def _delta_exhausted(disp, fetched) -> bool:
+        """Out of slots/window: the repair could not place everything it was
+        given room for — the tick escalates to a full solve."""
+        w_slots = (
+            len(disp["window"][0]) if disp["window"] is not None
+            else disp["outputs"].assign.shape[1]
         )
-        slots_seen = len(idx) if window is not None else n_slots
-        if int(np.sum(failed_d)) > 0 and int(n_next_h) >= slots_seen:
-            return None  # out of slots/window: the caller escalates to full
+        from karpenter_core_tpu.solver.tpu import TPUSolver
 
-        # decode through the standard path over a delta VIEW of the snapshot
-        # (same planes, classes carry only this tick's pods), then drop node
-        # decisions the repair placed nothing on — previously-decided nodes
-        # must not be re-launched.  Windowed outputs decode directly: only
-        # window slots can carry this tick's placements, and the smaller
-        # planes make the decode cheaper too.
-        delta_view = _delta_view(w.versioned.snapshot, pods_by_root)
-        results = self.solver.decode(delta_view, outputs, w.state_nodes)
+        return TPUSolver.fetch_exhausted(fetched, w_slots)
+
+    def _delta_results(self, disp):
+        """Host materialize: decode over the delta view (the fetch ticket's
+        staged arrays — no device re-touch), dropping node decisions the
+        repair placed nothing on (previously-decided nodes must not be
+        re-launched)."""
+        results = disp["solver"].decode(
+            disp["delta_view"], disp["outputs"], disp["state_nodes"],
+            fetched=disp["ticket"],
+        )
         results.new_nodes = [d for d in results.new_nodes if d.pods]
+        return results
 
-        # adopt: bookkeeping moves only after the device work succeeded
-        assign_d = np.asarray(assign_d, dtype=np.int32)
-        assign_ex_d = np.asarray(assign_ex_d, dtype=np.int32)
-        loc_d, unplaced = _locate_pods(delta_view, assign_d, assign_ex_d)
+    def _delta_adopt(self, disp, fetched) -> None:
+        """Bookkeeping: fold the repair's placements into the lineage.  Runs
+        only after the device work succeeded (the ticket's barrier)."""
+        w = self._warm
+        plan = disp["plan"]
+        window = disp["window"]
+        outputs = disp["outputs"]
+        c_pad = w.prep.cls.count.shape[0]
+        n_slots = w.assign.shape[1]
+        from karpenter_core_tpu.solver.tpu import TPUSolver
+
+        assign_d = np.asarray(fetched[TPUSolver.FETCH_ASSIGN], dtype=np.int32)
+        assign_ex_d = np.asarray(
+            fetched[TPUSolver.FETCH_ASSIGN_EX], dtype=np.int32
+        )
+        n_next_h = int(fetched[TPUSolver.FETCH_N_NEXT])
+        loc_d, unplaced = _locate_pods(disp["delta_view"], assign_d, assign_ex_d)
         if window is not None:
             # scatter the windowed repair back to the full-width lineage:
-            # assignment columns, pod locations, and the device carry
-            new_carry = solve_ops.scatter_repair_window(
-                carry, solve_ops.warm_carry_of(outputs), idx, np.int32(n_open_w)
+            # assignment columns, pod locations, and the device carry.  The
+            # donating twin writes the window into the full carry's device
+            # memory in place (the full carry is dead after this call).
+            idx, n_open_w = window
+            scatter = (
+                solve_ops.scatter_repair_window_donated if disp["donated"]
+                else solve_ops.scatter_repair_window
+            )
+            new_carry = scatter(
+                disp["keep_carry"], solve_ops.warm_carry_of(outputs), idx,
+                np.int32(n_open_w),
             )
             assign_g = np.zeros((c_pad, n_slots), dtype=np.int32)
             assign_g[:, idx] = assign_d
@@ -604,10 +873,10 @@ class IncrementalSolveSession:
                 uid: (row, kind, int(idx[i]) if kind == "new" else i)
                 for uid, (row, kind, i) in loc_d.items()
             }
-            n_next_h = w.n_next + (int(n_next_h) - n_open_w)
+            n_next_h = w.n_next + (n_next_h - n_open_w)
         else:
             new_carry = solve_ops.warm_carry_of(outputs)
-        for uid, loc in evicted_locs:
+        for uid, loc in plan["evicted_locs"]:
             row, kind, slot = loc
             (w.assign if kind == "new" else w.assign_ex)[row, slot] -= 1
             del w.pod_loc[uid]
@@ -617,28 +886,154 @@ class IncrementalSolveSession:
         # every non-evicted failure was retried this tick, so the repair's
         # unplaced tail IS the new failure set
         delta_pods = {
-            p.uid: p for pods in pods_by_root.values() for p in pods
+            p.uid: p for pods in plan["pods_by_root"].values() for p in pods
         }
         w.failed_pods = {
             uid: (row, delta_pods[uid]) for uid, row in unplaced
         }
         w.carry = new_carry
-        w.n_next = int(n_next_h)
-        # membership: previous minus evicted plus added
-        members = {k: list(v) for k, v in w.members.items()}
-        for key, uids in delta.evicted.items():
-            gone = set(uids)
-            if key in members:
-                members[key] = [u for u in members[key] if u not in gone]
-        for key, uids in delta.added.items():
-            members.setdefault(key, []).extend(uids)
-        w.members = {k: tuple(v) for k, v in members.items() if v}
+        w.n_next = n_next_h
+        w.members = plan["members_after"]
         w.delta_ticks += 1
+
+    def _delta_solve(self, delta, by_uid, state_nodes):
+        """The serial delta tick, stage order exactly as before the
+        pipelined loop: dispatch → barrier → exhaustion check → decode →
+        adopt.  None escalates to a full solve."""
+        plan = self._delta_plan(delta, by_uid)
+        if plan is None:
+            return None
+        disp = self._delta_dispatch(plan)
+        try:
+            fetched = disp["ticket"].wait()
+            if self._delta_exhausted(disp, fetched):
+                return None
+            results = self._delta_results(disp)
+            self._delta_adopt(disp, fetched)
+        except BaseException:
+            if disp["donated"]:
+                # the carry was donated: a kept lineage would re-read the
+                # deleted buffer on every later repair — drop it so the
+                # next solve re-anchors (KC_PIPELINE=0 keeps the old
+                # keep-the-lineage behavior, nothing was donated there)
+                self._warm = None
+            raise
         return results
 
+    def _delta_dispatch_deferred(self, delta, by_uid, pods_or_classes,
+                                 members, state_nodes, bound_pods,
+                                 supply_anchor):
+        """The pipelined tick: plan + dispatch now, settle at the next
+        solve's entry.  Returns the PendingResults handle, or None when the
+        plan cannot be expressed (caller escalates inline, exactly like the
+        serial path).  The current population's classes are captured so a
+        settle-time exhaustion re-anchors from THIS tick's population even
+        though the caller's ingest has moved on by then."""
+        plan = self._delta_plan(delta, by_uid)
+        if plan is None:
+            return None
+        disp = self._delta_dispatch(plan)
+        # capture AFTER dispatch so the snapshot build overlaps device work.
+        # PodIngest.classes() is a fresh finalized list (fresh pods lists);
+        # a prebuilt class list gets shallow pod-list copies for the same
+        # isolation from caller-side churn.
+        from karpenter_core_tpu.models.columnar import PodIngest
+
+        try:
+            if isinstance(pods_or_classes, PodIngest):
+                captured = pods_or_classes.classes()
+            else:
+                captured = [
+                    cls if getattr(cls, "is_ladder_variant", False)
+                    else dc_replace(cls, pods=list(cls.pods))
+                    for cls in pods_or_classes
+                ]
+        except BaseException:
+            if disp["donated"]:
+                self._warm = None  # dispatched with a donated carry
+            raise
+        box = PendingResults(self)
+        self._pending = _PendingTick(
+            kind="delta", box=box, data=dict(
+                disp=disp, members_after=plan["members_after"],
+                captured_classes=captured, members_at=dict(members),
+                state_nodes=list(state_nodes or ()),
+                bound_pods=list(bound_pods or ()),
+                supply_anchor=supply_anchor,
+            ),
+        )
+        return box
+
+    def settle(self) -> None:
+        """Retire the in-flight deferred tick: completion barrier, window
+        exhaustion check (a delta escalates to a full re-anchor of the
+        CAPTURED population — same semantics as the serial escalation; a
+        full retries with doubled slots), bookkeeping adoption, and mode
+        accounting.  Decode stays deferred to the handle's ``result()`` so
+        it overlaps the next tick's device compute; a handle still undecoded
+        by the NEXT settle materializes here first (its staging-ring slot is
+        about to be rewritten).  Never raises: a settle failure lands in the
+        handle and drops the lineage (the next solve re-anchors)."""
+        # flush the last settled-but-undecoded handle FIRST — and do it even
+        # when nothing is pending: its staged arrays live in the shared ring,
+        # and ANY later tick (a serial one included) would rewrite that slot
+        # under the handle.  In the canonical loop the consumer already
+        # called result(), making this a no-op; failures are cached in the
+        # box (PendingResults.result) and re-raised to its consumer.
+        if self._undecoded is not None:
+            try:
+                self._undecoded.result()
+            except Exception:  # noqa: BLE001 - recorded in the box
+                pass
+            self._undecoded = None
+        pending = self._pending
+        if pending is None:
+            return
+        self._pending = None
+        if pending.kind == "full":
+            mode, reason = MODE_FULL, pending.data["reason"]
+        else:
+            mode, reason = MODE_DELTA, "delta"
+        try:
+            if pending.kind == "full":
+                self._settle_full(pending)
+            else:
+                disp = pending.data["disp"]
+                fetched = disp["ticket"].wait()
+                if self._delta_exhausted(disp, fetched):
+                    mode, reason = MODE_FULL, "slots-exhausted"
+                    results = self._full_solve(
+                        pending.data["captured_classes"],
+                        pending.data["members_at"],
+                        pending.data["state_nodes"],
+                        pending.data["bound_pods"],
+                        pending.data["supply_anchor"], reason,
+                    )
+                    pending.box._settle_with(results=results)
+                else:
+                    self._delta_adopt(disp, fetched)
+                    pending.box._settle_with(
+                        decode=lambda: self._delta_results(disp)
+                    )
+                    self._undecoded = pending.box
+        except BaseException as e:  # noqa: BLE001 - routed to the handle
+            if pending.kind == "full" or pending.data["disp"]["donated"]:
+                self._warm = None  # serial parity: a failed anchor resets
+            pending.box._settle_with(error=e)
+            SOLVE_MODE.labels(mode).inc()
+            self.last_mode, self.last_reason = mode, f"{reason}:failed"
+            self.mode_counts[mode] = self.mode_counts.get(mode, 0) + 1
+            return
+        SOLVE_MODE.labels(mode).inc()
+        self.last_mode, self.last_reason = mode, reason
+        self.mode_counts[mode] = self.mode_counts.get(mode, 0) + 1
+
     # -- aggregate views (bench / parity tests) --------------------------------
+    # Each settles the in-flight deferred tick first: the view must reflect
+    # every dispatched solve (a no-op outside the pipelined loop).
 
     def node_count(self) -> int:
+        self.settle()
         w = self._warm
         if w is None:
             return 0
@@ -646,6 +1041,7 @@ class IncrementalSolveSession:
 
     def aggregates(self) -> Dict[str, int]:
         """The session lineage's current placement totals."""
+        self.settle()
         w = self._warm
         if w is None:
             return {"scheduled": 0, "failed": 0, "nodes": 0}
@@ -660,6 +1056,7 @@ class IncrementalSolveSession:
         class identity — the assignment-identity view the churn bench
         compares against a from-scratch full solve (order- and
         row-index-independent)."""
+        self.settle()
         w = self._warm
         if w is None:
             return ()
@@ -772,6 +1169,16 @@ def _locate_pods(snapshot, assign, assign_ex):
             continue
         unplaced.extend((p.uid, c) for p in snapshot.classes[c].pods[cursors[c]:])
     return loc, unplaced
+
+
+def _as_request_plane(requests):
+    """The per-pod request plane for repair_free: a device-resident prep's
+    plane passes straight through (already f32 on device — no host round
+    trip per tick); a host prep's numpy plane gets the f32 cast the jit
+    expects."""
+    if isinstance(requests, np.ndarray):
+        return np.asarray(requests, dtype=np.float32)
+    return requests
 
 
 def _topology_rows(prep) -> Tuple[np.ndarray, np.ndarray]:

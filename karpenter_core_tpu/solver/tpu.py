@@ -44,13 +44,24 @@ class _LazyPlanes:
                  "_n_zones", "_n_ct", "_viable", "_zone", "_ct", "_used")
 
     def __init__(self, state) -> None:
+        from karpenter_core_tpu.utils import pipeline as pipeline_mod
+
         self._n_it = state.viable.shape[-1]
         self._n_zones = state.zone.shape[-1]
         self._n_ct = state.ct.shape[-1]
         self._viable_p = solve_ops.pack_bool(state.viable)
         self._zone_p = solve_ops.pack_bool(state.zone)
         self._ct_p = solve_ops.pack_bool(state.ct)
-        self._used_d = state.used
+        used = state.used
+        if pipeline_mod.donation_enabled():
+            # the pipelined loop donates the carry these planes alias on the
+            # NEXT dispatch; node decisions consume `used` lazily (launch
+            # path) possibly after that, so take an owned device copy now.
+            # The packed planes above are already fresh arrays.
+            import jax.numpy as jnp
+
+            used = jnp.copy(used) if hasattr(used, "is_deleted") else used
+        self._used_d = used
         self._viable = self._zone = self._ct = self._used = None
 
     def prefetch(self) -> None:
@@ -917,7 +928,18 @@ class TPUSolver:
         final carry (ops.solve.WarmCarry); ``repair_plan`` carries the freed-
         hole planes the repair's fills refill first plus the out-of-window
         topology bases of a bounded repair (ops.solve.RepairPlan).
-        Returns raw SolveOutputs — decode is the caller's step."""
+        Returns raw SolveOutputs — device-resident futures (dispatch is
+        asynchronous); decode is the caller's step, and ``begin_fetch``
+        splits its device→host copy from the completion barrier so a
+        pipelined caller overlaps the next dispatch with this one's fetch.
+
+        Warm dispatches DONATE the carry's device buffers when the pipeline
+        is armed (utils.pipeline, KC_PIPELINE=0 disarms): the caller must
+        not read ``warm_carry`` after this call (the ``donated-read``
+        kcanalyze rule).  An enabled policy objective keeps donation off —
+        its decode stage re-reads the final state planes on device after
+        the dispatch (ops.objective.select_for_state), and those planes
+        alias the donated memory one tick later."""
         from karpenter_core_tpu.utils import compilecache
 
         cls = prep.cls
@@ -928,10 +950,14 @@ class TPUSolver:
             # the warm variant always takes the ex-static planes (its tol/vol
             # rows are per-class); synthesize the empty ones the full solve
             # built internally so the repair sees identical semantics
-            n_res = np.asarray(prep.cls.requests).shape[-1]
-            n_classes = np.asarray(cls.count).shape[0]
-            g1 = np.asarray(prep.statics_arrays.grp_skew).shape[0]
+            # (shape reads only — the prep's planes may be device-resident)
+            n_res = prep.cls.requests.shape[-1]
+            n_classes = cls.count.shape[0]
+            g1 = prep.statics_arrays.grp_skew.shape[0]
             ex_static = solve_ops.empty_existing_static(n_res, n_classes, g1)
+        donate = "auto"
+        if self.policy is not None and getattr(self.policy, "enabled", False):
+            donate = False
         return compilecache.run_solve(
             cls, prep.statics_arrays, n_slots or prep.n_slots, prep.key_has_bounds,
             None if warm_carry is not None else prep.ex_state,
@@ -945,7 +971,88 @@ class TPUSolver:
             # layout must keep matching the executable it resumes into even
             # if the live mesh config moves mid-lineage
             mesh_axes=getattr(prep, "mesh_axes", None),
+            donate_carry=donate,
         )
+
+    # ``begin_fetch``'s small-plane tuple layout.  The settle/exhaustion
+    # checks here and in solver.incremental consume the fetched tuple by
+    # these indices — extend the tuple ONLY by appending, and keep this
+    # block in lockstep with the tuple construction below.
+    FETCH_ASSIGN = 0
+    FETCH_ASSIGN_EX = 1
+    FETCH_FAILED = 2
+    FETCH_SUSPECT = 3
+    FETCH_EX_ZONE = 4
+    FETCH_POD_COUNT = 5
+    FETCH_TMPL_ID = 6
+    FETCH_OPEN = 7
+    FETCH_N_NEXT = 8
+
+    @classmethod
+    def fetch_exhausted(cls, fetched, slots) -> bool:
+        """Slot-exhaustion verdict over a fetched begin_fetch tuple: pods
+        failed AND the scan consumed every slot it was given.  The ONE
+        definition every escalation path shares — solve_encoded's retry,
+        the deferred anchor's settle, and the deferred repair's
+        window-overflow check (solver.incremental)."""
+        return (
+            int(np.sum(fetched[cls.FETCH_FAILED])) > 0
+            and int(fetched[cls.FETCH_N_NEXT]) >= int(slots)
+        )
+
+    def upload_prep(self, prep: SolvePrep) -> SolvePrep:
+        """Upload a SolvePrep's padded planes to the device ONCE (with the
+        prep's captured mesh shardings) and return the device-resident prep.
+        The incremental session adopts this after every full solve: steady
+        churn repairs then re-dispatch over the SAME device buffers tick
+        after tick — ``device_put`` is a no-op for device-resident leaves,
+        so only the fresh per-tick count vector ever crosses the host→device
+        boundary again (docs/KERNEL_PERF.md "Layer 7"; the host→device twin
+        of the warm carry's donation)."""
+        from karpenter_core_tpu.parallel import mesh as mesh_mod
+
+        trees = (prep.cls, prep.statics_arrays, prep.ex_state, prep.ex_static)
+        mesh_axes = getattr(prep, "mesh_axes", None)
+        if mesh_axes is None:
+            up = jax.device_put(trees)
+        else:
+            up = jax.device_put(
+                trees,
+                mesh_mod.mesh_shardings(trees, mesh_mod.mesh_for(mesh_axes)),
+            )
+        return prep._replace(
+            cls=up[0], statics_arrays=up[1], ex_state=up[2], ex_static=up[3]
+        )
+
+    def begin_fetch(self, outputs: solve_ops.SolveOutputs, ring=None):
+        """Split decode's fetch from its dispatch: start non-blocking
+        device→host copies of every array decode consumes (the small planes
+        first, the big lazy planes behind them) and return the
+        utils.pipeline.FetchTicket whose ``wait()`` is the completion
+        barrier.  ``decode(..., fetched=ticket)`` then materializes without
+        re-touching the device — the seam that lets solve[k+1]'s dispatch
+        overlap decode[k]'s copy and host expansion (docs/KERNEL_PERF.md
+        "Layer 7").  ``ring`` stages the fetched arrays into reusable host
+        buffers (the pipelined session's double-buffer)."""
+        from karpenter_core_tpu.utils import pipeline as pipeline_mod
+
+        state = outputs.state
+        small = (
+            outputs.assign,
+            outputs.assign_existing,
+            outputs.failed,
+            outputs.spread_suspect,
+            outputs.ex_state.zone,
+            state.pod_count,
+            state.tmpl_id,
+            state.open_,
+            state.n_next,
+        )
+        ticket = pipeline_mod.FetchTicket(small, ring=ring, label="decode")
+        planes = _LazyPlanes(state)
+        planes.prefetch()  # big planes ride the link behind the small fetch
+        ticket.planes = planes
+        return ticket
 
     def solve_encoded(
         self,
@@ -966,24 +1073,26 @@ class TPUSolver:
 
         prep = self.prepare_encoded(snapshot, state_nodes, bound_pods, n_slots)
         outputs = self.run_prepared(prep)
-        # slot exhaustion: retry once with double capacity.  One batched fetch
-        # (the relay costs ~67 ms per round trip); both arrays are cached on
-        # the jax array objects, so decode's batched fetch doesn't re-ship them.
-        n_next_h, failed_h = jax.device_get((outputs.state.n_next, outputs.failed))
-        n_used = int(n_next_h)
+        # slot exhaustion: retry once with double capacity.  ONE ticket
+        # serves both the exhaustion check and decode (the relay costs
+        # ~67 ms per round trip — the old path fetched n_next/failed twice).
+        ticket = self.begin_fetch(outputs)
+        fetched = ticket.wait()
         slots = outputs.assign.shape[1]
-        if int(np.sum(failed_h)) > 0 and n_used >= slots:
+        if self.fetch_exhausted(fetched, slots):
             outputs = self.run_prepared(prep, n_slots=slots * 2)
-        return self.decode(snapshot, outputs, state_nodes or [])
+            ticket = self.begin_fetch(outputs)
+        return self.decode(snapshot, outputs, state_nodes or [], fetched=ticket)
 
     def decode(
         self,
         snapshot: EncodedSnapshot,
         outputs: solve_ops.SolveOutputs,
         state_nodes: Optional[list] = None,
+        fetched=None,
     ) -> TPUSolveResults:
         with tracing.span("decode") as sp:
-            results = self._decode_impl(snapshot, outputs, state_nodes)
+            results = self._decode_impl(snapshot, outputs, state_nodes, fetched)
             self._apply_policy_selection(snapshot, outputs, results)
             sp.set(
                 new_nodes=len(results.new_nodes),
@@ -1035,45 +1144,34 @@ class TPUSolver:
         snapshot: EncodedSnapshot,
         outputs: solve_ops.SolveOutputs,
         state_nodes: Optional[list] = None,
+        fetched=None,
     ) -> TPUSolveResults:
         # NOTE: solver.incremental._locate_pods mirrors this walk's pod
         # consumption order (root-shared cursors, existing before new, index
         # order within each) to label pod -> slot for the repair path; a
         # change to the order here must be mirrored there (the tier-1 parity
         # fuzz in tests/test_incremental.py catches drift loudly).
-        state = outputs.state
-        # start every device→host copy up front so transfers overlap the
-        # host-side expansion work below; planes stay lazy until consumed.
-        # The device link is a high-latency relay (~67 ms per round trip on
-        # the axon tunnel), so everything eager ships in ONE batched fetch —
-        # including the n_next scalar, which as a bare int() would cost a
-        # full round trip of its own.
-        planes = _LazyPlanes(state)
-        small = (
-            outputs.assign,
-            outputs.assign_existing,
-            outputs.failed,
-            outputs.spread_suspect,
-            outputs.ex_state.zone,
-            state.pod_count,
-            state.tmpl_id,
-            state.open_,
-            state.n_next,
-        )
+        #
+        # Every device→host copy was started at begin_fetch time (at the
+        # dispatch site when the caller pipelines; here otherwise) so the
+        # transfers overlap whatever host work ran since; everything eager
+        # lands in ONE batched device_get — the relay is a high-latency
+        # tunnel (~67 ms per round trip), and the n_next scalar as a bare
+        # int() would cost a full round trip of its own.  Big planes stay
+        # lazy until consumed (launch path).
+        ticket = fetched if fetched is not None else self.begin_fetch(outputs)
+        planes = ticket.planes
         # the fetch is its own child span so the decode stage splits into
         # device→host transfer vs host expansion — the boundary the decode
         # pipelining work needs independently visible (docs/KERNEL_PERF.md).
-        # NB: without an upstream sync (ops/solve.sync_outputs) this span
-        # also absorbs any still-running device compute.
-        with tracing.span("decode.fetch", arrays=len(small)):
-            for arr in small:
-                try:
-                    arr.copy_to_host_async()
-                except AttributeError:
-                    pass
+        # ``prefetched`` marks a completion barrier that already ran at the
+        # pipelined settle (exposed wait ≈ 0 here); without an upstream sync
+        # (ops/solve.sync_outputs) a cold barrier also absorbs any
+        # still-running device compute.
+        with tracing.span("decode.fetch", arrays=9, batched=True,
+                          prefetched=ticket.done(), staged=ticket.staged):
             (assign, assign_ex, failed, suspect, ex_zone, pod_count, tmpl_id,
-             open_, n_next) = jax.device_get(small)
-            planes.prefetch()  # big planes ride the link while the host expands
+             open_, n_next) = ticket.wait()
 
         results = TPUSolveResults(n_slots_used=int(n_next))
         nodes: Dict[int, TPUNodeDecision] = {}

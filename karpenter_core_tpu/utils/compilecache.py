@@ -266,9 +266,20 @@ def solve_callable(
     warm_carry=None,
     repair_plan=None,
     mesh_axes=None,
+    donate_carry: bool = False,
 ):
     """An AOT-compiled solve callable served through the export cache, or None
     when export-caching is unavailable (callers fall back to the plain jit).
+
+    ``donate_carry`` selects the buffer-donating twin of the warm variant
+    (utils.pipeline, docs/KERNEL_PERF.md "Layer 7"): the ``warm_carry``
+    argument is donated so a steady-state churn repair reuses the carry's
+    device memory in place instead of reallocating the full-width planes
+    every tick.  It is part of the cache key — the donating and plain
+    executables never share a memo slot — and only meaningful with
+    ``warm_carry``.  Donating variants skip the exported-StableHLO disk
+    cache (donation is a property of the lowering, not the exported module);
+    the in-process memo and XLA's persistent cache still cover them.
 
     ``mesh_axes`` (hashable topology descriptor, e.g. ``(("catalog", 8),)``
     from parallel.mesh.solve_mesh_axes) selects the SHARDED variant: the
@@ -299,6 +310,7 @@ def solve_callable(
         has_ex = ex_state is not None
         has_warm = warm_carry is not None
         has_repair = repair_plan is not None
+        donate_carry = bool(donate_carry) and has_warm
         features = snap_features(features)
         key = (
             _kernel_src_hash(),
@@ -311,6 +323,7 @@ def solve_callable(
             packed_masks,
             has_ex,
             has_warm,
+            donate_carry,
             mesh_axes,
             _leaf_sig(cls),
             _leaf_sig(statics_arrays),
@@ -338,7 +351,7 @@ def solve_callable(
             return _build_and_memo(key, cls, statics_arrays, n_slots,
                                    key_has_bounds, ex_state, ex_static, n_passes,
                                    features, fuse_zones, packed_masks, warm_carry,
-                                   repair_plan, mesh_axes)
+                                   repair_plan, mesh_axes, donate_carry)
         finally:
             with _lock:
                 _in_flight.pop(key, None)
@@ -382,15 +395,20 @@ def _base_solve_fn(has_warm, has_ex, n_slots, key_has_bounds, n_passes,
 def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
                     ex_state, ex_static, n_passes, features=None,
                     fuse_zones=True, packed_masks=True, warm_carry=None,
-                    repair_plan=None, mesh_axes=None):
+                    repair_plan=None, mesh_axes=None, donate_carry=False):
     """Build one executable for ``key``: export-cache load (or trace+export),
     then AOT compile, then memoize.  Callers hold the key's in-flight slot.
     Mesh variants (``mesh_axes``) build jit(shard_map(...)) instead and skip
-    the export cache — the memo (and XLA's persistent cache) keep them warm."""
+    the export cache — the memo (and XLA's persistent cache) keep them warm.
+    ``donate_carry`` variants (warm only) also skip the export cache and
+    build the jit with the warm-carry argument donated (position 3 of the
+    warm signature ``(cls, statics, ex_static, warm_carry, repair_plan)``)."""
     import jax
 
     has_ex = ex_state is not None
     has_warm = warm_carry is not None
+    # the warm signature's donated argument index (see _base_solve_fn)
+    donate_argnums = (3,) if (donate_carry and has_warm) else ()
     digest = hashlib.sha256(repr(key).encode()).hexdigest()[:24]
     path = os.path.join(cache_dir(), f"solve-{digest}.stablehlo")
     if has_warm:
@@ -414,12 +432,26 @@ def _build_and_memo(key, cls, statics_arrays, n_slots, key_has_bounds,
             fuse_zones, packed_masks,
         )
         fn = mesh_mod.sharded_solve_callable(
-            mesh_axes, base_axis, base_plain, structs
+            mesh_axes, base_axis, base_plain, structs,
+            donate_argnums=donate_argnums,
         )
         with _lock:
             _memo[key] = fn
             _stats["builds"] += 1
         return fn
+    if donate_argnums:
+        # donation is a lowering property, not part of an exported StableHLO
+        # module — build the donating jit directly and AOT-compile it; the
+        # memo + XLA persistent cache keep it warm
+        base = jax.jit(_base_solve_fn(
+            has_warm, has_ex, n_slots, key_has_bounds, n_passes, features,
+            fuse_zones, packed_masks,
+        ), donate_argnums=donate_argnums)
+        compiled = base.lower(*structs).compile()
+        with _lock:
+            _memo[key] = compiled
+            _stats["builds"] += 1
+        return compiled
     fn = None
     if os.path.exists(path):
         try:
@@ -573,6 +605,7 @@ def run_solve(
     repair_plan=None,
     pre_padded: bool = False,
     mesh_axes="auto",
+    donate_carry="auto",
 ):
     """Solve through the export cache, falling back to the plain jit.
 
@@ -594,17 +627,29 @@ def run_solve(
     ``"auto"`` (the default — KC_SOLVER_MESH env / device count decide, so
     every production entry point inherits the sharded path without threading
     anything).  The sharded solve is bit-identical to the unsharded one
-    (docs/KERNEL_PERF.md "Layer 5")."""
+    (docs/KERNEL_PERF.md "Layer 5").
+
+    ``donate_carry``: ``"auto"`` (default) donates the warm carry's device
+    buffers whenever the pipeline is armed (utils.pipeline.donation_enabled
+    — KC_PIPELINE=0 switches it off, and backends that ignore donation skip
+    it); True/False force.  Donation never changes results — only whether
+    the repair reuses the carry's device memory in place.  The caller must
+    not read the passed ``warm_carry`` after this call when donation is
+    possible (the ``donated-read`` kcanalyze rule, docs/ANALYSIS.md)."""
     from concurrent.futures import ThreadPoolExecutor
 
     import jax
 
     from karpenter_core_tpu import tracing
     from karpenter_core_tpu.ops import solve as solve_ops
+    from karpenter_core_tpu.utils import pipeline as pipeline_mod
 
     fuse_zones, packed_masks = kernel_flags()
     features = snap_features(features)
     mesh_axes = resolve_mesh_axes(mesh_axes, statics_arrays)
+    if donate_carry == "auto":
+        donate_carry = pipeline_mod.donation_enabled()
+    donate_carry = bool(donate_carry) and warm_carry is not None
     # "dispatch" covers pad + upload + executable lookup + async kernel launch;
     # the separate "solve" span blocks on the outputs (tracing only) so device
     # compute is attributed to the solve, not to whichever span first touches
@@ -636,7 +681,7 @@ def run_solve(
             fn = solve_callable(
                 cls, statics_arrays, n_slots, key_has_bounds, ex_state, ex_static,
                 n_passes, features, fuse_zones, packed_masks, warm_carry,
-                repair_plan, mesh_axes,
+                repair_plan, mesh_axes, donate_carry,
             )
             cls, statics_arrays, ex_state, ex_static = upload.result()
         if fn is None:
@@ -646,8 +691,19 @@ def run_solve(
                 packed_masks=packed_masks, warm_carry=warm_carry,
                 repair_plan=repair_plan,
             )
+            if warm_carry is not None:
+                pipeline_mod.record_donation(False)
         elif warm_carry is not None:
             out = fn(cls, statics_arrays, ex_static, warm_carry, repair_plan)
+            # donation effectiveness ledger: a donated buffer is consumed at
+            # dispatch; a live host view (or an undonated variant) degrades
+            # to a realloc, which bench.pipeline_line surfaces
+            probe = getattr(
+                getattr(warm_carry, "state", None), "used", None
+            )
+            pipeline_mod.record_donation(
+                donate_carry and bool(getattr(probe, "is_deleted", lambda: False)())
+            )
         else:
             out = fn(cls, statics_arrays, ex_state, ex_static) if ex_state is not None else fn(cls, statics_arrays)
     if tracing.enabled():

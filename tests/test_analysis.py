@@ -647,6 +647,77 @@ class TestRetraceBudgetStatic:
         })
         assert "unkeyed-mesh-static" not in rules_of(retrace_budget.run(project))
 
+    def test_donated_read_flagged(self, tmp_path):
+        """Reading a buffer after the dispatch that donated it — both the
+        ``warm_carry=`` kwarg spelling and the ``*_donated`` helper
+        convention (first positional argument)."""
+        project = make_project(tmp_path, {
+            "badpkg/solver/loop.py": """\
+                def tick(solver, prep, carry, counts, plan):
+                    out = solver.run_prepared(
+                        prep, count=counts, warm_carry=carry, repair_plan=plan
+                    )
+                    return out, carry  # read after donation
+
+                def free(repair_free_donated, carry, f):
+                    freed = repair_free_donated(carry, f)
+                    stale = carry.state  # read after donation
+                    return freed, stale
+            """,
+        })
+        found = [f for f in retrace_budget.run(project)
+                 if f.rule == "donated-read"]
+        assert len(found) == 2
+        assert "'carry'" in found[0].detail and "'carry'" in found[1].detail
+
+    def test_donated_read_rebind_and_branches_silent(self, tmp_path):
+        """The intended idioms stay silent: rebinding the name to the
+        dispatch's output clears the taint, and a donation inside one
+        if-arm does not taint the sibling arm (it taints the code AFTER
+        the branch)."""
+        project = make_project(tmp_path, {
+            "badpkg/solver/loop.py": """\
+                def rebind(repair_free_donated, carry, f):
+                    carry = repair_free_donated(carry, f)
+                    return carry.state  # the OUTPUT: fine
+
+                def branches(solver, prep, counts, carry, win_carry, plan):
+                    if win_carry is not None:
+                        keep = carry
+                        out = solver.run_prepared(
+                            prep, count=counts, warm_carry=win_carry,
+                            repair_plan=plan,
+                        )
+                    else:
+                        out = solver.run_prepared(
+                            prep, count=counts, warm_carry=carry,
+                            repair_plan=plan,
+                        )
+                    return out, keep  # keep bound BEFORE the donation
+            """,
+        })
+        assert "donated-read" not in rules_of(retrace_budget.run(project))
+
+    def test_donated_read_after_merged_branches_flagged(self, tmp_path):
+        """Code AFTER an if/else inherits either arm's donations: a read of
+        the else-arm's donated carry past the join is flagged."""
+        project = make_project(tmp_path, {
+            "badpkg/solver/loop.py": """\
+                def tick(solver, prep, counts, carry, windowed, plan):
+                    if windowed:
+                        out = solver.run_prepared(prep, count=counts)
+                    else:
+                        out = solver.run_prepared(
+                            prep, count=counts, warm_carry=carry,
+                            repair_plan=plan,
+                        )
+                    return out, carry  # may read the donated buffer
+            """,
+        })
+        found = [f for f in retrace_budget.run(project)
+                 if f.rule == "donated-read"]
+        assert len(found) == 1 and "'carry'" in found[0].detail
+
     def test_current_tree_only_baselined_findings(self, repo_project,
                                                   repo_baseline):
         kept, _ = apply_baseline(retrace_budget.run(repo_project), repo_baseline)

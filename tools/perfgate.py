@@ -97,7 +97,8 @@ STAGE_KEYS = ("solve_decode_s", "solve_s", "decode_s", "ingest_s",
               "dispatch_s", "materialize_s", "cold_s",
               "churn_warm_solve_s", "churn_full_solve_s",
               "churn_delta_ingest_s", "objective_s",
-              "sharded_solve_s", "sharded_solve_1dev_s")
+              "sharded_solve_s", "sharded_solve_1dev_s",
+              "pipeline_warm_tick_s", "pipeline_serial_tick_s")
 # stages that matter enough to flag; the others are printed but only the
 # load-bearing ones gate (sub-10ms stages WARN on scheduler-noise otherwise)
 # objective_s gates too: the policy scoring stage rides every policy-enabled
@@ -115,7 +116,14 @@ GATED_STAGES = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "cold_s",
                 # the keys and are skipped per-stage, as usual.
                 "classify_s", "planes_s", "upload_s", "churn_delta_ingest_s",
                 "churn_warm_solve_s", "churn_full_solve_s", "objective_s",
-                "sharded_solve_s", "sharded_solve_1dev_s")
+                "sharded_solve_s", "sharded_solve_1dev_s",
+                # the pipelined loop's warm per-tick median gates as its own
+                # stage (bench.py pipeline_line): an overlap regression —
+                # a new sync point, a donation that stopped engaging — must
+                # not hide inside healthy solve/decode halves.  The serial
+                # twin stays advisory (it moves with machine noise and is
+                # already covered by the churn stages).
+                "pipeline_warm_tick_s")
 
 
 def compare_stages(detail: dict, prev_detail: dict, tol: float):
@@ -205,6 +213,49 @@ def report_churn(detail: dict) -> None:
         print(
             "perfgate: WARNING churn speedup below the 2x ISSUE-7 acceptance "
             "floor — the warm-start delta path is not paying for itself"
+        )
+
+
+def report_pipeline(detail: dict) -> None:
+    """Surface the pipelined-loop line (bench.py pipeline_line): serial vs
+    double-buffered per-tick medians, the hidden-fetch fraction, the
+    donation ledger, and assignment parity.  Advisory — the enforced side
+    is ``pipeline_warm_tick_s`` in GATED_STAGES."""
+    pipeline = detail.get("pipeline")
+    if not pipeline:
+        return
+    if "error" in pipeline:
+        print(f"perfgate: pipeline bench errored: {pipeline['error']}")
+        return
+    print(
+        "perfgate: pipeline warm tick {p:.4f}s vs serial {s:.4f}s — "
+        "speedup {x:.2f}x, overlap_efficiency={e}, donated={d}, "
+        "donation_reallocs={r}, identical_assignments={i}".format(
+            p=pipeline["pipelined_tick_s"], s=pipeline["serial_tick_s"],
+            x=pipeline.get("speedup", 0.0),
+            e=pipeline.get("overlap_efficiency"),
+            d=pipeline.get("donated"),
+            r=pipeline.get("donation_reallocs"),
+            i=pipeline.get("identical_assignments"),
+        )
+    )
+    eff = pipeline.get("overlap_efficiency")
+    if eff is not None and eff < 0.5:
+        print(
+            "perfgate: WARNING pipeline overlap efficiency below 0.5 — most "
+            "of the decode fetch is still exposed on the critical path (a "
+            "sync point crept in ahead of the completion barrier, or the "
+            "ticks have no host work to hide; docs/KERNEL_PERF.md Layer 7)"
+        )
+    if pipeline.get("identical_assignments") is False:
+        print(
+            "perfgate: WARNING pipelined loop diverged from the serial loop "
+            "— the overlap must be bit-identical (tests/test_pipeline.py)"
+        )
+    if pipeline.get("speedup", 0.0) < 1.2:
+        print(
+            "perfgate: WARNING pipeline speedup below the 1.2x ISSUE-14 "
+            "acceptance floor — the overlap is not paying for itself"
         )
 
 
@@ -366,6 +417,7 @@ def main() -> int:
     pods_per_sec = detail.get("pods_per_sec")
     warn_compile_budget(detail)
     report_churn(detail)
+    report_pipeline(detail)
     report_policy(detail)
     report_sharded(detail)
     report_tenant(detail)
