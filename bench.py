@@ -593,7 +593,8 @@ def pipeline_line(n_pods: int = 100_000, n_its: int = 2000,
             touched += len(d.requests)
         return touched
 
-    def anchor_leg(pipelined: bool) -> dict:
+    def anchor_leg(pipelined: bool, n_ticks=None) -> dict:
+        n_ticks = ticks if n_ticks is None else n_ticks
         ingest = PodIngest()
         ingest.add_all(pods)  # pods are read-only to the solve: legs share
         session = IncrementalSolveSession(
@@ -609,7 +610,7 @@ def pipeline_line(n_pods: int = 100_000, n_its: int = 2000,
         reps: dict = {}
         tick_walls, overlaps = [], []
         ring = pipeline_mod.SolvePipeline()  # KC_PIPELINE_DEPTH deep
-        for tick in range(ticks + 1):  # tick 0 warms; excluded from stats
+        for tick in range(n_ticks + 1):  # tick 0 warms; excluded from stats
             t_tick = time.perf_counter()
             churn(ingest, reps, tick)
             if pipelined:
@@ -671,10 +672,19 @@ def pipeline_line(n_pods: int = 100_000, n_its: int = 2000,
         }
 
     saved = os.environ.get("KC_PIPELINE")
+    saved_wd = os.environ.get("KC_WATCHDOG")
     try:
         os.environ["KC_PIPELINE"] = "1"
         pipe = anchor_leg(True)
         repairs = repair_segment()
+        # watchdog-overhead segment (tools/perfgate.py report_watchdog): the
+        # same pipelined anchor loop, SAME tick count, with KC_WATCHDOG=0 —
+        # the per-tick delta is what the monitored dispatch/fetch wrappers
+        # cost the hot path (advisory budget: <2% of pipeline_warm_tick_s;
+        # equal-length legs so tick variance doesn't masquerade as overhead)
+        os.environ["KC_WATCHDOG"] = "0"
+        unmonitored = anchor_leg(True)
+        os.environ.pop("KC_WATCHDOG", None)
         os.environ["KC_PIPELINE"] = "0"
         serial = anchor_leg(False)
     finally:
@@ -682,9 +692,20 @@ def pipeline_line(n_pods: int = 100_000, n_its: int = 2000,
             os.environ.pop("KC_PIPELINE", None)
         else:
             os.environ["KC_PIPELINE"] = saved
+        if saved_wd is None:
+            os.environ.pop("KC_WATCHDOG", None)
+        else:
+            os.environ["KC_WATCHDOG"] = saved_wd
 
     identical = serial["signature"] == pipe["signature"]
     serial_s, pipe_s = serial["tick_s"], pipe["tick_s"]
+    unmon_s = unmonitored["tick_s"]
+    # clamped at 0: a faster monitored leg is measurement noise, not
+    # negative overhead
+    watchdog_overhead = (
+        round(max((pipe_s - unmon_s) / unmon_s, 0.0), 4) if unmon_s > 0
+        else 0.0
+    )
     return {
         "pods": n_pods,
         "instance_types": n_its,
@@ -694,6 +715,8 @@ def pipeline_line(n_pods: int = 100_000, n_its: int = 2000,
         "pipelined_tick_s": round(pipe_s, 4),
         "speedup": round(serial_s / pipe_s, 2) if pipe_s > 0 else 0.0,
         "overlap_efficiency": pipe["overlap_efficiency"],
+        "unmonitored_tick_s": round(unmon_s, 4),
+        "watchdog_overhead_frac": watchdog_overhead,
         "donated": repairs["donated"],
         "donation_reallocs": repairs["donation_reallocs"],
         "repair_modes": repairs["modes"],
@@ -1159,13 +1182,22 @@ def main() -> None:
     # encode + trace + compile + solve + decode, with empty or stale caches.
     # ingest_s is the classification leg alone (the O(pods) host loop);
     # classify_s/planes_s/upload_s split the whole host ingest pipeline below.
+    # hang coverage (tools/tpu_watch.py): KC_PROBE_TIMEOUT_S bounds the
+    # PROBE, but the first real dispatch after a healthy probe can still
+    # wedge — the cold solve below is the one call every r02–r05 hang would
+    # have parked forever, so it (and every later stage, via the monitored
+    # run_prepared/fetch sites) rides the watchdog; timeouts land in
+    # ``detail.watchdog_timeouts`` instead of a silent stuck bench
+    from karpenter_core_tpu.utils import watchdog as watchdog_mod
+
+    watchdog_mod.reset_stats()
     t0 = time.perf_counter()
     ingest = PodIngest()
     ingest.add_all(pods)
     ingest_s = time.perf_counter() - t0
     classify_s = ingest_s
     snapshot = solver.encode(ingest)
-    out = solve_ops.solve(snapshot)
+    out = watchdog_mod.run("bench.solve", solve_ops.solve, snapshot)
     results = solver.decode(snapshot, out)
     first_boot_cold_s = time.perf_counter() - t0
 
@@ -1181,7 +1213,7 @@ def main() -> None:
         t0 = time.perf_counter()
         snapshot = solver.encode(ingest)
         t1 = time.perf_counter()
-        out = solve_ops.solve(snapshot)
+        out = watchdog_mod.run("bench.solve", solve_ops.solve, snapshot)
         t2 = time.perf_counter()
         results = solver.decode(snapshot, out)
         t3 = time.perf_counter()
@@ -1218,7 +1250,7 @@ def main() -> None:
     # expansion to decode_s; tools/perfgate.py gates each independently so
     # the pipelining work has a stable baseline.
     t0 = time.perf_counter()
-    out = solve_ops.solve(snapshot)
+    out = watchdog_mod.run("bench.solve", solve_ops.solve, snapshot)
     solve_ops.sync_outputs(out)
     t1 = time.perf_counter()
     solver.decode(snapshot, out)
@@ -1330,10 +1362,14 @@ def main() -> None:
 
     scheduled = sum(len(n.pods) for n in results.new_nodes)
     pods_per_sec = scheduled / warm_s if warm_s > 0 else 0.0
+    watchdog_snapshot = watchdog_mod.stats()
     detail = {
         "scheduled": scheduled,
         "failed": len(results.failed_pods),
         "nodes": len(results.new_nodes),
+        # per-site watchdog abandonments across the whole run (empty = no
+        # hangs); a non-empty map is the bench's structured hang evidence
+        "watchdog_timeouts": watchdog_snapshot["timeouts"],
         "pods_per_sec": round(pods_per_sec),
         "cold_s": round(cold_s, 2),
         "first_boot_cold_s": round(first_boot_cold_s, 2),
@@ -1377,6 +1413,11 @@ def main() -> None:
         detail["pipeline_speedup"] = pipeline["speedup"]
         detail["pipeline_overlap_efficiency"] = pipeline["overlap_efficiency"]
         detail["pipeline_donation_reallocs"] = pipeline["donation_reallocs"]
+        # watchdog-overhead mirror (report_watchdog advisory: < 2% of the
+        # pipelined warm tick)
+        detail["pipeline_watchdog_overhead_frac"] = pipeline[
+            "watchdog_overhead_frac"
+        ]
     detail["policy"] = policy
     if policy and "error" not in policy:
         # stage mirror for the perfgate objective_s gate + the acceptance

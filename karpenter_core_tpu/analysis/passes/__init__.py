@@ -12,11 +12,12 @@ from karpenter_core_tpu.analysis.passes import (
     lock_order,
     retrace_budget,
     trace_safety,
+    unbounded_block,
 )
 
 ALL_PASSES = [
     trace_safety, retrace_budget, lock_order, hygiene, instrumented,
-    chaos_hygiene,
+    chaos_hygiene, unbounded_block,
 ]
 
 __all__ = ["ALL_PASSES"]

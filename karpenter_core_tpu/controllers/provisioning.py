@@ -284,6 +284,12 @@ class ProvisioningController:
             reset_timeout_s=SOLVER_BREAKER_RESET_S,
             name="solver-backend",
         )
+        # the quarantine ladder over that breaker (utils/watchdog.py): each
+        # half-open window runs a deadline-bounded canary solve (tiny fixed
+        # fleet, known answer) instead of risking a real batch — only a
+        # verified canary re-admits the device path.  Built lazily (needs
+        # the watchdog module); inert when KC_WATCHDOG=0.
+        self._quarantine = None
         self._requeue_backoff = retry.Backoff(0.5, 60.0, max_exponent=7)
         self.last_reconcile_s: Optional[float] = None
         # host ingest/classification wall seconds of the last batch split —
@@ -528,6 +534,23 @@ class ProvisioningController:
                     TPU_KERNEL_FALLBACK.labels("degraded").inc()
                     return self._schedule_degraded(pods, state_nodes), None
                 was_half_open = self.solver_breaker.state == retry.HALF_OPEN
+                if was_half_open and not self.solver_endpoint:
+                    # (remote topology excluded: a CPU controller replica
+                    # must never initialize a device backend, and an
+                    # in-process canary would probe the wrong thing — the
+                    # half-open trial there stays the real remote batch)
+                    from karpenter_core_tpu.utils import watchdog as watchdog_mod
+
+                    if watchdog_mod.watchdog_enabled():
+                        # quarantine re-admission: prove the backend on a
+                        # deadline-bounded canary BEFORE trusting it with a
+                        # real batch.  Verified → the breaker closed and this
+                        # batch rides the device path normally; anything else
+                        # → the breaker re-opened, serve this batch degraded.
+                        if not self._canary_readmit():
+                            TPU_KERNEL_FALLBACK.labels("quarantined").inc()
+                            return self._schedule_degraded(pods, state_nodes), None
+                        was_half_open = False
                 try:
                     results = self._schedule_tpu(pods, state_nodes)
                 except NoProvisionersError:
@@ -621,6 +644,70 @@ class ProvisioningController:
             timer.daemon = True
             timer.start()
         return results
+
+    def _canary_readmit(self) -> bool:
+        """One quarantine-ladder rung: a deadline-bounded canary solve
+        against the quarantined backend (utils/watchdog.BackendQuarantine).
+        True re-admits the device path (breaker closed); False keeps it
+        quarantined (breaker re-opened) — the next half-open window retries,
+        so a dead backend is probed periodically at zero risk to real
+        batches."""
+        from karpenter_core_tpu.utils import watchdog as watchdog_mod
+
+        if self._quarantine is None:
+            self._quarantine = watchdog_mod.BackendQuarantine(
+                self.solver_breaker, self._run_canary
+            )
+        return self._quarantine.try_readmit()
+
+    def _run_canary(self) -> Optional[bool]:
+        """The canary solve itself: a tiny FIXED fleet with a known answer —
+        8 identical small pods against the real catalog must all place, on
+        any healthy backend, in well under the canary deadline.  Runs the
+        full encode → dispatch → fetch → decode path (each leg individually
+        watchdog-bounded), so a device that hangs at ANY stage fails the
+        canary instead of wedging a worker.  Returns None (no verdict —
+        trial slot released, breaker untouched) when the backend was never
+        exercised: no provisioners to solve against, or the canary shape
+        itself routed off the kernel."""
+        from karpenter_core_tpu.apis.objects import (
+            Container,
+            ObjectMeta,
+            PodSpec,
+            ResourceRequirements,
+        )
+        from karpenter_core_tpu.models.snapshot import KernelUnsupported
+        from karpenter_core_tpu.solver.tpu import TPUSolver
+
+        provisioners = self.kube_client.list_provisioners()
+        if not provisioners:
+            return None  # cluster-config condition, not backend evidence
+        solver = TPUSolver(
+            self.cloud_provider, provisioners,
+            daemonset_pods=self.get_daemonset_pods(),
+            kube_client=self.kube_client,
+        )
+        proto = Pod(
+            metadata=ObjectMeta(name="watchdog-canary"),
+            spec=PodSpec(containers=[Container(
+                resources=ResourceRequirements(
+                    requests={"cpu": 0.1, "memory": 128 * 2**20}
+                )
+            )]),
+        )
+        pods = [proto] * 8
+        try:
+            results = solver.solve(pods)
+        except KernelUnsupported:
+            return None  # shape routing: the device was never dispatched
+        placed = sum(len(d.pods) for d in results.new_nodes) + sum(
+            len(p) for p in results.existing_assignments.values()
+        )
+        return (
+            placed == len(pods)
+            and not results.failed_pods
+            and not results.spread_residual_pods
+        )
 
     def _schedule_tpu(self, pods: List[Pod], state_nodes) -> Optional[SchedulingResults]:
         """Route the batch through the TPU kernel; None falls back to the host

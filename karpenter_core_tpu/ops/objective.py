@@ -159,4 +159,13 @@ def select_for_state(state, planes, config, capacity_types) -> ObjectiveSelectio
         jnp.asarray(planes.throughput), jnp.asarray(is_spot),
         weights_of(config),
     )
-    return ObjectiveSelection(*jax.device_get(tuple(selection)))
+    from karpenter_core_tpu.utils import watchdog
+
+    # the objective stage's device→host fetch blocks like every barrier:
+    # watchdog-bounded so a quiet device fails the decode, not the process
+    return ObjectiveSelection(
+        *watchdog.run(
+            "pipeline.fetch", jax.device_get, tuple(selection),
+            key="objective",
+        )
+    )

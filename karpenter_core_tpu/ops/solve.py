@@ -2218,8 +2218,12 @@ def sync_outputs(outputs: SolveOutputs) -> SolveOutputs:
     work (overlap solve[k+1] with decode[k]) — call this between the two so
     device compute lands in the solve stage and decode measures only
     transfer + host expansion.  Production paths deliberately do NOT sync
-    here: skipping it saves one relay round trip (~67 ms)."""
-    jax.block_until_ready(outputs)
+    here: skipping it saves one relay round trip (~67 ms).  The barrier runs
+    under the watchdog (utils/watchdog.py): a device that went quiet raises
+    a bounded SolveTimeout instead of blocking forever."""
+    from karpenter_core_tpu.utils import watchdog
+
+    watchdog.run("solve.sync", jax.block_until_ready, outputs)
     return outputs
 
 

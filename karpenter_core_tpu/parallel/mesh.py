@@ -383,7 +383,9 @@ def solve_catalog_sharded(
         features=solve_ops.snapshot_features(snapshot),
         mesh_axes=((CATALOG_AXIS, axis_size),),
     )
-    jax.block_until_ready(out)
+    from karpenter_core_tpu.utils import watchdog
+
+    watchdog.run("solve.sync", jax.block_until_ready, out)
     return out
 
 
@@ -433,12 +435,17 @@ def monte_carlo_solve(
         mesh, key_has_bounds, n_slots, snapshot.scan_passes, avail_idx,
         compilecache.snap_features(solve_ops.snapshot_features(snapshot)),
     )
+    from karpenter_core_tpu.utils import watchdog
+
     with mesh:
         scheduled, failed, nodes, cost = fn(
             avail_r, cls, statics_arrays, it_price
         )
-        scheduled, failed, nodes, cost = jax.device_get(
-            (scheduled, failed, nodes, cost)
+        # monte-carlo replicas block on one batched fetch: deadline-bounded
+        # like every device→host barrier (utils/watchdog.py)
+        scheduled, failed, nodes, cost = watchdog.run(
+            "mesh.monte_carlo", jax.device_get,
+            (scheduled, failed, nodes, cost), key=n_replicas,
         )
     return {
         "replicas": n_replicas,
@@ -538,9 +545,12 @@ def policy_monte_carlo(
         mesh, key_has_bounds, n_slots, snapshot.scan_passes, avail_idx,
         compilecache.snap_features(solve_ops.snapshot_features(snapshot)),
     )
+    from karpenter_core_tpu.utils import watchdog
+
     with mesh:
-        scheduled, failed, nodes, cost = jax.device_get(
-            fn(avail_r, cls, statics_arrays, it_price)
+        scheduled, failed, nodes, cost = watchdog.run(
+            "mesh.monte_carlo", jax.device_get,
+            fn(avail_r, cls, statics_arrays, it_price), key=n_replicas,
         )
     cost = np.asarray(cost, dtype=np.float64)
     failed = np.asarray(failed, dtype=np.int64)
@@ -652,12 +662,16 @@ def crossed_consolidation_study(
             solve_ops.features_with_existing(snapshot, ex_static)
         ),
     )
+    from karpenter_core_tpu.utils import watchdog
+
     with mesh:
-        failed, n_new = jax.device_get(
+        failed, n_new = watchdog.run(
+            "mesh.monte_carlo", jax.device_get,
             fn(
                 avail_r, sizes, cls, statics_arrays, ex_state, ex_static,
                 jnp.asarray(candidate_rank), jnp.asarray(ex_cls_count),
-            )
+            ),
+            key="crossed",
         )
     failed = np.asarray(failed)[:n_replicas, : len(prefix_sizes)]
     n_new = np.asarray(n_new)[:n_replicas, : len(prefix_sizes)]

@@ -45,6 +45,7 @@ import json
 import logging
 import os
 import threading
+import time
 from concurrent import futures
 from typing import Dict, List, Optional
 
@@ -58,6 +59,7 @@ from karpenter_core_tpu.service import journal as journal_mod
 from karpenter_core_tpu.service import tenant as tenant_mod
 from karpenter_core_tpu.solver.tpu import TPUSolver
 from karpenter_core_tpu.state.cluster import StateNode
+from karpenter_core_tpu.utils.watchdog import SolveTimeout
 
 log = logging.getLogger(__name__)
 
@@ -233,15 +235,44 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
         if len(ordered) > plane.config.max_sessions:
             ordered = ordered[-plane.config.max_sessions:]
         warm = 0
+        # warm-restart watchdog (docs/SERVICE.md): each tenant's replay runs
+        # under a wall-clock deadline with a progress log every N frames —
+        # one pathological chain (or a quiet device mid-replay; each solve
+        # inside is already watchdog-bounded) downgrades THAT tenant to the
+        # session-lost re-anchor instead of stalling the whole restart.
+        # KC_JOURNAL_REPLAY_DEADLINE_S (default 30, 0 disables) bounds one
+        # tenant; KC_JOURNAL_REPLAY_LOG_EVERY (default 16) paces the log.
+        replay_deadline_s = tenant_mod._env_f(
+            "KC_JOURNAL_REPLAY_DEADLINE_S", 30.0
+        )
+        log_every = max(tenant_mod._env_i("KC_JOURNAL_REPLAY_LOG_EVERY", 16), 1)
         plane._bypass_coalescer = True  # replay is solo: no rendezvous waits
         try:
             for tenant_id, chain in ordered:
                 entry = plane.restore_entry(tenant_id)
+                t_replay = time.perf_counter()
                 try:
                     with tracing.span("session.recover", tenant=tenant_id,
                                       records=len(chain)):
-                        for rec in chain:
+                        for i, rec in enumerate(chain):
+                            if (
+                                replay_deadline_s > 0
+                                and time.perf_counter() - t_replay
+                                > replay_deadline_s
+                            ):
+                                raise journal_mod.RecoveryMismatch(
+                                    f"replay exceeded its "
+                                    f"{replay_deadline_s:.0f}s deadline at "
+                                    f"frame {i}/{len(chain)}"
+                                )
                             self._replay_record(entry, rec)
+                            if (i + 1) % log_every == 0:
+                                log.info(
+                                    "session recovery: tenant %s frame "
+                                    "%d/%d (%.1fs elapsed)", tenant_id,
+                                    i + 1, len(chain),
+                                    time.perf_counter() - t_replay,
+                                )
                         state = entry.session.lineage_state()
                         want = chain[-1].get("state") or {}
                         if state != want:
@@ -857,6 +888,38 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                         grpc.StatusCode.FAILED_PRECONDITION,
                         f"kernel unsupported: {e}",
                     )
+                except SolveTimeout as e:
+                    # the device went quiet under this tenant's solve: a
+                    # STRUCTURED timeout ejection, never a wedged worker —
+                    # the watchdog already abandoned the stuck call and the
+                    # session canceled/re-anchored its pipeline state
+                    # (solver/incremental), so the worker thread is free the
+                    # moment this returns.  The timeout is a backend
+                    # verdict, not tenant abuse, but it still counts on the
+                    # tenant breaker: a tenant whose snapshots reliably hang
+                    # the device is indistinguishable from poison and must
+                    # isolate (docs/SERVICE.md "Timeout ejection").
+                    verdict = True
+                    plane.record_timeout(entry)
+                    log.warning(
+                        "tenant %s solve timed out at %s (deadline %.2fs); "
+                        "ejected with watchdog-timeout", tid, e.site,
+                        e.deadline_s,
+                    )
+                    return msgpack.packb({
+                        "error": {
+                            "kind": "ejected",
+                            "reason": str(e),
+                            "timeout": {
+                                "site": e.site,
+                                "deadlineS": round(e.deadline_s, 3),
+                            },
+                        },
+                        "tenant": {
+                            "id": tid,
+                            "sessionVersion": entry.session.lineage_version(),
+                        },
+                    })
                 except Exception as e:  # noqa: BLE001 - eject, batch survives
                     verdict = True
                     plane.record_fault(entry)

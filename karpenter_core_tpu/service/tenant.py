@@ -415,7 +415,7 @@ class BatchCoalescer:
             # group's dispatch (another worker thread) overlaps this group's
             # device→host copy instead of queueing behind per-array blocking
             # transfers (docs/KERNEL_PERF.md "Layer 7").
-            outs = pipeline_mod.fetch_tree(fn(*args))
+            outs = pipeline_mod.fetch_tree(fn(*args), site="tenant.batch")
             return [
                 jax.tree_util.tree_map(lambda a, i=i: a[i], outs)
                 for i in range(len(preps))
@@ -677,6 +677,15 @@ class TenantPlane:
     def record_fault(self, entry: TenantEntry) -> None:
         """This tenant's solve faulted (ejected from its batch)."""
         TENANT_EJECTED.labels(entry.tenant_id, "solve-fault").inc()
+        entry.breaker.record_failure()
+
+    def record_timeout(self, entry: TenantEntry) -> None:
+        """This tenant's solve overran its watchdog deadline (a structured
+        ejection, docs/SERVICE.md "Timeout ejection"): the abandoned device
+        call never wedges the worker, and the tenant breaker counts it — a
+        tenant whose snapshots reliably hang the backend isolates exactly
+        like one whose snapshots fault it."""
+        TENANT_EJECTED.labels(entry.tenant_id, "watchdog-timeout").inc()
         entry.breaker.record_failure()
 
     def record_ok(self, entry: TenantEntry) -> None:

@@ -223,10 +223,72 @@ def churn_steady_sharded(seed: int = 59) -> SoakScenario:
     )
 
 
+def hung_device(seed: int = 73) -> SoakScenario:
+    """A churn-steady fleet whose device backend goes QUIET mid-run: a
+    seeded ``solver.hang`` chaos fault (kind ``hang``, docs/CHAOS.md) stalls
+    one monitored dispatch/fetch past its watchdog deadline.  The SLO
+    asserts the hang-proofing contract end to end: pending pods keep
+    draining through degraded host solves while the backend is quarantined
+    (bounded degraded time + bounded p99 pending age + bounded tick wall),
+    and the TPU path re-admits after the stall clears — the canary-verified
+    half-open trial — so the run must FINISH un-degraded.  Slow matrix
+    (kernel compiles); the tier-1 twin is tests/test_watchdog.py.  The
+    verdict replays from (scenario, seed): the stall schedule is a pure
+    function of the seed-replayable monitored-dispatch hit order, and the
+    breaker/canary timing steps on FakeClock."""
+    return SoakScenario(
+        name="hung-device",
+        seed=seed,
+        generator="diurnal",
+        # flat Poisson churn: standing population ≈ rate × lifetime ≈ 4.8k
+        params={
+            "duration_s": 300.0, "period_s": 300.0,
+            "base_rate_per_s": 16.0, "peak_rate_per_s": 16.0,
+            "mean_lifetime_s": 300.0,
+        },
+        slo={"rules": [
+            {"probe": "pending_age_p99_s", "agg": "max", "limit": 240.0},
+            {"probe": "machine_leaks", "agg": "max", "limit": 0.0},
+            {"probe": "pending_pods", "agg": "final", "limit": 0.0},
+            # degraded host progress is EXPECTED while quarantined — bound
+            # the window instead of forbidding it, and require re-admission
+            # by the end (final 0 = the canary verified and the device path
+            # came back)
+            {"probe": "degraded", "agg": "time_above", "above": 0.0,
+             "limit": 120.0},
+            {"probe": "degraded", "agg": "final", "limit": 0.0},
+            # the hang tick pays one abandoned deadline + a canary — still
+            # bounded wall time per tick (advisory, like every wall probe)
+            {"probe": "tick_wall_s", "agg": "max", "limit": 60.0},
+        ]},
+        tick_s=15.0,
+        settle_ticks=40,
+        use_tpu_kernel=True,
+        tpu_kernel_min_pods=128,
+        chaos_points={
+            # hits 9 and 10 stall until abandoned: each kernel solve hits
+            # the point several times across its dispatch/fetch sites and a
+            # SolveTimeout aborts the rest of its batch, so consecutive
+            # indices land in two CONSECUTIVE batches — exactly the
+            # TPU_KERNEL_MAX_FAILURES streak that opens the breaker and
+            # quarantines the backend a couple of solves into the run
+            "solver.hang": {"schedule": [9, 10], "kind": "hang"},
+        },
+        env={
+            # small real-time deadlines so the abandoned call costs the run
+            # seconds, not the production 120 s ceiling
+            "KC_WATCHDOG_FLOOR_S": "0.2",
+            "KC_WATCHDOG_COLD_MULT": "25",
+            "KC_WATCHDOG_CEILING_S": "60",
+        },
+    )
+
+
 CATALOG: Dict[str, Callable[[int], SoakScenario]] = {
     "deploy-storm-smoke": deploy_storm_smoke,
     "churn-steady": churn_steady,
     "churn-steady-sharded": churn_steady_sharded,
+    "hung-device": hung_device,
     "diurnal-consolidation": diurnal_consolidation,
     "batch-flood-flaky-api": batch_flood_flaky_api,
     "mass-eviction-capacity": mass_eviction_capacity,

@@ -222,7 +222,11 @@ class TPUConsolidationSearch:
             # copies started up front) instead of eight serial np.asarray
             # transfers — the coarse sweep's fetch no longer serializes
             # array-by-array ahead of the refine sweep's dispatch
-            out = pipeline_mod.fetch_tree(out)  # structure-preserving
+            # structure-preserving; the sweep's barrier budgets under its
+            # own watchdog site (a hung lane sweep must not wedge the
+            # deprovisioner — it surfaces as a SolveTimeout the breaker
+            # counts)
+            out = pipeline_mod.fetch_tree(out, site="consolidate.sweep")
         n_new = np.asarray(out.n_new)
         failed = np.asarray(out.failed)
         uninit = np.asarray(out.used_uninitialized)

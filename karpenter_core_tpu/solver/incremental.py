@@ -50,6 +50,7 @@ from karpenter_core_tpu.models.store import (
 )
 from karpenter_core_tpu.ops import solve as solve_ops
 from karpenter_core_tpu.utils import pipeline as pipeline_mod
+from karpenter_core_tpu.utils.watchdog import SolveTimeout
 
 log = logging.getLogger(__name__)
 
@@ -559,8 +560,11 @@ class IncrementalSolveSession:
                     ),
                 )
                 return box
-            n_next_h, failed_h = jax.device_get(
-                (outputs.state.n_next, outputs.failed)
+            from karpenter_core_tpu.utils import watchdog
+
+            n_next_h, failed_h = watchdog.run(
+                "pipeline.fetch", jax.device_get,
+                (outputs.state.n_next, outputs.failed), key="anchor-check",
             )
             slots = outputs.assign.shape[1]
             if int(np.sum(failed_h)) > 0 and int(n_next_h) >= slots:
@@ -585,8 +589,11 @@ class IncrementalSolveSession:
         if TPUSolver.fetch_exhausted(fetched, slots):
             outputs = f["run"](f["prep"], n_slots=slots * 2)
             ticket = f["solver"].begin_fetch(outputs, ring=self._staging)
-            ticket.wait()
+            # adopt the retry's ticket BEFORE its barrier: a wait() that
+            # fails must leave THIS ticket reachable for the settle error
+            # path's invalidate, not leak it behind the consumed original
             f["outputs"], f["ticket"] = outputs, ticket
+            ticket.wait()
         self._adopt(
             f["versioned"], f["prep"], f["outputs"], None, f["members"],
             f["supply"], f["state_nodes"], f["prev_nodes"], f["reason"],
@@ -600,9 +607,13 @@ class IncrementalSolveSession:
                state_nodes, prev_nodes, reason):
         import jax
 
+        from karpenter_core_tpu.utils import watchdog
+
         carry = solve_ops.warm_carry_of(outputs)
-        assign, assign_ex, n_next = jax.device_get(
-            (outputs.assign, outputs.assign_existing, outputs.state.n_next)
+        assign, assign_ex, n_next = watchdog.run(
+            "pipeline.fetch", jax.device_get,
+            (outputs.assign, outputs.assign_existing, outputs.state.n_next),
+            key="adopt",
         )
         assign = np.asarray(assign, dtype=np.int32).copy()
         assign_ex = np.asarray(assign_ex, dtype=np.int32).copy()
@@ -906,6 +917,15 @@ class IncrementalSolveSession:
         disp = self._delta_dispatch(plan)
         try:
             fetched = disp["ticket"].wait()
+        except BaseException:
+            # ANY failed barrier — the device going quiet (SolveTimeout) or
+            # throwing — cancels the tick cleanly: ticket retired from the
+            # open ledger, donation ledger balanced, lineage dropped so
+            # nothing is ever half-applied.  The error surfaces to the
+            # caller's breaker; the next solve re-anchors from scratch.
+            self._cancel_tick(disp)
+            raise
+        try:
             if self._delta_exhausted(disp, fetched):
                 return None
             results = self._delta_results(disp)
@@ -919,6 +939,18 @@ class IncrementalSolveSession:
                 self._warm = None
             raise
         return results
+
+    def _cancel_tick(self, disp) -> None:
+        """Invalidate a timed-out tick's in-flight device state: the
+        FetchTicket retires from the open ledger (its device refs drop, so
+        an abandoned copy cannot pin buffers into the next tick), a donated
+        dispatch's ledger entry is balanced, and the warm lineage drops —
+        its carry is either donated-dead or aliased by the abandoned fetch,
+        and a half-applied lineage must never seed repairs."""
+        disp["ticket"].invalidate()
+        if disp["donated"]:
+            pipeline_mod.record_donation_canceled()
+        self._warm = None
 
     def _delta_dispatch_deferred(self, delta, by_uid, pods_or_classes,
                                  members, state_nodes, bound_pods,
@@ -999,9 +1031,20 @@ class IncrementalSolveSession:
                 self._settle_full(pending)
             else:
                 disp = pending.data["disp"]
-                fetched = disp["ticket"].wait()
-                if self._delta_exhausted(disp, fetched):
-                    mode, reason = MODE_FULL, "slots-exhausted"
+                try:
+                    fetched = disp["ticket"].wait()
+                except SolveTimeout:
+                    # fault-triggered re-anchor: the watchdog abandoned this
+                    # tick's barrier, so cancel its in-flight state cleanly
+                    # and rebuild the lineage from the DISPATCH-TIME
+                    # population capture — the same escalation the deferred
+                    # window overflow takes, now driven by a hang instead of
+                    # slot pressure.  The re-anchor's own dispatch is still
+                    # watchdog-bounded: a persistently quiet device surfaces
+                    # as a SolveTimeout in the handle and the caller's
+                    # breaker quarantines the backend.
+                    self._cancel_tick(disp)
+                    mode, reason = MODE_FULL, "watchdog-timeout"
                     results = self._full_solve(
                         pending.data["captured_classes"],
                         pending.data["members_at"],
@@ -1010,15 +1053,44 @@ class IncrementalSolveSession:
                         pending.data["supply_anchor"], reason,
                     )
                     pending.box._settle_with(results=results)
+                except BaseException:
+                    # a non-timeout barrier fault: same clean cancellation
+                    # (ticket/donation ledgers must not leak on ANY error),
+                    # but no re-anchor — the error routes to the handle.
+                    # (A SolveTimeout raised by the RE-ANCHOR above is not
+                    # caught here — sibling except clauses don't catch
+                    # exceptions raised inside each other.)
+                    self._cancel_tick(disp)
+                    raise
                 else:
-                    self._delta_adopt(disp, fetched)
-                    pending.box._settle_with(
-                        decode=lambda: self._delta_results(disp)
-                    )
-                    self._undecoded = pending.box
+                    if self._delta_exhausted(disp, fetched):
+                        mode, reason = MODE_FULL, "slots-exhausted"
+                        results = self._full_solve(
+                            pending.data["captured_classes"],
+                            pending.data["members_at"],
+                            pending.data["state_nodes"],
+                            pending.data["bound_pods"],
+                            pending.data["supply_anchor"], reason,
+                        )
+                        pending.box._settle_with(results=results)
+                    else:
+                        self._delta_adopt(disp, fetched)
+                        pending.box._settle_with(
+                            decode=lambda: self._delta_results(disp)
+                        )
+                        self._undecoded = pending.box
         except BaseException as e:  # noqa: BLE001 - routed to the handle
             if pending.kind == "full" or pending.data["disp"]["donated"]:
                 self._warm = None  # serial parity: a failed anchor resets
+            # keep the ticket ledger leak-free on EVERY error path — a
+            # failed anchor barrier (timed out or thrown) leaves a ticket
+            # whose copy was never consumed
+            ticket = (
+                pending.data.get("ticket") if pending.kind == "full"
+                else pending.data["disp"]["ticket"]
+            )
+            if ticket is not None and not ticket.done():
+                ticket.invalidate()
             pending.box._settle_with(error=e)
             SOLVE_MODE.labels(mode).inc()
             self.last_mode, self.last_reason = mode, f"{reason}:failed"

@@ -75,9 +75,15 @@ class _LazyPlanes:
 
     def _fetch(self) -> None:
         if self._viable is None:
+            from karpenter_core_tpu.utils import watchdog
+
             with tracing.span("materialize"):
-                viable_p, zone_p, ct_p, used = jax.device_get(
-                    (self._viable_p, self._zone_p, self._ct_p, self._used_d)
+                # deadline-bounded: the big-plane copy crosses the same relay
+                # tunnel the solve fetch does, and can hang the same way
+                viable_p, zone_p, ct_p, used = watchdog.run(
+                    "pipeline.fetch", jax.device_get,
+                    (self._viable_p, self._zone_p, self._ct_p, self._used_d),
+                    key="planes",
                 )
                 self._viable = solve_ops.unpack_bool(viable_p, self._n_it)
                 self._zone = solve_ops.unpack_bool(zone_p, self._n_zones)
@@ -958,10 +964,36 @@ class TPUSolver:
         donate = "auto"
         if self.policy is not None and getattr(self.policy, "enabled", False):
             donate = False
-        return compilecache.run_solve(
+        from karpenter_core_tpu.utils import pipeline as pipeline_mod
+        from karpenter_core_tpu.utils import watchdog
+
+        # deadline-bounded dispatch (utils/watchdog.py): keyed on the same
+        # static identity the compile cache keys its executable on (shape
+        # bucket via n_slots/passes/features + mesh topology), so warm
+        # latencies of different programs budget separately and a hung
+        # relay surfaces as a structured SolveTimeout, not a wedged worker
+        return watchdog.run(
+            "solve.dispatch",
+            compilecache.run_solve,
             cls, prep.statics_arrays, n_slots or prep.n_slots, prep.key_has_bounds,
             None if warm_carry is not None else prep.ex_state,
             ex_static,
+            key=(
+                int(n_slots or prep.n_slots), int(prep.n_passes),
+                # SNAPPED features, matching the executable run_solve will
+                # actually pick: raw variants that widen to one covering
+                # executable must share one deadline budget
+                tuple(compilecache.snap_features(prep.features))
+                if prep.features is not None else None,
+                getattr(prep, "mesh_axes", None),
+                warm_carry is not None,
+                # executable-variant axes that recompile without moving the
+                # shape identity: a flip (KC_PIPELINE, policy toggling
+                # donation, kernel triage flags) must budget as a fresh
+                # cold key, not spike a warm EWMA into a spurious timeout
+                donate, pipeline_mod.donation_enabled(),
+                compilecache.kernel_flags(),
+            ),
             n_passes=prep.n_passes,
             features=prep.features,
             warm_carry=warm_carry,

@@ -67,6 +67,15 @@ _stats = {
     # staging-ring slots REBUILT because an array's shape/dtype moved
     # (a slot's first fill is the working set, not drift — uncounted)
     "staging_reallocs": 0,
+    # cancellation ledger (utils/watchdog.py): donated dispatches whose tick
+    # was invalidated after a watchdog timeout — the donated carry is dead
+    # and the lineage re-anchors, so donated == donation_canceled + live
+    # donated dispatches at all times (the leak invariant
+    # tests/test_watchdog.py pins)
+    "donation_canceled": 0,
+    # FetchTickets constructed minus tickets retired (first successful wait
+    # or invalidate) — a leak-free loop returns this to 0 at quiesce
+    "tickets_open": 0,
 }
 # last completed fetch's overlap record (provisioning surfaces it as the
 # soak probe ``tick_overlap_s``; bench reads it per tick)
@@ -120,6 +129,14 @@ def record_donation(engaged: bool) -> None:
         _stats["donated" if engaged else "donation_reallocs"] += 1
 
 
+def record_donation_canceled() -> None:
+    """A donated dispatch's tick was invalidated (watchdog timeout): the
+    donated buffer is dead without its results ever being applied — balance
+    the ledger so leak checks can assert donated == canceled + live."""
+    with _lock:
+        _stats["donation_canceled"] += 1
+
+
 def stats() -> Dict[str, int]:
     with _lock:
         return dict(_stats)
@@ -149,15 +166,20 @@ def start_host_copy(tree) -> None:
             pass
 
 
-def fetch_tree(tree):
+def fetch_tree(tree, site: str = "pipeline.fetch"):
     """The batched serial-path fetch: start async copies on every leaf, then
     ONE ``jax.device_get`` over the whole tree — no array-by-array blocking
     (the ``decode.fetch`` contract, now shared by the tenant coalescer and
-    the consolidation sweep)."""
+    the consolidation sweep).  The blocking ``device_get`` runs under the
+    watchdog (utils/watchdog.py) so a hung device→host copy raises a bounded
+    SolveTimeout instead of wedging the caller; ``site`` labels the deadline
+    bucket (the consolidation sweep and tenant coalescer pass their own)."""
     import jax
 
+    from karpenter_core_tpu.utils import watchdog
+
     start_host_copy(tree)
-    return jax.device_get(tree)
+    return watchdog.run(site, jax.device_get, tree)
 
 
 class HostStagingRing:
@@ -213,7 +235,7 @@ class FetchTicket:
     block) lands on the ``pipeline.overlap`` span and ``last_overlap()``."""
 
     __slots__ = ("_arrays", "_host", "_ring", "_label", "_t_dispatch",
-                 "hidden_s", "exposed_s", "planes")
+                 "_open", "_invalid", "hidden_s", "exposed_s", "planes")
 
     def __init__(self, arrays: Tuple, ring: Optional[HostStagingRing] = None,
                  label: str = "solve") -> None:
@@ -222,12 +244,16 @@ class FetchTicket:
         self._ring = ring
         self._label = label
         self._t_dispatch = time.perf_counter()
+        self._open = True
+        self._invalid = False
         self.hidden_s = 0.0
         self.exposed_s = 0.0
         # decode's lazy big-plane bundle rides the ticket when the solver
         # attaches one (solver.tpu.begin_fetch) so deferred decodes never
         # re-touch possibly-donated device buffers
         self.planes = None
+        with _lock:
+            _stats["tickets_open"] += 1
         start_host_copy(arrays)
 
     def done(self) -> bool:
@@ -237,12 +263,44 @@ class FetchTicket:
     def staged(self) -> bool:
         return self._ring is not None
 
+    def _close(self) -> None:
+        if self._open:
+            self._open = False
+            with _lock:
+                _stats["tickets_open"] -= 1
+
+    def invalidate(self) -> None:
+        """Cancel the ticket after a failed/abandoned barrier: drop the
+        TICKET's device refs and retire it from the open ledger; a later
+        wait() raises rather than touching the device again.  (A genuinely
+        hung ``device_get`` still pins the arrays from its own stuck frame
+        until it ever returns — the watchdog drops every reference it
+        controls, but the abandoned call's are the call's own.)"""
+        self._arrays = ()
+        self.planes = None
+        self._invalid = True
+        self._close()
+
     def wait(self) -> Tuple:
+        if self._invalid:
+            raise RuntimeError(
+                f"FetchTicket({self._label}) was invalidated after a "
+                "watchdog timeout; its tick re-anchors instead"
+            )
         if self._host is None:
             import jax
 
+            from karpenter_core_tpu.utils import watchdog
+
             t_block = time.perf_counter()
-            host = jax.device_get(self._arrays)
+            # the completion barrier is the hot path's most likely hang
+            # point (a dead relay wedges the device→host copy silently):
+            # bounded by the watchdog, keyed per ticket label so solve and
+            # decode fetches budget separately
+            host = watchdog.run(
+                "pipeline.fetch", jax.device_get, self._arrays,
+                key=self._label,
+            )
             t_end = time.perf_counter()
             if self._ring is not None:
                 host = self._ring.stage(tuple(host))
@@ -250,6 +308,7 @@ class FetchTicket:
             # drop the device refs: a retained zero-copy view would pin the
             # buffers and silently block the next tick's donation
             self._arrays = ()
+            self._close()
             self.hidden_s = max(t_block - self._t_dispatch, 0.0)
             self.exposed_s = max(t_end - t_block, 0.0)
             with _lock:
@@ -311,6 +370,7 @@ __all__ = [
     "pipeline_depth",
     "pipeline_enabled",
     "record_donation",
+    "record_donation_canceled",
     "reset_stats",
     "start_host_copy",
     "stats",

@@ -33,6 +33,7 @@ from karpenter_core_tpu.analysis.passes import (
     lock_order,
     retrace_budget,
     trace_safety,
+    unbounded_block,
 )
 
 REPO = Path(__file__).resolve().parent.parent
@@ -1263,3 +1264,100 @@ class TestChaosHygienePass:
         from karpenter_core_tpu.analysis.passes import chaos_hygiene
 
         assert chaos_hygiene.run(repo_project) == []
+
+
+class TestUnboundedBlock:
+    """The unbounded-block pass (ISSUE 15): blocking device calls in the
+    device-path subtrees must route through utils/watchdog — raw spellings
+    are findings, monitored spellings are clean."""
+
+    def _run(self, tmp_path, files):
+        return unbounded_block.run(make_project(tmp_path, files))
+
+    def test_raw_device_get_in_ops_is_flagged(self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/ops/kernel.py": textwrap.dedent("""
+                import jax
+
+                def fetch(outputs):
+                    return jax.device_get(outputs)
+
+                def sync(outputs):
+                    jax.block_until_ready(outputs)
+
+                def retire(handle):
+                    return handle.result()
+            """),
+        })
+        rules = sorted((f.path, f.symbol) for f in found)
+        assert rules == [
+            ("badpkg/ops/kernel.py", "fetch"),
+            ("badpkg/ops/kernel.py", "retire"),
+            ("badpkg/ops/kernel.py", "sync"),
+        ]
+
+    def test_monitored_spellings_are_clean(self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/ops/kernel.py": textwrap.dedent("""
+                import jax
+                from karpenter_core_tpu.utils import watchdog
+
+                def fetch(outputs):
+                    # the callable-argument shape: no raw Call node at all
+                    return watchdog.run("site", jax.device_get, outputs)
+
+                def fetch_lambda(outputs):
+                    # lexically inside the monitored call expression
+                    return watchdog.run("site", lambda: jax.device_get(outputs))
+
+                def fetch_instance(outputs):
+                    from karpenter_core_tpu.utils.watchdog import MonitoredDispatch
+                    return MonitoredDispatch("site").run(
+                        lambda: jax.device_get(outputs)
+                    )
+            """),
+        })
+        assert found == []
+
+    def test_unrelated_run_receiver_is_not_a_monitored_scope(self, tmp_path):
+        """A generic ``something_dispatch.run(...)`` must NOT exempt the
+        blocking calls nested inside it — only watchdog/MonitoredDispatch
+        receivers are monitored scopes."""
+        found = self._run(tmp_path, {
+            "badpkg/ops/kernel.py": textwrap.dedent("""
+                import jax
+
+                def sneak(batch_dispatch, outputs):
+                    return batch_dispatch.run(jax.device_get(outputs))
+            """),
+        })
+        assert [f.symbol for f in found] == ["sneak"]
+
+    def test_unwatched_subtrees_and_watchdog_module_exempt(self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/controllers/loop.py": textwrap.dedent("""
+                import jax
+
+                def fetch(outputs):
+                    return jax.device_get(outputs)
+            """),
+            "badpkg/utils/watchdog.py": textwrap.dedent("""
+                def run(site, fn, *args):
+                    return fn(*args)
+
+                def wait(job):
+                    return job.result()
+            """),
+        })
+        assert found == []
+
+    def test_current_tree_only_baselined_sites(self, repo_project):
+        from karpenter_core_tpu.analysis.core import Baseline, apply_baseline
+
+        baseline = Baseline.load(
+            REPO / "karpenter_core_tpu" / "analysis" / "baseline.toml"
+        )
+        kept, _suppressed = apply_baseline(
+            unbounded_block.run(repo_project), baseline
+        )
+        assert kept == [], [f.render() for f in kept]

@@ -502,6 +502,42 @@ class TestWarmRestart:
         assert after == before + 1
         self._stop(server2, client2)
 
+    def test_replay_deadline_downgrades_to_session_lost(self, tmp_path,
+                                                        monkeypatch):
+        """The warm-restart watchdog (ISSUE 15): a tenant whose journal
+        replay overruns KC_JOURNAL_REPLAY_DEADLINE_S downgrades to the
+        ``session-lost`` re-anchor instead of stalling the whole restart."""
+        provider = FakeCloudProvider()
+        server, client = self._serve(provider, tmp_path / "j")
+        r1 = _solve(client, "acme", count=6)
+        v1 = r1["tenant"]["sessionVersion"]
+        import time
+        time.sleep(0.2)
+        self._stop(server, client, abandon=True)
+        before = _counter_value(journal_mod.SESSION_RECOVERED,
+                                outcome="reanchor")
+        # a deadline nothing can meet: the replay downgrades immediately
+        monkeypatch.setenv("KC_JOURNAL_REPLAY_DEADLINE_S", "0.0000001")
+        server2, client2 = self._serve(provider, tmp_path / "j")
+        after = _counter_value(journal_mod.SESSION_RECOVERED,
+                               outcome="reanchor")
+        assert after == before + 1
+        # the tenant is served — cold: a claimed lineage answers session-lost
+        r2 = _solve(client2, "acme", count=6, version=v1)
+        assert r2["tenant"]["reason"] == "session-lost"
+        v2 = r2["tenant"]["sessionVersion"]
+        import time as _t
+        _t.sleep(0.2)  # let the writer drain the fresh anchor
+        self._stop(server2, client2, abandon=True)
+        # with the deadline disabled (0) the re-anchored journal recovers
+        # warm again — the downgrade was the deadline's doing, not damage
+        monkeypatch.setenv("KC_JOURNAL_REPLAY_DEADLINE_S", "0")
+        server3, client3 = self._serve(provider, tmp_path / "j")
+        r3 = _solve(client3, "acme", count=6, version=v2)
+        assert r3["tenant"]["solveMode"] == "delta"
+        assert r3["tenant"]["recovered"] == "warm"
+        self._stop(server3, client3)
+
     def test_evicted_session_is_not_resurrected(self, tmp_path):
         """An LRU-evicted tenant journals a drop record: recovery must not
         bring its lineage back from the dead."""

@@ -89,7 +89,12 @@ class TestGracefulFallback:
         assert env.provisioning.solver_breaker.state == retry.OPEN
 
         # past the reset timeout the breaker half-opens; a healthy trial
-        # batch (stubbed solve) closes it and restores the device path
+        # batch (stubbed solve) closes it and restores the device path.
+        # KC_WATCHDOG=0 keeps the LEGACY real-batch trial this test pins
+        # (still live for the remote topology and the kill switch) — the
+        # canary-gated re-admission ladder has its own coverage in
+        # tests/test_watchdog.py
+        monkeypatch.setenv("KC_WATCHDOG", "0")
         env.clock.step(prov_mod.SOLVER_BREAKER_RESET_S + 1)
         assert env.provisioning.solver_breaker.state == retry.HALF_OPEN
 
@@ -111,6 +116,9 @@ class TestGracefulFallback:
         monkeypatch.setattr(tpu_mod, "TPUSolver", _ExplodingSolver)
         for _ in range(prov_mod.TPU_KERNEL_MAX_FAILURES):
             expect_provisioned(env, *make_pods(3, requests={"cpu": "100m"}))
+        # legacy real-batch trial (see the note in the restore test above):
+        # the canary ladder would otherwise probe the exploding solver first
+        monkeypatch.setenv("KC_WATCHDOG", "0")
         env.clock.step(prov_mod.SOLVER_BREAKER_RESET_S + 1)
         assert env.provisioning.solver_breaker.state == retry.HALF_OPEN
 

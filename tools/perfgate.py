@@ -259,6 +259,35 @@ def report_pipeline(detail: dict) -> None:
         )
 
 
+def report_watchdog(detail: dict) -> None:
+    """Surface the watchdog line: any abandoned (hung) device calls during
+    the bench, and the monitored-dispatch overhead on the pipelined warm
+    tick.  Advisory: warns when the overhead exceeds 2% of
+    ``pipeline_warm_tick_s`` — the wrappers must stay invisible on the hot
+    path (docs/KERNEL_PERF.md "Watchdog")."""
+    timeouts = detail.get("watchdog_timeouts") or {}
+    if timeouts:
+        print(
+            f"perfgate: WARNING watchdog abandoned hung device calls during "
+            f"the bench: {timeouts} — the backend went quiet mid-run "
+            f"(bounded by SolveTimeout instead of hanging the bench)"
+        )
+    overhead = detail.get("pipeline_watchdog_overhead_frac")
+    if overhead is None:
+        return
+    print(
+        f"perfgate: watchdog overhead on the pipelined warm tick: "
+        f"{overhead * 100:.1f}%"
+    )
+    if overhead > 0.02:
+        print(
+            "perfgate: WARNING watchdog overhead above the 2% budget on "
+            "pipeline_warm_tick_s — the monitored dispatch/fetch wrappers "
+            "are no longer invisible (utils/watchdog.py; KC_WATCHDOG=0 to "
+            "A/B locally)"
+        )
+
+
 def report_policy(detail: dict) -> None:
     """Surface the policy-objective line: fleet cost first-fit vs objective
     and the scoring-stage cost.  The fleet-cost delta is the ISSUE-9
@@ -422,6 +451,7 @@ def main() -> int:
     report_sharded(detail)
     report_tenant(detail)
     report_recovery(detail)
+    report_watchdog(detail)
     if pods_per_sec is None:
         print(json.dumps(rec))
         print("perfgate: FAIL (bench produced no pods_per_sec)")

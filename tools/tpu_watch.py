@@ -60,6 +60,16 @@ def main() -> int:
                 f.write(json.dumps(rec) + "\n")
             recorded += 1
             print(f"[tpu_watch] appended record {recorded} to {OUT}", flush=True)
+            # hang evidence: bench's solve stages run under the watchdog
+            # (detail.watchdog_timeouts) — surface any abandonment loudly so
+            # a relay that answered the probe but hung the first dispatch is
+            # diagnosable from the watcher log alone
+            timeouts = (rec.get("detail") or {}).get("watchdog_timeouts") or {}
+            if timeouts:
+                print(
+                    f"[tpu_watch] WARNING watchdog abandoned hung device "
+                    f"calls: {timeouts}", flush=True,
+                )
             # one good record per hour is plenty; back off hard
             sleep_until(3600)
         else:
