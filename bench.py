@@ -685,6 +685,21 @@ def pipeline_line(n_pods: int = 100_000, n_its: int = 2000,
         os.environ["KC_WATCHDOG"] = "0"
         unmonitored = anchor_leg(True)
         os.environ.pop("KC_WATCHDOG", None)
+        # telemetry-overhead segment (tools/perfgate.py report_telemetry):
+        # the same pipelined anchor loop with tracing FULLY enabled — the
+        # per-tick delta against the trace-off ``pipe`` leg above (the
+        # KC_TRACE=0 baseline of the A/B) is what span bookkeeping plus the
+        # occupancy/overlap gauges cost the hot path (advisory: <2% of
+        # pipeline_warm_tick_s; equal-length legs, same rationale as the
+        # watchdog segment)
+        from karpenter_core_tpu import tracing as tracing_mod
+        was_tracing = tracing_mod.enabled()
+        tracing_mod.enable()
+        try:
+            traced = anchor_leg(True)
+        finally:
+            if not was_tracing:
+                tracing_mod.disable()
         os.environ["KC_PIPELINE"] = "0"
         serial = anchor_leg(False)
     finally:
@@ -706,6 +721,11 @@ def pipeline_line(n_pods: int = 100_000, n_its: int = 2000,
         round(max((pipe_s - unmon_s) / unmon_s, 0.0), 4) if unmon_s > 0
         else 0.0
     )
+    traced_s = traced["tick_s"]
+    telemetry_overhead = (
+        round(max((traced_s - pipe_s) / pipe_s, 0.0), 4) if pipe_s > 0
+        else 0.0
+    )
     return {
         "pods": n_pods,
         "instance_types": n_its,
@@ -717,6 +737,8 @@ def pipeline_line(n_pods: int = 100_000, n_its: int = 2000,
         "overlap_efficiency": pipe["overlap_efficiency"],
         "unmonitored_tick_s": round(unmon_s, 4),
         "watchdog_overhead_frac": watchdog_overhead,
+        "traced_tick_s": round(traced_s, 4),
+        "telemetry_overhead_frac": telemetry_overhead,
         "donated": repairs["donated"],
         "donation_reallocs": repairs["donation_reallocs"],
         "repair_modes": repairs["modes"],
@@ -924,11 +946,15 @@ def tenant_line(n_tenants: int = 8, pods_per_tenant: int = 256) -> dict:
         total = time.perf_counter() - t0
         if total < serial_s:
             serial_s, lat = total, lats
+    from karpenter_core_tpu.utils import compilecache
+
+    compilecache.reset_occupancy()  # isolate the timed coalesced dispatches
     batched_s = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
         BatchCoalescer._run_batched(preps)  # device_gets internally: synced
         batched_s = min(batched_s, time.perf_counter() - t0)
+    occupancy = compilecache.occupancy_stats()
     p99 = percentile(lat, 0.99)  # the soak SLO engine's nearest-rank
 
     # durable-session overhead (ISSUE-13, docs/SERVICE.md): the serial loop
@@ -989,6 +1015,7 @@ def tenant_line(n_tenants: int = 8, pods_per_tenant: int = 256) -> dict:
         "journal_overhead_fraction": (
             round(p99_j / p99 - 1.0, 4) if p99 > 0 else None
         ),
+        "batch_occupancy": occupancy,
     }
 
 
@@ -1418,6 +1445,11 @@ def main() -> None:
         detail["pipeline_watchdog_overhead_frac"] = pipeline[
             "watchdog_overhead_frac"
         ]
+        # telemetry-overhead mirror (report_telemetry advisory: < 2% of the
+        # pipelined warm tick with tracing fully enabled vs KC_TRACE=0)
+        detail["pipeline_telemetry_overhead_frac"] = pipeline[
+            "telemetry_overhead_frac"
+        ]
     detail["policy"] = policy
     if policy and "error" not in policy:
         # stage mirror for the perfgate objective_s gate + the acceptance
@@ -1432,6 +1464,9 @@ def main() -> None:
         detail["tenant_batched_solve_s"] = tenant["batched_s"]
         detail["tenant_serial_solve_s"] = tenant["serial_s"]
         detail["tenant_speedup"] = tenant["speedup"]
+        # real-vs-padded rows per (bucket, mesh) for the coalesced
+        # dispatches — the padding-waste story at fleet scale (ISSUE 16)
+        detail["batch_occupancy"] = tenant.get("batch_occupancy") or {}
     detail["sharded"] = sharded
     if sharded and "error" not in sharded and "solve_s_1dev" in sharded:
         # stage mirrors so tools/perfgate.py gates the sharded path

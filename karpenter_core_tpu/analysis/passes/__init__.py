@@ -10,6 +10,7 @@ from karpenter_core_tpu.analysis.passes import (
     hygiene,
     instrumented,
     lock_order,
+    metric_docs,
     retrace_budget,
     trace_safety,
     unbounded_block,
@@ -17,7 +18,7 @@ from karpenter_core_tpu.analysis.passes import (
 
 ALL_PASSES = [
     trace_safety, retrace_budget, lock_order, hygiene, instrumented,
-    chaos_hygiene, unbounded_block,
+    chaos_hygiene, unbounded_block, metric_docs,
 ]
 
 __all__ = ["ALL_PASSES"]

@@ -2,22 +2,28 @@ from karpenter_core_tpu.metrics.registry import (
     Counter,
     Gauge,
     Histogram,
+    LabelCardinalityGuard,
     Registry,
     Summary,
     REGISTRY,
     DURATION_BUCKETS,
     SOLVE_STAGE_DURATION,
+    TENANT_LABEL_GUARD,
     measure,
+    tenant_label,
 )
 
 __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "LabelCardinalityGuard",
     "Summary",
     "Registry",
     "REGISTRY",
     "DURATION_BUCKETS",
     "SOLVE_STAGE_DURATION",
+    "TENANT_LABEL_GUARD",
     "measure",
+    "tenant_label",
 ]

@@ -10,6 +10,7 @@ text-format compatible (``Registry.render``) for scraping.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -157,6 +158,18 @@ def _format_bound(bound: float) -> str:
     return format(bound, "g")
 
 
+def _escape_label_value(value: str) -> str:
+    """Classic Prometheus text-format label-value escaping: backslash, the
+    double quote, and line feed are the three characters the grammar reserves
+    (https://prometheus.io/docs/instrumenting/exposition_formats/)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 class Histogram(_Metric):
     kind = "histogram"
 
@@ -270,6 +283,17 @@ class Registry:
             for metric in self._metrics.values():
                 metric.clear()
 
+    def label_set_count(self) -> int:
+        """Total live label sets (time series) across every registered
+        metric — the number the cardinality guard keeps bounded."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        total = 0
+        for metric in metrics:
+            with metric._lock:
+                total += len(metric._children)
+        return total
+
     def render(self, exemplars: bool = False) -> str:
         """Prometheus text exposition.  With ``exemplars=True`` bucket lines
         carry their exemplar in OpenMetrics syntax
@@ -282,14 +306,18 @@ class Registry:
             lines.append(f"# TYPE {metric.name} {metric.kind}")
             for name, labels, value, exemplar in metric.samples_with_exemplars():
                 if labels:
-                    rendered = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+                    rendered = ",".join(
+                        f'{k}="{_escape_label_value(v)}"'
+                        for k, v in sorted(labels.items())
+                    )
                     line = f"{name}{{{rendered}}} {value}"
                 else:
                     line = f"{name} {value}"
                 if exemplars and exemplar is not None:
                     ex_labels, ex_value, ex_wall = exemplar
                     ex_rendered = ",".join(
-                        f'{k}="{v}"' for k, v in sorted(ex_labels.items())
+                        f'{k}="{_escape_label_value(v)}"'
+                        for k, v in sorted(ex_labels.items())
                     )
                     line += f" # {{{ex_rendered}}} {ex_value} {ex_wall:.3f}"
                 lines.append(line)
@@ -338,6 +366,72 @@ POLICY_FLEET_COST = Gauge(
     ("view",),
 )
 REGISTRY.register(POLICY_FLEET_COST)
+
+
+class LabelCardinalityGuard:
+    """Bounds the distinct values a high-cardinality label may take.
+
+    The tenant id is the first unbounded-by-construction label value this
+    registry carries (every other label is a small closed vocabulary).  The
+    guard admits the first ``cap`` distinct values verbatim; every later
+    value maps to the overflow bucket (``"_other"``), so a 10k-tenant churn
+    holds /metrics to a bounded series count while the busiest (earliest)
+    tenants keep per-tenant resolution.  Admission is for the process
+    lifetime — releasing on session eviction would let churn re-admit
+    forever, which is exactly the cardinality leak being prevented.
+    """
+
+    OVERFLOW = "_other"
+
+    def __init__(self, cap: int) -> None:
+        self._cap = max(int(cap), 1)
+        self._lock = threading.Lock()
+        self._seen: set = set()
+        self.overflowed = 0
+
+    def admit(self, value: str) -> str:
+        value = str(value)
+        with self._lock:
+            if value in self._seen:
+                return value
+            if len(self._seen) < self._cap:
+                self._seen.add(value)
+                return value
+            self.overflowed += 1
+            return self.OVERFLOW
+
+    @property
+    def cap(self) -> int:
+        return self._cap
+
+    def seen(self) -> int:
+        with self._lock:
+            return len(self._seen)
+
+    def reset(self, cap: Optional[int] = None) -> None:
+        with self._lock:
+            self._seen.clear()
+            self.overflowed = 0
+            if cap is not None:
+                self._cap = max(int(cap), 1)
+
+
+def _tenant_label_cap_from_env() -> int:
+    try:
+        return int(os.environ.get("KC_TENANT_LABEL_MAX", "64") or 64)
+    except ValueError:
+        return 64  # a tuning-knob typo must not take the operator down
+
+
+TENANT_LABEL_GUARD = LabelCardinalityGuard(_tenant_label_cap_from_env())
+
+
+def tenant_label(tenant_id: str) -> str:
+    """The guarded spelling of a tenant id for metric labels: the id itself
+    while the process-wide cap (``KC_TENANT_LABEL_MAX``) has room, the
+    ``"_other"`` overflow bucket after.  Every ``{tenant=...}`` call site
+    must route through this."""
+    return TENANT_LABEL_GUARD.admit(tenant_id)
 
 
 def measure(observer, clock=None):

@@ -151,11 +151,30 @@ class OperatorHTTP:
 
             def _traces(self, query) -> None:
                 """The last N solve traces as JSON; ``format=chrome`` emits
-                trace-event JSON loadable in chrome://tracing / Perfetto."""
+                trace-event JSON loadable in chrome://tracing / Perfetto.
+                ``?trace_id=<id>`` returns the MERGED tree for that trace:
+                every stored segment sharing the id (client solve, server
+                session tick, coalesced dispatch, journal replay) stitched
+                into one span list (tracing.TraceStore.tree)."""
                 try:
                     n = int(query.get("n", ["0"])[0])
                 except ValueError:
                     return self._text(400, f"bad n: {query.get('n')!r}\n")
+                trace_id = query.get("trace_id", [""])[0]
+                if trace_id:
+                    tree = tracing.TRACE_STORE.tree(trace_id)
+                    if tree is None:
+                        return self._text(404, f"no trace {trace_id!r}\n")
+                    if query.get("format", [""])[0] == "chrome":
+                        return self._json(200, tracing.to_chrome([tree]))
+                    return self._json(
+                        200,
+                        {
+                            "enabled": tracing.enabled(),
+                            "trace": tree.to_dict(),
+                            "audits": list(tree.audits()),
+                        },
+                    )
                 traces = tracing.TRACE_STORE.last(n if n > 0 else None)
                 if query.get("format", [""])[0] == "chrome":
                     return self._json(200, tracing.to_chrome(traces))

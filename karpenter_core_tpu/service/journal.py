@@ -72,6 +72,7 @@ import os
 import queue
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import msgpack
@@ -111,6 +112,25 @@ JOURNAL_ACTIVE = REGISTRY.gauge(
     "karpenter_journal_active",
     "1 while the session journal is accepting appends (0 = disabled or "
     "failed closed).",
+)
+JOURNAL_FSYNC_LATENCY = REGISTRY.histogram(
+    "karpenter_journal_fsync_latency_seconds",
+    "Per-record journal fsync latency on the writer thread (absent when "
+    "KC_JOURNAL_FSYNC=0).",
+    buckets=[0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1, 0.25, 0.5, 1],
+)
+JOURNAL_CHECKPOINT_BYTES = REGISTRY.histogram(
+    "karpenter_journal_checkpoint_bytes",
+    "Compacted checkpoint size in bytes, per compaction.",
+    buckets=[1024, 4096, 16384, 65536, 262144, 1048576, 4194304, 16777216,
+             67108864, 268435456],
+)
+SESSION_REPLAY_DURATION = REGISTRY.histogram(
+    "karpenter_session_replay_duration_seconds",
+    "Per-tenant journal replay time during warm recovery, by (guarded) "
+    "tenant label.",
+    ("tenant",),
 )
 
 MAGIC = b"KCWJ1\n"
@@ -427,10 +447,18 @@ class SessionJournal:
         client_supply: Optional[str],
         state: Dict[str, object],
         request: bytes,
+        trace_ctx: Optional[Dict[str, str]] = None,
     ) -> None:
         """Record one completed tenant solve.  Called with the tenant entry
         lock held — everything here is dict construction plus a non-blocking
-        enqueue; framing, I/O, and fsync happen on the writer thread."""
+        enqueue; framing, I/O, and fsync happen on the writer thread.
+
+        ``trace_ctx`` is the solve's tracing wire context
+        (``tracing.wire_context()``: ``{"traceId", "spanId"}``) when the
+        solve ran traced — journaled so a warm-restart replay links its
+        spans back to the originating trace.  The field is OPTIONAL on read
+        (schema v1 additive, service/SCHEMA.md): journals written before it
+        existed, or with tracing off, replay exactly as before."""
         if not self.active():
             return
         rec = {
@@ -444,6 +472,8 @@ class SessionJournal:
             "request": bytes(request),
             "ts": self.clock.now(),
         }
+        if trace_ctx:
+            rec["trace"] = {str(k): str(v) for k, v in trace_ctx.items()}
         self._enqueue(rec, kind)
 
     def append_drop(self, tenant: str) -> None:
@@ -539,7 +569,11 @@ class SessionJournal:
             fd.write(frame)
             fd.flush()
             if self.fsync:
+                t0 = time.perf_counter()
                 os.fsync(fd.fileno())
+                JOURNAL_FSYNC_LATENCY.labels().observe(
+                    time.perf_counter() - t0
+                )
         except (OSError, ValueError) as e:
             # ValueError = operation on a closed file (a teardown race):
             # same verdict as a disk error — fail closed, keep serving
@@ -569,13 +603,17 @@ class SessionJournal:
         with tracing.span("journal.checkpoint",
                           tenants=len(self._mirror.chains)):
             tmp = f"{self.checkpoint_path}.tmp.{os.getpid()}"
+            ckpt_bytes = len(MAGIC)
             with open(tmp, "wb") as f:
                 f.write(MAGIC)
                 for rec in self._mirror.live_records():
-                    f.write(encode_frame(rec))
+                    frame = encode_frame(rec)
+                    ckpt_bytes += len(frame)
+                    f.write(frame)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.checkpoint_path)
+            JOURNAL_CHECKPOINT_BYTES.labels().observe(float(ckpt_bytes))
             self._fsync_dir()
             # rotate the journal: everything live is in the checkpoint now
             self._close_fd()

@@ -53,6 +53,7 @@ import grpc
 import msgpack
 
 from karpenter_core_tpu import chaos, tracing
+from karpenter_core_tpu import metrics as metrics_mod
 from karpenter_core_tpu.apis import codec
 from karpenter_core_tpu.models.snapshot import KernelUnsupported
 from karpenter_core_tpu.service import journal as journal_mod
@@ -251,9 +252,20 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             for tenant_id, chain in ordered:
                 entry = plane.restore_entry(tenant_id)
                 t_replay = time.perf_counter()
+                # trace linkage across the restart: the most recent journaled
+                # record carrying a trace context names the originating trace
+                # — the replay's spans adopt it (span_remote), so /debug/
+                # traces shows the crashed solve and its warm replay as one
+                # tree.  Old journals without the field replay untraced-
+                # linked, exactly as before (schema-additive).
+                trace_ctx = next(
+                    (rec.get("trace") for rec in reversed(chain)
+                     if rec.get("trace")), None,
+                )
                 try:
-                    with tracing.span("session.recover", tenant=tenant_id,
-                                      records=len(chain)):
+                    with tracing.span_remote("session.recover", trace_ctx,
+                                             tenant=tenant_id,
+                                             records=len(chain)):
                         for i, rec in enumerate(chain):
                             if (
                                 replay_deadline_s > 0
@@ -296,6 +308,10 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                     entry.recovered = "warm"
                     warm += 1
                     journal_mod.SESSION_RECOVERED.labels("warm").inc()
+                finally:
+                    journal_mod.SESSION_REPLAY_DURATION.labels(
+                        metrics_mod.tenant_label(tenant_id)
+                    ).observe(time.perf_counter() - t_replay)
         finally:
             plane._bypass_coalescer = False
         log.info(
@@ -336,10 +352,14 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             )
 
     def _journal_solve(self, entry, tenant_id: str, mode: str,
-                       supply_digest, request: bytes) -> None:
+                       supply_digest, request: bytes,
+                       trace_ctx=None) -> None:
         """Append one completed tenant solve to the journal.  Called with the
         entry lock held — the verification state must snapshot the lineage
-        the response was computed from; the actual I/O is enqueued."""
+        the response was computed from; the actual I/O is enqueued.
+        ``trace_ctx`` is the serving span's wire context: replay after a
+        restart links back to the trace that originally produced the
+        record (docs/OBSERVABILITY.md)."""
         version = entry.session.lineage_version()
         if self.journal is None or version <= 0:
             return  # nothing warm to recover (carry-less solve)
@@ -357,6 +377,7 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             client_supply=supply_digest,
             state=entry.session.lineage_state(),
             request=bytes(request),
+            trace_ctx=trace_ctx,
         )
 
     # -- graceful drain (SIGTERM path, docs/SERVICE.md) ------------------------
@@ -874,9 +895,17 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 # doesn't echo a stale batch size
                 entry.last_batched = 1
                 t_solve = tenant_mod.monotonic()
+                # the envelope's optional trace context stitches this
+                # server-side segment into the client's trace tree; the
+                # serving span's own wire context is captured for the
+                # journal so replay-after-restart links back to it
+                trace_ctx = envelope.get("trace")
+                server_ctx = None
                 try:
-                    with tracing.span("solve.tenant", tenant=tid,
-                                      classes=len(classes)):
+                    with tracing.span_remote("solve.tenant", trace_ctx,
+                                             tenant=tid,
+                                             classes=len(classes)):
+                        server_ctx = tracing.wire_context()
                         results = entry.session.solve(
                             classes, state_nodes or None, bound
                         )
@@ -944,7 +973,8 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 recovered = entry.recovered
                 # durable sessions: journal the completed solve (enqueue
                 # only; framing/fsync ride the writer thread off this path)
-                self._journal_solve(entry, tid, mode, supply_digest, request)
+                self._journal_solve(entry, tid, mode, supply_digest, request,
+                                    trace_ctx=server_ctx or trace_ctx)
             self._deadline_guard(context, t0)
 
             t_decode = tenant_mod.monotonic()
@@ -1353,6 +1383,12 @@ class SnapshotSolverClient:
         RESOURCE_EXHAUSTED / UNAVAILABLE RpcErrors whose details carry a
         ``retry-after-s=`` hint (service.tenant.parse_retry_after)."""
         self._client_chaos("SolveClasses")
+        envelope = dict(tenant)
+        ctx = tracing.wire_context()
+        if ctx is not None and "trace" not in envelope:
+            # stamp the caller's active span so the server-side segment
+            # joins the same trace tree (schema-additive; SCHEMA.md)
+            envelope["trace"] = ctx
         request = msgpack.packb(
             {
                 "podClasses": [
@@ -1364,7 +1400,7 @@ class SnapshotSolverClient:
                 "nodes": nodes or [],
                 "claimDrivers": claim_drivers or {},
                 "policy": _policy_wire(policy),
-                "tenant": dict(tenant),
+                "tenant": envelope,
             }
         )
         return msgpack.unpackb(self._solve_classes(request, timeout=timeout))

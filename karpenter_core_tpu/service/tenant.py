@@ -53,14 +53,14 @@ from __future__ import annotations
 import os
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from karpenter_core_tpu import tracing
-from karpenter_core_tpu.metrics import REGISTRY
+from karpenter_core_tpu.metrics import REGISTRY, tenant_label
 from karpenter_core_tpu.utils import pipeline as pipeline_mod
 from karpenter_core_tpu.utils import retry
 from karpenter_core_tpu.utils.clock import Clock
@@ -107,6 +107,30 @@ TENANT_BATCHES = REGISTRY.counter(
     "Coalesced tenant solves dispatched, by batch size (1 = solo).",
     ("size",),
 )
+TENANT_ADMITTED = REGISTRY.counter(
+    "karpenter_tenant_admitted_total",
+    "Tenant requests accepted by admission control, by tenant.",
+    ("tenant",),
+)
+TENANT_RETRY_AFTER = REGISTRY.histogram(
+    "karpenter_tenant_retry_after_seconds",
+    "Retry-after hints handed to shed tenant requests, by tenant.",
+    ("tenant",),
+    buckets=[0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60],
+)
+TENANT_DISPATCH = REGISTRY.counter(
+    "karpenter_tenant_dispatch_total",
+    "Tenant solve dispatches, by tenant and mode (coalesced / solo).",
+    ("tenant", "mode"),
+)
+TENANT_SLO_BURN_RATE = REGISTRY.gauge(
+    "karpenter_tenant_slo_burn_rate",
+    "Multi-window error-budget burn rate over the declared per-tenant solve "
+    "latency SLO (KC_TENANT_SLO_SOLVE_S / KC_TENANT_SLO_OBJECTIVE): the "
+    "window's bad-solve fraction divided by the budget (1 - objective); "
+    "1.0 = burning exactly the budget.",
+    ("tenant", "window"),
+)
 
 # the shed/isolated detail string clients parse the hint out of
 RETRY_AFTER_PREFIX = "retry-after-s="
@@ -136,6 +160,70 @@ def _env_i(name: str, default: int) -> int:
         return int(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+class SloTracker:
+    """Per-tenant multi-window burn rate over a declared solve-latency SLO.
+
+    The SLO is "fraction ``objective`` of solves finish under ``target_s``";
+    the burn rate for a window is the window's observed bad fraction divided
+    by the error budget (``1 - objective``) — the standard multi-window
+    burn-rate alerting shape, so 1.0 means spending the budget exactly and
+    14.4 on the short window is a page.  Samples are bounded per tenant and
+    tenants are bounded by the metrics label-cardinality guard (overflow
+    tenants pool their samples under ``"_other"``)."""
+
+    WINDOWS = (("5m", 300.0), ("1h", 3600.0))
+    MAX_SAMPLES = 4096
+
+    def __init__(self, target_s: Optional[float] = None,
+                 objective: Optional[float] = None) -> None:
+        self.target_s = (
+            target_s if target_s is not None
+            else max(_env_f("KC_TENANT_SLO_SOLVE_S", 1.0), 1e-6)
+        )
+        objective = (
+            objective if objective is not None
+            else _env_f("KC_TENANT_SLO_OBJECTIVE", 0.99)
+        )
+        self.objective = min(max(objective, 0.0), 0.9999)
+        self._lock = threading.Lock()
+        # guarded tenant label -> deque[(monotonic_t, was_bad)]
+        self._samples: Dict[str, "deque"] = {}
+
+    def observe(self, tenant: str, solve_s: float,
+                now: Optional[float] = None) -> None:
+        """Record one solve under the guarded tenant label and refresh the
+        tenant's burn-rate gauges for every window."""
+        now = monotonic() if now is None else now
+        bad = solve_s > self.target_s
+        budget = 1.0 - self.objective
+        with self._lock:
+            samples = self._samples.get(tenant)
+            if samples is None:
+                samples = deque(maxlen=self.MAX_SAMPLES)
+                self._samples[tenant] = samples
+            samples.append((now, bad))
+            horizon = self.WINDOWS[-1][1]
+            while samples and now - samples[0][0] > horizon:
+                samples.popleft()
+            snapshot = list(samples)
+        for window, span_s in self.WINDOWS:
+            in_window = [b for (t, b) in snapshot if now - t <= span_s]
+            if not in_window:
+                burn = 0.0
+            else:
+                burn = (sum(in_window) / len(in_window)) / budget
+            TENANT_SLO_BURN_RATE.labels(tenant, window).set(burn)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples.clear()
+
+
+# module singleton: TenantPlane.observe_latencies is static (the handler
+# calls it without plumbing the plane through), so the tracker lives here
+SLO_TRACKER = SloTracker()
 
 
 # weighted fair-share bounds: a weight outside this band is someone fat-
@@ -266,11 +354,13 @@ def bucket_key(prep) -> tuple:
 
 
 class _Member:
-    __slots__ = ("prep", "solo", "done", "outputs", "error", "batch_n")
+    __slots__ = ("prep", "solo", "tenant", "done", "outputs", "error", "batch_n")
 
-    def __init__(self, prep, solo: Callable[[], object]) -> None:
+    def __init__(self, prep, solo: Callable[[], object],
+                 tenant: Optional[str] = None) -> None:
         self.prep = prep
         self.solo = solo
+        self.tenant = tenant
         self.done = threading.Event()
         self.outputs = None
         self.error: Optional[BaseException] = None
@@ -299,11 +389,12 @@ class BatchCoalescer:
         self._lock = threading.Lock()
         self._groups: Dict[tuple, _Group] = {}
 
-    def run(self, prep, solo: Callable[[], object]) -> Tuple[object, int]:
+    def run(self, prep, solo: Callable[[], object],
+            tenant: Optional[str] = None) -> Tuple[object, int]:
         if self.window_s <= 0 or self.max_batch <= 1:
             return solo(), 1
         key = bucket_key(prep)
-        member = _Member(prep, solo)
+        member = _Member(prep, solo, tenant)
         with self._lock:
             group = self._groups.get(key)
             # a full group is as good as closed: the leader may not have
@@ -354,7 +445,10 @@ class BatchCoalescer:
                 m.error = e
             return
         try:
-            outs = self._run_batched([m.prep for m in members])
+            outs = self._run_batched(
+                [m.prep for m in members],
+                tenants=[m.tenant for m in members if m.tenant is not None],
+            )
         except BaseException:  # noqa: BLE001 - batch fault: contain per tenant
             # fault containment: the batch PROGRAM faulted (device error,
             # chaos) — nothing tenant-attributable yet.  Re-run each member
@@ -372,9 +466,11 @@ class BatchCoalescer:
             m.batch_n = len(members)
 
     @staticmethod
-    def _run_batched(preps) -> List[object]:
+    def _run_batched(preps, tenants=None) -> List[object]:
         """One vmapped device dispatch over the stacked preps; returns
-        per-tenant output slices (bit-identical to solo solves)."""
+        per-tenant output slices (bit-identical to solo solves).  ``tenants``
+        (optional member tenant ids, dispatch order) rides the span so a
+        server-side trace names who co-batched."""
         import jax
 
         from karpenter_core_tpu.parallel import mesh as mesh_mod
@@ -388,8 +484,21 @@ class BatchCoalescer:
                 lambda *ls: np.stack([np.asarray(x) for x in ls]), *trees
             )
 
+        # coalesced occupancy: the preps arrive bucket-padded, so the real
+        # row count is recovered from the count vector (padded rows never
+        # carry pods) — one ledger entry for the whole stacked dispatch
+        padded_rows = int(np.asarray(p0.cls.count).shape[0])
+        real_rows = sum(
+            int(np.count_nonzero(np.asarray(p.cls.count))) for p in preps
+        ) / len(preps)
+        compilecache.record_batch_occupancy(
+            real_rows, padded_rows, p0.n_slots, n_passes=p0.n_passes,
+            mesh_axes=mesh_mod.tenant_mesh_axes(len(preps)),
+            tenants=len(preps),
+        )
         with tracing.span("solve.coalesced", tenants=len(preps),
-                          n_slots=p0.n_slots):
+                          n_slots=p0.n_slots,
+                          tenant=",".join(tenants) if tenants else None):
             args = [stack([p.cls for p in preps]),
                     stack([p.statics_arrays for p in preps])]
             if has_ex:
@@ -515,12 +624,17 @@ class TenantPlane:
         coalescing candidates; anything parameterized (slot-exhaustion
         retries) dispatches solo."""
         solver = entry.session.solver
+        tenant = tenant_label(entry.tenant_id)
         if kw or self._bypass_coalescer:
+            TENANT_DISPATCH.labels(tenant, "solo").inc()
             return solver.run_prepared(prep, **kw)
         outputs, batched = self.coalescer.run(
-            prep, lambda: solver.run_prepared(prep)
+            prep, lambda: solver.run_prepared(prep), tenant=entry.tenant_id
         )
         entry.last_batched = batched
+        TENANT_DISPATCH.labels(
+            tenant, "coalesced" if batched > 1 else "solo"
+        ).inc()
         return outputs
 
     def checkout(self, tenant_id: str, weight: Optional[float] = None) -> TenantEntry:
@@ -622,16 +736,19 @@ class TenantPlane:
         this tenant's own tokens (a queue-shed retry must not escalate into
         a rate shed).  ``weight`` is the wire envelope's fair-share claim
         (config.resolve_weight decides; an operator env pin wins)."""
+        tenant = tenant_label(tenant_id)
         if self._draining:
             # no checkout: a draining server must not mint fresh sessions
-            TENANT_SHED.labels(tenant_id, "draining").inc()
+            TENANT_SHED.labels(tenant, "draining").inc()
+            TENANT_RETRY_AFTER.labels(tenant).observe(self._drain_hint_s)
             return AdmissionDecision(False, "draining", self._drain_hint_s)
         entry = self.checkout(
             tenant_id, weight=self.config.resolve_weight(tenant_id, weight)
         )
         if not entry.breaker.allow():
             hint = max(entry.breaker.reset_timeout_s, 1.0)
-            TENANT_SHED.labels(tenant_id, "isolated").inc()
+            TENANT_SHED.labels(tenant, "isolated").inc()
+            TENANT_RETRY_AFTER.labels(tenant).observe(hint)
             return AdmissionDecision(False, "isolated", hint, entry=entry)
         granted_trial = entry.breaker.state == retry.HALF_OPEN
         with self._lock:
@@ -641,8 +758,9 @@ class TenantPlane:
         if queued:
             if granted_trial:
                 entry.breaker.release_trial()  # shed ≠ a backend verdict
-            TENANT_SHED.labels(tenant_id, "queue").inc()
+            TENANT_SHED.labels(tenant, "queue").inc()
             hint = max(entry.shed_backoff.next(), 0.25)
+            TENANT_RETRY_AFTER.labels(tenant).observe(hint)
             return AdmissionDecision(False, "queue", hint, entry=entry)
         if not entry.bucket.allow():
             with self._lock:
@@ -653,9 +771,11 @@ class TenantPlane:
             # repeated sheds escalate the hint so a hammering client backs
             # off harder each time (reset on the next successful admit)
             hint = max(hint, entry.shed_backoff.next())
-            TENANT_SHED.labels(tenant_id, "rate").inc()
+            TENANT_SHED.labels(tenant, "rate").inc()
+            TENANT_RETRY_AFTER.labels(tenant).observe(hint)
             return AdmissionDecision(False, "rate", hint, entry=entry)
         entry.shed_backoff.reset()
+        TENANT_ADMITTED.labels(tenant).inc()
         return AdmissionDecision(True, entry=entry, trial=granted_trial)
 
     def release(self, tenant_id: str) -> None:
@@ -671,12 +791,12 @@ class TenantPlane:
     def record_bad_request(self, entry: TenantEntry, reason: str) -> None:
         """Malformed / oversized snapshot: tenant-attributable, breaker
         counts it toward isolation."""
-        TENANT_EJECTED.labels(entry.tenant_id, reason).inc()
+        TENANT_EJECTED.labels(tenant_label(entry.tenant_id), reason).inc()
         entry.breaker.record_failure()
 
     def record_fault(self, entry: TenantEntry) -> None:
         """This tenant's solve faulted (ejected from its batch)."""
-        TENANT_EJECTED.labels(entry.tenant_id, "solve-fault").inc()
+        TENANT_EJECTED.labels(tenant_label(entry.tenant_id), "solve-fault").inc()
         entry.breaker.record_failure()
 
     def record_timeout(self, entry: TenantEntry) -> None:
@@ -685,7 +805,9 @@ class TenantPlane:
         call never wedges the worker, and the tenant breaker counts it — a
         tenant whose snapshots reliably hang the backend isolates exactly
         like one whose snapshots fault it."""
-        TENANT_EJECTED.labels(entry.tenant_id, "watchdog-timeout").inc()
+        TENANT_EJECTED.labels(
+            tenant_label(entry.tenant_id), "watchdog-timeout"
+        ).inc()
         entry.breaker.record_failure()
 
     def record_ok(self, entry: TenantEntry) -> None:
@@ -696,9 +818,11 @@ class TenantPlane:
     @staticmethod
     def observe_latencies(tenant_id: str, queue_s: float, solve_s: float,
                           decode_s: float) -> None:
-        TENANT_QUEUE_LATENCY.labels(tenant_id).observe(max(queue_s, 0.0))
-        TENANT_SOLVE_LATENCY.labels(tenant_id).observe(max(solve_s, 0.0))
-        TENANT_DECODE_LATENCY.labels(tenant_id).observe(max(decode_s, 0.0))
+        tenant = tenant_label(tenant_id)
+        TENANT_QUEUE_LATENCY.labels(tenant).observe(max(queue_s, 0.0))
+        TENANT_SOLVE_LATENCY.labels(tenant).observe(max(solve_s, 0.0))
+        TENANT_DECODE_LATENCY.labels(tenant).observe(max(decode_s, 0.0))
+        SLO_TRACKER.observe(tenant, max(solve_s, 0.0))
 
 
 def monotonic() -> float:

@@ -16,7 +16,9 @@ from karpenter_core_tpu.tracing.trace import (
     enable,
     enabled,
     span,
+    span_remote,
     traced,
+    wire_context,
 )
 from karpenter_core_tpu.tracing.export import from_jsonl, to_chrome, to_jsonl
 from karpenter_core_tpu.tracing.audit import (
@@ -41,7 +43,9 @@ __all__ = [
     "record_unschedulable",
     "rejection",
     "span",
+    "span_remote",
     "to_chrome",
     "to_jsonl",
     "traced",
+    "wire_context",
 ]

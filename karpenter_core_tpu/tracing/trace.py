@@ -87,6 +87,14 @@ class Span:
     def __init__(self, name: str, parent: Optional["Span"], attrs: Dict[str, Any]) -> None:
         self.name = name
         self.attrs = dict(attrs)
+        # the tenant attribute is baggage: a span opened under a tenant-owned
+        # span belongs to that tenant, so per-tenant trace filtering sees the
+        # WHOLE server-side subtree (encode/dispatch/decode), not just the
+        # envelope span — only paid when tracing is on
+        if parent is not None and "tenant" not in self.attrs:
+            tenant = parent.attrs.get("tenant")
+            if tenant is not None:
+                self.attrs["tenant"] = tenant
         self.events: List[Dict[str, Any]] = []
         self.parent_id = parent.span_id if parent is not None else None
         self.trace_id = parent.trace_id if parent is not None else _new_id(8)
@@ -213,6 +221,55 @@ def current() -> Optional[Span]:
     return _current.get()
 
 
+def wire_context() -> Optional[Dict[str, str]]:
+    """The active span's identity as a wire-portable context dict
+    (``{"traceId", "spanId"}``) for stamping into RPC envelopes and journal
+    records, or None when tracing is off / no span is active.  The W3C
+    traceparent idea without the header spelling: trace id + parent span id
+    are all a remote side needs to join the tree."""
+    if not _enabled:
+        return None
+    sp = _current.get()
+    if sp is None or not sp.trace_id:
+        return None
+    return {"traceId": sp.trace_id, "spanId": sp.span_id}
+
+
+@contextlib.contextmanager
+def span_remote(
+    name: str, ctx: Optional[Dict[str, Any]], sync: Any = None, **attrs: Any
+) -> Iterator[object]:
+    """Open a span that ADOPTS a remote trace context: same disabled-path
+    contract as ``span()`` (one flag check), but when ``ctx`` carries a
+    ``traceId`` the new span joins that trace — it records the remote span as
+    its parent while remaining a store-root on THIS side, so its completed
+    segment lands in the local ``TRACE_STORE`` under the adopted trace id
+    (``TraceStore.tree`` merges the segments back into one tree).  A missing
+    or empty ``ctx`` degrades to a plain ``span()``."""
+    if not _enabled:
+        yield _NOOP
+        return
+    trace_id = str((ctx or {}).get("traceId") or "")
+    if not trace_id:
+        with span(name, sync=sync, **attrs) as sp:
+            yield sp
+        return
+    sp = Span(name, None, attrs)
+    sp.trace_id = trace_id
+    sp.parent_id = str(ctx.get("spanId") or "") or None
+    if sync is not None:
+        sp.sync_on(sync)
+    token = _current.set(sp)
+    try:
+        yield sp
+    except BaseException as e:
+        sp.attrs.setdefault("error", f"{type(e).__name__}: {e}"[:200])
+        raise
+    finally:
+        _current.reset(token)
+        sp._finish()
+
+
 def add_event(name: str, **attrs: Any) -> None:
     """Attach a structured event to the active span (no-op without one)."""
     sp = _current.get()
@@ -306,6 +363,34 @@ class TraceStore:
                 if trace.trace_id == trace_id:
                     return trace
         return None
+
+    def tree(self, trace_id: str) -> Optional[Trace]:
+        """All stored segments of one trace merged into a single tree.
+
+        Cross-boundary propagation (``span_remote``) lands each side's
+        segment as its own ``Trace`` entry sharing the trace id — the client
+        RPC span, the server session tick, a warm-restart replay.  This
+        merges them: spans combined in wall-clock order, the earliest
+        segment's root named, duration spanning first start to last end."""
+        with self._lock:
+            matches = [t for t in self._traces if t.trace_id == trace_id]
+        if not matches:
+            return None
+        if len(matches) == 1:
+            return matches[0]
+        spans: List[Dict[str, Any]] = []
+        for t in matches:
+            spans.extend(t.spans)
+        spans.sort(key=lambda rec: rec.get("startWall") or 0.0)
+        first = min(matches, key=lambda t: t.start_wall)
+        end = max(t.start_wall + (t.duration_s or 0.0) for t in matches)
+        return Trace(
+            trace_id=trace_id,
+            name=first.name,
+            start_wall=first.start_wall,
+            duration_s=end - first.start_wall,
+            spans=spans,
+        )
 
     def set_capacity(self, capacity: int) -> None:
         with self._lock:

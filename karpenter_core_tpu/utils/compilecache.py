@@ -45,6 +45,81 @@ _in_flight: Dict[tuple, threading.Event] = {}
 # across varied reconcile batches within the same shape buckets.
 _stats = {"builds": 0, "memo_hits": 0}
 
+# -- batch-occupancy / padding accounting -------------------------------------
+#
+# The shape-bucket padding (ops/solve.pad_planes) and the coalesced tenant
+# batching both trade wasted rows for executable reuse; this ledger makes the
+# trade measurable per (bucket, mesh): real class rows the snapshot shipped
+# vs padded rows the kernel ran, and a padded-work proxy in "flops"
+# (wasted rows × slots × passes × tenants — a relative yardstick for fusion
+# tuning, not a hardware FLOP count).  Exported on /metrics and surfaced as
+# ``detail.batch_occupancy`` by bench.py's tenant line.
+from karpenter_core_tpu.metrics import REGISTRY
+
+BATCH_OCCUPANCY = REGISTRY.gauge(
+    "karpenter_batch_occupancy_ratio",
+    "Real rows / padded rows of the latest solve dispatch, by shape bucket "
+    "(padded class-row count) and mesh topology.",
+    ("bucket", "mesh"),
+)
+PADDED_FLOPS = REGISTRY.counter(
+    "karpenter_padded_flops_total",
+    "Padded-row work dispatched to the kernel (wasted rows x slots x passes "
+    "x tenants; a relative padding-cost proxy, not hardware FLOPs), by shape "
+    "bucket and mesh topology.",
+    ("bucket", "mesh"),
+)
+
+_occupancy: Dict[tuple, dict] = {}
+
+
+def record_batch_occupancy(real_rows, padded_rows, n_slots, n_passes=1,
+                           mesh_axes=None, tenants=1) -> None:
+    """Record one dispatch's real-vs-padded class rows (one call per device
+    dispatch — never on the traced hot path inside an executable).
+    ``real_rows`` is per batch element (a float mean for coalesced batches);
+    ``tenants`` scales the cumulative row/flops ledger."""
+    real_rows = float(real_rows)
+    padded_rows = max(int(padded_rows), 1)
+    tenants = max(int(tenants), 1)
+    bucket = str(padded_rows)
+    mesh = repr(tuple(mesh_axes)) if mesh_axes else "none"
+    ratio = min(real_rows / padded_rows, 1.0)
+    wasted = max(padded_rows - real_rows, 0.0) * int(n_slots) * max(int(n_passes), 1) * tenants
+    BATCH_OCCUPANCY.labels(bucket, mesh).set(ratio)
+    PADDED_FLOPS.labels(bucket, mesh).inc(float(wasted))
+    with _lock:
+        entry = _occupancy.setdefault(
+            (bucket, mesh),
+            {"dispatches": 0, "real_rows": 0.0, "padded_rows": 0,
+             "padded_flops": 0.0, "tenant_rows": 0},
+        )
+        entry["dispatches"] += 1
+        entry["real_rows"] += real_rows * tenants
+        entry["padded_rows"] += padded_rows * tenants
+        entry["tenant_rows"] += tenants
+        entry["padded_flops"] += float(wasted)
+
+
+def occupancy_stats() -> Dict[str, dict]:
+    """Cumulative per-(bucket, mesh) occupancy: ``{"<bucket>|<mesh>":
+    {dispatches, real_rows, padded_rows, occupancy_ratio, padded_flops}}``."""
+    with _lock:
+        snapshot = {k: dict(v) for k, v in _occupancy.items()}
+    out: Dict[str, dict] = {}
+    for (bucket, mesh), entry in snapshot.items():
+        entry["occupancy_ratio"] = (
+            entry["real_rows"] / entry["padded_rows"]
+            if entry["padded_rows"] else 0.0
+        )
+        out[f"{bucket}|{mesh}"] = entry
+    return out
+
+
+def reset_occupancy() -> None:
+    with _lock:
+        _occupancy.clear()
+
 
 _slots_seen: set = set()
 
@@ -662,8 +737,13 @@ def run_solve(
             and warm_carry is None
             and os.environ.get("KC_TPU_SHAPE_BUCKETS", "1") != "0"
         ):
+            real_rows = int(cls.count.shape[0])
             cls, statics_arrays, key_has_bounds, ex_state, ex_static = solve_ops.pad_planes(
                 cls, statics_arrays, key_has_bounds, ex_state, ex_static
+            )
+            record_batch_occupancy(
+                real_rows, int(cls.count.shape[0]), n_slots,
+                n_passes=n_passes, mesh_axes=mesh_axes,
             )
 
         def _upload(tree):

@@ -54,6 +54,19 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from karpenter_core_tpu import tracing
+from karpenter_core_tpu.metrics import REGISTRY
+
+STAGING_RING_OCCUPANCY = REGISTRY.gauge(
+    "karpenter_staging_ring_occupancy",
+    "Fraction of host staging-ring slots holding live buffers (filled at "
+    "least once since ring construction).",
+)
+PIPELINE_OVERLAP_RATIO = REGISTRY.gauge(
+    "karpenter_pipeline_overlap_ratio",
+    "Dispatch/fetch overlap of the latest completed fetch, by ticket label: "
+    "hidden_s / (hidden_s + exposed_s); 1.0 = the barrier never blocked.",
+    ("label",),
+)
 
 _lock = threading.Lock()
 _stats = {
@@ -222,6 +235,9 @@ class HostStagingRing:
                 slot.append(None)
             slot[i] = buf
             out.append(buf)
+        STAGING_RING_OCCUPANCY.labels().set(
+            sum(1 for s in self._slots if s) / self.depth
+        )
         return tuple(out)
 
 
@@ -314,6 +330,10 @@ class FetchTicket:
             with _lock:
                 _last_overlap["hidden_s"] = self.hidden_s
                 _last_overlap["exposed_s"] = self.exposed_s
+            total = self.hidden_s + self.exposed_s
+            PIPELINE_OVERLAP_RATIO.labels(self._label).set(
+                self.hidden_s / total if total > 0 else 0.0
+            )
             with tracing.span(
                 "pipeline.overlap", label=self._label,
                 hidden_s=round(self.hidden_s, 6),
@@ -362,6 +382,8 @@ class SolvePipeline:
 __all__ = [
     "FetchTicket",
     "HostStagingRing",
+    "PIPELINE_OVERLAP_RATIO",
+    "STAGING_RING_OCCUPANCY",
     "SolvePipeline",
     "backend_supports_donation",
     "donation_enabled",

@@ -252,6 +252,32 @@ class TestSessionJournal:
         chains, broken, _stats = fresh.recover()
         assert [r["request"] for r in chains["a"]] == [b"new", b"d"]
 
+    def test_trace_context_is_optional_and_round_trips(self, tmp_path):
+        """ISSUE 16: ``append_solve(trace_ctx=...)`` stores the serving
+        span's wire context; records without it (every pre-ISSUE-16
+        journal) carry no ``trace`` key and replay identically."""
+        journal = self._journal(tmp_path)
+        journal.start()
+        ctx = {"traceId": "aabbccdd" * 2, "spanId": "11223344"}
+        journal.append_solve("acme", "anchor", 0, 1, None, {"version": 1},
+                             b"r1", trace_ctx=ctx)
+        journal.append_solve("acme", "delta", 1, 1, None, {"version": 1},
+                             b"r2")  # old-format append: no trace field
+        journal.close(checkpoint=False)
+        fresh = self._journal(tmp_path)
+        chains, broken, _stats = fresh.recover()
+        assert not broken
+        anchor, delta = chains["acme"]
+        assert anchor["trace"] == ctx
+        assert "trace" not in delta
+        # checkpoint compaction preserves the field too
+        fresh.start()
+        self._drain(fresh)
+        fresh.close(checkpoint=False)
+        final = self._journal(tmp_path)
+        chains, _broken, _stats = final.recover()
+        assert chains["acme"][0]["trace"] == ctx
+
     def test_drop_survives_restart(self, tmp_path):
         journal = self._journal(tmp_path)
         journal.start()
@@ -446,6 +472,55 @@ class TestWarmRestart:
         r4 = _solve(client2, "acme", count=12, version=r3["tenant"]["sessionVersion"])
         assert "recovered" not in r4["tenant"]
         self._stop(server2, client2)
+
+    def test_replay_links_to_originating_trace(self, tmp_path):
+        """Trace propagation across restart (ISSUE 16): the journaled solve
+        carries the serving span's context, so the warm-restart replay's
+        ``session.recover`` segment lands under the SAME trace id the
+        client's solve minted — one tree spanning both server lifetimes —
+        and the replayed session still passes digest verification (the
+        ``recovered: warm`` echo)."""
+        from karpenter_core_tpu import tracing
+
+        tracing.TRACE_STORE.clear()
+        tracing.enable()
+        try:
+            provider = FakeCloudProvider()
+            server, client = self._serve(provider, tmp_path / "j")
+            with tracing.span("client.solve") as client_span:
+                r1 = _solve(client, "acme", count=6)
+            assert r1["tenant"]["solveMode"] == "full"
+            import time
+            time.sleep(0.2)  # let the writer flush the traced record
+            self._stop(server, client, abandon=True)
+
+            # the server-side segment adopted the client's trace id
+            # (in-process gRPC: both sides share this TRACE_STORE)
+            tenant_segments = [
+                t for t in tracing.TRACE_STORE.last()
+                if t.trace_id == client_span.trace_id
+                and any(s["name"] == "solve.tenant" for s in t.spans)
+            ]
+            assert tenant_segments, "server segment missing from the trace"
+
+            server2, client2 = self._serve(provider, tmp_path / "j")
+            tree = tracing.TRACE_STORE.tree(client_span.trace_id)
+            names = {s["name"] for s in tree.spans}
+            assert "client.solve" in names
+            assert "solve.tenant" in names
+            assert "session.recover" in names
+            recover = next(s for s in tree.spans
+                           if s["name"] == "session.recover")
+            assert recover["traceId"] == client_span.trace_id
+            assert recover["attrs"]["tenant"] == "acme"
+            # verification passed: the next solve resumes warm
+            r2 = _solve(client2, "acme", count=6,
+                        version=r1["tenant"]["sessionVersion"])
+            assert r2["tenant"]["recovered"] == "warm"
+            self._stop(server2, client2)
+        finally:
+            tracing.disable()
+            tracing.TRACE_STORE.clear()
 
     def test_corrupt_checkpoint_downgrades_to_session_lost(self, tmp_path):
         provider = FakeCloudProvider()

@@ -272,6 +272,99 @@ class TestSnapshotChannel:
         assert excinfo.value.code() == grpc.StatusCode.FAILED_PRECONDITION
 
 
+class TestTraceEnvelope:
+    """Trace propagation on the tenant wire (ISSUE 16): the OPTIONAL
+    ``trace`` envelope field is stamped only while client tracing is on —
+    with tracing off the request payload is bit-for-bit what it was before
+    trace propagation existed (the hot path pays nothing)."""
+
+    @pytest.fixture()
+    def channel(self):
+        from karpenter_core_tpu.service.snapshot_channel import (
+            SnapshotSolverClient,
+            serve,
+        )
+
+        server, port = serve(FakeCloudProvider())
+        client = SnapshotSolverClient(f"127.0.0.1:{port}")
+        yield client
+        client.close()
+        server.stop(0)
+
+    @pytest.fixture()
+    def sent_requests(self, monkeypatch):
+        """Capture every request dict the client packs onto the wire."""
+        from karpenter_core_tpu.service import snapshot_channel as sc
+
+        captured = []
+        real_packb = sc.msgpack.packb
+
+        def spy(obj, *args, **kwargs):
+            if isinstance(obj, dict) and "podClasses" in obj:
+                captured.append(obj)
+            return real_packb(obj, *args, **kwargs)
+
+        monkeypatch.setattr(sc.msgpack, "packb", spy)
+        return captured
+
+    def _solve(self, channel):
+        return channel.solve_tenant_classes(
+            [(make_pod(requests={"cpu": "500m"}), 4)],
+            [make_provisioner()],
+            tenant={"id": "acme", "sessionVersion": 0},
+        )
+
+    def test_tracing_off_sends_no_trace_field(self, channel, sent_requests):
+        from karpenter_core_tpu import tracing
+
+        assert not tracing.enabled()
+        response = self._solve(channel)
+        assert response["tenant"]["id"] == "acme"
+        assert sent_requests, "request never crossed the capture point"
+        assert "trace" not in sent_requests[-1]["tenant"]
+
+    def test_tracing_on_stamps_callers_span(self, channel, sent_requests):
+        from karpenter_core_tpu import tracing
+
+        tracing.TRACE_STORE.clear()
+        tracing.enable()
+        try:
+            with tracing.span("client.solve") as client_span:
+                response = self._solve(channel)
+        finally:
+            tracing.disable()
+            tracing.TRACE_STORE.clear()
+        assert response["tenant"]["id"] == "acme"
+        envelope = sent_requests[-1]["tenant"]
+        assert envelope["trace"] == {
+            "traceId": client_span.trace_id,
+            "spanId": client_span.span_id,
+        }
+
+    def test_server_segment_joins_the_client_trace(self, channel):
+        from karpenter_core_tpu import tracing
+
+        tracing.TRACE_STORE.clear()
+        tracing.enable()
+        try:
+            with tracing.span("client.solve") as client_span:
+                self._solve(channel)
+            # in-process gRPC: the serving side shares this TRACE_STORE, so
+            # the adopted segment is visible without a /debug/traces fetch
+            tree = tracing.TRACE_STORE.tree(client_span.trace_id)
+            assert tree is not None
+            names = {s["name"] for s in tree.spans}
+            assert {"client.solve", "solve.tenant"} <= names
+            tenant_span = next(
+                s for s in tree.spans if s["name"] == "solve.tenant"
+            )
+            assert tenant_span["parentId"] == client_span.span_id
+            assert tenant_span["attrs"]["tenant"] == "acme"
+        finally:
+            tracing.disable()
+            tracing.TRACE_STORE.clear()
+
+
 class TestWireSchema:
     """Golden test pinning service/SCHEMA.md to the code: the wire contract
     is stable within karpenter.v1 — field renames must fail here first."""

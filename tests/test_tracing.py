@@ -218,6 +218,92 @@ class TestDebugTracesEndpoint:
         assert audits[0]["rejections"][0]["candidate"] == "template/default"
 
 
+class TestTracePropagation:
+    """Cross-boundary propagation (docs/OBSERVABILITY.md): wire_context /
+    span_remote carry one trace id across a process boundary, and
+    TraceStore.tree merges the per-side segments into one tree."""
+
+    def test_wire_context_requires_tracing_and_a_span(self, traced):
+        assert tracing.wire_context() is None  # enabled, but no active span
+        tracing.disable()
+        with tracing.span("off"):
+            assert tracing.wire_context() is None
+        tracing.enable()
+        with tracing.span("on") as sp:
+            ctx = tracing.wire_context()
+        assert ctx == {"traceId": sp.trace_id, "spanId": sp.span_id}
+
+    def test_span_remote_adopts_the_remote_trace(self, traced):
+        # "client side": a local root span whose context goes on the wire
+        with tracing.span("client.solve") as client:
+            ctx = tracing.wire_context()
+        # "server side": a store-root segment under the client's trace id
+        with tracing.span_remote("solve.tenant", ctx, tenant="acme") as srv:
+            assert srv.trace_id == client.trace_id
+            with tracing.span("solve.incremental"):
+                pass
+        segments = tracing.TRACE_STORE.last()
+        assert [t.name for t in segments] == ["client.solve", "solve.tenant"]
+        assert segments[0].trace_id == segments[1].trace_id
+        server_root = segments[1].spans[-1]
+        assert server_root["parentId"] == client.span_id
+        assert server_root["attrs"]["tenant"] == "acme"
+
+    def test_span_remote_without_context_is_a_local_root(self, traced):
+        with tracing.span_remote("solve.tenant", None, tenant="t") as sp:
+            pass
+        assert sp.trace_id  # minted locally, still lands in the store
+        assert tracing.TRACE_STORE.last(1)[0].spans[0]["parentId"] is None
+
+    def test_span_remote_disabled_is_noop(self):
+        assert not tracing.enabled()
+        with tracing.span_remote("x", {"traceId": "a", "spanId": "b"}) as sp:
+            sp.event("ignored")
+        assert len(tracing.TRACE_STORE) == 0
+
+    def test_tree_merges_segments_in_wall_order(self, traced):
+        with tracing.span("client.solve") as client:
+            ctx = tracing.wire_context()
+        with tracing.span_remote("solve.tenant", ctx):
+            with tracing.span("solve.coalesced"):
+                pass
+        tree = tracing.TRACE_STORE.tree(client.trace_id)
+        assert tree.trace_id == client.trace_id
+        names = [s["name"] for s in tree.spans]
+        assert set(names) == {"client.solve", "solve.tenant",
+                              "solve.coalesced"}
+        assert names[0] == "client.solve"  # earliest segment leads
+        starts = [s["startWall"] for s in tree.spans]
+        assert starts == sorted(starts)
+        assert tracing.TRACE_STORE.tree("missing") is None
+
+    def test_debug_traces_trace_id_query(self, traced):
+        from karpenter_core_tpu.operator.httpserver import OperatorHTTP
+
+        with tracing.span("client.solve") as client:
+            ctx = tracing.wire_context()
+        with tracing.span_remote("solve.tenant", ctx):
+            pass
+        http = OperatorHTTP(metrics_port=0, health_port=0).start()
+        try:
+            url = (f"http://127.0.0.1:{http.metrics_port}/debug/traces"
+                   f"?trace_id={client.trace_id}")
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                doc = json.loads(resp.read().decode())
+            assert doc["trace"]["traceId"] == client.trace_id
+            assert {s["name"] for s in doc["trace"]["spans"]} == {
+                "client.solve", "solve.tenant"
+            }
+            with pytest.raises(urllib.error.HTTPError) as excinfo:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{http.metrics_port}/debug/traces"
+                    "?trace_id=feedface", timeout=5,
+                )
+            assert excinfo.value.code == 404
+        finally:
+            http.stop()
+
+
 class TestDecisionAudit:
     def test_predicate_classification(self):
         cases = {

@@ -424,6 +424,53 @@ def report_recovery(detail: dict) -> None:
         )
 
 
+def report_telemetry(detail: dict) -> None:
+    """Surface the fully-enabled telemetry cost (ISSUE-16,
+    docs/OBSERVABILITY.md): the pipelined warm tick re-run with tracing ON
+    (spans, exemplars, occupancy/overlap gauges all live) against the
+    KC_TRACE=0 leg it normally runs as.  Advisory: warns past 2% of
+    ``pipeline_warm_tick_s`` — observability must not tax the hot path it
+    observes.  Also prints the coalesced batch-occupancy ledger so padding
+    waste is visible next to the speedup it buys."""
+    overhead = detail.get("pipeline_telemetry_overhead_frac")
+    if overhead is not None:
+        pipeline = detail.get("pipeline") or {}
+        print(
+            "perfgate: telemetry-on warm tick {t:.4f}s vs {p:.4f}s traced-off "
+            "— overhead {o:.1f}%".format(
+                t=pipeline.get("traced_tick_s") or 0.0,
+                p=pipeline.get("pipelined_tick_s") or 0.0,
+                o=overhead * 100.0,
+            )
+        )
+        if overhead > 0.02:
+            print(
+                "perfgate: WARNING fully-enabled telemetry adds "
+                f"{overhead * 100.0:.1f}% to pipeline_warm_tick_s (>2%) — "
+                "span bookkeeping or a gauge update crept inside the timed "
+                "loop (tracing must stay one flag check when off; "
+                "docs/OBSERVABILITY.md)"
+            )
+    occupancy = detail.get("batch_occupancy") or {}
+    for key, stats in sorted(occupancy.items()):
+        print(
+            "perfgate: batch occupancy [{k}]: ratio {r:.3f} over "
+            "{d} dispatches ({t} tenant-rows, padded_flops {f:.0f})".format(
+                k=key, r=stats.get("occupancy_ratio") or 0.0,
+                d=stats.get("dispatches"), t=stats.get("tenant_rows"),
+                f=stats.get("padded_flops") or 0.0,
+            )
+        )
+        ratio = stats.get("occupancy_ratio")
+        if ratio is not None and ratio < 0.5:
+            print(
+                "perfgate: WARNING coalesced batch occupancy below 0.5 — "
+                "more than half the padded rows are dead weight; the bucket "
+                "ladder is too coarse for this tenant mix "
+                "(docs/SERVICE.md coalescing triage)"
+            )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=0.05,
@@ -452,6 +499,7 @@ def main() -> int:
     report_tenant(detail)
     report_recovery(detail)
     report_watchdog(detail)
+    report_telemetry(detail)
     if pods_per_sec is None:
         print(json.dumps(rec))
         print("perfgate: FAIL (bench produced no pods_per_sec)")

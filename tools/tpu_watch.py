@@ -17,13 +17,23 @@ output dict plus ``recorded_at_unix``.
 
 Usage: python tools/tpu_watch.py [--interval 180] [--max-hours 12]
 Run it in the background for the round; it exits after --max-hours.
+
+Fleet mode (ISSUE 16): ``--tenants http://host:9090/metrics`` switches the
+watcher from the bench loop to a per-tenant top-N console sourced from the
+solver service's /metrics endpoint — mean solve latency, SLO burn rate per
+window (karpenter_tenant_slo_burn_rate), admission/ejection counters, plus
+the coalesced batch-occupancy ladder.  ``--top`` bounds the table; the
+``tenant="_other"`` overflow bucket (docs/OBSERVABILITY.md cardinality
+guard) sorts last so real tenants keep the visibility.
 """
 
 import argparse
 import json
 import os
+import re
 import sys
 import time
+import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "BENCH_TPU_OPPORTUNISTIC.jsonl")
@@ -39,11 +49,140 @@ def probe(timeout_s=None):
     return probe_once(timeout_s).platform
 
 
+# -- per-tenant fleet view (--tenants) --------------------------------------
+
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_ESCAPES = {"n": "\n", "\\": "\\", '"': '"'}
+
+
+def _unescape(value: str) -> str:
+    return re.sub(r"\\(.)", lambda m: _ESCAPES.get(m.group(1), m.group(1)),
+                  value)
+
+
+def parse_exposition(text: str):
+    """Classic-exposition text -> [(name, {label: value}, float)].  Handles
+    the registry's label-value escaping (backslash, quote, newline); skips
+    comments and unparseable values (+Inf buckets parse via float)."""
+    samples = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        labels = {
+            k: _unescape(v) for k, v in _LABEL_RE.findall(raw_labels or "")
+        }
+        samples.append((name, labels, value))
+    return samples
+
+
+def tenant_view(text: str, top: int = 10) -> str:
+    """Render the per-tenant top-N console from one /metrics scrape.
+
+    Sort key: worst 5m burn rate first (the page-now signal), then mean
+    solve latency.  The ``_other`` overflow tenant sorts last regardless —
+    it aggregates everyone past the cardinality cap and would otherwise
+    pin a top slot forever."""
+    samples = parse_exposition(text)
+    tenants: dict = {}
+
+    def row(tid: str) -> dict:
+        return tenants.setdefault(tid, {
+            "solve_sum": 0.0, "solve_count": 0.0, "admitted": 0.0,
+            "ejected": 0.0, "burn": {},
+        })
+
+    for name, labels, value in samples:
+        tid = labels.get("tenant")
+        if tid is None:
+            continue
+        if name == "karpenter_tenant_solve_latency_seconds_sum":
+            row(tid)["solve_sum"] += value
+        elif name == "karpenter_tenant_solve_latency_seconds_count":
+            row(tid)["solve_count"] += value
+        elif name == "karpenter_tenant_admitted_total":
+            row(tid)["admitted"] += value
+        elif name == "karpenter_tenant_ejected_total":
+            row(tid)["ejected"] += value
+        elif name == "karpenter_tenant_slo_burn_rate":
+            row(tid)["burn"][labels.get("window", "?")] = value
+
+    def sort_key(item):
+        tid, rec = item
+        overflow = 1 if tid == "_other" else 0
+        burn5m = rec["burn"].get("5m", 0.0)
+        mean = (rec["solve_sum"] / rec["solve_count"]
+                if rec["solve_count"] else 0.0)
+        return (overflow, -burn5m, -mean)
+
+    lines = [
+        f"{'tenant':<20} {'solves':>8} {'mean_s':>8} {'burn 5m':>8} "
+        f"{'burn 1h':>8} {'ejected':>8}"
+    ]
+    for tid, rec in sorted(tenants.items(), key=sort_key)[:max(top, 1)]:
+        mean = (rec["solve_sum"] / rec["solve_count"]
+                if rec["solve_count"] else 0.0)
+        # tenant ids are caller-supplied strings: re-escape control
+        # characters so one hostile id cannot shear the table layout
+        tid = tid.replace("\\", "\\\\").replace("\n", "\\n").replace("\r", "\\r")
+        lines.append(
+            f"{tid:<20.20} {int(rec['solve_count']):>8d} {mean:>8.4f} "
+            f"{rec['burn'].get('5m', 0.0):>8.2f} "
+            f"{rec['burn'].get('1h', 0.0):>8.2f} {int(rec['ejected']):>8d}"
+        )
+    if len(tenants) > top:
+        lines.append(f"... {len(tenants) - top} more tenants")
+
+    occupancy = [
+        (labels.get("bucket", "?"), labels.get("mesh", "?"), value)
+        for name, labels, value in samples
+        if name == "karpenter_batch_occupancy_ratio"
+    ]
+    if occupancy:
+        lines.append("batch occupancy (bucket/mesh -> real/padded rows):")
+        for bucket, mesh, ratio in sorted(occupancy):
+            lines.append(f"  bucket={bucket:<8} mesh={mesh:<16} {ratio:.3f}")
+    return "\n".join(lines)
+
+
+def watch_tenants(url: str, interval: float, top: int,
+                  max_hours: float) -> int:
+    deadline = time.monotonic() + max_hours * 3600
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=10.0) as resp:
+                text = resp.read().decode("utf-8", "replace")
+            print(f"[tpu_watch] tenants @ {time.strftime('%H:%M:%S')}",
+                  flush=True)
+            print(tenant_view(text, top), flush=True)
+        except OSError as e:
+            print(f"[tpu_watch] scrape failed: {e}", flush=True)
+        if time.monotonic() >= deadline:
+            return 0
+        time.sleep(max(min(interval, deadline - time.monotonic()), 0.0))
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=float, default=180.0)
     ap.add_argument("--max-hours", type=float, default=12.0)
+    ap.add_argument("--tenants", default=None, metavar="METRICS_URL",
+                    help="per-tenant top-N console from this /metrics "
+                         "endpoint instead of the bench watch loop")
+    ap.add_argument("--top", type=int, default=10,
+                    help="tenant rows shown in --tenants mode")
     args = ap.parse_args()
+    if args.tenants:
+        return watch_tenants(args.tenants, min(args.interval, 30.0),
+                             args.top, args.max_hours)
     deadline = time.monotonic() + args.max_hours * 3600
     recorded = 0
 
