@@ -12,8 +12,8 @@ verify: chaos soak  ## static checks + the chaos and soak gates: bytecode-compil
 chaos:  ## tier-1 chaos subset with a fixed seed: seeded fault scenarios must converge leak-free (docs/CHAOS.md)
 	KC_CHAOS_SEED=1729 $(PYTEST) tests/test_chaos_matrix.py tests/test_retry.py -q -m "not slow"
 
-soak:  ## tier-1 soak smoke with a fixed seed: one deterministic trace-driven scenario must meet its SLO spec and replay byte-identically (docs/SOAK.md), plus the multi-tenant service soak (docs/SERVICE.md)
-	KC_SOAK_SEED=1729 $(PYTEST) tests/test_soak.py tests/test_tenant_soak.py -q -m "not slow"
+soak:  ## tier-1 soak smoke with a fixed seed: one deterministic trace-driven scenario must meet its SLO spec and replay byte-identically (docs/SOAK.md), the multi-tenant service soak (docs/SERVICE.md), plus the multi-process fleet-failover soak (docs/FLEET.md)
+	KC_SOAK_SEED=1729 $(PYTEST) tests/test_soak.py tests/test_tenant_soak.py tests/test_fleet_soak.py -q -m "not slow"
 
 test:  ## fast behavioral tier (virtual 8-device CPU mesh, ~2 min)
 	$(PYTEST) tests/ -x -q -m "not compile and not slow"

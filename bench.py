@@ -1019,6 +1019,147 @@ def tenant_line(n_tenants: int = 8, pods_per_tenant: int = 256) -> dict:
     }
 
 
+def fleet_line(chains=(1, 8, 64), pods: int = 128) -> dict:
+    """Fleet failover cost (ISSUE-17, docs/FLEET.md): how fast an adopting
+    replica restores an evicted tenant's warm lineage, measured both ways at
+    1/8/64-delta chain depths:
+
+      checkpoint  ONE deserialize of the tensor-level session checkpoint
+                  (fleet/checkpoint.py) + the never-trust digest verify
+      replay      the peer-journal fallback rung: re-solving the anchor and
+                  every delta from the dead replica's journal chain
+
+    A real replica serves the chain over the wire, then two fresh services
+    adopt it via the actual failover ladder (``_fleet_adopt``) — one with
+    the checkpoint present, one with it dropped.  Both restored lineages
+    must answer the NEXT delta bit-identically; tools/perfgate.py gates
+    ``fleet_restore_64_s`` and report_fleet warns when the checkpoint path
+    stops beating replay by ≥5x at 64 deltas.  Env: KC_BENCH_FLEET=0 skips,
+    KC_BENCH_FLEET_CHAINS / KC_BENCH_FLEET_PODS size it."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_core_tpu.fleet import FleetLocal, FleetMap
+    from karpenter_core_tpu.service.snapshot_channel import (
+        SnapshotSolverClient,
+        serve,
+    )
+    from karpenter_core_tpu.service.tenant import TenantConfig
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    config = TenantConfig(
+        rate_per_s=1000.0, burst=1000, max_inflight=16,
+        batch_window_s=0.0, max_batch=4,
+    )
+    fleet_map = FleetMap.parse("r1=pending:0,r2=pending:0,r3=pending:0")
+
+    def canon(resp: dict) -> str:
+        """Canonical digest of a response body; the one-shot recovery echo
+        and coalescing flag are load-dependent, everything else must match."""
+        body = dict(resp)
+        echo = dict(body.get("tenant") or {})
+        echo.pop("recovered", None)
+        echo.pop("batched", None)
+        body["tenant"] = echo
+        return hashlib.sha256(
+            json.dumps(body, sort_keys=True, default=repr).encode()
+        ).hexdigest()
+
+    def solve(client, count: int, version: int) -> dict:
+        return client.solve_tenant_classes(
+            [(make_pod(requests={"cpu": "500m"}), count)],
+            [make_provisioner()],
+            tenant={"id": "bench", "sessionVersion": version},
+        )
+
+    rows = []
+    for n_deltas in chains:
+        directory = tempfile.mkdtemp(prefix="kc-bench-fleet-")
+        servers, clients = [], []
+
+        def boot(rid: str):
+            fleet = FleetLocal(
+                directory=directory, replica_id=rid, fleet_map=fleet_map,
+                ckpt_every=1,
+            )
+            server, port = serve(
+                FakeCloudProvider(), tenant_config=config, fleet=fleet,
+                journal_dir=os.path.join(directory, "journals", rid),
+            )
+            servers.append(server)
+            return server, port
+
+        try:
+            # replica r1 serves anchor + N deltas, then dies (SIGKILL shape:
+            # no drain checkpoint — shutdown() only flushes the wal, so the
+            # replay rung sees exactly what a dead process leaves on disk)
+            server_a, port_a = boot("r1")
+            client_a = SnapshotSolverClient(f"127.0.0.1:{port_a}")
+            clients.append(client_a)
+            version = 0
+            for tick in range(n_deltas + 1):
+                version = solve(
+                    client_a, pods + tick, version
+                )["tenant"]["sessionVersion"]
+            server_a.stop(grace=0)
+            server_a.kc_service.shutdown()
+
+            # r2 adopts WARM: one checkpoint deserialize + digest verify
+            server_b, port_b = boot("r2")
+            svc_b = server_b.kc_service
+            entry_b = svc_b.tenants.restore_entry("bench")
+            t0 = time.perf_counter()
+            warm_ok = svc_b._fleet_adopt("bench", entry_b, version)
+            ckpt_restore_s = time.perf_counter() - t0
+
+            # r3 adopts with the checkpoint gone: the peer-journal replay
+            # rung re-solves the whole chain (run BEFORE r2's next solve so
+            # r2's journal holds no competing chain for the tenant)
+            server_c, port_c = boot("r3")
+            svc_c = server_c.kc_service
+            svc_c._ckpt.drop("bench")
+            entry_c = svc_c.tenants.restore_entry("bench")
+            t0 = time.perf_counter()
+            replay_ok = svc_c._fleet_adopt("bench", entry_c, version)
+            replay_restore_s = time.perf_counter() - t0
+
+            # both restored lineages answer the next delta bit-identically
+            bit_identical = None
+            if warm_ok and replay_ok:
+                client_b = SnapshotSolverClient(f"127.0.0.1:{port_b}")
+                client_c = SnapshotSolverClient(f"127.0.0.1:{port_c}")
+                clients += [client_b, client_c]
+                next_count = pods + n_deltas + 1
+                bit_identical = canon(
+                    solve(client_b, next_count, version)
+                ) == canon(solve(client_c, next_count, version))
+            rows.append({
+                "deltas": n_deltas,
+                "checkpoint_restore_s": round(ckpt_restore_s, 4),
+                "replay_restore_s": round(replay_restore_s, 4),
+                "speedup": (
+                    round(replay_restore_s / ckpt_restore_s, 2)
+                    if ckpt_restore_s > 0 else None
+                ),
+                "warm_ok": bool(warm_ok),
+                "replay_ok": bool(replay_ok),
+                "bit_identical": bit_identical,
+            })
+        finally:
+            for client in clients:
+                client.close()
+            for server in servers:
+                server.stop(grace=0)
+                try:
+                    server.kc_service.shutdown()
+                except Exception:  # noqa: BLE001 - already shut down
+                    pass
+            shutil.rmtree(directory, ignore_errors=True)
+    return {"pods": pods, "restores": rows}
+
+
 def sharded_line() -> dict:
     """The mesh scaling study (docs/KERNEL_PERF.md "Layer 5"): the SAME fleet
     solved at mesh sizes 1/2/4/8 (KC_BENCH_SHARDED_SIZES, trimmed to what the
@@ -1373,6 +1514,26 @@ def main() -> None:
             traceback.print_exc(file=sys.stderr)
             tenant = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # fleet failover: checkpoint-restore vs journal-replay adoption cost at
+    # 1/8/64-delta chains (docs/FLEET.md); KC_BENCH_FLEET=0 skips.
+    fleet = None
+    if os.environ.get("KC_BENCH_FLEET", "1") != "0":
+        try:
+            chains = tuple(
+                int(c) for c in
+                os.environ.get("KC_BENCH_FLEET_CHAINS", "1,8,64").split(",")
+                if c.strip()
+            )
+            fleet = fleet_line(
+                chains=chains,
+                pods=int(os.environ.get("KC_BENCH_FLEET_PODS", "128")),
+            )
+        except Exception as e:  # noqa: BLE001 - fleet line never kills the headline
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            fleet = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # restart cold: a fresh process with the persistent caches this process
     # just populated — the cost every operator restart actually pays.  The
     # child inherits os.environ, so a CPU fallback pins it too.
@@ -1467,6 +1628,22 @@ def main() -> None:
         # real-vs-padded rows per (bucket, mesh) for the coalesced
         # dispatches — the padding-waste story at fleet scale (ISSUE 16)
         detail["batch_occupancy"] = tenant.get("batch_occupancy") or {}
+    detail["fleet"] = fleet
+    if fleet and "error" not in fleet:
+        # stage mirrors for the deepest chain: the checkpoint-restore gates
+        # in tools/perfgate.py, the replay twin stays advisory (it moves
+        # with solve cost and is covered by the solve stages); report_fleet
+        # warns when restore stops beating replay ≥5x at 64 deltas
+        deepest = max(
+            (r for r in fleet.get("restores", []) if r.get("warm_ok")),
+            key=lambda r: r["deltas"], default=None,
+        )
+        if deepest is not None:
+            detail["fleet_restore_deltas"] = deepest["deltas"]
+            detail["fleet_restore_s"] = deepest["checkpoint_restore_s"]
+            detail["fleet_replay_s"] = deepest["replay_restore_s"]
+            detail["fleet_restore_speedup"] = deepest["speedup"]
+            detail["fleet_restore_bit_identical"] = deepest["bit_identical"]
     detail["sharded"] = sharded
     if sharded and "error" not in sharded and "solve_s_1dev" in sharded:
         # stage mirrors so tools/perfgate.py gates the sharded path

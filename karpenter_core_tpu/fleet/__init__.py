@@ -1,0 +1,105 @@
+"""Replicated solver fleet: router, session checkpoints, warm failover.
+
+One replica of the snapshot-solver service holds each tenant's solve lineage
+(session + warm carry + journal chain).  The fleet layer makes that lineage
+SURVIVABLE and the replica set HORIZONTAL:
+
+  fleet/ring.py        consistent-hash tenant→replica placement (bounded load)
+  fleet/lease.py       replica liveness: lease heartbeats over the existing
+                       LeaseGet/LeaseApply CAS plane
+  fleet/checkpoint.py  tensor-level session checkpoints — one deserialize
+                       restores a warm lineage instead of replaying N deltas
+  fleet/admission.py   fleet-level token buckets at the router (the single
+                       admission point; per-replica buckets stay as backstop)
+  fleet/router.py      the thin forwarding router + failover + rebalancing
+  fleet/replica_main.py subprocess entrypoint for the multi-process soak
+
+This module holds only the shared config (``FleetLocal``) and the failover
+outcome counter, so importing ``karpenter_core_tpu.fleet`` never drags in
+grpc or the service.  ``KC_FLEET=0`` (or an empty ``KC_FLEET_MAP``) disables
+everything: the service serves byte-identical responses to a fleetless build
+(pinned by tests/test_fleet_router.py wire-regression).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_core_tpu.metrics import REGISTRY
+
+from karpenter_core_tpu.fleet.ring import FleetMap, HashRing  # noqa: F401
+
+FAILOVER_TOTAL = REGISTRY.counter(
+    "karpenter_fleet_failover_total",
+    "Tenant failover adoptions by outcome: warm (tensor checkpoint restored "
+    "and digest-verified), replay (checkpoint missing/stale/corrupt — "
+    "lineage rebuilt from a peer's journal chain), reanchor (no restorable "
+    "artifact — the tenant re-anchors session-lost).",
+    ("outcome",),
+)
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_i(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclass
+class FleetLocal:
+    """One replica's (or the router's) view of the fleet configuration.
+
+    ``None`` from :meth:`from_env` means "not in a fleet" — every caller
+    treats that as the fleetless fast path.
+    """
+
+    directory: str
+    replica_id: str = ""
+    fleet_map: FleetMap = field(default_factory=FleetMap)
+    ckpt_every: int = 8
+    heartbeat_s: float = 2.0
+    lease_ttl_s: float = 10.0
+    router_address: str = ""
+
+    @classmethod
+    def from_env(cls) -> Optional["FleetLocal"]:
+        if os.environ.get("KC_FLEET", "0") != "1":
+            return None
+        directory = os.environ.get("KC_FLEET_DIR", "")
+        if not directory:
+            return None
+        return cls(
+            directory=directory,
+            replica_id=os.environ.get("KC_FLEET_REPLICA", ""),
+            fleet_map=FleetMap.from_env(),
+            ckpt_every=max(_env_i("KC_FLEET_CKPT_EVERY", 8), 1),
+            heartbeat_s=max(_env_f("KC_FLEET_HEARTBEAT_S", 2.0), 0.05),
+            lease_ttl_s=max(_env_f("KC_FLEET_LEASE_TTL_S", 10.0), 0.25),
+            router_address=os.environ.get("KC_FLEET_ROUTER", ""),
+        )
+
+    @property
+    def size(self) -> int:
+        return self.fleet_map.size
+
+    def checkpoint_dir(self) -> str:
+        return os.path.join(self.directory, "checkpoints")
+
+    def journal_root(self) -> str:
+        return os.path.join(self.directory, "journals")
+
+    def journal_dir(self, replica_id: Optional[str] = None) -> str:
+        return os.path.join(self.journal_root(), replica_id or self.replica_id)
+
+    def lease_path(self) -> str:
+        return os.path.join(self.directory, "leases.json")

@@ -1,0 +1,76 @@
+"""Fleet-level admission: token buckets at the router.
+
+Per-replica buckets alone over-admit a fleet: N replicas each granting a
+tenant's full ``rate_per_s`` means N× the intended rate as soon as routing
+spreads (and exactly that bug pre-dated the fleet: every process read the
+same KC_TENANT_RATE).  The router is the single front door, so the
+fleet-level buckets live HERE, shaped by the UNSCALED tenant config — and
+the replicas scale their local buckets down by 1/N as a backstop
+(TenantConfig.fleet_scaled), so a client dialing a replica directly still
+cannot exceed its fair share by more than one replica's slice.
+
+Shed responses carry the same machine-parseable ``retry-after-s=`` hint the
+replica plane emits, computed from the bucket's actual next-token time —
+clients cannot tell which layer shed them, and their pacing stays exact.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from karpenter_core_tpu.service import tenant as tenant_mod
+from karpenter_core_tpu.utils import retry
+from karpenter_core_tpu.utils.clock import Clock
+
+# the router never keeps per-tenant sessions, so its bucket map is bounded
+# only by this LRU cap — far above any real tenant population, small enough
+# that an id-spraying client cannot balloon router memory
+MAX_TENANT_BUCKETS = 4096
+
+
+class FleetAdmission:
+    """Per-tenant RetryBudget buckets keyed by tenant id, LRU-bounded."""
+
+    def __init__(self, config: Optional[tenant_mod.TenantConfig] = None, *,
+                 clock: Optional[Clock] = None,
+                 max_tenants: int = MAX_TENANT_BUCKETS) -> None:
+        self.config = config or tenant_mod.TenantConfig.from_env()
+        self.clock = clock or Clock()
+        self.max_tenants = max(int(max_tenants), 1)
+        self._buckets: "OrderedDict[str, retry.RetryBudget]" = OrderedDict()
+
+    def _bucket(self, tenant_id: str, weight: Optional[float]) -> retry.RetryBudget:
+        resolved = self.config.resolve_weight(tenant_id, weight)
+        budget, window_s = self.config.bucket_shape(resolved)
+        bucket = self._buckets.get(tenant_id)
+        if bucket is None:
+            bucket = retry.RetryBudget(
+                self.clock, budget=budget, window_s=window_s,
+                name=f"fleet:{tenant_id}",
+            )
+            self._buckets[tenant_id] = bucket
+            while len(self._buckets) > self.max_tenants:
+                self._buckets.popitem(last=False)
+        else:
+            self._buckets.move_to_end(tenant_id)
+            bucket.reconfigure(budget, window_s)
+        return bucket
+
+    def admit(self, tenant_id: str, weight: Optional[float] = None
+              ) -> Tuple[bool, float]:
+        """(admitted, retry_after_s) — the hint is the bucket's exact
+        next-token time, floored like the replica plane's rate shed."""
+        bucket = self._bucket(tenant_id, weight)
+        if bucket.allow():
+            return True, 0.0
+        return False, max(bucket.next_token_s(), 0.05)
+
+    @staticmethod
+    def shed_detail(retry_after_s: float) -> str:
+        """The abort detail: same hint grammar as tenant-plane sheds
+        (service/tenant.py parse_retry_after reads it back verbatim)."""
+        return (
+            f"fleet-shed reason=rate "
+            f"{tenant_mod.RETRY_AFTER_PREFIX}{retry_after_s:.3f}"
+        )

@@ -1,0 +1,236 @@
+"""Replica liveness over the existing lease CAS plane.
+
+The service already exposes a compare-and-swap lease store
+(/LeaseGet, /LeaseApply — service/snapshot_channel.py) for operator leader
+election.  The fleet reuses the SAME wire protocol for replica liveness:
+
+  LeasePlane       the router-side authority — an in-memory CAS map with the
+                   exact handler semantics of the service's lease plane,
+                   JSON-persisted so a router restart keeps the directory
+  ReplicaPulse     the replica-side heartbeat: a ``RemoteLeaseStore`` CAS
+                   renew of ``fleet-replica-<id>`` every ``heartbeat_s``;
+                   SIGTERM drain flips ``leaseDurationSeconds`` to 0 so the
+                   router remaps the replica's arc BEFORE the process exits
+  LeaseDirectory   the router's read view: alive / draining replica sets by
+                   renew-time freshness against the injected clock
+
+A replica with NO lease yet counts alive (bootstrap: routing must not wait
+for the first heartbeat); a replica whose lease went stale counts dead and
+its tenants remap (warm, via the fleet checkpoints).  SIGKILL needs no
+cooperation — the lease simply stops renewing.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Iterable, Optional, Set, Tuple
+
+import msgpack
+
+from karpenter_core_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+LEASE_NAMESPACE = "kc-fleet"
+LEASE_PREFIX = "fleet-replica-"
+
+
+def lease_name(replica_id: str) -> str:
+    return f"{LEASE_PREFIX}{replica_id}"
+
+
+class LeasePlane:
+    """The router-hosted lease store: same CAS semantics, same wire shapes
+    as ``SnapshotSolverService._lease_get/_lease_apply`` — ``RemoteLeaseStore``
+    clients cannot tell the difference (that is the point)."""
+
+    def __init__(self, path: str = "") -> None:
+        self._leases: Dict[Tuple[str, str], Dict] = {}
+        self._lock = threading.Lock()
+        self._path = path
+        self._load()
+
+    def _load(self) -> None:
+        if not self._path:
+            return
+        import json
+
+        try:
+            with open(self._path) as f:
+                for entry in json.load(f):
+                    self._leases[(entry.get("namespace", ""), entry["name"])] = entry
+        except FileNotFoundError:
+            pass
+        except Exception as e:  # noqa: BLE001 - durability is best-effort
+            log.warning("fleet lease state load failed (%s), starting empty", e)
+
+    def _persist_locked(self) -> None:
+        if not self._path:
+            return
+        import json
+        import os
+
+        try:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            tmp = f"{self._path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(list(self._leases.values()), f)
+            os.replace(tmp, self._path)
+        except Exception as e:  # noqa: BLE001 - durability is best-effort
+            log.debug("fleet lease state persist failed: %s", e)
+
+    def get_wire(self, request: bytes) -> bytes:
+        req = msgpack.unpackb(request)
+        with self._lock:
+            stored = self._leases.get((req.get("namespace", ""), req["name"]))
+            return msgpack.packb({"lease": dict(stored) if stored else None})
+
+    def apply_wire(self, request: bytes) -> bytes:
+        req = msgpack.unpackb(request)
+        lease = dict(req["lease"])
+        key = (lease.get("namespace", ""), lease["name"])
+        expected = req.get("expectedVersion")
+        with self._lock:
+            stored = self._leases.get(key)
+            if expected is None:
+                if stored is not None:
+                    return msgpack.packb(
+                        {"ok": False, "conflict": True, "lease": dict(stored)}
+                    )
+                lease["resourceVersion"] = 1
+            else:
+                if stored is None or stored["resourceVersion"] != expected:
+                    return msgpack.packb({
+                        "ok": False, "conflict": True,
+                        "lease": dict(stored) if stored else None,
+                    })
+                lease["resourceVersion"] = stored["resourceVersion"] + 1
+            self._leases[key] = lease
+            self._persist_locked()
+            return msgpack.packb(
+                {"ok": True, "conflict": False, "lease": dict(lease)}
+            )
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """replica_id -> lease wire dict, for the LeaseDirectory."""
+        with self._lock:
+            out = {}
+            for (ns, name), lease in self._leases.items():
+                if ns == LEASE_NAMESPACE and name.startswith(LEASE_PREFIX):
+                    out[name[len(LEASE_PREFIX):]] = dict(lease)
+            return out
+
+
+class LeaseDirectory:
+    """The router's liveness read: which fleet-map replicas are alive or
+    draining right now, by lease freshness."""
+
+    def __init__(self, plane: LeasePlane, *, clock: Optional[Clock] = None,
+                 ttl_s: float = 10.0) -> None:
+        self.plane = plane
+        self.clock = clock or Clock()
+        self.ttl_s = float(ttl_s)
+
+    def view(self, replica_ids: Iterable[str]) -> Tuple[Set[str], Set[str]]:
+        """(alive, draining) subsets of ``replica_ids``.  No lease yet =
+        alive (bootstrap); duration 0 = draining; stale renew = dead."""
+        leases = self.plane.snapshot()
+        now = self.clock.now()
+        alive: Set[str] = set()
+        draining: Set[str] = set()
+        for rid in replica_ids:
+            lease = leases.get(rid)
+            if lease is None:
+                alive.add(rid)
+                continue
+            if int(lease.get("leaseDurationSeconds", 0) or 0) == 0:
+                draining.add(rid)
+                continue
+            if now - float(lease.get("renewTime", 0.0) or 0.0) <= self.ttl_s:
+                alive.add(rid)
+        return alive, draining
+
+
+class ReplicaPulse:
+    """The replica's heartbeat thread: CAS-renew this replica's lease at the
+    router every ``heartbeat_s``.  Failures log and retry on the next beat —
+    a router restart or partition must not take the replica down with it."""
+
+    def __init__(self, store, replica_id: str, *,
+                 clock: Optional[Clock] = None, heartbeat_s: float = 2.0,
+                 ttl_s: float = 10.0) -> None:
+        self.store = store  # RemoteLeaseStore-shaped (get/create/update_with_version)
+        self.replica_id = replica_id
+        self.clock = clock or Clock()
+        self.heartbeat_s = max(float(heartbeat_s), 0.05)
+        self.ttl_s = float(ttl_s)
+        self._stop = threading.Event()
+        self._draining = False
+        self._thread: Optional[threading.Thread] = None
+
+    def _lease(self, duration_s: int):
+        from karpenter_core_tpu.apis.objects import Lease, LeaseSpec, ObjectMeta
+
+        now = self.clock.now()
+        return Lease(
+            metadata=ObjectMeta(
+                name=lease_name(self.replica_id), namespace=LEASE_NAMESPACE
+            ),
+            spec=LeaseSpec(
+                holder_identity=self.replica_id,
+                lease_duration_seconds=duration_s,
+                acquire_time=now,
+                renew_time=now,
+            ),
+        )
+
+    def beat(self) -> bool:
+        """One heartbeat: create the lease, or CAS-renew whatever version is
+        stored.  Returns True when the renewal landed."""
+        from karpenter_core_tpu.operator.kubeclient import ConflictError
+
+        duration = 0 if self._draining else max(int(round(self.ttl_s)), 1)
+        try:
+            stored = self.store.get(None, lease_name(self.replica_id),
+                                    LEASE_NAMESPACE)
+            lease = self._lease(duration)
+            if stored is None:
+                self.store.create(lease)
+            else:
+                self.store.update_with_version(
+                    lease, stored.metadata.resource_version
+                )
+            return True
+        except ConflictError:
+            # a concurrent create/renew won the CAS — next beat re-reads
+            return False
+        except Exception as e:  # noqa: BLE001 - liveness is best-effort
+            log.debug("fleet heartbeat failed for %s: %s", self.replica_id, e)
+            return False
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=f"fleet-pulse-{self.replica_id}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.beat()
+            self._stop.wait(self.heartbeat_s)
+
+    def mark_draining(self) -> None:
+        """SIGTERM path: advertise drain NOW (duration 0) so the router
+        remaps this replica's arc before the process exits."""
+        self._draining = True
+        self.beat()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

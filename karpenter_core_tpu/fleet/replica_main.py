@@ -1,0 +1,56 @@
+"""Fleet replica subprocess entrypoint.
+
+The multi-process soak (soak/tenants.py ``fleet-failover``) and the fleet
+tests launch replicas as REAL processes — separate interpreters, separate
+device runtimes, killable with SIGKILL — via::
+
+    python -m karpenter_core_tpu.fleet.replica_main
+
+Configuration arrives entirely through the KC_FLEET_* environment
+(fleet/__init__.py FleetLocal.from_env): the shared fleet directory, this
+replica's id, the fleet map, and the router address to heartbeat at.  The
+process prints ``PORT <n>`` on stdout once the port is bound and serving —
+the parent reads that line instead of racing a poll — then blocks until
+terminated.  SIGTERM runs the graceful drain (final checkpoints, lease
+flip); SIGKILL is the failover path under test and needs no cooperation.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s replica[{os.environ.get('KC_FLEET_REPLICA', '?')}]"
+               " %(levelname)s %(name)s: %(message)s",
+    )
+    from karpenter_core_tpu import fleet as fleet_mod
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_core_tpu.service.snapshot_channel import serve
+
+    fleet = fleet_mod.FleetLocal.from_env()
+    if fleet is None:
+        print("KC_FLEET=1 and KC_FLEET_DIR are required", file=sys.stderr)
+        return 2
+    server, port = serve(
+        FakeCloudProvider(),
+        address=os.environ.get("KC_FLEET_BIND", "127.0.0.1:0"),
+        fleet=fleet,
+        drain_on_sigterm=True,
+    )
+    # the parent parses this exact line; flush so a pipe reader never stalls
+    print(f"PORT {port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,132 @@
+"""Consistent-hash tenant→replica placement: the fleet map and the ring.
+
+The router (fleet/router.py) places every tenant on exactly one replica so
+its server-side solve lineage (session, warm carry, journal chain) has one
+home.  Placement must be:
+
+  deterministic   two routers with the same ``FleetMap`` place identically —
+                  the ring hashes replica ids and tenant ids through sha256
+                  (PYTHONHASHSEED-free), never ``hash()``.
+
+  stable          adding/removing one replica moves only the tenants on the
+                  affected arcs (classic consistent hashing with ``vnodes``
+                  virtual points per replica).
+
+  bounded-load    the "consistent hashing with bounded loads" variant: a
+                  replica already carrying more than ``load_factor`` times
+                  its fair share is skipped and the tenant walks to the next
+                  arc, so one hot arc cannot melt a single replica while its
+                  peers idle (docs/FLEET.md "Placement").
+
+``FleetMap`` is the static replica roster (``KC_FLEET_MAP``:
+``r1=host:port,r2=host:port``); LIVENESS is dynamic and comes from the lease
+directory (fleet/lease.py) — the ring only ever places on replicas the
+caller says are alive.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import math
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, Optional, Tuple
+
+
+def _point(key: str) -> int:
+    """Deterministic 64-bit ring coordinate (sha256, PYTHONHASHSEED-free)."""
+    return int.from_bytes(hashlib.sha256(key.encode()).digest()[:8], "big")
+
+
+@dataclass(frozen=True)
+class FleetMap:
+    """The ordered replica roster: ((replica_id, address), ...)."""
+
+    replicas: Tuple[Tuple[str, str], ...] = ()
+
+    @classmethod
+    def parse(cls, spec: str) -> "FleetMap":
+        """``r1=host:port,r2=host:port`` — unparseable parts are skipped (a
+        typo must not take routing down), duplicate ids keep the first."""
+        seen = {}
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part or "=" not in part:
+                continue
+            rid, _, address = part.partition("=")
+            rid, address = rid.strip(), address.strip()
+            if rid and address and rid not in seen:
+                seen[rid] = address
+        return cls(replicas=tuple(seen.items()))
+
+    @classmethod
+    def from_env(cls) -> "FleetMap":
+        return cls.parse(os.environ.get("KC_FLEET_MAP", ""))
+
+    @property
+    def size(self) -> int:
+        return len(self.replicas)
+
+    def ids(self) -> Tuple[str, ...]:
+        return tuple(rid for rid, _ in self.replicas)
+
+    def addresses(self) -> Dict[str, str]:
+        return dict(self.replicas)
+
+
+class HashRing:
+    """Deterministic consistent-hash ring with bounded-load placement."""
+
+    def __init__(self, fleet_map: FleetMap, vnodes: int = 64,
+                 load_factor: float = 1.25) -> None:
+        self.fleet_map = fleet_map
+        self.vnodes = max(int(vnodes), 1)
+        self.load_factor = max(float(load_factor), 1.0)
+        points = []
+        for rid, _address in fleet_map.replicas:
+            for v in range(self.vnodes):
+                points.append((_point(f"{rid}#{v}"), rid))
+        points.sort()
+        self._points = points
+        self._keys = [p for p, _ in points]
+
+    def arc(self, tenant: str) -> Tuple[str, ...]:
+        """The full preference walk for a tenant: every replica once, in ring
+        successor order from the tenant's coordinate.  Placement, failover
+        remap, and the chaos matrix all derive from this ONE ordering."""
+        if not self._points:
+            return ()
+        start = bisect.bisect_right(self._keys, _point(tenant))
+        seen = []
+        have = set()
+        n = len(self._points)
+        for i in range(n):
+            _, rid = self._points[(start + i) % n]
+            if rid not in have:
+                have.add(rid)
+                seen.append(rid)
+        return tuple(seen)
+
+    def owner(self, tenant: str, alive: Optional[Iterable[str]] = None,
+              assigned: Optional[Dict[str, int]] = None) -> Optional[str]:
+        """The tenant's home replica: first ALIVE replica on its arc whose
+        current assignment count is under the bounded-load cap
+        (``ceil(load_factor * (total+1) / alive)``).  Every alive replica
+        over the cap ⇒ the first alive one takes it anyway (the bound is a
+        spreading pressure, not an availability cliff)."""
+        walk = self.arc(tenant)
+        if not walk:
+            return None
+        alive_set = set(walk if alive is None else alive)
+        candidates = [rid for rid in walk if rid in alive_set]
+        if not candidates:
+            return None
+        if not assigned:
+            return candidates[0]
+        total = sum(int(assigned.get(rid, 0)) for rid in candidates)
+        cap = math.ceil(self.load_factor * (total + 1) / len(candidates))
+        for rid in candidates:
+            if int(assigned.get(rid, 0)) < cap:
+                return rid
+        return candidates[0]

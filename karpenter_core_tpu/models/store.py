@@ -154,6 +154,18 @@ def stable_digest(obj) -> str:
     return hashlib.sha256(repr(stable_canonical(obj)).encode()).hexdigest()
 
 
+def content_digest(chunks) -> str:
+    """sha256 hex over an iterable of byte chunks — the whole-file content
+    digest the fleet session checkpoints (fleet/checkpoint.py) stamp in their
+    trailer frame and re-derive on read (never-trust: a checkpoint whose body
+    doesn't hash to its trailer is treated as missing, the restore ladder
+    falls to journal replay)."""
+    h = hashlib.sha256()
+    for chunk in chunks:
+        h.update(chunk)
+    return h.hexdigest()
+
+
 def class_key(cls) -> tuple:
     """Version-stable identity of one class row: the equivalence-class
     signature of its representative pod (ladder variants carry the relaxed

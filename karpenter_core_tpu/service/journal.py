@@ -187,10 +187,12 @@ def encode_frame(record: dict) -> bytes:
     return _FRAME_HEAD.pack(len(payload), crc32c(payload)) + payload
 
 
-def read_frames(path: str) -> Tuple[List[dict], str]:
+def read_frames(path: str, magic: bytes = MAGIC) -> Tuple[List[dict], str]:
     """Decode every intact frame from ``path``; stops at the FIRST torn or
     corrupt frame (WAL discipline: framing after corruption cannot be
-    trusted).  Returns (records, status)."""
+    trusted).  Returns (records, status).  ``magic`` lets other crc32c-framed
+    files (the fleet session checkpoints, fleet/checkpoint.py) share the
+    exact same read discipline without sharing the journal's file identity."""
     try:
         with open(path, "rb") as f:
             data = f.read()
@@ -201,10 +203,10 @@ def read_frames(path: str) -> Tuple[List[dict], str]:
         return [], STATUS_CORRUPT
     if not data:
         return [], STATUS_EMPTY
-    if not data.startswith(MAGIC):
+    if not data.startswith(magic):
         return [], STATUS_CORRUPT
     records: List[dict] = []
-    off = len(MAGIC)
+    off = len(magic)
     n = len(data)
     while off < n:
         if off + _FRAME_HEAD.size > n:

@@ -40,7 +40,6 @@ controller's solver breaker + degraded mode absorb it.
 from __future__ import annotations
 
 import copy
-import hashlib
 import json
 import logging
 import os
@@ -135,12 +134,47 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
     electors, not here."""
 
     def __init__(self, cloud_provider, clock=None, tenant_config=None,
-                 journal_dir=None) -> None:
+                 journal_dir=None, fleet=None) -> None:
         self.cloud_provider = cloud_provider
+        # fleet membership (karpenter_core_tpu.fleet, docs/FLEET.md): when
+        # this process is one replica of a routed fleet (``fleet`` argument
+        # or KC_FLEET=1 + KC_FLEET_DIR), it writes tensor-level session
+        # checkpoints to the shared directory, restores adopted tenants from
+        # peers' checkpoints, and scales its LOCAL admission buckets to 1/N
+        # as a backstop behind the router's fleet-level buckets.  None — the
+        # default — leaves every byte of service behavior unchanged.
+        from karpenter_core_tpu import fleet as fleet_mod
+
+        self.fleet = fleet if fleet is not None else fleet_mod.FleetLocal.from_env()
+        self._ckpt = None
+        self._pulse = None  # ReplicaPulse, attached by serve()
+        if self.fleet is not None and self.fleet.size > 1:
+            tenant_config = (
+                tenant_config or tenant_mod.TenantConfig.from_env()
+            ).fleet_scaled(self.fleet.size)
         # the multi-tenant plane: admission + sessions + breakers + coalescer
         # (service/tenant.py).  ``clock`` drives every timing policy so
         # FakeClock suites can step TTLs and breaker windows.
         self.tenants = tenant_mod.TenantPlane(clock=clock, config=tenant_config)
+        if self.fleet is not None:
+            from karpenter_core_tpu.fleet.checkpoint import CheckpointPlane
+
+            self._ckpt = CheckpointPlane(
+                self.fleet.checkpoint_dir(), clock=self.tenants.clock,
+                replica_id=self.fleet.replica_id,
+                every=self.fleet.ckpt_every,
+            )
+            # a replica journals under the shared fleet root so peers can
+            # replay its chains when a checkpoint is stale (the failover
+            # ladder's middle rung); an explicit journal_dir or
+            # KC_JOURNAL_DIR still wins
+            if (
+                journal_dir is None
+                and os.environ.get("KC_SESSION_JOURNAL", "0") == "1"
+                and not os.environ.get("KC_JOURNAL_DIR")
+                and self.fleet.replica_id
+            ):
+                journal_dir = self.fleet.journal_dir()
         # durable sessions (service/journal.py, docs/SERVICE.md): when a
         # journal directory is configured, every completed tenant solve is
         # journaled and a restart replays the per-tenant chains back into
@@ -167,6 +201,16 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             self._recover_sessions()
             self.journal.start()
             self.tenants.on_drop = self.journal.append_drop
+        if self._ckpt is not None:
+            # a dropped tenant's checkpoint must not resurrect it on a peer
+            journal_drop = self.tenants.on_drop
+
+            def _drop_everywhere(tenant_id: str, _j=journal_drop) -> None:
+                if _j is not None:
+                    _j(tenant_id)
+                self._ckpt.drop(tenant_id)
+
+            self.tenants.on_drop = _drop_everywhere
         # server-side per-RPC deadline: an abandoned/slow client cannot pin a
         # worker past this (0 disables); checked at the solve stage
         # boundaries, the coarsest-grained units of handler work
@@ -252,6 +296,25 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             for tenant_id, chain in ordered:
                 entry = plane.restore_entry(tenant_id)
                 t_replay = time.perf_counter()
+                # fleet checkpoint rung: when this tenant's published
+                # checkpoint is EXACTLY as fresh as the journal tail (full
+                # lineage-state equality, never timestamps), one deserialize
+                # replaces the whole chain replay.  Stale or damaged
+                # checkpoints fall through to replay below.
+                if (
+                    self._ckpt is not None
+                    and self._fleet_restore_chain(entry, tenant_id, chain)
+                ):
+                    last = chain[-1]
+                    entry.supply_digest = last.get("client_supply")
+                    entry.journal_tseq = int(last.get("tseq", 0))
+                    entry.recovered = "warm"
+                    warm += 1
+                    journal_mod.SESSION_RECOVERED.labels("warm").inc()
+                    journal_mod.SESSION_REPLAY_DURATION.labels(
+                        metrics_mod.tenant_label(tenant_id)
+                    ).observe(time.perf_counter() - t_replay)
+                    continue
                 # trace linkage across the restart: the most recent journaled
                 # record carrying a trace context names the originating trace
                 # — the replay's spans adopt it (span_remote), so /debug/
@@ -331,7 +394,7 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
         from karpenter_core_tpu.solver.incremental import MODE_FULL
 
         req = msgpack.unpackb(rec["request"])
-        (classes, _uid_class, provisioners, daemonset_pods, state_nodes,
+        (classes, uid_class, provisioners, daemonset_pods, state_nodes,
          bound, resolver) = self._decode_tenant_classes(req)
         solver = TPUSolver(
             self.cloud_provider, provisioners, daemonset_pods,
@@ -343,6 +406,11 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
         if rec.get("kind") == journal_mod.KIND_ANCHOR:
             session.reset()
             session.store.seed_version(int(rec.get("version", 1)) - 1)
+            # fleet checkpoints serialize the lineage's anchor request so an
+            # adopting peer can re-encode without this journal — capture it
+            # on replay too, so a recovered replica checkpoints complete
+            entry.anchor_request = bytes(rec["request"])
+            entry.anchor_uid_bases = tuple(uid_class)
         session.solve(classes, state_nodes or None, bound)
         want_full = rec.get("kind") == journal_mod.KIND_ANCHOR
         if (session.last_mode == MODE_FULL) != want_full:
@@ -350,6 +418,180 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 f"replayed {rec.get('kind')} record resolved as "
                 f"{session.last_mode}"
             )
+
+    # -- fleet failover (karpenter_core_tpu.fleet, docs/FLEET.md) --------------
+
+    @staticmethod
+    def _reset_session_store(entry) -> None:
+        """A failed warm restore can leave the session's store committed at
+        the checkpoint's version; the next rung (replay, or the session-lost
+        full solve) must start from a store that has never committed, or
+        ``seed_version`` refuses."""
+        from karpenter_core_tpu.models.store import SnapshotStore
+
+        entry.session.reset()
+        entry.session.store = SnapshotStore()
+
+    def _fleet_restore_chain(self, entry, tenant_id: str, chain) -> bool:
+        """Checkpoint rung of journal recovery: restore this tenant's fleet
+        checkpoint in one deserialize iff its lineage state equals the
+        journal chain's tail exactly.  Returns False (with the entry's
+        session left fresh) on miss, staleness, or any restore failure."""
+        from karpenter_core_tpu.fleet import checkpoint as ckpt_mod
+
+        ckpt, _status = self._ckpt.load(tenant_id)
+        if ckpt is None:
+            return False
+        want = chain[-1].get("state") or {}
+        if ckpt.state != want:
+            log.info(
+                "fleet checkpoint for tenant %s is stale (version %s, "
+                "journal %s): replaying the chain", tenant_id,
+                ckpt.version, want.get("version"),
+            )
+            return False
+        try:
+            ckpt_mod.restore_session(ckpt, entry.session, self.cloud_provider)
+        except Exception as e:  # noqa: BLE001 - downgrade, never trust
+            log.warning(
+                "fleet checkpoint restore for tenant %s failed (%s); "
+                "replaying the chain", tenant_id, e,
+            )
+            self._reset_session_store(entry)
+            return False
+        entry.anchor_request = bytes(ckpt.anchor)
+        entry.anchor_uid_bases = tuple(
+            str(b) for b in ckpt.header.get("uid_bases", [])
+        )
+        entry.ckpt_ticks = 0
+        return True
+
+    def _fleet_adopt(self, tenant_id: str, entry, claimed: int) -> bool:
+        """Cross-replica failover ladder: a client claiming a warm lineage
+        this replica doesn't hold may be a routed-over tenant whose previous
+        replica died or drained.  Rungs, cheapest first:
+
+          warm      one checkpoint deserialize + never-trust verify
+          replay    re-run the tenant's chain from a PEER's journal directory
+          reanchor  give up; the caller runs the session-lost full solve
+
+        Every rung must land the lineage at EXACTLY the version the client
+        claims — anything else would answer session-lost anyway.  Outcomes
+        count on ``karpenter_fleet_failover_total``."""
+        from karpenter_core_tpu import fleet as fleet_mod
+        from karpenter_core_tpu.fleet import checkpoint as ckpt_mod
+
+        # the entry may carry a committed store from an earlier reset
+        # lineage (reset drops the warm state, not the store) — both rungs
+        # seed_version, which demands a never-committed store
+        if entry.session.store.current is not None:
+            self._reset_session_store(entry)
+        ckpt, _status = self._ckpt.load(tenant_id)
+        if ckpt is not None and ckpt.version == claimed:
+            try:
+                ckpt_mod.restore_session(
+                    ckpt, entry.session, self.cloud_provider
+                )
+                entry.anchor_request = bytes(ckpt.anchor)
+                entry.anchor_uid_bases = tuple(
+                    str(b) for b in ckpt.header.get("uid_bases", [])
+                )
+                entry.ckpt_ticks = 0
+                entry.supply_digest = ckpt.header.get("client_supply")
+                entry.journal_tseq = int(ckpt.header.get("tseq", 0))
+                fleet_mod.FAILOVER_TOTAL.labels("warm").inc()
+                log.info(
+                    "fleet failover: tenant %s adopted warm at version %d",
+                    tenant_id, claimed,
+                )
+                return True
+            except Exception as e:  # noqa: BLE001 - fall to the next rung
+                log.warning(
+                    "fleet failover: checkpoint restore for tenant %s "
+                    "failed (%s); trying peer journals", tenant_id, e,
+                )
+                self._reset_session_store(entry)
+        elif ckpt is not None:
+            log.info(
+                "fleet failover: checkpoint for tenant %s is at version %d, "
+                "client claims %d; trying peer journals", tenant_id,
+                ckpt.version, claimed,
+            )
+        if self._fleet_peer_replay(tenant_id, entry, claimed):
+            fleet_mod.FAILOVER_TOTAL.labels("replay").inc()
+            log.info(
+                "fleet failover: tenant %s adopted by journal replay at "
+                "version %d", tenant_id, claimed,
+            )
+            return True
+        fleet_mod.FAILOVER_TOTAL.labels("reanchor").inc()
+        return False
+
+    @staticmethod
+    def _read_peer_chain(directory: str, tenant_id: str):
+        """Assemble one tenant's live chain from a peer replica's journal
+        files, READ-ONLY — the peer's writer (if it still runs) owns the
+        files; ``read_frames`` tolerates a torn tail by design."""
+        ck, _ = journal_mod.read_frames(
+            os.path.join(directory, "checkpoint.wal")
+        )
+        j, _ = journal_mod.read_frames(os.path.join(directory, "journal.wal"))
+        if not ck and not j:
+            return None
+        mirror = journal_mod.ChainMirror()
+        for rec in ck + j:
+            mirror.apply(rec)
+        if tenant_id in mirror.broken:
+            return None
+        return mirror.chains.get(tenant_id)
+
+    def _fleet_peer_replay(self, tenant_id: str, entry, claimed: int) -> bool:
+        """Replay rung: scan the shared fleet root for a PEER journal whose
+        chain for this tenant ends at the claimed version, and replay it."""
+        root = self.fleet.journal_root()
+        try:
+            peers = sorted(os.listdir(root))
+        except OSError:
+            return False
+        plane = self.tenants
+        for rid in peers:
+            if rid == self.fleet.replica_id:
+                continue
+            chain = self._read_peer_chain(
+                os.path.join(root, rid), tenant_id
+            )
+            if not chain or int(chain[-1].get("version", 0)) != claimed:
+                continue
+            # replay is solo by nature: the coalescer bypass is plane-wide,
+            # so a concurrent full solve may dispatch unbatched during this
+            # window — a throughput nick, never a correctness change
+            bypass = plane._bypass_coalescer
+            plane._bypass_coalescer = True
+            try:
+                for rec in chain:
+                    self._replay_record(entry, rec)
+                state = entry.session.lineage_state()
+                want = chain[-1].get("state") or {}
+                if state != want:
+                    raise journal_mod.RecoveryMismatch(
+                        f"peer-replayed lineage diverged (have version "
+                        f"{state.get('version')}, journal "
+                        f"{want.get('version')})"
+                    )
+                last = chain[-1]
+                entry.supply_digest = last.get("client_supply")
+                entry.journal_tseq = int(last.get("tseq", 0))
+                entry.ckpt_ticks = 0
+                return True
+            except Exception as e:  # noqa: BLE001 - next peer, never trust
+                log.warning(
+                    "fleet failover: peer %s journal replay for tenant %s "
+                    "failed: %s", rid, tenant_id, e,
+                )
+                self._reset_session_store(entry)
+            finally:
+                plane._bypass_coalescer = bypass
+        return False
 
     def _journal_solve(self, entry, tenant_id: str, mode: str,
                        supply_digest, request: bytes,
@@ -392,6 +634,11 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             timeout_s = tenant_mod._env_f("KC_SERVICE_DRAIN_S", 30.0)
         if retry_after_s is None:
             retry_after_s = tenant_mod._env_f("KC_DRAIN_RETRY_AFTER_S", 5.0)
+        if self._pulse is not None:
+            # advertise the drain at the router FIRST (lease duration 0):
+            # its next maintenance pass remaps this replica's arc, and the
+            # adopting peers find the final checkpoints written below
+            self._pulse.mark_draining()
         self.tenants.start_draining(retry_after_s)
         deadline = tenant_mod.monotonic() + max(timeout_s, 0.0)
         import time as _time
@@ -399,8 +646,16 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
         while self.tenants.inflight() > 0 and tenant_mod.monotonic() < deadline:
             _time.sleep(0.02)
         drained = self.tenants.inflight() == 0
+        if self._ckpt is not None:
+            written = self._ckpt.write_all(self.tenants.entries_snapshot())
+            if written:
+                log.info(
+                    "fleet drain: %d session checkpoint(s) published", written
+                )
         if self.journal is not None:
             self.journal.close(checkpoint=True)
+        if self._pulse is not None:
+            self._pulse.stop()
         log.info("service drained (quiesced=%s)", drained)
         return drained
 
@@ -431,7 +686,23 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
     # -- handlers -------------------------------------------------------------
 
     def _health(self, request: bytes, context) -> bytes:
-        return msgpack.packb({"status": "ok"})
+        if self.fleet is None:
+            # KC_FLEET off: byte-identical to the pre-fleet response
+            return msgpack.packb({"status": "ok"})
+        # fleet replicas self-describe: the router's health fan-out and the
+        # soak's cross-process leak audit read these
+        machines = 0
+        created = getattr(self.cloud_provider, "created_machines", None)
+        if callable(created):
+            machines = len(created())
+        return msgpack.packb({
+            "status": "ok",
+            "fleet": {
+                "replica": self.fleet.replica_id,
+                "sessions": len(self.tenants.entries_snapshot()),
+                "machines": machines,
+            },
+        })
 
     def _rpc_chaos(self, context, method: str):
         """Server-transport leg of the ``service.rpc`` chaos point.  error →
@@ -774,10 +1045,16 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             pods.append(pod)
         return pods
 
-    def _decode_tenant_classes(self, req):
-        """(classes, uid_base -> request class index, decode_common tail)."""
+    @classmethod
+    def _decode_tenant_classes(cls_, req):
+        """(classes, uid_base -> request class index, decode_common tail).
+
+        A classmethod so the fleet checkpoint restore (fleet/checkpoint.py
+        restore_session) can re-decode a checkpointed anchor request without
+        a service instance — the adopting replica re-derives classes and
+        synthetic uids from the same bytes the serving replica encoded."""
         from karpenter_core_tpu.models.snapshot import build_pod_ladder
-        from karpenter_core_tpu.models.store import class_key
+        from karpenter_core_tpu.models.store import class_key, stable_digest
 
         entries = req.get("podClasses", [])
         classes = []
@@ -786,18 +1063,19 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             rep = codec.pod_from_dict(entry["pod"])
             cls = build_pod_ladder(rep)
             cls.pods = [rep]  # class_key derives from the representative
-            # class identity digest: stable across this process's lifetime,
-            # which is all the lineage needs (a restart re-anchors anyway)
-            uid_base = hashlib.sha256(
-                repr(class_key(cls)).encode()
-            ).hexdigest()[:16]
+            # class identity digest: CROSS-PROCESS stable (stable_digest
+            # canonicalizes the key's frozensets), so a fleet checkpoint's
+            # membership bookkeeping — keyed by these synthetic uids — reads
+            # back identically on the replica that adopts the tenant
+            # (fleet/checkpoint.py); same-process lineages see no change
+            uid_base = stable_digest(class_key(cls))[:16]
             if uid_base in uid_class:
                 raise ValueError(f"duplicate pod class at index {i}")
             uid_class[uid_base] = i
-            cls.pods = self._materialize_class(rep, int(entry["count"]), uid_base)
+            cls.pods = cls_._materialize_class(rep, int(entry["count"]), uid_base)
             classes.append(cls)
         provisioners, daemonset_pods, state_nodes, bound, resolver, _ = (
-            self._decode_common(req)
+            cls_._decode_common(req)
         )
         return classes, uid_class, provisioners, daemonset_pods, state_nodes, bound, resolver
 
@@ -870,11 +1148,29 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                     # ``session-lost`` re-anchor), a restarted client
                     # (claimed == 0, have > 0), or plain version skew.  The
                     # answer is always the same: drop the lineage, full
-                    # solve, never a stale delta.
-                    entry.session.reset()
-                    entry.session.force_full(
-                        "session-lost" if claimed else "client-reanchor"
+                    # solve, never a stale delta.  In a fleet, the first
+                    # shape gets one more chance: the tenant may have been
+                    # routed here after its previous replica died — adopt
+                    # the lineage warm from the shared checkpoints (or a
+                    # peer's journal) before giving up.
+                    adopted = (
+                        bool(claimed) and not have
+                        and self._ckpt is not None
+                        and self._fleet_adopt(tid, entry, claimed)
                     )
+                    if adopted:
+                        entry.recovered = entry.recovered or "warm"
+                        if (
+                            supply_digest is not None
+                            and entry.supply_digest is not None
+                            and supply_digest != entry.supply_digest
+                        ):
+                            entry.session.force_full("supply-digest")
+                    else:
+                        entry.session.reset()
+                        entry.session.force_full(
+                            "session-lost" if claimed else "client-reanchor"
+                        )
                 elif (
                     have
                     and supply_digest is not None
@@ -975,6 +1271,17 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 # only; framing/fsync ride the writer thread off this path)
                 self._journal_solve(entry, tid, mode, supply_digest, request,
                                     trace_ctx=server_ctx or trace_ctx)
+                if self._ckpt is not None:
+                    if mode == "full":
+                        # the anchor request is what an adopting peer
+                        # re-decodes: a full solve re-anchors the lineage,
+                        # so it replaces the captured anchor wholesale
+                        entry.anchor_request = bytes(request)
+                        entry.anchor_uid_bases = tuple(uid_class)
+                    # cadence checkpoint: full solves always, deltas every
+                    # KC_FLEET_CKPT_EVERY ticks; never raises (counted on
+                    # karpenter_fleet_checkpoint_total instead)
+                    self._ckpt.after_solve(tid, entry, mode)
             self._deadline_guard(context, t0)
 
             t_decode = tenant_mod.monotonic()
@@ -1121,6 +1428,7 @@ def serve(
     metrics_port: Optional[int] = None,
     journal_dir: Optional[str] = None,
     drain_on_sigterm: bool = False,
+    fleet=None,
 ):
     """Start the sidecar; returns (server, bound_port).
 
@@ -1147,7 +1455,7 @@ def serve(
     )
     service = SnapshotSolverService(
         cloud_provider, clock=clock, tenant_config=tenant_config,
-        journal_dir=journal_dir,
+        journal_dir=journal_dir, fleet=fleet,
     )
     server.add_generic_rpc_handlers((service,))
     port = server.add_insecure_port(address)
@@ -1155,6 +1463,27 @@ def serve(
     # the service (and its tenant plane) stays reachable for operators/tests
     server.kc_service = service
     server.kc_http = None
+    server.kc_pulse = None
+    fleet_local = service.fleet
+    if (
+        fleet_local is not None
+        and fleet_local.replica_id
+        and fleet_local.router_address
+    ):
+        # fleet replica: heartbeat this process's lease at the router so the
+        # ring routes here (and remaps the moment the lease goes stale)
+        from karpenter_core_tpu.fleet.lease import ReplicaPulse
+
+        pulse = ReplicaPulse(
+            RemoteLeaseStore(fleet_local.router_address),
+            fleet_local.replica_id,
+            clock=service.tenants.clock,
+            heartbeat_s=fleet_local.heartbeat_s,
+            ttl_s=fleet_local.lease_ttl_s,
+        )
+        pulse.start()
+        service._pulse = pulse
+        server.kc_pulse = pulse
     if drain_on_sigterm:
         install_drain_handler(server, service)
     if metrics_port is not None:

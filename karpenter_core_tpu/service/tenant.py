@@ -216,6 +216,22 @@ class SloTracker:
                 burn = (sum(in_window) / len(in_window)) / budget
             TENANT_SLO_BURN_RATE.labels(tenant, window).set(burn)
 
+    def burn(self, tenant: str, window: str = "5m",
+             now: Optional[float] = None) -> float:
+        """Read one tenant's current burn rate (0.0 when unobserved) — the
+        fleet router's load-aware rebalancing signal (fleet/router.py): a
+        replica whose tenants burn hottest sheds placements first."""
+        now = monotonic() if now is None else now
+        span_s = dict(self.WINDOWS).get(window, self.WINDOWS[0][1])
+        budget = 1.0 - self.objective
+        with self._lock:
+            samples = self._samples.get(tenant)
+            snapshot = list(samples) if samples else []
+        in_window = [b for (t, b) in snapshot if now - t <= span_s]
+        if not in_window:
+            return 0.0
+        return (sum(in_window) / len(in_window)) / budget
+
     def reset(self) -> None:
         with self._lock:
             self._samples.clear()
@@ -273,11 +289,16 @@ class TenantConfig:
     max_batch: int = 8
     # request bound: oversized snapshots count against the tenant's breaker
     max_request_bytes: int = 32 * 1024 * 1024
+    # True when KC_TENANT_RATE was set explicitly: an operator pin is an
+    # absolute per-process statement, so fleet_scaled() must NOT divide it
+    # by the fleet size (docs/FLEET.md "Admission")
+    rate_pinned: bool = False
 
     @classmethod
     def from_env(cls) -> "TenantConfig":
         return cls(
             rate_per_s=max(_env_f("KC_TENANT_RATE", 10.0), 0.001),
+            rate_pinned="KC_TENANT_RATE" in os.environ,
             burst=max(_env_i("KC_TENANT_BURST", 20), 1),
             max_inflight=max(_env_i("KC_TENANT_QUEUE", 16), 1),
             max_sessions=max(_env_i("KC_TENANT_SESSIONS", 256), 1),
@@ -310,6 +331,25 @@ class TenantConfig:
         rounds to an int — shed hints stay exact."""
         budget = max(int(round(self.burst * weight)), 1)
         return budget, budget / (self.rate_per_s * weight)
+
+    def fleet_scaled(self, fleet_size: int) -> "TenantConfig":
+        """The per-replica backstop shape for an N-replica fleet: the
+        fleet-level buckets at the router already enforce the configured
+        rate, so each replica grants 1/N of it — N replicas together can
+        never over-admit a tenant that bypasses the router, and the fleetless
+        (N<=1) config is returned unchanged.  An explicit ``KC_TENANT_RATE``
+        pin wins: the operator said per-process, the fleet must not reshape
+        it (satellite fix for the historical N× over-admission)."""
+        n = int(fleet_size)
+        if n <= 1 or self.rate_pinned:
+            return self
+        import dataclasses
+
+        return dataclasses.replace(
+            self,
+            rate_per_s=max(self.rate_per_s / n, 0.001),
+            burst=max(int(round(self.burst / n)), 1),
+        )
 
 
 @dataclass
@@ -555,6 +595,13 @@ class TenantEntry:
     # surfaced on the first post-recovery response
     journal_tseq: int = 0
     recovered: Optional[str] = None
+    # fleet checkpoints (fleet/checkpoint.py): the raw wire bytes of the last
+    # FULL-solve request and its class-identity digests — what a peer replica
+    # re-decodes to rebuild this lineage's snapshot — plus the solves-since-
+    # last-checkpoint cadence counter
+    anchor_request: Optional[bytes] = None
+    anchor_uid_bases: Tuple[str, ...] = ()
+    ckpt_ticks: int = 0
 
 
 class TenantPlane:
@@ -678,6 +725,12 @@ class TenantPlane:
                 self._drop_entry(evicted, "lru")
             TENANT_SESSIONS_LIVE.labels().set(float(len(self._entries)))
             return entry
+
+    def entries_snapshot(self) -> Dict[str, TenantEntry]:
+        """A point-in-time copy of the resident tenant map (drain-time fleet
+        checkpointing iterates it without holding the plane lock)."""
+        with self._lock:
+            return dict(self._entries)
 
     def discard_entry(self, tenant_id: str) -> None:
         """Remove a tenant whose recovery replay failed verification — the

@@ -298,6 +298,326 @@ class _TenantDriver:
         )
 
 
+# -- fleet failover soak (ISSUE-17, docs/FLEET.md) -----------------------------
+
+
+@dataclass
+class FleetSoakScenario:
+    """The ``fleet-failover`` acceptance run: ≥3 REAL replica processes
+    behind one in-process router, SIGKILL one replica mid-churn, and every
+    tenant it held must resume WARM on another replica (checkpoint adoption,
+    echo ``recovered="warm"``) inside the p99 SLO — with 0 cross-tenant
+    wrong answers and 0 machine leaks fleet-wide."""
+
+    name: str = "fleet-failover"
+    seed: int = 1729
+    replicas: int = 3
+    tenants: int = 8
+    rounds: int = 4
+    pods_per_tenant: int = 8
+    churn_fraction: float = 0.3
+    # SIGKILL the most-loaded replica after this round completes
+    kill_after_round: Optional[int] = 1
+    p99_slo_s: float = 120.0
+    max_attempts: int = 80
+    min_warm_fraction: float = 0.95
+    # checkpoint cadence 1: every solve leaves a restorable artifact, so the
+    # SIGKILL window never catches a tenant without one
+    ckpt_every: int = 1
+    heartbeat_s: float = 0.25
+    lease_ttl_s: float = 2.0
+    startup_timeout_s: float = 180.0
+
+
+def _free_ports(n: int) -> List[int]:
+    """Reserve n distinct loopback ports (bind-all-then-close so two calls
+    can't hand back the same port)."""
+    import socket
+
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class _ReplicaProc:
+    """One fleet replica as a real killable subprocess
+    (``python -m karpenter_core_tpu.fleet.replica_main``)."""
+
+    def __init__(self, rid: str, port: int, env: Dict[str, str],
+                 stderr_path: str) -> None:
+        import subprocess
+        import sys
+
+        self.rid = rid
+        self.port = port
+        # stderr goes to a file, not a pipe: replica logging must never
+        # block on an unread pipe buffer mid-soak
+        self.stderr_file = open(stderr_path, "wb")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "karpenter_core_tpu.fleet.replica_main"],
+            env=env, stdout=subprocess.PIPE, stderr=self.stderr_file,
+        )
+
+    def wait_ready(self) -> None:
+        """Block until the replica prints its ``PORT <n>`` readiness line."""
+        line = self.proc.stdout.readline().decode(errors="replace").strip()
+        if not line.startswith("PORT "):
+            raise RuntimeError(
+                f"replica {self.rid} failed to start (got {line!r}, "
+                f"rc={self.proc.poll()}) — see {self.stderr_file.name}"
+            )
+
+    def sigkill(self) -> None:
+        self.proc.kill()  # SIGKILL: no drain, no final checkpoint flush
+
+    def close(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10.0)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                self.proc.kill()
+                self.proc.wait(timeout=5.0)
+        try:
+            self.proc.stdout.close()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        self.stderr_file.close()
+
+
+def _fleet_machines(router_address: str) -> int:
+    """Fleet-wide machine count via the router Health fan-out (each alive
+    replica reports ``fleet.machines`` from its own cloud provider)."""
+    import grpc
+    import msgpack
+
+    channel = grpc.insecure_channel(router_address)
+    try:
+        raw = channel.unary_unary(
+            "/karpenter.v1.SnapshotSolver/Health"
+        )(msgpack.packb({}), timeout=10.0)
+        health = msgpack.unpackb(raw)
+        return sum(
+            int((r.get("fleet") or {}).get("machines", 0) or 0)
+            for r in (health.get("fleet") or {}).get("replicas", {}).values()
+            if r.get("status") == "ok"
+        )
+    finally:
+        channel.close()
+
+
+def run_fleet_failover(scenario: Optional[FleetSoakScenario] = None,
+                       seed: Optional[int] = None,
+                       fleet_dir: Optional[str] = None) -> dict:
+    """Run the fleet-failover scenario; returns a soak-style report dict."""
+    import os
+    import shutil
+    import tempfile
+
+    from karpenter_core_tpu.fleet import FleetLocal, FleetMap
+    from karpenter_core_tpu.fleet.router import serve_router
+    from karpenter_core_tpu.service.tenant import TenantConfig
+    from karpenter_core_tpu.soak.slo import percentile
+
+    scenario = scenario or FleetSoakScenario()
+    if seed is not None:
+        scenario.seed = int(seed)
+    own_dir = fleet_dir is None
+    if own_dir:
+        fleet_dir = tempfile.mkdtemp(prefix="kc-fleet-soak-")
+    os.makedirs(fleet_dir, exist_ok=True)
+
+    # reserve all ports up front so the fleet map is REAL on both sides:
+    # replicas bind the mapped port, the router dials it, heartbeats land on
+    # the router port — no placeholder maps, no discovery race
+    ports = _free_ports(scenario.replicas + 1)
+    router_port = ports[-1]
+    router_address = f"127.0.0.1:{router_port}"
+    rids = [f"r{i}" for i in range(scenario.replicas)]
+    fleet_map_spec = ",".join(
+        f"{rid}=127.0.0.1:{ports[i]}" for i, rid in enumerate(rids)
+    )
+
+    base_env = dict(os.environ)
+    for stale in ("KC_JOURNAL_DIR", "KC_FLEET_BIND"):
+        base_env.pop(stale, None)
+    base_env.update({
+        "JAX_PLATFORMS": "cpu",
+        "KC_FLEET": "1",
+        "KC_FLEET_DIR": fleet_dir,
+        "KC_FLEET_MAP": fleet_map_spec,
+        "KC_FLEET_ROUTER": router_address,
+        "KC_FLEET_CKPT_EVERY": str(scenario.ckpt_every),
+        "KC_FLEET_HEARTBEAT_S": str(scenario.heartbeat_s),
+        "KC_FLEET_LEASE_TTL_S": str(scenario.lease_ttl_s),
+        # per-replica journal (fleet.journal_dir) backs the peer-replay rung
+        "KC_SESSION_JOURNAL": "1",
+        # explicit KC_TENANT_RATE pin: replicas must not shed the soak load
+        # (the pin also exercises the "operator pin beats fleet 1/N scaling"
+        # admission contract)
+        "KC_TENANT_RATE": "200", "KC_TENANT_BURST": "400",
+        "KC_TENANT_QUEUE": "64",
+        "KC_TENANT_BATCH_WINDOW_S": "0.02",
+    })
+
+    procs: Dict[str, _ReplicaProc] = {}
+    for i, rid in enumerate(rids):
+        env = dict(base_env)
+        env["KC_FLEET_REPLICA"] = rid
+        env["KC_FLEET_BIND"] = f"127.0.0.1:{ports[i]}"
+        procs[rid] = _ReplicaProc(
+            rid, ports[i], env, os.path.join(fleet_dir, f"{rid}.stderr.log")
+        )
+    router_server = None
+    box = _ServerBox()
+    drivers = [
+        _TenantDriver(i, scenario, box) for i in range(scenario.tenants)
+    ]
+    t_wall = time.perf_counter()
+    killed_rid: Optional[str] = None
+    evicted: List[str] = []
+    machine_leaks = 0
+    try:
+        for proc in procs.values():
+            proc.wait_ready()
+        fleet = FleetLocal(
+            directory=fleet_dir,
+            fleet_map=FleetMap.parse(fleet_map_spec),
+            heartbeat_s=scenario.heartbeat_s,
+            lease_ttl_s=scenario.lease_ttl_s,
+        )
+        router_server, _ = serve_router(
+            fleet, address=f"127.0.0.1:{router_port}",
+            tenant_config=TenantConfig(
+                rate_per_s=200.0, burst=400,
+                max_inflight=max(scenario.tenants * 2, 16),
+            ),
+            max_workers=max(scenario.tenants + 2, 8),
+        )
+        box.set(router_address)
+        router = router_server.kc_router
+        for round_idx in range(scenario.rounds):
+            expect_relost = killed_rid is not None
+            threads = [
+                threading.Thread(
+                    target=d.run_round, args=(expect_relost,), daemon=True
+                )
+                for d in drivers
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if (
+                scenario.kill_after_round is not None
+                and round_idx == scenario.kill_after_round
+            ):
+                # SIGKILL the most-loaded replica mid-churn: its tenants'
+                # next routed solve fails over along the arc and the
+                # adopting replica restores them WARM from the shared
+                # checkpoint directory — no cooperation from the victim
+                with router._lock:
+                    placements = dict(router._placements)
+                loads: Dict[str, int] = {}
+                for rid in placements.values():
+                    loads[rid] = loads.get(rid, 0) + 1
+                killed_rid = max(
+                    rids, key=lambda r: (loads.get(r, 0), r)
+                )
+                evicted = sorted(
+                    t for t, rid in placements.items() if rid == killed_rid
+                )
+                procs[killed_rid].sigkill()
+        machine_leaks = _fleet_machines(router_address)
+    finally:
+        for d in drivers:
+            if d.client is not None:
+                try:
+                    d.client.close()
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+        if router_server is not None:
+            router_server.stop(grace=0)
+            router_server.kc_router.close()
+        for proc in procs.values():
+            proc.close()
+        if own_dir:
+            shutil.rmtree(fleet_dir, ignore_errors=True)
+
+    latencies = [v for d in drivers for v in d.latencies]
+    wrong = sum(d.stats["wrong_answers"] for d in drivers)
+    incomplete = sum(d.stats["incomplete_rounds"] for d in drivers)
+    outcomes = {d.tenant_id: d.resume_outcome for d in drivers}
+    warm_evicted = sum(1 for t in evicted if outcomes.get(t) == "warm")
+    warm_fraction = warm_evicted / len(evicted) if evicted else 0.0
+    p99 = percentile(latencies, 0.99)
+
+    rules = [
+        {"probe": "wrong_answers", "agg": "max", "limit": 0.0,
+         "observed": float(wrong), "passed": wrong == 0},
+        {"probe": "machine_leaks", "agg": "max", "limit": 0.0,
+         "observed": float(machine_leaks), "passed": machine_leaks == 0},
+        {"probe": "incomplete_rounds", "agg": "max", "limit": 0.0,
+         "observed": float(incomplete), "passed": incomplete == 0},
+        # the SIGKILL must actually have evicted someone, or the warm
+        # fraction would pass vacuously
+        {"probe": "evicted_tenants", "agg": "final", "limit": 1.0,
+         "observed": float(len(evicted)), "passed": len(evicted) >= 1},
+        {"probe": "warm_resume_fraction", "agg": "final",
+         "limit": scenario.min_warm_fraction,
+         "observed": round(warm_fraction, 3),
+         "passed": warm_fraction >= scenario.min_warm_fraction},
+        {"probe": "e2e_latency_p99_s", "agg": "max",
+         "limit": scenario.p99_slo_s, "observed": round(p99, 3),
+         "passed": p99 <= scenario.p99_slo_s},
+    ]
+    mode_counts: Dict[str, int] = {}
+    for d in drivers:
+        for k, v in d.mode_counts.items():
+            mode_counts[k] = mode_counts.get(k, 0) + v
+    return {
+        "verdict": {
+            "scenario": scenario.name,
+            "seed": scenario.seed,
+            "passed": all(r["passed"] for r in rules),
+            "slo": rules,
+            "tenants": scenario.tenants,
+            "rounds": scenario.rounds,
+            "replicas": scenario.replicas,
+            "killed_replica": killed_rid,
+            "warm_resumes": warm_evicted,
+            "converged": incomplete == 0,
+            "ticks": scenario.rounds,
+        },
+        "diagnostics": {
+            "wall_s": round(time.perf_counter() - t_wall, 3),
+            "latency_p99_s": round(p99, 3),
+            "latency_max_s": round(max(latencies), 3) if latencies else 0.0,
+            "mode_counts": mode_counts,
+            "evicted": list(evicted),
+            "outcomes": outcomes,
+            "stats": {
+                k: sum(d.stats[k] for d in drivers)
+                for k in drivers[0].stats
+            } if drivers else {},
+            "errors": [e for d in drivers for e in d.errors][:20],
+            "tenants": {
+                d.tenant_id: {
+                    "outcome": d.resume_outcome,
+                    "digests": list(d.round_digests),
+                }
+                for d in drivers
+            },
+        },
+    }
+
+
 def run_multi_tenant(scenario: Optional[TenantSoakScenario] = None,
                      seed: Optional[int] = None) -> dict:
     """Run the scenario; returns a soak-style report dict (verdict +

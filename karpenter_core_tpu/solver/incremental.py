@@ -663,6 +663,134 @@ class IncrementalSolveSession:
         if carry is None:
             self._warm = None  # outputs predate the carry fields
 
+    def adopt_restored(self, versioned, prep, carry, *, assign, assign_ex,
+                       n_next, members, pod_loc, failed_rows, supply,
+                       state_nodes, delta_ticks=0, initial_slots_used=0,
+                       materialized=()) -> None:
+        """Adopt a deserialized warm lineage (fleet/checkpoint.py restore).
+
+        The tensor-level twin of ``_adopt``: instead of fetching a just-run
+        solve's outputs it takes checkpointed planes verbatim — the padded
+        prep, the warm scan carry, the cumulative assignment planes, the
+        membership bookkeeping — and rebuilds the exact ``_WarmState`` the
+        originating replica held, so the next delta repairs over the restored
+        carry bit-for-bit instead of replaying the request chain.  The CALLER
+        owns the never-trust verification: ``versioned`` must be a fresh
+        commit whose plane digests equal the checkpointed ones before this
+        runs, and ``lineage_state()`` must equal the checkpointed state after
+        (fleet/checkpoint.restore_session).  Any inconsistency raises — the
+        restore ladder falls to journal replay, never a stale answer."""
+        import jax
+
+        if self._pending is not None:
+            self.settle()
+        if getattr(prep, "mesh_axes", None) is not None:
+            # a mesh-sharded carry would need resharding onto THIS replica's
+            # device topology; the ladder's replay rung covers that case
+            raise ValueError("mesh-sharded lineage cannot adopt a checkpoint")
+        carry = jax.device_put(carry)
+        assign = np.asarray(assign, dtype=np.int32).copy()
+        assign_ex = np.asarray(assign_ex, dtype=np.int32).copy()
+        snapshot = versioned.snapshot
+        all_pods = {p.uid: p for cls in snapshot.classes for p in cls.pods}
+        if snapshot.cls_root is not None:
+            root_of = [int(r) for r in snapshot.cls_root]
+        else:
+            root_of = list(range(len(snapshot.classes)))
+        failed_pods = {}
+        for uid, row in dict(failed_rows or {}).items():
+            pod = all_pods.get(uid)
+            if pod is None:
+                # a pod that joined on a DELTA tick after the anchor: absent
+                # from the anchor snapshot, but class members are fungible
+                # copies of the representative differing only in uid
+                # (service tenant path, _materialize_class) — rebuild it
+                root = root_of[int(row)] if int(row) < len(root_of) else -1
+                reps = (snapshot.classes[root].pods
+                        if 0 <= root < len(snapshot.classes) else ())
+                if not reps:
+                    raise ValueError(
+                        f"checkpointed failed pod {uid!r} has no class "
+                        f"representative in the re-encoded anchor snapshot"
+                    )
+                pod = copy.copy(reps[0])
+                pod.metadata = copy.copy(reps[0].metadata)
+                pod.metadata.uid = uid
+            failed_pods[uid] = (int(row), pod)
+        member_rows, own_inv_rows = _topology_rows(prep)
+        if pipeline_mod.pipeline_enabled():
+            prep = self.solver.upload_prep(prep)
+        self._warm = _WarmState(
+            versioned=versioned,
+            prep=prep,
+            carry=carry,
+            assign=assign,
+            assign_ex=assign_ex,
+            n_next=int(n_next),
+            members={k: tuple(v) for k, v in members.items()},
+            class_index=versioned.index_of(),
+            pod_loc={u: (int(r), str(kind), int(i))
+                     for u, (r, kind, i) in pod_loc.items()},
+            row_key={i: row.key for i, row in enumerate(versioned.rows)},
+            failed_pods=failed_pods,
+            member_rows=member_rows,
+            own_inv_rows=own_inv_rows,
+            supply=supply,
+            state_nodes=list(state_nodes or []),
+            delta_ticks=int(delta_ticks),
+            initial_slots_used=int(initial_slots_used),
+            materialized=set(materialized),
+        )
+
+    def export_lineage(self) -> Optional[Dict[str, object]]:
+        """The warm lineage as host-side data — what the fleet checkpoint
+        (fleet/checkpoint.py) serializes, and the exact argument set
+        ``adopt_restored`` consumes on the adopting replica.  Device-resident
+        leaves (the scan carry, an uploaded prep) are fetched here under the
+        pipeline-fetch watchdog; class keys — frozenset-bearing tuples, not
+        msgpack-able — are translated to class ROWS, which the restorer
+        inverts through its freshly committed ``versioned.rows``.  None when
+        there is no warm lineage (nothing to checkpoint)."""
+        import jax
+
+        from karpenter_core_tpu.utils import watchdog
+
+        self.settle()
+        w = self._warm
+        if w is None or w.carry is None:
+            return None
+        if getattr(w.prep, "mesh_axes", None) is not None:
+            return None  # sharded carries restore via replay, never tensors
+        prep, carry = watchdog.run(
+            "pipeline.fetch", jax.device_get, (w.prep, w.carry),
+            key="lineage-export",
+        )
+        # strict: a members key outside the committed class index would mean
+        # the lineage invariant broke — let the KeyError surface; the
+        # checkpoint plane degrades that tenant to the replay rung
+        members_rows = sorted(
+            (int(w.class_index[key]), sorted(uids))
+            for key, uids in w.members.items()
+        )
+        return {
+            "version": int(w.versioned.version),
+            "supply": w.supply,
+            "state": self.lineage_state(),
+            "prep": prep,
+            "carry": carry,
+            "assign": w.assign.copy(),
+            "assign_ex": w.assign_ex.copy(),
+            "n_next": int(w.n_next),
+            "members_rows": members_rows,
+            "pod_loc": {uid: [int(r), str(kind), int(i)]
+                        for uid, (r, kind, i) in w.pod_loc.items()},
+            "failed_rows": {uid: int(row)
+                            for uid, (row, _pod) in w.failed_pods.items()},
+            "delta_ticks": int(w.delta_ticks),
+            "initial_slots_used": int(w.initial_slots_used),
+            "materialized": sorted(w.materialized),
+        }
+
     # -- delta path ------------------------------------------------------------
     #
     # One delta tick is four stages — plan (host), dispatch (device, async),

@@ -537,6 +537,143 @@ def _run_flake_scenario(seed: int):
     }
 
 
+# -- the fleet routing leg (ISSUE-17, fleet/router.py, docs/FLEET.md) ----------
+
+
+class TestFleetRouteMatrix:
+    """The ``fleet.route`` leg of the matrix: injected forwarding faults PLUS
+    a real replica eviction mid-stream — every routed tenant retries through
+    to a structurally correct answer (zero cross-tenant wrong answers), and
+    the evicted tenant resumes WARM on the adopting replica."""
+
+    def _fleet(self, tmp_path):
+        import os
+
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_core_tpu.fleet import FleetLocal, FleetMap
+        from karpenter_core_tpu.fleet.router import serve_router
+        from karpenter_core_tpu.service.snapshot_channel import (
+            SnapshotSolverClient,
+            serve,
+        )
+        from karpenter_core_tpu.service.tenant import TenantConfig
+
+        directory = str(tmp_path / "fleet")
+        config = TenantConfig(
+            rate_per_s=1000.0, burst=1000, max_inflight=64,
+            batch_window_s=0.0, max_batch=8,
+        )
+        servers, parts = {}, []
+        for rid in ("r1", "r2"):
+            fleet = FleetLocal(
+                directory=directory, replica_id=rid,
+                fleet_map=FleetMap.parse("r1=pending:0,r2=pending:0"),
+                ckpt_every=1,
+            )
+            server, port = serve(
+                FakeCloudProvider(), tenant_config=config, fleet=fleet,
+                journal_dir=os.path.join(directory, "journals", rid),
+            )
+            servers[rid] = server
+            parts.append(f"{rid}=127.0.0.1:{port}")
+        router_fleet = FleetLocal(
+            directory=directory,
+            fleet_map=FleetMap.parse(",".join(parts)),
+        )
+        router_server, router_port = serve_router(
+            router_fleet, tenant_config=config,
+        )
+        client = SnapshotSolverClient(f"127.0.0.1:{router_port}")
+        return servers, router_server, client
+
+    @staticmethod
+    def _verify_accounting(resp, tenant_id, sent):
+        """A wrong answer is any response that does not account for exactly
+        this tenant's own classes (the cross-tenant contamination check)."""
+        echo = resp["tenant"]
+        assert echo["id"] == tenant_id, echo
+        placed = [0] * len(sent)
+        for node in resp.get("newNodes", []):
+            for c, n in node.get("classCounts", []):
+                assert 0 <= c < len(sent) and n >= 0, (c, n)
+                placed[c] += n
+        for counts in resp.get("existingAssignments", {}).values():
+            for c, n in counts:
+                assert 0 <= c < len(sent) and n >= 0, (c, n)
+                placed[c] += n
+        for bucket in ("failedClassCounts", "residualClassCounts"):
+            for c, n in resp.get(bucket, []):
+                assert 0 <= c < len(sent) and n >= 0, (c, n)
+                placed[c] += n
+        if echo.get("solveMode") == "full":
+            assert placed == sent, (placed, sent)
+        else:
+            assert all(p <= s for p, s in zip(placed, sent)), (placed, sent)
+
+    def test_routed_tenants_survive_eviction_and_route_faults(self, tmp_path):
+        import grpc
+        import msgpack
+
+        servers, router_server, client = self._fleet(tmp_path)
+
+        def solve(tid, count, version):
+            """Retry through injected route faults, as real clients do."""
+            for _ in range(10):
+                try:
+                    return client.solve_tenant_classes(
+                        [(make_pod(requests={"cpu": "500m"}), count)],
+                        [make_provisioner()],
+                        tenant={"id": tid, "sessionVersion": version},
+                    )
+                except grpc.RpcError as e:
+                    assert e.code() in (
+                        grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                    ), e
+            raise AssertionError(f"{tid}: no convergence in 10 attempts")
+
+        scenario = chaos.Scenario("fleet-evict", 29, {
+            "fleet.route": chaos.PointSpec(prob=0.4, stop_after=3,
+                                           kind="error"),
+        })
+        versions = {"acme": 0, "zeta": 0}
+        recovered = {}
+        try:
+            with chaos.armed(scenario):
+                for round_no in range(4):
+                    for tid in versions:
+                        count = 6 + 2 * round_no
+                        resp = solve(tid, count, versions[tid])
+                        self._verify_accounting(resp, tid, [count])
+                        versions[tid] = resp["tenant"]["sessionVersion"]
+                        if resp["tenant"].get("recovered"):
+                            recovered[tid] = resp["tenant"]["recovered"]
+                    if round_no == 1:
+                        # mid-stream eviction: kill the replica holding acme
+                        state = msgpack.unpackb(
+                            client.channel.unary_unary(
+                                "/karpenter.v1.SnapshotSolver/FleetState"
+                            )(msgpack.packb({}))
+                        )
+                        holder = state["placements"]["acme"]
+                        servers[holder].stop(grace=0)
+                        servers[holder].kc_service.shutdown()
+            # the injected faults actually fired, and the evicted tenant
+            # came back WARM on the adopting replica — never a wrong answer
+            assert scenario.fired_counts().get("fleet.route", 0) >= 1
+            assert recovered.get("acme") == "warm", recovered
+        finally:
+            client.close()
+            router_server.kc_router.close()
+            router_server.stop(grace=0)
+            for server in servers.values():
+                server.stop(grace=0)
+                try:
+                    server.kc_service.shutdown()
+                except Exception:  # noqa: BLE001 - already shut down
+                    pass
+
+
 class TestSeedReplay:
     def test_same_seed_reproduces_the_run(self):
         import os

@@ -1,0 +1,413 @@
+"""Fleet router (ISSUE-17, fleet/ring.py + fleet/router.py, docs/FLEET.md):
+deterministic consistent-hash placement with bounded load, fleet-level
+admission with exact retry hints, warm cross-replica failover through the
+router, and the KC_FLEET=0 wire-level byte-identity regression pin."""
+
+import math
+import os
+
+import grpc
+import msgpack
+import pytest
+
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.apis import codec
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_core_tpu.fleet import FleetLocal, FleetMap
+from karpenter_core_tpu.fleet.ring import HashRing
+from karpenter_core_tpu.fleet.router import serve_router
+from karpenter_core_tpu.service.snapshot_channel import (
+    SnapshotSolverClient,
+    serve,
+)
+from karpenter_core_tpu.service.tenant import TenantConfig, parse_retry_after
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+from karpenter_core_tpu.utils.clock import FakeClock
+
+
+def _loose_config(**kw) -> TenantConfig:
+    base = dict(
+        rate_per_s=1000.0, burst=1000, max_inflight=64,
+        batch_window_s=0.0, max_batch=8,
+        breaker_threshold=3, breaker_reset_s=30.0,
+    )
+    base.update(kw)
+    return TenantConfig(**base)
+
+
+def _solve(client, tenant_id, count=4, version=0, cpu="500m"):
+    return client.solve_tenant_classes(
+        [(make_pod(requests={"cpu": cpu}), count)], [make_provisioner()],
+        tenant={"id": tenant_id, "sessionVersion": version},
+    )
+
+
+# -- fleet map + ring ---------------------------------------------------------
+
+
+class TestFleetMap:
+    def test_parse_skips_malformed_and_keeps_first_duplicate(self):
+        fm = FleetMap.parse(
+            " r1=127.0.0.1:41, bogus ,=:0, r2 = 127.0.0.1:42 ,r1=9.9.9.9:1,"
+        )
+        assert fm.ids() == ("r1", "r2")
+        assert fm.addresses() == {
+            "r1": "127.0.0.1:41", "r2": "127.0.0.1:42",
+        }
+        assert FleetMap.parse("").size == 0
+
+    def test_from_env_gating(self, monkeypatch):
+        monkeypatch.delenv("KC_FLEET", raising=False)
+        assert FleetLocal.from_env() is None
+        monkeypatch.setenv("KC_FLEET", "1")
+        assert FleetLocal.from_env() is None  # no directory
+        monkeypatch.setenv("KC_FLEET_DIR", "/tmp/fleet-x")
+        monkeypatch.setenv("KC_FLEET_REPLICA", "r2")
+        monkeypatch.setenv("KC_FLEET_MAP", "r1=a:1,r2=b:2,r3=c:3")
+        fleet = FleetLocal.from_env()
+        assert fleet is not None
+        assert fleet.replica_id == "r2" and fleet.size == 3
+        assert fleet.journal_dir() == "/tmp/fleet-x/journals/r2"
+        assert fleet.journal_dir("r1") == "/tmp/fleet-x/journals/r1"
+
+
+class TestHashRing:
+    FM = FleetMap.parse("r1=a:1,r2=b:2,r3=c:3,r4=d:4")
+
+    def test_placement_deterministic_across_instances(self):
+        a, b = HashRing(self.FM), HashRing(self.FM)
+        for i in range(64):
+            t = f"tenant-{i}"
+            assert a.owner(t) == b.owner(t)
+            assert a.arc(t) == b.arc(t)
+
+    def test_arc_is_a_permutation_of_the_roster(self):
+        ring = HashRing(self.FM)
+        for i in range(32):
+            arc = ring.arc(f"t{i}")
+            assert sorted(arc) == sorted(self.FM.ids())
+
+    def test_remap_walks_to_next_on_arc_when_owner_dies(self):
+        ring = HashRing(self.FM)
+        for i in range(32):
+            t = f"t{i}"
+            arc = ring.arc(t)
+            assert ring.owner(t) == arc[0]
+            alive = set(arc) - {arc[0]}
+            assert ring.owner(t, alive=alive) == arc[1]
+
+    def test_single_replica_loss_moves_only_its_tenants(self):
+        ring = HashRing(self.FM)
+        tenants = [f"t{i}" for i in range(200)]
+        before = {t: ring.owner(t) for t in tenants}
+        alive = set(self.FM.ids()) - {"r2"}
+        for t in tenants:
+            after = ring.owner(t, alive=alive)
+            if before[t] != "r2":
+                assert after == before[t], "unaffected arcs must not move"
+
+    def test_bounded_load_caps_the_hot_replica(self):
+        ring = HashRing(self.FM, load_factor=1.25)
+        assigned = {}
+        for i in range(400):
+            rid = ring.owner(f"t{i}", assigned=assigned)
+            assigned[rid] = assigned.get(rid, 0) + 1
+        cap = math.ceil(1.25 * 400 / self.FM.size) + 1
+        assert max(assigned.values()) <= cap, assigned
+        assert len(assigned) == self.FM.size, "every replica takes load"
+
+    def test_empty_ring_places_nowhere(self):
+        ring = HashRing(FleetMap())
+        assert ring.arc("t") == ()
+        assert ring.owner("t") is None
+
+
+# -- KC_FLEET=0 byte-identity pin ---------------------------------------------
+
+
+def _raw_request(tenant, count=4):
+    return msgpack.packb({
+        "podClasses": [
+            {"pod": codec.pod_to_dict(make_pod(requests={"cpu": "500m"})),
+             "count": count},
+        ],
+        "provisioners": [codec.provisioner_to_dict(make_provisioner())],
+        "daemonsetPods": [], "nodes": [], "claimDrivers": {}, "policy": {},
+        "tenant": {"id": tenant, "sessionVersion": 0},
+    })
+
+
+class TestFleetOffByteIdentity:
+    def test_fleetless_wire_bytes_are_unchanged(self, tmp_path, monkeypatch):
+        """The regression pin: with no fleet configured, every response byte
+        (health AND tenant solve) is identical to a fleet-enabled replica's
+        serving path — the fleet layer adds zero bytes to the default wire."""
+        monkeypatch.delenv("KC_FLEET", raising=False)
+        servers = []
+        try:
+            raws = []
+            for fleet in (
+                None,
+                FleetLocal(
+                    directory=str(tmp_path / "fleet"), replica_id="r1",
+                    fleet_map=FleetMap.parse("r1=a:1,r2=b:2"),
+                ),
+            ):
+                server, port = serve(
+                    FakeCloudProvider(), tenant_config=_loose_config(),
+                    fleet=fleet,
+                )
+                servers.append(server)
+                channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+                solve = channel.unary_unary(
+                    "/karpenter.v1.SnapshotSolver/SolveClasses"
+                )
+                health = channel.unary_unary(
+                    "/karpenter.v1.SnapshotSolver/Health"
+                )
+                raws.append((
+                    solve(_raw_request("acme")),
+                    health(msgpack.packb({})),
+                ))
+                channel.close()
+            (plain_solve, plain_health), (fleet_solve, fleet_health) = raws
+            # the fleetless health response is pinned to the exact pre-fleet
+            # bytes; the fleet replica's solve bytes must not diverge either
+            assert plain_health == msgpack.packb({"status": "ok"})
+            assert plain_solve == fleet_solve
+            assert b"fleet" not in plain_solve
+            fleet_info = msgpack.unpackb(fleet_health)["fleet"]
+            assert fleet_info["replica"] == "r1"
+        finally:
+            for server in servers:
+                server.stop(grace=0)
+                server.kc_service.shutdown()
+
+
+# -- routed end to end --------------------------------------------------------
+
+
+class _Fleet:
+    """Two live replicas + a router over a shared fleet directory."""
+
+    def __init__(self, tmp_path, router_config=None, ckpt_every=1):
+        directory = str(tmp_path / "fleet")
+        self.provider = FakeCloudProvider()
+        self.servers = {}
+        parts = []
+        for rid in ("r1", "r2"):
+            fleet = FleetLocal(
+                directory=directory, replica_id=rid,
+                fleet_map=FleetMap.parse("r1=pending:0,r2=pending:0"),
+                ckpt_every=ckpt_every,
+            )
+            server, port = serve(
+                self.provider, tenant_config=_loose_config(), fleet=fleet,
+                journal_dir=os.path.join(directory, "journals", rid),
+            )
+            self.servers[rid] = server
+            parts.append(f"{rid}=127.0.0.1:{port}")
+        self.router_fleet = FleetLocal(
+            directory=directory, replica_id="",
+            fleet_map=FleetMap.parse(",".join(parts)),
+        )
+        self.router_server, self.router_port = serve_router(
+            self.router_fleet, tenant_config=router_config or _loose_config(),
+        )
+        self.client = SnapshotSolverClient(f"127.0.0.1:{self.router_port}")
+
+    def kill(self, rid):
+        """SIGKILL shape: the replica vanishes without drain or checkpoint
+        flush beyond what serving already published."""
+        self.servers[rid].stop(grace=0)
+        self.servers[rid].kc_service.shutdown()
+
+    def close(self):
+        self.client.close()
+        self.router_server.kc_router.close()
+        self.router_server.stop(grace=0)
+        for server in self.servers.values():
+            server.stop(grace=0)
+            server.kc_service.shutdown()
+
+
+class TestRoutedEndToEnd:
+    def test_route_sticky_and_envelope_preserved(self, tmp_path):
+        fl = _Fleet(tmp_path)
+        try:
+            r1 = _solve(fl.client, "acme", count=8)
+            assert r1["tenant"]["id"] == "acme"
+            assert r1["tenant"]["solveMode"] == "full"
+            v1 = r1["tenant"]["sessionVersion"]
+            r2 = _solve(fl.client, "acme", count=10, version=v1)
+            # sticky placement: the delta lands on the replica holding the
+            # warm lineage — a remap would answer full/session-lost
+            assert r2["tenant"]["solveMode"] == "delta"
+            state = msgpack.unpackb(
+                fl.client.channel.unary_unary(
+                    "/karpenter.v1.SnapshotSolver/FleetState"
+                )(msgpack.packb({}))
+            )
+            assert state["placements"]["acme"] in ("r1", "r2")
+            assert sorted(state["alive"]) == ["r1", "r2"]
+        finally:
+            fl.close()
+
+    def test_failover_resumes_warm_through_the_router(self, tmp_path):
+        """Kill the replica holding the tenant: the router walks the arc and
+        the peer adopts the lineage WARM from the shared checkpoint — the
+        client sees one transparent delta, recovered=warm."""
+        fl = _Fleet(tmp_path)
+        try:
+            r1 = _solve(fl.client, "acme", count=8)
+            v1 = r1["tenant"]["sessionVersion"]
+            r2 = _solve(fl.client, "acme", count=10, version=v1)
+            assert r2["tenant"]["solveMode"] == "delta"
+            state = msgpack.unpackb(
+                fl.client.channel.unary_unary(
+                    "/karpenter.v1.SnapshotSolver/FleetState"
+                )(msgpack.packb({}))
+            )
+            holder = state["placements"]["acme"]
+            fl.kill(holder)
+            r3 = _solve(fl.client, "acme", count=12,
+                        version=r2["tenant"]["sessionVersion"])
+            assert r3["tenant"]["solveMode"] == "delta"
+            assert r3["tenant"]["recovered"] == "warm"
+            r4 = _solve(fl.client, "acme", count=14,
+                        version=r3["tenant"]["sessionVersion"])
+            assert r4["tenant"]["solveMode"] == "delta"
+            assert "recovered" not in r4["tenant"]
+        finally:
+            fl.close()
+
+    def test_fleet_admission_sheds_with_exact_hint(self, tmp_path):
+        tight = _loose_config(rate_per_s=0.5, burst=1)
+        fl = _Fleet(tmp_path, router_config=tight)
+        try:
+            _solve(fl.client, "noisy", count=4)
+            with pytest.raises(grpc.RpcError) as exc:
+                _solve(fl.client, "noisy", count=4)
+            assert exc.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            detail = exc.value.details()
+            assert detail.startswith("fleet-shed reason=rate")
+            hint = parse_retry_after(detail)
+            assert hint is not None and 0.0 < hint <= 3.0
+        finally:
+            fl.close()
+
+    def test_replica_abort_passes_through_the_router(self, tmp_path):
+        """Replica-originated aborts keep code AND details across the hop —
+        the router only retries UNAVAILABLE/DEADLINE, never verdicts."""
+        fl = _Fleet(tmp_path)
+        try:
+            with pytest.raises(grpc.RpcError) as exc:
+                fl.client.solve_tenant_classes(
+                    [(make_pod(requests={"cpu": "500m"}), 4)],
+                    [make_provisioner()], tenant={"id": ""},
+                )
+            assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            assert "tenant.id required" in exc.value.details()
+        finally:
+            fl.close()
+
+
+class TestChaosFleetRoute:
+    def _arm(self, kind):
+        return chaos.armed(chaos.Scenario(
+            f"fleet-{kind}", 1,
+            {"fleet.route": chaos.PointSpec(first_n=1, kind=kind)},
+        ))
+
+    def test_error_and_timeout_surface_as_grpc_codes(self, tmp_path):
+        fl = _Fleet(tmp_path)
+        try:
+            with self._arm("error"):
+                with pytest.raises(grpc.RpcError) as exc:
+                    _solve(fl.client, "acme", count=4)
+            assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+            with self._arm("timeout"):
+                with pytest.raises(grpc.RpcError) as exc:
+                    _solve(fl.client, "acme", count=4)
+            assert exc.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+            # the fleet heals: the next un-faulted solve answers normally
+            r = _solve(fl.client, "acme", count=4)
+            assert r["tenant"]["solveMode"] == "full"
+        finally:
+            fl.close()
+
+    def test_partial_drops_the_answer_after_the_replica_solved(self, tmp_path):
+        """The mid-stream eviction shape: the replica computes and journals,
+        the client never sees the response — and the session recovers on the
+        retry without a wrong answer."""
+        fl = _Fleet(tmp_path)
+        try:
+            r1 = _solve(fl.client, "acme", count=8)
+            v1 = r1["tenant"]["sessionVersion"]
+            with self._arm("partial"):
+                with pytest.raises(grpc.RpcError) as exc:
+                    _solve(fl.client, "acme", count=10, version=v1)
+            assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+            # the replica's lineage advanced without us: the retry with the
+            # stale claim re-anchors deterministically — never a stale delta
+            r2 = _solve(fl.client, "acme", count=10, version=v1)
+            assert r2["tenant"]["solveMode"] in ("full", "delta")
+            n_sent = 10
+            placed = sum(
+                n for node in r2["newNodes"] for _c, n in node["classCounts"]
+            ) + sum(
+                n for counts in r2["existingAssignments"].values()
+                for _c, n in counts
+            ) + sum(n for _c, n in r2["failedClassCounts"]) + sum(
+                n for _c, n in r2.get("residualClassCounts", [])
+            )
+            if r2["tenant"]["solveMode"] == "full":
+                assert placed == n_sent
+        finally:
+            fl.close()
+
+
+class TestLeaseLiveness:
+    def test_pulse_beats_and_drains_through_the_router(self, tmp_path):
+        from karpenter_core_tpu.fleet.lease import (
+            LeaseDirectory,
+            ReplicaPulse,
+        )
+        from karpenter_core_tpu.service.snapshot_channel import (
+            RemoteLeaseStore,
+        )
+
+        fl = _Fleet(tmp_path)
+        try:
+            router = fl.router_server.kc_router
+            store = RemoteLeaseStore(f"127.0.0.1:{fl.router_port}")
+            pulse = ReplicaPulse(store, "r1", ttl_s=5.0)
+            assert pulse.beat() is True
+            alive, draining = router.directory.view(("r1", "r2"))
+            assert "r1" in alive and "r2" in alive  # r2: bootstrap, no lease
+            pulse.mark_draining()
+            alive, draining = router.directory.view(("r1", "r2"))
+            assert "r1" in draining and "r1" not in alive
+        finally:
+            fl.close()
+
+    def test_stale_lease_counts_dead(self, tmp_path):
+        from karpenter_core_tpu.fleet.lease import (
+            LeaseDirectory,
+            LeasePlane,
+            lease_name,
+        )
+
+        clock = FakeClock()
+        plane = LeasePlane("")
+        plane.apply_wire(msgpack.packb({"lease": {
+            "name": lease_name("r1"), "namespace": "kc-fleet",
+            "holderIdentity": "r1", "leaseDurationSeconds": 5,
+            "acquireTime": clock.now(), "renewTime": clock.now(),
+        }, "expectedVersion": None}))
+        directory = LeaseDirectory(plane, clock=clock, ttl_s=5.0)
+        alive, draining = directory.view(("r1",))
+        assert alive == {"r1"}
+        clock.step(60.0)
+        alive, draining = directory.view(("r1",))
+        assert alive == set() and draining == set()

@@ -73,6 +73,34 @@ def test_decode_regression_flags_independently_of_solve():
     assert not by_key["solve_decode_s"][3], "fused number inside tolerance"
 
 
+def test_fleet_restore_stage_gates_and_advisory_warns(capsys):
+    """The fleet checkpoint-restore stage (bench.py fleet_line) gates like
+    any other load-bearing stage, and report_fleet warns when the restore
+    stops beating journal replay ≥5x at 64 deltas or when the restored
+    lineages diverge (ISSUE-17 acceptance)."""
+    pg = _load_perfgate()
+    assert "fleet_restore_s" in pg.GATED_STAGES
+    prev = {"fleet_restore_s": 0.10}
+    cur = {"fleet_restore_s": 0.50}
+    rows = pg.compare_stages(cur, prev, tol=0.25)
+    (row,) = rows
+    assert row[0] == "fleet_restore_s" and row[3], "5x restore regression"
+
+    pg.report_fleet({
+        "fleet": {"restores": [{
+            "deltas": 64, "checkpoint_restore_s": 0.05,
+            "replay_restore_s": 0.10, "speedup": 2.0,
+            "warm_ok": True, "replay_ok": True, "bit_identical": False,
+        }]},
+        "fleet_restore_deltas": 64,
+        "fleet_restore_speedup": 2.0,
+    })
+    out = capsys.readouterr().out
+    assert "WARNING" in out
+    assert "5x" in out
+    assert "diverged" in out
+
+
 def test_records_predating_the_split_are_skipped():
     pg = _load_perfgate()
     prev = {"solve_decode_s": 1.0}  # an old BENCH_r*.json without the split

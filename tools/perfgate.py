@@ -98,7 +98,8 @@ STAGE_KEYS = ("solve_decode_s", "solve_s", "decode_s", "ingest_s",
               "churn_warm_solve_s", "churn_full_solve_s",
               "churn_delta_ingest_s", "objective_s",
               "sharded_solve_s", "sharded_solve_1dev_s",
-              "pipeline_warm_tick_s", "pipeline_serial_tick_s")
+              "pipeline_warm_tick_s", "pipeline_serial_tick_s",
+              "fleet_restore_s", "fleet_replay_s")
 # stages that matter enough to flag; the others are printed but only the
 # load-bearing ones gate (sub-10ms stages WARN on scheduler-noise otherwise)
 # objective_s gates too: the policy scoring stage rides every policy-enabled
@@ -123,7 +124,13 @@ GATED_STAGES = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "cold_s",
                 # not hide inside healthy solve/decode halves.  The serial
                 # twin stays advisory (it moves with machine noise and is
                 # already covered by the churn stages).
-                "pipeline_warm_tick_s")
+                "pipeline_warm_tick_s",
+                # the fleet checkpoint-restore cost at the deepest chain
+                # (bench.py fleet_line): the latency an evicted tenant pays
+                # before its first failover answer.  The replay twin stays
+                # advisory — it moves with solve cost, which the solve
+                # stages already gate.
+                "fleet_restore_s")
 
 
 def compare_stages(detail: dict, prev_detail: dict, tol: float):
@@ -395,6 +402,54 @@ def report_tenant(detail: dict) -> None:
         )
 
 
+def report_fleet(detail: dict) -> None:
+    """Surface the fleet failover restore line (ISSUE-17, docs/FLEET.md):
+    checkpoint-restore vs journal-replay adoption cost per chain depth.  The
+    enforced side is ``fleet_restore_s`` in GATED_STAGES; the advisory warns
+    when the tensor checkpoint stops beating replay by ≥5x at the deepest
+    chain (64 deltas — the whole point of checkpoints over replay), or when
+    the two restored lineages stop answering bit-identically."""
+    fleet = detail.get("fleet")
+    if not fleet:
+        return
+    if "error" in fleet:
+        print(f"perfgate: fleet bench errored: {fleet['error']}")
+        return
+    for row in fleet.get("restores", []):
+        print(
+            "perfgate: fleet restore @{d} deltas: checkpoint {c:.4f}s vs "
+            "replay {r:.4f}s — speedup {x:.2f}x, bit_identical={b}".format(
+                d=row["deltas"], c=row["checkpoint_restore_s"],
+                r=row["replay_restore_s"], x=row.get("speedup") or 0.0,
+                b=row.get("bit_identical"),
+            )
+        )
+        if not (row.get("warm_ok") and row.get("replay_ok")):
+            print(
+                "perfgate: WARNING fleet restore rung failed at "
+                f"{row['deltas']} deltas (warm_ok={row.get('warm_ok')}, "
+                "replay_ok={0}) — the failover ladder is broken "
+                "(docs/FLEET.md triage)".format(row.get("replay_ok"))
+            )
+        if row.get("bit_identical") is False:
+            print(
+                "perfgate: WARNING checkpoint-restored and replay-restored "
+                f"lineages diverged on the next solve at {row['deltas']} "
+                "deltas — a checkpoint plane is drifting from the journal "
+                "truth (docs/FLEET.md bit-identity contract)"
+            )
+    deepest = detail.get("fleet_restore_deltas")
+    speedup = detail.get("fleet_restore_speedup")
+    if deepest is not None and deepest >= 64 and speedup is not None \
+            and speedup < 5.0:
+        print(
+            "perfgate: WARNING fleet checkpoint restore only "
+            f"{speedup:.2f}x faster than journal replay at {deepest} "
+            "deltas (< 5x acceptance floor) — the one-deserialize restore "
+            "is losing its reason to exist (docs/FLEET.md)"
+        )
+
+
 def report_recovery(detail: dict) -> None:
     """Surface the durable-session journal's hot-path cost (ISSUE-13,
     docs/SERVICE.md): the tenant bench's serial p99 with a per-solve journal
@@ -497,6 +552,7 @@ def main() -> int:
     report_policy(detail)
     report_sharded(detail)
     report_tenant(detail)
+    report_fleet(detail)
     report_recovery(detail)
     report_watchdog(detail)
     report_telemetry(detail)
