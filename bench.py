@@ -1019,6 +1019,232 @@ def tenant_line(n_tenants: int = 8, pods_per_tenant: int = 256) -> dict:
     }
 
 
+def fusion_line(tenant_counts=(2, 4, 8), pods_per_tenant: int = 256) -> dict:
+    """Generalized solve fusion benchmark (PR 18, docs/SERVICE.md "Solve
+    fusion"): REPAIR dispatches from N tenants under steady churn, solved
+    two ways —
+
+      serial   N solo warm-carry repair dispatches, one per tenant
+      fused    ONE vmapped dispatch over the tenant-stacked repair planes
+               (warm_carry + repair_plan leaves batched alongside the class
+               planes)
+
+    at each count in ``tenant_counts``, with a bit-level parity check of the
+    fused per-tenant slices against the solo outputs at the deepest count.
+    Capture runs with KC_DELTA_WINDOW=0 (full-width repairs) so every
+    tenant's repair lands in ONE shape bucket regardless of which rows
+    churned; windowed-fusion parity is pinned by tests/test_solve_fusion.py.
+
+    Also sweeps KC_BUCKET_QUANTIZE over a mixed-size tenant population:
+    distinct executable buckets and batch occupancy vs padded FLOPs, default
+    ladder against the coarser power-of-two ladder.  tools/perfgate.py gates
+    ``fusion_repair_solve_s`` and warns when fused throughput drops under
+    2x serial at the deepest count.  Env: KC_BENCH_FUSION=0 skips,
+    KC_BENCH_FUSION_TENANTS, KC_BENCH_FUSION_PODS."""
+    import copy as copy_mod
+    import random
+
+    import numpy as np
+
+    from karpenter_core_tpu.apis.objects import new_uid
+    from karpenter_core_tpu.cloudprovider import fake as fake_cp
+    from karpenter_core_tpu.models.columnar import PodIngest
+    from karpenter_core_tpu.ops import solve as solve_ops
+    from karpenter_core_tpu.service.tenant import BatchCoalescer, bucket_key
+    from karpenter_core_tpu.solver.incremental import (
+        MODE_DELTA,
+        FallbackPolicy,
+        IncrementalSolveSession,
+    )
+    from karpenter_core_tpu.solver.tpu import TPUSolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+    from karpenter_core_tpu.utils import compilecache
+
+    sizes = [{"cpu": "500m"}, {"cpu": "250m"}, {"cpu": 1, "memory": "1Gi"}]
+    n_max = max(tenant_counts)
+    provider = fake_cp.FakeCloudProvider()
+    provisioners = [make_provisioner()]
+
+    def churn(ingest, rng, fraction=0.05):
+        members = ingest.class_members()
+        uids = [u for us in members.values() for u in us]
+        for i, uid in enumerate(
+            rng.sample(uids, max(int(len(uids) * fraction), 1))
+        ):
+            rep = copy_mod.deepcopy(ingest.get(uid))
+            ingest.remove(uid)
+            rep.metadata.name = f"churn-{i}"
+            rep.metadata.uid = new_uid()
+            rep.spec.node_name = ""
+            ingest.add(rep)
+
+    saved_window = os.environ.get("KC_DELTA_WINDOW")
+    os.environ["KC_DELTA_WINDOW"] = "0"
+    captured = []  # (solver, prep, kw) of each tenant's repair dispatch
+    try:
+        for t in range(n_max):
+            solver = TPUSolver(provider, provisioners)
+            holder = {}
+
+            def hook(prep, _solver=solver, _holder=holder, **kw):
+                # the tenant service's dispatch shape: the session already
+                # passes donate_carry=False to hooked repairs (the coalescer
+                # may stack copies of the carry)
+                if kw.get("warm_carry") is not None:
+                    _holder["repair"] = (prep, dict(kw))
+                return _solver.run_prepared(prep, **kw)
+
+            session = IncrementalSolveSession(
+                solver,
+                FallbackPolicy(enabled=True, audit_interval=0,
+                               max_delta_fraction=0.9),
+                run_prepared=hook,
+            )
+            ingest = PodIngest()
+            ingest.add_all([
+                make_pod(requests=sizes[(t + i) % len(sizes)])
+                for i in range(pods_per_tenant)
+            ])
+            session.solve(ingest)
+            churn(ingest, random.Random(17 + t))
+            session.solve(ingest)
+            if session.last_mode != MODE_DELTA or "repair" not in holder:
+                raise RuntimeError(
+                    f"tenant {t} repair not captured "
+                    f"({session.last_mode}/{session.last_reason})"
+                )
+            prep, kw = holder["repair"]
+            captured.append((solver, prep, kw))
+    finally:
+        if saved_window is None:
+            os.environ.pop("KC_DELTA_WINDOW", None)
+        else:
+            os.environ["KC_DELTA_WINDOW"] = saved_window
+
+    buckets = {bucket_key(p, kw) for _s, p, kw in captured}
+    if len(buckets) != 1:
+        raise RuntimeError(
+            f"repair dispatches split {len(buckets)} shape buckets"
+        )
+
+    def run_solo(solver, prep, kw):
+        # the captured kw carries donate_carry=False from the hooked session
+        return solver.run_prepared(prep, **kw)
+
+    # bit-level parity at the deepest count before anything is timed
+    import jax
+
+    solo_outputs = [run_solo(*c) for c in captured]
+    fused_outputs = BatchCoalescer._run_batched(
+        [p for _s, p, _kw in captured], kws=[kw for *_ , kw in captured]
+    )
+    for t, (solo_out, fused_out) in enumerate(
+        zip(solo_outputs, fused_outputs)
+    ):
+        solo_leaves = jax.tree_util.tree_leaves(jax.device_get(solo_out))
+        fused_leaves = jax.tree_util.tree_leaves(jax.device_get(fused_out))
+        for a, b in zip(solo_leaves, fused_leaves):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                raise RuntimeError(f"fused repair diverged for tenant {t}")
+
+    compilecache.reset_occupancy()
+    repair = {}
+    for n in sorted(tenant_counts):
+        sub = captured[:n]
+        preps = [p for _s, p, _kw in sub]
+        kws = [kw for *_ , kw in sub]
+        BatchCoalescer._run_batched(preps, kws=kws)  # compile outside timing
+        serial_s = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for c in sub:
+                solve_ops.sync_outputs(run_solo(*c))
+            serial_s = min(serial_s, time.perf_counter() - t0)
+        fused_s = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            BatchCoalescer._run_batched(preps, kws=kws)
+            fused_s = min(fused_s, time.perf_counter() - t0)
+        repair[str(n)] = {
+            "serial_s": round(serial_s, 4),
+            "fused_s": round(fused_s, 4),
+            "speedup": round(serial_s / fused_s, 2) if fused_s > 0 else None,
+        }
+    occupancy = compilecache.occupancy_stats()
+
+    # KC_BUCKET_QUANTIZE sweep: tenants with MIXED distinct-class counts
+    # (the class axis is what actually varies across real tenants — pod
+    # counts collapse into classes), distinct executable buckets +
+    # occupancy-vs-padded-FLOPs under each padding ladder.  Pairs like
+    # (10, 14) straddle a default 1.5x rung (12) and its next power of two
+    # (16), so the coarser power-of-two ladder provably merges buckets.
+    mixed = [5, 7, 10, 14, 20, 28]
+
+    def quant_leg(enabled: bool) -> dict:
+        saved_q = os.environ.get("KC_BUCKET_QUANTIZE")
+        os.environ["KC_BUCKET_QUANTIZE"] = "1" if enabled else "0"
+        try:
+            groups: dict = {}
+            for t, n_classes in enumerate(mixed):
+                solver = TPUSolver(provider, provisioners)
+                ingest = PodIngest()
+                ingest.add_all([
+                    make_pod(requests={"cpu": f"{100 + 25 * j}m"})
+                    for j in range(n_classes)
+                    for _ in range(12)
+                ])
+                prep = solver.prepare_encoded(solver.encode(ingest))
+                groups.setdefault(bucket_key(prep), []).append(prep)
+            compilecache.reset_occupancy()
+            for preps in groups.values():
+                BatchCoalescer._run_batched(preps)
+            stats = compilecache.occupancy_stats()
+            padded_flops = sum(s["padded_flops"] for s in stats.values())
+            real = sum(s["real_rows"] for s in stats.values())
+            padded = sum(s["padded_rows"] for s in stats.values())
+            dispatches = sum(s["dispatches"] for s in stats.values())
+            return {
+                "buckets": len(groups),
+                # batch occupancy: how many tenants each vmapped dispatch
+                # carries — the number quantization exists to raise
+                "tenants_per_dispatch": (
+                    round(len(mixed) / dispatches, 2) if dispatches else None
+                ),
+                # row-level padding waste inside those dispatches — the
+                # FLOPs price paid for the coarser ladder
+                "occupancy_ratio": (
+                    round(real / padded, 4) if padded else None
+                ),
+                "padded_flops": round(padded_flops, 1),
+            }
+        finally:
+            if saved_q is None:
+                os.environ.pop("KC_BUCKET_QUANTIZE", None)
+            else:
+                os.environ["KC_BUCKET_QUANTIZE"] = saved_q
+
+    quant_default = quant_leg(False)
+    quant_on = quant_leg(True)
+
+    deepest = repair[str(n_max)]
+    return {
+        "tenant_counts": sorted(tenant_counts),
+        "pods_per_tenant": pods_per_tenant,
+        "repair": repair,
+        "fusion_repair_solve_s": deepest["fused_s"],
+        "fusion_repair_serial_s": deepest["serial_s"],
+        "fusion_speedup": deepest["speedup"],
+        "parity_ok": True,
+        "batch_occupancy": occupancy,
+        "quantize": {
+            "mixed_pod_counts": mixed,
+            "default": quant_default,
+            "quantized": quant_on,
+            "bucket_reduction": quant_default["buckets"] - quant_on["buckets"],
+        },
+    }
+
+
 def fleet_line(chains=(1, 8, 64), pods: int = 128) -> dict:
     """Fleet failover cost (ISSUE-17, docs/FLEET.md): how fast an adopting
     replica restores an evicted tenant's warm lineage, measured both ways at
@@ -1514,6 +1740,29 @@ def main() -> None:
             traceback.print_exc(file=sys.stderr)
             tenant = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # generalized solve fusion: fused vs serial REPAIR dispatches across
+    # tenants + the KC_BUCKET_QUANTIZE occupancy sweep (docs/SERVICE.md
+    # "Solve fusion"); KC_BENCH_FUSION=0 skips.
+    fusion = None
+    if os.environ.get("KC_BENCH_FUSION", "1") != "0":
+        try:
+            counts = tuple(
+                int(c) for c in
+                os.environ.get("KC_BENCH_FUSION_TENANTS", "2,4,8").split(",")
+                if c.strip()
+            )
+            fusion = fusion_line(
+                tenant_counts=counts,
+                pods_per_tenant=int(
+                    os.environ.get("KC_BENCH_FUSION_PODS", "256")
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 - fusion line never kills the headline
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            fusion = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # fleet failover: checkpoint-restore vs journal-replay adoption cost at
     # 1/8/64-delta chains (docs/FLEET.md); KC_BENCH_FLEET=0 skips.
     fleet = None
@@ -1628,6 +1877,14 @@ def main() -> None:
         # real-vs-padded rows per (bucket, mesh) for the coalesced
         # dispatches — the padding-waste story at fleet scale (ISSUE 16)
         detail["batch_occupancy"] = tenant.get("batch_occupancy") or {}
+    detail["fusion"] = fusion
+    if fusion and "error" not in fusion:
+        # stage mirrors: perfgate gates the fused repair dispatch time as
+        # its own stage and report_fusion warns when fused throughput drops
+        # under 2x serial at the deepest tenant count
+        detail["fusion_repair_solve_s"] = fusion["fusion_repair_solve_s"]
+        detail["fusion_repair_serial_s"] = fusion["fusion_repair_serial_s"]
+        detail["fusion_speedup"] = fusion["fusion_speedup"]
     detail["fleet"] = fleet
     if fleet and "error" not in fleet:
         # stage mirrors for the deepest chain: the checkpoint-restore gates

@@ -63,7 +63,8 @@ CHECKPOINT_TOTAL = REGISTRY.counter(
     "Fleet session-checkpoint writes by result: written (fsynced and "
     "atomically published), skipped (no warm lineage / no anchor bytes to "
     "stamp), error (export or I/O failed — the tenant keeps its previous "
-    "checkpoint, the journal stays the fallback rung).",
+    "checkpoint, the journal stays the fallback rung), gc (an older "
+    "generation removed by the KC_FLEET_CHECKPOINT_KEEP retention sweep).",
     ("result",),
 )
 CHECKPOINT_BYTES = REGISTRY.histogram(
@@ -262,26 +263,71 @@ def _safe_name(tenant_id: str) -> str:
     return f"{stem}-{suffix}.kcfc"
 
 
+def _retention_keep() -> int:
+    """Checkpoint generations retained per tenant (KC_FLEET_CHECKPOINT_KEEP,
+    default 2, floor 1).  Keeping >1 lets the restore ladder fall back to the
+    previous complete generation when the newest file fails verification —
+    e.g. a disk-level corruption that lands AFTER publish — before degrading
+    all the way to journal replay."""
+    try:
+        return max(int(os.environ.get("KC_FLEET_CHECKPOINT_KEEP", "2")), 1)
+    except ValueError:
+        return 2
+
+
 class CheckpointPlane:
     """The serving replica's checkpoint writer + the adopting replica's
     reader, over one shared directory (FleetLocal.checkpoint_dir()).
 
     Writes are atomic (tmp + fsync + os.replace + directory fsync, the
-    journal's compaction discipline) and KEYED BY TENANT: one live file per
-    tenant, each write replacing the last, so a reader sees either the
-    previous complete checkpoint or the new complete one.  ``after_solve``
-    is the cadence hook — every anchor solve, then every ``every``-th solve
-    — and never raises: checkpointing is an optimization over the journal,
-    losing one must never fail a solve that already answered."""
+    journal's compaction discipline) and KEYED BY TENANT AND GENERATION:
+    each write publishes ``<stem>-<digest>.gNNNNNNNN.kcfc`` and then sweeps
+    generations beyond the retention window (``keep``, default from
+    KC_FLEET_CHECKPOINT_KEEP), so a reader always sees complete files and
+    the newest failing verification still leaves the previous generation to
+    restore from.  A pre-retention unsuffixed ``<stem>-<digest>.kcfc`` file
+    is treated as generation 0.  ``after_solve`` is the cadence hook — every
+    anchor solve, then every ``every``-th solve — and never raises:
+    checkpointing is an optimization over the journal, losing one must never
+    fail a solve that already answered."""
 
     def __init__(self, directory: str, *, clock: Optional[Clock] = None,
-                 replica_id: str = "", every: int = 8) -> None:
+                 replica_id: str = "", every: int = 8,
+                 keep: Optional[int] = None) -> None:
         self.directory = directory
         self.clock = clock or Clock()
         self.replica_id = replica_id
         self.every = max(int(every), 1)
+        self.keep = _retention_keep() if keep is None else max(int(keep), 1)
+
+    def _generations(self, tenant_id: str) -> List[Tuple[int, str]]:
+        """Existing checkpoint generations for a tenant, newest first.  The
+        legacy unsuffixed file participates as generation 0 so upgrades GC
+        it once ``keep`` newer generations exist."""
+        base = _safe_name(tenant_id)
+        prefix = base[: -len(".kcfc")]
+        pattern = re.compile(re.escape(prefix) + r"\.g(\d{8})\.kcfc\Z")
+        found: List[Tuple[int, str]] = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            m = pattern.match(name)
+            if m:
+                found.append((int(m.group(1)),
+                              os.path.join(self.directory, name)))
+            elif name == base:
+                found.append((0, os.path.join(self.directory, name)))
+        found.sort(reverse=True)
+        return found
 
     def path_for(self, tenant_id: str) -> str:
+        """The current live checkpoint path: the newest published generation,
+        or (before any generation exists) the legacy unsuffixed path."""
+        gens = self._generations(tenant_id)
+        if gens:
+            return gens[0][1]
         return os.path.join(self.directory, _safe_name(tenant_id))
 
     def after_solve(self, tenant_id: str, entry, mode: str) -> None:
@@ -335,8 +381,13 @@ class CheckpointPlane:
             "materialized": list(export["materialized"]),
         }
         blob = checkpoint_bytes(header, entry.anchor_request, tensors)
-        path = self.path_for(tenant_id)
         os.makedirs(self.directory, exist_ok=True)
+        gens = self._generations(tenant_id)
+        gen = (gens[0][0] + 1) if gens else 1
+        base = _safe_name(tenant_id)
+        path = os.path.join(
+            self.directory, f"{base[:-len('.kcfc')]}.g{gen:08d}.kcfc"
+        )
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             f.write(blob)
@@ -350,6 +401,15 @@ class CheckpointPlane:
             os.close(dfd)
         CHECKPOINT_TOTAL.labels("written").inc()
         CHECKPOINT_BYTES.labels().observe(float(len(blob)))
+        # retention sweep AFTER the publish fsync: the new generation is
+        # durable before any older one disappears, so a crash mid-sweep can
+        # only leave extras, never zero
+        for _g, old in gens[self.keep - 1:]:
+            try:
+                os.remove(old)
+                CHECKPOINT_TOTAL.labels("gc").inc()
+            except OSError:
+                pass
         return path
 
     def write_all(self, entries: Dict[str, object]) -> int:
@@ -367,12 +427,33 @@ class CheckpointPlane:
         return written
 
     def load(self, tenant_id: str) -> Tuple[Optional[Checkpoint], str]:
-        return load_checkpoint(self.path_for(tenant_id))
+        """Newest generation that VERIFIES wins: a corrupt newest file falls
+        back to the previous retained generation before the caller's ladder
+        degrades to journal replay."""
+        gens = self._generations(tenant_id)
+        if not gens:
+            return load_checkpoint(
+                os.path.join(self.directory, _safe_name(tenant_id))
+            )
+        status = STATUS_MISSING
+        for i, (_gen, path) in enumerate(gens):
+            ckpt, st = load_checkpoint(path)
+            if ckpt is not None:
+                return ckpt, st
+            if i == 0:
+                status = st  # report the newest generation's failure mode
+        return None, status
 
     def drop(self, tenant_id: str) -> None:
-        """A dropped tenant's checkpoint must not resurrect it elsewhere."""
+        """A dropped tenant's checkpoints (every generation) must not
+        resurrect it elsewhere."""
+        for _gen, path in self._generations(tenant_id):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
         try:
-            os.remove(self.path_for(tenant_id))
+            os.remove(os.path.join(self.directory, _safe_name(tenant_id)))
         except OSError:
             pass
 

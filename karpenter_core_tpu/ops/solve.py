@@ -2393,11 +2393,31 @@ def pad_catalog(cls, statics_arrays, multiple: int, it_price=None):
     return cls, sa, _pad_axis(np.asarray(it_price), 0, i_new, np.inf)
 
 
+def bucket_quantize_enabled() -> bool:
+    """KC_BUCKET_QUANTIZE: the opt-in coarser bucket ladder (docs/SERVICE.md
+    "Solve fusion").  When set, :func:`bucket` skips the 1.5x rungs and pads
+    straight up the powers of two — mixed-size tenants land in FEWER distinct
+    shape buckets, so more of them share one coalesced executable and batch
+    occupancy rises, at the cost of up to ~50% more padded rows per axis
+    (the padded-FLOP vs executable-reuse trade ``bench.py fusion_line``
+    measures).  Default off: unset (or "0") keeps the exact default grid,
+    byte-identical planes and cache keys."""
+    return os.environ.get("KC_BUCKET_QUANTIZE", "") not in ("", "0")
+
+
 def bucket(n: int, floor: int = 8) -> int:
     """Smallest grid value >= max(n, floor); the grid is the powers of two
-    and 1.5x powers of two starting at 2 (2, 3, 4, 6, 8, 12, ...)."""
+    and 1.5x powers of two starting at 2 (2, 3, 4, 6, 8, 12, ...).  Under
+    ``KC_BUCKET_QUANTIZE`` (``bucket_quantize_enabled``) the 1.5x rungs drop
+    out and the grid is the powers of two alone — a strict subset, so every
+    quantized bucket is >= its default-grid value and the distinct-bucket
+    count over any size mix can only shrink."""
     target = max(int(n), int(floor), 2)
     b = 2
+    if bucket_quantize_enabled():
+        while b < target:
+            b <<= 1
+        return b
     while b < target:
         b = b * 3 // 2 if (b & (b - 1)) == 0 else (b // 3) * 4
     return b

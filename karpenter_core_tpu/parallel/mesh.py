@@ -77,8 +77,13 @@ CATALOG_PARTITION_RULES: Tuple[Tuple[str, int], ...] = (
 # service/tenant.py): the coalescer stacks EVERY solve pytree leaf with a
 # leading tenant axis, so one catch-all rule shards axis 0 of every leaf —
 # each device holds T/D whole tenants and no collectives cross them (tenant
-# solves are independent by construction).  Same rule-by-regex machinery as
-# the catalog rules above, just a different axis and rule set.
+# solves are independent by construction).  The catch-all is what makes the
+# rule set closed under new fused variants: the repair batch's extra
+# positional pytrees (WarmCarry, RepairPlan, the synthesized ExistingStatic)
+# and the ex-plane batch's ExistingState/ExistingStatic all stack with the
+# same leading tenant axis and shard under this one rule, no per-variant
+# additions needed (docs/SERVICE.md "Solve fusion").  Same rule-by-regex
+# machinery as the catalog rules above, just a different axis and rule set.
 TENANT_PARTITION_RULES: Tuple[Tuple[str, int], ...] = (
     (r".", 0),
 )

@@ -562,11 +562,11 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             )
             if not chain or int(chain[-1].get("version", 0)) != claimed:
                 continue
-            # replay is solo by nature: the coalescer bypass is plane-wide,
-            # so a concurrent full solve may dispatch unbatched during this
-            # window — a throughput nick, never a correctness change
-            bypass = plane._bypass_coalescer
-            plane._bypass_coalescer = True
+            # replay is solo by nature: the bypass is PER ENTRY, so only
+            # this tenant's replayed solves skip the rendezvous — concurrent
+            # tenants keep coalescing (the old plane-wide flag's save/
+            # restore raced them out of their batches)
+            entry.bypass_coalescer = True
             try:
                 for rec in chain:
                     self._replay_record(entry, rec)
@@ -590,7 +590,7 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                 )
                 self._reset_session_store(entry)
             finally:
-                plane._bypass_coalescer = bypass
+                entry.bypass_coalescer = False
         return False
 
     def _journal_solve(self, entry, tenant_id: str, mode: str,
@@ -1186,9 +1186,10 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
                     # ``session-lost`` into the mode counter and span
                     entry.session.force_full("supply-digest")
                 entry.session.rebind(solver)
-                # last_batched is written by the coalescer hook, which only
-                # full solves reach — reset so a delta (solo by design)
-                # doesn't echo a stale batch size
+                # last_batched is written by the coalescer hook — full
+                # solves AND fused repairs reach it (docs/SERVICE.md "Solve
+                # fusion") — reset so a solve that short-circuits before the
+                # hook doesn't echo a stale batch size
                 entry.last_batched = 1
                 t_solve = tenant_mod.monotonic()
                 # the envelope's optional trace context stitches this

@@ -123,6 +123,13 @@ TENANT_DISPATCH = REGISTRY.counter(
     "Tenant solve dispatches, by tenant and mode (coalesced / solo).",
     ("tenant", "mode"),
 )
+TENANT_REPAIR_DISPATCH = REGISTRY.counter(
+    "karpenter_tenant_repair_dispatch_total",
+    "Tenant delta/repair solve dispatches, by tenant and mode (coalesced = "
+    "fused with compatible repair windows from other tenants, solo = "
+    "unfused; KC_COALESCE_WINDOW=0 forces solo).",
+    ("tenant", "mode"),
+)
 TENANT_SLO_BURN_RATE = REGISTRY.gauge(
     "karpenter_tenant_slo_burn_rate",
     "Multi-window error-budget burn rate over the declared per-tenant solve "
@@ -287,6 +294,10 @@ class TenantConfig:
     # coalescing: rendezvous window + cap (window 0 disables batching)
     batch_window_s: float = 0.01
     max_batch: int = 8
+    # solve fusion (docs/SERVICE.md "Solve fusion"): repair/delta dispatches
+    # join the coalescer's rendezvous too; KC_COALESCE_WINDOW=0 restores the
+    # repairs-always-solo behavior (anchors keep coalescing)
+    coalesce_repairs: bool = True
     # request bound: oversized snapshots count against the tenant's breaker
     max_request_bytes: int = 32 * 1024 * 1024
     # True when KC_TENANT_RATE was set explicitly: an operator pin is an
@@ -307,6 +318,7 @@ class TenantConfig:
             breaker_reset_s=_env_f("KC_TENANT_BREAKER_RESET_S", 30.0),
             batch_window_s=_env_f("KC_TENANT_BATCH_WINDOW_S", 0.01),
             max_batch=max(_env_i("KC_TENANT_BATCH_MAX", 8), 1),
+            coalesce_repairs=os.environ.get("KC_COALESCE_WINDOW", "1") != "0",
             max_request_bytes=max(
                 _env_i("KC_TENANT_MAX_BYTES", 32 * 1024 * 1024), 1024
             ),
@@ -375,13 +387,21 @@ class AdmissionDecision:
 # -- batch coalescing ---------------------------------------------------------
 
 
-def bucket_key(prep) -> tuple:
+def bucket_key(prep, kw=None) -> tuple:
     """The shape-bucket identity of a SolvePrep: two preps with equal keys
     run the same solve program, so their batches can stack on a tenant axis.
-    Mirrors the compile-cache key's static components (docs/SERVICE.md)."""
+    Mirrors the compile-cache key's static components (docs/SERVICE.md).
+
+    ``kw`` (the dispatch kwargs of a repair solve) extends the key with the
+    repair-window identity — the window width (``n_slots`` override) plus the
+    warm-carry/repair-plan leaf signatures, mirroring the solo compile
+    cache's ``delta`` variant key — so compatible repair windows from
+    different tenants stack on one vmapped dispatch (docs/SERVICE.md
+    "Solve fusion").  The per-tick ``count`` vector is values-only (its shape
+    is already pinned by the cls signature), so it never splits a bucket."""
     from karpenter_core_tpu.utils import compilecache
 
-    return (
+    key = (
         compilecache._leaf_sig(prep.cls),
         compilecache._leaf_sig(prep.statics_arrays),
         compilecache._leaf_sig(prep.ex_state) if prep.ex_state is not None else None,
@@ -391,16 +411,27 @@ def bucket_key(prep) -> tuple:
         int(prep.n_passes),
         tuple(prep.features) if prep.features is not None else None,
     )
+    if kw and kw.get("warm_carry") is not None:
+        key += (
+            "repair",
+            int(kw.get("n_slots") or 0) or int(prep.n_slots),
+            compilecache._leaf_sig(kw["warm_carry"]),
+            compilecache._leaf_sig(kw["repair_plan"])
+            if kw.get("repair_plan") is not None else None,
+        )
+    return key
 
 
 class _Member:
-    __slots__ = ("prep", "solo", "tenant", "done", "outputs", "error", "batch_n")
+    __slots__ = ("prep", "solo", "tenant", "kw", "done", "outputs", "error",
+                 "batch_n")
 
     def __init__(self, prep, solo: Callable[[], object],
-                 tenant: Optional[str] = None) -> None:
+                 tenant: Optional[str] = None, kw=None) -> None:
         self.prep = prep
         self.solo = solo
         self.tenant = tenant
+        self.kw = kw
         self.done = threading.Event()
         self.outputs = None
         self.error: Optional[BaseException] = None
@@ -430,11 +461,11 @@ class BatchCoalescer:
         self._groups: Dict[tuple, _Group] = {}
 
     def run(self, prep, solo: Callable[[], object],
-            tenant: Optional[str] = None) -> Tuple[object, int]:
+            tenant: Optional[str] = None, kw=None) -> Tuple[object, int]:
         if self.window_s <= 0 or self.max_batch <= 1:
             return solo(), 1
-        key = bucket_key(prep)
-        member = _Member(prep, solo, tenant)
+        key = bucket_key(prep, kw)
+        member = _Member(prep, solo, tenant, kw)
         with self._lock:
             group = self._groups.get(key)
             # a full group is as good as closed: the leader may not have
@@ -488,6 +519,7 @@ class BatchCoalescer:
             outs = self._run_batched(
                 [m.prep for m in members],
                 tenants=[m.tenant for m in members if m.tenant is not None],
+                kws=[m.kw for m in members],
             )
         except BaseException:  # noqa: BLE001 - batch fault: contain per tenant
             # fault containment: the batch PROGRAM faulted (device error,
@@ -506,49 +538,92 @@ class BatchCoalescer:
             m.batch_n = len(members)
 
     @staticmethod
-    def _run_batched(preps, tenants=None) -> List[object]:
+    def _run_batched(preps, tenants=None, kws=None) -> List[object]:
         """One vmapped device dispatch over the stacked preps; returns
         per-tenant output slices (bit-identical to solo solves).  ``tenants``
         (optional member tenant ids, dispatch order) rides the span so a
-        server-side trace names who co-batched."""
+        server-side trace names who co-batched.  ``kws`` (per-member dispatch
+        kwargs, aligned with ``preps``) carries repair dispatches: members
+        with a ``warm_carry`` stack their per-tick count vectors, synthesized
+        ex-static planes, warm carries, and repair plans as batch leaves and
+        run the vmapped REPAIR executable — the rendezvous key (bucket_key's
+        repair extension) guarantees every member of one group agrees on the
+        variant and the window width."""
         import jax
 
         from karpenter_core_tpu.parallel import mesh as mesh_mod
         from karpenter_core_tpu.utils import compilecache
 
         p0 = preps[0]
-        has_ex = p0.ex_state is not None
+        kws = kws if kws is not None else [None] * len(preps)
+        kw_of = lambda i: kws[i] or {}  # noqa: E731 - local accessor
+        kw0 = kw_of(0)
+        has_warm = kw0.get("warm_carry") is not None
+        has_ex = p0.ex_state is not None and not has_warm
+        n_slots = int(kw0.get("n_slots") or 0) or int(p0.n_slots)
 
         def stack(trees):
             return jax.tree_util.tree_map(
                 lambda *ls: np.stack([np.asarray(x) for x in ls]), *trees
             )
 
+        def member_cls(p, kw):
+            count = kw.get("count")
+            if count is None:
+                return p.cls
+            return p.cls._replace(count=np.asarray(count, dtype=np.int32))
+
+        cls_list = [member_cls(p, kw_of(i)) for i, p in enumerate(preps)]
         # coalesced occupancy: the preps arrive bucket-padded, so the real
         # row count is recovered from the count vector (padded rows never
-        # carry pods) — one ledger entry for the whole stacked dispatch
+        # carry pods; a repair's count holds only this tick's delta pods) —
+        # one ledger entry for the whole stacked dispatch
         padded_rows = int(np.asarray(p0.cls.count).shape[0])
         real_rows = sum(
-            int(np.count_nonzero(np.asarray(p.cls.count))) for p in preps
+            int(np.count_nonzero(np.asarray(c.count))) for c in cls_list
         ) / len(preps)
         compilecache.record_batch_occupancy(
-            real_rows, padded_rows, p0.n_slots, n_passes=p0.n_passes,
+            real_rows, padded_rows, n_slots, n_passes=p0.n_passes,
             mesh_axes=mesh_mod.tenant_mesh_axes(len(preps)),
             tenants=len(preps),
         )
         with tracing.span("solve.coalesced", tenants=len(preps),
-                          n_slots=p0.n_slots,
+                          n_slots=n_slots, repair=has_warm,
                           tenant=",".join(tenants) if tenants else None):
-            args = [stack([p.cls for p in preps]),
+            args = [stack(cls_list),
                     stack([p.statics_arrays for p in preps])]
-            if has_ex:
+            ex_static0 = p0.ex_static
+            if has_warm:
+                from karpenter_core_tpu.ops import solve as solve_ops
+
+                # the warm variant always takes the ex-static planes;
+                # synthesize the empty ones exactly as the solo
+                # run_prepared does for preps that never had a fleet
+                ex_statics = []
+                for p in preps:
+                    if p.ex_static is not None:
+                        ex_statics.append(p.ex_static)
+                    else:
+                        ex_statics.append(solve_ops.empty_existing_static(
+                            p.cls.requests.shape[-1], p.cls.count.shape[0],
+                            p.statics_arrays.grp_skew.shape[0],
+                        ))
+                ex_static0 = ex_statics[0]
+                args.append(stack(ex_statics))
+                args.append(stack([kw_of(i)["warm_carry"]
+                                   for i in range(len(preps))]))
+                args.append(stack([kw_of(i)["repair_plan"]
+                                   for i in range(len(preps))]))
+            elif has_ex:
                 args.append(stack([p.ex_state for p in preps]))
                 args.append(stack([p.ex_static for p in preps]))
             mesh_axes = mesh_mod.tenant_mesh_axes(len(preps))
             fn = compilecache.batched_solve_callable(
-                len(preps), p0.cls, p0.statics_arrays, p0.n_slots,
-                p0.key_has_bounds, p0.ex_state, p0.ex_static,
-                p0.n_passes, p0.features, mesh_axes,
+                len(preps), cls_list[0], p0.statics_arrays, n_slots,
+                p0.key_has_bounds, None if has_warm else p0.ex_state,
+                ex_static0, p0.n_passes, p0.features, mesh_axes,
+                warm_carry=kw0.get("warm_carry"),
+                repair_plan=kw0.get("repair_plan"),
             )
             if mesh_axes is not None:
                 mesh = mesh_mod.mesh_for(mesh_axes)
@@ -602,6 +677,11 @@ class TenantEntry:
     anchor_request: Optional[bytes] = None
     anchor_uid_bases: Tuple[str, ...] = ()
     ckpt_ticks: int = 0
+    # per-entry coalescer bypass: a recovery/failover replay dispatches THIS
+    # tenant's solves solo (no rendezvous waits) without degrading concurrent
+    # tenants' batching — the per-request property the dispatch hook reads
+    # (the old plane-wide flag was racy under concurrent tenant requests)
+    bypass_coalescer: bool = False
 
 
 class TenantPlane:
@@ -627,8 +707,10 @@ class TenantPlane:
         # session-drop hook: the durable-session journal records evictions so
         # recovery never resurrects a dropped lineage
         self.on_drop: Optional[Callable[[str], None]] = None
-        # recovery replay runs solves through _dispatch before the server
-        # accepts traffic — solo, no rendezvous window to wait out
+        # plane-wide coalescer bypass: only the pre-traffic restart recovery
+        # sets it (no concurrent requests exist yet).  Replays DURING traffic
+        # use the per-entry TenantEntry.bypass_coalescer instead — a
+        # plane-wide flip would race concurrent tenants out of their batches.
         self._bypass_coalescer = False
 
     # -- session lifecycle -----------------------------------------------------
@@ -667,21 +749,35 @@ class TenantPlane:
         return entry
 
     def _dispatch(self, entry: TenantEntry, prep, **kw):
-        """The session's full-solve dispatch hook: plain full solves are
-        coalescing candidates; anything parameterized (slot-exhaustion
-        retries) dispatches solo."""
+        """The session's dispatch hook: plain full solves AND repair/delta
+        dispatches (``warm_carry`` + ``repair_plan`` kwargs) are coalescing
+        candidates — compatible repair windows from different tenants fuse on
+        one vmapped dispatch (docs/SERVICE.md "Solve fusion").  Anything else
+        parameterized (the bare slot-exhaustion retry) dispatches solo, as
+        does a replaying entry (``bypass_coalescer`` — per entry, so one
+        tenant's recovery replay never degrades concurrent tenants)."""
         solver = entry.session.solver
         tenant = tenant_label(entry.tenant_id)
-        if kw or self._bypass_coalescer:
+        is_repair = (
+            kw.get("warm_carry") is not None
+            and kw.get("repair_plan") is not None
+        )
+        bypass = entry.bypass_coalescer or self._bypass_coalescer
+        fusable = not kw or (is_repair and self.config.coalesce_repairs)
+        if bypass or not fusable:
             TENANT_DISPATCH.labels(tenant, "solo").inc()
+            if is_repair:
+                TENANT_REPAIR_DISPATCH.labels(tenant, "solo").inc()
             return solver.run_prepared(prep, **kw)
         outputs, batched = self.coalescer.run(
-            prep, lambda: solver.run_prepared(prep), tenant=entry.tenant_id
+            prep, lambda: solver.run_prepared(prep, **kw),
+            tenant=entry.tenant_id, kw=kw or None,
         )
         entry.last_batched = batched
-        TENANT_DISPATCH.labels(
-            tenant, "coalesced" if batched > 1 else "solo"
-        ).inc()
+        mode = "coalesced" if batched > 1 else "solo"
+        TENANT_DISPATCH.labels(tenant, mode).inc()
+        if is_repair:
+            TENANT_REPAIR_DISPATCH.labels(tenant, mode).inc()
         return outputs
 
     def checkout(self, tenant_id: str, weight: Optional[float] = None) -> TenantEntry:

@@ -254,11 +254,15 @@ class IncrementalSolveSession:
         self.last_reason: Optional[str] = None
         self.last_audit_drift_nodes: Optional[int] = None
         self.mode_counts: Dict[str, int] = {MODE_FULL: 0, MODE_DELTA: 0}
-        # dispatch hook for FULL solves: ``run_prepared(prep, **kw)`` replaces
+        # dispatch hook: ``run_prepared(prep, **kw)`` replaces
         # ``solver.run_prepared`` so a host (the multi-tenant solver service)
         # can route the device execution through its batch coalescer — the
-        # prep/decode bookkeeping around it is unchanged, and delta repairs
-        # (whose warm carry is lineage-private) always dispatch solo
+        # prep/decode bookkeeping around it is unchanged.  Full solves AND
+        # delta repairs route through it: compatible repair windows from
+        # different tenants fuse on one vmapped dispatch (docs/SERVICE.md
+        # "Solve fusion").  Hooked repairs never donate the carry — the
+        # coalescer may stack it into a batched program whose member buffers
+        # must stay readable — so the hook passes donate_carry=False through.
         self._run_prepared = run_prepared
         self._forced_reason: Optional[str] = None
         # pipelined-loop state: the in-flight deferred tick, the two-deep
@@ -860,19 +864,29 @@ class IncrementalSolveSession:
 
     def _delta_dispatch(self, plan):
         """Dispatch the repair onto the device (asynchronously) and start
-        its device→host fetch.  Warm dispatches donate the carry when the
-        pipeline is armed (utils.pipeline): the pre-dispatch carry is dead
-        after this call — only ``keep_carry`` (the full-width carry of a
-        WINDOWED repair, which the settle's scatter consumes) may be read
-        again, and an exception anywhere past the donating call drops the
-        lineage (the except below and its twins in _delta_solve/settle):
+        its device→host fetch.  The dispatch routes through the
+        ``_run_prepared`` hook when one is set — the tenant service's batch
+        coalescer fuses compatible repair windows from different tenants
+        onto one vmapped dispatch (docs/SERVICE.md "Solve fusion"); hooked
+        repairs never donate.  Unhooked warm dispatches donate the carry
+        when the pipeline is armed (utils.pipeline): the pre-dispatch carry
+        is dead after this call — only ``keep_carry`` (the full-width carry
+        of a WINDOWED repair, which the settle's scatter consumes) may be
+        read again, and an exception anywhere past the donating call drops
+        the lineage (the except below and its twins in _delta_solve/settle):
         a kept ``_warm`` pointing at a donated buffer would turn one
         transient fault into a crash loop on every later repair."""
         w = self._warm
         free_new, free_ex = plan["free_new"], plan["free_ex"]
         evicted_locs, counts = plan["evicted_locs"], plan["counts"]
         n_slots = w.assign.shape[1]
-        donate = pipeline_mod.donation_enabled() and not (
+        # hooked dispatches (the tenant service's coalescer) never donate:
+        # the batch program stacks COPIES of member carries, so the solo
+        # donation bookkeeping would free buffers the fused path still reads
+        # — donation is a solo-dispatch optimization only
+        run = self._run_prepared or self.solver.run_prepared
+        hooked = self._run_prepared is not None
+        donate = pipeline_mod.donation_enabled() and not hooked and not (
             self.solver.policy is not None
             and getattr(self.solver.policy, "enabled", False)
         )
@@ -915,9 +929,10 @@ class IncrementalSolveSession:
                     base_inv_full=base[2],
                 )
                 keep_carry = carry
-                outputs = self.solver.run_prepared(
+                outputs = run(
                     w.prep, count=counts, warm_carry=win_carry,
                     repair_plan=repair_plan, n_slots=len(idx),
+                    donate_carry=donate,
                 )
                 donated = donated or donate
             else:
@@ -928,9 +943,9 @@ class IncrementalSolveSession:
                     base_inv_full=zeros_gz,
                 )
                 keep_carry = None
-                outputs = self.solver.run_prepared(
+                outputs = run(
                     w.prep, count=counts, warm_carry=carry,
-                    repair_plan=repair_plan,
+                    repair_plan=repair_plan, donate_carry=donate,
                 )
                 donated = donated or donate
             if self._staging is None and pipeline_mod.pipeline_enabled():

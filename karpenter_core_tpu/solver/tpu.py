@@ -850,7 +850,11 @@ class TPUSolver:
     ) -> SolvePrep:
         """Kernel inputs for one encoded snapshot, existing-node planes
         included, bucket-padded (unless KC_TPU_SHAPE_BUCKETS=0) and ready for
-        ``run_prepared``.  Splitting prepare from run is what lets the
+        ``run_prepared``.  ``KC_BUCKET_QUANTIZE`` selects the coarser
+        powers-of-two padding ladder (ops.solve.bucket_quantize_enabled):
+        mixed-size tenants quantize into fewer distinct shape buckets, so
+        more of them fuse onto one coalesced executable (docs/SERVICE.md
+        "Solve fusion").  Splitting prepare from run is what lets the
         incremental session hold a prep across reconciles and re-run it with
         a delta count vector + warm carry (docs/INCREMENTAL.md).
 
@@ -876,9 +880,12 @@ class TPUSolver:
         pad = os.environ.get("KC_TPU_SHAPE_BUCKETS", "1") != "0"
         anchors = None
         if ex_state is None and pad:
+            # the quantize flag rides the anchor tuple: a mid-process flip
+            # (bench A/B legs, tests) must not serve a prep padded under the
+            # other grid
             anchors = tuple(
                 getattr(snapshot, f, None) for f in self._PREP_ANCHOR_FIELDS
-            )
+            ) + (solve_ops.bucket_quantize_enabled(),)
             cached = getattr(self, "_prep_cache", None)
             if cached is not None and all(
                 a is b for a, b in zip(cached["anchors"], anchors)
@@ -927,6 +934,7 @@ class TPUSolver:
         warm_carry=None,
         repair_plan=None,
         n_slots: int = 0,
+        donate_carry=None,
     ) -> solve_ops.SolveOutputs:
         """Run the kernel on a SolvePrep.  ``count`` overrides the class-count
         vector (the repair solve passes only the delta pods; shape must match
@@ -945,7 +953,11 @@ class TPUSolver:
         kcanalyze rule).  An enabled policy objective keeps donation off —
         its decode stage re-reads the final state planes on device after
         the dispatch (ops.objective.select_for_state), and those planes
-        alias the donated memory one tick later."""
+        alias the donated memory one tick later.  ``donate_carry`` overrides
+        the auto decision (the incremental session passes False for
+        dispatches routed through the service coalescer, whose batched
+        executable stacks member carries and cannot donate them); an enabled
+        policy still forces donation off."""
         from karpenter_core_tpu.utils import compilecache
 
         cls = prep.cls
@@ -961,7 +973,7 @@ class TPUSolver:
             n_classes = cls.count.shape[0]
             g1 = prep.statics_arrays.grp_skew.shape[0]
             ex_static = solve_ops.empty_existing_static(n_res, n_classes, g1)
-        donate = "auto"
+        donate = "auto" if donate_carry is None else bool(donate_carry)
         if self.policy is not None and getattr(self.policy, "enabled", False):
             donate = False
         from karpenter_core_tpu.utils import pipeline as pipeline_mod

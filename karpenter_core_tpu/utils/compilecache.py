@@ -567,16 +567,27 @@ def batched_solve_callable(
     n_passes: int = 1,
     features=None,
     mesh_axes=None,
+    warm_carry=None,
+    repair_plan=None,
 ):
-    """The coalesced multi-tenant executable: ``vmap`` of the plain solve body
-    over a leading tenant axis (service/tenant.py stacks N compatible-bucket
+    """The coalesced multi-tenant executable: ``vmap`` of the solve body over
+    a leading tenant axis (service/tenant.py stacks N compatible-bucket
     tenants' planes and unstacks the outputs).  Memoized in ``_memo`` like
     every other variant, keyed on the batch size + the per-tenant bucket
     signature, so steady coalescing reuses ONE batched executable per
-    (bucket, N).  ``cls``/``statics_arrays``/``ex_*`` are ONE tenant's
-    (unstacked) pytrees — only shapes/dtypes matter.  ``mesh_axes``
-    (parallel.mesh.tenant_mesh_axes) selects the sharded twin: the same vmap
-    body under a shard_map that splits the tenant axis across devices.
+    (bucket, N).  ``cls``/``statics_arrays``/``ex_*``/``warm_carry``/
+    ``repair_plan`` are ONE tenant's (unstacked) pytrees — only shapes/dtypes
+    matter.  ``mesh_axes`` (parallel.mesh.tenant_mesh_axes) selects the
+    sharded twin: the same vmap body under a shard_map that splits the tenant
+    axis across devices.
+
+    ``warm_carry`` selects the fused-REPAIR variant (the vmapped twin of the
+    solo delta executable): the positional signature becomes ``(cls, statics,
+    ex_static, warm_carry, repair_plan)`` with a leading tenant axis on every
+    leaf, exactly mirroring the solo ``delta`` variant key above —
+    ``n_slots`` is then the (shared) repair-window width.  Fused repairs
+    never donate: member carries are stacked copies, and the per-tenant
+    output slices must stay readable after the dispatch.
 
     Per-element semantics are the solo program's exactly — the coalesced
     parity suite pins every co-batched tenant's outputs bit-identical to its
@@ -585,9 +596,10 @@ def batched_solve_callable(
 
     fuse_zones, packed_masks = kernel_flags()
     features = snap_features(features)
-    has_ex = ex_state is not None
+    has_warm = warm_carry is not None
+    has_ex = ex_state is not None and not has_warm
     key = (
-        "tenant-batch",
+        "tenant-batch-repair" if has_warm else "tenant-batch",
         int(n_tenants),
         _kernel_src_hash(),
         jax.default_backend(),
@@ -602,7 +614,9 @@ def batched_solve_callable(
         _leaf_sig(cls),
         _leaf_sig(statics_arrays),
         _leaf_sig(ex_state) if has_ex else None,
-        _leaf_sig(ex_static) if has_ex else None,
+        _leaf_sig(ex_static) if (has_ex or has_warm) else None,
+        _leaf_sig(warm_carry) if has_warm else None,
+        _leaf_sig(repair_plan) if has_warm else None,
     )
     with _lock:
         fn = _memo.get(key)
@@ -610,10 +624,12 @@ def batched_solve_callable(
             _stats["memo_hits"] += 1
             return fn
     base = _base_solve_fn(
-        False, has_ex, n_slots, key_has_bounds, n_passes, features,
+        has_warm, has_ex, n_slots, key_has_bounds, n_passes, features,
         fuse_zones, packed_masks,
     )
-    if has_ex:
+    if has_warm:
+        solo_args = (cls, statics_arrays, ex_static, warm_carry, repair_plan)
+    elif has_ex:
         solo_args = (cls, statics_arrays, ex_state, ex_static)
     else:
         solo_args = (cls, statics_arrays)

@@ -342,11 +342,11 @@ class TestRestore:
 
         time.sleep(0.3)
         _stop(server1, client1, abandon=True)
-        # the checkpoint is gone (corrupt volume, races, ...)
-        os.remove(os.path.join(
-            str(tmp_path / "fleet" / "checkpoints"),
-            os.listdir(str(tmp_path / "fleet" / "checkpoints"))[0],
-        ))
+        # the checkpoints are gone (corrupt volume, races, ...): EVERY
+        # retained generation, or the load ladder restores from an older one
+        ckpt_dir = str(tmp_path / "fleet" / "checkpoints")
+        for name in os.listdir(ckpt_dir):
+            os.remove(os.path.join(ckpt_dir, name))
 
         from karpenter_core_tpu import fleet as fleet_mod
 
@@ -384,3 +384,95 @@ class TestRestore:
             fleet_mod.FAILOVER_TOTAL, outcome="reanchor"
         ) == reanchor_before + 1
         _stop(server, client)
+
+
+# -- retention (PR 18: KC_FLEET_CHECKPOINT_KEEP) ------------------------------
+
+
+class TestRetention:
+    def _dir(self, tmp_path):
+        return str(tmp_path / "fleet" / "checkpoints")
+
+    def test_generations_bounded_and_newest_wins(self, tmp_path):
+        """ckpt_every=1 and many delta solves: the shared directory holds at
+        most ``keep`` generations per tenant, path_for points at the newest,
+        and the newest generation is what load returns."""
+        fleet = _fleet(tmp_path, ckpt_every=1)
+        server, client = _serve(FakeCloudProvider(), fleet=fleet)
+        try:
+            r = _solve(client, "acme", count=4)
+            v = r["tenant"]["sessionVersion"]
+            for count in (6, 8, 10, 12):
+                r = _solve(client, "acme", count=count, version=v)
+            svc = server.kc_service
+            plane = svc._ckpt
+            names = sorted(os.listdir(self._dir(tmp_path)))
+            assert len(names) == plane.keep, names
+            assert all(".g" in n and n.endswith(".kcfc") for n in names)
+            assert plane.path_for("acme") == os.path.join(
+                self._dir(tmp_path), names[-1]
+            )
+            ckpt, status = plane.load("acme")
+            assert status == ckpt_mod.STATUS_OK
+            assert ckpt.path == plane.path_for("acme")
+            live = svc.tenants.entries_snapshot()["acme"]
+            assert ckpt.state == live.session.lineage_state()
+        finally:
+            _stop(server, client)
+
+    def test_corrupt_newest_falls_back_to_previous_generation(self, tmp_path):
+        """The durability win retention buys: flip a byte in the newest
+        generation and load serves the previous COMPLETE generation instead
+        of failing to the journal rung."""
+        fleet = _fleet(tmp_path, ckpt_every=1)
+        server, client = _serve(FakeCloudProvider(), fleet=fleet)
+        try:
+            r = _solve(client, "acme", count=4)
+            _solve(client, "acme", count=6,
+                   version=r["tenant"]["sessionVersion"])
+            plane = server.kc_service._ckpt
+            newest = plane.path_for("acme")
+            prev_ckpt, prev_status = load_checkpoint(sorted(
+                os.path.join(self._dir(tmp_path), n)
+                for n in os.listdir(self._dir(tmp_path))
+            )[0])
+            assert prev_status == ckpt_mod.STATUS_OK
+            data = bytearray(open(newest, "rb").read())
+            data[len(data) // 2] ^= 0xFF
+            with open(newest, "wb") as f:
+                f.write(bytes(data))
+            ckpt, status = plane.load("acme")
+            assert status == ckpt_mod.STATUS_OK
+            assert ckpt.path == prev_ckpt.path
+            assert ckpt.version == prev_ckpt.version
+        finally:
+            _stop(server, client)
+
+    def test_keep_env_override_and_floor(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KC_FLEET_CHECKPOINT_KEEP", "1")
+        assert ckpt_mod.CheckpointPlane(str(tmp_path)).keep == 1
+        monkeypatch.setenv("KC_FLEET_CHECKPOINT_KEEP", "5")
+        assert ckpt_mod.CheckpointPlane(str(tmp_path)).keep == 5
+        monkeypatch.setenv("KC_FLEET_CHECKPOINT_KEEP", "0")
+        assert ckpt_mod.CheckpointPlane(str(tmp_path)).keep == 1
+        monkeypatch.setenv("KC_FLEET_CHECKPOINT_KEEP", "bogus")
+        assert ckpt_mod.CheckpointPlane(str(tmp_path)).keep == 2
+        monkeypatch.delenv("KC_FLEET_CHECKPOINT_KEEP")
+        assert ckpt_mod.CheckpointPlane(str(tmp_path)).keep == 2
+        assert ckpt_mod.CheckpointPlane(str(tmp_path), keep=7).keep == 7
+
+    def test_legacy_unsuffixed_file_is_generation_zero(self, tmp_path):
+        """Upgrade path: a pre-retention ``<stem>-<digest>.kcfc`` file loads
+        as generation 0, newer writes supersede it, and the sweep removes it
+        once ``keep`` suffixed generations exist."""
+        plane = ckpt_mod.CheckpointPlane(str(tmp_path), keep=1)
+        legacy = os.path.join(str(tmp_path), ckpt_mod._safe_name("acme"))
+        with open(legacy, "wb") as f:
+            f.write(b"stale bytes from an old writer")
+        assert plane.path_for("acme") == legacy
+        gens = plane._generations("acme")
+        assert gens == [(0, legacy)]
+        # drop removes every generation including the legacy file
+        plane.drop("acme")
+        assert plane._generations("acme") == []
+        assert not os.path.exists(legacy)

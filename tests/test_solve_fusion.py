@@ -1,0 +1,282 @@
+"""Generalized solve fusion (PR 18, service/tenant.py + solver/incremental.py,
+docs/SERVICE.md "Solve fusion"): delta/repair dispatches and existing-node-
+plane solves from DIFFERENT tenants fuse onto one vmapped dispatch whenever
+their padded shapes and repair-window identities agree — with every
+per-tenant answer bit-identical to the same tenant solving alone.
+
+Three contracts under test:
+
+  - fused-repair fuzz: k tenants with divergent fleets at steady count
+    churn, every response (anchors and deltas) byte-equal to a coalescing-
+    disabled reference server fed the same request sequence, and the final
+    session lineage states equal too;
+  - ex-plane coalescing: tenants whose fleets DIFFER still fuse their
+    anchor solves when the padded existing-node planes share a bucket;
+  - the KC_COALESCE_WINDOW=0 triage flag restores repairs-always-solo
+    without touching anchor coalescing.
+"""
+
+import threading
+
+from karpenter_core_tpu.apis import codec, labels as labels_api
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_core_tpu.service.snapshot_channel import (
+    SnapshotSolverClient,
+    serve,
+)
+from karpenter_core_tpu.service.tenant import (
+    TENANT_REPAIR_DISPATCH,
+    TenantConfig,
+)
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+from karpenter_core_tpu.utils import compilecache
+from karpenter_core_tpu.utils.clock import FakeClock
+
+
+def _config(**kw) -> TenantConfig:
+    base = dict(
+        rate_per_s=1000.0, burst=1000, max_inflight=64,
+        batch_window_s=0.0, max_batch=8,
+        breaker_threshold=3, breaker_reset_s=30.0,
+    )
+    base.update(kw)
+    return TenantConfig(**base)
+
+
+def _fleet_nodes(n: int):
+    """n ready existing nodes — each tenant gets a DIFFERENT n, so fleets
+    diverge while the padded ex-plane shapes still share a bucket."""
+    nodes = []
+    for i in range(n):
+        node = make_node(
+            name=f"fleet-node-{i}",
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: "default-instance-type",
+                labels_api.LABEL_CAPACITY_TYPE: "spot",
+                labels_api.LABEL_NODE_INITIALIZED: "true",
+                labels_api.LABEL_TOPOLOGY_ZONE: f"test-zone-{1 + i % 3}",
+            },
+            allocatable={"cpu": 4, "memory": "4Gi", "pods": 16},
+        )
+        nodes.append({"node": codec.node_to_dict(node), "pods": []})
+    return nodes
+
+
+def _solve(client, tenant_id, count, version=0, nodes=None):
+    return client.solve_tenant_classes(
+        [(make_pod(requests={"cpu": "500m"}), count)], [make_provisioner()],
+        nodes=nodes, tenant={"id": tenant_id, "sessionVersion": version},
+    )
+
+
+def _strip(resp: dict) -> dict:
+    return {k: v for k, v in resp.items() if k != "tenant"}
+
+
+def _serve(config):
+    clock = FakeClock()
+    server, port = serve(FakeCloudProvider(), tenant_config=config,
+                         clock=clock)
+    return server, SnapshotSolverClient(f"127.0.0.1:{port}")
+
+
+def _concurrent(calls):
+    """Run thunks on threads; returns results by key, re-raising the first
+    error."""
+    results, errors = {}, []
+
+    def wrap(key, thunk):
+        try:
+            results[key] = thunk()
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=wrap, args=(k, t)) for k, t in calls
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return results
+
+
+def _counter_value(counter, **labels) -> float:
+    total = 0.0
+    for _name, sample_labels, value in counter.samples():
+        if all(sample_labels.get(k) == v for k, v in labels.items()):
+            total += value
+    return total
+
+
+K = 3
+TICKS = 3
+
+
+def _drive(client, concurrent: bool):
+    """Anchor K divergent-fleet tenants, then TICKS rounds of count churn.
+    Returns {tenant: [response, ...]} in request order."""
+    fleets = {f"t{i}": _fleet_nodes(i + 1) for i in range(K)}
+    counts = {f"t{i}": 10 + 2 * i for i in range(K)}
+    versions = {t: 0 for t in fleets}
+    out = {t: [] for t in fleets}
+
+    def one(t, count):
+        r = _solve(client, t, count, version=versions[t], nodes=fleets[t])
+        versions[t] = r["tenant"]["sessionVersion"]
+        out[t].append(r)
+        return r
+
+    # +1 pod per tick: steady churn small enough that the fallback policy
+    # keeps every tick on the delta path for every tenant
+    rounds = [dict(counts)]
+    for tick in range(1, TICKS + 1):
+        rounds.append({t: counts[t] + tick for t in counts})
+    for round_counts in rounds:
+        if concurrent:
+            _concurrent([
+                (t, lambda t=t, c=c: one(t, c))
+                for t, c in round_counts.items()
+            ])
+        else:
+            for t, c in sorted(round_counts.items()):
+                one(t, c)
+    return out
+
+
+def _lineage_states(server):
+    entries = server.kc_service.tenants.entries_snapshot()
+    return {t: e.session.lineage_state() for t, e in entries.items()}
+
+
+class TestRepairFusionFuzz:
+    def test_k_divergent_tenants_steady_churn_bit_identical(self):
+        """The PR 18 acceptance pin: K tenants with divergent fleets under
+        steady churn — every fused response byte-equal to a fusion-disabled
+        reference server's, final lineage states equal, repairs observed
+        coalescing, and the occupancy ledger accounting the fused rows."""
+        # max_batch=K makes the rendezvous deterministic: the group
+        # dispatches the moment all K arrive, the window is only the
+        # straggler bound
+        server_f, client_f = _serve(
+            _config(batch_window_s=5.0, max_batch=K)
+        )
+        server_s, client_s = _serve(_config(batch_window_s=0.0))
+        compilecache.reset_occupancy()
+        coalesced_before = _counter_value(TENANT_REPAIR_DISPATCH,
+                                          mode="coalesced")
+        try:
+            fused = _drive(client_f, concurrent=True)
+            solo = _drive(client_s, concurrent=False)
+            for t in fused:
+                assert [r["tenant"]["solveMode"] for r in fused[t]] == \
+                    ["full"] + ["delta"] * TICKS, t
+                for i, (rf, rs) in enumerate(zip(fused[t], solo[t])):
+                    assert _strip(rf) == _strip(rs), (t, i)
+            # with max_batch=K every dispatch waits for all K tenants:
+            # anchors and every repair tick fuse at exactly K
+            for t in fused:
+                for i, r in enumerate(fused[t]):
+                    assert r["tenant"]["batched"] == K, (t, i)
+            assert _counter_value(
+                TENANT_REPAIR_DISPATCH, mode="coalesced"
+            ) == coalesced_before + K * TICKS
+            # the fused lineages end bit-equal to the solo lineages
+            states_f = _lineage_states(server_f)
+            states_s = _lineage_states(server_s)
+            assert set(states_f) == set(states_s)
+            for t in states_f:
+                assert states_f[t] == states_s[t], t
+            # occupancy ledger: fused dispatches carried K tenants' rows
+            stats = compilecache.occupancy_stats()
+            assert stats, "fused dispatches must land in the ledger"
+            total = {
+                k: sum(s[k] for s in stats.values())
+                for k in ("dispatches", "tenant_rows")
+            }
+            assert total["dispatches"] >= 1 + TICKS  # anchor + repair rounds
+            assert total["tenant_rows"] >= K * (1 + TICKS)
+            for s in stats.values():
+                assert 0.0 < s["occupancy_ratio"] <= 1.0
+        finally:
+            client_f.close()
+            server_f.stop(grace=0)
+            client_s.close()
+            server_s.stop(grace=0)
+
+
+class TestExPlaneCoalescing:
+    def test_divergent_fleets_anchor_coalesce_bit_identical(self):
+        """Tenants whose EXISTING fleets differ (1 vs 2 nodes) fuse their
+        anchor solves once padding lands them in one bucket — answers
+        bit-identical to each tenant's solo solve."""
+        server_s, client_s = _serve(_config(batch_window_s=0.0))
+        server_f, client_f = _serve(_config(batch_window_s=5.0, max_batch=2))
+        try:
+            solo = {
+                "a": _solve(client_s, "a", 4, nodes=_fleet_nodes(1)),
+                "b": _solve(client_s, "b", 4, nodes=_fleet_nodes(2)),
+            }
+            fused = _concurrent([
+                ("a", lambda: _solve(client_f, "a", 4,
+                                     nodes=_fleet_nodes(1))),
+                ("b", lambda: _solve(client_f, "b", 4,
+                                     nodes=_fleet_nodes(2))),
+            ])
+            assert fused["a"]["tenant"]["batched"] == 2
+            assert fused["b"]["tenant"]["batched"] == 2
+            assert _strip(fused["a"]) == _strip(solo["a"])
+            assert _strip(fused["b"]) == _strip(solo["b"])
+            # the answers place pods on the EXISTING planes, so the fused
+            # path really exercised the stacked ex-plane leaves
+            assert any(
+                solo[t].get("existingAssignments") for t in ("a", "b")
+            )
+        finally:
+            client_f.close()
+            server_f.stop(grace=0)
+            client_s.close()
+            server_s.stop(grace=0)
+
+
+class TestCoalesceWindowFlag:
+    def test_kc_coalesce_window_zero_forces_repairs_solo(self, monkeypatch):
+        monkeypatch.setenv("KC_COALESCE_WINDOW", "0")
+        assert TenantConfig.from_env().coalesce_repairs is False
+        monkeypatch.setenv("KC_COALESCE_WINDOW", "1")
+        assert TenantConfig.from_env().coalesce_repairs is True
+        monkeypatch.delenv("KC_COALESCE_WINDOW")
+        assert TenantConfig.from_env().coalesce_repairs is True
+
+    def test_repairs_stay_solo_when_disabled_but_anchors_still_fuse(self):
+        """coalesce_repairs=False (the KC_COALESCE_WINDOW=0 shape): the
+        repair tick answers batched=1 even with a wide-open window, while
+        concurrent anchors keep coalescing."""
+        server, client = _serve(
+            _config(batch_window_s=5.0, max_batch=2,
+                    coalesce_repairs=False)
+        )
+        try:
+            anchors = _concurrent([
+                ("a", lambda: _solve(client, "a", 12)),
+                ("b", lambda: _solve(client, "b", 12)),
+            ])
+            assert anchors["a"]["tenant"]["batched"] == 2
+            solo_before = _counter_value(TENANT_REPAIR_DISPATCH, mode="solo")
+            repairs = _concurrent([
+                (t, lambda t=t, r=r: _solve(
+                    client, t, 13, version=r["tenant"]["sessionVersion"]))
+                for t, r in anchors.items()
+            ])
+            for t, r in repairs.items():
+                assert r["tenant"]["solveMode"] == "delta", t
+                assert r["tenant"]["batched"] == 1, t
+            assert _counter_value(
+                TENANT_REPAIR_DISPATCH, mode="solo"
+            ) == solo_before + 2
+        finally:
+            client.close()
+            server.stop(grace=0)

@@ -99,7 +99,8 @@ STAGE_KEYS = ("solve_decode_s", "solve_s", "decode_s", "ingest_s",
               "churn_delta_ingest_s", "objective_s",
               "sharded_solve_s", "sharded_solve_1dev_s",
               "pipeline_warm_tick_s", "pipeline_serial_tick_s",
-              "fleet_restore_s", "fleet_replay_s")
+              "fleet_restore_s", "fleet_replay_s",
+              "fusion_repair_solve_s", "fusion_repair_serial_s")
 # stages that matter enough to flag; the others are printed but only the
 # load-bearing ones gate (sub-10ms stages WARN on scheduler-noise otherwise)
 # objective_s gates too: the policy scoring stage rides every policy-enabled
@@ -130,7 +131,17 @@ GATED_STAGES = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "cold_s",
                 # before its first failover answer.  The replay twin stays
                 # advisory — it moves with solve cost, which the solve
                 # stages already gate.
-                "fleet_restore_s")
+                "fleet_restore_s",
+                # the fused cross-tenant REPAIR dispatch at the deepest
+                # tenant count (bench.py fusion_line): the vmapped warm-
+                # carry solve the coalescer amortizes steady churn onto.
+                # Gates independently of the anchor-batch stage — a repair-
+                # fusion regression (a new per-member sync, a stacking copy
+                # gone quadratic) must not hide inside healthy anchor
+                # coalescing numbers.  The serial twin stays advisory (it
+                # moves with solo repair cost, already gated by
+                # churn_warm_solve_s).
+                "fusion_repair_solve_s")
 
 
 def compare_stages(detail: dict, prev_detail: dict, tol: float):
@@ -402,6 +413,62 @@ def report_tenant(detail: dict) -> None:
         )
 
 
+def report_fusion(detail: dict) -> None:
+    """Surface the generalized solve-fusion line (PR 18, docs/SERVICE.md
+    "Solve fusion"): fused vs serial cross-tenant REPAIR dispatch
+    throughput at each tenant count, plus the KC_BUCKET_QUANTIZE sweep.
+    Advisory: warns when fused repair throughput drops under the 2x floor
+    at the deepest count; the enforced side is ``fusion_repair_solve_s``
+    in GATED_STAGES."""
+    fusion = detail.get("fusion")
+    if not fusion:
+        return
+    if "error" in fusion:
+        print(f"perfgate: fusion bench errored: {fusion['error']}")
+        return
+    for n, row in sorted(
+        (fusion.get("repair") or {}).items(), key=lambda kv: int(kv[0])
+    ):
+        print(
+            "perfgate: fusion x{n} repair fused {f:.4f}s vs serial "
+            "{s:.4f}s — speedup {x:.2f}x".format(
+                n=n, f=row["fused_s"], s=row["serial_s"],
+                x=row.get("speedup") or 0.0,
+            )
+        )
+    speedup = fusion.get("fusion_speedup")
+    deepest = max(
+        (int(n) for n in (fusion.get("repair") or {})), default=0
+    )
+    if speedup is not None and speedup < 2.0:
+        print(
+            f"perfgate: WARNING fused repair only {speedup:.2f}x serial at "
+            f"{deepest} tenants (< 2x floor) — repair fusion is not paying "
+            "for its stacking overhead (docs/SERVICE.md triage: "
+            "KC_COALESCE_WINDOW)"
+        )
+    quant = fusion.get("quantize") or {}
+    default, quantized = quant.get("default"), quant.get("quantized")
+    if default and quantized:
+        print(
+            "perfgate: fusion quantize ladder: {bd} buckets -> {bq} "
+            "(occupancy {od} -> {oq} tenants/dispatch, padded FLOPs "
+            "{fd:.0f} -> {fq:.0f})".format(
+                bd=default["buckets"], bq=quantized["buckets"],
+                od=default.get("tenants_per_dispatch"),
+                oq=quantized.get("tenants_per_dispatch"),
+                fd=default.get("padded_flops") or 0.0,
+                fq=quantized.get("padded_flops") or 0.0,
+            )
+        )
+        if quantized["buckets"] > default["buckets"]:
+            print(
+                "perfgate: WARNING the quantized ladder produced MORE "
+                "buckets than the default — KC_BUCKET_QUANTIZE stopped "
+                "being a subset grid"
+            )
+
+
 def report_fleet(detail: dict) -> None:
     """Surface the fleet failover restore line (ISSUE-17, docs/FLEET.md):
     checkpoint-restore vs journal-replay adoption cost per chain depth.  The
@@ -552,6 +619,7 @@ def main() -> int:
     report_policy(detail)
     report_sharded(detail)
     report_tenant(detail)
+    report_fusion(detail)
     report_fleet(detail)
     report_recovery(detail)
     report_watchdog(detail)
