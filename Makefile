@@ -6,7 +6,7 @@ presubmit: verify test kernel-smoke perf-gate  ## everything a PR needs to pass
 
 verify: chaos soak  ## static checks + the chaos and soak gates: bytecode-compile, kcanalyze (all analysis passes, baseline-aware), build the native library
 	python -m compileall -q karpenter_core_tpu tests bench.py __graft_entry__.py
-	python tools/kcanalyze.py
+	python tools/kcanalyze.py --strict
 	$(MAKE) -C native
 
 chaos:  ## tier-1 chaos subset with a fixed seed: seeded fault scenarios must converge leak-free (docs/CHAOS.md)

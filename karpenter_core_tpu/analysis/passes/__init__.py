@@ -7,18 +7,20 @@ write the module, append it here, and document it in docs/ANALYSIS.md.
 
 from karpenter_core_tpu.analysis.passes import (
     chaos_hygiene,
+    env_flags,
     hygiene,
     instrumented,
     lock_order,
     metric_docs,
     retrace_budget,
+    shared_state,
     trace_safety,
     unbounded_block,
 )
 
 ALL_PASSES = [
     trace_safety, retrace_budget, lock_order, hygiene, instrumented,
-    chaos_hygiene, unbounded_block, metric_docs,
+    chaos_hygiene, unbounded_block, metric_docs, shared_state, env_flags,
 ]
 
 __all__ = ["ALL_PASSES"]

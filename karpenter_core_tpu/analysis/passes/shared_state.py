@@ -1,0 +1,809 @@
+"""shared-state: lockset inference for the concurrent service/fleet surface.
+
+``lock-order`` proves the locks cannot deadlock; this pass proves the locks
+actually GUARD something.  For every class (or module) in the concurrency
+scope — ``service/``, ``fleet/``, ``state/``, ``solver/incremental.py``,
+``utils/compilecache.py`` — that owns a ``threading.Lock`` / ``RLock`` /
+``Condition``, it infers the per-method lockset held at every shared-field
+access (``with self._lock:`` blocks, acquire/release pairs, interprocedural
+context through same-class helper calls) and reports:
+
+  unguarded-field      a field accessed under a lock on one path and
+                       lock-free on another thread-reachable path, with at
+                       least one non-init write — the classic torn-update
+                       race (Eraser/RacerD lockset discipline)
+  mixed-guard          every access is locked, but no single lock covers
+                       them all: lock A on one path, lock B on another
+  unlocked-publication a mutable container (dict/list/set) swapped in
+                       lock-free while other paths mutate it under a lock —
+                       readers can observe the swap mid-mutation
+
+Soundness shape (documented, deliberate):
+
+  - Entry points are public methods/functions plus anything registered as a
+    thread target (``threading.Thread(target=...)``), an executor submit, or
+    a gRPC ``*_rpc_method_handler``; a private method's incoming lockset is
+    the INTERSECTION over every resolvable call site (standard lockset
+    join), so one lock-free caller taints the method.  Private methods with
+    no resolvable caller are skipped, and constructors (``__init__`` /
+    ``__post_init__`` and helpers reachable only from them) fall out of the
+    analysis naturally — that is the init-only escape hatch.
+  - Companion objects: ``with entry.lock:`` where ``lock`` is the uniquely
+    named lock attribute of exactly one in-scope class pins accesses like
+    ``entry.recovered`` (fields declared by exactly one lock-owning class)
+    to that class's lockset; a companion built by a constructor call in the
+    same function (``entry = TenantEntry(...)``) is still being initialized
+    and is exempt.  Companion locksets flow through same-class helper calls
+    by argument-to-parameter mapping.
+  - Closures and lambdas inherit their definition-point lockset: in this
+    codebase inner functions are invoked synchronously downstream (solve
+    hooks), so the definition site's locks are the honest approximation.
+  - Duck-typed cross-class calls are invisible; a clean report is necessary,
+    not sufficient.  The runtime half (karpenter_core_tpu/testing/lockcheck)
+    is the dynamic witness for what this pass cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from karpenter_core_tpu.analysis.callgraph import shared_graph
+from karpenter_core_tpu.analysis.core import (
+    Finding,
+    Project,
+    SourceModule,
+    dotted,
+    import_map,
+    resolve_call_root,
+)
+
+NAME = "shared-state"
+
+# the concurrency scope: package-relative directories and files
+_SCOPE_DIRS = {"service", "fleet", "state"}
+_SCOPE_FILES = {"solver/incremental.py", "utils/compilecache.py"}
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+    "multiprocessing.Lock",
+}
+
+# attribute calls that mutate their receiver container in place
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear", "appendleft", "popleft",
+    "move_to_end",
+}
+
+_CONTAINER_CTORS = {
+    "dict", "list", "set", "OrderedDict", "defaultdict", "deque", "Counter",
+    "collections.OrderedDict", "collections.defaultdict",
+    "collections.deque", "collections.Counter",
+}
+
+FuncKey = Tuple[str, str]  # (module name, top-level qualname)
+Token = Tuple[str, str]  # ("self", attr) | ("mod", name) | (var, lock key)
+
+
+def _in_scope(module: SourceModule, package: str) -> bool:
+    name = module.name
+    if not name.startswith(package + "."):
+        return False
+    rel = name[len(package) + 1:].split(".")
+    if rel and rel[0] in _SCOPE_DIRS:
+        return True
+    return "/".join(rel) + ".py" in _SCOPE_FILES
+
+
+def _is_container_ctor(value: ast.expr, imports: Dict[str, str]) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        root = resolve_call_root(value.func, imports)
+        return root in _CONTAINER_CTORS
+    return False
+
+
+@dataclass
+class _Unit:
+    """One audited lock-owning scope: a class, or a module's globals."""
+
+    key: str  # "module:Class" or "module:<module>"
+    kind: str  # "class" | "module"
+    module: SourceModule
+    display: str  # short human name for details
+    locks: Dict[str, str] = field(default_factory=dict)  # attr -> lock key
+    declared: Set[str] = field(default_factory=set)  # shared field names
+    methods: Set[str] = field(default_factory=set)  # class method names
+
+
+@dataclass
+class _Acc:
+    kind: str  # "self" | "comp" | "glob"
+    var: str  # receiver variable ("self" / companion var / global name)
+    attr: str  # field name (== var for "glob")
+    write: bool
+    publishes: bool  # Store of a fresh container
+    tokens: FrozenSet[Token]  # locally held at the access
+    line: int
+
+
+@dataclass
+class _Edge:
+    callee: FuncKey
+    tokens: FrozenSet[Token]  # locally held at the call site
+    argmap: Dict[str, str]  # callee param -> caller variable
+    line: int
+
+
+@dataclass
+class _Func:
+    key: FuncKey
+    module: SourceModule
+    node: ast.AST
+    cls: Optional[str]
+    qualname: str
+    accesses: List[_Acc] = field(default_factory=list)
+    calls: List[_Edge] = field(default_factory=list)
+    ctor_vars: Set[str] = field(default_factory=set)
+
+
+# -- unit discovery -----------------------------------------------------------
+
+
+def _lock_ctor_kind(value: ast.expr, imports: Dict[str, str]) -> bool:
+    """True when ``value`` constructs a lock (dataclass ``field(
+    default_factory=threading.Lock)`` included)."""
+    if not isinstance(value, ast.Call):
+        return False
+    root = resolve_call_root(value.func, imports)
+    if root in _LOCK_CTORS:
+        return True
+    if root in ("field", "dataclasses.field"):
+        for kw in value.keywords:
+            if kw.arg == "default_factory":
+                factory = None
+                if isinstance(kw.value, (ast.Name, ast.Attribute)):
+                    d = dotted(kw.value)
+                    if d is not None:
+                        head, _, rest = d.partition(".")
+                        target = imports.get(head, head)
+                        factory = f"{target}.{rest}" if rest else target
+                if factory in _LOCK_CTORS:
+                    return True
+    return False
+
+
+def _discover_units(
+    modules: List[SourceModule],
+) -> Tuple[Dict[str, _Unit], Dict[str, _Unit]]:
+    """(units by key, class units by bare class name)."""
+    units: Dict[str, _Unit] = {}
+    by_class: Dict[str, _Unit] = {}
+    for module in modules:
+        imports = import_map(module.tree)
+        # module unit: module-global locks + module-global containers
+        mod_unit = _Unit(
+            key=f"{module.name}:<module>", kind="module", module=module,
+            display=module.name.rsplit(".", 1)[-1],
+        )
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+                isinstance(node.targets[0], ast.Name)
+            ):
+                name = node.targets[0].id
+                if _lock_ctor_kind(node.value, imports):
+                    mod_unit.locks[name] = f"{module.name}:{name}"
+                elif _is_container_ctor(node.value, imports):
+                    mod_unit.declared.add(name)
+        if mod_unit.locks and mod_unit.declared:
+            units[mod_unit.key] = mod_unit
+
+        for cls in module.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            unit = _Unit(
+                key=f"{module.name}:{cls.name}", kind="class", module=module,
+                display=cls.name,
+            )
+            for stmt in cls.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    unit.methods.add(stmt.name)
+                elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    # dataclass field declarations
+                    if stmt.value is not None and _lock_ctor_kind(
+                        stmt.value, imports
+                    ):
+                        unit.locks[stmt.target.id] = (
+                            f"{module.name}:{cls.name}.{stmt.target.id}"
+                        )
+                    else:
+                        unit.declared.add(stmt.target.id)
+                elif isinstance(stmt, ast.Assign) and len(
+                    stmt.targets
+                ) == 1 and isinstance(stmt.targets[0], ast.Name):
+                    if _lock_ctor_kind(stmt.value, imports):
+                        unit.locks[stmt.targets[0].id] = (
+                            f"{module.name}:{cls.name}.{stmt.targets[0].id}"
+                        )
+            # self.X = threading.Lock() / self.X = <anything> in __init__
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        if _lock_ctor_kind(node.value, imports):
+                            unit.locks[t.attr] = (
+                                f"{module.name}:{cls.name}.{t.attr}"
+                            )
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and fn.name in ("__init__", "__post_init__"):
+                    for node in ast.walk(fn):
+                        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                            targets = (
+                                node.targets
+                                if isinstance(node, ast.Assign)
+                                else [node.target]
+                            )
+                            for t in targets:
+                                if (
+                                    isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"
+                                    and t.attr not in unit.locks
+                                ):
+                                    unit.declared.add(t.attr)
+            unit.declared -= set(unit.locks)
+            unit.declared -= unit.methods
+            if unit.locks:
+                units[unit.key] = unit
+                by_class[cls.name] = unit
+    return units, by_class
+
+
+def _unique_map(pairs: List[Tuple[str, str]]) -> Dict[str, str]:
+    """name -> value for names that map to exactly one value."""
+    seen: Dict[str, Optional[str]] = {}
+    for name, value in pairs:
+        if name in seen and seen[name] != value:
+            seen[name] = None
+        else:
+            seen[name] = value
+    return {k: v for k, v in seen.items() if v is not None}
+
+
+# -- per-function fact extraction ---------------------------------------------
+
+
+class _FnWalker:
+    def __init__(
+        self,
+        func: _Func,
+        unit: Optional[_Unit],  # enclosing class unit, if any
+        mod_unit: Optional[_Unit],
+        imports: Dict[str, str],
+        comp_locks: Dict[str, Tuple[str, str]],  # attr -> (unit key, lock key)
+        unit_class_names: Set[str],
+        module_funcs: Set[str],
+        class_methods: Dict[str, ast.AST],
+    ) -> None:
+        self.func = func
+        self.unit = unit
+        self.mod_unit = mod_unit
+        self.imports = imports
+        self.comp_locks = comp_locks
+        self.unit_class_names = unit_class_names
+        self.module_funcs = module_funcs
+        self.class_methods = class_methods
+        self.held: List[Token] = []
+        self._written: Set[int] = set()  # Attribute/Name ids already recorded
+        self._locals = self._local_names(func.node)
+
+    @staticmethod
+    def _local_names(node: ast.AST) -> Set[str]:
+        """Names bound in the function (params + assignments), used to tell
+        module globals from locals.  ``global`` declarations un-bind."""
+        out: Set[str] = set()
+        args = getattr(node, "args", None)
+        if args is not None:
+            for a in (
+                args.posonlyargs + args.args + args.kwonlyargs
+                + ([args.vararg] if args.vararg else [])
+                + ([args.kwarg] if args.kwarg else [])
+            ):
+                out.add(a.arg)
+        hoisted: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                out.add(sub.id)
+            elif isinstance(sub, ast.Global):
+                hoisted.update(sub.names)
+        return out - hoisted
+
+    def token_of(self, expr: ast.expr) -> Optional[Token]:
+        """Held-token for a lock-typed context-manager / acquire receiver."""
+        if isinstance(expr, ast.Name):
+            if self.mod_unit is not None and expr.id in self.mod_unit.locks \
+                    and expr.id not in self._locals:
+                return ("mod", expr.id)
+            return None
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ):
+            recv = expr.value.id
+            if recv == "self":
+                if self.unit is not None and expr.attr in self.unit.locks:
+                    return ("self", expr.attr)
+                return None
+            if recv in self.imports:
+                return None
+            hit = self.comp_locks.get(expr.attr)
+            if hit is not None:
+                return (recv, hit[1])
+        return None
+
+    def run(self) -> None:
+        body = self.func.node.body
+        for stmt in body if isinstance(body, list) else [body]:
+            self._walk(stmt)
+
+    # -- access recording -----------------------------------------------------
+
+    def _record_attr(self, node: ast.Attribute, write: bool,
+                     publishes: bool = False) -> None:
+        if not isinstance(node.value, ast.Name):
+            return
+        recv = node.value.id
+        attr = node.attr
+        if attr.startswith("__") and attr.endswith("__"):
+            return
+        tokens = frozenset(self.held)
+        if recv == "self":
+            if self.unit is None or attr in self.unit.locks \
+                    or attr in self.unit.methods:
+                return
+            self.func.accesses.append(
+                _Acc("self", "self", attr, write, publishes, tokens,
+                     node.lineno)
+            )
+            self._written.add(id(node))
+        else:
+            if recv in self.imports or recv in self.module_funcs \
+                    or recv in self.unit_class_names:
+                return
+            self.func.accesses.append(
+                _Acc("comp", recv, attr, write, publishes, tokens,
+                     node.lineno)
+            )
+            self._written.add(id(node))
+
+    def _record_name(self, node: ast.Name, write: bool,
+                     publishes: bool = False) -> None:
+        if self.mod_unit is None or node.id not in self.mod_unit.declared:
+            return
+        if not write and node.id in self._locals:
+            return  # shadowed by a local binding
+        self.func.accesses.append(
+            _Acc("glob", node.id, node.id, write, publishes,
+                 frozenset(self.held), node.lineno)
+        )
+        self._written.add(id(node))
+
+    def _record_target(self, target: ast.expr, publishes: bool) -> None:
+        """Classify an assignment/del target as a shared-state write."""
+        if isinstance(target, ast.Attribute):
+            self._record_attr(target, write=True, publishes=publishes)
+        elif isinstance(target, ast.Name):
+            if target.id not in self._locals:  # only `global X` writes count
+                self._record_name(target, write=True, publishes=publishes)
+        elif isinstance(target, ast.Subscript):
+            # container mutation through the receiver: d[k] = v / del d[k]
+            if isinstance(target.value, ast.Attribute):
+                self._record_attr(target.value, write=True)
+            elif isinstance(target.value, ast.Name):
+                self._record_name(target.value, write=True)
+            self._walk(target.slice)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_target(elt, publishes)
+
+    # -- the walk --------------------------------------------------------------
+
+    def _walk(self, node: ast.AST) -> None:
+        if isinstance(node, ast.With):
+            taken: List[Token] = []
+            for item in node.items:
+                tok = self.token_of(item.context_expr)
+                if tok is not None:
+                    self.held.append(tok)
+                    taken.append(tok)
+                else:
+                    self._walk(item.context_expr)
+            for stmt in node.body:
+                self._walk(stmt)
+            for tok in reversed(taken):
+                self.held.remove(tok)
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = node.value
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            else:
+                targets = [node.target]
+            publishes = value is not None and _is_container_ctor(
+                value, self.imports
+            ) and not isinstance(node, ast.AugAssign)
+            for t in targets:
+                self._record_target(t, publishes)
+            # companion-constructor escape: entry = TenantEntry(...)
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id in self.unit_class_names
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                self.func.ctor_vars.add(node.targets[0].id)
+            if value is not None:
+                self._walk(value)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._record_target(t, publishes=False)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node)
+            return
+        if isinstance(node, ast.Attribute) and id(node) not in self._written:
+            self._record_attr(node, write=False)
+            # fall through: walk the receiver too? the receiver is a Name
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and id(node) not in self._written:
+            self._record_name(node, write=False)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+    def _call(self, node: ast.Call) -> None:
+        func = node.func
+        # acquire/release pairs on a known lock track like `with`
+        if isinstance(func, ast.Attribute) and func.attr in (
+            "acquire", "release"
+        ):
+            tok = self.token_of(func.value)
+            if tok is not None:
+                if func.attr == "acquire":
+                    self.held.append(tok)
+                elif tok in self.held:
+                    self.held.remove(tok)
+                return
+        # in-place container mutation: self.d.update(...), entry.xs.append(..)
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+            if isinstance(func.value, ast.Attribute):
+                self._record_attr(func.value, write=True)
+            elif isinstance(func.value, ast.Name):
+                self._record_name(func.value, write=True)
+        # propagation edges: self.helper(...) and module-level f(...)
+        callee_key: Optional[FuncKey] = None
+        callee_node: Optional[ast.AST] = None
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("self", "cls")
+            and self.func.cls is not None
+        ):
+            qual = f"{self.func.cls}.{func.attr}"
+            callee_node = self.class_methods.get(qual)
+            if callee_node is not None:
+                callee_key = (self.func.module.name, qual)
+        elif isinstance(func, ast.Name) and func.id in self.module_funcs:
+            callee_node = self.class_methods.get(func.id)
+            if callee_node is not None:
+                callee_key = (self.func.module.name, func.id)
+        if callee_key is not None and callee_node is not None:
+            argmap: Dict[str, str] = {}
+            args = getattr(callee_node, "args", None)
+            if args is not None:
+                params = [a.arg for a in args.posonlyargs + args.args]
+                if params and params[0] in ("self", "cls") and isinstance(
+                    func, ast.Attribute
+                ):
+                    params = params[1:]
+                for p, a in zip(params, node.args):
+                    if isinstance(a, ast.Name):
+                        argmap[p] = a.id
+                kwparams = {a.arg for a in args.args + args.kwonlyargs}
+                for kw in node.keywords:
+                    if kw.arg in kwparams and isinstance(kw.value, ast.Name):
+                        argmap[kw.arg] = kw.value.id
+            self.func.calls.append(
+                _Edge(callee_key, frozenset(self.held), argmap, node.lineno)
+            )
+        for child in ast.iter_child_nodes(node):
+            if child is func and callee_key is not None:
+                continue
+            self._walk(child)
+
+
+# -- entry-point seeding ------------------------------------------------------
+
+
+def _thread_seeds(project: Project) -> Set[str]:
+    """Call-graph keys registered as thread targets, executor submits, or
+    RPC method handlers anywhere in the package."""
+    graph = shared_graph(project)
+    seeds: Set[str] = set()
+    imports_cache: Dict[str, Dict[str, str]] = {}
+    for info in graph.functions.values():
+        src = info.module.source  # textual gate: most modules register nothing
+        if "Thread(" not in src and ".submit(" not in src \
+                and "_rpc_method_handler" not in src:
+            continue
+        imports = imports_cache.setdefault(
+            info.module.name, import_map(info.module.tree)
+        )
+        nested = {id(graph.functions[k].node) for k in info.children}
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if id(child) in nested:
+                    continue
+                if isinstance(child, ast.Call):
+                    root = resolve_call_root(child.func, imports)
+                    cands: List[ast.expr] = []
+                    if root == "threading.Thread":
+                        cands += [
+                            kw.value for kw in child.keywords
+                            if kw.arg == "target"
+                        ]
+                    elif isinstance(child.func, ast.Attribute) and \
+                            child.func.attr == "submit" and child.args:
+                        cands.append(child.args[0])
+                    elif (root or "").rpartition(".")[2].endswith(
+                        "_rpc_method_handler"
+                    ):
+                        cands += list(child.args)
+                    for cand in cands:
+                        key = graph.resolve(cand, info.module, info)
+                        if key is not None:
+                            seeds.add(key)
+                walk(child)
+
+        body = info.node.body
+        for stmt in body if isinstance(body, list) else [body]:
+            walk(stmt)
+    return seeds
+
+
+# -- the pass -----------------------------------------------------------------
+
+
+def run(project: Project) -> List[Finding]:
+    modules = [
+        m for m in project.package_modules if _in_scope(m, project.package)
+    ]
+    if not modules:
+        return []
+    units, by_class = _discover_units(modules)
+    if not units:
+        return []
+
+    # companion resolution tables: uniquely-named lock attrs and fields
+    class_units = [u for u in units.values() if u.kind == "class"]
+    comp_locks_flat = _unique_map(
+        [(attr, key) for u in class_units for attr, key in u.locks.items()]
+    )
+    comp_locks = {
+        attr: next(
+            (u.key, key) for u in class_units if u.locks.get(attr) == key
+        )
+        for attr, key in comp_locks_flat.items()
+    }
+    field_owner = _unique_map(
+        [(f, u.key) for u in class_units for f in u.declared]
+    )
+    unit_class_names = {u.display for u in class_units}
+
+    # index every top-level function/method in scope, extract local facts
+    funcs: Dict[FuncKey, _Func] = {}
+    for module in modules:
+        imports = import_map(module.tree)
+        mod_unit = units.get(f"{module.name}:<module>")
+        module_funcs = {
+            n.name for n in module.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # flat lookup: "f" for module funcs, "Class.m" for methods
+        flat: Dict[str, ast.AST] = {
+            n.name: n for n in module.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for cls in module.tree.body:
+            if isinstance(cls, ast.ClassDef):
+                for m in cls.body:
+                    if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        flat[f"{cls.name}.{m.name}"] = m
+        for qual, node in flat.items():
+            cls_name = qual.split(".")[0] if "." in qual else None
+            func = _Func(
+                key=(module.name, qual), module=module, node=node,
+                cls=cls_name, qualname=qual,
+            )
+            unit = units.get(f"{module.name}:{cls_name}") if cls_name else None
+            _FnWalker(
+                func, unit, mod_unit, imports, comp_locks, unit_class_names,
+                module_funcs, flat,
+            ).run()
+            funcs[func.key] = func
+
+    # seed contexts: public API + thread/executor/RPC registrations
+    seeds: Set[FuncKey] = set()
+    for key, func in funcs.items():
+        leaf = func.qualname.split(".")[-1]
+        if not leaf.startswith("_"):
+            seeds.add(key)
+    for gkey in _thread_seeds(project):
+        mod_name, _, qual = gkey.partition(":")
+        top = ".".join(qual.split(".")[:2]) if "." in qual else qual
+        for cand in (qual, top, qual.split(".")[0]):
+            if (mod_name, cand) in funcs:
+                seeds.add((mod_name, cand))
+                break
+
+    # lockset fixpoint: context(m) = intersection over resolvable call sites
+    ctx: Dict[FuncKey, Optional[FrozenSet[Token]]] = {
+        k: None for k in funcs
+    }
+    work = deque()
+    for k in seeds:
+        ctx[k] = frozenset()
+        work.append(k)
+    while work:
+        caller_key = work.popleft()
+        caller = funcs[caller_key]
+        base = ctx[caller_key]
+        if base is None:
+            continue
+        caller_unit = (
+            units.get(f"{caller.module.name}:{caller.cls}")
+            if caller.cls else None
+        )
+        for edge in caller.calls:
+            callee = funcs.get(edge.callee)
+            if callee is None:
+                continue
+            incoming = base | edge.tokens
+            out: Set[Token] = set()
+            same_class = (
+                caller.cls is not None and callee.cls == caller.cls
+                and callee.module is caller.module
+            )
+            inv: Dict[str, List[str]] = {}
+            for p, v in edge.argmap.items():
+                inv.setdefault(v, []).append(p)
+            for tok in incoming:
+                head, tail = tok
+                if head == "self":
+                    if same_class:
+                        out.add(tok)
+                    lock_key = (
+                        caller_unit.locks.get(tail) if caller_unit else None
+                    )
+                    if lock_key is not None:
+                        for p in inv.get("self", ()):
+                            out.add((p, lock_key))
+                elif head == "mod":
+                    if callee.cls is None and \
+                            callee.module is caller.module:
+                        out.add(tok)
+                else:
+                    for p in inv.get(head, ()):
+                        out.add((p, tail))
+            new = frozenset(out)
+            prev = ctx[edge.callee]
+            joined = new if prev is None else (prev & new)
+            if joined != prev:
+                ctx[edge.callee] = joined
+                work.append(edge.callee)
+
+    # collect per-(unit, field) observations
+    Obs = Tuple[bool, FrozenSet[str], str, int, str, bool]
+    obs: Dict[Tuple[str, str], List[Obs]] = {}
+    for key, func in funcs.items():
+        base = ctx[key]
+        if base is None:
+            continue  # unreachable / init-only: escape-analyzed away
+        for acc in func.accesses:
+            tokens = base | acc.tokens
+            unit: Optional[_Unit] = None
+            lockset: Set[str] = set()
+            if acc.kind == "self":
+                unit = units.get(f"{func.module.name}:{func.cls}")
+                if unit is None:
+                    continue
+                for head, tail in tokens:
+                    if head == "self" and tail in unit.locks:
+                        lockset.add(unit.locks[tail])
+            elif acc.kind == "comp":
+                if acc.var in func.ctor_vars:
+                    continue  # still under construction in this function
+                owner = field_owner.get(acc.attr)
+                if owner is None:
+                    continue
+                unit = units[owner]
+                lock_keys = set(unit.locks.values())
+                for head, tail in tokens:
+                    if head == acc.var and tail in lock_keys:
+                        lockset.add(tail)
+            else:  # glob
+                unit = units.get(f"{func.module.name}:<module>")
+                if unit is None:
+                    continue
+                for head, tail in tokens:
+                    if head == "mod" and tail in unit.locks:
+                        lockset.add(unit.locks[tail])
+            obs.setdefault((unit.key, acc.attr), []).append((
+                acc.write, frozenset(lockset), func.module.relpath,
+                acc.line, func.qualname, acc.publishes,
+            ))
+
+    # verdicts
+    findings: List[Finding] = []
+    for (unit_key, fname), observations in sorted(obs.items()):
+        unit = units[unit_key]
+        writes = [o for o in observations if o[0]]
+        if not writes:
+            continue  # read-only after init
+        locked = [o for o in observations if o[1]]
+        unlocked = [o for o in observations if not o[1]]
+        label = f"{unit.display}.{fname}" if unit.kind == "class" else fname
+        if locked and unlocked:
+            lwrite, llock, lpath, lline, lqual, _ = locked[0]
+            locked_mutates = any(o[0] and not o[5] for o in locked)
+            if all(o[5] for o in unlocked) and locked_mutates:
+                w = unlocked[0]
+                findings.append(Finding(
+                    w[2], w[3], "unlocked-publication",
+                    f"mutable container {label!r} is published lock-free "
+                    f"here while {lpath}:{lline} ({lqual}) mutates it under "
+                    f"{sorted(llock)[0]!r} — readers can observe the swap "
+                    "mid-mutation; publish under the same lock",
+                    NAME, symbol=w[4],
+                ))
+            else:
+                w = unlocked[0]
+                findings.append(Finding(
+                    w[2], w[3], "unguarded-field",
+                    f"shared field {label!r} is "
+                    f"{'written' if w[0] else 'read'} lock-free here but "
+                    f"guarded by {sorted(llock)[0]!r} at {lpath}:{lline} "
+                    f"({lqual}) — every thread-reachable access must hold "
+                    "one common lock (or prove it benign in the baseline)",
+                    NAME, symbol=w[4],
+                ))
+        elif locked:
+            common = frozenset.intersection(*[o[1] for o in locked])
+            if not common:
+                first = locked[0]
+                other = next(o for o in locked if o[1] != first[1])
+                findings.append(Finding(
+                    other[2], other[3], "mixed-guard",
+                    f"shared field {label!r} is guarded by "
+                    f"{sorted(other[1])[0]!r} here but by "
+                    f"{sorted(first[1])[0]!r} at {first[2]}:{first[3]} "
+                    f"({first[4]}) — no single lock covers every access",
+                    NAME, symbol=other[4],
+                ))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

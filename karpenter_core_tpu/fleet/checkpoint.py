@@ -37,6 +37,7 @@ import hashlib
 import logging
 import os
 import re
+import threading
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import msgpack
@@ -299,6 +300,11 @@ class CheckpointPlane:
         self.replica_id = replica_id
         self.every = max(int(every), 1)
         self.keep = _retention_keep() if keep is None else max(int(keep), 1)
+        # serializes list-generations -> publish -> sweep: without it a
+        # cadence write (serve path) racing the drain's write_all can compute
+        # the SAME next generation number — one os.replace clobbers the
+        # other — and sweep against a stale listing
+        self._lock = threading.Lock()
 
     def _generations(self, tenant_id: str) -> List[Tuple[int, str]]:
         """Existing checkpoint generations for a tenant, newest first.  The
@@ -331,10 +337,14 @@ class CheckpointPlane:
         return os.path.join(self.directory, _safe_name(tenant_id))
 
     def after_solve(self, tenant_id: str, entry, mode: str) -> None:
-        entry.ckpt_ticks += 1
-        if mode != "full" and entry.ckpt_ticks < self.every:
-            return
-        entry.ckpt_ticks = 0
+        # the cadence counter is entry state shared with the drain's
+        # write_all: hold the entry lock (re-entrant — the serve path
+        # already owns it) so the read-modify-write is never torn
+        with entry.lock:
+            entry.ckpt_ticks += 1
+            if mode != "full" and entry.ckpt_ticks < self.every:
+                return
+            entry.ckpt_ticks = 0
         try:
             self.write(tenant_id, entry)
         except Exception:  # noqa: BLE001 - checkpointing must never fail a solve
@@ -346,26 +356,33 @@ class CheckpointPlane:
         """Serialize the entry's lineage; returns the published path or None
         when there is nothing to checkpoint (no warm lineage, or the anchor
         request bytes were never captured)."""
-        if not getattr(entry, "anchor_request", None):
-            CHECKPOINT_TOTAL.labels("skipped").inc()
-            return None
-        export = entry.session.export_lineage()
-        if export is None:
-            CHECKPOINT_TOTAL.labels("skipped").inc()
-            return None
-        header = {
-            "t": "header",
-            "format": FORMAT,
-            "tenant": tenant_id,
-            "version": export["version"],
-            "tseq": int(getattr(entry, "journal_tseq", 0)),
-            "client_supply": getattr(entry, "supply_digest", None),
-            "state": export["state"],
-            "supply": export["supply"],
-            "uid_bases": list(getattr(entry, "anchor_uid_bases", ()) or ()),
-            "replica": self.replica_id,
-            "written_at": float(self.clock.now()),
-        }
+        # snapshot the entry fields under its lock: write() also runs from
+        # the drain's write_all, concurrently with a serve thread that is
+        # mid-solve under this same lock on another tenant's thread
+        with entry.lock:
+            if not getattr(entry, "anchor_request", None):
+                CHECKPOINT_TOTAL.labels("skipped").inc()
+                return None
+            export = entry.session.export_lineage()
+            if export is None:
+                CHECKPOINT_TOTAL.labels("skipped").inc()
+                return None
+            anchor_request = entry.anchor_request
+            header = {
+                "t": "header",
+                "format": FORMAT,
+                "tenant": tenant_id,
+                "version": export["version"],
+                "tseq": int(getattr(entry, "journal_tseq", 0)),
+                "client_supply": getattr(entry, "supply_digest", None),
+                "state": export["state"],
+                "supply": export["supply"],
+                "uid_bases": list(
+                    getattr(entry, "anchor_uid_bases", ()) or ()
+                ),
+                "replica": self.replica_id,
+                "written_at": float(self.clock.now()),
+            }
         tensors = {
             "t": "tensors",
             "prep": enc(export["prep"]),
@@ -380,36 +397,41 @@ class CheckpointPlane:
             "initial_slots_used": export["initial_slots_used"],
             "materialized": list(export["materialized"]),
         }
-        blob = checkpoint_bytes(header, entry.anchor_request, tensors)
+        blob = checkpoint_bytes(header, anchor_request, tensors)
         os.makedirs(self.directory, exist_ok=True)
-        gens = self._generations(tenant_id)
-        gen = (gens[0][0] + 1) if gens else 1
-        base = _safe_name(tenant_id)
-        path = os.path.join(
-            self.directory, f"{base[:-len('.kcfc')]}.g{gen:08d}.kcfc"
-        )
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        dfd = os.open(self.directory, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-        CHECKPOINT_TOTAL.labels("written").inc()
-        CHECKPOINT_BYTES.labels().observe(float(len(blob)))
-        # retention sweep AFTER the publish fsync: the new generation is
-        # durable before any older one disappears, so a crash mid-sweep can
-        # only leave extras, never zero
-        for _g, old in gens[self.keep - 1:]:
+        # list -> publish -> sweep is one critical section: two concurrent
+        # writers (cadence vs drain) listing the same generations would pick
+        # the SAME next number — one publish silently clobbers the other —
+        # and each would sweep against the other's stale listing
+        with self._lock:
+            gens = self._generations(tenant_id)
+            gen = (gens[0][0] + 1) if gens else 1
+            base = _safe_name(tenant_id)
+            path = os.path.join(
+                self.directory, f"{base[:-len('.kcfc')]}.g{gen:08d}.kcfc"
+            )
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            dfd = os.open(self.directory, os.O_RDONLY)
             try:
-                os.remove(old)
-                CHECKPOINT_TOTAL.labels("gc").inc()
-            except OSError:
-                pass
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+            CHECKPOINT_TOTAL.labels("written").inc()
+            CHECKPOINT_BYTES.labels().observe(float(len(blob)))
+            # retention sweep AFTER the publish fsync: the new generation is
+            # durable before any older one disappears, so a crash mid-sweep
+            # can only leave extras, never zero
+            for _g, old in gens[self.keep - 1:]:
+                try:
+                    os.remove(old)
+                    CHECKPOINT_TOTAL.labels("gc").inc()
+                except OSError:
+                    pass
         return path
 
     def write_all(self, entries: Dict[str, object]) -> int:

@@ -105,6 +105,10 @@ class FleetRouter(grpc.GenericRpcHandler):
         ), 0.0), 1.0)
         self._lock = threading.Lock()
         self._placements: Dict[str, str] = {}
+        # channel/stub construction has its own lock: concurrent handler
+        # threads race the first use of a replica (duplicate channels, one
+        # leaked unclosed), and _lock must stay free for placement scans
+        self._stub_lock = threading.Lock()
         self._channels: Dict[str, grpc.Channel] = {}
         self._stubs: Dict[Tuple[str, str], object] = {}
         self._breakers: Dict[str, retry.CircuitBreaker] = {
@@ -141,14 +145,15 @@ class FleetRouter(grpc.GenericRpcHandler):
 
     def _stub(self, rid: str, method: str):
         key = (rid, method)
-        stub = self._stubs.get(key)
-        if stub is None:
-            channel = self._channels.get(rid)
-            if channel is None:
-                channel = grpc.insecure_channel(self.addresses[rid])
-                self._channels[rid] = channel
-            stub = channel.unary_unary(f"/{SERVICE}/{method}")
-            self._stubs[key] = stub
+        with self._stub_lock:
+            stub = self._stubs.get(key)
+            if stub is None:
+                channel = self._channels.get(rid)
+                if channel is None:
+                    channel = grpc.insecure_channel(self.addresses[rid])
+                    self._channels[rid] = channel
+                stub = channel.unary_unary(f"/{SERVICE}/{method}")
+                self._stubs[key] = stub
         return stub
 
     # -- liveness + rebalance (lazy: piggybacked on routed requests) -----------
@@ -163,8 +168,13 @@ class FleetRouter(grpc.GenericRpcHandler):
             for t in dead_placements:
                 del self._placements[t]
         now = self.clock.now()
-        if now - self._last_rebalance >= self.rebalance_interval_s:
-            self._last_rebalance = now
+        with self._lock:
+            # claim the interval under the lock: two handler threads racing
+            # the same deadline must not both run a rebalance round
+            due = now - self._last_rebalance >= self.rebalance_interval_s
+            if due:
+                self._last_rebalance = now
+        if due:
             self._rebalance(alive)
         return alive, draining
 
@@ -351,10 +361,11 @@ class FleetRouter(grpc.GenericRpcHandler):
         })
 
     def close(self) -> None:
-        for channel in self._channels.values():
-            channel.close()
-        self._channels.clear()
-        self._stubs.clear()
+        with self._stub_lock:
+            for channel in self._channels.values():
+                channel.close()
+            self._channels.clear()
+            self._stubs.clear()
 
 
 def serve_router(fleet: FleetLocal, address: str = "127.0.0.1:0", *,

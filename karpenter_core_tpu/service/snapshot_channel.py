@@ -1304,7 +1304,8 @@ class SnapshotSolverService(grpc.GenericRpcHandler):
             }
             if recovered:
                 response["tenant"]["recovered"] = recovered
-                entry.recovered = None
+                with entry.lock:
+                    entry.recovered = None
             verdict = True
             plane.record_ok(entry)
             plane.observe_latencies(

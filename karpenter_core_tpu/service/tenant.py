@@ -658,7 +658,10 @@ class TenantEntry:
     breaker: retry.CircuitBreaker
     bucket: retry.RetryBudget
     shed_backoff: retry.Backoff
-    lock: threading.Lock = field(default_factory=threading.Lock)
+    # re-entrant: the serve path holds it across the whole solve, and the
+    # dispatch hook / checkpoint plane re-take it for their own field access
+    # so every entry-field touch is lexically locked (shared-state pass)
+    lock: threading.RLock = field(default_factory=threading.RLock)
     last_seen: float = 0.0
     supply_digest: Optional[str] = None
     last_batched: int = 1
@@ -762,7 +765,8 @@ class TenantPlane:
             kw.get("warm_carry") is not None
             and kw.get("repair_plan") is not None
         )
-        bypass = entry.bypass_coalescer or self._bypass_coalescer
+        with entry.lock:
+            bypass = entry.bypass_coalescer or self._bypass_coalescer
         fusable = not kw or (is_repair and self.config.coalesce_repairs)
         if bypass or not fusable:
             TENANT_DISPATCH.labels(tenant, "solo").inc()
@@ -773,7 +777,8 @@ class TenantPlane:
             prep, lambda: solver.run_prepared(prep, **kw),
             tenant=entry.tenant_id, kw=kw or None,
         )
-        entry.last_batched = batched
+        with entry.lock:
+            entry.last_batched = batched
         mode = "coalesced" if batched > 1 else "solo"
         TENANT_DISPATCH.labels(tenant, mode).inc()
         if is_repair:

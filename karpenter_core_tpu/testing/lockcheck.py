@@ -1,0 +1,391 @@
+"""Runtime lockset tracer: the dynamic half of the shared-state gate.
+
+The static ``shared-state`` pass (analysis/passes/shared_state.py) proves
+what it can see lexically; calls through callbacks, duck-typed hooks, and
+closures are invisible to it.  This module is the witness for that blind
+spot: while a :class:`LockCheck` is active it
+
+  - patches the ``threading.Lock`` / ``threading.RLock`` factories so every
+    lock constructed in the window is a traced proxy that maintains a
+    per-thread *held lockset*, and
+  - instruments watched classes (``__setattr__`` + ``__getattribute__``) so
+    every instance-field access records a ``(field, held lockset)``
+    observation.
+
+Observations feed the classic Eraser state machine per ``(object, field)``:
+Virgin -> Exclusive(first thread) -> Shared -> SharedModified, with the
+candidate lockset refined by intersection on every access.  A field reaches
+a *violation* when it is SharedModified (written with two or more threads
+involved) and the intersection is empty — some access pair shares no lock.
+Fields only ever touched by one thread (init-only, or genuinely
+thread-confined) never report, which is the runtime analogue of the static
+pass's init-only escape analysis.
+
+Opt-in: the chaos matrix and the fleet-failover soak enable the tracer when
+``KC_LOCKCHECK=1`` (see docs/CHAOS.md); tests can also use ``LockCheck``
+directly as a context manager around any threaded section.
+
+Scope and honesty:
+
+  - Only locks *constructed inside the window* are traced; module-level
+    locks created at import time are invisible (construct the system under
+    test inside the window — every test here does).
+  - ``with lock:`` never reaches a profile hook for ``__enter__`` on this
+    interpreter, which is why the proxies patch the factories instead of
+    ``sys.setprofile``: the proxy's own ``__enter__`` is the trace point.
+  - The instrumentation is deliberately heavyweight (every attribute access
+    takes the tracer's bookkeeping lock); it is a test harness, never a
+    production path.
+"""
+
+from __future__ import annotations
+
+import _thread
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple, Type
+
+__all__ = ["LockCheck", "LockCheckError", "Violation", "enabled"]
+
+# real primitive types, captured before any factory patching
+_REAL_LOCK_TYPES = (type(_thread.allocate_lock()), type(threading.RLock()))
+
+# Eraser states per (object, field)
+_VIRGIN = 0          # never accessed
+_EXCLUSIVE = 1       # one thread only — init / thread-confined
+_SHARED = 2          # read by a second thread, no writes since
+_SHARED_MODIFIED = 3  # written with >= 2 threads involved: lockset gates
+
+
+def enabled() -> bool:
+    """True when the opt-in suites should run under the tracer."""
+    return os.environ.get("KC_LOCKCHECK", "0") == "1"
+
+
+class LockCheckError(AssertionError):
+    """Raised by :meth:`LockCheck.assert_clean` — subclasses AssertionError
+    so a violation fails a pytest the same way a bare assert would."""
+
+
+@dataclass
+class Violation:
+    """A field some access pair touched with no common lock held."""
+
+    cls: str
+    fld: str
+    threads: int
+    writes: int
+    locksets: Tuple[FrozenSet[str], ...]  # distinct observed locksets
+
+    def render(self) -> str:
+        shapes = sorted(
+            "{" + ", ".join(sorted(s)) + "}" if s else "{}"
+            for s in self.locksets
+        )
+        return (
+            f"{self.cls}.{self.fld}: {self.threads} thread(s), "
+            f"{self.writes} write(s), empty lockset intersection "
+            f"(observed locksets: {', '.join(shapes)})"
+        )
+
+
+class _FieldState:
+    __slots__ = ("state", "first_thread", "threads", "writes",
+                 "lockset", "seen_locksets")
+
+    def __init__(self) -> None:
+        self.state = _VIRGIN
+        self.first_thread: Optional[int] = None
+        self.threads: Set[int] = set()
+        self.writes = 0
+        self.lockset: Optional[FrozenSet[str]] = None  # refined intersection
+        self.seen_locksets: Set[FrozenSet[str]] = set()
+
+
+class _Tracer:
+    """Held-lockset bookkeeping + the Eraser table.  The internal mutex is a
+    raw ``_thread`` lock so the tracer never observes itself."""
+
+    def __init__(self) -> None:
+        self._mu = _thread.allocate_lock()
+        self._held: Dict[int, Dict[str, int]] = {}  # thread id -> key -> depth
+        self._fields: Dict[Tuple[int, str], _FieldState] = {}
+        self._field_cls: Dict[Tuple[int, str], str] = {}
+        self._lock_seq = 0
+        self.active = False
+
+    # -- lock side ------------------------------------------------------------
+
+    def next_lock_key(self, kind: str) -> str:
+        with self._mu:
+            self._lock_seq += 1
+            return f"{kind}#{self._lock_seq}"
+
+    def on_acquire(self, key: str) -> None:
+        tid = _thread.get_ident()
+        with self._mu:
+            held = self._held.setdefault(tid, {})
+            held[key] = held.get(key, 0) + 1
+
+    def on_release(self, key: str) -> None:
+        tid = _thread.get_ident()
+        with self._mu:
+            held = self._held.get(tid)
+            if not held or key not in held:
+                return  # released on a thread that never traced the acquire
+            held[key] -= 1
+            if held[key] <= 0:
+                del held[key]
+
+    # -- field side -----------------------------------------------------------
+
+    def record(self, obj: object, fld: str, write: bool) -> None:
+        if not self.active:
+            return
+        tid = _thread.get_ident()
+        key = (id(obj), fld)
+        with self._mu:
+            lockset = frozenset(self._held.get(tid, ()))
+            st = self._fields.get(key)
+            if st is None:
+                st = self._fields[key] = _FieldState()
+                self._field_cls[key] = type(obj).__name__
+            st.threads.add(tid)
+            if write:
+                st.writes += 1
+            # Eraser transitions
+            if st.state == _VIRGIN:
+                st.state = _EXCLUSIVE
+                st.first_thread = tid
+            elif st.state == _EXCLUSIVE and tid != st.first_thread:
+                # standard Eraser: a second-thread READ of a field only the
+                # first thread wrote is read-sharing (publish-once), not yet
+                # a race; a second-thread WRITE gates immediately.  The
+                # candidate lockset starts at this first shared access, so
+                # lock-free init writes before the object escaped don't
+                # poison the intersection.
+                st.state = _SHARED_MODIFIED if write else _SHARED
+                st.lockset = None
+            elif st.state == _SHARED and write:
+                st.state = _SHARED_MODIFIED
+            if st.state in (_SHARED, _SHARED_MODIFIED):
+                st.seen_locksets.add(lockset)
+                st.lockset = (lockset if st.lockset is None
+                              else st.lockset & lockset)
+
+    def violations(self) -> List[Violation]:
+        with self._mu:
+            out = []
+            for key, st in self._fields.items():
+                if st.state == _SHARED_MODIFIED and not st.lockset:
+                    out.append(Violation(
+                        cls=self._field_cls[key], fld=key[1],
+                        threads=len(st.threads), writes=st.writes,
+                        locksets=tuple(sorted(st.seen_locksets,
+                                              key=sorted)),
+                    ))
+            out.sort(key=lambda v: (v.cls, v.fld))
+            return out
+
+    def observations(self) -> Dict[Tuple[str, str], Set[FrozenSet[str]]]:
+        """(class, field) -> distinct locksets observed while shared —
+        the raw evidence behind :meth:`violations`, exposed for tests."""
+        with self._mu:
+            return {
+                (self._field_cls[key], key[1]): set(st.seen_locksets)
+                for key, st in self._fields.items()
+                if st.seen_locksets
+            }
+
+
+def _unwrap(lock: object) -> object:
+    return lock._lc_lock if isinstance(lock, _TracedLock) else lock
+
+
+class _TracedLock:
+    """Delegating proxy around a real ``threading`` lock.  Implements the
+    private RLock protocol (``_is_owned`` etc.) so ``threading.Condition``
+    built on a traced lock keeps working — including updating the held set
+    across ``wait()``'s release/reacquire."""
+
+    __slots__ = ("_lc_lock", "_lc_tracer", "_lc_key")
+
+    def __init__(self, real: object, tracer: _Tracer, key: str) -> None:
+        self._lc_lock = real
+        self._lc_tracer = tracer
+        self._lc_key = key
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lc_lock.acquire(blocking, timeout)
+        if got:
+            self._lc_tracer.on_acquire(self._lc_key)
+        return got
+
+    def release(self) -> None:
+        self._lc_tracer.on_release(self._lc_key)
+        self._lc_lock.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: object) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lc_lock.locked()
+
+    # RLock protocol used by threading.Condition; plain locks lack it, so
+    # fall back exactly the way Condition itself would on a bare Lock
+    def _is_owned(self) -> bool:
+        inner = self._lc_lock
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self):  # Condition.wait: full release
+        self._lc_tracer.on_release(self._lc_key)
+        inner = self._lc_lock
+        if hasattr(inner, "_release_save"):
+            return inner._release_save()
+        inner.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:  # Condition.wait: reacquire
+        inner = self._lc_lock
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(state)
+        else:
+            inner.acquire()
+        self._lc_tracer.on_acquire(self._lc_key)
+
+    def __repr__(self) -> str:
+        return f"<traced {self._lc_key} of {self._lc_lock!r}>"
+
+
+class LockCheck:
+    """Context manager activating the tracer.
+
+    ::
+
+        with LockCheck(watch=(TenantEntry, CheckpointPlane)) as lc:
+            ...construct the system under test, run the threaded scenario...
+        lc.assert_clean()
+
+    ``watch`` classes get their ``__setattr__``/``__getattribute__``
+    instrumented for the duration; every ``threading.Lock()`` /
+    ``threading.RLock()`` constructed inside the window is traced.  Nesting
+    is not supported (one tracer owns the factories).
+    """
+
+    _active: Optional["LockCheck"] = None
+
+    def __init__(self, watch: Tuple[Type, ...] = ()) -> None:
+        self.tracer = _Tracer()
+        self._watch = tuple(watch)
+        self._saved_factories: Dict[str, object] = {}
+        self._saved_methods: List[Tuple[Type, str, object]] = []
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def __enter__(self) -> "LockCheck":
+        if LockCheck._active is not None:
+            raise RuntimeError("LockCheck does not nest")
+        LockCheck._active = self
+        tracer = self.tracer
+        self._saved_factories = {
+            "Lock": threading.Lock, "RLock": threading.RLock,
+        }
+        real_lock, real_rlock = threading.Lock, threading.RLock
+
+        def traced_lock():
+            return _TracedLock(real_lock(), tracer,
+                               tracer.next_lock_key("Lock"))
+
+        def traced_rlock():
+            return _TracedLock(real_rlock(), tracer,
+                               tracer.next_lock_key("RLock"))
+
+        threading.Lock = traced_lock  # type: ignore[assignment]
+        threading.RLock = traced_rlock  # type: ignore[assignment]
+        for cls in self._watch:
+            self._instrument(cls)
+        tracer.active = True
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.tracer.active = False
+        threading.Lock = self._saved_factories["Lock"]  # type: ignore
+        threading.RLock = self._saved_factories["RLock"]  # type: ignore
+        for cls, name, orig in reversed(self._saved_methods):
+            if orig is None:
+                try:
+                    delattr(cls, name)
+                except AttributeError:
+                    pass
+            else:
+                setattr(cls, name, orig)
+        self._saved_methods.clear()
+        LockCheck._active = None
+
+    # -- instrumentation ------------------------------------------------------
+
+    def _instrument(self, cls: Type) -> None:
+        tracer = self.tracer
+
+        orig_setattr = cls.__dict__.get("__setattr__")
+        base_setattr = cls.__setattr__
+
+        def traced_setattr(self, name, value):
+            if not name.startswith("_lc_") and not _is_lockish(value):
+                tracer.record(self, name, write=True)
+            base_setattr(self, name, value)
+
+        self._saved_methods.append((cls, "__setattr__", orig_setattr))
+        cls.__setattr__ = traced_setattr
+
+        orig_getattribute = cls.__dict__.get("__getattribute__")
+
+        def traced_getattribute(self, name):
+            value = object.__getattribute__(self, name)
+            if name.startswith("__") or name.startswith("_lc_"):
+                return value
+            try:
+                inst = object.__getattribute__(self, "__dict__")
+            except AttributeError:
+                return value
+            # only instance DATA fields count: methods/class attrs are not
+            # shared mutable state, and lock fields are the guards themselves
+            if name in inst and not _is_lockish(value):
+                tracer.record(self, name, write=False)
+            return value
+
+        self._saved_methods.append(
+            (cls, "__getattribute__", orig_getattribute))
+        cls.__getattribute__ = traced_getattribute
+
+    # -- results --------------------------------------------------------------
+
+    def violations(self) -> List[Violation]:
+        return self.tracer.violations()
+
+    def observations(self):
+        return self.tracer.observations()
+
+    def assert_clean(self) -> None:
+        bad = self.violations()
+        if bad:
+            lines = "\n  ".join(v.render() for v in bad)
+            raise LockCheckError(
+                f"lockcheck: {len(bad)} field(s) with an empty lockset "
+                f"intersection across a shared access pair:\n  {lines}"
+            )
+
+
+def _is_lockish(value: object) -> bool:
+    return isinstance(value, (_TracedLock,) + _REAL_LOCK_TYPES) or \
+        isinstance(value, (threading.Condition, threading.Event,
+                           threading.Semaphore))

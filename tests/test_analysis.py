@@ -1361,3 +1361,362 @@ class TestUnboundedBlock:
             unbounded_block.run(repo_project), baseline
         )
         assert kept == [], [f.render() for f in kept]
+
+
+# -- shared-state (lockset inference) -----------------------------------------
+
+
+class TestSharedState:
+    """shared-state: per-method lockset inference over lock-owning classes in
+    the concurrency-bearing subtrees.  Fragments live under badpkg/service/
+    because the pass scopes itself to the subtrees that actually run
+    threaded (service/, fleet/, state/, solver/incremental.py,
+    utils/compilecache.py)."""
+
+    def _run(self, tmp_path, files):
+        from karpenter_core_tpu.analysis.passes import shared_state
+
+        return shared_state.run(make_project(tmp_path, files))
+
+    def test_unguarded_field_fires_at_the_lock_free_site(self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/service/plane.py": """
+                import threading
+
+                class Plane:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.count = 0
+
+                    def bump(self):
+                        with self._lock:
+                            self.count += 1
+
+                    def reset(self):
+                        self.count = 0
+            """,
+        })
+        assert rules_of(found) == {"unguarded-field"}
+        (f,) = found
+        assert f.symbol == "Plane.reset"
+        assert "count" in f.detail
+
+    def test_two_lock_field_is_mixed_guard(self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/service/plane.py": """
+                import threading
+
+                class Plane:
+                    def __init__(self):
+                        self._a = threading.Lock()
+                        self._b = threading.Lock()
+                        self.val = 0
+
+                    def left(self):
+                        with self._a:
+                            self.val += 1
+
+                    def right(self):
+                        with self._b:
+                            self.val += 1
+            """,
+        })
+        assert rules_of(found) == {"mixed-guard"}
+        assert "no single lock" in found[0].detail
+
+    def test_init_only_field_is_silent(self, tmp_path):
+        """Escape analysis: a field written only during __init__ (before the
+        object is reachable by any other thread) and read lock-free after
+        publication is the immutable-config idiom, not a race."""
+        found = self._run(tmp_path, {
+            "badpkg/service/plane.py": """
+                import threading
+
+                class Plane:
+                    def __init__(self, cfg):
+                        self._lock = threading.Lock()
+                        self.cfg = dict(cfg)
+                        self.hits = 0
+
+                    def lookup(self, key):
+                        return self.cfg[key]
+
+                    def record(self):
+                        with self._lock:
+                            self.hits += 1
+            """,
+        })
+        assert found == []
+
+    def test_thread_target_makes_private_method_reachable(self, tmp_path):
+        """A private method is exempt until something makes it a thread
+        entry point: Thread(target=self._pump) seeds it, so its lock-free
+        write fires; the never-referenced private twin stays silent."""
+        found = self._run(tmp_path, {
+            "badpkg/service/plane.py": """
+                import threading
+
+                class Plane:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.jobs = 0
+
+                    def start(self):
+                        t = threading.Thread(target=self._pump)
+                        t.start()
+
+                    def submit(self):
+                        with self._lock:
+                            self.jobs += 1
+
+                    def _pump(self):
+                        self.jobs = 0
+
+                    def _never_called(self):
+                        self.jobs = -1
+            """,
+        })
+        assert rules_of(found) == {"unguarded-field"}
+        assert [f.symbol for f in found] == ["Plane._pump"]
+
+    def test_lock_free_container_swap_is_unlocked_publication(self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/service/plane.py": """
+                import threading
+
+                class Plane:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.items = []
+
+                    def add(self, x):
+                        with self._lock:
+                            self.items.append(x)
+
+                    def clear_all(self):
+                        self.items = []
+            """,
+        })
+        assert rules_of(found) == {"unlocked-publication"}
+        assert found[0].symbol == "Plane.clear_all"
+
+    def test_helper_inherits_caller_lockset(self, tmp_path):
+        """Interprocedural half: a private helper called only from inside
+        ``with self._lock:`` runs with that lockset, so its accesses are
+        guarded even though the helper itself names no lock."""
+        found = self._run(tmp_path, {
+            "badpkg/service/plane.py": """
+                import threading
+
+                class Plane:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.count = 0
+
+                    def bump(self):
+                        with self._lock:
+                            self._bump_locked()
+
+                    def drain(self):
+                        with self._lock:
+                            self._bump_locked()
+
+                    def _bump_locked(self):
+                        self.count += 1
+            """,
+        })
+        assert found == []
+
+    def test_out_of_scope_subtree_is_exempt(self, tmp_path):
+        """The same unguarded pattern outside the concurrency-bearing
+        subtrees (a controller that runs single-threaded) is not scanned."""
+        found = self._run(tmp_path, {
+            "badpkg/controllers/loop.py": """
+                import threading
+
+                class Loop:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.count = 0
+
+                    def bump(self):
+                        with self._lock:
+                            self.count += 1
+
+                    def reset(self):
+                        self.count = 0
+            """,
+        })
+        assert found == []
+
+    def test_current_tree_clean(self, repo_project):
+        from karpenter_core_tpu.analysis.passes import shared_state
+
+        kept = shared_state.run(repo_project)
+        assert kept == [], [f.render() for f in kept]
+
+
+# -- env-flags (KC_* registry) ------------------------------------------------
+
+
+class TestEnvFlags:
+    """env-flags: every KC_* environment read must appear in the central
+    registry (utils/flags.py FLAGS) and the docs table (docs/FLAGS.md);
+    registry rows nothing reads are dead."""
+
+    def _run(self, tmp_path, files):
+        from karpenter_core_tpu.analysis.passes import env_flags
+
+        return env_flags.run(make_project(tmp_path, files))
+
+    _REGISTRY = """
+        FLAGS = {
+            "KC_RATE": "per-tenant refill rate",
+        }
+    """
+
+    def test_unregistered_read_fires_at_the_read_site(self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/svc.py": """
+                import os
+                TIMEOUT = os.getenv("KC_TIMEOUT_S", "5")
+            """,
+            "badpkg/utils/flags.py": self._REGISTRY,
+        })
+        rules = [(f.path, f.rule) for f in found]
+        assert ("badpkg/svc.py", "unregistered-read") in rules
+        assert any("KC_TIMEOUT_S" in f.detail for f in found)
+
+    def test_dead_entry_and_undocumented_fire_at_the_registry_row(
+            self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/utils/flags.py": self._REGISTRY,
+        })
+        rules = rules_of(found)
+        assert "dead-entry" in rules          # nothing reads KC_RATE
+        assert "undocumented-flag" in rules   # no docs/FLAGS.md row
+        assert all(f.path == "badpkg/utils/flags.py" for f in found)
+
+    def test_registered_documented_read_is_clean(self, tmp_path):
+        project = make_project(tmp_path, {
+            "badpkg/svc.py": """
+                import os
+                RATE = os.getenv("KC_RATE", "1")
+            """,
+            "badpkg/utils/flags.py": self._REGISTRY,
+        })
+        docs = tmp_path / "docs" / "FLAGS.md"
+        docs.parent.mkdir(parents=True, exist_ok=True)
+        docs.write_text("| `KC_RATE` | refill rate |\n")
+        from karpenter_core_tpu.analysis.passes import env_flags
+
+        assert env_flags.run(project) == []
+
+    def test_helper_indirection_counts_as_a_read(self, tmp_path):
+        """Reads through a local env helper (``_env("KC_X")`` where the
+        helper's parameter flows into os.getenv) must resolve, in both
+        directions: the flag is not dead, and an unregistered flag read
+        through the helper still fires."""
+        found = self._run(tmp_path, {
+            "badpkg/cfg.py": """
+                import os
+
+                def _env(name, default=""):
+                    return os.getenv(name, default)
+
+                RATE = _env("KC_RATE")
+                BURST = _env("KC_BURST")
+            """,
+            "badpkg/utils/flags.py": self._REGISTRY,
+        })
+        rules = [(f.rule, f.detail.split()[0]) for f in found]
+        assert ("unregistered-read", "KC_BURST") in rules
+        assert ("dead-entry", "registry") not in [
+            (r, d) for r, d in rules if "KC_RATE" in d
+        ]
+
+    def test_environ_subscript_and_membership_are_reads(self, tmp_path):
+        found = self._run(tmp_path, {
+            "badpkg/svc.py": """
+                import os
+                A = os.environ["KC_A"]
+                B = "KC_B" in os.environ
+            """,
+            "badpkg/utils/flags.py": self._REGISTRY,
+        })
+        flagged = {f.detail.split()[0] for f in found
+                   if f.rule == "unregistered-read"}
+        assert flagged == {"KC_A", "KC_B"}
+
+    def test_current_tree_clean(self, repo_project):
+        from karpenter_core_tpu.analysis.passes import env_flags
+
+        kept = env_flags.run(repo_project)
+        assert kept == [], [f.render() for f in kept]
+
+
+# -- driver: --json and --strict ----------------------------------------------
+
+
+class TestDriverJsonStrict:
+    def test_json_report_on_clean_tree(self, tmp_path):
+        make_project(tmp_path, {
+            "badpkg/ok.py": "def f(x):\n    return x\n",
+        })
+        proc = run_driver(tmp_path, "--json")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)  # stdout must be pure JSON
+        assert report["ok"] is True
+        assert report["findings"] == []
+        assert report["total_s"] > 0
+        names = {p["name"] for p in report["passes"]}
+        assert {"shared-state", "env-flags", "lock-order"} <= names
+        for p in report["passes"]:
+            assert p["seconds"] >= 0
+
+    def test_json_report_carries_findings(self, tmp_path):
+        make_project(tmp_path, {
+            "badpkg/service/plane.py": textwrap.dedent("""
+                import threading
+
+                class Plane:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.count = 0
+
+                    def bump(self):
+                        with self._lock:
+                            self.count += 1
+
+                    def reset(self):
+                        self.count = 0
+            """),
+        })
+        proc = run_driver(tmp_path, "--json")
+        assert proc.returncode == 1
+        report = json.loads(proc.stdout)
+        assert report["ok"] is False
+        assert any(
+            f["pass"] == "shared-state" and f["rule"] == "unguarded-field"
+            for f in report["findings"]
+        )
+
+    def test_strict_turns_unused_baseline_into_failure(self, tmp_path):
+        files = {
+            "badpkg/ok.py": "def f(x):\n    return x\n",
+            "badpkg/analysis/baseline.toml": """\
+                [[suppress]]
+                pass = "hygiene"
+                rule = "tabs"
+                file = "badpkg/gone.py"
+                reason = "stale: the offending file was deleted"
+            """,
+        }
+        make_project(tmp_path, files)
+        lax = run_driver(tmp_path)
+        assert lax.returncode == 0, lax.stdout + lax.stderr
+        assert "WARNING unused baseline entry" in lax.stderr
+        strict = run_driver(tmp_path, "--strict")
+        assert strict.returncode == 1, strict.stdout + strict.stderr
+        assert "ERROR unused baseline entry" in strict.stderr
+        assert "FAIL" in strict.stdout

@@ -691,3 +691,101 @@ class TestSeedReplay:
             result = _run_flake_scenario(seed)
             assert result["rounds"] <= 30
             assert result["nodes"] >= 1
+
+
+# -- runtime lockset tracer (docs/CHAOS.md "Lockset tracing") ------------------
+
+
+import threading
+
+from karpenter_core_tpu.testing import lockcheck as lockcheck_mod
+from karpenter_core_tpu.testing.lockcheck import LockCheck, LockCheckError
+
+
+class _SeededPlane:
+    """A deliberately racy fixture class: ``count`` is hammered lock-free by
+    worker threads while ``guarded`` takes the lock — the tracer must flag
+    exactly the former."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.count = 0
+        self.guarded = 0
+
+
+def _hammer(plane, racy_rounds=200):
+    def racy():
+        for _ in range(racy_rounds):
+            plane.count = plane.count + 1
+
+    def safe():
+        for _ in range(racy_rounds):
+            with plane.lock:
+                plane.guarded = plane.guarded + 1
+
+    threads = [threading.Thread(target=racy) for _ in range(2)]
+    threads += [threading.Thread(target=safe) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+class TestLockcheck:
+    """testing/lockcheck.py: the runtime half of the shared-state gate.  The
+    static pass proves what it can see lexically; the tracer witnesses the
+    callback/duck-typed paths it cannot, by recording (field, lockset)
+    observations and failing on an empty intersection across a shared
+    access pair."""
+
+    def test_seeded_unguarded_write_is_caught(self):
+        with LockCheck(watch=(_SeededPlane,)) as lc:
+            _hammer(_SeededPlane())
+        violations = lc.violations()
+        assert any(v.fld == "count" for v in violations), violations
+        bad = next(v for v in violations if v.fld == "count")
+        assert bad.threads >= 2 and bad.writes > 0
+        # the raw evidence: count was observed shared with an empty lockset
+        obs = lc.observations()
+        assert frozenset() in obs[("_SeededPlane", "count")]
+
+    def test_guarded_twin_is_clean(self):
+        with LockCheck(watch=(_SeededPlane,)) as lc:
+            _hammer(_SeededPlane())
+        assert not any(v.fld == "guarded" for v in lc.violations())
+
+    def test_assert_clean_raises_naming_the_field(self):
+        with LockCheck(watch=(_SeededPlane,)) as lc:
+            _hammer(_SeededPlane())
+        with pytest.raises(LockCheckError, match=r"_SeededPlane\.count"):
+            lc.assert_clean()
+
+    def test_single_thread_and_init_writes_never_report(self):
+        """Thread-confined state and publish-once init writes are the
+        runtime analogue of the static pass's init-only escape — silent."""
+        with LockCheck(watch=(_SeededPlane,)) as lc:
+            p = _SeededPlane()
+            for _ in range(100):
+                p.count = p.count + 1  # one thread only
+        assert lc.violations() == []
+
+    def test_factories_are_restored_on_exit(self):
+        orig_lock, orig_rlock = threading.Lock, threading.RLock
+        with LockCheck():
+            assert threading.Lock is not orig_lock
+        assert threading.Lock is orig_lock
+        assert threading.RLock is orig_rlock
+
+    def test_flake_scenario_under_tracer_opt_in(self):
+        """The chaos matrix's KC_LOCKCHECK=1 opt-in: the seeded flake
+        scenario replays under the tracer watching the tenant service
+        classes, and must come back violation-free; without the env the
+        same scenario runs untraced (the tier-1 default)."""
+        if not lockcheck_mod.enabled():
+            _run_flake_scenario(1729)
+            return
+        from karpenter_core_tpu.service.tenant import TenantEntry, TenantPlane
+
+        with LockCheck(watch=(TenantPlane, TenantEntry)) as lc:
+            _run_flake_scenario(1729)
+        lc.assert_clean()

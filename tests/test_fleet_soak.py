@@ -9,15 +9,29 @@ answers and 0 machine leaks fleet-wide.  Wired into ``make soak``; the
 tier-1 smoke runs the same multi-process topology scaled down.
 """
 
+import contextlib
 import os
 
 import pytest
 
 from karpenter_core_tpu.soak.tenants import FleetSoakScenario, run_fleet_failover
+from karpenter_core_tpu.testing import lockcheck as lockcheck_mod
 
 
 def _seed() -> int:
     return int(os.environ.get("KC_SOAK_SEED", "1729"))
+
+
+def _maybe_lockcheck():
+    """KC_LOCKCHECK=1 runs the soak's in-process side (the router the
+    replica subprocesses sit behind) under the runtime lockset tracer
+    (docs/CHAOS.md "Lockset tracing"); otherwise a no-op context."""
+    if not lockcheck_mod.enabled():
+        return contextlib.nullcontext(None)
+    from karpenter_core_tpu.fleet.checkpoint import CheckpointPlane
+    from karpenter_core_tpu.fleet.router import FleetRouter
+
+    return lockcheck_mod.LockCheck(watch=(FleetRouter, CheckpointPlane))
 
 
 def _assert_fleet_verdict(report: dict) -> None:
@@ -43,15 +57,18 @@ class TestFleetFailoverSmoke:
     SIGKILL) at the smallest churn that still proves warm failover."""
 
     def test_fleet_failover_smoke(self, tmp_path):
-        report = run_fleet_failover(
-            FleetSoakScenario(
-                replicas=3, tenants=4, rounds=2, kill_after_round=0,
-                pods_per_tenant=6,
-            ),
-            seed=_seed(),
-            fleet_dir=str(tmp_path / "fleet"),
-        )
+        with _maybe_lockcheck() as lc:
+            report = run_fleet_failover(
+                FleetSoakScenario(
+                    replicas=3, tenants=4, rounds=2, kill_after_round=0,
+                    pods_per_tenant=6,
+                ),
+                seed=_seed(),
+                fleet_dir=str(tmp_path / "fleet"),
+            )
         _assert_fleet_verdict(report)
+        if lc is not None:
+            lc.assert_clean()
         # tools/soak.py renders this report with the same verdict-line code
         # path as every other scenario — pin the fields it reads
         verdict = report["verdict"]

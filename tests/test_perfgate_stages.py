@@ -107,3 +107,36 @@ def test_records_predating_the_split_are_skipped():
     cur = {"solve_decode_s": 1.0, "solve_s": 0.5, "decode_s": 0.5}
     rows = pg.compare_stages(cur, prev, tol=0.25)
     assert [row[0] for row in rows] == ["solve_decode_s"]
+
+
+def test_analysis_budget_gate_passes_and_fails_on_total(monkeypatch, capsys):
+    """gate_analysis_budget: the static-analysis suite's wall time gates
+    like any other perf stage — under the 30 s presubmit budget passes,
+    over it fails, and a broken kcanalyze --json report is a hard fail."""
+    import json
+    import subprocess
+
+    pg = _load_perfgate()
+
+    class _Proc:
+        def __init__(self, out, rc=0):
+            self.stdout, self.stderr, self.returncode = out, "", rc
+
+    report = {
+        "ok": True, "files": 200, "total_s": 5.0,
+        "passes": [{"name": "lock-order", "seconds": 2.0,
+                    "findings": 0, "suppressed": 0}],
+    }
+    monkeypatch.setattr(
+        subprocess, "run", lambda *a, **k: _Proc(json.dumps(report)))
+    assert pg.gate_analysis_budget() == 0
+    assert "budget 30s" in capsys.readouterr().out
+
+    report["total_s"] = 31.0
+    assert pg.gate_analysis_budget() == 1
+    assert "blew the 30s presubmit budget" in capsys.readouterr().out
+
+    monkeypatch.setattr(
+        subprocess, "run", lambda *a, **k: _Proc("not json", rc=2))
+    assert pg.gate_analysis_budget() == 1
+    assert "produced no report" in capsys.readouterr().out

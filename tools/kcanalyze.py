@@ -9,14 +9,16 @@ suite is < 30 s; in practice it runs in well under 5 s).
 
 Exit status: 1 when any unsuppressed finding (or a malformed baseline, or a
 file that fails to parse) survives; 0 otherwise.  Unused baseline entries
-are reported as warnings, not failures — prune them when the underlying
-code moves.
+are reported as warnings by default and become failures under --strict
+(the `make verify` mode) so stale suppressions cannot accumulate.
 
 Usage:
     python tools/kcanalyze.py                  # whole repo, all passes
     python tools/kcanalyze.py --pass lock-order --pass trace-safety
     python tools/kcanalyze.py --root /tmp/tree --package badpkg
     python tools/kcanalyze.py --baseline none  # ignore suppressions
+    python tools/kcanalyze.py --strict         # stale baseline entries fail
+    python tools/kcanalyze.py --json           # machine-readable report
     python tools/kcanalyze.py --list           # show available passes
 
 See docs/ANALYSIS.md for the pass catalog and baseline policy.
@@ -61,7 +63,14 @@ def main(argv=None) -> int:
     ap.add_argument("--list", action="store_true", help="list passes and exit")
     ap.add_argument("--verbose", action="store_true",
                     help="also print suppressed findings with their reasons")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object on stdout (findings, per-pass "
+                         "timings, total_s) instead of human-readable lines")
+    ap.add_argument("--strict", action="store_true",
+                    help="unused baseline entries fail the run instead of "
+                         "warning (the `make verify` mode)")
     args = ap.parse_args(argv)
+    say = (lambda *a, **k: None) if args.json else print
 
     if args.list:
         for p in ALL_PASSES:
@@ -105,8 +114,8 @@ def main(argv=None) -> int:
     all_kept = list(project.errors)  # parse failures are findings
     n_suppressed = 0
     timings = []
-    print(f"kcanalyze: loaded {len(project.all_modules)} file(s) "
-          f"in {load_s:.2f}s")
+    say(f"kcanalyze: loaded {len(project.all_modules)} file(s) "
+        f"in {load_s:.2f}s")
     for p in selected:
         t1 = time.perf_counter()
         found = p.run(project)
@@ -117,38 +126,68 @@ def main(argv=None) -> int:
         n_suppressed += len(suppressed)
         if args.verbose:
             for f, reason in suppressed:
-                print(f"suppressed: {f.render()}  # {reason}")
+                say(f"suppressed: {f.render()}  # {reason}")
 
-    for f in sorted(all_kept, key=lambda f: (f.path, f.line, f.pass_name, f.rule)):
-        print(f.render())
+    all_kept.sort(key=lambda f: (f.path, f.line, f.pass_name, f.rule))
+    for f in all_kept:
+        say(f.render())
 
     selected_names = {p.NAME for p in selected}
+    unused_entries = []
     for entry in baseline.unused():
         # under --pass only entries scoped to a selected pass are judged:
         # a retrace-budget suppression is not "unused" because this run
         # only executed lock-order
         if entry.get("pass") is not None and entry["pass"] not in selected_names:
             continue
-        print(
-            "kcanalyze: WARNING unused baseline entry at "
-            f"{baseline.path}:{entry.get('_line', 0)} "
-            f"(pass={entry.get('pass')!r} rule={entry.get('rule')!r} "
-            f"file={entry.get('file')!r}) — prune it",
-            file=sys.stderr,
-        )
+        unused_entries.append(entry)
+        severity = "ERROR" if args.strict else "WARNING"
+        if not args.json:
+            print(
+                f"kcanalyze: {severity} unused baseline entry at "
+                f"{baseline.path}:{entry.get('_line', 0)} "
+                f"(pass={entry.get('pass')!r} rule={entry.get('rule')!r} "
+                f"file={entry.get('file')!r}) — prune it",
+                file=sys.stderr,
+            )
 
+    failed = bool(all_kept) or (args.strict and bool(unused_entries))
     total_s = time.perf_counter() - t0
     for name, secs, n_found, n_supp in timings:
         extra = f", {n_supp} suppressed" if n_supp else ""
-        print(f"kcanalyze: pass {name}: {n_found} finding(s){extra} "
-              f"in {secs:.2f}s")
-    verdict = "FAIL" if all_kept else "OK"
-    print(
+        say(f"kcanalyze: pass {name}: {n_found} finding(s){extra} "
+            f"in {secs:.2f}s")
+    verdict = "FAIL" if failed else "OK"
+    say(
         f"kcanalyze: {verdict} — {len(selected)} pass(es), "
         f"{len(all_kept)} finding(s), {n_suppressed} suppressed, "
         f"{len(project.all_modules)} file(s) in {total_s:.2f}s"
     )
-    return 1 if all_kept else 0
+    if args.json:
+        import json
+        print(json.dumps({
+            "ok": not failed,
+            "files": len(project.all_modules),
+            "load_s": round(load_s, 4),
+            "total_s": round(total_s, 4),
+            "suppressed": n_suppressed,
+            "passes": [
+                {"name": name, "seconds": round(secs, 4),
+                 "findings": n_found, "suppressed": n_supp}
+                for name, secs, n_found, n_supp in timings
+            ],
+            "findings": [
+                {"path": f.path, "line": f.line, "pass": f.pass_name,
+                 "rule": f.rule, "detail": f.detail, "symbol": f.symbol}
+                for f in all_kept
+            ],
+            "unused_baseline": [
+                {"line": e.get("_line", 0), "pass": e.get("pass"),
+                 "rule": e.get("rule"), "file": e.get("file")}
+                for e in unused_entries
+            ],
+        }, indent=2))
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

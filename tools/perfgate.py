@@ -162,6 +162,42 @@ def compare_stages(detail: dict, prev_detail: dict, tol: float):
     return rows
 
 
+def gate_analysis_budget(budget_s: float = 30.0) -> int:
+    """The static-analysis suite rides every presubmit (`make verify`
+    runs kcanalyze --strict), so its wall time is a perf surface like any
+    other stage: hard-fail when the whole pass suite blows the 30 s
+    presubmit budget.  Runs ``kcanalyze --json`` in a subprocess — running
+    the passes in-process would hide their real cold-start cost behind this
+    process's already-warm imports."""
+    import subprocess
+
+    cmd = [sys.executable, os.path.join(REPO, "tools", "kcanalyze.py"),
+           "--json"]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    try:
+        report = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        print("perfgate: FAIL kcanalyze --json produced no report "
+              f"(rc={proc.returncode}): {proc.stderr.strip()[:200]}")
+        return 1
+    total = float(report.get("total_s") or 0.0)
+    slowest = sorted(report.get("passes", ()),
+                     key=lambda p: -p["seconds"])[:3]
+    names = ", ".join(f"{p['name']} {p['seconds']:.1f}s" for p in slowest)
+    print(f"perfgate: analysis suite {total:.1f}s over "
+          f"{report.get('files')} file(s) "
+          f"(budget {budget_s:.0f}s; slowest: {names})")
+    if not report.get("ok", False):
+        print("perfgate: note kcanalyze reported findings — `make verify` "
+              "gates those; this stage only gates the time budget")
+    if total >= budget_s:
+        print(f"perfgate: FAIL analysis suite {total:.1f}s blew the "
+              f"{budget_s:.0f}s presubmit budget — a pass went quadratic "
+              "(per-pass timings above point at the culprit)")
+        return 1
+    return 0
+
+
 def warn_compile_budget(detail: dict) -> None:
     """Advisory tie between the static retrace budget and the measured run:
     warn when the bench's observed XLA compile count exceeds the manifest's
@@ -609,6 +645,7 @@ def main() -> int:
                     help="also write the fresh bench line to this path")
     args = ap.parse_args()
 
+    analysis_rc = gate_analysis_budget()
     rec = run_bench()
     detail = rec.get("detail") or {}
     platform = detail.get("platform")
@@ -636,7 +673,7 @@ def main() -> int:
     if prior is None:
         print(f"perfgate: PASS (no prior {platform} record; "
               f"current {pods_per_sec} pods/s)")
-        return 0
+        return analysis_rc
     rnd, path, prev = prior
     prev_detail = prev.get("detail") or {}
     prev_pps = prev_detail["pods_per_sec"]
@@ -672,7 +709,7 @@ def main() -> int:
     if verdict == "WARN":
         print("perfgate: advisory mode — drift does not fail presubmit "
               "(KC_PERF_GATE_STRICT=1 to enforce)")
-    return 1 if verdict == "FAIL" else 0
+    return 1 if (verdict == "FAIL" or analysis_rc) else 0
 
 
 if __name__ == "__main__":
