@@ -97,10 +97,14 @@ class FleetRestoreError(Exception):
 def _nt_registry() -> Dict[str, type]:
     from karpenter_core_tpu.ops import masks as mask_ops
     from karpenter_core_tpu.ops import solve as solve_ops
+    from karpenter_core_tpu.policy.planes import ObjectivePlanes
     from karpenter_core_tpu.solver.tpu import SolvePrep
 
     classes = (
         SolvePrep,
+        # SolvePrep.pol (the relax family's objective sheet) rides the
+        # checkpointed prep when present
+        ObjectivePlanes,
         mask_ops.ReqTensor,
         solve_ops.ClassTensors,
         solve_ops.StaticArrays,

@@ -1,0 +1,19 @@
+"""The relaxation solver family (``KC_SOLVER_MODE=relax``, docs/RELAX.md).
+
+A second solver family next to the exact greedy-by-priority scan kernel
+(ops/solve.py): pod-class -> (instance type, zone, capacity type) placement
+formulated as a continuous relaxation over the SAME encoded planes the scan
+consumes — decision tensor x[C, I, Z] with class counts as simplex
+constraints, the packed-mask / capacity / offering predicates as the
+support, and the policy objective planes (policy/planes.py) as the linear
+cost — solved by a projected-gradient loop inside one pure-jnp
+``lax.while_loop`` jit (relax/kernel.py), rounded deterministically
+(largest fraction first, seeded tie order), audited against the exact
+predicate planes, and repaired by the existing warm-start scan machinery
+(relax/solve.py).  Approximate in cost, never wrong in placement.
+"""
+
+from karpenter_core_tpu.relax.kernel import RelaxResult, relax_core
+from karpenter_core_tpu.relax.solve import RelaxFallback, run_relax
+
+__all__ = ["RelaxResult", "RelaxFallback", "relax_core", "run_relax"]

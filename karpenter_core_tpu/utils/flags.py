@@ -37,6 +37,9 @@ FLAGS: Dict[str, str] = {
     "KC_DELTA_MAX_FRACTION": "churn fraction above which a delta solve falls back to full",
     "KC_DELTA_AUDIT_INTERVAL": "full-solve audit cadence for long delta chains",
     "KC_DEGRADED_MAX_PODS": "pod-count ceiling for the degraded (host fallback) solve path",
+    "KC_SOLVER_MODE": "solver family routing: scan | relax | auto (PolicyConfig/provisioner spec wins over env)",
+    "KC_RELAX_MAX_ITERS": "projected-gradient iteration cap for the relax solver family",
+    "KC_RELAX_MIN_PODS": "pod-count threshold above which auto mode picks the relax family",
     # -- backend probe + watchdog ---------------------------------------------
     "KC_PROBE_TIMEOUT_S": "accelerator backend probe deadline",
     "KC_PROBE_LIVENESS_TIMEOUT_S": "liveness pre-check deadline before the full backend probe",

@@ -1,0 +1,341 @@
+"""Relaxation solver family (ISSUE 20, docs/RELAX.md): mode routing, the
+projected-gradient + rounding + exact-audit pipeline, the convergence
+fallback, fleet-cost parity vs the greedy scan, mesh determinism, and the
+incremental session's mode-changed escalation.
+
+The family's contract under test: "approximate in cost, never wrong in
+placement" — every pod a relax solve commits must pass the scan kernel's own
+exact predicates (fuzzed through the host validator below), and every pod it
+cannot model lands in the exact repair pass or falls the whole batch back to
+the scan with a structured reason.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.cloudprovider import fake as fake_cp
+from karpenter_core_tpu.policy import PolicyConfig
+from karpenter_core_tpu.solver import modes
+from karpenter_core_tpu.solver.incremental import (
+    MODE_FULL,
+    SOLVE_MODE,
+    FallbackPolicy,
+)
+from karpenter_core_tpu.solver.tpu import TPUSolver
+from karpenter_core_tpu.testing import make_node, make_pods, make_provisioner
+
+SEED = 20260807
+
+
+def _mode_count(mode: str) -> float:
+    for _name, labels, value in SOLVE_MODE.samples():
+        if labels.get("mode") == mode:
+            return value
+    return 0.0
+
+
+def _solver(mode="relax", n_its=8, skew=True):
+    """A TPUSolver over the skewed fake catalog with the family pinned via
+    the policy spec (spec wins over env, so ambient KC_SOLVER_MODE can't
+    leak into these fixtures)."""
+    provider = fake_cp.FakeCloudProvider(fake_cp.instance_types(n_its))
+    if skew:
+        # zone-2 spot at 40% off: the optimum hides off the provider's
+        # first-listed offerings, so index-order placement loses on price
+        for it in provider.get_instance_types(None):
+            provider.set_price(it.name, it.offerings[0].price * 0.6,
+                               capacity_type="spot", zone="test-zone-2")
+    policy = PolicyConfig(enabled=True, solver_mode=mode)
+    return provider, TPUSolver(
+        provider, [make_provisioner(name="default")], policy=policy
+    )
+
+
+# -- mode routing --------------------------------------------------------------
+
+
+class TestModeRouting:
+    def test_default_is_scan(self, monkeypatch):
+        monkeypatch.delenv("KC_SOLVER_MODE", raising=False)
+        assert modes.resolve_mode(None) == modes.MODE_SCAN
+
+    def test_env_routes(self, monkeypatch):
+        monkeypatch.setenv("KC_SOLVER_MODE", "relax")
+        assert modes.resolve_mode(None) == modes.MODE_RELAX
+        monkeypatch.setenv("KC_SOLVER_MODE", "auto")
+        assert modes.resolve_mode(None) == modes.MODE_AUTO
+
+    def test_spec_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("KC_SOLVER_MODE", "relax")
+        assert modes.resolve_mode(PolicyConfig(solver_mode="scan")) \
+            == modes.MODE_SCAN
+        monkeypatch.setenv("KC_SOLVER_MODE", "scan")
+        assert modes.resolve_mode(PolicyConfig(solver_mode="relax")) \
+            == modes.MODE_RELAX
+        # empty spec defers to the env
+        assert modes.resolve_mode(PolicyConfig(solver_mode="")) \
+            == modes.MODE_SCAN
+
+    def test_unknown_mode_degrades_to_scan(self, monkeypatch):
+        """The kill-switch semantics: a typo'd family never routes anywhere
+        unintended."""
+        monkeypatch.setenv("KC_SOLVER_MODE", "simplex")
+        assert modes.resolve_mode(None) == modes.MODE_SCAN
+        assert modes.resolve_mode(PolicyConfig(solver_mode="lp")) \
+            == modes.MODE_SCAN
+
+    def test_auto_threshold(self, monkeypatch):
+        monkeypatch.setenv("KC_RELAX_MIN_PODS", "100")
+        assert not modes.relax_selected(modes.MODE_AUTO, 99)
+        assert modes.relax_selected(modes.MODE_AUTO, 100)
+        assert modes.relax_selected(modes.MODE_RELAX, 1)
+        assert not modes.relax_selected(modes.MODE_SCAN, 10 ** 9)
+        monkeypatch.setenv("KC_RELAX_MIN_PODS", "bogus")
+        assert modes.relax_min_pods() == 4096
+
+    def test_max_iters_env(self, monkeypatch):
+        monkeypatch.setenv("KC_RELAX_MAX_ITERS", "7")
+        assert modes.relax_max_iters() == 7
+        monkeypatch.setenv("KC_RELAX_MAX_ITERS", "bogus")
+        assert modes.relax_max_iters() == 64
+
+
+# -- the relax pipeline end to end ---------------------------------------------
+
+
+class TestRelaxSolve:
+    def test_relax_places_on_the_skewed_optimum(self):
+        """The routed family solves the batch, commits every pod, and its
+        decode lands on the same zone-2-spot argmin the policy stage pins
+        for the scan — placement exactness is not mode-dependent."""
+        before = _mode_count("relax")
+        _, solver = _solver("relax")
+        results = solver.solve(make_pods(64, requests={"cpu": "500m"}))
+        assert solver.last_solve_mode == "relax"
+        assert _mode_count("relax") == before + 1
+        assert not results.failed_pods
+        assert sum(len(d.pods) for d in results.new_nodes) == 64
+        for decision in results.new_nodes:
+            assert decision.selected is not None
+            assert decision.selected["zone"] == "test-zone-2"
+            assert decision.selected["capacity_type"] == "spot"
+        stats = solver.last_relax_stats
+        assert stats["converged"] and stats["rounded_violations"] == 0
+
+    def test_fleet_cost_parity_with_greedy(self):
+        """The acceptance floor: on the skewed uniform-size fleet the
+        relaxation's fleet must cost no more than the greedy scan's."""
+        _, scan_solver = _solver("scan")
+        _, relax_solver = _solver("relax")
+        pods = lambda: make_pods(200, requests={"cpu": "500m"})  # noqa: E731
+        scan_results = scan_solver.solve(pods())
+        relax_results = relax_solver.solve(pods())
+        assert relax_solver.last_solve_mode == "relax"
+        assert scan_results.fleet_cost is not None
+        assert relax_results.fleet_cost is not None
+        assert relax_results.fleet_cost <= scan_results.fleet_cost + 1e-6
+        assert not relax_results.failed_pods
+
+    def test_mixed_sizes_repair_places_everything(self):
+        """Mixed request sizes force per-class sub-node tails into the exact
+        repair leg; every pod still lands (approximate in cost, never wrong
+        or lost in placement)."""
+        _, solver = _solver("relax")
+        pods = []
+        for size in ({"cpu": "500m"}, {"cpu": 1}, {"cpu": "250m"}):
+            pods.extend(make_pods(40, requests=size))
+        results = solver.solve(pods)
+        assert solver.last_solve_mode == "relax"
+        assert not results.failed_pods
+        placed = sum(len(d.pods) for d in results.new_nodes)
+        assert placed == len(pods)
+        assert solver.last_relax_stats["rounded_violations"] == 0
+
+    def test_convergence_fallback(self, monkeypatch):
+        """An iteration cap too small to converge must fall the batch back
+        to the scan with the structured reason — and still place every pod."""
+        monkeypatch.setenv("KC_RELAX_MAX_ITERS", "1")
+        before = _mode_count("relax-fallback")
+        _, solver = _solver("relax")
+        results = solver.solve(make_pods(64, requests={"cpu": "500m"}))
+        assert solver.last_solve_mode == "relax-fallback:non-convergence"
+        assert _mode_count("relax-fallback") == before + 1
+        assert not results.failed_pods
+        assert sum(len(d.pods) for d in results.new_nodes) == 64
+
+    def test_existing_nodes_fall_back(self):
+        """The relaxation does not model existing-node planes; a stateful
+        solve routes to the scan with the gate's reason."""
+        from karpenter_core_tpu.apis import labels as labels_api
+        from karpenter_core_tpu.state.cluster import StateNode
+
+        provider, solver = _solver("relax")
+        it = provider.get_instance_types(None)[0]
+        nodes = [StateNode(make_node(
+            labels={
+                labels_api.PROVISIONER_NAME_LABEL_KEY: "default",
+                labels_api.LABEL_INSTANCE_TYPE_STABLE: it.name,
+            },
+            allocatable=it.allocatable(), capacity=dict(it.capacity),
+        ))]
+        results = solver.solve(
+            make_pods(16, requests={"cpu": "500m"}), state_nodes=nodes
+        )
+        assert solver.last_solve_mode == "relax-fallback:existing-nodes"
+        assert not results.failed_pods
+
+    def test_scan_mode_never_dispatches_relax(self):
+        before = _mode_count("relax") + _mode_count("relax-fallback")
+        _, solver = _solver("scan")
+        solver.solve(make_pods(32, requests={"cpu": "500m"}))
+        assert solver.last_solve_mode == "scan"
+        assert _mode_count("relax") + _mode_count("relax-fallback") == before
+
+
+# -- mesh determinism ----------------------------------------------------------
+
+
+class TestMeshDeterminism:
+    def test_sharded_rounding_bit_identical(self, monkeypatch):
+        """The rounding (seeded permutation + stable sorts) is shape-, not
+        layout-, defined: the catalog-sharded dispatch must commit the exact
+        placements of the single-device solve."""
+        import jax
+
+        from karpenter_core_tpu.models.columnar import PodIngest
+        from karpenter_core_tpu.parallel import mesh as mesh_ops
+
+        monkeypatch.setenv("KC_SOLVER_MESH", "1")
+        monkeypatch.setenv("KC_SOLVER_MESH_DEVICES", "8")
+        _, solver = _solver("relax", n_its=16)
+        ingest = PodIngest()
+        ingest.add_all(make_pods(96, requests={"cpu": "500m"}))
+        snapshot = solver.encode(ingest)
+        prep = solver.prepare_encoded(snapshot)
+        assert prep.mesh_axes == ((mesh_ops.CATALOG_AXIS, 8),)
+        sharded = solver.run_prepared(prep)
+        assert solver.last_solve_mode == "relax"
+        plain = solver.run_prepared(prep._replace(mesh_axes=None))
+        assert solver.last_solve_mode == "relax"
+        a, b = jax.device_get((sharded, plain))
+        for name, left, right in (
+            ("assign", a.assign, b.assign),
+            ("failed", a.failed, b.failed),
+            ("pod_count", a.state.pod_count, b.state.pod_count),
+            ("tmpl_id", a.state.tmpl_id, b.state.tmpl_id),
+            ("open", a.state.open_, b.state.open_),
+        ):
+            assert np.array_equal(np.asarray(left), np.asarray(right)), (
+                f"sharded relax diverged from single-device on {name!r}"
+            )
+        assert int(a.state.n_next) == int(b.state.n_next)
+
+
+# -- feasibility fuzz through the host validator -------------------------------
+
+
+class TestFeasibilityFuzz:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_no_wrong_placements(self, monkeypatch, seed):
+        """Random mixed fleets under KC_SOLVER_MODE=relax: whatever the
+        router decides per batch (relax, a gated fallback, or repair for
+        ineligible classes), every binding the controller commits must pass
+        the host validator's full constraint audit."""
+        from karpenter_core_tpu.apis import labels as labels_api
+        from karpenter_core_tpu.apis.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+        from karpenter_core_tpu.testing.harness import (
+            expect_provisioned,
+            make_environment,
+        )
+        from karpenter_core_tpu.testing.validator import expect_valid_placements
+
+        monkeypatch.setenv("KC_SOLVER_MODE", "relax")
+        rng = random.Random(SEED + seed)
+        env = make_environment(instance_types=fake_cp.instance_types(8))
+        env.kube.create(make_provisioner(name="default"))
+        env.provisioning.use_tpu_kernel = True
+        env.provisioning.tpu_kernel_min_pods = 1
+        pods = []
+        sizes = ({"cpu": "100m"}, {"cpu": "500m"}, {"cpu": 1},
+                 {"cpu": "250m", "memory": "512Mi"})
+        for cls_i in range(rng.randint(2, 4)):
+            labels = {"app": f"relax-fuzz-{cls_i}"}
+            kwargs = dict(labels=labels, requests=rng.choice(sizes))
+            if rng.random() < 0.3:
+                # a relax-INELIGIBLE shape: rides the exact repair leg
+                kwargs["topology_spread"] = [TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=labels_api.LABEL_TOPOLOGY_ZONE,
+                    label_selector=LabelSelector(match_labels=dict(labels)),
+                )]
+            pods.extend(make_pods(rng.randint(8, 48), **kwargs))
+        result = expect_provisioned(env, *pods)
+        assert all(node is not None for node in result.values())
+        expect_valid_placements(env, pods)
+
+    def test_fuzz_actually_exercised_relax(self, monkeypatch):
+        """The fuzz must not silently validate the scan three times: a
+        plain uniform batch at this scale dispatches the relaxation."""
+        monkeypatch.setenv("KC_SOLVER_MODE", "relax")
+        from karpenter_core_tpu.testing.harness import (
+            expect_provisioned,
+            make_environment,
+        )
+        from karpenter_core_tpu.testing.validator import expect_valid_placements
+
+        before = _mode_count("relax")
+        env = make_environment(instance_types=fake_cp.instance_types(8))
+        env.kube.create(make_provisioner(name="default"))
+        env.provisioning.use_tpu_kernel = True
+        env.provisioning.tpu_kernel_min_pods = 1
+        pods = make_pods(60, requests={"cpu": "500m"})
+        result = expect_provisioned(env, *pods)
+        assert all(node is not None for node in result.values())
+        expect_valid_placements(env, pods)
+        assert _mode_count("relax") == before + 1
+
+
+# -- incremental session escalation --------------------------------------------
+
+
+class TestModeChangedEscalation:
+    def test_mode_changed_forces_full(self):
+        from karpenter_core_tpu.models.store import SnapshotDelta
+
+        delta = SnapshotDelta(
+            from_version=1, to_version=2, pods_before=10, pods_after=10,
+            added={("k",): ("u1",)},
+        )
+        pol = FallbackPolicy(enabled=True, audit_interval=0)
+        assert pol.decide(delta, 0, 0, mode_changed=True) \
+            == (MODE_FULL, "mode-changed")
+        # mirrors mesh-changed: topology outranks family in the reason chain
+        assert pol.decide(delta, 0, 0, mesh_changed=True, mode_changed=True) \
+            == (MODE_FULL, "mesh-changed")
+        assert pol.decide(delta, 0, 0, mode_changed=False)[1] != "mode-changed"
+
+    def test_session_records_and_escalates_on_flip(self, monkeypatch):
+        """A live session anchored under one family re-anchors with a full
+        solve when the configured family flips — the lineage analogue of a
+        mesh-topology change."""
+        from karpenter_core_tpu.models.columnar import PodIngest
+        from karpenter_core_tpu.solver.incremental import IncrementalSolveSession
+
+        monkeypatch.delenv("KC_SOLVER_MODE", raising=False)
+        _, solver = _solver("scan")
+        session = IncrementalSolveSession(solver)
+        ingest = PodIngest()
+        ingest.add_all(make_pods(24, requests={"cpu": "500m"}))
+        session.solve(ingest)
+        assert session._warm is not None
+        assert session._warm.solve_mode == "scan"
+        solver.policy = PolicyConfig(enabled=True, solver_mode="relax")
+        ingest.add_all(make_pods(1, requests={"cpu": "500m"}))
+        session.solve(ingest)
+        assert session.last_reason == "mode-changed"
+        assert session._warm.solve_mode == "relax"

@@ -100,7 +100,8 @@ STAGE_KEYS = ("solve_decode_s", "solve_s", "decode_s", "ingest_s",
               "sharded_solve_s", "sharded_solve_1dev_s",
               "pipeline_warm_tick_s", "pipeline_serial_tick_s",
               "fleet_restore_s", "fleet_replay_s",
-              "fusion_repair_solve_s", "fusion_repair_serial_s")
+              "fusion_repair_solve_s", "fusion_repair_serial_s",
+              "relax_solve_s")
 # stages that matter enough to flag; the others are printed but only the
 # load-bearing ones gate (sub-10ms stages WARN on scheduler-noise otherwise)
 # objective_s gates too: the policy scoring stage rides every policy-enabled
@@ -141,7 +142,15 @@ GATED_STAGES = ("solve_decode_s", "solve_s", "decode_s", "ingest_s", "cold_s",
                 # coalescing numbers.  The serial twin stays advisory (it
                 # moves with solo repair cost, already gated by
                 # churn_warm_solve_s).
-                "fusion_repair_solve_s")
+                "fusion_repair_solve_s",
+                # the relaxation family's full pipeline wall (bench.py
+                # relax_line: PG solve + rounding + audit + exact repair) at
+                # the skewed-fleet size.  Gates independently of the scan
+                # stages: a relax-only regression — an extra device sync, a
+                # repair window gone full-width — must not hide behind a
+                # healthy scan solve_s (the scan twin in the same bench line
+                # is already covered by solve_s/churn stages).
+                "relax_solve_s")
 
 
 def compare_stages(detail: dict, prev_detail: dict, tol: float):
@@ -371,6 +380,45 @@ def report_policy(detail: dict) -> None:
         print(
             "perfgate: WARNING policy decode changed pod placements — the "
             "objective stage must select offerings, never reassign pods"
+        )
+
+
+def report_relax(detail: dict) -> None:
+    """Surface the relax-vs-scan solver family line (ISSUE-20,
+    docs/RELAX.md): both solve walls, the fleet-cost delta, and the audit's
+    violation count.  The enforced side is ``relax_solve_s`` in
+    GATED_STAGES; advisory warnings fire when the relaxation's fleet costs
+    MORE than the greedy scan (the acceptance yardstick is delta >= 0) or
+    when the routed mode shows the bench fell back to the scan — the numbers
+    then measure the scan twice and gate nothing relax-specific."""
+    relax = detail.get("relax")
+    if not relax:
+        return
+    if "error" in relax:
+        print(f"perfgate: relax bench errored: {relax['error']}")
+        return
+    print(
+        "perfgate: relax solve {r:.4f}s vs scan {s:.4f}s — fleet cost "
+        "{cr:.4f} vs {cs:.4f} (delta {d:.4f}), violations={v} "
+        "iters={i} leftover={lo} mode={m}".format(
+            r=relax["relax_solve_s"], s=relax["scan_solve_s"],
+            cr=relax["fleet_cost_relax"], cs=relax["fleet_cost_scan"],
+            d=relax["fleet_cost_delta"], v=relax["rounded_violations"],
+            i=relax["relax_iters"], lo=relax["relax_leftover"],
+            m=relax.get("relax_mode"),
+        )
+    )
+    if relax.get("relax_mode") != "relax":
+        print(
+            "perfgate: WARNING relax bench fell back to the scan "
+            f"({relax.get('relax_mode')}) — relax_solve_s measured the "
+            "greedy kernel, not the relaxation"
+        )
+    if relax.get("fleet_cost_delta", 0.0) < 0.0:
+        print(
+            "perfgate: WARNING relax fleet cost is worse than greedy — the "
+            "relaxation must match or beat the scan on the skewed bench "
+            "fleet (ISSUE-20 acceptance floor, docs/RELAX.md)"
         )
 
 
@@ -654,6 +702,7 @@ def main() -> int:
     report_churn(detail)
     report_pipeline(detail)
     report_policy(detail)
+    report_relax(detail)
     report_sharded(detail)
     report_tenant(detail)
     report_fusion(detail)
